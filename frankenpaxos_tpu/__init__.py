@@ -1,0 +1,17 @@
+"""frankenpaxos_tpu: a TPU-native state machine replication (SMR) framework.
+
+A ground-up rebuild of the capabilities of FrankenPaxos
+(https://github.com/zdmwi/frankenpaxos) designed TPU-first:
+
+- Protocol roles are pure, single-threaded event-loop state machines
+  (the reference's Actor/Transport contract, Transport.scala:37-40).
+- The hot loops -- quorum vote collection, watermark math, dependency-set
+  algebra -- are lifted off the per-message path into batched device
+  kernels over ``[slots x acceptors]`` matrices (``ops/``), evaluated as
+  single fused XLA reductions/matmuls per event-loop drain.
+- Multi-core scaling uses ``jax.sharding.Mesh`` + ``shard_map`` over the
+  slot axis (Mencius leader stripes, MultiPaxos acceptor groups), not
+  point-to-point message translation.
+"""
+
+__version__ = "0.1.0"

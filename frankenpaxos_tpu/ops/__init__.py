@@ -1,0 +1,20 @@
+"""Batched device kernels for the hot SMR loops.
+
+The reference framework spends its cycles in per-message JVM loops:
+Phase2b vote collection (multipaxos/ProxyLeader.scala:217-258), quorum
+predicates (quorums/), watermark math (util/QuorumWatermark.scala:31-50),
+and dependency-set algebra (epaxos/InstancePrefixSet.scala:12-60). Here
+those loops are data: a ``[window_slots x acceptors]`` vote matrix plus
+mask matrices, updated by scatters and evaluated by matmul/reductions in
+one fused XLA step per event-loop drain.
+"""
+
+from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker, VoteBoard
+from frankenpaxos_tpu.ops.watermark import quorum_watermark, quorum_watermark_vector
+
+__all__ = [
+    "TpuQuorumChecker",
+    "VoteBoard",
+    "quorum_watermark",
+    "quorum_watermark_vector",
+]

@@ -1,0 +1,98 @@
+"""Batched dependency-set algebra for EPaxos/BPaxos.
+
+Reference behavior: epaxos/InstancePrefixSet.scala:12-60 — a dependency
+set over vertex ids ``(leader, id)`` stored as one IntPrefixSet per leader
+column. On device a batch of dependency sets is:
+
+  * ``watermarks [B, L] int32``: per-leader prefix ("ids < w all present"),
+  * ``tails [B, L, W] uint8``: sparse window of ids in
+    ``[base, base + W)`` (absolute offsets from a shared GC base).
+
+Union = max of watermarks + OR of tails; equality, containment, and
+cardinality are elementwise reductions — the per-command set loops of
+epaxos/Replica.scala:1159-1420 become one fused step per drain.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DepSetBatch(NamedTuple):
+    watermarks: jax.Array  # [B, L] int32
+    tails: jax.Array       # [B, L, W] uint8, absolute base `tail_base`
+    tail_base: jax.Array   # [] int32: id of tail column 0
+
+
+@jax.jit
+def union(a: DepSetBatch, b: DepSetBatch) -> DepSetBatch:
+    """Rowwise union (EPaxos slow path unions deps across replies).
+
+    PRECONDITION: ``a.tail_base == b.tail_base``. Tails are OR'd
+    bit-for-bit, so both batches must window the same id range; callers
+    GC all batches to a shared base before combining (use
+    :func:`union_checked` from host code to enforce this).
+    """
+    return DepSetBatch(
+        watermarks=jnp.maximum(a.watermarks, b.watermarks),
+        tails=a.tails | b.tails,
+        tail_base=a.tail_base,
+    )
+
+
+def union_checked(a: DepSetBatch, b: DepSetBatch) -> DepSetBatch:
+    """Host-side union that enforces the shared-tail-base precondition."""
+    if int(a.tail_base) != int(b.tail_base):
+        raise ValueError(
+            f"dep-set unions need a shared tail base: "
+            f"{int(a.tail_base)} != {int(b.tail_base)}")
+    return union(a, b)
+
+
+@jax.jit
+def normalized(d: DepSetBatch) -> DepSetBatch:
+    """Clear tail bits already covered by the watermark, then absorb the
+    contiguous run at each watermark (IntPrefixSet compaction)."""
+    w = d.tails.shape[-1]
+    ids = d.tail_base + jnp.arange(w, dtype=jnp.int32)          # [W]
+    covered = ids[None, None, :] < d.watermarks[:, :, None]
+    tails = jnp.where(covered, jnp.uint8(0), d.tails)
+    # Absorb run: for each (b, l), advance watermark while next id present.
+    present_from = jnp.where(ids[None, None, :] >= d.watermarks[:, :, None],
+                             tails, jnp.uint8(1))
+    run = jnp.cumprod(present_from, axis=-1).sum(axis=-1)       # [B, L]
+    new_wm = jnp.maximum(d.watermarks, d.tail_base + run)
+    covered2 = ids[None, None, :] < new_wm[:, :, None]
+    return DepSetBatch(new_wm, jnp.where(covered2, jnp.uint8(0), tails),
+                       d.tail_base)
+
+
+@jax.jit
+def equal(a: DepSetBatch, b: DepSetBatch) -> jax.Array:
+    """[B] bool rowwise set equality (EPaxos fast-path identical-deps test).
+    Callers must pass normalized batches."""
+    return (jnp.all(a.watermarks == b.watermarks, axis=-1)
+            & jnp.all(a.tails == b.tails, axis=(-1, -2)))
+
+
+@jax.jit
+def contains(d: DepSetBatch, leader: jax.Array, vid: jax.Array) -> jax.Array:
+    """[B] bool: does each row contain vertex (leader[b], vid[b])?"""
+    b = d.watermarks.shape[0]
+    rows = jnp.arange(b)
+    in_prefix = vid < d.watermarks[rows, leader]
+    off = vid - d.tail_base
+    off_c = jnp.clip(off, 0, d.tails.shape[-1] - 1)
+    in_tail = (d.tails[rows, leader, off_c] > 0) & (off >= 0) \
+        & (off < d.tails.shape[-1])
+    return in_prefix | in_tail
+
+
+@jax.jit
+def size(d: DepSetBatch) -> jax.Array:
+    """[B] int32 cardinality (assumes normalized rows)."""
+    return (d.watermarks.sum(-1)
+            + d.tails.astype(jnp.int32).sum(axis=(-1, -2)))

@@ -1,0 +1,319 @@
+"""The TpuQuorumChecker: batched quorum-vote aggregation on device.
+
+This is the keystone kernel of the framework (BASELINE.json north star).
+It replaces the reference's per-message vote-collection loops --
+multipaxos/ProxyLeader.scala:217-258 (Phase2b -> Chosen),
+multipaxos/Leader.scala:504-576 (Phase1b quorums),
+multipaxos/Client.scala:851-933 (MaxSlot read quorums) -- with a
+persistent device **vote board** plus one jitted, state-donating step per
+event-loop drain.
+
+Layout (TPU-first): the board is ``votes[acceptors, window]`` --
+**slot-major along the 128-wide lane dimension**. A ``[window, n_acc]``
+layout with a tiny trailing dim wastes >95% of every (8, 128) TPU tile;
+transposed, every op runs at full lane utilization (measured ~40x faster
+on v5e).
+
+Two update paths:
+
+  * **dense blocks** (the hot path): slots are allocated contiguously, so
+    a drain's votes for slot range ``[start, start+B)`` are a dense
+    ``[n, B]`` bitmask applied with ``dynamic_update_slice`` -- no
+    scatter at all. Measured ~1.5-4G slot-checks/s on one v5e core.
+  * **sparse scatter** (stragglers, retries, out-of-order): classic
+    ``.at[nodes, slots].max`` scatter; ~40x slower per element but only
+    used for the thin out-of-order tail.
+
+The quorum predicate itself is ``counts = masks @ votes_block`` (a
+``[G, N] x [N, B]`` matmul) + compare + any/all over groups -- see
+quorums/spec.py for how every quorum system factors into this form.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.quorums.spec import ANY, QuorumSpec
+
+_NEG_INF32 = jnp.int32(-(2**31) + 1)
+
+
+class VoteBoard(NamedTuple):
+    """Per-slot vote-collection state for a window of slots.
+
+    The window is a ring over slot space: column ``slot % window`` holds
+    slot ``slot`` (callers must GC -- see ``release`` -- before wrapping,
+    the device analog of util/BufferMap.scala:8-66's watermark contract).
+    """
+
+    votes: jax.Array   # [n, window] uint8: acceptor voted in `rounds[slot]`
+    rounds: jax.Array  # [window] int32: highest round seen per slot
+    chosen: jax.Array  # [window] bool: quorum already reached
+
+
+def make_vote_board(window: int, num_nodes: int) -> VoteBoard:
+    return VoteBoard(
+        votes=jnp.zeros((num_nodes, window), dtype=jnp.uint8),
+        rounds=jnp.full((window,), -1, dtype=jnp.int32),
+        chosen=jnp.zeros((window,), dtype=jnp.bool_),
+    )
+
+
+def _quorum_hit(votes_block: jax.Array, masks: jax.Array,
+                thresholds: jax.Array, combine_any: bool) -> jax.Array:
+    """``[B]`` bool from a ``[N, B]`` vote block: the predicate matmul."""
+    counts = masks @ votes_block.astype(jnp.int32)        # [G, B]
+    satisfied = counts >= thresholds[:, None]
+    return satisfied.any(0) if combine_any else satisfied.all(0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(5, 6))
+def _record_and_check(
+    board: VoteBoard,
+    slots: jax.Array,      # [B] int32, already reduced mod window
+    nodes: jax.Array,      # [B] int32 acceptor rows
+    vote_rounds: jax.Array,  # [B] int32
+    valid: jax.Array,      # [B] bool (padding mask for partial batches)
+    masks_t: tuple,        # static: ((row, ...), ...) -> rebuilt as [G, N]
+    meta: tuple,           # static: (thresholds tuple, combine_any bool)
+) -> tuple[VoteBoard, jax.Array]:
+    """Sparse path: out-of-order / straggler votes. O(batch) work."""
+    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))  # [G, N]
+    thresholds, combine_any = meta
+    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
+
+    old_rounds = board.rounds[slots]                            # [B]
+    new_rounds = board.rounds.at[slots].max(
+        jnp.where(valid, vote_rounds, _NEG_INF32))
+    cur = new_rounds[slots]                                     # [B]
+    # A newer round preempts: clear the slot's votes (ProxyLeader state is
+    # per (slot, round); an old column must not count toward the new
+    # round). `preempted` depends only on slot-level values, so duplicate
+    # batch entries for one slot all scatter identical columns.
+    preempted = cur > old_rounds                                # [B]
+    cols = board.votes[:, slots]                                # [N, B]
+    cols = jnp.where(preempted[None, :], jnp.uint8(0), cols)
+    votes = board.votes.at[:, slots].set(cols)
+    # Record votes that are for the slot's (possibly new) current round.
+    live = valid & (vote_rounds == cur)
+    votes = votes.at[nodes, slots].max(live.astype(jnp.uint8))
+
+    # Quorum predicate for exactly the touched columns (duplicates are
+    # fine: they see identical post-scatter state).
+    hit = _quorum_hit(votes[:, slots], masks, thresholds, combine_any)
+    hit = hit & valid
+    newly = hit & ~board.chosen[slots]
+    chosen = board.chosen.at[slots].max(hit)
+    return VoteBoard(votes, new_rounds, chosen), newly
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5, 6))
+def _record_block(
+    board: VoteBoard,
+    start: jax.Array,        # [] int32 ring offset of the block
+    block: jax.Array,        # [N, B] uint8 vote arrivals for these slots
+    vote_round: jax.Array,   # [] int32: round all these votes belong to
+    block_size: int,         # static
+    masks_t: tuple,
+    meta: tuple,
+) -> tuple[VoteBoard, jax.Array]:
+    """Dense path: votes for a contiguous slot block, one round.
+
+    The steady-state Phase2b stream (Leader.scala:331-408 allocates slots
+    contiguously; ProxyLeader collects in slot order) maps here: no
+    scatter, only slicing. Returns the ``[B]`` newly-chosen mask.
+    """
+    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
+    thresholds, combine_any = meta
+    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
+    n = board.votes.shape[0]
+
+    old_rounds = jax.lax.dynamic_slice(board.rounds, (start,), (block_size,))
+    new_rounds = jnp.maximum(old_rounds, vote_round)
+    preempted = new_rounds > old_rounds
+    cols = jax.lax.dynamic_slice(board.votes, (0, start), (n, block_size))
+    cols = jnp.where(preempted[None, :], jnp.uint8(0), cols)
+    live = vote_round == new_rounds                            # [B]
+    cols = cols | (block & live[None, :].astype(jnp.uint8))
+
+    hit = _quorum_hit(cols, masks, thresholds, combine_any)
+    old_chosen = jax.lax.dynamic_slice(board.chosen, (start,), (block_size,))
+    newly = hit & ~old_chosen
+    return VoteBoard(
+        votes=jax.lax.dynamic_update_slice(board.votes, cols, (0, start)),
+        rounds=jax.lax.dynamic_update_slice(board.rounds, new_rounds,
+                                            (start,)),
+        chosen=jax.lax.dynamic_update_slice(board.chosen, hit | old_chosen,
+                                            (start,)),
+    ), newly
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _release(board: VoteBoard, slots: jax.Array, valid: jax.Array) -> VoteBoard:
+    """Reset columns for GC'd slots so the ring can wrap
+    (BufferMap.scala:55-62)."""
+    votes = board.votes.at[:, slots].set(
+        jnp.where(valid[None, :], jnp.uint8(0), board.votes[:, slots]))
+    rounds = board.rounds.at[slots].set(
+        jnp.where(valid, jnp.int32(-1), board.rounds[slots]))
+    chosen = board.chosen.at[slots].set(
+        jnp.where(valid, False, board.chosen[slots]))
+    return VoteBoard(votes, rounds, chosen)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _check_batch(present: jax.Array, masks_t: tuple, meta: tuple) -> jax.Array:
+    """``[B, N]`` responder rows -> ``[B]`` bool (stateless)."""
+    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
+    thresholds, combine_any = meta
+    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
+    return _quorum_hit(present.T, masks, thresholds, combine_any)
+
+
+@jax.jit
+def _check_batch_multi(
+    present: jax.Array,       # [B, N]
+    config_idx: jax.Array,    # [B] int32
+    masks: jax.Array,         # [K, G, N]
+    thresholds: jax.Array,    # [K, G]
+    combine_any: jax.Array,   # [K] bool
+) -> jax.Array:
+    """Per-row quorum check under per-row configurations.
+
+    This is the Matchmaker reconfiguration shape (SURVEY.md section 2.3):
+    quorum systems change per round, so each checked row selects its own
+    padded (masks, thresholds) plane.
+    """
+    sel_masks = masks[config_idx].astype(jnp.int32)        # [B, G, N]
+    counts = jnp.einsum("bn,bgn->bg", present.astype(jnp.int32), sel_masks)
+    satisfied = counts >= thresholds[config_idx]
+    return jnp.where(combine_any[config_idx],
+                     satisfied.any(-1), satisfied.all(-1))
+
+
+def _spec_statics(spec: QuorumSpec) -> tuple[tuple, tuple]:
+    masks_t = tuple(tuple(int(x) for x in row) for row in spec.masks)
+    meta = (tuple(int(t) for t in spec.thresholds), spec.combine == ANY)
+    return masks_t, meta
+
+
+class TpuQuorumChecker:
+    """Stateful batched quorum checking for one quorum predicate.
+
+    Typical use (ProxyLeader Phase2b path)::
+
+        checker = TpuQuorumChecker(qs.write_spec(), window=1 << 20)
+        # hot path: contiguous slot block, dense [n, B] arrival mask
+        newly = checker.record_block(start_slot, arrivals, round=3)
+        # thin tail: out-of-order votes
+        newly = checker.record_and_check(slots, acceptor_cols, rounds)
+
+    One call per event-loop drain, thousands of votes per call.
+    """
+
+    def __init__(self, spec: QuorumSpec, window: int):
+        if window <= 0:
+            raise ValueError("window must be positive")
+        self.spec = spec
+        self.window = window
+        self.num_nodes = spec.num_nodes
+        self._masks_t, self._meta = _spec_statics(spec)
+        self.board = make_vote_board(window, spec.num_nodes)
+
+    def record_block(self, start_slot: int, block: np.ndarray,
+                     vote_round: int = 0) -> np.ndarray:
+        """Dense path: record ``block[n, B]`` arrivals for slots
+        ``[start_slot, start_slot + B)`` (must not straddle the ring end);
+        return the ``[B]`` newly-chosen mask."""
+        n, b = block.shape
+        if n != self.num_nodes:
+            raise ValueError(f"block has {n} acceptor rows, spec has "
+                             f"{self.num_nodes}")
+        start = start_slot % self.window
+        if start + b > self.window:
+            raise ValueError(
+                f"block [{start}, {start + b}) straddles the ring end "
+                f"(window {self.window}); split it")
+        self.board, newly = _record_block(
+            self.board, jnp.int32(start), jnp.asarray(block, dtype=jnp.uint8),
+            jnp.int32(vote_round), b, self._masks_t, self._meta)
+        return np.asarray(newly)
+
+    def record_and_check(
+        self,
+        slots: Sequence[int] | np.ndarray,
+        node_cols: Sequence[int] | np.ndarray,
+        rounds: Sequence[int] | np.ndarray | None = None,
+        pad_to: int | None = None,
+    ) -> np.ndarray:
+        """Sparse path: record out-of-order votes; return per-vote "slot
+        newly has quorum".
+
+        Duplicate slots in one batch each report quorum; callers dedup
+        (the host side keeps the small pending-slot dict, as ProxyLeader
+        keeps `states`, ProxyLeader.scala:135).
+        """
+        slots = np.asarray(slots, dtype=np.int32)
+        b = slots.shape[0]
+        if rounds is None:
+            rounds = np.zeros(b, dtype=np.int32)
+        if pad_to is None:
+            # Bucket to powers of two so variable drain sizes compile
+            # O(log max_batch) kernels, not one per size.
+            pad_to = 64
+            while pad_to < b:
+                pad_to *= 2
+        size = max(pad_to, b)
+        slots_p = np.zeros(size, dtype=np.int32)
+        nodes_p = np.zeros(size, dtype=np.int32)
+        rounds_p = np.zeros(size, dtype=np.int32)
+        valid = np.zeros(size, dtype=bool)
+        slots_p[:b] = slots % self.window
+        nodes_p[:b] = np.asarray(node_cols, dtype=np.int32)
+        rounds_p[:b] = np.asarray(rounds, dtype=np.int32)
+        valid[:b] = True
+        self.board, newly = _record_and_check(
+            self.board, jnp.asarray(slots_p), jnp.asarray(nodes_p),
+            jnp.asarray(rounds_p), jnp.asarray(valid),
+            self._masks_t, self._meta)
+        return np.asarray(newly)[:b]
+
+    def release(self, slots: Sequence[int] | np.ndarray) -> None:
+        """GC slot columns below the chosen watermark so the ring can wrap."""
+        slots = np.asarray(slots, dtype=np.int32) % self.window
+        valid = np.ones(slots.shape[0], dtype=bool)
+        self.board = _release(self.board, jnp.asarray(slots),
+                              jnp.asarray(valid))
+
+    def check_batch(self, present: np.ndarray) -> np.ndarray:
+        """Stateless: evaluate the predicate for ``[B, N]`` responder rows."""
+        return np.asarray(_check_batch(jnp.asarray(present), self._masks_t,
+                                       self._meta))
+
+
+class MultiConfigQuorumChecker:
+    """Stateless batched checks where each row picks its own quorum system.
+
+    Built from :func:`frankenpaxos_tpu.quorums.spec.pad_specs`; serves
+    Matchmaker per-round configurations and mixed acceptor-group grids.
+    """
+
+    def __init__(self, specs: Sequence[QuorumSpec]):
+        from frankenpaxos_tpu.quorums.spec import pad_specs
+
+        masks, thresholds, combine_any = pad_specs(specs)
+        self.universe = specs[0].universe
+        self._masks = jnp.asarray(masks)
+        self._thresholds = jnp.asarray(thresholds)
+        self._combine_any = jnp.asarray(combine_any)
+
+    def check_batch(self, present: np.ndarray,
+                    config_idx: np.ndarray) -> np.ndarray:
+        return np.asarray(_check_batch_multi(
+            jnp.asarray(present), jnp.asarray(config_idx, dtype=jnp.int32),
+            self._masks, self._thresholds, self._combine_any))

@@ -1,0 +1,63 @@
+"""Batched Phase-1 safe-value selection.
+
+Reference behavior: multipaxos/Leader.scala:318-330 (``safeValue``): given
+the Phase1b votes for a slot, adopt the value with the highest vote round,
+or a Noop if no acceptor voted. The same masked-argmax shape serves Fast
+Paxos recovery (any value voted by enough acceptors) and EPaxos fast-path
+"k identical replies" tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NO_VOTE = -1
+
+
+@jax.jit
+def safe_values(vote_rounds: jax.Array, value_ids: jax.Array
+                ) -> tuple[jax.Array, jax.Array]:
+    """Per-slot highest-round vote.
+
+    Args:
+      vote_rounds: ``[S, N]`` int32; ``NO_VOTE`` where acceptor didn't vote.
+      value_ids: ``[S, N]`` int32 ids naming each acceptor's voted value
+        (host keeps the id -> bytes table).
+
+    Returns:
+      ``(has_vote [S] bool, value_id [S] int32)``; ``value_id`` is arbitrary
+      (first argmax) where ``has_vote`` is False -- callers substitute Noop
+      (Leader.scala:318-330).
+    """
+    best = jnp.argmax(vote_rounds, axis=-1)
+    best_round = jnp.take_along_axis(vote_rounds, best[:, None], axis=-1)[:, 0]
+    chosen_value = jnp.take_along_axis(value_ids, best[:, None], axis=-1)[:, 0]
+    return best_round > NO_VOTE, chosen_value
+
+
+@jax.jit
+def count_matching_replies(reply_value_ids: jax.Array, valid: jax.Array
+                           ) -> tuple[jax.Array, jax.Array]:
+    """Per-slot modal reply and its multiplicity.
+
+    EPaxos takes the fast path when ``f + (f+1)/2`` PreAcceptOks carry
+    identical (seq, deps) (epaxos/Replica.scala:1291-1420); Fast Paxos
+    needs "some value voted by >= k acceptors". Both reduce to: for each
+    row of reply ids, the most frequent valid id and its count.
+
+    Args:
+      reply_value_ids: ``[S, N]`` int32 ids (hash of reply content).
+      valid: ``[S, N]`` bool.
+
+    Returns:
+      ``(modal_id [S] int32, count [S] int32)``.
+    """
+    # Pairwise-equality count: O(N^2) per row, tiny N, MXU/VPU friendly.
+    eq = (reply_value_ids[:, :, None] == reply_value_ids[:, None, :])
+    eq = eq & valid[:, :, None] & valid[:, None, :]
+    counts = eq.sum(-1)                      # [S, N]: votes agreeing with col
+    best = jnp.argmax(counts, axis=-1)
+    modal = jnp.take_along_axis(reply_value_ids, best[:, None], axis=-1)[:, 0]
+    count = jnp.take_along_axis(counts, best[:, None], axis=-1)[:, 0]
+    return modal, count

@@ -1,0 +1,45 @@
+"""Device watermark math.
+
+The reference computes quorum watermarks by sorting small buffers per call
+(util/QuorumWatermark.scala:42-49); replicas find executable log prefixes
+by walking the log one entry at a time (multipaxos/Replica.scala:394-453).
+Here both are batched reductions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def quorum_watermark(watermarks: jax.Array, quorum_size: jax.Array) -> jax.Array:
+    """Largest w such that >= quorum_size of ``watermarks[..., n]`` are >= w.
+
+    Sorted ascending, that's element ``n - quorum_size``
+    (QuorumWatermark.scala:42-49).
+    """
+    n = watermarks.shape[-1]
+    sorted_w = jnp.sort(watermarks, axis=-1)
+    return jnp.take_along_axis(
+        sorted_w, jnp.broadcast_to(n - quorum_size, sorted_w.shape[:-1])[..., None],
+        axis=-1)[..., 0]
+
+
+def quorum_watermark_vector(watermarks: np.ndarray, quorum_size: int) -> np.ndarray:
+    """Columnwise quorum watermark over ``[n, depth]``
+    (QuorumWatermarkVector.scala:20+)."""
+    return np.asarray(
+        quorum_watermark(jnp.asarray(watermarks).T, jnp.int32(quorum_size)))
+
+
+@jax.jit
+def contiguous_prefix_length(present: jax.Array) -> jax.Array:
+    """Length of the all-True prefix of a bool vector.
+
+    The replica's executeLog advances its executed watermark to the end of
+    the contiguous chosen prefix (Replica.scala:394-453); on device that's
+    ``sum(cumprod(present))``.
+    """
+    return jnp.cumprod(present.astype(jnp.int32), axis=-1).sum(axis=-1)

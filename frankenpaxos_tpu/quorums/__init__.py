@@ -1,0 +1,32 @@
+"""Read/write quorum systems (Flexible-Paxos style).
+
+Reference behavior: shared/src/main/scala/frankenpaxos/quorums/
+(QuorumSystem.scala:16-24, SimpleMajority.scala:19-56, Grid.scala:5-57,
+UnanimousWrites.scala:17-57).
+
+The TPU-first design factors every quorum system into a :class:`QuorumSpec`
+-- a ``[groups x nodes]`` membership-mask matrix plus per-group thresholds
+and an any/all combiner -- so that "is this set of responders a quorum?"
+becomes one matmul + compare + reduction, batched over a whole window of
+slots on the MXU (see ops/quorum.py).
+"""
+
+from frankenpaxos_tpu.quorums.spec import QuorumSpec
+from frankenpaxos_tpu.quorums.systems import (
+    Grid,
+    QuorumSystem,
+    SimpleMajority,
+    UnanimousWrites,
+    quorum_system_from_dict,
+    quorum_system_to_dict,
+)
+
+__all__ = [
+    "QuorumSpec",
+    "QuorumSystem",
+    "SimpleMajority",
+    "Grid",
+    "UnanimousWrites",
+    "quorum_system_from_dict",
+    "quorum_system_to_dict",
+]

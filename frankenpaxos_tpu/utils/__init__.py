@@ -1,0 +1,14 @@
+"""Host-side log-storage and watermark utilities (reference: util/)."""
+
+from frankenpaxos_tpu.utils.buffer_map import BufferMap
+from frankenpaxos_tpu.utils.topk import TopK, TopOne, VertexIdLike
+from frankenpaxos_tpu.utils.watermark import QuorumWatermark, QuorumWatermarkVector
+
+__all__ = [
+    "BufferMap",
+    "QuorumWatermark",
+    "QuorumWatermarkVector",
+    "TopOne",
+    "TopK",
+    "VertexIdLike",
+]

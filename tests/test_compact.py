@@ -1,0 +1,113 @@
+"""IntPrefixSet semantics vs. a plain-set oracle.
+
+Mirrors compact/IntPrefixSetTest.scala.
+"""
+
+import random
+
+from frankenpaxos_tpu.compact import FakeCompactSet, IntPrefixSet
+
+
+def test_basic_add_contains():
+    s = IntPrefixSet()
+    assert not s.contains(0)
+    assert s.add(0) is False       # wasn't present
+    assert s.add(0) is True        # now it is
+    assert s.watermark == 1        # compacted into watermark
+    s.add(2)
+    assert s.contains(2)
+    assert not s.contains(1)
+    s.add(1)
+    assert s.watermark == 3        # 0,1,2 all compacted
+    assert s.uncompacted_size == 0
+
+
+def test_from_watermark_and_set():
+    s = IntPrefixSet(3, {5, 7})
+    assert s.contains(0) and s.contains(2)
+    assert not s.contains(3)
+    assert s.contains(5) and s.contains(7)
+    assert s.size == 5
+    assert s.materialize() == {0, 1, 2, 5, 7}
+
+
+def test_compaction_on_construction():
+    s = IntPrefixSet(2, {2, 3, 6})
+    assert s.watermark == 4
+    assert s.values == {6}
+
+
+def test_union_diff():
+    a = IntPrefixSet(3, {5})
+    b = IntPrefixSet(1, {2, 8})
+    u = a.union(b)
+    assert u.materialize() == {0, 1, 2, 5, 8}
+    d = a.diff(b)
+    assert d.materialize() == {1, 5}  # a = {0,1,2,5}; b = {0,2,8}
+
+
+def test_subtract_one_below_watermark():
+    s = IntPrefixSet(4, set())
+    s.subtract_one(2)
+    assert s.materialize() == {0, 1, 3}
+    assert s.watermark == 2  # re-compacted prefix 0,1
+
+
+def test_subset_is_monotone():
+    s = IntPrefixSet(3, {10})
+    sub = s.subset()
+    assert sub.materialize() <= s.materialize()
+    s.add(3)
+    assert sub.materialize() <= s.materialize()
+
+
+def test_wire_roundtrip():
+    s = IntPrefixSet(3, {7, 9})
+    back = IntPrefixSet.from_dict(s.to_dict())
+    assert back == s
+
+
+def test_randomized_vs_set_oracle():
+    rng = random.Random(99)
+    s = IntPrefixSet()
+    oracle: set[int] = set()
+    for _ in range(500):
+        op = rng.random()
+        x = rng.randrange(40)
+        if op < 0.6:
+            assert s.add(x) == (x in oracle)
+            oracle.add(x)
+        elif op < 0.8:
+            s.subtract_one(x)
+            oracle.discard(x)
+        else:
+            other_vals = {rng.randrange(40) for _ in range(3)}
+            other = IntPrefixSet.from_set(other_vals)
+            if rng.random() < 0.5:
+                s.add_all(other)
+                oracle |= other_vals
+            else:
+                s.subtract_all(other)
+                oracle -= other_vals
+        assert s.materialize() == oracle
+        assert s.size == len(oracle)
+        for probe in range(45):
+            assert s.contains(probe) == (probe in oracle)
+
+
+def test_diff_iterator_matches_materialized():
+    rng = random.Random(5)
+    for _ in range(50):
+        a = IntPrefixSet(rng.randrange(10),
+                         {rng.randrange(30) for _ in range(5)})
+        b = IntPrefixSet(rng.randrange(10),
+                         {rng.randrange(30) for _ in range(5)})
+        assert set(a.materialized_diff(b)) == a.materialize() - b.materialize()
+
+
+def test_fake_compact_set():
+    s = FakeCompactSet([1, 2])
+    assert s.add(1) is True
+    assert s.add(5) is False
+    assert s.union(FakeCompactSet([9])).materialize() == {1, 2, 5, 9}
+    assert s.diff(FakeCompactSet([2])).materialize() == {1, 5}

@@ -1,0 +1,188 @@
+"""TpuQuorumChecker vs. the host oracle, including round preemption and GC."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.ops.quorum import MultiConfigQuorumChecker, TpuQuorumChecker
+from frankenpaxos_tpu.quorums import Grid, SimpleMajority, UnanimousWrites
+
+
+def test_check_batch_matches_oracle():
+    qs = Grid([[0, 1, 2], [3, 4, 5]])
+    spec = qs.write_spec()
+    subsets = [set(c) for r in range(7)
+               for c in itertools.combinations(range(6), r)]
+    present = np.stack([spec.present_vector(s) for s in subsets])
+    checker = TpuQuorumChecker(spec, window=8)
+    got = checker.check_batch(present)
+    expected = spec.evaluate(present)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_record_and_check_simple_majority():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=16)
+    # Two votes for slot 5 in round 0: second one completes the majority.
+    newly = checker.record_and_check([5, 5], [0, 1], [0, 0])
+    # Both batch entries see post-batch state: quorum reached.
+    assert newly.any()
+    # Re-voting an already-chosen slot doesn't report it again.
+    newly = checker.record_and_check([5], [2], [0])
+    assert not newly.any()
+    # A different slot is independent.
+    newly = checker.record_and_check([6], [0], [0])
+    assert not newly.any()
+    newly = checker.record_and_check([6], [2], [0])
+    assert newly.any()
+
+
+def test_round_preemption_clears_votes():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=16)
+    assert not checker.record_and_check([3], [0], [0]).any()
+    # A vote in a higher round wipes the round-0 vote: still no quorum.
+    assert not checker.record_and_check([3], [1], [5]).any()
+    # An old-round vote is discarded.
+    assert not checker.record_and_check([3], [2], [0]).any()
+    # Second vote in round 5 completes the quorum.
+    assert checker.record_and_check([3], [0], [5]).any()
+
+
+def test_release_recycles_rows():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=4)
+    assert checker.record_and_check([1, 1], [0, 1], [0, 0]).any()
+    checker.release([1])
+    # Slot 5 maps to the same ring row; it must start clean.
+    assert not checker.record_and_check([5], [0], [0]).any()
+    assert checker.record_and_check([5], [1], [0]).any()
+
+
+def test_randomized_against_host_oracle():
+    """Random vote streams: device chosen-set == host replay."""
+    rng = random.Random(1234)
+    qs = Grid([[0, 1], [2, 3]])
+    spec = qs.write_spec()
+    window = 32
+    checker = TpuQuorumChecker(spec, window=window)
+
+    host_rounds = {}  # slot -> round
+    host_votes = {}   # slot -> set of cols
+    host_chosen = set()
+
+    for _ in range(30):
+        batch = max(1, rng.randrange(8))
+        slots = [rng.randrange(window) for _ in range(batch)]
+        cols = [rng.randrange(4) for _ in range(batch)]
+        rounds = [rng.randrange(3) for _ in range(batch)]
+        newly = checker.record_and_check(slots, cols, rounds)
+        # Host replay with identical semantics (batch max-round first).
+        batch_round = {}
+        for s, r in zip(slots, rounds):
+            batch_round[s] = max(batch_round.get(s, -1), r)
+        for s, r in batch_round.items():
+            if r > host_rounds.get(s, -1):
+                host_rounds[s] = r
+                host_votes[s] = set()
+        for s, c, r in zip(slots, cols, rounds):
+            if r == host_rounds.get(s, -1):
+                host_votes.setdefault(s, set()).add(c)
+        newly_host = set()
+        for s in set(slots):
+            if s not in host_chosen and spec.check(host_votes.get(s, set())):
+                newly_host.add(s)
+                host_chosen.add(s)
+        got = {s for s, n in zip(slots, newly) if n}
+        assert got == newly_host, (got, newly_host)
+
+
+def test_padding_invalid_entries_ignored():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=8)
+    newly = checker.record_and_check([2], [0], [0], pad_to=64)
+    assert newly.shape == (1,)
+    assert not newly.any()
+    # The padded (slot 0, node 0, round 0) lanes must not have voted:
+    # nodes 1 and 2 alone must be what completes the majority for slot 0.
+    state = np.asarray(checker.board.votes)
+    assert state[0, 0] == 0
+    assert checker.record_and_check([0, 0], [1, 2], [0, 0]).any()
+
+
+def test_record_block_dense_path():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=64)
+    # Acceptors 0 and 1 vote for slots [8, 16); acceptor 2 silent.
+    block = np.zeros((3, 8), dtype=np.uint8)
+    block[0, :] = 1
+    block[1, :4] = 1
+    newly = checker.record_block(8, block)
+    np.testing.assert_array_equal(newly, [True] * 4 + [False] * 4)
+    # Acceptor 2 completes the rest; first 4 not re-reported.
+    block2 = np.zeros((3, 8), dtype=np.uint8)
+    block2[2, :] = 1
+    newly = checker.record_block(8, block2)
+    np.testing.assert_array_equal(newly, [False] * 4 + [True] * 4)
+
+
+def test_record_block_round_preemption():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=64)
+    block = np.zeros((3, 4), dtype=np.uint8)
+    block[0, :] = 1
+    assert not checker.record_block(0, block, vote_round=0).any()
+    # Higher round clears acceptor 0's round-0 votes.
+    block1 = np.zeros((3, 4), dtype=np.uint8)
+    block1[1, :] = 1
+    assert not checker.record_block(0, block1, vote_round=2).any()
+    # Stale round-0 votes are ignored.
+    block2 = np.zeros((3, 4), dtype=np.uint8)
+    block2[2, :] = 1
+    assert not checker.record_block(0, block2, vote_round=0).any()
+    # Completing round 2 chooses.
+    assert checker.record_block(0, block, vote_round=2).all()
+
+
+def test_record_block_mixed_with_sparse():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=64)
+    block = np.zeros((3, 8), dtype=np.uint8)
+    block[0, :] = 1
+    assert not checker.record_block(16, block).any()
+    # Straggler vote via the sparse path completes slot 20 only.
+    newly = checker.record_and_check([20], [1], [0])
+    assert newly.all()
+
+
+def test_record_block_straddle_rejected():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=16)
+    with pytest.raises(ValueError):
+        checker.record_block(12, np.zeros((3, 8), dtype=np.uint8))
+    with pytest.raises(ValueError):
+        checker.record_block(0, np.zeros((2, 8), dtype=np.uint8))
+
+
+def test_multi_config_checker():
+    universe = tuple(range(6))
+    grid = Grid([[0, 1, 2], [3, 4, 5]])
+    maj = SimpleMajority([0, 1, 2, 3, 4])
+    una = UnanimousWrites([0, 1, 2])
+    specs = [grid.write_spec().reindexed(universe),
+             maj.write_spec().reindexed(universe),
+             una.write_spec().reindexed(universe)]
+    checker = MultiConfigQuorumChecker(specs)
+
+    rng = random.Random(9)
+    rows, cfgs, expected = [], [], []
+    for _ in range(200):
+        xs = {i for i in range(6) if rng.random() < 0.5}
+        k = rng.randrange(3)
+        rows.append(specs[k].present_vector(xs))
+        cfgs.append(k)
+        expected.append(specs[k].check(xs))
+    got = checker.check_batch(np.stack(rows), np.array(cfgs))
+    np.testing.assert_array_equal(got, np.array(expected))

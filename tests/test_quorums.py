@@ -1,0 +1,177 @@
+"""Quorum systems vs. brute force; matrix specs vs. set semantics.
+
+Mirrors the reference's quorums tests (shared/src/test/scala/quorums/:
+SimpleMajorityTest, GridTest, QuorumSystemTest).
+"""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.quorums import (
+    Grid,
+    QuorumSpec,
+    SimpleMajority,
+    UnanimousWrites,
+    quorum_system_from_dict,
+    quorum_system_to_dict,
+)
+
+
+def all_subsets(nodes):
+    nodes = sorted(nodes)
+    for r in range(len(nodes) + 1):
+        yield from (set(c) for c in itertools.combinations(nodes, r))
+
+
+def brute_is_majority(xs, members):
+    return len(set(xs) & set(members)) >= len(members) // 2 + 1
+
+
+class TestSimpleMajority:
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            SimpleMajority([])
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5])
+    def test_matches_brute_force(self, n):
+        members = list(range(10, 10 + n))
+        qs = SimpleMajority(members)
+        for xs in all_subsets(members):
+            expected = brute_is_majority(xs, members)
+            assert qs.is_read_quorum(xs) == expected
+            assert qs.is_write_quorum(xs) == expected
+            assert qs.is_superset_of_read_quorum(xs) == expected
+
+    def test_superset_allows_foreign_nodes(self):
+        qs = SimpleMajority([0, 1, 2])
+        assert qs.is_superset_of_read_quorum({0, 1, 99})
+        assert not qs.is_superset_of_read_quorum({0, 99})
+        with pytest.raises(ValueError):
+            qs.is_read_quorum({0, 1, 99})
+
+    def test_random_quorums_are_quorums(self):
+        rng = random.Random(17)
+        qs = SimpleMajority(range(5))
+        for _ in range(50):
+            assert qs.is_read_quorum(qs.random_read_quorum(rng))
+            assert qs.is_write_quorum(qs.random_write_quorum(rng))
+
+
+class TestGrid:
+    def setup_method(self):
+        #  1 2 3
+        #  4 5 6
+        self.grid = Grid([[1, 2, 3], [4, 5, 6]])
+
+    def test_read_quorums(self):
+        assert self.grid.is_read_quorum({1, 2, 3})
+        assert self.grid.is_read_quorum({4, 5, 6})
+        assert self.grid.is_read_quorum({1, 2, 3, 4})
+        assert not self.grid.is_read_quorum({1, 2, 4, 5})
+        assert not self.grid.is_read_quorum(set())
+
+    def test_write_quorums(self):
+        assert self.grid.is_write_quorum({1, 4})
+        assert self.grid.is_write_quorum({3, 5})
+        assert self.grid.is_write_quorum({1, 2, 6})
+        assert not self.grid.is_write_quorum({1, 2, 3})
+        assert not self.grid.is_write_quorum({4})
+
+    def test_read_write_intersection(self):
+        # Every read quorum must intersect every write quorum.
+        nodes = self.grid.nodes()
+        for xs in all_subsets(nodes):
+            for ys in all_subsets(nodes):
+                if self.grid.is_read_quorum(xs) and self.grid.is_write_quorum(ys):
+                    assert xs & ys, (xs, ys)
+
+    def test_random_quorums(self):
+        rng = random.Random(3)
+        for _ in range(50):
+            assert self.grid.is_read_quorum(self.grid.random_read_quorum(rng))
+            assert self.grid.is_write_quorum(self.grid.random_write_quorum(rng))
+
+    def test_unequal_rows_rejected(self):
+        with pytest.raises(ValueError):
+            Grid([[1, 2], [3]])
+
+
+class TestUnanimousWrites:
+    def test_semantics(self):
+        qs = UnanimousWrites([1, 2, 3])
+        assert qs.is_read_quorum({1})
+        assert qs.is_read_quorum({2, 3})
+        assert not qs.is_read_quorum(set())
+        assert qs.is_write_quorum({1, 2, 3})
+        assert not qs.is_write_quorum({1, 2})
+        assert qs.is_superset_of_write_quorum({1, 2, 3, 99})
+
+
+@pytest.mark.parametrize("qs", [
+    SimpleMajority([3, 1, 4, 1, 5][:n] or [7]) for n in range(1, 6)
+] + [
+    Grid([[1, 2], [3, 4]]),
+    Grid([[1, 2, 3], [4, 5, 6], [7, 8, 9]]),
+    UnanimousWrites([2, 4, 6]),
+])
+def test_spec_matches_set_semantics(qs):
+    """read_spec/write_spec evaluate identically to the set-based methods."""
+    read_spec, write_spec = qs.read_spec(), qs.write_spec()
+    for xs in all_subsets(qs.nodes()):
+        assert read_spec.check(xs) == qs.is_superset_of_read_quorum(xs)
+        assert write_spec.check(xs) == qs.is_superset_of_write_quorum(xs)
+
+
+def test_spec_batch_evaluation():
+    qs = Grid([[1, 2, 3], [4, 5, 6]])
+    spec = qs.write_spec()
+    subsets = list(all_subsets(qs.nodes()))
+    present = np.stack([spec.present_vector(xs) for xs in subsets])
+    got = spec.evaluate(present)
+    expected = np.array([qs.is_write_quorum(xs) for xs in subsets])
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_spec_reindexed():
+    qs = SimpleMajority([1, 2, 3])
+    spec = qs.read_spec().reindexed([0, 1, 2, 3, 4])
+    assert spec.check({1, 2})
+    assert not spec.check({1, 4})  # 4 isn't a member; its vote doesn't count
+    assert not spec.check({0, 4})
+
+
+@pytest.mark.parametrize("qs", [
+    SimpleMajority([1, 2, 3]),
+    UnanimousWrites([4, 5]),
+    Grid([[1, 2], [3, 4]]),
+])
+def test_wire_roundtrip(qs):
+    d = quorum_system_to_dict(qs)
+    back = quorum_system_from_dict(d)
+    assert type(back) is type(qs)
+    assert back.nodes() == qs.nodes()
+    for xs in all_subsets(qs.nodes()):
+        assert back.is_read_quorum(xs) == qs.is_read_quorum(xs)
+        assert back.is_write_quorum(xs) == qs.is_write_quorum(xs)
+
+
+def test_pad_specs():
+    from frankenpaxos_tpu.quorums.spec import pad_specs
+
+    universe = tuple(range(6))
+    g = Grid([[0, 1, 2], [3, 4, 5]])
+    m = SimpleMajority([0, 1, 2, 3, 4])
+    specs = [g.write_spec().reindexed(universe),
+             m.read_spec().reindexed(universe)]
+    masks, thresholds, combine_any = pad_specs(specs)
+    assert masks.shape == (2, 2, 6)
+    # Padded group of the majority spec must never flip the ANY result.
+    present = np.ones(6, dtype=np.uint8)
+    counts = present @ masks[1].T
+    assert (counts >= thresholds[1]).any()
+    present0 = np.zeros(6, dtype=np.uint8)
+    counts0 = present0 @ masks[1].T
+    assert not (counts0 >= thresholds[1]).any()

@@ -1,0 +1,113 @@
+"""Round system semantics (mirrors roundsystem/RoundSystemTest.scala)."""
+
+import pytest
+
+from frankenpaxos_tpu.roundsystem import (
+    ClassicRoundRobin,
+    ClassicStutteredRoundRobin,
+    MixedRoundRobin,
+    RenamedRoundSystem,
+    RotatedClassicRoundRobin,
+    RotatedRoundZeroFast,
+    RoundType,
+    RoundZeroFast,
+)
+
+ALL_SYSTEMS = [
+    ClassicRoundRobin(1),
+    ClassicRoundRobin(3),
+    ClassicStutteredRoundRobin(3, 2),
+    ClassicStutteredRoundRobin(2, 3),
+    RoundZeroFast(3),
+    MixedRoundRobin(3),
+    RenamedRoundSystem(ClassicRoundRobin(3), {0: 1, 1: 2, 2: 0}),
+    RotatedClassicRoundRobin(3, 1),
+    RotatedRoundZeroFast(3, 2),
+]
+
+
+@pytest.mark.parametrize("rs", ALL_SYSTEMS, ids=repr)
+def test_next_classic_round_contract(rs):
+    """next_classic_round returns the smallest classic round of the leader
+    strictly greater than `round` (RoundSystem.scala:33-37)."""
+    n = rs.num_leaders()
+    for leader in range(n):
+        for round in range(-1, 40):
+            nxt = rs.next_classic_round(leader, round)
+            assert nxt > round
+            assert rs.leader(nxt) == leader
+            assert rs.round_type(nxt) == RoundType.CLASSIC
+            # Minimality: no classic round of this leader in between.
+            for r in range(round + 1, nxt):
+                assert not (rs.leader(r) == leader
+                            and rs.round_type(r) == RoundType.CLASSIC)
+
+
+@pytest.mark.parametrize("rs", ALL_SYSTEMS, ids=repr)
+def test_next_fast_round_contract(rs):
+    n = rs.num_leaders()
+    for leader in range(n):
+        for round in range(-1, 30):
+            nxt = rs.next_fast_round(leader, round)
+            if nxt is None:
+                continue
+            assert nxt > round
+            assert rs.leader(nxt) == leader
+            assert rs.round_type(nxt) == RoundType.FAST
+            for r in range(round + 1, nxt):
+                assert not (rs.leader(r) == leader
+                            and rs.round_type(r) == RoundType.FAST)
+
+
+@pytest.mark.parametrize("rs", ALL_SYSTEMS, ids=repr)
+def test_every_round_has_one_leader(rs):
+    for round in range(60):
+        assert 0 <= rs.leader(round) < rs.num_leaders()
+
+
+def test_classic_round_robin_table():
+    rs = ClassicRoundRobin(3)
+    assert [rs.leader(r) for r in range(7)] == [0, 1, 2, 0, 1, 2, 0]
+    assert rs.next_classic_round(0, -1) == 0
+    assert rs.next_classic_round(1, 0) == 1
+    assert rs.next_classic_round(0, 0) == 3
+
+
+def test_stuttered_table():
+    rs = ClassicStutteredRoundRobin(3, 2)
+    assert [rs.leader(r) for r in range(7)] == [0, 0, 1, 1, 2, 2, 0]
+
+
+def test_round_zero_fast_table():
+    rs = RoundZeroFast(3)
+    assert rs.round_type(0) == RoundType.FAST
+    assert [rs.leader(r) for r in range(7)] == [0, 0, 1, 2, 0, 1, 2]
+    assert rs.next_fast_round(0, -1) == 0
+    assert rs.next_fast_round(0, 0) is None
+    assert rs.next_fast_round(1, -1) is None
+
+
+def test_mixed_round_robin_table():
+    rs = MixedRoundRobin(3)
+    assert [rs.leader(r) for r in range(10)] == [0, 0, 1, 1, 2, 2, 0, 0, 1, 1]
+    assert [rs.round_type(r) for r in range(4)] == [
+        RoundType.FAST, RoundType.CLASSIC, RoundType.FAST, RoundType.CLASSIC]
+
+
+def test_rotated_table():
+    rs = RotatedClassicRoundRobin(3, 1)
+    assert [rs.leader(r) for r in range(7)] == [1, 2, 0, 1, 2, 0, 1]
+    rs2 = RotatedRoundZeroFast(3, 2)
+    assert rs2.leader(0) == 2
+    assert rs2.round_type(0) == RoundType.FAST
+    assert [rs2.leader(r) for r in range(1, 7)] == [2, 0, 1, 2, 0, 1]
+
+
+def test_vectorized_leaders():
+    import numpy as np
+
+    for rs in [ClassicRoundRobin(3), ClassicStutteredRoundRobin(3, 2)]:
+        rounds = np.arange(50)
+        got = rs.leaders_of(rounds)
+        expected = [rs.leader(int(r)) for r in rounds]
+        assert got.tolist() == expected
