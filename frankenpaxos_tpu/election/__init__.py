@@ -1,0 +1,9 @@
+"""Leader election (reference: election/basic and election/raft)."""
+
+from frankenpaxos_tpu.election.basic import (
+    ElectionOptions,
+    ElectionParticipant,
+    ElectionState,
+)
+
+__all__ = ["ElectionOptions", "ElectionParticipant", "ElectionState"]

@@ -1,0 +1,9 @@
+"""Protocol implementations.
+
+Each protocol package mirrors the reference's structure: message
+dataclasses (the analog of the per-protocol ``.proto``), a ``Config``
+listing all role addresses with a ``check_valid()``, and one Actor
+subclass per role. Roles are pure single-threaded state machines over the
+runtime contract; their hot loops call into the batched device kernels in
+``ops/``.
+"""

@@ -1,0 +1,48 @@
+"""Compartmentalized MultiPaxos -- the flagship protocol.
+
+Reference behavior: multipaxos/ (~4,300 LoC Scala; see SURVEY.md section
+2.2). Roles: Batcher -> Leader -> ProxyLeader -> Acceptor (groups or
+grid) -> ProxyLeader -> Replica -> ProxyReplica -> Client, plus
+linearizable / sequential / eventual reads.
+
+The Phase2b vote-collection loop (the reference's hottest code) runs on
+a pluggable quorum tracker; the "tpu" backend batches votes onto the
+TpuQuorumChecker vote board (ops/quorum.py) once per event-loop drain.
+"""
+
+from frankenpaxos_tpu.protocols.multipaxos.acceptor import Acceptor, AcceptorOptions
+from frankenpaxos_tpu.protocols.multipaxos.batcher import Batcher, BatcherOptions
+from frankenpaxos_tpu.protocols.multipaxos.client import Client, ClientOptions
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    DistributionScheme,
+    MultiPaxosConfig,
+)
+from frankenpaxos_tpu.protocols.multipaxos.leader import Leader, LeaderOptions
+from frankenpaxos_tpu.protocols.multipaxos.proxy_leader import (
+    ProxyLeader,
+    ProxyLeaderOptions,
+)
+from frankenpaxos_tpu.protocols.multipaxos.proxy_replica import (
+    ProxyReplica,
+    ProxyReplicaOptions,
+)
+from frankenpaxos_tpu.protocols.multipaxos.replica import Replica, ReplicaOptions
+
+__all__ = [
+    "Acceptor",
+    "AcceptorOptions",
+    "Batcher",
+    "BatcherOptions",
+    "Client",
+    "ClientOptions",
+    "DistributionScheme",
+    "Leader",
+    "LeaderOptions",
+    "MultiPaxosConfig",
+    "ProxyLeader",
+    "ProxyLeaderOptions",
+    "ProxyReplica",
+    "ProxyReplicaOptions",
+    "Replica",
+    "ReplicaOptions",
+]

@@ -1,0 +1,131 @@
+"""MultiPaxos Acceptor.
+
+Reference behavior: multipaxos/Acceptor.scala:59-255. Per-slot
+{vote_round, vote_value} state, a single monotone ``round``, nacks for
+stale rounds (Phase2a nacks go to the round's *leader*, not the proxy
+leader that forwarded it), ``max_voted_slot`` serving quorum reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from sortedcontainers import SortedDict  # type: ignore[import-untyped]
+
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    BatchMaxSlotReply,
+    BatchMaxSlotRequest,
+    CommandBatchOrNoop,
+    MaxSlotReply,
+    MaxSlotRequest,
+    Nack,
+    Phase1a,
+    Phase1b,
+    Phase1bSlotInfo,
+    Phase2a,
+    Phase2b,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceptorOptions:
+    measure_latencies: bool = True
+
+
+@dataclasses.dataclass
+class _VoteState:
+    vote_round: int
+    vote_value: CommandBatchOrNoop
+
+
+class Acceptor(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MultiPaxosConfig,
+                 options: AcceptorOptions = AcceptorOptions(),
+                 collectors: Collectors | None = None):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        collectors = collectors or FakeCollectors()
+        self.metrics_requests = collectors.counter(
+            "multipaxos_acceptor_requests_total", labels=("type",))
+        self.group_index = next(
+            g for g, group in enumerate(config.acceptor_addresses)
+            if address in group)
+        self.index = list(
+            config.acceptor_addresses[self.group_index]).index(address)
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.round = -1
+        self.states: SortedDict = SortedDict()  # slot -> _VoteState
+        self.max_voted_slot = -1
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1a):
+            self.metrics_requests.labels("Phase1a").inc()
+            self._handle_phase1a(src, message)
+        elif isinstance(message, Phase2a):
+            self.metrics_requests.labels("Phase2a").inc()
+            self._handle_phase2a(src, message)
+        elif isinstance(message, MaxSlotRequest):
+            self.metrics_requests.labels("MaxSlotRequest").inc()
+            self._handle_max_slot_request(src, message)
+        elif isinstance(message, BatchMaxSlotRequest):
+            self.metrics_requests.labels("BatchMaxSlotRequest").inc()
+            self._handle_batch_max_slot_request(src, message)
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        if phase1a.round < self.round:
+            self.logger.debug(
+                f"acceptor got Phase1a in round {phase1a.round} but is in "
+                f"round {self.round}")
+            self.send(src, Nack(round=self.round))
+            return
+        self.round = phase1a.round
+        info = tuple(
+            Phase1bSlotInfo(slot=slot,
+                            vote_round=self.states[slot].vote_round,
+                            vote_value=self.states[slot].vote_value)
+            for slot in self.states.irange(minimum=phase1a.chosen_watermark))
+        self.send(src, Phase1b(group_index=self.group_index,
+                               acceptor_index=self.index,
+                               round=self.round, info=info))
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        if phase2a.round < self.round:
+            self.logger.debug(
+                f"acceptor got Phase2a in round {phase2a.round} but is in "
+                f"round {self.round}")
+            # Nack the round's leader, not the forwarding proxy leader
+            # (Acceptor.scala:184-200).
+            leader = self.config.leader_addresses[
+                self.round_system.leader(phase2a.round)]
+            self.send(leader, Nack(round=self.round))
+            return
+        self.round = phase2a.round
+        self.states[phase2a.slot] = _VoteState(vote_round=self.round,
+                                               vote_value=phase2a.value)
+        self.max_voted_slot = max(self.max_voted_slot, phase2a.slot)
+        self.send(src, Phase2b(group_index=self.group_index,
+                               acceptor_index=self.index,
+                               slot=phase2a.slot, round=self.round))
+
+    def _handle_max_slot_request(self, src: Address,
+                                 request: MaxSlotRequest) -> None:
+        self.send(src, MaxSlotReply(command_id=request.command_id,
+                                    group_index=self.group_index,
+                                    acceptor_index=self.index,
+                                    slot=self.max_voted_slot))
+
+    def _handle_batch_max_slot_request(self, src: Address,
+                                       request: BatchMaxSlotRequest) -> None:
+        self.send(src, BatchMaxSlotReply(
+            read_batcher_index=request.read_batcher_index,
+            read_batcher_id=request.read_batcher_id,
+            group_index=self.group_index,
+            acceptor_index=self.index,
+            slot=self.max_voted_slot))

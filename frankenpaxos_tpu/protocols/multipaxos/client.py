@@ -1,0 +1,317 @@
+"""MultiPaxos Client.
+
+Reference behavior: multipaxos/Client.scala:120-1060. Per-pseudonym
+pending-operation state machines with resend timers:
+
+  * writes (writeImpl, Client.scala:563-603): ClientRequest to a random
+    batcher (or the round's leader when there are no batchers); NotLeader
+    bounces trigger LeaderInfoRequest round discovery.
+  * linearizable reads (readImpl + handleMaxSlotReply,
+    Client.scala:604-700, 851-933): MaxSlotRequest to f+1 of a random
+    acceptor group (or a grid read quorum); on quorum, read at
+    ``max_slot + num_groups - 1`` (grid: ``max_slot``) at a random
+    replica, deferred there until executed.
+  * sequential reads (Client.scala:697+): read at the largest slot this
+    pseudonym has seen.
+  * eventual reads (Client.scala:739+): straight to a random replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ClientReply,
+    ClientRequest,
+    Command,
+    CommandId,
+    EventualReadRequest,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestClient,
+    MaxSlotReply,
+    MaxSlotRequest,
+    NotLeaderClient,
+    ReadReply,
+    ReadRequest,
+    SequentialReadRequest,
+)
+
+Callback = Callable[[bytes], None]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientOptions:
+    resend_client_request_period_s: float = 10.0
+    resend_max_slot_requests_period_s: float = 10.0
+    resend_read_request_period_s: float = 10.0
+    # Performance-debugging unsafe modes (Client.scala:42-53).
+    unsafe_read_at_first_slot: bool = False
+    unsafe_read_at_i: bool = False
+    flush_writes_every_n: int = 1
+    flush_reads_every_n: int = 1
+    measure_latencies: bool = True
+
+
+@dataclasses.dataclass
+class _PendingWrite:
+    id: int
+    command: bytes
+    callback: Callback
+    resend: object
+
+
+@dataclasses.dataclass
+class _MaxSlot:
+    id: int
+    command: bytes
+    callback: Callback
+    replies: dict[tuple[int, int], int]
+    resend: object
+
+
+@dataclasses.dataclass
+class _PendingRead:
+    id: int
+    command: bytes
+    callback: Callback
+    resend: object
+
+
+class Client(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MultiPaxosConfig,
+                 options: ClientOptions = ClientOptions(), seed: int = 0,
+                 collectors: Collectors | None = None):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.metrics_replies = collectors.counter(
+            "multipaxos_client_replies_received_total")
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.grid = config.quorum_grid() if config.flexible else None
+        self._row_size = len(config.acceptor_addresses[0])
+        self.round = 0
+        self.ids: dict[int, int] = {}               # pseudonym -> next id
+        self.states: dict[int, object] = {}         # pseudonym -> pending op
+        self.largest_seen_slots: dict[int, int] = {}  # pseudonym -> slot
+
+    # --- public API -------------------------------------------------------
+    def write(self, pseudonym: int, command: bytes,
+              callback: Optional[Callback] = None) -> None:
+        self._check_idle(pseudonym)
+        callback = callback or (lambda _: None)
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(Command(
+            CommandId(self.address, pseudonym, id), command))
+        self._send_client_request(request)
+
+        def resend():
+            self._send_client_request(request)
+            timer.start()
+
+        timer = self.timer(f"resendWrite{pseudonym}",
+                           self.options.resend_client_request_period_s,
+                           resend)
+        timer.start()
+        self.states[pseudonym] = _PendingWrite(id, command, callback, timer)
+        self.ids[pseudonym] = id + 1
+
+    def read(self, pseudonym: int, command: bytes,
+             callback: Optional[Callback] = None) -> None:
+        """Linearizable quorum read."""
+        self._check_idle(pseudonym)
+        callback = callback or (lambda _: None)
+        id = self.ids.get(pseudonym, 0)
+        request = MaxSlotRequest(CommandId(self.address, pseudonym, id))
+        if not self.config.flexible:
+            group_index = self.rng.randrange(self.config.num_acceptor_groups)
+            group = list(self.config.acceptor_addresses[group_index])
+            quorum = self.rng.sample(group, self.config.f + 1)
+            resend_to = group
+        else:
+            quorum = [self._acceptor_address(flat)
+                      for flat in self.grid.random_read_quorum(self.rng)]
+            resend_to = [a for g in self.config.acceptor_addresses
+                         for a in g]
+        for acceptor in quorum:
+            self.send(acceptor, request)
+
+        def resend():
+            for acceptor in resend_to:
+                self.send(acceptor, request)
+            timer.start()
+
+        timer = self.timer(f"resendMaxSlot{pseudonym}",
+                           self.options.resend_max_slot_requests_period_s,
+                           resend)
+        timer.start()
+        self.states[pseudonym] = _MaxSlot(id, command, callback, {}, timer)
+        self.ids[pseudonym] = id + 1
+
+    def sequential_read(self, pseudonym: int, command: bytes,
+                        callback: Optional[Callback] = None) -> None:
+        self._check_idle(pseudonym)
+        callback = callback or (lambda _: None)
+        id = self.ids.get(pseudonym, 0)
+        slot = self.largest_seen_slots.get(pseudonym, -1)
+        request = SequentialReadRequest(
+            slot=slot,
+            command=Command(CommandId(self.address, pseudonym, id), command))
+        replica = self._random_replica()
+        self.send(replica, request)
+        timer = self._make_read_resend_timer(pseudonym, replica, request)
+        self.states[pseudonym] = _PendingRead(id, command, callback, timer)
+        self.ids[pseudonym] = id + 1
+
+    def eventual_read(self, pseudonym: int, command: bytes,
+                      callback: Optional[Callback] = None) -> None:
+        self._check_idle(pseudonym)
+        callback = callback or (lambda _: None)
+        id = self.ids.get(pseudonym, 0)
+        request = EventualReadRequest(
+            Command(CommandId(self.address, pseudonym, id), command))
+        replica = self._random_replica()
+        self.send(replica, request)
+        timer = self._make_read_resend_timer(pseudonym, replica, request)
+        self.states[pseudonym] = _PendingRead(id, command, callback, timer)
+        self.ids[pseudonym] = id + 1
+
+    # --- helpers ----------------------------------------------------------
+    def _check_idle(self, pseudonym: int) -> None:
+        if pseudonym in self.states:
+            raise RuntimeError(
+                f"pseudonym {pseudonym} already has a pending operation; a "
+                f"client can have one pending operation per pseudonym")
+
+    def _acceptor_address(self, flat: int) -> Address:
+        return self.config.acceptor_addresses[flat // self._row_size][
+            flat % self._row_size]
+
+    def _random_replica(self) -> Address:
+        return self.config.replica_addresses[
+            self.rng.randrange(self.config.num_replicas)]
+
+    def _send_client_request(self, request: ClientRequest) -> None:
+        if self.config.num_batchers > 0:
+            dst = self.config.batcher_addresses[
+                self.rng.randrange(self.config.num_batchers)]
+        else:
+            dst = self.config.leader_addresses[
+                self.round_system.leader(self.round)]
+        self.send(dst, request)
+
+    def _make_read_resend_timer(self, pseudonym: int, replica: Address,
+                                request) -> object:
+        def resend():
+            self.send(replica, request)
+            timer.start()
+
+        timer = self.timer(f"resendRead{pseudonym}",
+                           self.options.resend_read_request_period_s, resend)
+        timer.start()
+        return timer
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientReply):
+            self._handle_client_reply(src, message)
+        elif isinstance(message, MaxSlotReply):
+            self._handle_max_slot_reply(src, message)
+        elif isinstance(message, ReadReply):
+            self._handle_read_reply(src, message)
+        elif isinstance(message, NotLeaderClient):
+            self._handle_not_leader(src, message)
+        elif isinstance(message, LeaderInfoReplyClient):
+            self._handle_leader_info(src, message)
+        else:
+            self.logger.fatal(f"unexpected client message {message!r}")
+
+    def _handle_client_reply(self, src: Address, reply: ClientReply) -> None:
+        pseudonym = reply.command_id.client_pseudonym
+        state = self.states.get(pseudonym)
+        if not isinstance(state, _PendingWrite) \
+                or reply.command_id.client_id != state.id:
+            self.logger.debug(f"stale ClientReply {reply}")
+            return
+        state.resend.stop()
+        self.largest_seen_slots[pseudonym] = max(
+            self.largest_seen_slots.get(pseudonym, -1), reply.slot)
+        del self.states[pseudonym]
+        self.metrics_replies.inc()
+        state.callback(reply.result)
+
+    def _handle_max_slot_reply(self, src: Address,
+                               reply: MaxSlotReply) -> None:
+        pseudonym = reply.command_id.client_pseudonym
+        state = self.states.get(pseudonym)
+        if not isinstance(state, _MaxSlot) \
+                or reply.command_id.client_id != state.id:
+            self.logger.debug(f"stale MaxSlotReply {reply}")
+            return
+        state.replies[(reply.group_index, reply.acceptor_index)] = reply.slot
+        if not self.config.flexible:
+            if len(state.replies) < self.config.f + 1:
+                return
+        else:
+            flat = {g * self._row_size + i for g, i in state.replies}
+            if not self.grid.is_superset_of_read_quorum(flat):
+                return
+
+        max_slot = max(state.replies.values())
+        if self.options.unsafe_read_at_first_slot:
+            slot = 0
+        elif self.config.flexible or self.options.unsafe_read_at_i:
+            slot = max_slot
+        else:
+            # Slots round-robin over groups; the true global max voted slot
+            # can exceed this group's by at most num_groups - 1.
+            slot = max_slot + self.config.num_acceptor_groups - 1
+        request = ReadRequest(
+            slot=slot,
+            command=Command(CommandId(self.address, pseudonym, state.id),
+                            state.command))
+        replica = self._random_replica()
+        self.send(replica, request)
+        state.resend.stop()
+        timer = self._make_read_resend_timer(pseudonym, replica, request)
+        self.states[pseudonym] = _PendingRead(state.id, state.command,
+                                              state.callback, timer)
+
+    def _handle_read_reply(self, src: Address, reply: ReadReply) -> None:
+        pseudonym = reply.command_id.client_pseudonym
+        state = self.states.get(pseudonym)
+        if not isinstance(state, _PendingRead) \
+                or reply.command_id.client_id != state.id:
+            self.logger.debug(f"stale ReadReply {reply}")
+            return
+        state.resend.stop()
+        self.largest_seen_slots[pseudonym] = max(
+            self.largest_seen_slots.get(pseudonym, -1), reply.slot)
+        del self.states[pseudonym]
+        state.callback(reply.result)
+
+    def _handle_not_leader(self, src: Address, _: NotLeaderClient) -> None:
+        for leader in self.config.leader_addresses:
+            self.send(leader, LeaderInfoRequestClient())
+
+    def _handle_leader_info(self, src: Address,
+                            reply: LeaderInfoReplyClient) -> None:
+        if reply.round <= self.round:
+            return
+        self.round = reply.round
+        # Re-send every pending write to the new round's leader
+        # (Client.scala handleLeaderInfoReplyClient).
+        for pseudonym, state in self.states.items():
+            if isinstance(state, _PendingWrite):
+                self._send_client_request(ClientRequest(Command(
+                    CommandId(self.address, pseudonym, state.id),
+                    state.command)))

@@ -1,0 +1,343 @@
+"""MultiPaxos Leader.
+
+Reference behavior: multipaxos/Leader.scala:95-723. A state machine over
+{Inactive, Phase1, Phase2}:
+
+  * Phase1 (startPhase1, Leader.scala:409-430): send Phase1a with the
+    chosen watermark to f+1 acceptors per group (or a grid read quorum);
+    collect Phase1b until per-group quorums (or grid read quorum); adopt
+    the highest-vote-round value per slot in [chosen_watermark, max_slot]
+    -- `safeValue`, Leader.scala:318-330 -- propose them, jump to Phase2,
+    replay pending batches.
+  * Phase2 (processClientRequestBatch, Leader.scala:331-408): assign the
+    next slot, hand the Phase2a to a proxy leader (round-robin in Hash
+    mode, own colocated one otherwise).
+  * Nacks bump the round and re-run Phase1 (Leader.scala:669-696);
+    Recover triggers a leader change so holes get repaired
+    (Leader.scala:698-722); the embedded election participant drives
+    Inactive <-> active transitions (Leader.scala:192-203).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    DistributionScheme,
+    MultiPaxosConfig,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    NOOP,
+    ChosenWatermark,
+    ClientRequest,
+    ClientRequestBatch,
+    CommandBatch,
+    LeaderInfoReplyBatcher,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestBatcher,
+    LeaderInfoRequestClient,
+    Nack,
+    NotLeaderBatcher,
+    NotLeaderClient,
+    Phase1a,
+    Phase1b,
+    Phase2a,
+    Recover,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderOptions:
+    resend_phase1as_period_s: float = 5.0
+    flush_phase2as_every_n: int = 1
+    noop_flush_period_s: float = 0.0  # 0 disables
+    election_options: ElectionOptions = ElectionOptions()
+    measure_latencies: bool = True
+
+
+class _Inactive:
+    pass
+
+
+@dataclasses.dataclass
+class _Phase1:
+    # group index -> acceptor index -> Phase1b
+    phase1bs: list[dict[int, Phase1b]]
+    phase1b_acceptors: set[tuple[int, int]]
+    pending_batches: list[ClientRequestBatch]
+    resend_phase1as: object  # Timer
+
+
+@dataclasses.dataclass
+class _Phase2:
+    noop_flush: Optional[object] = None  # Timer
+
+
+class Leader(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MultiPaxosConfig,
+                 options: LeaderOptions = LeaderOptions(),
+                 collectors: Collectors | None = None, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.metrics_requests = collectors.counter(
+            "multipaxos_leader_requests_total", labels=("type",))
+        self.index = list(config.leader_addresses).index(address)
+        self.grid = config.quorum_grid() if config.flexible else None
+        self._row_size = len(config.acceptor_addresses[0])
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        # Active leader's round, or the largest known active round.
+        self.round = self.round_system.next_classic_round(0, -1)
+        self.next_slot = 0
+        self.chosen_watermark = 0
+        self._current_proxy_leader = 0
+        self._unflushed_phase2as = 0
+
+        # Embedded election participant (Leader.scala:192-203).
+        self.election = ElectionParticipant(
+            config.leader_election_addresses[self.index], transport, logger,
+            config.leader_election_addresses, initial_leader_index=0,
+            options=options.election_options, seed=seed)
+        self.election.register(
+            lambda leader_index: self.leader_change(leader_index == self.index))
+
+        self.state: object = (self._start_phase1(self.round,
+                                                 self.chosen_watermark)
+                              if self.index == 0 else _Inactive())
+
+    # --- helpers ----------------------------------------------------------
+    def _acceptor_address(self, flat: int) -> Address:
+        return self.config.acceptor_addresses[flat // self._row_size][
+            flat % self._row_size]
+
+    def _proxy_leader_address(self) -> Address:
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            return self.config.proxy_leader_addresses[
+                self._current_proxy_leader]
+        return self.config.proxy_leader_addresses[self.index]
+
+    def _advance_proxy_leader(self) -> None:
+        self._current_proxy_leader = (
+            (self._current_proxy_leader + 1) % self.config.num_proxy_leaders)
+
+    @staticmethod
+    def _safe_value(phase1bs, slot: int):
+        """Highest-vote-round value for ``slot`` else Noop
+        (Leader.scala:318-330)."""
+        best_round, best_value = -1, None
+        for phase1b in phase1bs:
+            for info in phase1b.info:
+                if info.slot == slot and info.vote_round > best_round:
+                    best_round, best_value = info.vote_round, info.vote_value
+        return NOOP if best_value is None else best_value
+
+    def _send_phase2a(self, phase2a: Phase2a) -> None:
+        dst = self._proxy_leader_address()
+        if self.options.flush_phase2as_every_n <= 1:
+            self.send(dst, phase2a)
+            self._advance_proxy_leader()
+        else:
+            self.send_no_flush(dst, phase2a)
+            self._unflushed_phase2as += 1
+            if self._unflushed_phase2as >= self.options.flush_phase2as_every_n:
+                self.flush(dst)
+                self._unflushed_phase2as = 0
+                self._advance_proxy_leader()
+
+    def _process_client_request_batch(self, batch: ClientRequestBatch) -> None:
+        if not isinstance(self.state, _Phase2):
+            self.logger.fatal(
+                f"leader processing a batch outside Phase2: {self.state}")
+        self._send_phase2a(Phase2a(slot=self.next_slot, round=self.round,
+                                   value=batch.batch))
+        self.next_slot += 1
+
+    # --- phase 1 ----------------------------------------------------------
+    def _start_phase1(self, round: int, chosen_watermark: int) -> _Phase1:
+        phase1a = Phase1a(round=round, chosen_watermark=chosen_watermark)
+        if not self.config.flexible:
+            for group in self.config.acceptor_addresses:
+                for acceptor in self.rng.sample(list(group),
+                                                self.config.f + 1):
+                    self.send(acceptor, phase1a)
+        else:
+            for flat in self.grid.random_read_quorum(self.rng):
+                self.send(self._acceptor_address(flat), phase1a)
+
+        def resend():
+            for group in self.config.acceptor_addresses:
+                for acceptor in group:
+                    self.send(acceptor, phase1a)
+            timer.start()
+
+        timer = self.timer("resendPhase1as",
+                           self.options.resend_phase1as_period_s, resend)
+        timer.start()
+        return _Phase1(
+            phase1bs=[{} for _ in range(self.config.num_acceptor_groups)],
+            phase1b_acceptors=set(),
+            pending_batches=[],
+            resend_phase1as=timer)
+
+    def _make_noop_flush_timer(self) -> Optional[object]:
+        """In non-flexible mode with multiple groups, periodically propose
+        noops so no acceptor group starves (Leader.scala:240-280)."""
+        if self.config.flexible or self.options.noop_flush_period_s <= 0:
+            return None
+
+        def flush_noop():
+            if not isinstance(self.state, _Phase2):
+                self.logger.fatal("noop flush outside Phase2")
+            self._send_phase2a(Phase2a(slot=self.next_slot, round=self.round,
+                                       value=NOOP))
+            self.next_slot += 1
+            self._advance_proxy_leader()
+            timer.start()
+
+        timer = self.timer("noopFlush", self.options.noop_flush_period_s,
+                           flush_noop)
+        timer.start()
+        return timer
+
+    def _stop_state_timers(self) -> None:
+        if isinstance(self.state, _Phase1):
+            self.state.resend_phase1as.stop()
+        elif isinstance(self.state, _Phase2) and self.state.noop_flush:
+            self.state.noop_flush.stop()
+
+    def leader_change(self, is_new_leader: bool) -> None:
+        """Election callback (Leader.scala:432-459)."""
+        self._stop_state_timers()
+        if not is_new_leader:
+            self.state = _Inactive()
+            return
+        self.round = self.round_system.next_classic_round(self.index,
+                                                          self.round)
+        self.state = self._start_phase1(self.round, self.chosen_watermark)
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        handlers = [
+            (Phase1b, "Phase1b", self._handle_phase1b),
+            (ClientRequest, "ClientRequest", self._handle_client_request),
+            (ClientRequestBatch, "ClientRequestBatch",
+             self._handle_client_request_batch),
+            (LeaderInfoRequestClient, "LeaderInfoRequestClient",
+             self._handle_leader_info_request_client),
+            (LeaderInfoRequestBatcher, "LeaderInfoRequestBatcher",
+             self._handle_leader_info_request_batcher),
+            (Nack, "Nack", self._handle_nack),
+            (ChosenWatermark, "ChosenWatermark",
+             self._handle_chosen_watermark),
+            (Recover, "Recover", self._handle_recover),
+        ]
+        for klass, label, handler in handlers:
+            if isinstance(message, klass):
+                self.metrics_requests.labels(label).inc()
+                handler(src, message)
+                return
+        self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        if not isinstance(self.state, _Phase1):
+            self.logger.debug("Phase1b outside Phase1; ignoring")
+            return
+        phase1 = self.state
+        if phase1b.round != self.round:
+            self.logger.debug(
+                f"Phase1b in round {phase1b.round} != {self.round}; ignoring")
+            self.logger.check_lt(phase1b.round, self.round)
+            return
+
+        phase1.phase1bs[phase1b.group_index][phase1b.acceptor_index] = phase1b
+        if not self.config.flexible:
+            if any(len(group) < self.config.f + 1
+                   for group in phase1.phase1bs):
+                return
+        else:
+            phase1.phase1b_acceptors.add(
+                (phase1b.group_index, phase1b.acceptor_index))
+            flat = {g * self._row_size + i
+                    for g, i in phase1.phase1b_acceptors}
+            if not self.grid.is_superset_of_read_quorum(flat):
+                return
+
+        max_slot = max(
+            (info.slot
+             for group in phase1.phase1bs
+             for p1b in group.values()
+             for info in p1b.info),
+            default=-1)
+        for slot in range(self.chosen_watermark, max_slot + 1):
+            group = phase1.phase1bs[slot % self.config.num_acceptor_groups]
+            self._send_phase2a(Phase2a(
+                slot=slot, round=self.round,
+                value=self._safe_value(group.values(), slot)))
+        self.next_slot = max_slot + 1
+
+        phase1.resend_phase1as.stop()
+        self.state = _Phase2(self._make_noop_flush_timer())
+        for batch in phase1.pending_batches:
+            self._process_client_request_batch(batch)
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        if isinstance(self.state, _Inactive):
+            self.send(src, NotLeaderClient())
+        elif isinstance(self.state, _Phase1):
+            self.state.pending_batches.append(
+                ClientRequestBatch(CommandBatch((request.command,))))
+        else:
+            self._process_client_request_batch(
+                ClientRequestBatch(CommandBatch((request.command,))))
+
+    def _handle_client_request_batch(self, src: Address,
+                                     batch: ClientRequestBatch) -> None:
+        if isinstance(self.state, _Inactive):
+            # Bounce the batch back so the batcher can re-route it
+            # (Leader.scala:606-634).
+            self.send(src, NotLeaderBatcher(client_request_batch=batch))
+        elif isinstance(self.state, _Phase1):
+            self.state.pending_batches.append(batch)
+        else:
+            self._process_client_request_batch(batch)
+
+    def _handle_leader_info_request_client(self, src: Address, _) -> None:
+        if not isinstance(self.state, _Inactive):
+            self.send(src, LeaderInfoReplyClient(round=self.round))
+
+    def _handle_leader_info_request_batcher(self, src: Address, _) -> None:
+        if not isinstance(self.state, _Inactive):
+            self.send(src, LeaderInfoReplyBatcher(round=self.round))
+
+    def _handle_nack(self, src: Address, nack: Nack) -> None:
+        if nack.round <= self.round:
+            self.logger.debug(f"stale Nack in round {nack.round}; ignoring")
+            return
+        if isinstance(self.state, _Inactive):
+            self.round = nack.round
+        else:
+            self.round = self.round_system.next_classic_round(self.index,
+                                                              nack.round)
+            self.leader_change(is_new_leader=True)
+
+    def _handle_chosen_watermark(self, src: Address,
+                                 msg: ChosenWatermark) -> None:
+        self.chosen_watermark = max(self.chosen_watermark, msg.slot)
+
+    def _handle_recover(self, src: Address, recover: Recover) -> None:
+        # Re-running Phase1 recovers every unchosen slot below some chosen
+        # one (Leader.scala:698-722).
+        if not isinstance(self.state, _Inactive):
+            self.leader_change(is_new_leader=True)

@@ -1,0 +1,230 @@
+"""MultiPaxos wire messages.
+
+Reference behavior: multipaxos/MultiPaxos.proto (one dataclass per
+message; the per-role ``<Role>Inbound`` oneof envelopes are unnecessary
+in Python -- receive() dispatches on type).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+from frankenpaxos_tpu.runtime.transport import Address
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    """Uniquely identifies a command: (client, pseudonym, id)
+    (MultiPaxos.proto CommandId)."""
+
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Noop:
+    pass
+
+
+NOOP = Noop()
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandBatch:
+    commands: tuple[Command, ...]
+
+
+# A log entry value: a batch of commands or a noop filler.
+CommandBatchOrNoop = Union[CommandBatch, Noop]
+
+
+# --- client <-> batcher/leader ---------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequestBatch:
+    batch: CommandBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class NotLeaderClient:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoRequestClient:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoReplyClient:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class NotLeaderBatcher:
+    client_request_batch: ClientRequestBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoRequestBatcher:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoReplyBatcher:
+    round: int
+
+
+# --- phase 1 ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+    chosen_watermark: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1bSlotInfo:
+    slot: int
+    vote_round: int
+    vote_value: CommandBatchOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    group_index: int
+    acceptor_index: int
+    round: int
+    info: tuple[Phase1bSlotInfo, ...]
+
+
+# --- phase 2 ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    slot: int
+    round: int
+    value: CommandBatchOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    group_index: int
+    acceptor_index: int
+    slot: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    value: CommandBatchOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Nack:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ChosenWatermark:
+    slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Recover:
+    slot: int
+
+
+# --- replies ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    slot: int
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReplyBatch:
+    batch: tuple[ClientReply, ...]
+
+
+# --- reads ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MaxSlotRequest:
+    command_id: CommandId
+
+
+@dataclasses.dataclass(frozen=True)
+class MaxSlotReply:
+    command_id: CommandId
+    group_index: int
+    acceptor_index: int
+    slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadRequest:
+    slot: int
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class SequentialReadRequest:
+    slot: int
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class EventualReadRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadReply:
+    command_id: CommandId
+    slot: int
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadReplyBatch:
+    batch: tuple[ReadReply, ...]
+
+
+# --- read batcher -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMaxSlotRequest:
+    read_batcher_index: int
+    read_batcher_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMaxSlotReply:
+    read_batcher_index: int
+    read_batcher_id: int
+    group_index: int
+    acceptor_index: int
+    slot: int

@@ -1,0 +1,131 @@
+"""MultiPaxos ProxyLeader.
+
+Reference behavior: multipaxos/ProxyLeader.scala:67-259. On Phase2a: fan
+the message to a write quorum (thrifty f+1 of the slot's acceptor group,
+or a random grid write quorum in flexible mode) and remember the value.
+On Phase2b: collect votes per (slot, round) until quorum -- THE hot loop
+-- then broadcast Chosen to every replica.
+
+The vote-collection loop is delegated to a
+:class:`~frankenpaxos_tpu.protocols.multipaxos.quorum_tracker.QuorumTracker`:
+the host-dict oracle or the TPU vote board flushed once per transport
+drain (``on_drain``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    Chosen,
+    Phase2a,
+    Phase2b,
+)
+from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+    DictQuorumTracker,
+    QuorumTracker,
+    TpuQuorumTracker,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProxyLeaderOptions:
+    flush_phase2as_every_n: int = 1
+    measure_latencies: bool = True
+    # "dict" (host oracle) or "tpu" (batched vote board).
+    quorum_backend: str = "dict"
+    tpu_window: int = 1 << 20
+
+
+class ProxyLeader(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MultiPaxosConfig,
+                 options: ProxyLeaderOptions = ProxyLeaderOptions(),
+                 collectors: Collectors | None = None, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.metrics_requests = collectors.counter(
+            "multipaxos_proxy_leader_requests_total", labels=("type",))
+        self.grid = config.quorum_grid() if config.flexible else None
+        self._row_size = len(config.acceptor_addresses[0])
+        # (slot, round) -> pending value; moved to _done once chosen.
+        self.pending: dict[tuple[int, int], object] = {}
+        self._done: set[tuple[int, int]] = set()
+        self.chosen_count = 0
+        self._unflushed_phase2as = 0
+        if options.quorum_backend == "tpu":
+            self.tracker: QuorumTracker = TpuQuorumTracker(
+                config, window=options.tpu_window)
+        else:
+            self.tracker = DictQuorumTracker(config)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase2a):
+            self.metrics_requests.labels("Phase2a").inc()
+            self._handle_phase2a(src, message)
+        elif isinstance(message, Phase2b):
+            self.metrics_requests.labels("Phase2b").inc()
+            self._handle_phase2b(src, message)
+        else:
+            self.logger.fatal(f"unexpected proxy leader message {message!r}")
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        key = (phase2a.slot, phase2a.round)
+        if key in self.pending:
+            self.logger.debug(f"duplicate Phase2a for {key}; ignoring")
+            return
+        if not self.config.flexible:
+            group = list(self.config.acceptor_addresses[
+                phase2a.slot % self.config.num_acceptor_groups])
+            quorum = self.rng.sample(group, self.config.f + 1)
+        else:
+            write_quorum = self.grid.random_write_quorum(self.rng)
+            quorum = [
+                self.config.acceptor_addresses[flat // self._row_size]
+                [flat % self._row_size] for flat in write_quorum]
+
+        if self.options.flush_phase2as_every_n <= 1:
+            for acceptor in quorum:
+                self.send(acceptor, phase2a)
+        else:
+            for acceptor in quorum:
+                self.send_no_flush(acceptor, phase2a)
+            self._unflushed_phase2as += 1
+            if self._unflushed_phase2as >= self.options.flush_phase2as_every_n:
+                for group_addresses in self.config.acceptor_addresses:
+                    for acceptor in group_addresses:
+                        self.flush(acceptor)
+                self._unflushed_phase2as = 0
+        self.pending[key] = phase2a.value
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        key = (phase2b.slot, phase2b.round)
+        if key not in self.pending:
+            # Either never proposed here (a fatal bug in the reference,
+            # ProxyLeader.scala:227-232) or already chosen. The tracker
+            # dedups chosen slots; unknown (slot, round)s are fatal.
+            if key not in self._done:
+                self.logger.fatal(
+                    f"ProxyLeader got Phase2b for {key} but never sent a "
+                    f"Phase2a there")
+            return
+        self.tracker.record(phase2b.slot, phase2b.round,
+                            phase2b.group_index, phase2b.acceptor_index)
+
+    def on_drain(self) -> None:
+        for key in self.tracker.drain():
+            value = self.pending.pop(key, None)
+            if value is None:
+                continue
+            self._done.add(key)
+            self.chosen_count += 1
+            for replica in self.config.replica_addresses:
+                self.send(replica, Chosen(slot=key[0], value=value))

@@ -1,0 +1,114 @@
+"""Pluggable Phase2b write-quorum tracking: host dict or TPU vote board.
+
+The ProxyLeader's vote-collection loop (ProxyLeader.scala:217-258) is the
+hottest code in the reference. Here it is a strategy interface with two
+implementations:
+
+  * ``DictQuorumTracker`` -- the reference's semantics verbatim: a dict
+    keyed (slot, round) accumulating (group, acceptor) votes. The oracle.
+  * ``TpuQuorumTracker`` -- votes buffered per event-loop drain, then one
+    ``TpuQuorumChecker.record_and_check`` scatter + matmul per drain.
+    Acceptor coordinates flatten to columns ``group * group_size + index``.
+    In non-flexible mode only a slot's own group is ever messaged, so a
+    universe-wide count >= f+1 threshold is exactly the per-group f+1
+    quorum; in flexible mode the grid write-spec applies.
+
+Both report each (slot, round)'s quorum exactly once.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker
+from frankenpaxos_tpu.quorums import QuorumSpec
+from frankenpaxos_tpu.quorums.spec import ANY
+from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
+
+
+class QuorumTracker(abc.ABC):
+    """Tracks Phase2b votes; reports slots whose quorum completes."""
+
+    @abc.abstractmethod
+    def record(self, slot: int, round: int, group_index: int,
+               acceptor_index: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def drain(self) -> list[tuple[int, int]]:
+        """Flush buffered votes; return [(slot, round)] newly at quorum."""
+
+
+class DictQuorumTracker(QuorumTracker):
+    def __init__(self, config: MultiPaxosConfig):
+        self.config = config
+        self.grid = config.quorum_grid() if config.flexible else None
+        self._row_size = len(config.acceptor_addresses[0])
+        # (slot, round) -> set of (group, index); None once chosen.
+        self.states: dict[tuple[int, int], set | None] = {}
+        self._newly: list[tuple[int, int]] = []
+
+    def record(self, slot, round, group_index, acceptor_index) -> None:
+        key = (slot, round)
+        votes = self.states.get(key)
+        if votes is None and key in self.states:
+            return  # already chosen (Done)
+        if votes is None:
+            votes = set()
+            self.states[key] = votes
+        votes.add((group_index, acceptor_index))
+        if self.config.flexible:
+            flat = {g * self._row_size + i for g, i in votes}
+            if not self.grid.is_superset_of_write_quorum(flat):
+                return
+        else:
+            if len(votes) < self.config.f + 1:
+                return
+        self.states[key] = None  # Done
+        self._newly.append(key)
+
+    def drain(self) -> list[tuple[int, int]]:
+        newly, self._newly = self._newly, []
+        return newly
+
+
+class TpuQuorumTracker(QuorumTracker):
+    def __init__(self, config: MultiPaxosConfig, window: int = 1 << 20):
+        self.config = config
+        self._row_size = len(config.acceptor_addresses[0])
+        num_cols = config.num_acceptor_groups * self._row_size
+        universe = tuple(range(num_cols))
+        if config.flexible:
+            spec = config.quorum_grid().write_spec().reindexed(universe)
+        else:
+            spec = QuorumSpec(
+                masks=np.ones((1, num_cols), dtype=np.uint8),
+                thresholds=np.array([config.f + 1], dtype=np.int32),
+                combine=ANY,
+                universe=universe,
+            )
+        self.checker = TpuQuorumChecker(spec, window=window)
+        self._slots: list[int] = []
+        self._cols: list[int] = []
+        self._rounds: list[int] = []
+
+    def record(self, slot, round, group_index, acceptor_index) -> None:
+        self._slots.append(slot)
+        self._cols.append(group_index * self._row_size + acceptor_index)
+        self._rounds.append(round)
+
+    def drain(self) -> list[tuple[int, int]]:
+        if not self._slots:
+            return []
+        newly = self.checker.record_and_check(self._slots, self._cols,
+                                              self._rounds)
+        out: list[tuple[int, int]] = []
+        seen: set[int] = set()
+        for slot, round, hit in zip(self._slots, self._rounds, newly):
+            if hit and slot not in seen:
+                seen.add(slot)
+                out.append((slot, round))
+        self._slots, self._cols, self._rounds = [], [], []
+        return out

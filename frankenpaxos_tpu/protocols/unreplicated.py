@@ -1,0 +1,126 @@
+"""Unreplicated: a single server executing a state machine directly.
+
+Reference behavior: unreplicated/ (unreplicated/Unreplicated.proto,
+Server.scala, Client.scala). The throughput upper-bound baseline: no
+consensus, just client -> server -> state machine -> reply, with
+exactly-once via per-client command ids and client resend timers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+class UnreplicatedServer(Actor):
+    """Executes commands in arrival order; caches the last reply per
+    (client, pseudonym) for resend dedup."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, state_machine: StateMachine,
+                 flush_every_n: int = 1):
+        super().__init__(address, transport, logger)
+        self.state_machine = state_machine
+        self.flush_every_n = flush_every_n
+        self._unflushed = 0
+        # (client address, pseudonym) -> (largest executed id, its reply)
+        self.client_table: dict[tuple, tuple[int, bytes]] = {}
+
+    def receive(self, src: Address, message: ClientRequest) -> None:
+        key = (message.client_address, message.client_pseudonym)
+        executed = self.client_table.get(key)
+        if executed is not None:
+            largest_id, cached = executed
+            if message.client_id < largest_id:
+                return  # stale; client has moved on
+            if message.client_id == largest_id:
+                self.send(src, ClientReply(message.client_pseudonym,
+                                           message.client_id, cached))
+                return
+        result = self.state_machine.run(message.command)
+        self.client_table[key] = (message.client_id, result)
+        reply = ClientReply(message.client_pseudonym, message.client_id,
+                            result)
+        if self.flush_every_n <= 1:
+            self.send(src, reply)
+        else:
+            self.send_no_flush(src, reply)
+            self._unflushed += 1
+            if self._unflushed >= self.flush_every_n:
+                self.flush(src)
+                self._unflushed = 0
+
+
+@dataclasses.dataclass
+class _PendingCommand:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend_timer: object
+
+
+class UnreplicatedClient(Actor):
+    """Issues commands with per-pseudonym increasing ids; resends on
+    timeout (unreplicated/Client.scala)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, server_address: Address,
+                 resend_period_s: float = 1.0):
+        super().__init__(address, transport, logger)
+        self.server_address = server_address
+        self.resend_period_s = resend_period_s
+        self._ids: dict[int, int] = {}          # pseudonym -> next id
+        self._pending: dict[int, _PendingCommand] = {}  # per pseudonym
+
+    def propose(self, pseudonym: int, command: bytes,
+                callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self._pending:
+            raise RuntimeError(
+                f"pseudonym {pseudonym} already has a pending command")
+        client_id = self._ids.get(pseudonym, 0)
+        request = ClientRequest(self.address, pseudonym, client_id, command)
+        timer = self.timer(
+            f"resend-{pseudonym}-{client_id}", self.resend_period_s,
+            lambda: self._resend(pseudonym))
+        timer.start()
+        self._pending[pseudonym] = _PendingCommand(
+            client_id, command, callback or (lambda _: None), timer)
+        self.send(self.server_address, request)
+
+    def _resend(self, pseudonym: int) -> None:
+        pending = self._pending.get(pseudonym)
+        if pending is None:
+            return
+        self.send(self.server_address,
+                  ClientRequest(self.address, pseudonym, pending.id,
+                                pending.command))
+        pending.resend_timer.start()
+
+    def receive(self, src: Address, message: ClientReply) -> None:
+        pending = self._pending.get(message.client_pseudonym)
+        if pending is None or pending.id != message.client_id:
+            self.logger.debug(f"stale reply {message}")
+            return
+        pending.resend_timer.stop()
+        del self._pending[message.client_pseudonym]
+        self._ids[message.client_pseudonym] = message.client_id + 1
+        pending.callback(message.result)

@@ -1,0 +1,56 @@
+"""The actor runtime kernel (reference L0/L1).
+
+Reference behavior: Actor.scala:7-51, Transport.scala:44-99, Chan.scala:3-17,
+Timer.scala:23-42, Serializer.scala:5-10, Logger.scala:1-118,
+FakeTransport.scala:64-183, NettyTcpTransport.scala:124-505.
+
+The load-bearing invariant (Transport.scala:37-40): **every transport is a
+single-threaded event loop** -- `receive` and timer callbacks run serially.
+Protocols are therefore deterministic, lock-free state machines; all
+parallelism lives in the batched device kernels they call into.
+"""
+
+from frankenpaxos_tpu.runtime.actor import Actor, Chan
+from frankenpaxos_tpu.runtime.logger import (
+    FakeLogger,
+    FileLogger,
+    LogLevel,
+    Logger,
+    PrintLogger,
+)
+from frankenpaxos_tpu.runtime.monitoring import (
+    Collectors,
+    Counter,
+    FakeCollectors,
+    Gauge,
+    PrometheusCollectors,
+    Summary,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    PickleSerializer,
+    Serializer,
+)
+from frankenpaxos_tpu.runtime.sim_transport import SimTimer, SimTransport
+from frankenpaxos_tpu.runtime.transport import Timer, Transport
+
+__all__ = [
+    "Actor",
+    "Chan",
+    "Collectors",
+    "Counter",
+    "FakeCollectors",
+    "FakeLogger",
+    "FileLogger",
+    "Gauge",
+    "LogLevel",
+    "Logger",
+    "PickleSerializer",
+    "PrintLogger",
+    "PrometheusCollectors",
+    "Serializer",
+    "SimTimer",
+    "SimTransport",
+    "Summary",
+    "Timer",
+    "Transport",
+]

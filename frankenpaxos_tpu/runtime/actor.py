@@ -1,0 +1,91 @@
+"""Actor and Chan.
+
+Reference behavior: Actor.scala:7-51 (address/transport/logger; declares
+InboundMessage + serializer + receive; registers itself at construction;
+chan/send/sendNoFlush/flush helpers; timer factory) and Chan.scala:3-17
+(typed channel serializing the *destination's* inbound type).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Generic, TypeVar
+
+from frankenpaxos_tpu.runtime.logger import Logger
+from frankenpaxos_tpu.runtime.serializer import PickleSerializer, Serializer
+from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
+
+M = TypeVar("M")
+
+
+class Chan(Generic[M]):
+    """A typed channel from a source actor to a destination address
+    (Chan.scala:3-17)."""
+
+    def __init__(self, transport: Transport, src: Address, dst: Address,
+                 serializer: Serializer[M]):
+        self.transport = transport
+        self.src = src
+        self.dst = dst
+        self.serializer = serializer
+
+    def send(self, message: M) -> None:
+        self.transport.send(self.src, self.dst,
+                            self.serializer.to_bytes(message))
+
+    def send_no_flush(self, message: M) -> None:
+        self.transport.send_no_flush(self.src, self.dst,
+                                     self.serializer.to_bytes(message))
+
+    def flush(self) -> None:
+        self.transport.flush(self.src, self.dst)
+
+
+class Actor(abc.ABC):
+    """A single-threaded protocol role.
+
+    Subclasses set ``serializer`` (for their own inbound messages) and
+    implement ``receive``. Like the reference (Actor.scala:19-20), an
+    actor registers with its transport at construction.
+    """
+
+    serializer: Serializer = PickleSerializer()
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger):
+        self.address = address
+        self.transport = transport
+        self.logger = logger
+        transport.register(address, self)
+
+    @abc.abstractmethod
+    def receive(self, src: Address, message: Any) -> None:
+        ...
+
+    def on_drain(self) -> None:
+        """Called by the transport after it finishes delivering a batch of
+        inbound messages. Actors that stage work for batched device kernels
+        (e.g. ProxyLeader vote collection onto the TpuQuorumChecker) flush
+        it here -- the host-side analog of "one jitted step per event-loop
+        drain" (SURVEY.md section 7)."""
+
+    # --- helpers (Actor.scala:26-50) --------------------------------------
+    def chan(self, dst: Address,
+             serializer: Serializer | None = None) -> Chan:
+        return Chan(self.transport, self.address, dst,
+                    serializer or PickleSerializer())
+
+    def send(self, dst: Address, message: Any,
+             serializer: Serializer | None = None) -> None:
+        self.chan(dst, serializer).send(message)
+
+    def send_no_flush(self, dst: Address, message: Any,
+                      serializer: Serializer | None = None) -> None:
+        self.chan(dst, serializer).send_no_flush(message)
+
+    def flush(self, dst: Address) -> None:
+        self.transport.flush(self.address, dst)
+
+    def timer(self, name: str, delay_s: float,
+              f: Callable[[], None]) -> Timer:
+        return self.transport.timer(self.address, name, delay_s, f)

@@ -1,0 +1,42 @@
+"""Message serialization.
+
+Reference behavior: Serializer.scala:5-10 / ProtoSerializer.scala:3-11 --
+every inbound message type has a serializer with ``to_bytes`` /
+``from_bytes`` plus a debug ``to_pretty_string``.
+
+Protocol messages here are plain dataclasses; the default wire format is
+pickle (simple, complete). The framing layer (tcp_transport / the C++
+codec) is format-agnostic, so a fixed-layout binary codec can replace
+pickle per-message-type without touching protocol code.
+"""
+
+from __future__ import annotations
+
+import abc
+import pickle
+from typing import Generic, TypeVar
+
+M = TypeVar("M")
+
+
+class Serializer(abc.ABC, Generic[M]):
+    @abc.abstractmethod
+    def to_bytes(self, message: M) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def from_bytes(self, data: bytes) -> M:
+        ...
+
+    def to_pretty_string(self, message: M) -> str:
+        return repr(message)
+
+
+class PickleSerializer(Serializer[M]):
+    """Default serializer for dataclass messages."""
+
+    def to_bytes(self, message: M) -> bytes:
+        return pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def from_bytes(self, data: bytes) -> M:
+        return pickle.loads(data)

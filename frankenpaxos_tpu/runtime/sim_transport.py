@@ -1,0 +1,197 @@
+"""SimTransport: the deterministic in-memory transport for testing.
+
+Reference behavior: FakeTransport.scala:64-230. Messages accumulate in a
+buffer instead of being delivered; tests (and the property-based
+simulator, sim/) explicitly deliver any buffered message or trigger any
+running timer, in any order. That explores reordering, duplication (via
+protocol resends), and loss (never delivering). Everything executes
+inline on the caller's thread (FakeTransport.scala:127-140), keeping runs
+perfectly deterministic for a given command sequence.
+
+Also supports actor partitioning (JsTransport.scala:77): messages to or
+from a partitioned actor are dropped at delivery time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.runtime.actor import Actor
+from frankenpaxos_tpu.runtime.logger import Logger, PrintLogger
+from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class SimMessage:
+    id: int
+    src: Address
+    dst: Address
+    data: bytes
+
+
+class SimTimer(Timer):
+    """A timer that only fires when the test triggers it
+    (FakeTransport.scala:9-62)."""
+
+    def __init__(self, transport: "SimTransport", timer_id: int,
+                 address: Address, name: str, delay_s: float,
+                 f: Callable[[], None]):
+        self._transport = transport
+        self._id = timer_id
+        self.address = address
+        self._name = name
+        self.delay_s = delay_s
+        self._f = f
+        self.running = False
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def id(self) -> int:
+        return self._id
+
+    def start(self) -> None:
+        self.running = True
+
+    def stop(self) -> None:
+        self.running = False
+
+    def run(self) -> None:
+        """Fire the timer (one-shot: stops first, like
+        FakeTransport.scala:40-46)."""
+        if self.running:
+            self.running = False
+            self._f()
+
+
+# Commands the simulator replays against a SimTransport (the bridge to
+# property-based testing, FakeTransport.scala:196-230).
+@dataclasses.dataclass(frozen=True)
+class DeliverMessage:
+    message: SimMessage
+
+
+@dataclasses.dataclass(frozen=True)
+class TriggerTimer:
+    address: Address
+    name: str
+    timer_id: int
+
+
+SimCommand = Union[DeliverMessage, TriggerTimer]
+
+
+class SimTransport(Transport):
+    """Addresses are arbitrary hashables (conventionally strings)."""
+
+    def __init__(self, logger: Optional[Logger] = None):
+        self.logger = logger or PrintLogger()
+        self.actors: dict[Address, Actor] = {}
+        self.messages: list[SimMessage] = []
+        self.timers: dict[int, SimTimer] = {}
+        self.partitioned: set[Address] = set()
+        self.history: list[SimCommand] = []
+        self._ids = itertools.count()
+
+    # --- Transport API ----------------------------------------------------
+    def register(self, address: Address, actor: Actor) -> None:
+        if address in self.actors:
+            raise ValueError(f"an actor is already registered at {address}")
+        self.actors[address] = actor
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        self.messages.append(SimMessage(next(self._ids), src, dst, data))
+
+    def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
+        self.send(src, dst, data)
+
+    def flush(self, src: Address, dst: Address) -> None:
+        pass
+
+    def timer(self, address: Address, name: str, delay_s: float,
+              f: Callable[[], None]) -> SimTimer:
+        t = SimTimer(self, next(self._ids), address, name, delay_s, f)
+        self.timers[t.id] = t
+        return t
+
+    # --- test / simulator API (FakeTransport.scala:142-230) ---------------
+    def running_timers(self) -> list[SimTimer]:
+        return [t for t in self.timers.values() if t.running]
+
+    def deliver_message(self, message: SimMessage) -> None:
+        """Remove ``message`` from the buffer and run the destination's
+        ``receive`` inline. Unknown/partitioned destinations drop."""
+        try:
+            self.messages.remove(message)
+        except ValueError:
+            self.logger.warn(f"delivering unbuffered message {message}")
+            return
+        self.history.append(DeliverMessage(message))
+        if (message.dst in self.partitioned
+                or message.src in self.partitioned):
+            return
+        actor = self.actors.get(message.dst)
+        if actor is None:
+            self.logger.warn(f"no actor registered at {message.dst}")
+            return
+        actor.receive(message.src, actor.serializer.from_bytes(message.data))
+        actor.on_drain()
+
+    def trigger_timer(self, timer_id: int) -> None:
+        timer = self.timers.get(timer_id)
+        if timer is None or not timer.running:
+            return
+        if timer.address in self.partitioned:
+            timer.stop()
+            return
+        self.history.append(
+            TriggerTimer(timer.address, timer.name, timer_id))
+        timer.run()
+
+    def run_command(self, command: SimCommand) -> None:
+        if isinstance(command, DeliverMessage):
+            self.deliver_message(command.message)
+        else:
+            self.trigger_timer(command.timer_id)
+
+    def possible_commands(self) -> list[SimCommand]:
+        """Everything that could happen next (FakeTransport.scala:196-220)."""
+        commands: list[SimCommand] = [DeliverMessage(m)
+                                      for m in self.messages]
+        commands.extend(TriggerTimer(t.address, t.name, t.id)
+                        for t in self.running_timers())
+        return commands
+
+    def generate_command(self, rng) -> Optional[SimCommand]:
+        """Pick a random next step, weighting deliveries vs. timers by
+        availability (the spirit of FakeTransport.generateCommand)."""
+        n_msgs = len(self.messages)
+        running = self.running_timers()
+        total = n_msgs + len(running)
+        if total == 0:
+            return None
+        i = rng.randrange(total)
+        if i < n_msgs:
+            return DeliverMessage(self.messages[i])
+        return TriggerTimer(running[i - n_msgs].address,
+                            running[i - n_msgs].name,
+                            running[i - n_msgs].id)
+
+    def deliver_all(self, max_steps: int = 100000) -> int:
+        """FIFO-deliver until no messages remain (no timers). Convenience
+        for non-adversarial integration tests."""
+        steps = 0
+        while self.messages and steps < max_steps:
+            self.deliver_message(self.messages[0])
+            steps += 1
+        return steps
+
+    def partition(self, address: Address) -> None:
+        self.partitioned.add(address)
+
+    def heal(self, address: Address) -> None:
+        self.partitioned.discard(address)

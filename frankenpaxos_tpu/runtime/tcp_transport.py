@@ -1,0 +1,249 @@
+"""TcpTransport: the production transport (asyncio).
+
+Reference behavior: NettyTcpTransport.scala:124-505 --
+
+  * one event-loop thread for everything (``NioEventLoopGroup(1)``,
+    NettyTcpTransport.scala:240) -> here: one asyncio loop; ``receive``
+    and timer callbacks run serially on it, preserving the single-thread
+    contract;
+  * 4-byte length-prefixed frames, 10 MiB max
+    (``LengthFieldBasedFrameDecoder(10485760, 0, 4, 0, 4)``,
+    NettyTcpTransport.scala:353,417);
+  * lazy connection establishment with pending-message buffering
+    (NettyTcpTransport.scala:377-445), channel map keyed
+    ``(local_actor_address, remote_address)``
+    (NettyTcpTransport.scala:268-271);
+  * ``send_no_flush`` + ``flush`` write coalescing
+    (NettyTcpTransport.scala:455-495);
+  * timers scheduled on the same loop (NettyTcpTransport.scala:78-122).
+
+Addresses are ``(host, port)`` tuples. Each frame is prefixed by the
+sender's address (so the receiving actor sees a meaningful ``src``),
+mirroring the reference where inbound connections learn the remote actor
+address from the channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.runtime.actor import Actor
+from frankenpaxos_tpu.runtime.logger import Logger, PrintLogger
+from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
+
+MAX_FRAME = 10 * 1024 * 1024  # 10 MiB, like the reference's frame decoder
+_LEN = struct.Struct(">I")
+
+
+def _encode_frame(src: Address, data: bytes) -> bytes:
+    host, port = src
+    header = f"{host}:{port}".encode()
+    payload = _LEN.pack(len(header)) + header + data
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame of {len(payload)} bytes exceeds {MAX_FRAME}")
+    return _LEN.pack(len(payload)) + payload
+
+
+class TcpTimer(Timer):
+    def __init__(self, loop: asyncio.AbstractEventLoop, name: str,
+                 delay_s: float, f: Callable[[], None]):
+        self._loop = loop
+        self._name = name
+        self._delay_s = delay_s
+        self._f = f
+        self._handle: Optional[asyncio.TimerHandle] = None
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def start(self) -> None:
+        self._loop.call_soon_threadsafe(self._start_on_loop)
+
+    def _start_on_loop(self) -> None:
+        if self._handle is None:
+            self._handle = self._loop.call_later(self._delay_s, self._fire)
+
+    def stop(self) -> None:
+        self._loop.call_soon_threadsafe(self._stop_on_loop)
+
+    def _stop_on_loop(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    def _fire(self) -> None:
+        self._handle = None
+        self._f()
+
+
+class _Conn:
+    """One outbound connection with lazy connect + pending buffer
+    (NettyTcpTransport.scala:377-445)."""
+
+    def __init__(self):
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.pending: list[bytes] = []
+        self.connecting = False
+
+
+class TcpTransport(Transport):
+    """Run the loop either externally (``await serve()``) or on a daemon
+    thread (``start()``) for synchronous callers like the CLI mains."""
+
+    def __init__(self, listen_address: Optional[Address] = None,
+                 logger: Optional[Logger] = None):
+        self.logger = logger or PrintLogger()
+        self.listen_address = listen_address
+        self.actors: dict[Address, Actor] = {}
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._conns: dict[tuple[Address, Address], _Conn] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # --- lifecycle --------------------------------------------------------
+    async def serve(self) -> None:
+        """Bind (if a listen address was given) and run until cancelled."""
+        self.loop = asyncio.get_running_loop()
+        if self.listen_address is not None:
+            host, port = self.listen_address
+            self._server = await asyncio.start_server(
+                self._handle_conn, host, port)
+        self._started.set()
+        try:
+            await asyncio.Event().wait()  # run forever
+        finally:
+            await self._shutdown()
+
+    def start(self) -> None:
+        """Spawn the event loop on a daemon thread and wait until bound."""
+        def runner():
+            try:
+                asyncio.run(self.serve())
+            except asyncio.CancelledError:
+                pass
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("TcpTransport failed to start")
+
+    def stop(self) -> None:
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(
+                lambda: [t.cancel() for t in asyncio.all_tasks(self.loop)])
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    async def _shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for conn in self._conns.values():
+            if conn.writer is not None:
+                conn.writer.close()
+
+    # --- inbound ----------------------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                head = await reader.readexactly(4)
+                (length,) = _LEN.unpack(head)
+                if length > MAX_FRAME:
+                    self.logger.error(f"oversized frame ({length} bytes)")
+                    break
+                payload = await reader.readexactly(length)
+                (hlen,) = _LEN.unpack(payload[:4])
+                header = payload[4:4 + hlen].decode()
+                host, _, port = header.rpartition(":")
+                src: Address = (host, int(port))
+                data = payload[4 + hlen:]
+                self._dispatch(src, data)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    def _dispatch(self, src: Address, data: bytes) -> None:
+        # Frames address the listening endpoint; with one actor per
+        # process-port (the deployment model, one role per process), the
+        # single registered actor on this transport receives it.
+        if self.listen_address is not None:
+            actor = self.actors.get(self.listen_address)
+            if actor is not None:
+                actor.receive(src, actor.serializer.from_bytes(data))
+                actor.on_drain()
+                return
+        self.logger.warn(f"dropping frame from {src}: no registered actor")
+
+    # --- Transport API ----------------------------------------------------
+    def register(self, address: Address, actor: Actor) -> None:
+        if address in self.actors:
+            raise ValueError(f"an actor is already registered at {address}")
+        self.actors[address] = actor
+
+    def _conn_for(self, src: Address, dst: Address) -> _Conn:
+        key = (src, dst)
+        conn = self._conns.get(key)
+        if conn is None:
+            conn = _Conn()
+            self._conns[key] = conn
+        return conn
+
+    def _write(self, src: Address, dst: Address, data: bytes,
+               flush: bool) -> None:
+        assert self.loop is not None, "transport not started"
+        conn = self._conn_for(src, dst)
+        conn.pending.append(_encode_frame(src, data))
+        if conn.writer is not None:
+            if flush:
+                self._flush_conn(conn)
+        elif not conn.connecting:
+            conn.connecting = True
+            self.loop.create_task(self._connect(conn, dst))
+
+    async def _connect(self, conn: _Conn, dst: Address) -> None:
+        host, port = dst
+        try:
+            _, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            self.logger.warn(f"connect to {dst} failed: {e}; "
+                             f"dropping {len(conn.pending)} pending")
+            conn.pending.clear()
+            conn.connecting = False
+            return
+        conn.writer = writer
+        conn.connecting = False
+        self._flush_conn(conn)
+
+    def _flush_conn(self, conn: _Conn) -> None:
+        if conn.writer is None or not conn.pending:
+            return
+        conn.writer.write(b"".join(conn.pending))
+        conn.pending.clear()
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        self._call_on_loop(lambda: self._write(src, dst, data, flush=True))
+
+    def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
+        self._call_on_loop(lambda: self._write(src, dst, data, flush=False))
+
+    def flush(self, src: Address, dst: Address) -> None:
+        self._call_on_loop(
+            lambda: self._flush_conn(self._conn_for(src, dst)))
+
+    def _call_on_loop(self, f: Callable[[], None]) -> None:
+        assert self.loop is not None, "transport not started"
+        if threading.get_ident() == getattr(self.loop, "_thread_id", None):
+            f()
+        else:
+            self.loop.call_soon_threadsafe(f)
+
+    def timer(self, address: Address, name: str, delay_s: float,
+              f: Callable[[], None]) -> TcpTimer:
+        assert self.loop is not None, "transport not started"
+        return TcpTimer(self.loop, name, delay_s, f)

@@ -1,0 +1,76 @@
+"""Transport and Timer contracts.
+
+Reference behavior: Transport.scala:44-99 (associated Address/Timer types;
+register/send/sendNoFlush/flush/timer) and Timer.scala:23-42
+(name/start/stop/reset; names are non-unique, purely for debugging).
+
+THE CONTRACT (Transport.scala:37-40): a transport is a single-threaded
+event loop. ``Actor.receive`` and timer callbacks run serially on one
+logical thread; protocol code never needs locks and stays deterministic.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Hashable, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from frankenpaxos_tpu.runtime.actor import Actor
+
+# Addresses are opaque hashable values; each transport documents its
+# concrete address type (host:port tuples for TCP, strings for sim).
+Address = Hashable
+
+
+class Timer(abc.ABC):
+    """A restartable one-shot timer owned by an actor's event loop."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        ...
+
+    def reset(self) -> None:
+        self.stop()
+        self.start()
+
+
+class Transport(abc.ABC):
+    """Asynchronous, unordered, at-most-once message delivery between
+    registered actors, plus timers -- all on one event loop."""
+
+    @abc.abstractmethod
+    def register(self, address: Address, actor: "Actor") -> None:
+        """Register ``actor`` to receive messages addressed to ``address``.
+        At most one actor per address (Transport.scala:58-63)."""
+
+    @abc.abstractmethod
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        ...
+
+    @abc.abstractmethod
+    def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
+        """Queue without flushing; enables write batching
+        (NettyTcpTransport.scala:455-495)."""
+
+    @abc.abstractmethod
+    def flush(self, src: Address, dst: Address) -> None:
+        ...
+
+    @abc.abstractmethod
+    def timer(self, address: Address, name: str, delay_s: float,
+              f: Callable[[], None]) -> Timer:
+        """Create a stopped timer on ``address``'s event loop firing ``f``
+        after ``delay_s`` once started."""
+
+    def stage(self) -> Any:
+        """Optional hook: transports that batch device work override this."""
+        return None

@@ -1,0 +1,16 @@
+"""Deterministic randomized protocol simulation.
+
+Reference behavior: shared/src/test/scala/frankenpaxos/simulator/
+(SimulatedSystem.scala:152-200, Simulator.scala:221-266): a
+QuickCheck-for-stateful-systems harness that runs many random executions
+of a protocol wired over a SimTransport, checks invariants after every
+step, and minimizes failing traces to near-minimal reproducers.
+"""
+
+from frankenpaxos_tpu.sim.simulator import (
+    BadHistory,
+    SimulatedSystem,
+    Simulator,
+)
+
+__all__ = ["BadHistory", "SimulatedSystem", "Simulator"]

@@ -1,0 +1,128 @@
+"""Wire a whole MultiPaxos deployment over one SimTransport.
+
+The analog of the reference's test harness
+(shared/src/test/scala/multipaxos/MultiPaxos.scala:17-171): every role
+in one process, driven by explicit message deliveries / timer firings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog, StateMachine
+from frankenpaxos_tpu.protocols.multipaxos import (
+    Acceptor,
+    Batcher,
+    BatcherOptions,
+    Client,
+    ClientOptions,
+    DistributionScheme,
+    Leader,
+    LeaderOptions,
+    MultiPaxosConfig,
+    ProxyLeader,
+    ProxyLeaderOptions,
+    ProxyReplica,
+    Replica,
+    ReplicaOptions,
+)
+
+
+@dataclasses.dataclass
+class MultiPaxosSim:
+    transport: SimTransport
+    config: MultiPaxosConfig
+    batchers: list
+    leaders: list
+    proxy_leaders: list
+    acceptors: list
+    replicas: list
+    proxy_replicas: list
+    clients: list
+
+
+def make_multipaxos(
+    f: int = 1,
+    num_clients: int = 1,
+    num_acceptor_groups: int = 1,
+    num_batchers: int = 0,
+    num_proxy_replicas: int = 0,
+    flexible: bool = False,
+    grid_shape: tuple[int, int] | None = None,
+    batch_size: int = 1,
+    quorum_backend: str = "dict",
+    state_machine_factory=AppendLog,
+    seed: int = 0,
+    log_level: LogLevel = LogLevel.FATAL,
+) -> MultiPaxosSim:
+    logger = FakeLogger(log_level)
+    transport = SimTransport(logger)
+
+    if flexible:
+        rows, cols = grid_shape or (f + 1, f + 1)
+        acceptor_addresses = [[f"acceptor-{g}-{i}" for i in range(cols)]
+                              for g in range(rows)]
+    else:
+        acceptor_addresses = [
+            [f"acceptor-{g}-{i}" for i in range(2 * f + 1)]
+            for g in range(num_acceptor_groups)]
+
+    config = MultiPaxosConfig(
+        f=f,
+        batcher_addresses=[f"batcher-{i}" for i in range(num_batchers)],
+        read_batcher_addresses=[],
+        leader_addresses=[f"leader-{i}" for i in range(f + 1)],
+        leader_election_addresses=[f"election-{i}" for i in range(f + 1)],
+        proxy_leader_addresses=[f"proxy-leader-{i}" for i in range(f + 1)],
+        acceptor_addresses=acceptor_addresses,
+        replica_addresses=[f"replica-{i}" for i in range(f + 1)],
+        proxy_replica_addresses=[f"proxy-replica-{i}"
+                                 for i in range(num_proxy_replicas)],
+        flexible=flexible,
+        distribution_scheme=DistributionScheme.HASH,
+    )
+    config.check_valid()
+
+    batchers = [
+        Batcher(a, transport, logger, config,
+                BatcherOptions(batch_size=batch_size))
+        for a in config.batcher_addresses]
+    leaders = [
+        Leader(a, transport, logger, config,
+               LeaderOptions(resend_phase1as_period_s=5.0), seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)]
+    proxy_leaders = [
+        ProxyLeader(a, transport, logger, config,
+                    ProxyLeaderOptions(quorum_backend=quorum_backend,
+                                       tpu_window=1 << 12),
+                    seed=seed + 10 + i)
+        for i, a in enumerate(config.proxy_leader_addresses)]
+    acceptors = [
+        Acceptor(a, transport, logger, config)
+        for group in config.acceptor_addresses for a in group]
+    replicas = [
+        Replica(a, transport, logger, state_machine_factory(), config,
+                ReplicaOptions(send_chosen_watermark_every_n_entries=10),
+                seed=seed + 20 + i)
+        for i, a in enumerate(config.replica_addresses)]
+    proxy_replicas = [
+        ProxyReplica(a, transport, logger, config)
+        for a in config.proxy_replica_addresses]
+    clients = [
+        Client(f"client-{i}", transport, logger, config,
+               ClientOptions(), seed=seed + 30 + i)
+        for i in range(num_clients)]
+
+    return MultiPaxosSim(transport, config, batchers, leaders, proxy_leaders,
+                         acceptors, replicas, proxy_replicas, clients)
+
+
+def executed_prefix(replica: Replica) -> list:
+    """The replica's executed log prefix as a list of values."""
+    return [replica.log.get(slot)
+            for slot in range(replica.executed_watermark)]
+
+
+def state_machine_of(sim: MultiPaxosSim, i: int) -> StateMachine:
+    return sim.replicas[i].state_machine

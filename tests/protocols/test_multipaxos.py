@@ -1,0 +1,268 @@
+"""MultiPaxos: end-to-end integration over SimTransport, plus the
+property-based simulation with the reference's invariants
+(multipaxos/MultiPaxos.scala:291-318: replica executed-log prefixes
+mutually compatible; logs only grow)."""
+
+import random
+from typing import Optional
+
+import pytest
+
+from frankenpaxos_tpu.runtime import PickleSerializer
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import (
+    GetRequest,
+    KeyValueStore,
+    SetRequest,
+)
+
+from tests.protocols.multipaxos_harness import (
+    executed_prefix,
+    make_multipaxos,
+)
+
+SER = PickleSerializer()
+
+
+def run_write(sim, client_index, pseudonym, payload):
+    got = []
+    sim.clients[client_index].write(pseudonym, payload, got.append)
+    sim.transport.deliver_all()
+    return got
+
+
+class TestMultiPaxosIntegration:
+    def test_single_write(self):
+        sim = make_multipaxos(f=1)
+        got = run_write(sim, 0, 0, b"hello")
+        assert got == [b"0"]
+        for replica in sim.replicas:
+            assert replica.state_machine.get() == [b"hello"]
+
+    def test_sequential_writes_agree(self):
+        sim = make_multipaxos(f=1)
+        for i in range(10):
+            assert run_write(sim, 0, 0, b"cmd%d" % i) == [b"%d" % i]
+        logs = [executed_prefix(r) for r in sim.replicas]
+        assert logs[0] == logs[1]
+        assert len(logs[0]) == 10
+
+    def test_multiple_clients_pseudonyms(self):
+        sim = make_multipaxos(f=1, num_clients=3)
+        results = []
+        for i, client in enumerate(sim.clients):
+            client.write(0, b"c%d-p0" % i, results.append)
+            client.write(1, b"c%d-p1" % i, results.append)
+        sim.transport.deliver_all()
+        assert len(results) == 6
+        for replica in sim.replicas:
+            assert len(replica.state_machine.get()) == 6
+
+    def test_f2(self):
+        sim = make_multipaxos(f=2)
+        assert run_write(sim, 0, 0, b"x") == [b"0"]
+
+    def test_multiple_acceptor_groups(self):
+        sim = make_multipaxos(f=1, num_acceptor_groups=3)
+        for i in range(6):
+            assert run_write(sim, 0, 0, b"cmd%d" % i) == [b"%d" % i]
+        # Slots round-robin over groups: every group voted.
+        assert all(a.max_voted_slot >= 0 for a in sim.acceptors)
+
+    def test_flexible_grid(self):
+        sim = make_multipaxos(f=1, flexible=True, grid_shape=(2, 3))
+        for i in range(5):
+            assert run_write(sim, 0, 0, b"cmd%d" % i) == [b"%d" % i]
+
+    def test_batchers(self):
+        sim = make_multipaxos(f=1, num_batchers=2, batch_size=2,
+                              num_clients=4)
+        results = []
+        for client in sim.clients:
+            client.write(0, b"w", results.append)
+        sim.transport.deliver_all()
+        # Partial batches can strand below batch_size until client resends
+        # top them up (batchers only flush on size, Batcher.scala:148-163).
+        for _ in range(5):
+            if len(results) == 4:
+                break
+            for timer in sim.transport.running_timers():
+                if timer.name.startswith("resendWrite"):
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all()
+        assert len(results) == 4
+        assert len(sim.replicas[0].state_machine.get()) == 4
+
+    def test_proxy_replicas(self):
+        sim = make_multipaxos(f=1, num_proxy_replicas=2)
+        assert run_write(sim, 0, 0, b"via-proxy") == [b"0"]
+
+    def test_tpu_quorum_backend_matches(self):
+        sim = make_multipaxos(f=1, quorum_backend="tpu")
+        for i in range(5):
+            assert run_write(sim, 0, 0, b"cmd%d" % i) == [b"%d" % i]
+        logs = [executed_prefix(r) for r in sim.replicas]
+        assert logs[0] == logs[1] and len(logs[0]) == 5
+
+    def test_tpu_backend_flexible_grid(self):
+        sim = make_multipaxos(f=1, flexible=True, grid_shape=(2, 3),
+                              quorum_backend="tpu")
+        for i in range(4):
+            assert run_write(sim, 0, 0, b"cmd%d" % i) == [b"%d" % i]
+
+    def test_kv_store_write_and_read(self):
+        sim = make_multipaxos(f=1, state_machine_factory=KeyValueStore)
+        client = sim.clients[0]
+        got = []
+        client.write(0, SER.to_bytes(SetRequest((("k", "v"),))),
+                     got.append)
+        sim.transport.deliver_all()
+        assert len(got) == 1
+
+        reads = []
+        client.read(1, SER.to_bytes(GetRequest(("k",))),
+                    lambda r: reads.append(SER.from_bytes(r)))
+        sim.transport.deliver_all()
+        assert len(reads) == 1
+        assert reads[0].key_values == (("k", "v"),)
+
+    def test_sequential_and_eventual_reads(self):
+        sim = make_multipaxos(f=1, state_machine_factory=KeyValueStore)
+        client = sim.clients[0]
+        client.write(0, SER.to_bytes(SetRequest((("k", "v"),))))
+        sim.transport.deliver_all()
+        seq, ev = [], []
+        client.sequential_read(1, SER.to_bytes(GetRequest(("k",))),
+                               lambda r: seq.append(SER.from_bytes(r)))
+        client.eventual_read(2, SER.to_bytes(GetRequest(("k",))),
+                             lambda r: ev.append(SER.from_bytes(r)))
+        sim.transport.deliver_all()
+        assert seq and seq[0].key_values == (("k", "v"),)
+        assert ev and ev[0].key_values == (("k", "v"),)
+
+    def test_write_resend_is_deduplicated(self):
+        sim = make_multipaxos(f=1)
+        got = []
+        sim.clients[0].write(0, b"once", got.append)
+        # Fire the client's resend timer before any delivery.
+        for timer in sim.transport.running_timers():
+            if timer.name.startswith("resendWrite"):
+                sim.transport.trigger_timer(timer.id)
+        sim.transport.deliver_all()
+        assert got == [b"0"]
+        # Executed exactly once despite duplicate ClientRequests.
+        assert sim.replicas[0].state_machine.get() == [b"once"]
+
+    def test_pending_pseudonym_rejected(self):
+        sim = make_multipaxos(f=1)
+        sim.clients[0].write(0, b"a")
+        with pytest.raises(RuntimeError):
+            sim.clients[0].write(0, b"b")
+
+
+# --- property-based simulation ---------------------------------------------
+
+
+class WriteCmd:
+    def __init__(self, client, pseudonym, payload):
+        self.client = client
+        self.pseudonym = pseudonym
+        self.payload = payload
+
+    def __repr__(self):
+        return f"Write({self.client}, {self.pseudonym}, {self.payload!r})"
+
+
+class TransportCmd:
+    def __init__(self, command):
+        self.command = command
+
+    def __repr__(self):
+        return f"Transport({self.command!r})"
+
+
+def prefixes_compatible(a: list, b: list) -> bool:
+    n = min(len(a), len(b))
+    return a[:n] == b[:n]
+
+
+class MultiPaxosSimulated(SimulatedSystem):
+    """Random writes interleaved with arbitrary deliveries/timer firings
+    (the reference interleaves the same way,
+    multipaxos/MultiPaxos.scala:229-268)."""
+
+    def __init__(self, **harness_kwargs):
+        self.harness_kwargs = harness_kwargs
+
+    def new_system(self, seed):
+        sim = make_multipaxos(seed=seed, num_clients=2,
+                              **self.harness_kwargs)
+        sim._counter = 0
+        return sim
+
+    def generate_command(self, sim, rng: random.Random):
+        choices = []
+        # Writes are only possible for idle pseudonyms.
+        idle = [(c, p) for c, client in enumerate(sim.clients)
+                for p in (0, 1) if p not in client.states]
+        if idle:
+            choices.append("write")
+        transport_cmd = sim.transport.generate_command(rng)
+        if transport_cmd is not None:
+            # Weight transport activity higher: most steps move messages.
+            choices.extend(["transport"] * 6)
+        if not choices:
+            return None
+        kind = rng.choice(choices)
+        if kind == "write":
+            client, pseudonym = rng.choice(idle)
+            sim._counter += 1
+            return WriteCmd(client, pseudonym,
+                            b"w%d" % sim._counter)
+        return TransportCmd(transport_cmd)
+
+    def run_command(self, sim, command):
+        if isinstance(command, WriteCmd):
+            client = sim.clients[command.client]
+            if command.pseudonym not in client.states:
+                client.write(command.pseudonym, command.payload)
+        else:
+            sim.transport.run_command(command.command)
+        return sim
+
+    def get_state(self, sim):
+        return tuple(tuple(executed_prefix(r)) for r in sim.replicas)
+
+    def state_invariant(self, sim) -> Optional[str]:
+        logs = [executed_prefix(r) for r in sim.replicas]
+        for i in range(len(logs)):
+            for j in range(i + 1, len(logs)):
+                if not prefixes_compatible(logs[i], logs[j]):
+                    return (f"replica logs diverge: {logs[i]!r} vs "
+                            f"{logs[j]!r}")
+        return None
+
+    def step_invariant(self, old_state, new_state) -> Optional[str]:
+        for old_log, new_log in zip(old_state, new_state):
+            if list(new_log[:len(old_log)]) != list(old_log):
+                return f"replica log shrank/rewrote: {old_log} -> {new_log}"
+        return None
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(f=1),
+    dict(f=1, num_acceptor_groups=2),
+    dict(f=1, flexible=True, grid_shape=(2, 2)),
+    dict(f=1, num_batchers=2, batch_size=2),
+    dict(f=2),
+], ids=["f1", "groups2", "grid", "batched", "f2"])
+def test_simulation_no_divergence(kwargs):
+    simulated = MultiPaxosSimulated(**kwargs)
+    failure = Simulator(simulated, run_length=150, num_runs=20).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_simulation_with_tpu_backend():
+    simulated = MultiPaxosSimulated(f=1, quorum_backend="tpu")
+    failure = Simulator(simulated, run_length=60, num_runs=3).run(seed=0)
+    assert failure is None, str(failure)

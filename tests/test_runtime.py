@@ -1,0 +1,222 @@
+"""Runtime kernel: SimTransport semantics, timers, loggers, metrics,
+echo/unreplicated protocols over sim and TCP transports."""
+
+import random
+
+import pytest
+
+from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer
+from frankenpaxos_tpu.protocols.unreplicated import (
+    UnreplicatedClient,
+    UnreplicatedServer,
+)
+from frankenpaxos_tpu.runtime import (
+    FakeCollectors,
+    FakeLogger,
+    LogLevel,
+    SimTransport,
+)
+from frankenpaxos_tpu.runtime.logger import FatalError
+from frankenpaxos_tpu.statemachine import AppendLog, KeyValueStore
+
+
+def make_echo():
+    logger = FakeLogger()
+    transport = SimTransport(logger)
+    server = EchoServer("server", transport, logger)
+    client = EchoClient("client", transport, logger, "server")
+    return transport, server, client
+
+
+class TestSimTransport:
+    def test_messages_buffer_until_delivered(self):
+        transport, server, client = make_echo()
+        client.echo("hi")
+        assert server.num_messages_received == 0
+        assert len(transport.messages) == 1
+        transport.deliver_message(transport.messages[0])
+        assert server.num_messages_received == 1
+        # The reply is now buffered.
+        assert len(transport.messages) == 1
+        transport.deliver_message(transport.messages[0])
+        assert client.num_messages_received == 1
+
+    def test_echo_round_trip_with_callback(self):
+        transport, _, client = make_echo()
+        got = []
+        client.echo("hello", got.append)
+        transport.deliver_all()
+        assert got == ["hello"]
+
+    def test_messages_can_be_reordered(self):
+        transport, server, client = make_echo()
+        client.echo("a")
+        client.echo("b")
+        m_a, m_b = transport.messages
+        transport.deliver_message(m_b)
+        transport.deliver_message(m_a)
+        assert server.num_messages_received == 2
+
+    def test_messages_can_be_dropped(self):
+        transport, server, client = make_echo()
+        client.echo("lost")
+        transport.messages.clear()
+        assert server.num_messages_received == 0
+
+    def test_delivering_removed_message_is_noop(self):
+        transport, server, client = make_echo()
+        client.echo("x")
+        msg = transport.messages[0]
+        transport.deliver_message(msg)
+        transport.deliver_message(msg)  # already delivered: warn + drop
+        assert server.num_messages_received == 1
+
+    def test_timers_fire_only_when_triggered(self):
+        transport, server, client = make_echo()
+        client.ping_timer.start()
+        assert transport.running_timers() == [client.ping_timer]
+        transport.trigger_timer(client.ping_timer.id)
+        # Ping sent; timer restarted itself.
+        assert len(transport.messages) == 1
+        assert client.ping_timer.running
+
+    def test_stopped_timer_does_not_fire(self):
+        transport, server, client = make_echo()
+        client.ping_timer.start()
+        client.ping_timer.stop()
+        transport.trigger_timer(client.ping_timer.id)
+        assert transport.messages == []
+
+    def test_partition_drops_messages(self):
+        transport, server, client = make_echo()
+        transport.partition("server")
+        client.echo("into the void")
+        transport.deliver_all()
+        assert server.num_messages_received == 0
+        transport.heal("server")
+        client.echo("hello again")
+        transport.deliver_all()
+        assert server.num_messages_received == 1
+
+    def test_generate_command_exhaustive(self):
+        transport, server, client = make_echo()
+        rng = random.Random(0)
+        assert transport.generate_command(rng) is None
+        client.echo("a")
+        client.ping_timer.start()
+        kinds = set()
+        for _ in range(50):
+            cmd = transport.generate_command(rng)
+            kinds.add(type(cmd).__name__)
+        assert kinds == {"DeliverMessage", "TriggerTimer"}
+
+    def test_duplicate_registration_rejected(self):
+        transport, server, client = make_echo()
+        with pytest.raises(ValueError):
+            EchoServer("server", transport, FakeLogger())
+
+
+class TestLogger:
+    def test_levels_filter(self):
+        logger = FakeLogger(LogLevel.WARN)
+        logger.debug("nope")
+        logger.warn("yes")
+        assert logger.records == [(LogLevel.WARN, "yes")]
+
+    def test_lazy_messages_not_forced_when_filtered(self):
+        logger = FakeLogger(LogLevel.ERROR)
+        logger.debug(lambda: 1 / 0)  # must not evaluate
+
+    def test_fatal_raises(self):
+        logger = FakeLogger()
+        with pytest.raises(FatalError):
+            logger.fatal("boom")
+
+    def test_checks(self):
+        logger = FakeLogger()
+        logger.check_eq(1, 1)
+        logger.check_lt(1, 2)
+        with pytest.raises(FatalError):
+            logger.check_eq(1, 2)
+        with pytest.raises(FatalError):
+            logger.check(False)
+
+
+class TestMetrics:
+    def test_fake_counter_and_summary(self):
+        collectors = FakeCollectors()
+        c = collectors.counter("requests_total")
+        c.inc()
+        c.inc(2)
+        assert c.get() == 3
+        s = collectors.summary("latency")
+        s.observe(0.5)
+        s.observe(1.5)
+        assert s.get_count() == 2
+        assert s.get_sum() == 2.0
+        g = collectors.gauge("depth")
+        g.set(7)
+        g.dec()
+        assert g.get() == 6
+
+    def test_same_name_same_metric(self):
+        collectors = FakeCollectors()
+        assert collectors.counter("x") is collectors.counter("x")
+
+
+class TestUnreplicated:
+    def test_propose_execute_reply(self):
+        logger = FakeLogger()
+        transport = SimTransport(logger)
+        UnreplicatedServer("server", transport, logger, AppendLog())
+        client = UnreplicatedClient("client", transport, logger, "server")
+        got = []
+        client.propose(0, b"a", got.append)
+        transport.deliver_all()
+        assert got == [b"0"]
+        client.propose(0, b"b", got.append)
+        transport.deliver_all()
+        assert got == [b"0", b"1"]
+
+    def test_resend_is_deduplicated(self):
+        logger = FakeLogger()
+        transport = SimTransport(logger)
+        server = UnreplicatedServer("server", transport, logger, AppendLog())
+        client = UnreplicatedClient("client", transport, logger, "server")
+        got = []
+        client.propose(0, b"a", got.append)
+        # Fire the resend timer twice before delivering anything: three
+        # copies of the same request are in flight.
+        (timer,) = transport.running_timers()
+        transport.trigger_timer(timer.id)
+        (timer,) = transport.running_timers()
+        transport.trigger_timer(timer.id)
+        assert len(transport.messages) == 3
+        transport.deliver_all()
+        # Executed exactly once despite duplicates.
+        assert server.state_machine.get() == [b"a"]
+        assert got == [b"0"]
+
+    def test_pseudonyms_are_independent(self):
+        logger = FakeLogger()
+        transport = SimTransport(logger)
+        UnreplicatedServer("server", transport, logger, KeyValueStore())
+        client = UnreplicatedClient("client", transport, logger, "server")
+        from frankenpaxos_tpu.statemachine import GetRequest, SetRequest
+        from frankenpaxos_tpu.runtime import PickleSerializer
+
+        ser = PickleSerializer()
+        got = []
+        client.propose(0, ser.to_bytes(SetRequest((("k", "v"),))), got.append)
+        client.propose(1, ser.to_bytes(GetRequest(("k",))), got.append)
+        transport.deliver_all()
+        assert len(got) == 2
+
+    def test_double_propose_same_pseudonym_rejected(self):
+        logger = FakeLogger()
+        transport = SimTransport(logger)
+        UnreplicatedServer("server", transport, logger, AppendLog())
+        client = UnreplicatedClient("client", transport, logger, "server")
+        client.propose(0, b"a")
+        with pytest.raises(RuntimeError):
+            client.propose(0, b"b")

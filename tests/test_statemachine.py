@@ -1,0 +1,181 @@
+"""State machines + conflict indexes (mirrors statemachine/ tests:
+StateMachineTest, ConflictIndexTest, TopKConflictIndexTest) and
+ClientTable (clienttable/ClientTableTest)."""
+
+import pytest
+
+from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable, Executed
+from frankenpaxos_tpu.statemachine import (
+    AppendLog,
+    GetReply,
+    GetRequest,
+    KeyValueStore,
+    Noop,
+    ReadableAppendLog,
+    Register,
+    SetReply,
+    SetRequest,
+    state_machine_by_name,
+)
+from frankenpaxos_tpu.utils.topk import VertexIdLike
+
+VLIKE = VertexIdLike(leader_index=lambda v: v[0], id=lambda v: v[1])
+
+
+class TestAppendLog:
+    def test_run_returns_index(self):
+        sm = AppendLog()
+        assert sm.run(b"a") == b"0"
+        assert sm.run(b"b") == b"1"
+        assert sm.get() == [b"a", b"b"]
+
+    def test_everything_conflicts(self):
+        sm = AppendLog()
+        assert sm.conflicts(b"a", b"b")
+
+    def test_snapshot_roundtrip(self):
+        sm = AppendLog()
+        sm.run(b"a")
+        snapshot = sm.to_bytes()
+        sm.run(b"b")
+        sm.from_bytes(snapshot)
+        assert sm.get() == [b"a"]
+
+    def test_conflict_index(self):
+        idx = AppendLog().conflict_index()
+        idx.put(1, b"a")
+        idx.put(2, b"b")
+        idx.remove(1)
+        assert idx.get_conflicts(b"c") == {2}
+
+    def test_top_one_conflict_index(self):
+        idx = AppendLog().top_k_conflict_index(1, 2, VLIKE)
+        idx.put((0, 4), b"a")
+        idx.put((1, 2), b"b")
+        idx.put((0, 1), b"c")
+        assert idx.get_top_one_conflicts(b"z").get() == [5, 3]
+
+
+class TestKeyValueStore:
+    def test_get_set(self):
+        sm = KeyValueStore()
+        assert sm.typed_run(SetRequest((("x", "1"),))) == SetReply()
+        assert sm.typed_run(GetRequest(("x", "y"))) == GetReply(
+            (("x", "1"), ("y", None)))
+
+    def test_conflicts(self):
+        sm = KeyValueStore()
+        get_x = GetRequest(("x",))
+        get_y = GetRequest(("y",))
+        set_x = SetRequest((("x", "1"),))
+        set_y = SetRequest((("y", "1"),))
+        assert not sm.typed_conflicts(get_x, get_y)
+        assert not sm.typed_conflicts(get_x, get_x)  # gets never conflict
+        assert sm.typed_conflicts(get_x, set_x)
+        assert sm.typed_conflicts(set_x, set_x)
+        assert not sm.typed_conflicts(set_x, set_y)
+        assert not sm.typed_conflicts(get_x, set_y)
+
+    def test_bytes_interface_and_snapshot(self):
+        sm = KeyValueStore()
+        ser = sm.input_serializer
+        sm.run(ser.to_bytes(SetRequest((("k", "v"),))))
+        snapshot = sm.to_bytes()
+        sm.run(ser.to_bytes(SetRequest((("k", "w"),))))
+        sm.from_bytes(snapshot)
+        assert sm.get() == {"k": "v"}
+
+    def test_typed_conflict_index_inverted(self):
+        idx = KeyValueStore().typed_conflict_index()
+        idx.put(1, SetRequest((("x", "1"),)))
+        idx.put(2, GetRequest(("x",)))
+        idx.put(3, SetRequest((("y", "2"),)))
+        assert idx.get_conflicts(GetRequest(("x",))) == {1}
+        assert idx.get_conflicts(SetRequest((("x", "0"),))) == {1, 2}
+        assert idx.get_conflicts(GetRequest(("z",))) == set()
+        idx.put_snapshot(9)
+        assert idx.get_conflicts(GetRequest(("z",))) == {9}
+        idx.remove(1)
+        assert idx.get_conflicts(GetRequest(("x",))) == {9}
+
+    def test_put_overwrites(self):
+        idx = KeyValueStore().typed_conflict_index()
+        idx.put(1, SetRequest((("x", "1"),)))
+        idx.put(1, SetRequest((("y", "1"),)))
+        assert idx.get_conflicts(GetRequest(("x",))) == set()
+        assert idx.get_conflicts(GetRequest(("y",))) == {1}
+
+
+class TestOthers:
+    def test_noop(self):
+        sm = Noop()
+        assert sm.run(b"anything") == b""
+        assert not sm.conflicts(b"a", b"b")
+
+    def test_register(self):
+        sm = Register()
+        assert sm.run(b"a") == b"a"
+        assert sm.get() == b"a"
+        assert sm.conflicts(b"a", b"b")
+        snapshot = sm.to_bytes()
+        sm.run(b"b")
+        sm.from_bytes(snapshot)
+        assert sm.get() == b"a"
+
+    def test_readable_append_log(self):
+        sm = ReadableAppendLog()
+        sm.run(b"a")
+        out = sm.run(b"r:")
+        import pickle
+        assert pickle.loads(out) == [b"a"]
+        assert sm.get() == [b"a"]  # read didn't append
+        assert not sm.conflicts(b"r:", b"r:")
+        assert sm.conflicts(b"r:", b"a")
+
+    def test_by_name(self):
+        assert isinstance(state_machine_by_name("AppendLog"), AppendLog)
+        assert isinstance(state_machine_by_name("KeyValueStore"),
+                          KeyValueStore)
+        with pytest.raises(ValueError):
+            state_machine_by_name("Nope")
+
+
+class TestClientTable:
+    def test_in_order_execution(self):
+        table = ClientTable()
+        assert table.executed("c", 0) is NOT_EXECUTED
+        table.execute("c", 0, b"r0")
+        assert table.executed("c", 0) == Executed(b"r0")
+        table.execute("c", 1, b"r1")
+        assert table.executed("c", 1) == Executed(b"r1")
+        # Older id: executed, but output no longer cached.
+        assert table.executed("c", 0) == Executed(None)
+
+    def test_out_of_order_execution(self):
+        # The EPaxos scenario from ClientTable.scala:43-58.
+        table = ClientTable()
+        table.execute("c", 1, b"r1")
+        assert table.executed("c", 0) is NOT_EXECUTED  # still executable!
+        table.execute("c", 0, b"r0")
+        assert table.executed("c", 0) == Executed(None)
+        assert table.executed("c", 1) == Executed(b"r1")
+
+    def test_double_execute_rejected(self):
+        table = ClientTable()
+        table.execute("c", 0, b"r0")
+        with pytest.raises(ValueError):
+            table.execute("c", 0, b"again")
+
+    def test_clients_independent(self):
+        table = ClientTable()
+        table.execute("a", 0, b"x")
+        assert table.executed("b", 0) is NOT_EXECUTED
+
+    def test_wire_roundtrip(self):
+        table = ClientTable()
+        table.execute("c", 0, b"r0")
+        table.execute("c", 2, b"r2")
+        back = ClientTable.from_dict(table.to_dict())
+        assert back.executed("c", 0) == Executed(None)
+        assert back.executed("c", 2) == Executed(b"r2")
+        assert back.executed("c", 1) is NOT_EXECUTED

@@ -1,0 +1,145 @@
+"""TcpTransport: framing, lazy connect, flush coalescing, timers —
+echo and unreplicated over real localhost sockets."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer
+from frankenpaxos_tpu.protocols.unreplicated import (
+    UnreplicatedClient,
+    UnreplicatedServer,
+)
+from frankenpaxos_tpu.runtime import FakeLogger
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport, _encode_frame
+from frankenpaxos_tpu.statemachine import AppendLog
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def transports():
+    created = []
+
+    def make(address=None):
+        t = TcpTransport(address, FakeLogger())
+        t.start()
+        created.append(t)
+        return t
+
+    yield make
+    for t in created:
+        t.stop()
+
+
+def test_frame_encoding_roundtrip():
+    frame = _encode_frame(("127.0.0.1", 9000), b"payload")
+    import struct
+    (length,) = struct.unpack(">I", frame[:4])
+    assert length == len(frame) - 4
+    (hlen,) = struct.unpack(">I", frame[4:8])
+    assert frame[8:8 + hlen] == b"127.0.0.1:9000"
+    assert frame[8 + hlen:] == b"payload"
+
+
+def test_oversized_frame_rejected():
+    with pytest.raises(ValueError):
+        _encode_frame(("h", 1), b"x" * (10 * 1024 * 1024 + 1))
+
+
+def test_echo_over_tcp(transports):
+    server_addr = ("127.0.0.1", free_port())
+    client_addr = ("127.0.0.1", free_port())
+    server_t = transports(server_addr)
+    client_t = transports(client_addr)
+    logger = FakeLogger()
+    server = EchoServer(server_addr, server_t, logger)
+    client = EchoClient(client_addr, client_t, logger, server_addr)
+
+    got = []
+    client.echo("over tcp", got.append)
+    assert wait_for(lambda: got == ["over tcp"])
+    assert server.num_messages_received == 1
+
+
+def test_unreplicated_over_tcp_with_batching(transports):
+    server_addr = ("127.0.0.1", free_port())
+    client_addr = ("127.0.0.1", free_port())
+    server_t = transports(server_addr)
+    client_t = transports(client_addr)
+    logger = FakeLogger()
+    server = UnreplicatedServer(server_addr, server_t, logger, AppendLog(),
+                                flush_every_n=4)
+    client = UnreplicatedClient(client_addr, client_t, logger, server_addr,
+                                resend_period_s=30.0)
+
+    # Four pipelined command streams (pseudonyms), two rounds each: every
+    # round of four replies fills the server's flush batch exactly.
+    results = []
+    done = threading.Event()
+
+    def on_reply(pseudonym, round, result):
+        results.append((pseudonym, round, result))
+        if len(results) == 8:
+            done.set()
+        elif round == 0:
+            client.propose(pseudonym, b"cmd-%d-1" % pseudonym,
+                           lambda r, p=pseudonym: on_reply(p, 1, r))
+
+    for p in range(4):
+        client.propose(p, b"cmd-%d-0" % p,
+                       lambda r, p=p: on_reply(p, 0, r))
+    assert done.wait(timeout=10)
+    assert len(server.state_machine.get()) == 8
+    assert {(p, r) for p, r, _ in results} == {(p, r) for p in range(4)
+                                              for r in range(2)}
+
+
+def test_timer_fires_and_resets(transports):
+    t = transports(("127.0.0.1", free_port()))
+    fired = []
+    timer = t.timer(("x", 0), "t", 0.05, lambda: fired.append(1))
+    timer.start()
+    assert wait_for(lambda: fired == [1])
+    # One-shot: doesn't refire on its own.
+    time.sleep(0.1)
+    assert fired == [1]
+    timer.start()
+    assert wait_for(lambda: fired == [1, 1])
+
+
+def test_timer_stop_prevents_fire(transports):
+    t = transports(("127.0.0.1", free_port()))
+    fired = []
+    timer = t.timer(("x", 0), "t", 0.2, lambda: fired.append(1))
+    timer.start()
+    timer.stop()
+    time.sleep(0.35)
+    assert fired == []
+
+
+def test_connect_failure_drops_and_logs(transports):
+    logger = FakeLogger()
+    t = TcpTransport(("127.0.0.1", free_port()), logger)
+    t.start()
+    try:
+        dead = ("127.0.0.1", free_port())  # nobody listening
+        t.send(t.listen_address, dead, b"hello?")
+        assert wait_for(lambda: any("connect" in m for _, m in logger.records))
+    finally:
+        t.stop()
