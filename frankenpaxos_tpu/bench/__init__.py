@@ -1,0 +1,1 @@
+"""Benchmarking: the device-resident MultiPaxos pipeline and harness."""

@@ -1,0 +1,144 @@
+"""The fully device-resident MultiPaxos steady-state pipeline.
+
+This is the north-star benchmark configuration (BASELINE.json): the
+steady-state Phase2 write path of compartmentalized MultiPaxos --
+propose -> acceptor votes -> quorum check -> chosen -> replica execute ->
+GC -- expressed as one jitted step over a ``[acceptors, window]`` vote
+board with a 1M-slot in-flight window, iterated under ``lax.fori_loop``
+with donated state. No host round-trips on the hot path (mandatory: the
+device link has ~10ms+ fetch latency; see .claude/skills/verify/SKILL.md).
+
+Mapping to the reference's roles (SURVEY.md section 3.1):
+
+  * Leader.processClientRequestBatch (Leader.scala:331-408): slot
+    assignment is the contiguous block frontier; proposed command ids are
+    written into the window.
+  * Acceptor.handlePhase2a (Acceptor.scala:184-220): vote arrivals land
+    as a dense ``[n, B]`` bitmask OR'd into the board. Arrival patterns
+    are hash-derived per (iteration, acceptor, slot): ~87% of votes
+    arrive in the drain after proposal, the rest one drain later --
+    modeling cross-drain vote straggling.
+  * ProxyLeader.handlePhase2b (ProxyLeader.scala:217-258): the quorum
+    predicate matmul over the touched blocks; newly-chosen = hit & ~chosen.
+  * Replica.executeLog (Replica.scala:394-453): chosen commands apply to
+    a device state register; the executed watermark trails the fully
+    chosen block; replies are counted.
+  * BufferMap GC (BufferMap.scala:55-62): executed blocks are zeroed so
+    the ring can wrap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PipelineState(NamedTuple):
+    votes: jax.Array      # [n, window] uint8
+    chosen: jax.Array     # [window] bool
+    commands: jax.Array   # [window] int32 proposed command ids
+    results: jax.Array    # [window] int32 state-machine outputs
+    sm_state: jax.Array   # [] int32: the replica's running register
+    committed: jax.Array  # [] int32 committed commands
+    exec_wm: jax.Array    # [] int32 executed watermark (global slots)
+
+
+def make_state(window: int, num_acceptors: int) -> PipelineState:
+    return PipelineState(
+        votes=jnp.zeros((num_acceptors, window), jnp.uint8),
+        chosen=jnp.zeros((window,), jnp.bool_),
+        commands=jnp.zeros((window,), jnp.int32),
+        results=jnp.zeros((window,), jnp.int32),
+        sm_state=jnp.int32(0),
+        committed=jnp.int32(0),
+        exec_wm=jnp.int32(0),
+    )
+
+
+def _arrivals(i: jax.Array, start: jax.Array, n: int, block: int,
+              salt: int) -> jax.Array:
+    """Deterministic pseudo-random [n, block] uint8 vote-arrival mask."""
+    lane = start + jnp.arange(block, dtype=jnp.int32)
+    acc = jnp.arange(n, dtype=jnp.int32)[:, None]
+    h = (lane[None, :] * 1103515245 + acc * 12820163
+         + (i + salt) * 22695477) >> 7
+    return ((h & 7) < 7).astype(jnp.uint8)  # ~87.5% arrive this drain
+
+
+def steady_state_step(state: PipelineState, i: jax.Array, *,
+                      block_size: int, masks: np.ndarray,
+                      threshold: int) -> PipelineState:
+    """One event-loop drain: new proposals + straggler completion.
+
+    Each block gets exactly two passes (drain t: most votes; drain t+1:
+    the stragglers), so the window holds ~2 blocks of in-flight
+    vote-collection at the frontier plus the 1M-slot chosen/executing
+    tail behind it.
+    """
+    n, window = state.votes.shape
+    b = block_size
+    masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [1, N]
+    num_blocks = window // b
+    start_new = (i % num_blocks) * b
+    start_old = ((i - 1) % num_blocks) * b
+
+    # --- Leader: assign slots, propose command ids --------------------------
+    proposed = (start_new + jnp.arange(b, dtype=jnp.int32)) * 7 + i
+    commands = jax.lax.dynamic_update_slice(state.commands, proposed,
+                                            (start_new,))
+
+    def quorum_pass(votes, chosen, committed, start, arrivals):
+        block = jax.lax.dynamic_slice(votes, (0, start), (n, b)) | arrivals
+        votes = jax.lax.dynamic_update_slice(votes, block, (0, start))
+        counts = (masks_d @ block.astype(jnp.int32))[0]     # [B]
+        hit = counts >= threshold
+        old = jax.lax.dynamic_slice(chosen, (start,), (b,))
+        newly = hit & ~old
+        chosen = jax.lax.dynamic_update_slice(chosen, hit | old, (start,))
+        return votes, chosen, committed + newly.sum(dtype=jnp.int32), newly
+
+    # --- Acceptors + ProxyLeader: pass 1 on the new block -------------------
+    arr1 = _arrivals(i, start_new, n, b, salt=0)
+    votes, chosen, committed, newly1 = quorum_pass(
+        state.votes, state.chosen, state.committed, start_new, arr1)
+    # --- pass 2: stragglers complete the previous block ---------------------
+    arr2 = 1 - _arrivals(i - 1, start_old, n, b, salt=0)
+    votes, chosen, committed, newly2 = quorum_pass(
+        votes, chosen, committed, start_old, arr2)
+
+    # --- Replica: execute the now fully-chosen previous block ---------------
+    cmds_old = jax.lax.dynamic_slice(commands, (start_old,), (b,))
+    block_results = cmds_old * 3 + 7
+    results = jax.lax.dynamic_update_slice(state.results, block_results,
+                                           (start_old,))
+    sm_state = state.sm_state + cmds_old.sum(dtype=jnp.int32)
+    exec_wm = jnp.where(i >= 1, (i.astype(jnp.int32)) * b, 0)
+
+    # --- GC: release the block executed long ago so the ring can wrap -------
+    # (Early iterations "GC" still-zero wrap-around blocks: harmless.)
+    start_gc = ((i - 2) % num_blocks) * b
+    votes = jax.lax.dynamic_update_slice(
+        votes, jnp.zeros((n, b), jnp.uint8), (0, start_gc))
+    chosen = jax.lax.dynamic_update_slice(
+        chosen, jnp.zeros((b,), jnp.bool_), (start_gc,))
+
+    return PipelineState(votes, chosen, commands, results, sm_state,
+                         committed, exec_wm)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4),
+                   donate_argnums=(0,))
+def run_steps(state: PipelineState, iters: int, block_size: int,
+              masks_t: tuple, threshold: int) -> PipelineState:
+    """``iters`` drains in one dispatch (the bench hot loop)."""
+    masks = np.asarray(masks_t, dtype=np.int32)
+
+    def body(i, s):
+        return steady_state_step(s, i, block_size=block_size, masks=masks,
+                                 threshold=threshold)
+
+    return jax.lax.fori_loop(0, iters, body, state)

@@ -3,12 +3,20 @@
 Multi-chip TPU hardware isn't available in CI; sharding correctness is
 validated on forced host devices (the driver separately dry-runs
 ``__graft_entry__.dryrun_multichip``).
+
+Note: the axon TPU plugin's sitecustomize calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at import, overriding
+the environment variable -- so we must override the config back, not
+just the env var.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
