@@ -1,0 +1,18 @@
+"""Dependency graphs for generalized consensus (EPaxos/BPaxos executors).
+
+Reference behavior: depgraph/ (DependencyGraph.scala:127-193 abstract API;
+TarjanDependencyGraph.scala:149+ the fast one; Jgrapht/ScalaGraph
+library-backed variants used as oracles in tests). Commit command
+vertices with dependency sets; emit strongly-connected components in
+reverse topological order for execution.
+"""
+
+from frankenpaxos_tpu.depgraph.base import DependencyGraph
+from frankenpaxos_tpu.depgraph.naive import NaiveDependencyGraph
+from frankenpaxos_tpu.depgraph.tarjan import TarjanDependencyGraph
+
+__all__ = [
+    "DependencyGraph",
+    "NaiveDependencyGraph",
+    "TarjanDependencyGraph",
+]

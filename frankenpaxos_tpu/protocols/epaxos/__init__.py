@@ -1,0 +1,27 @@
+"""EPaxos: leaderless generalized consensus.
+
+Reference behavior: epaxos/ (~2,400 LoC Scala; SURVEY.md section 2.2).
+One Replica role holding every sub-role; dependency sets as
+InstancePrefixSets (per-replica watermark columns -- the device twin is
+ops/depset.py); execution via Tarjan SCC ordering.
+"""
+
+from frankenpaxos_tpu.protocols.epaxos.client import EPaxosClient
+from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+    Instance,
+    InstancePrefixSet,
+)
+from frankenpaxos_tpu.protocols.epaxos.replica import (
+    EPaxosConfig,
+    EPaxosReplica,
+    EPaxosReplicaOptions,
+)
+
+__all__ = [
+    "EPaxosClient",
+    "EPaxosConfig",
+    "EPaxosReplica",
+    "EPaxosReplicaOptions",
+    "Instance",
+    "InstancePrefixSet",
+]

@@ -1,0 +1,215 @@
+"""EPaxos: integration + property-based simulation.
+
+Invariant (mirrors shared/src/test/scala/epaxos/EPaxos.scala): committed
+triples agree across replicas per instance, and conflicting executed
+commands are totally ordered consistently (checked via KV state
+agreement after quiescence)."""
+
+import random
+from typing import Optional
+
+import pytest
+
+from frankenpaxos_tpu.runtime import (
+    FakeLogger,
+    LogLevel,
+    PickleSerializer,
+    SimTransport,
+)
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import (
+    AppendLog,
+    GetRequest,
+    KeyValueStore,
+    SetRequest,
+)
+from frankenpaxos_tpu.protocols.epaxos import (
+    EPaxosClient,
+    EPaxosConfig,
+    EPaxosReplica,
+    EPaxosReplicaOptions,
+)
+from frankenpaxos_tpu.protocols.epaxos.replica import CommittedEntry
+
+SER = PickleSerializer()
+
+
+def make_epaxos(f=1, num_clients=1, state_machine_factory=KeyValueStore,
+                seed=0, top_k=1):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    config = EPaxosConfig(
+        f=f, replica_addresses=tuple(f"replica-{i}" for i in range(2 * f + 1)))
+    replicas = [
+        EPaxosReplica(a, transport, logger, config, state_machine_factory(),
+                      EPaxosReplicaOptions(top_k_dependencies=top_k),
+                      seed=seed + i)
+        for i, a in enumerate(config.replica_addresses)]
+    clients = [EPaxosClient(f"client-{i}", transport, logger, config,
+                            seed=seed + 100 + i)
+               for i in range(num_clients)]
+    return transport, config, replicas, clients
+
+
+def committed_triples(replica):
+    return {i: (e.triple.command_or_noop, e.triple.sequence_number,
+                e.triple.dependencies)
+            for i, e in replica.cmd_log.items()
+            if isinstance(e, CommittedEntry)}
+
+
+class TestEPaxosIntegration:
+    def test_single_command(self):
+        transport, _, replicas, clients = make_epaxos()
+        got = []
+        clients[0].propose(0, SER.to_bytes(SetRequest((("k", "v"),))),
+                           got.append)
+        transport.deliver_all()
+        assert len(got) == 1
+        # All replicas committed the instance identically.
+        base = committed_triples(replicas[0])
+        assert len(base) == 1
+        for replica in replicas[1:]:
+            assert committed_triples(replica).keys() == base.keys()
+
+    def test_sequential_commands_execute_everywhere(self):
+        transport, _, replicas, clients = make_epaxos()
+        results = []
+        for i in range(6):
+            clients[0].propose(
+                0, SER.to_bytes(SetRequest((("k", str(i)),))),
+                results.append)
+            transport.deliver_all()
+        assert len(results) == 6
+        for replica in replicas:
+            assert replica.state_machine.get() == {"k": "5"}
+
+    def test_conflicting_commands_from_multiple_clients(self):
+        transport, _, replicas, clients = make_epaxos(num_clients=3)
+        for i, client in enumerate(clients):
+            client.propose(0, SER.to_bytes(SetRequest((("k", str(i)),))))
+        transport.deliver_all()
+        # All replicas end in the same state despite conflicts.
+        states = [r.state_machine.get() for r in replicas]
+        assert states[0] == states[1] == states[2]
+        assert states[0]["k"] in {"0", "1", "2"}
+
+    def test_read_write(self):
+        transport, _, replicas, clients = make_epaxos()
+        clients[0].propose(0, SER.to_bytes(SetRequest((("x", "7"),))))
+        transport.deliver_all()
+        got = []
+        clients[0].propose(
+            0, SER.to_bytes(GetRequest(("x",))),
+            lambda r: got.append(SER.from_bytes(r)))
+        transport.deliver_all()
+        assert got and got[0].key_values == (("x", "7"),)
+
+    def test_resend_deduplicated(self):
+        transport, _, replicas, clients = make_epaxos(
+            state_machine_factory=AppendLog)
+        got = []
+        clients[0].propose(0, b"only-once", got.append)
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith("resend-"):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+        assert len(got) == 1
+        for replica in replicas:
+            log = replica.state_machine.get()
+            assert log.count(b"only-once") == 1
+
+    def test_f2(self):
+        transport, _, replicas, clients = make_epaxos(f=2)
+        got = []
+        clients[0].propose(0, SER.to_bytes(SetRequest((("k", "v"),))),
+                           got.append)
+        transport.deliver_all()
+        assert len(got) == 1
+
+
+# --- property-based simulation ---------------------------------------------
+
+
+class ProposeCmd:
+    def __init__(self, client, pseudonym, key, value):
+        self.client = client
+        self.pseudonym = pseudonym
+        self.key = key
+        self.value = value
+
+    def __repr__(self):
+        return (f"Propose({self.client}, {self.pseudonym}, "
+                f"{self.key}={self.value})")
+
+
+class TransportCmd:
+    def __init__(self, command):
+        self.command = command
+
+    def __repr__(self):
+        return f"Transport({self.command!r})"
+
+
+class EPaxosSimulated(SimulatedSystem):
+    """Random conflicting writes + arbitrary deliveries/timer firings.
+
+    Invariant: for every instance, all replicas that committed it agree
+    on its value and dependencies (EPaxos consistency)."""
+
+    KEYS = ["a", "b"]
+
+    def new_system(self, seed):
+        transport, config, replicas, clients = make_epaxos(
+            num_clients=2, seed=seed)
+        system = dict(transport=transport, replicas=replicas,
+                      clients=clients, counter=0)
+        return system
+
+    def generate_command(self, system, rng: random.Random):
+        choices = []
+        idle = [(c, p) for c, client in enumerate(system["clients"])
+                for p in (0, 1) if p not in client.pending]
+        if idle:
+            choices.append("propose")
+        transport_cmd = system["transport"].generate_command(rng)
+        if transport_cmd is not None:
+            choices.extend(["transport"] * 6)
+        if not choices:
+            return None
+        if rng.choice(choices) == "propose":
+            client, pseudonym = rng.choice(idle)
+            system["counter"] += 1
+            return ProposeCmd(client, pseudonym, rng.choice(self.KEYS),
+                              str(system["counter"]))
+        return TransportCmd(transport_cmd)
+
+    def run_command(self, system, command):
+        if isinstance(command, ProposeCmd):
+            client = system["clients"][command.client]
+            if command.pseudonym not in client.pending:
+                client.propose(command.pseudonym, SER.to_bytes(
+                    SetRequest(((command.key, command.value),))))
+        else:
+            system["transport"].run_command(command.command)
+        return system
+
+    def state_invariant(self, system) -> Optional[str]:
+        per_instance: dict = {}
+        for replica in system["replicas"]:
+            for instance, triple in committed_triples(replica).items():
+                value = (triple[0], triple[1],
+                         tuple(sorted(triple[2].materialize())))
+                if instance in per_instance:
+                    if per_instance[instance] != value:
+                        return (f"replicas disagree on {instance}: "
+                                f"{per_instance[instance]} vs {value}")
+                else:
+                    per_instance[instance] = value
+        return None
+
+
+def test_simulation_committed_agreement():
+    failure = Simulator(EPaxosSimulated(), run_length=120, num_runs=20
+                        ).run(seed=0)
+    assert failure is None, str(failure)
