@@ -1,0 +1,187 @@
+"""BatchedUnreplicated: Batcher -> Server -> ProxyServer pipeline.
+
+Reference behavior: batchedunreplicated/ (Batcher.scala:29-160,
+Server.scala:30-170, ProxyServer.scala:30-150, Client.scala:33-170).
+The batching throughput baseline: batchers accumulate client commands
+into batches, one server executes them, proxy servers fan the replies
+back out -- decoupling the three stages so each scales independently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedUnreplicatedConfig:
+    batcher_addresses: tuple
+    server_address: Address
+    proxy_server_addresses: tuple
+
+    def check_valid(self) -> None:
+        if not self.batcher_addresses:
+            raise ValueError("need at least one batcher")
+        if not self.proxy_server_addresses:
+            raise ValueError("need at least one proxy server")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequestBatch:
+    batch: tuple[Command, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReplyBatch:
+    batch: tuple[ClientReply, ...]
+
+
+class BatchedUnreplicatedBatcher(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: BatchedUnreplicatedConfig,
+                 batch_size: int = 10):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check_ge(batch_size, 1)
+        self.config = config
+        self.batch_size = batch_size
+        self.growing_batch: list[Command] = []
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientRequest):
+            self.logger.fatal(f"unexpected batcher message {message!r}")
+        self.growing_batch.append(message.command)
+        if len(self.growing_batch) >= self.batch_size:
+            self.send(self.config.server_address,
+                      ClientRequestBatch(tuple(self.growing_batch)))
+            self.growing_batch.clear()
+
+
+class BatchedUnreplicatedServer(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: BatchedUnreplicatedConfig,
+                 state_machine: StateMachine, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientRequestBatch):
+            self.logger.fatal(f"unexpected server message {message!r}")
+        replies = tuple(
+            ClientReply(command.command_id,
+                        self.state_machine.run(command.command))
+            for command in message.batch)
+        proxy = self.config.proxy_server_addresses[
+            self.rng.randrange(len(self.config.proxy_server_addresses))]
+        self.send(proxy, ClientReplyBatch(replies))
+
+
+class BatchedUnreplicatedProxyServer(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: BatchedUnreplicatedConfig,
+                 flush_every_n: int = 1):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.flush_every_n = flush_every_n
+        self._unflushed = 0
+        self._unflushed_clients: set[Address] = set()
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReplyBatch):
+            self.logger.fatal(f"unexpected proxy server message {message!r}")
+        for reply in message.batch:
+            dst = reply.command_id.client_address
+            if self.flush_every_n <= 1:
+                self.send(dst, reply)
+            else:
+                self.send_no_flush(dst, reply)
+                self._unflushed_clients.add(dst)
+                self._unflushed += 1
+                if self._unflushed >= self.flush_every_n:
+                    for client in self._unflushed_clients:
+                        self.flush(client)
+                    self._unflushed_clients.clear()
+                    self._unflushed = 0
+
+
+@dataclasses.dataclass
+class _Pending:
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend_timer: object
+
+
+class BatchedUnreplicatedClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: BatchedUnreplicatedConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.next_id = 0
+        self.pending: dict[int, _Pending] = {}
+
+    def propose(self, command: bytes,
+                callback: Optional[Callable[[bytes], None]] = None) -> None:
+        id = self.next_id
+        self.next_id += 1
+        request = ClientRequest(Command(CommandId(self.address, id), command))
+
+        def send_it():
+            batcher = self.config.batcher_addresses[
+                self.rng.randrange(len(self.config.batcher_addresses))]
+            self.send(batcher, request)
+
+        def resend():
+            send_it()
+            timer.start()
+
+        send_it()
+        timer = self.timer(f"resend-{id}", self.resend_period_s, resend)
+        timer.start()
+        self.pending[id] = _Pending(command, callback or (lambda _: None),
+                                    timer)
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.pop(message.command_id.client_id, None)
+        if pending is None:
+            self.logger.debug(f"stale reply {message}")
+            return
+        pending.resend_timer.stop()
+        pending.callback(message.result)

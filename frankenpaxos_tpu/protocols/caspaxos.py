@@ -1,0 +1,303 @@
+"""CASPaxos: replicated compare-and-set state without a log.
+
+Reference behavior: caspaxos/ (Leader.scala:79-470, Acceptor.scala:76-210).
+State is a grow-only set of ints; each client request carries a set that
+is unioned into the replicated state. The leader serializes requests:
+Phase1 reads the highest-vote-round state from f+1 acceptors, applies
+the client's change, Phase2 writes the new state to f+1. Nacks move the
+leader to a randomized WaitingToRecover backoff (dueling-leader
+avoidance, Leader.scala:433-470).
+
+Note: the reference picks the phase-1 value with ``minBy(_.voteRound)``
+(Leader.scala:342) while its own comment calls for the *largest* vote
+round; we implement the comment (standard CASPaxos), not the bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.roundsystem import RoundSystem, RotatedClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class CasPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    acceptor_addresses: tuple
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    def check_valid(self) -> None:
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    client_address: Address
+    client_id: int
+    int_set: frozenset[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    client_id: int
+    value: frozenset[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    round: int
+    acceptor_index: int
+    vote_round: int
+    vote_value: Optional[frozenset[int]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    round: int
+    value: frozenset[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    round: int
+    acceptor_index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Nack:
+    higher_round: int
+
+
+class CasPaxosLeader(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: CasPaxosConfig,
+                 resend_period_s: float = 5.0,
+                 recover_min_period_s: float = 5.0,
+                 recover_max_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.leader_addresses).index(address)
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.recover_min_period_s = recover_min_period_s
+        self.recover_max_period_s = recover_max_period_s
+        self.round_system: RoundSystem = RotatedClassicRoundRobin(
+            len(config.leader_addresses), 0)
+        # state: ("idle", round) | ("phase1", ...) | ("phase2", ...)
+        #        | ("waiting", ...)
+        self.status = "idle"
+        self.round = self.round_system.next_classic_round(self.index, -1)
+        self.client_requests: list[ClientRequest] = []
+        self.phase1bs: dict[int, Phase1b] = {}
+        self.phase2bs: dict[int, Phase2b] = {}
+        self.phase2_value: Optional[frozenset] = None
+        self._resend_timer = None
+        self._recover_timer = None
+
+    # --- helpers ----------------------------------------------------------
+    def _stop_timers(self) -> None:
+        if self._resend_timer is not None:
+            self._resend_timer.stop()
+            self._resend_timer = None
+        if self._recover_timer is not None:
+            self._recover_timer.stop()
+            self._recover_timer = None
+
+    def _make_resend_timer(self, message) -> None:
+        def resend():
+            for acceptor in self.config.acceptor_addresses:
+                self.send(acceptor, message)
+            timer.start()
+
+        timer = self.timer("resend", self.resend_period_s, resend)
+        timer.start()
+        self._resend_timer = timer
+
+    def _transition_to_phase1(self, round: int) -> None:
+        self._stop_timers()
+        self.status = "phase1"
+        self.round = round
+        self.phase1bs.clear()
+        phase1a = Phase1a(round=round)
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, phase1a)
+        self._make_resend_timer(phase1a)
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientRequest):
+            self._handle_client_request(src, message)
+        elif isinstance(message, Phase1b):
+            self._handle_phase1b(src, message)
+        elif isinstance(message, Phase2b):
+            self._handle_phase2b(src, message)
+        elif isinstance(message, Nack):
+            self._handle_nack(src, message)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        self.client_requests.append(request)
+        if self.status == "idle":
+            self._transition_to_phase1(self.round)
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        if self.status != "phase1" or phase1b.round != self.round:
+            return
+        self.phase1bs[phase1b.acceptor_index] = phase1b
+        if len(self.phase1bs) < self.config.quorum_size:
+            return
+        best = max(self.phase1bs.values(), key=lambda r: r.vote_round)
+        previous = (frozenset() if best.vote_round == -1
+                    else best.vote_value)
+        new_value = frozenset(previous | self.client_requests[0].int_set)
+        self._stop_timers()
+        self.status = "phase2"
+        self.phase2_value = new_value
+        self.phase2bs.clear()
+        phase2a = Phase2a(round=self.round, value=new_value)
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, phase2a)
+        self._make_resend_timer(phase2a)
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        if self.status != "phase2" or phase2b.round != self.round:
+            return
+        self.phase2bs[phase2b.acceptor_index] = phase2b
+        if len(self.phase2bs) < self.config.quorum_size:
+            return
+        request = self.client_requests.pop(0)
+        self.send(request.client_address,
+                  ClientReply(client_id=request.client_id,
+                              value=self.phase2_value))
+        self._stop_timers()
+        self.round = self.round_system.next_classic_round(self.index,
+                                                          self.round)
+        if self.client_requests:
+            self._transition_to_phase1(self.round)
+        else:
+            self.status = "idle"
+
+    def _handle_nack(self, src: Address, nack: Nack) -> None:
+        if nack.higher_round <= self.round:
+            return
+        new_round = self.round_system.next_classic_round(self.index,
+                                                         nack.higher_round)
+        self._stop_timers()
+        self.round = new_round
+        if self.status == "idle":
+            return
+        # Back off to avoid dueling leaders (Leader.scala:433-470).
+        self.status = "waiting"
+
+        def recover():
+            self._transition_to_phase1(self.round)
+
+        timer = self.timer(
+            "recover",
+            self.rng.uniform(self.recover_min_period_s,
+                             self.recover_max_period_s),
+            recover)
+        timer.start()
+        self._recover_timer = timer
+
+
+class CasPaxosAcceptor(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: CasPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.round = -1
+        self.vote_round = -1
+        self.vote_value: Optional[frozenset] = None
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1a):
+            if message.round < self.round:
+                self.send(src, Nack(higher_round=self.round))
+                return
+            self.round = message.round
+            self.send(src, Phase1b(round=self.round,
+                                   acceptor_index=self.index,
+                                   vote_round=self.vote_round,
+                                   vote_value=self.vote_value))
+        elif isinstance(message, Phase2a):
+            if message.round < self.round:
+                self.send(src, Nack(higher_round=self.round))
+                return
+            self.round = message.round
+            self.vote_round = message.round
+            self.vote_value = message.value
+            self.send(src, Phase2b(round=self.round,
+                                   acceptor_index=self.index))
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+
+class CasPaxosClient(Actor):
+    """Propose set-union deltas; exactly-once per client id."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: CasPaxosConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.next_id = 0
+        self.pending: Optional[tuple[int, ClientRequest, Callable,
+                                     object]] = None
+
+    def propose(self, int_set: frozenset[int] | set[int],
+                callback: Optional[Callable[[frozenset], None]] = None
+                ) -> None:
+        if self.pending is not None:
+            raise RuntimeError("a proposal is already pending")
+        request = ClientRequest(self.address, self.next_id,
+                                frozenset(int_set))
+        self.next_id += 1
+        leader = self.config.leader_addresses[
+            self.rng.randrange(len(self.config.leader_addresses))]
+        self.send(leader, request)
+
+        def resend():
+            target = self.config.leader_addresses[
+                self.rng.randrange(len(self.config.leader_addresses))]
+            self.send(target, request)
+            timer.start()
+
+        timer = self.timer("resend", self.resend_period_s, resend)
+        timer.start()
+        self.pending = (request.client_id, request,
+                        callback or (lambda _: None), timer)
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        if self.pending is None or self.pending[0] != message.client_id:
+            return
+        _, _, callback, timer = self.pending
+        timer.stop()
+        self.pending = None
+        callback(message.value)

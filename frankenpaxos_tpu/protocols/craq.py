@@ -1,0 +1,233 @@
+"""CRAQ: chain replication with apportioned queries.
+
+Reference behavior: craq/ (ChainNode.scala:59-340, Client.scala, Config).
+Writes enter at the head and propagate down the chain as pending; the
+tail applies, replies to the client, and acks back up the chain, at
+which point each node applies the write and clears it from pending.
+Reads hit any node: clean keys (no pending write) are served locally;
+dirty keys are forwarded to the tail (the apportioned-queries rule,
+ChainNode.scala:163-197).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class CraqConfig:
+    chain_node_addresses: tuple
+
+    def check_valid(self) -> None:
+        if not self.chain_node_addresses:
+            raise ValueError("need at least one chain node")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Write:
+    command_id: CommandId
+    key: str
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class WriteBatch:
+    writes: tuple[Write, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Read:
+    command_id: CommandId
+    key: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadBatch:
+    reads: tuple[Read, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TailRead:
+    read_batch: ReadBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class Ack:
+    write_batch: WriteBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadReply:
+    command_id: CommandId
+    value: str
+
+
+class ChainNode(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: CraqConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.chain_node_addresses).index(address)
+        self.is_head = self.index == 0
+        self.is_tail = self.index == len(config.chain_node_addresses) - 1
+        self.pending_writes: list[WriteBatch] = []
+        self.state_machine: dict[str, str] = {}
+        self.versions = 0
+
+    # --- write path (ChainNode.scala:135-161) -----------------------------
+    def _process_write_batch(self, batch: WriteBatch) -> None:
+        if not self.is_tail:
+            self.pending_writes.append(batch)
+            self.send(self.config.chain_node_addresses[self.index + 1],
+                      batch)
+            return
+        # Tail: apply, reply, ack upstream.
+        for write in batch.writes:
+            self.state_machine[write.key] = write.value
+            self.send(write.command_id.client_address,
+                      ClientReply(write.command_id))
+            self.versions += 1
+        if not self.is_head:
+            self.send(self.config.chain_node_addresses[self.index - 1],
+                      Ack(batch))
+
+    def _handle_ack(self, ack: Ack) -> None:
+        for write in ack.write_batch.writes:
+            self.state_machine[write.key] = write.value
+        if ack.write_batch in self.pending_writes:
+            self.pending_writes.remove(ack.write_batch)
+        if not self.is_head:
+            self.send(self.config.chain_node_addresses[self.index - 1], ack)
+
+    # --- read path (ChainNode.scala:163-197) ------------------------------
+    def _process_read_batch(self, batch: ReadBatch) -> None:
+        dirty_keys = {write.key
+                      for pending in self.pending_writes
+                      for write in pending.writes}
+        dirty_reads = []
+        for read in batch.reads:
+            if read.key in dirty_keys:
+                dirty_reads.append(read)
+            else:
+                value = self.state_machine.get(read.key, "default")
+                self.send(read.command_id.client_address,
+                          ReadReply(read.command_id, value))
+                self.versions += 1
+        if dirty_reads:
+            self.send(self.config.chain_node_addresses[-1],
+                      TailRead(ReadBatch(tuple(dirty_reads))))
+
+    def _handle_tail_read(self, tail_read: TailRead) -> None:
+        for read in tail_read.read_batch.reads:
+            value = self.state_machine.get(read.key, "default")
+            self.send(read.command_id.client_address,
+                      ReadReply(read.command_id, value))
+            self.versions += 1
+
+    # --- dispatch ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Write):
+            self._process_write_batch(WriteBatch((message,)))
+        elif isinstance(message, WriteBatch):
+            self._process_write_batch(message)
+        elif isinstance(message, Read):
+            self._process_read_batch(ReadBatch((message,)))
+        elif isinstance(message, ReadBatch):
+            self._process_read_batch(message)
+        elif isinstance(message, Ack):
+            self._handle_ack(message)
+        elif isinstance(message, TailRead):
+            self._handle_tail_read(message)
+        else:
+            self.logger.fatal(f"unexpected chain node message {message!r}")
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    callback: Callable
+    resend_timer: object
+
+
+class CraqClient(Actor):
+    """Writes go to the head; reads go to a random node
+    (craq/Client.scala)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: CraqConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.ids: dict[int, int] = {}
+        self.pending: dict[int, _Pending] = {}
+
+    def _start(self, pseudonym: int, make_request, dst: Address,
+               callback) -> None:
+        if pseudonym in self.pending:
+            raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = make_request(CommandId(self.address, pseudonym, id))
+
+        def resend():
+            self.send(dst, request)
+            timer.start()
+
+        self.send(dst, request)
+        timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.pending[pseudonym] = _Pending(id, callback or (lambda *_: None),
+                                           timer)
+
+    def write(self, pseudonym: int, key: str, value: str,
+              callback: Optional[Callable[[], None]] = None) -> None:
+        self._start(pseudonym, lambda cid: Write(cid, key, value),
+                    self.config.chain_node_addresses[0], callback)
+
+    def read(self, pseudonym: int, key: str,
+             callback: Optional[Callable[[str], None]] = None) -> None:
+        node = self.config.chain_node_addresses[
+            self.rng.randrange(len(self.config.chain_node_addresses))]
+        self._start(pseudonym, lambda cid: Read(cid, key), node, callback)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientReply):
+            pseudonym = message.command_id.client_pseudonym
+            result = None
+        elif isinstance(message, ReadReply):
+            pseudonym = message.command_id.client_pseudonym
+            result = message.value
+        else:
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.get(pseudonym)
+        if pending is None or pending.id != message.command_id.client_id:
+            self.logger.debug(f"stale reply {message}")
+            return
+        pending.resend_timer.stop()
+        del self.pending[pseudonym]
+        if result is None:
+            pending.callback()
+        else:
+            pending.callback(result)

@@ -1,0 +1,231 @@
+"""Single-decree classic Paxos.
+
+Reference behavior: paxos/ (Leader.scala:40-240, Acceptor.scala:30-120,
+Client.scala). Leaders run Phase1 (f+1 promises, adopt the highest vote)
+then Phase2 (f+1 votes choose); with n leaders, leader i uses rounds
+i, i+n, i+2n, ... Acceptors keep (round, vote_round, vote_value).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class PaxosConfig:
+    f: int
+    leader_addresses: tuple
+    acceptor_addresses: tuple
+
+    def check_valid(self) -> None:
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProposeRequest:
+    v: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ProposeReply:
+    chosen: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    round: int
+    acceptor_id: int
+    vote_round: int
+    vote_value: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    round: int
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    acceptor_id: int
+    round: int
+
+
+class PaxosLeader(Actor):
+    """(paxos/Leader.scala:40-240)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: PaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.leader_addresses).index(address)
+        self.round = -1
+        self.status = "idle"  # idle | phase1 | phase2 | chosen
+        self.proposed_value: Optional[str] = None
+        self.phase1b_responses: dict[int, Phase1b] = {}
+        self.phase2b_responses: dict[int, Phase2b] = {}
+        self.chosen_value: Optional[str] = None
+        self.waiting_clients: list[Address] = []
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ProposeRequest):
+            self._handle_propose_request(src, message)
+        elif isinstance(message, Phase1b):
+            self._handle_phase1b(src, message)
+        elif isinstance(message, Phase2b):
+            self._handle_phase2b(src, message)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_propose_request(self, src: Address,
+                                request: ProposeRequest) -> None:
+        if self.chosen_value is not None:
+            self.send(src, ProposeReply(self.chosen_value))
+            return
+        n = len(self.config.leader_addresses)
+        self.round = self.index if self.round == -1 else self.round + n
+        self.proposed_value = request.v
+        self.status = "phase1"
+        self.phase1b_responses.clear()
+        self.phase2b_responses.clear()
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, Phase1a(round=self.round))
+        self.waiting_clients.append(src)
+
+    def _handle_phase1b(self, src: Address, response: Phase1b) -> None:
+        if self.status != "phase1" or response.round != self.round:
+            self.logger.debug(f"ignoring {response}")
+            return
+        self.phase1b_responses[response.acceptor_id] = response
+        if len(self.phase1b_responses) < self.config.f + 1:
+            return
+        # Adopt the highest-vote-round value, else our own.
+        best = max(self.phase1b_responses.values(),
+                   key=lambda r: r.vote_round)
+        if best.vote_round != -1:
+            self.proposed_value = best.vote_value
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, Phase2a(round=self.round,
+                                        value=self.proposed_value))
+        self.status = "phase2"
+
+    def _handle_phase2b(self, src: Address, response: Phase2b) -> None:
+        if self.status != "phase2" or response.round != self.round:
+            self.logger.debug(f"ignoring {response}")
+            return
+        self.phase2b_responses[response.acceptor_id] = response
+        if len(self.phase2b_responses) < self.config.f + 1:
+            return
+        chosen = self.proposed_value
+        if self.chosen_value is not None:
+            self.logger.check_eq(self.chosen_value, chosen)
+        self.chosen_value = chosen
+        self.status = "chosen"
+        for client in self.waiting_clients:
+            self.send(client, ProposeReply(chosen=chosen))
+        self.waiting_clients.clear()
+
+
+class PaxosAcceptor(Actor):
+    """(paxos/Acceptor.scala:30-120)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: PaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.round = -1
+        self.vote_round = -1
+        self.vote_value: Optional[str] = None
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1a):
+            self._handle_phase1a(src, message)
+        elif isinstance(message, Phase2a):
+            self._handle_phase2a(src, message)
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        if phase1a.round <= self.round:
+            return
+        self.round = phase1a.round
+        self.send(src, Phase1b(round=self.round, acceptor_id=self.index,
+                               vote_round=self.vote_round,
+                               vote_value=self.vote_value))
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        if phase2a.round < self.round:
+            return
+        if phase2a.round == self.round and phase2a.round == self.vote_round:
+            return  # already voted this round
+        self.round = phase2a.round
+        self.vote_round = phase2a.round
+        self.vote_value = phase2a.value
+        self.send(src, Phase2b(acceptor_id=self.index, round=self.round))
+
+
+class PaxosClient(Actor):
+    """(paxos/Client.scala): propose to a leader with a re-propose timer."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: PaxosConfig,
+                 repropose_period_s: float = 10.0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.proposed_value: Optional[str] = None
+        self.chosen_value: Optional[str] = None
+        self.callbacks: list[Callable[[str], None]] = []
+        self.repropose_timer = self.timer(
+            "repropose", repropose_period_s, self._repropose)
+
+    def propose(self, v: str,
+                callback: Optional[Callable[[str], None]] = None) -> None:
+        if callback is not None:
+            self.callbacks.append(callback)
+        if self.chosen_value is not None:
+            for cb in self.callbacks:
+                cb(self.chosen_value)
+            self.callbacks.clear()
+            return
+        if self.proposed_value is not None:
+            return  # already proposing; callback queued
+        self.proposed_value = v
+        self._send_proposal()
+        self.repropose_timer.start()
+
+    def _send_proposal(self) -> None:
+        for leader in self.config.leader_addresses:
+            self.send(leader, ProposeRequest(v=self.proposed_value))
+
+    def _repropose(self) -> None:
+        if self.chosen_value is None and self.proposed_value is not None:
+            self._send_proposal()
+            self.repropose_timer.start()
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ProposeReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        if self.chosen_value is not None:
+            self.logger.check_eq(self.chosen_value, message.chosen)
+            return
+        self.chosen_value = message.chosen
+        self.repropose_timer.stop()
+        for cb in self.callbacks:
+            cb(message.chosen)
+        self.callbacks.clear()

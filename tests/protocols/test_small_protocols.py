@@ -1,0 +1,341 @@
+"""Paxos, FastPaxos, CASPaxos, BatchedUnreplicated, CRAQ: integration +
+targeted property tests (mirrors the per-protocol test harnesses in
+shared/src/test/scala)."""
+
+import random
+from typing import Optional
+
+import pytest
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.paxos import (
+    PaxosAcceptor,
+    PaxosClient,
+    PaxosConfig,
+    PaxosLeader,
+)
+from frankenpaxos_tpu.protocols.fastpaxos import (
+    FastPaxosAcceptor,
+    FastPaxosClient,
+    FastPaxosConfig,
+    FastPaxosLeader,
+)
+from frankenpaxos_tpu.protocols.caspaxos import (
+    CasPaxosAcceptor,
+    CasPaxosClient,
+    CasPaxosConfig,
+    CasPaxosLeader,
+)
+from frankenpaxos_tpu.protocols.batchedunreplicated import (
+    BatchedUnreplicatedBatcher,
+    BatchedUnreplicatedClient,
+    BatchedUnreplicatedConfig,
+    BatchedUnreplicatedProxyServer,
+    BatchedUnreplicatedServer,
+)
+from frankenpaxos_tpu.protocols.craq import (
+    ChainNode,
+    CraqClient,
+    CraqConfig,
+)
+
+
+def sim_logger():
+    logger = FakeLogger(LogLevel.FATAL)
+    return logger, SimTransport(logger)
+
+
+# --- single-decree Paxos ----------------------------------------------------
+
+
+def make_paxos(f=1, num_clients=2):
+    logger, transport = sim_logger()
+    config = PaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(2 * f + 1)))
+    leaders = [PaxosLeader(a, transport, logger, config)
+               for a in config.leader_addresses]
+    acceptors = [PaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    clients = [PaxosClient(f"client-{i}", transport, logger, config)
+               for i in range(num_clients)]
+    return transport, leaders, acceptors, clients
+
+
+class TestPaxos:
+    def test_single_proposal_chosen(self):
+        transport, leaders, _, clients = make_paxos()
+        got = []
+        clients[0].propose("x", got.append)
+        transport.deliver_all()
+        assert got == ["x"]
+
+    def test_competing_proposals_agree(self):
+        transport, leaders, _, clients = make_paxos()
+        got = []
+        clients[0].propose("x", got.append)
+        clients[1].propose("y", got.append)
+        transport.deliver_all()
+        # Retries may be needed when leaders duel.
+        for _ in range(10):
+            if len(got) == 2:
+                break
+            for timer in transport.running_timers():
+                transport.trigger_timer(timer.id)
+            transport.deliver_all()
+        assert len(got) == 2
+        assert got[0] == got[1]
+
+    def test_safety_under_reordering(self):
+        """Randomized delivery: at most one value ever chosen."""
+        for seed in range(20):
+            rng = random.Random(seed)
+            transport, leaders, _, clients = make_paxos()
+            clients[0].propose("a")
+            clients[1].propose("b")
+            for _ in range(400):
+                cmd = transport.generate_command(rng)
+                if cmd is None:
+                    break
+                transport.run_command(cmd)
+            chosen = {l.chosen_value for l in leaders
+                      if l.chosen_value is not None}
+            assert len(chosen) <= 1, (seed, chosen)
+
+
+# --- Fast Paxos -------------------------------------------------------------
+
+
+def make_fastpaxos(f=1, num_clients=2):
+    logger, transport = sim_logger()
+    config = FastPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(2 * f + 1)))
+    leaders = [FastPaxosLeader(a, transport, logger, config)
+               for a in config.leader_addresses]
+    acceptors = [FastPaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    clients = [FastPaxosClient(f"client-{i}", transport, logger, config)
+               for i in range(num_clients)]
+    return transport, leaders, acceptors, clients
+
+
+class TestFastPaxos:
+    def test_fast_path(self):
+        transport, leaders, acceptors, clients = make_fastpaxos()
+        # Let leader 0 set up the fast round ("any" value distribution).
+        transport.deliver_all()
+        got = []
+        clients[0].propose("fast", got.append)
+        transport.deliver_all()
+        assert got == ["fast"]
+
+    def test_classic_fallback_on_conflict(self):
+        transport, leaders, acceptors, clients = make_fastpaxos()
+        transport.deliver_all()
+        got = []
+        # Two clients race in the fast round; a conflict may prevent a
+        # fast quorum. The repropose timers fall back to the leaders.
+        clients[0].propose("a", got.append)
+        clients[1].propose("b", got.append)
+        transport.deliver_all()
+        for _ in range(10):
+            if len(got) == 2:
+                break
+            for timer in transport.running_timers():
+                transport.trigger_timer(timer.id)
+            transport.deliver_all()
+        assert len(got) == 2
+        assert got[0] == got[1]
+
+    def test_safety_under_reordering(self):
+        for seed in range(20):
+            rng = random.Random(seed)
+            transport, leaders, acceptors, clients = make_fastpaxos()
+            clients[0].propose("a")
+            clients[1].propose("b")
+            for _ in range(400):
+                cmd = transport.generate_command(rng)
+                if cmd is None:
+                    break
+                transport.run_command(cmd)
+            chosen = ({l.chosen_value for l in leaders
+                       if l.chosen_value is not None}
+                      | {c.chosen_value for c in clients
+                         if c.chosen_value is not None})
+            assert len(chosen) <= 1, (seed, chosen)
+
+
+# --- CASPaxos ---------------------------------------------------------------
+
+
+def make_caspaxos(f=1, num_clients=2):
+    logger, transport = sim_logger()
+    config = CasPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(2 * f + 1)))
+    leaders = [CasPaxosLeader(a, transport, logger, config, seed=i)
+               for i, a in enumerate(config.leader_addresses)]
+    acceptors = [CasPaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    clients = [CasPaxosClient(f"client-{i}", transport, logger, config,
+                              seed=i)
+               for i in range(num_clients)]
+    return transport, leaders, acceptors, clients
+
+
+class TestCasPaxos:
+    def test_single_update(self):
+        transport, _, _, clients = make_caspaxos()
+        got = []
+        clients[0].propose({1, 2}, got.append)
+        transport.deliver_all()
+        assert got == [frozenset({1, 2})]
+
+    def test_updates_accumulate(self):
+        transport, _, _, clients = make_caspaxos()
+        got = []
+        clients[0].propose({1}, got.append)
+        transport.deliver_all()
+        clients[0].propose({2}, got.append)
+        transport.deliver_all()
+        # Even through different leaders/rounds, state grows monotonically.
+        for _ in range(10):
+            if len(got) == 2:
+                break
+            for timer in transport.running_timers():
+                transport.trigger_timer(timer.id)
+            transport.deliver_all()
+        assert len(got) == 2
+        assert got[0] <= got[1]
+        assert {1, 2} <= got[1]
+
+    def test_concurrent_updates_converge(self):
+        transport, _, _, clients = make_caspaxos()
+        got = []
+        clients[0].propose({1}, got.append)
+        clients[1].propose({2}, got.append)
+        for _ in range(20):
+            if len(got) == 2:
+                break
+            transport.deliver_all()
+            for timer in transport.running_timers():
+                transport.trigger_timer(timer.id)
+        assert len(got) == 2
+        assert got[0] <= got[1] or got[1] <= got[0]
+
+
+# --- BatchedUnreplicated ----------------------------------------------------
+
+
+class TestBatchedUnreplicated:
+    def test_pipeline(self):
+        logger, transport = sim_logger()
+        config = BatchedUnreplicatedConfig(
+            batcher_addresses=("batcher-0", "batcher-1"),
+            server_address="server",
+            proxy_server_addresses=("proxy-0", "proxy-1"))
+        batchers = [BatchedUnreplicatedBatcher(a, transport, logger, config,
+                                               batch_size=2)
+                    for a in config.batcher_addresses]
+        server = BatchedUnreplicatedServer("server", transport, logger,
+                                           config, AppendLog())
+        proxies = [BatchedUnreplicatedProxyServer(a, transport, logger,
+                                                  config)
+                   for a in config.proxy_server_addresses]
+        clients = [BatchedUnreplicatedClient(f"client-{i}", transport,
+                                             logger, config, seed=i)
+                   for i in range(4)]
+        got = []
+        for i, client in enumerate(clients):
+            client.propose(b"cmd%d" % i, got.append)
+        transport.deliver_all()
+        for _ in range(10):
+            if len(got) == 4:
+                break
+            for timer in transport.running_timers():
+                transport.trigger_timer(timer.id)
+            transport.deliver_all()
+        assert len(got) == 4
+        assert len(server.state_machine.get()) >= 4
+
+
+# --- CRAQ -------------------------------------------------------------------
+
+
+def make_craq(chain_length=3, num_clients=2):
+    logger, transport = sim_logger()
+    config = CraqConfig(chain_node_addresses=tuple(
+        f"node-{i}" for i in range(chain_length)))
+    nodes = [ChainNode(a, transport, logger, config)
+             for a in config.chain_node_addresses]
+    clients = [CraqClient(f"client-{i}", transport, logger, config, seed=i)
+               for i in range(num_clients)]
+    return transport, nodes, clients
+
+
+class TestCraq:
+    def test_write_then_read(self):
+        transport, nodes, clients = make_craq()
+        done = []
+        clients[0].write(0, "k", "v", lambda: done.append(True))
+        transport.deliver_all()
+        assert done == [True]
+        # Write propagated to every node via acks.
+        for node in nodes:
+            assert node.state_machine == {"k": "v"}
+            assert node.pending_writes == []
+        got = []
+        clients[0].read(0, "k", got.append)
+        transport.deliver_all()
+        assert got == ["v"]
+
+    def test_missing_key_reads_default(self):
+        transport, nodes, clients = make_craq()
+        got = []
+        clients[0].read(0, "nope", got.append)
+        transport.deliver_all()
+        assert got == ["default"]
+
+    def test_dirty_read_forwarded_to_tail(self):
+        transport, nodes, clients = make_craq()
+        clients[0].write(0, "k", "new")
+        # Deliver only the head's processing: write is pending at node 0.
+        head_write = transport.messages[0]
+        transport.deliver_message(head_write)
+        assert nodes[0].pending_writes
+        # A read at the head for the dirty key must go to the tail.
+        clients[1].read(0, "k", lambda v: got.append(v))
+        got = []
+        # Route the read to the head specifically.
+        read_messages = [m for m in transport.messages
+                         if m.dst == "node-0" and m.src == "client-1"]
+        if not read_messages:
+            # Client picked another node randomly; that's fine -- just
+            # check the apportioned rule directly at the head.
+            from frankenpaxos_tpu.protocols.craq import (
+                CommandId,
+                Read,
+                ReadBatch,
+            )
+            nodes[0]._process_read_batch(ReadBatch((
+                Read(CommandId("client-1", 0, 99), "k"),)))
+            tail_reads = [m for m in transport.messages
+                          if m.dst == "node-2"]
+            assert tail_reads
+        transport.deliver_all()
+
+    def test_linearizable_reads_after_ack(self):
+        transport, nodes, clients = make_craq(chain_length=2)
+        clients[0].write(0, "x", "1")
+        transport.deliver_all()
+        for i in range(5):
+            got = []
+            clients[1].read(1, "x", got.append)
+            transport.deliver_all()
+            assert got == ["1"]
