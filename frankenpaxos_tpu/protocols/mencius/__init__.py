@@ -1,0 +1,36 @@
+"""Compartmentalized Mencius: multi-leader log partitioning.
+
+Reference behavior: mencius/ (~3,000 LoC Scala; SURVEY.md section 2.2).
+Leader groups own round-robin slot stripes; laggards skip their stripes
+with noop ranges driven by high-watermark gossip. The slot-stripe layout
+is the direct analog of sharding the slot axis across cores
+(SURVEY.md section 2.3 item 4).
+"""
+
+from frankenpaxos_tpu.protocols.mencius.common import (
+    DistributionScheme,
+    MenciusConfig,
+)
+from frankenpaxos_tpu.protocols.mencius.replica import (
+    MenciusClient,
+    MenciusProxyReplica,
+    MenciusReplica,
+)
+from frankenpaxos_tpu.protocols.mencius.roles import (
+    MenciusAcceptor,
+    MenciusBatcher,
+    MenciusLeader,
+    MenciusProxyLeader,
+)
+
+__all__ = [
+    "DistributionScheme",
+    "MenciusAcceptor",
+    "MenciusBatcher",
+    "MenciusClient",
+    "MenciusConfig",
+    "MenciusLeader",
+    "MenciusProxyLeader",
+    "MenciusProxyReplica",
+    "MenciusReplica",
+]

@@ -1,0 +1,186 @@
+"""Mencius messages and configuration.
+
+Reference behavior: mencius/Mencius.proto and mencius/Config.scala.
+Mencius partitions the log round-robin across *leader groups*; each
+leader group runs its own MultiPaxos over its own acceptor groups.
+Lagging groups skip their slots by choosing noop *ranges*
+(Mencius.proto:160-202).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Union
+
+from frankenpaxos_tpu.runtime.transport import Address
+
+# Re-used value/message shapes identical to MultiPaxos.
+from frankenpaxos_tpu.protocols.multipaxos.messages import (  # noqa: F401
+    NOOP,
+    ChosenWatermark,
+    ClientReply,
+    ClientReplyBatch,
+    ClientRequest,
+    ClientRequestBatch,
+    Command,
+    CommandBatch,
+    CommandBatchOrNoop,
+    CommandId,
+    Nack,
+    Noop,
+    Phase1a,
+    Phase1b,
+    Phase1bSlotInfo,
+    Phase2a,
+    Phase2b,
+    Recover,
+)
+
+
+class DistributionScheme(enum.Enum):
+    HASH = "hash"
+    COLOCATED = "colocated"
+
+
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    value: CommandBatchOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class HighWatermark:
+    next_slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2aNoopRange:
+    slot_start_inclusive: int
+    slot_end_exclusive: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2bNoopRange:
+    acceptor_group_index: int
+    acceptor_index: int
+    slot_start_inclusive: int
+    slot_end_exclusive: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ChosenNoopRange:
+    slot_start_inclusive: int
+    slot_end_exclusive: int
+
+
+@dataclasses.dataclass(frozen=True)
+class NotLeaderClient:
+    leader_group_index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoRequestClient:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoReplyClient:
+    leader_group_index: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class NotLeaderBatcher:
+    leader_group_index: int
+    client_request_batch: ClientRequestBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoRequestBatcher:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoReplyBatcher:
+    leader_group_index: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MenciusConfig:
+    """(mencius/Config.scala:20-60):
+    - 0 or >= f+1 batchers
+    - >= 1 leader group, each of >= f+1 leaders (elections mirror them)
+    - one set of >= 1 acceptor groups of 2f+1 per leader group
+    - >= f+1 replicas; 0 or >= f+1 proxy replicas
+    """
+
+    f: int
+    batcher_addresses: tuple
+    leader_addresses: tuple          # [group][member]
+    leader_election_addresses: tuple  # [group][member]
+    proxy_leader_addresses: tuple
+    acceptor_addresses: tuple        # [leader group][acceptor group][member]
+    replica_addresses: tuple
+    proxy_replica_addresses: tuple
+    distribution_scheme: DistributionScheme = DistributionScheme.HASH
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def num_batchers(self) -> int:
+        return len(self.batcher_addresses)
+
+    @property
+    def num_leader_groups(self) -> int:
+        return len(self.leader_addresses)
+
+    @property
+    def num_proxy_leaders(self) -> int:
+        return len(self.proxy_leader_addresses)
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_addresses)
+
+    @property
+    def num_proxy_replicas(self) -> int:
+        return len(self.proxy_replica_addresses)
+
+    def all_leaders(self) -> list[Address]:
+        return [a for group in self.leader_addresses for a in group]
+
+    def check_valid(self) -> None:
+        def require(cond, msg):
+            if not cond:
+                raise ValueError(msg)
+
+        require(self.f >= 1, "f must be >= 1")
+        require(self.num_batchers == 0 or self.num_batchers >= self.f + 1,
+                "num_batchers must be 0 or >= f+1")
+        require(self.num_leader_groups >= 1, "need >= 1 leader group")
+        for i, group in enumerate(self.leader_addresses):
+            require(len(group) >= self.f + 1,
+                    f"leader group {i} must have >= f+1 members")
+        require(len(self.leader_election_addresses)
+                == self.num_leader_groups,
+                "election groups must mirror leader groups")
+        require(self.num_proxy_leaders >= self.f + 1,
+                "num_proxy_leaders must be >= f+1")
+        require(len(self.acceptor_addresses) == self.num_leader_groups,
+                "one acceptor-group set per leader group")
+        for groups in self.acceptor_addresses:
+            require(len(groups) >= 1, "need >= 1 acceptor group")
+            for group in groups:
+                require(len(group) == 2 * self.f + 1,
+                        "acceptor groups must have 2f+1 members")
+        require(self.num_replicas >= self.f + 1,
+                "num_replicas must be >= f+1")
+        require(self.num_proxy_replicas == 0
+                or self.num_proxy_replicas >= self.f + 1,
+                "num_proxy_replicas must be 0 or >= f+1")

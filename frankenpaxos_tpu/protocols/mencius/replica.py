@@ -1,0 +1,282 @@
+"""Mencius Replica, ProxyReplica, and Client.
+
+Reference behavior: mencius/Replica.scala:151-560 (BufferMap log,
+Chosen + ChosenNoopRange, in-order executeLog, recover timer on holes),
+mencius/ProxyReplica.scala, mencius/Client.scala (per-leader-group round
+tracking).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+from frankenpaxos_tpu.protocols.mencius.common import (
+    Chosen,
+    ChosenNoopRange,
+    ChosenWatermark,
+    ClientReply,
+    ClientReplyBatch,
+    ClientRequest,
+    Command,
+    CommandBatch,
+    CommandId,
+    DistributionScheme,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestClient,
+    MenciusConfig,
+    Noop,
+    NotLeaderClient,
+    Recover,
+)
+
+
+class MenciusReplica(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, state_machine: StateMachine,
+                 config: MenciusConfig, log_grow_size: int = 5000,
+                 send_chosen_watermark_every_n: int = 100,
+                 recover_min_period_s: float = 5.0,
+                 recover_max_period_s: float = 10.0,
+                 unsafe_dont_recover: bool = False, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.send_chosen_watermark_every_n = send_chosen_watermark_every_n
+        self.index = list(config.replica_addresses).index(address)
+        self.slot_system = ClassicRoundRobin(config.num_leader_groups)
+        self.log: BufferMap = BufferMap(log_grow_size)
+        self.executed_watermark = 0
+        self.num_chosen = 0
+        self.high_watermark = -1
+        self.client_table: dict[tuple, tuple[int, bytes]] = {}
+        self.recovering_slot: Optional[int] = None
+        self.recover_timer = None
+        if not unsafe_dont_recover:
+            self.recover_timer = self.timer(
+                "recover",
+                self.rng.uniform(recover_min_period_s, recover_max_period_s),
+                self._recover)
+
+    def _proxy_replica(self) -> Optional[Address]:
+        if not self.config.proxy_replica_addresses:
+            return None
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            return self.config.proxy_replica_addresses[
+                self.rng.randrange(self.config.num_proxy_replicas)]
+        return self.config.proxy_replica_addresses[
+            self.index % self.config.num_proxy_replicas]
+
+    def _send_to_owning_leaders(self, message, slot: int) -> None:
+        proxy = self._proxy_replica()
+        if proxy is not None:
+            self.send(proxy, message)
+            return
+        for leader in self.config.leader_addresses[
+                self.slot_system.leader(slot)]:
+            self.send(leader, message)
+
+    def _recover(self) -> None:
+        self.send_recover(self.executed_watermark)
+        self.recover_timer.start()
+
+    def send_recover(self, slot: int) -> None:
+        self._send_to_owning_leaders(Recover(slot=slot), slot)
+
+    def _execute_command(self, slot: int, command: Command,
+                         replies: list[ClientReply]) -> None:
+        cid = command.command_id
+        key = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(key)
+        if cached is not None:
+            largest_id, cached_result = cached
+            if cid.client_id < largest_id:
+                return
+            if cid.client_id == largest_id:
+                replies.append(ClientReply(cid, slot, cached_result))
+                return
+        result = self.state_machine.run(command.command)
+        self.client_table[key] = (cid.client_id, result)
+        if slot % self.config.num_replicas == self.index:
+            replies.append(ClientReply(cid, slot, result))
+
+    def _execute_log(self) -> list[ClientReply]:
+        replies: list[ClientReply] = []
+        while True:
+            value = self.log.get(self.executed_watermark)
+            if value is None:
+                return replies
+            slot = self.executed_watermark
+            if isinstance(value, CommandBatch):
+                for command in value.commands:
+                    self._execute_command(slot, command, replies)
+            self.executed_watermark += 1
+            every_n = self.send_chosen_watermark_every_n
+            if (self.executed_watermark % every_n == 0
+                    and (self.executed_watermark // every_n)
+                    % self.config.num_replicas == self.index):
+                watermark = ChosenWatermark(slot=self.executed_watermark)
+                proxy = self._proxy_replica()
+                if proxy is not None:
+                    self.send(proxy, watermark)
+                else:
+                    for group in self.config.leader_addresses:
+                        for leader in group:
+                            self.send(leader, watermark)
+
+    def _after_choose(self) -> None:
+        replies = self._execute_log()
+        if replies:
+            proxy = self._proxy_replica()
+            if proxy is not None:
+                self.send(proxy, ClientReplyBatch(batch=tuple(replies)))
+            else:
+                for reply in replies:
+                    self.send(reply.command_id.client_address, reply)
+        # Hole-recovery timer management (Replica.scala:432-462).
+        if self.recover_timer is None:
+            return
+        has_hole = self.num_chosen != self.executed_watermark
+        if self.recovering_slot is None and has_hole:
+            self.recovering_slot = self.executed_watermark
+            self.recover_timer.start()
+        elif self.recovering_slot is not None and has_hole:
+            if self.recovering_slot != self.executed_watermark:
+                self.recovering_slot = self.executed_watermark
+                self.recover_timer.reset()
+        elif self.recovering_slot is not None and not has_hole:
+            self.recovering_slot = None
+            self.recover_timer.stop()
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Chosen):
+            if self.log.get(message.slot) is not None:
+                return
+            self.log.put(message.slot, message.value)
+            self.num_chosen += 1
+            self.high_watermark = max(self.high_watermark, message.slot)
+            self._after_choose()
+        elif isinstance(message, ChosenNoopRange):
+            for slot in range(message.slot_start_inclusive,
+                              message.slot_end_exclusive,
+                              self.config.num_leader_groups):
+                if self.log.get(slot) is None:
+                    self.log.put(slot, Noop())
+                    self.num_chosen += 1
+            self._after_choose()
+        else:
+            self.logger.fatal(f"unexpected replica message {message!r}")
+
+
+class MenciusProxyReplica(Actor):
+    """(mencius/ProxyReplica.scala): unbatch replies; route watermarks to
+    all leaders and Recovers to the owning group."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MenciusConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.slot_system = ClassicRoundRobin(config.num_leader_groups)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientReplyBatch):
+            for reply in message.batch:
+                self.send(reply.command_id.client_address, reply)
+        elif isinstance(message, ChosenWatermark):
+            for leader in self.config.all_leaders():
+                self.send(leader, message)
+        elif isinstance(message, Recover):
+            for leader in self.config.leader_addresses[
+                    self.slot_system.leader(message.slot)]:
+                self.send(leader, message)
+        else:
+            self.logger.fatal(f"unexpected proxy replica message {message!r}")
+
+
+@dataclasses.dataclass
+class _PendingWrite:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class MenciusClient(Actor):
+    """(mencius/Client.scala): like the MultiPaxos client, but tracks a
+    round per leader group and targets a random group per request."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MenciusConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.rounds = [0] * config.num_leader_groups
+        self.ids: dict[int, int] = {}
+        self.states: dict[int, _PendingWrite] = {}
+
+    def _send_request(self, request: ClientRequest) -> None:
+        if self.config.num_batchers > 0:
+            dst = self.config.batcher_addresses[
+                self.rng.randrange(self.config.num_batchers)]
+        else:
+            group = self.rng.randrange(self.config.num_leader_groups)
+            rs = ClassicRoundRobin(len(self.config.leader_addresses[group]))
+            dst = self.config.leader_addresses[group][
+                rs.leader(self.rounds[group])]
+        self.send(dst, request)
+
+    def write(self, pseudonym: int, command: bytes,
+              callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self.states:
+            raise RuntimeError(
+                f"pseudonym {pseudonym} already has a pending operation")
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(Command(
+            CommandId(self.address, pseudonym, id), command))
+        self._send_request(request)
+
+        def resend():
+            self._send_request(request)
+            timer.start()
+
+        timer = self.timer(f"resendWrite{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.states[pseudonym] = _PendingWrite(
+            id, command, callback or (lambda _: None), timer)
+        self.ids[pseudonym] = id + 1
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientReply):
+            pseudonym = message.command_id.client_pseudonym
+            state = self.states.get(pseudonym)
+            if state is None or message.command_id.client_id != state.id:
+                return
+            state.resend.stop()
+            del self.states[pseudonym]
+            state.callback(message.result)
+        elif isinstance(message, NotLeaderClient):
+            for leader in self.config.leader_addresses[
+                    message.leader_group_index]:
+                self.send(leader, LeaderInfoRequestClient())
+        elif isinstance(message, LeaderInfoReplyClient):
+            if message.round > self.rounds[message.leader_group_index]:
+                self.rounds[message.leader_group_index] = message.round
+                for pseudonym, state in self.states.items():
+                    self._send_request(ClientRequest(Command(
+                        CommandId(self.address, pseudonym, state.id),
+                        state.command)))
+        else:
+            self.logger.fatal(f"unexpected client message {message!r}")

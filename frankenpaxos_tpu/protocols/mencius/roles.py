@@ -1,0 +1,552 @@
+"""Mencius Batcher, Leader, ProxyLeader, and Acceptor.
+
+Reference behavior: mencius/Batcher.scala:85-190, Leader.scala:130-870,
+ProxyLeader.scala:31-420, Acceptor.scala:103-300.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from sortedcontainers import SortedDict  # type: ignore[import-untyped]
+
+from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.protocols.mencius.common import (
+    NOOP,
+    Chosen,
+    ChosenNoopRange,
+    ChosenWatermark,
+    ClientRequest,
+    ClientRequestBatch,
+    CommandBatch,
+    DistributionScheme,
+    HighWatermark,
+    LeaderInfoReplyBatcher,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestBatcher,
+    LeaderInfoRequestClient,
+    MenciusConfig,
+    Nack,
+    NotLeaderBatcher,
+    NotLeaderClient,
+    Phase1a,
+    Phase1b,
+    Phase1bSlotInfo,
+    Phase2a,
+    Phase2aNoopRange,
+    Phase2b,
+    Phase2bNoopRange,
+    Recover,
+)
+
+
+class MenciusBatcher(Actor):
+    """(Batcher.scala:85-190): batch, then send to the current round's
+    leader of a random leader group (Hash) or the colocated group."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MenciusConfig,
+                 batch_size: int = 1, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.batch_size = batch_size
+        self.rng = random.Random(seed)
+        self.index = (list(config.batcher_addresses).index(address)
+                      if address in config.batcher_addresses else 0)
+        # Known round per leader group.
+        self.rounds = [0] * config.num_leader_groups
+        self.growing_batch: list = []
+        self.pending_resend_batches: list = []
+
+    def _group_leader(self, group: int) -> Address:
+        rs = ClassicRoundRobin(len(self.config.leader_addresses[group]))
+        return self.config.leader_addresses[group][
+            rs.leader(self.rounds[group])]
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientRequest):
+            self.growing_batch.append(message.command)
+            if len(self.growing_batch) >= self.batch_size:
+                if (self.config.distribution_scheme
+                        == DistributionScheme.HASH):
+                    group = self.rng.randrange(
+                        self.config.num_leader_groups)
+                else:
+                    group = self.index % self.config.num_leader_groups
+                self.send(self._group_leader(group), ClientRequestBatch(
+                    CommandBatch(tuple(self.growing_batch))))
+                self.growing_batch.clear()
+        elif isinstance(message, NotLeaderBatcher):
+            self.pending_resend_batches.append(
+                (message.leader_group_index, message.client_request_batch))
+            for leader in self.config.leader_addresses[
+                    message.leader_group_index]:
+                self.send(leader, LeaderInfoRequestBatcher())
+        elif isinstance(message, LeaderInfoReplyBatcher):
+            if message.round > self.rounds[message.leader_group_index]:
+                self.rounds[message.leader_group_index] = message.round
+            still_pending = []
+            for group, batch in self.pending_resend_batches:
+                if group == message.leader_group_index:
+                    self.send(self._group_leader(group), batch)
+                else:
+                    still_pending.append((group, batch))
+            self.pending_resend_batches = still_pending
+        else:
+            self.logger.fatal(f"unexpected batcher message {message!r}")
+
+
+@dataclasses.dataclass
+class _Phase1:
+    # One dict per acceptor group of this leader group.
+    phase1bs: list[dict[int, Phase1b]]
+    pending_batches: list[ClientRequestBatch]
+    # Slot to force-recover through phase 1, or -1 (Leader.scala:160-172).
+    recover_slot: int
+    resend_phase1as: object
+
+
+class MenciusLeader(Actor):
+    """(mencius/Leader.scala:130-870)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MenciusConfig,
+                 resend_phase1as_period_s: float = 5.0,
+                 send_high_watermark_every_n: int = 100,
+                 send_noop_range_if_lagging_by: int = 100,
+                 election_options: ElectionOptions = ElectionOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.send_high_watermark_every_n = send_high_watermark_every_n
+        self.send_noop_range_if_lagging_by = send_noop_range_if_lagging_by
+        self.resend_phase1as_period_s = resend_phase1as_period_s
+        self.group_index = next(
+            g for g, group in enumerate(config.leader_addresses)
+            if address in group)
+        self.index = list(
+            config.leader_addresses[self.group_index]).index(address)
+        self.round_system = ClassicRoundRobin(
+            len(config.leader_addresses[self.group_index]))
+        # Which leader group owns which slot (Leader.scala:208-213).
+        self.slot_system = ClassicRoundRobin(config.num_leader_groups)
+        self.round = self.round_system.next_classic_round(0, -1)
+        self.next_slot = self.group_index
+        self.high_watermark = self.next_slot
+        self.chosen_watermark = 0
+        self._commands_since_watermark_send = 0
+        self._current_proxy_leader = self.rng.randrange(
+            config.num_proxy_leaders)
+
+        self.election = ElectionParticipant(
+            config.leader_election_addresses[self.group_index][self.index],
+            transport, logger,
+            config.leader_election_addresses[self.group_index],
+            initial_leader_index=0, options=election_options, seed=seed)
+        self.election.register(
+            lambda leader_index: self.leader_change(
+                leader_index == self.index, recover_slot=-1))
+
+        self.state: object = ("inactive",)
+        if self.index == 0:
+            self.state = self._start_phase1(self.round,
+                                            self.chosen_watermark, -1)
+
+    # --- helpers ----------------------------------------------------------
+    @property
+    def _my_acceptor_groups(self) -> tuple:
+        return self.config.acceptor_addresses[self.group_index]
+
+    def _acceptor_group_index_by_slot(self, slot: int) -> int:
+        self.logger.check_eq(self.slot_system.leader(slot), self.group_index)
+        return ((slot // self.config.num_leader_groups)
+                % len(self._my_acceptor_groups))
+
+    def _proxy_leader(self) -> Address:
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            return self.config.proxy_leader_addresses[
+                self._current_proxy_leader]
+        return self.config.proxy_leader_addresses[self.group_index]
+
+    def _advance_proxy_leader(self) -> None:
+        self._current_proxy_leader = (
+            (self._current_proxy_leader + 1) % self.config.num_proxy_leaders)
+
+    @staticmethod
+    def _safe_value(phase1bs, slot: int):
+        best_round, best_value = -1, None
+        for phase1b in phase1bs:
+            for info in phase1b.info:
+                if info.slot == slot and info.vote_round > best_round:
+                    best_round, best_value = info.vote_round, info.vote_value
+        return NOOP if best_value is None else best_value
+
+    def _start_phase1(self, round: int, chosen_watermark: int,
+                      recover_slot: int) -> _Phase1:
+        phase1a = Phase1a(round=round, chosen_watermark=chosen_watermark)
+        for group in self._my_acceptor_groups:
+            for acceptor in self.rng.sample(list(group),
+                                            self.config.quorum_size):
+                self.send(acceptor, phase1a)
+
+        def resend():
+            for group in self._my_acceptor_groups:
+                for acceptor in group:
+                    self.send(acceptor, phase1a)
+            timer.start()
+
+        timer = self.timer("resendPhase1as", self.resend_phase1as_period_s,
+                           resend)
+        timer.start()
+        return _Phase1(
+            phase1bs=[{} for _ in self._my_acceptor_groups],
+            pending_batches=[], recover_slot=recover_slot,
+            resend_phase1as=timer)
+
+    def leader_change(self, is_new_leader: bool, recover_slot: int) -> None:
+        if isinstance(self.state, _Phase1):
+            self.state.resend_phase1as.stop()
+        if not is_new_leader:
+            self.state = ("inactive",)
+            return
+        self.round = self.round_system.next_classic_round(self.index,
+                                                          self.round)
+        self.state = self._start_phase1(self.round, self.chosen_watermark,
+                                        recover_slot)
+
+    def _process_batch(self, batch: ClientRequestBatch) -> None:
+        self.logger.check_eq(self.state, ("phase2",))
+        self.send(self._proxy_leader(),
+                  Phase2a(slot=self.next_slot, round=self.round,
+                          value=batch.batch))
+        self._advance_proxy_leader()
+        self.next_slot += self.config.num_leader_groups
+        # Periodically gossip our nextSlot so laggards can skip
+        # (Leader.scala:455-480).
+        self._commands_since_watermark_send += 1
+        if (self._commands_since_watermark_send
+                >= self.send_high_watermark_every_n):
+            self.send(self._proxy_leader(),
+                      HighWatermark(next_slot=self.next_slot))
+            self._commands_since_watermark_send = 0
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1b):
+            self._handle_phase1b(src, message)
+        elif isinstance(message, ClientRequest):
+            self._handle_client_request_batch(
+                src, ClientRequestBatch(CommandBatch((message.command,))),
+                from_client=True)
+        elif isinstance(message, ClientRequestBatch):
+            self._handle_client_request_batch(src, message,
+                                              from_client=False)
+        elif isinstance(message, HighWatermark):
+            self._handle_high_watermark(src, message)
+        elif isinstance(message, LeaderInfoRequestClient):
+            if self.state != ("inactive",):
+                self.send(src, LeaderInfoReplyClient(self.group_index,
+                                                     self.round))
+        elif isinstance(message, LeaderInfoRequestBatcher):
+            if self.state != ("inactive",):
+                self.send(src, LeaderInfoReplyBatcher(self.group_index,
+                                                      self.round))
+        elif isinstance(message, Nack):
+            self._handle_nack(src, message)
+        elif isinstance(message, ChosenWatermark):
+            self.chosen_watermark = max(self.chosen_watermark, message.slot)
+        elif isinstance(message, Recover):
+            self._handle_recover(src, message)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        if not isinstance(self.state, _Phase1):
+            return
+        phase1 = self.state
+        if phase1b.round != self.round:
+            self.logger.check_lt(phase1b.round, self.round)
+            return
+        phase1.phase1bs[phase1b.group_index][phase1b.acceptor_index] = phase1b
+        if any(len(g) < self.config.quorum_size for g in phase1.phase1bs):
+            return
+
+        max_slot = max(
+            (info.slot for group in phase1.phase1bs
+             for p1b in group.values() for info in p1b.info),
+            default=-1)
+        max_slot = max(max_slot, phase1.recover_slot)
+        self.logger.check(
+            max_slot == -1
+            or self.slot_system.leader(max_slot) == self.group_index)
+
+        # Fill only the slots this group owns (Leader.scala:624-647).
+        start = self.slot_system.next_classic_round(
+            self.group_index, self.chosen_watermark - 1)
+        for slot in range(start, max_slot + 1,
+                          self.config.num_leader_groups):
+            group = phase1.phase1bs[self._acceptor_group_index_by_slot(slot)]
+            self.send(self._proxy_leader(),
+                      Phase2a(slot=slot, round=self.round,
+                              value=self._safe_value(group.values(), slot)))
+        self.next_slot = self.slot_system.next_classic_round(
+            self.group_index, max_slot)
+        phase1.resend_phase1as.stop()
+        self.state = ("phase2",)
+        for batch in phase1.pending_batches:
+            self._process_batch(batch)
+
+    def _handle_client_request_batch(self, src: Address,
+                                     batch: ClientRequestBatch,
+                                     from_client: bool) -> None:
+        if self.state == ("inactive",):
+            if from_client:
+                self.send(src, NotLeaderClient(self.group_index))
+            else:
+                self.send(src, NotLeaderBatcher(self.group_index, batch))
+        elif isinstance(self.state, _Phase1):
+            self.state.pending_batches.append(batch)
+        else:
+            self._process_batch(batch)
+
+    def _handle_high_watermark(self, src: Address,
+                               message: HighWatermark) -> None:
+        """Skip our slots if we're lagging (Leader.scala:717-770)."""
+        self.high_watermark = max(self.next_slot, self.high_watermark)
+        if message.next_slot <= self.high_watermark:
+            return
+        self.high_watermark = message.next_slot
+        if self.state != ("phase2",):
+            return
+        if self.high_watermark - self.next_slot \
+                < self.send_noop_range_if_lagging_by:
+            return
+        end = self.slot_system.next_classic_round(self.group_index,
+                                                  self.high_watermark)
+        self.send(self._proxy_leader(),
+                  Phase2aNoopRange(slot_start_inclusive=self.next_slot,
+                                   slot_end_exclusive=end,
+                                   round=self.round))
+        self.next_slot = end
+
+    def _handle_nack(self, src: Address, nack: Nack) -> None:
+        if nack.round <= self.round:
+            return
+        if self.state == ("inactive",):
+            self.round = nack.round
+        else:
+            self.round = self.round_system.next_classic_round(self.index,
+                                                              nack.round)
+            self.leader_change(is_new_leader=True, recover_slot=-1)
+
+    def _handle_recover(self, src: Address, recover: Recover) -> None:
+        # A hole in one group's slots can only be fixed by that group
+        # (Leader.scala:845-869); recover_slot threads through phase 1.
+        if self.slot_system.leader(recover.slot) != self.group_index:
+            return
+        if self.state != ("inactive",):
+            self.leader_change(is_new_leader=True,
+                               recover_slot=recover.slot)
+
+
+class MenciusProxyLeader(Actor):
+    """(mencius/ProxyLeader.scala:31-420)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MenciusConfig, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.slot_system = ClassicRoundRobin(config.num_leader_groups)
+        # (start, end, round) -> pending state; None once Done.
+        self.states: dict[tuple, object] = {}
+
+    def _acceptor_group_index_by_slot(self, leader_group: int,
+                                      slot: int) -> int:
+        return ((slot // self.config.num_leader_groups)
+                % len(self.config.acceptor_addresses[leader_group]))
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, HighWatermark):
+            # Relay to every leader of every group
+            # (ProxyLeader.scala:207-214).
+            for leader in self.config.all_leaders():
+                self.send(leader, message)
+        elif isinstance(message, Phase2a):
+            self._handle_phase2a(src, message)
+        elif isinstance(message, Phase2b):
+            self._handle_phase2b(src, message)
+        elif isinstance(message, Phase2aNoopRange):
+            self._handle_phase2a_noop_range(src, message)
+        elif isinstance(message, Phase2bNoopRange):
+            self._handle_phase2b_noop_range(src, message)
+        else:
+            self.logger.fatal(f"unexpected proxy leader message {message!r}")
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        key = (phase2a.slot, phase2a.slot + 1, phase2a.round)
+        if key in self.states:
+            return
+        leader_group = self.slot_system.leader(phase2a.slot)
+        group = self.config.acceptor_addresses[leader_group][
+            self._acceptor_group_index_by_slot(leader_group, phase2a.slot)]
+        for acceptor in self.rng.sample(list(group),
+                                        self.config.quorum_size):
+            self.send(acceptor, phase2a)
+        self.states[key] = {"phase2a": phase2a, "phase2bs": {}}
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        key = (phase2b.slot, phase2b.slot + 1, phase2b.round)
+        state = self.states.get(key)
+        if key not in self.states:
+            self.logger.fatal(f"Phase2b for unknown {key}")
+        if state is None or "phase2a" not in state:
+            return  # Done or a noop-range entry
+        state["phase2bs"][phase2b.acceptor_index] = phase2b
+        if len(state["phase2bs"]) < self.config.quorum_size:
+            return
+        for replica in self.config.replica_addresses:
+            self.send(replica, Chosen(slot=phase2b.slot,
+                                      value=state["phase2a"].value))
+        self.states[key] = None  # Done
+
+    def _handle_phase2a_noop_range(self, src: Address,
+                                   phase2a: Phase2aNoopRange) -> None:
+        key = (phase2a.slot_start_inclusive, phase2a.slot_end_exclusive,
+               phase2a.round)
+        if key in self.states:
+            return
+        leader_group = self.slot_system.leader(phase2a.slot_start_inclusive)
+        for group in self.config.acceptor_addresses[leader_group]:
+            for acceptor in self.rng.sample(list(group),
+                                            self.config.quorum_size):
+                self.send(acceptor, phase2a)
+        self.states[key] = {
+            "noop_range": phase2a,
+            "phase2bs_per_group": [
+                {} for _ in self.config.acceptor_addresses[leader_group]],
+        }
+
+    def _handle_phase2b_noop_range(self, src: Address,
+                                   phase2b: Phase2bNoopRange) -> None:
+        key = (phase2b.slot_start_inclusive, phase2b.slot_end_exclusive,
+               phase2b.round)
+        state = self.states.get(key)
+        if key not in self.states:
+            self.logger.fatal(f"Phase2bNoopRange for unknown {key}")
+        if state is None or "noop_range" not in state:
+            return
+        state["phase2bs_per_group"][phase2b.acceptor_group_index][
+            phase2b.acceptor_index] = phase2b
+        if any(len(g) < self.config.quorum_size
+               for g in state["phase2bs_per_group"]):
+            return
+        for replica in self.config.replica_addresses:
+            self.send(replica, ChosenNoopRange(
+                slot_start_inclusive=phase2b.slot_start_inclusive,
+                slot_end_exclusive=phase2b.slot_end_exclusive))
+        self.states[key] = None  # Done
+
+
+@dataclasses.dataclass
+class _VoteState:
+    vote_round: int
+    vote_value: object
+
+
+class MenciusAcceptor(Actor):
+    """(mencius/Acceptor.scala:103-300)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MenciusConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.leader_group_index, self.acceptor_group_index, self.index = next(
+            (lg, ag, i)
+            for lg, groups in enumerate(config.acceptor_addresses)
+            for ag, group in enumerate(groups)
+            for i, a in enumerate(group)
+            if a == address)
+        self.round_system = ClassicRoundRobin(
+            len(config.leader_addresses[self.leader_group_index]))
+        self.slot_system = ClassicRoundRobin(config.num_leader_groups)
+        self.round = -1
+        self.states: SortedDict = SortedDict()
+        self.max_voted_slot = -1
+
+    def _nack_leader(self, round: int, slot: int) -> Address:
+        return self.config.leader_addresses[self.slot_system.leader(slot)][
+            self.round_system.leader(round)]
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1a):
+            self._handle_phase1a(src, message)
+        elif isinstance(message, Phase2a):
+            self._handle_phase2a(src, message)
+        elif isinstance(message, Phase2aNoopRange):
+            self._handle_phase2a_noop_range(src, message)
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        if phase1a.round < self.round:
+            self.send(src, Nack(round=self.round))
+            return
+        self.round = phase1a.round
+        info = tuple(
+            Phase1bSlotInfo(slot=slot,
+                            vote_round=self.states[slot].vote_round,
+                            vote_value=self.states[slot].vote_value)
+            for slot in self.states.irange(minimum=phase1a.chosen_watermark))
+        self.send(src, Phase1b(group_index=self.acceptor_group_index,
+                               acceptor_index=self.index,
+                               round=self.round, info=info))
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        if phase2a.round < self.round:
+            self.send(self._nack_leader(phase2a.round, phase2a.slot),
+                      Nack(round=self.round))
+            return
+        self.round = phase2a.round
+        self.states[phase2a.slot] = _VoteState(self.round, phase2a.value)
+        self.max_voted_slot = max(self.max_voted_slot, phase2a.slot)
+        self.send(src, Phase2b(group_index=self.acceptor_group_index,
+                               acceptor_index=self.index,
+                               slot=phase2a.slot, round=self.round))
+
+    def _handle_phase2a_noop_range(self, src: Address,
+                                   phase2a: Phase2aNoopRange) -> None:
+        """Vote noop for every slot in the range owned by this acceptor
+        group (Acceptor.scala:237-293)."""
+        if phase2a.round < self.round:
+            self.send(self._nack_leader(phase2a.round,
+                                        phase2a.slot_start_inclusive),
+                      Nack(round=self.round))
+            return
+        self.round = phase2a.round
+        num_groups = len(
+            self.config.acceptor_addresses[self.leader_group_index])
+        stride = self.config.num_leader_groups * num_groups
+        start = phase2a.slot_start_inclusive
+        while (start < phase2a.slot_end_exclusive
+               and ((start // self.config.num_leader_groups) % num_groups)
+               != self.acceptor_group_index):
+            start += self.config.num_leader_groups
+        for slot in range(start, phase2a.slot_end_exclusive, stride):
+            self.states[slot] = _VoteState(self.round, NOOP)
+        self.send(src, Phase2bNoopRange(
+            acceptor_group_index=self.acceptor_group_index,
+            acceptor_index=self.index,
+            slot_start_inclusive=phase2a.slot_start_inclusive,
+            slot_end_exclusive=phase2a.slot_end_exclusive,
+            round=self.round))
