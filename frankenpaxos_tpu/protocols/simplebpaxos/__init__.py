@@ -1,0 +1,35 @@
+"""Simple BPaxos: disaggregated generalized consensus.
+
+Reference behavior: simplebpaxos/ (~2,200 LoC Scala; SURVEY.md section
+2.2). Leaders assign vertices and ask a dependency-service quorum for
+conflicts; per-vertex Paxos (proposers + acceptors) chooses
+(command, deps); replicas execute in dependency-graph SCC order.
+"""
+
+from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+    SimpleBPaxosConfig,
+    VertexId,
+    VertexIdPrefixSet,
+)
+from frankenpaxos_tpu.protocols.simplebpaxos.replica import (
+    BPaxosClient,
+    BPaxosReplica,
+)
+from frankenpaxos_tpu.protocols.simplebpaxos.roles import (
+    BPaxosAcceptor,
+    BPaxosDepServiceNode,
+    BPaxosLeader,
+    BPaxosProposer,
+)
+
+__all__ = [
+    "BPaxosAcceptor",
+    "BPaxosClient",
+    "BPaxosDepServiceNode",
+    "BPaxosLeader",
+    "BPaxosProposer",
+    "BPaxosReplica",
+    "SimpleBPaxosConfig",
+    "VertexId",
+    "VertexIdPrefixSet",
+]

@@ -1,0 +1,175 @@
+"""Simple GC BPaxos: SimpleBPaxos plus vertex garbage collection.
+
+Reference behavior: simplegcbpaxos/ (GarbageCollector.scala:56-180,
+Proposer.scala:599-626, Acceptor.scala:269-287, Replica.scala:500-600,
+DepServiceNode GC). Replicas gossip their executed frontier (a
+per-leader watermark vector) to GarbageCollector nodes every N
+executions; collectors relay GarbageCollect to proposers, acceptors, and
+dep service nodes, which fold the frontiers into an f+1
+QuorumWatermarkVector and prune all per-vertex state below the quorum
+watermark -- once f+1 replicas have executed a vertex, its consensus
+state is unrecoverable-needed and reclaimable.
+
+(The reference also supports snapshot commands, CommitSnapshot, for
+replicas that fall far behind; here recovery below the GC watermark is
+handled by the noop-recovery path instead. Snapshot-command parity is a
+round-2 item.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.utils.watermark import QuorumWatermarkVector
+from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+    SimpleBPaxosConfig,
+    VertexId,
+)
+from frankenpaxos_tpu.protocols.simplebpaxos.replica import BPaxosReplica
+from frankenpaxos_tpu.protocols.simplebpaxos.roles import (
+    BPaxosAcceptor,
+    BPaxosDepServiceNode,
+    BPaxosProposer,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GcBPaxosConfig(SimpleBPaxosConfig):
+    garbage_collector_addresses: tuple = ()
+
+    def check_valid(self) -> None:
+        super().check_valid()
+        if len(self.garbage_collector_addresses) \
+                != len(self.replica_addresses):
+            raise ValueError("collectors must mirror replicas")
+
+
+@dataclasses.dataclass(frozen=True)
+class GarbageCollect:
+    replica_index: int
+    frontier: tuple[int, ...]  # per-leader executed watermark vector
+
+
+class GarbageCollector(Actor):
+    """Relays GarbageCollect to proposers, acceptors, and dep nodes
+    (GarbageCollector.scala:56-180)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: GcBPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, GarbageCollect):
+            self.logger.fatal(f"unexpected collector message {message!r}")
+        for dst in (tuple(self.config.proposer_addresses)
+                    + tuple(self.config.acceptor_addresses)
+                    + tuple(self.config.dep_service_node_addresses)):
+            self.send(dst, message)
+
+
+class _GcWatermarkMixin:
+    """Fold GarbageCollect frontiers into an f+1 quorum watermark vector
+    and prune per-vertex state below it."""
+
+    def _init_gc(self, config: GcBPaxosConfig) -> None:
+        self._gc_vector = QuorumWatermarkVector(
+            n=len(config.replica_addresses),
+            depth=len(config.leader_addresses))
+        self.gc_watermark = [0] * len(config.leader_addresses)
+
+    def _handle_garbage_collect(self, message: GarbageCollect) -> None:
+        self._gc_vector.update(message.replica_index, message.frontier)
+        self.gc_watermark = self._gc_vector.watermark(
+            quorum_size=self.config.f + 1)
+        self._prune()
+
+    def _collectable(self, vertex_id: VertexId) -> bool:
+        return vertex_id.instance_number \
+            < self.gc_watermark[vertex_id.replica_index]
+
+    def _prune(self) -> None:
+        for vertex_id in [v for v in self.states if self._collectable(v)]:
+            state = self.states.pop(vertex_id)
+            resend = getattr(state, "resend", None)
+            if resend is not None:
+                resend.stop()
+
+
+class GcBPaxosProposer(_GcWatermarkMixin, BPaxosProposer):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._init_gc(self.config)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, GarbageCollect):
+            self._handle_garbage_collect(message)
+            return
+        super().receive(src, message)
+
+
+class GcBPaxosAcceptor(_GcWatermarkMixin, BPaxosAcceptor):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._init_gc(self.config)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, GarbageCollect):
+            self._handle_garbage_collect(message)
+            return
+        super().receive(src, message)
+
+
+class GcBPaxosDepServiceNode(BPaxosDepServiceNode):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._gc_vector = QuorumWatermarkVector(
+            n=len(self.config.replica_addresses),
+            depth=len(self.config.leader_addresses))
+        self.gc_watermark = [0] * len(self.config.leader_addresses)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, GarbageCollect):
+            self._gc_vector.update(message.replica_index, message.frontier)
+            self.gc_watermark = self._gc_vector.watermark(
+                quorum_size=self.config.f + 1)
+            for vertex_id in [
+                    v for v in self.dependencies_cache
+                    if v.instance_number
+                    < self.gc_watermark[v.replica_index]]:
+                del self.dependencies_cache[vertex_id]
+                # Top-k conflict indexes don't support removal; stale
+                # entries only add extra dependencies, which is safe
+                # (DepServiceNode "fast conflict indexes don't remove").
+            return
+        super().receive(src, message)
+
+
+class GcBPaxosReplica(BPaxosReplica):
+    """Gossips its executed frontier every N executions
+    (Replica.scala:575-600)."""
+
+    def __init__(self, *args, send_gc_every_n: int = 10, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.send_gc_every_n = send_gc_every_n
+        self._since_gc_send = 0
+        num_leaders = len(self.config.leader_addresses)
+        # Contiguous executed prefix per leader column.
+        self._frontier = [0] * num_leaders
+
+    def _execute(self, vertex_id: VertexId, value) -> None:
+        super()._execute(vertex_id, value)
+        # Advance the contiguous frontier for the vertex's column.
+        column = vertex_id.replica_index
+        executed = self.dependency_graph.executed
+        while VertexId(column, self._frontier[column]) in executed:
+            self._frontier[column] += 1
+        self._since_gc_send += 1
+        if self._since_gc_send >= self.send_gc_every_n:
+            self._since_gc_send = 0
+            self.send(self.config.garbage_collector_addresses[self.index],
+                      GarbageCollect(replica_index=self.index,
+                                     frontier=tuple(self._frontier)))

@@ -1,0 +1,573 @@
+"""Unanimous BPaxos: fast-path BPaxos with unanimous dependency quorums.
+
+Reference behavior: unanimousbpaxos/ (Config.scala: fast quorum = n =
+2f+1; Leader.scala:35-900, DepServiceNode.scala:25-185,
+Acceptor.scala:21-280, Client.scala). Flow:
+
+  * leader assigns a vertex and broadcasts DependencyRequest to all dep
+    service nodes; dep node i computes conflicts and forwards a
+    FastProposal(command, deps) to its colocated acceptor i, which votes
+    in the implicit fast round 0 and replies Phase2bFast to the leader;
+  * if all n acceptors voted identical dependencies, the value is chosen
+    (the unanimous fast path); otherwise the leader performs coordinated
+    recovery: it skips phase 1 and proposes the union of deps in round 1;
+  * stuck vertices recover through classic phase 1/2 rounds;
+  * leaders double as replicas: committed vertices execute locally in
+    dependency-graph order and the owning leader replies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable, Executed
+from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+    Command,
+    Noop,
+    NOOP,
+    VertexId,
+)
+from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
+
+
+@dataclasses.dataclass(frozen=True)
+class UnanimousBPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    dep_service_node_addresses: tuple
+    acceptor_addresses: tuple
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def classic_quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def fast_quorum_size(self) -> int:
+        return self.n
+
+    def check_valid(self) -> None:
+        if len(self.leader_addresses) != self.f + 1:
+            raise ValueError("need exactly f+1 leaders")
+        if len(self.dep_service_node_addresses) != self.n:
+            raise ValueError("need 2f+1 dep service nodes")
+        if len(self.acceptor_addresses) != self.n:
+            raise ValueError("need 2f+1 acceptors")
+
+
+@dataclasses.dataclass(frozen=True)
+class VoteValue:
+    command_or_noop: Union[Command, Noop]
+    dependencies: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class DependencyRequest:
+    vertex_id: VertexId
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class FastProposal:
+    vertex_id: VertexId
+    value: VoteValue
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2bFast:
+    vertex_id: VertexId
+    acceptor_id: int
+    vote_value: VoteValue
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    vertex_id: VertexId
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    vertex_id: VertexId
+    acceptor_id: int
+    round: int
+    vote_round: int
+    vote_value: Optional[VoteValue]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    vertex_id: VertexId
+    round: int
+    vote_value: VoteValue
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2bClassic:
+    vertex_id: VertexId
+    acceptor_id: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Nack:
+    vertex_id: VertexId
+    higher_round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit:
+    vertex_id: VertexId
+    value: VoteValue
+
+
+@dataclasses.dataclass
+class _Phase2Fast:
+    command: Command
+    phase2b_fasts: dict[int, Phase2bFast]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Phase1:
+    round: int
+    value: VoteValue
+    phase1bs: dict[int, Phase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Phase2Classic:
+    round: int
+    value: VoteValue
+    phase2bs: dict[int, Phase2bClassic]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Committed:
+    value: VoteValue
+
+
+class UnanimousBPaxosLeader(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: UnanimousBPaxosConfig,
+                 state_machine: StateMachine,
+                 resend_period_s: float = 10.0,
+                 recover_min_period_s: float = 20.0,
+                 recover_max_period_s: float = 40.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.recover_min = recover_min_period_s
+        self.recover_max = recover_max_period_s
+        self.index = list(config.leader_addresses).index(address)
+        self.next_vertex_id = 0
+        self.states: dict[VertexId, object] = {}
+        self.dependency_graph = TarjanDependencyGraph()
+        self.client_table: ClientTable = ClientTable()
+        self.recover_vertex_timers: dict[VertexId, object] = {}
+        self.executed_count = 0
+
+    def _round_system(self, vertex_id: VertexId):
+        # The vertex owner leads rounds 0 and 1 (coordinated recovery).
+        return RotatedClassicRoundRobin(len(self.config.leader_addresses),
+                                        vertex_id.replica_index)
+
+    def _make_resend_timer(self, name: str, targets, message) -> object:
+        def resend():
+            for dst in targets:
+                self.send(dst, message)
+            timer.start()
+
+        timer = self.timer(name, self.resend_period_s, resend)
+        timer.start()
+        return timer
+
+    def _stop_timers(self, vertex_id: VertexId) -> None:
+        state = self.states.get(vertex_id)
+        if state is not None and hasattr(state, "resend"):
+            state.resend.stop()
+
+    # --- commit + execution ----------------------------------------------
+    def _commit(self, vertex_id: VertexId, value: VoteValue,
+                inform_others: bool) -> None:
+        if isinstance(self.states.get(vertex_id), _Committed):
+            return
+        self._stop_timers(vertex_id)
+        self.states[vertex_id] = _Committed(value)
+        timer = self.recover_vertex_timers.pop(vertex_id, None)
+        if timer is not None:
+            timer.stop()
+        if inform_others:
+            for leader in self.config.leader_addresses:
+                if leader != self.address:
+                    self.send(leader, Commit(vertex_id, value))
+        self.dependency_graph.commit(vertex_id, 0, set(value.dependencies))
+        executables, blockers = self.dependency_graph.execute(1)
+        for blocked in blockers:
+            if blocked not in self.recover_vertex_timers:
+                self.recover_vertex_timers[blocked] = \
+                    self._make_recover_timer(blocked)
+        for v in executables:
+            committed = self.states.get(v)
+            if not isinstance(committed, _Committed):
+                self.logger.fatal(f"{v} executable but not committed")
+            self._execute(v, committed.value)
+
+    def _execute(self, vertex_id: VertexId, value: VoteValue) -> None:
+        if isinstance(value.command_or_noop, Noop):
+            return
+        command = value.command_or_noop
+        identity = (command.client_address, command.client_pseudonym)
+        if self.client_table.executed(identity,
+                                      command.client_id) is not NOT_EXECUTED:
+            return
+        output = self.state_machine.run(command.command)
+        self.client_table.execute(identity, command.client_id, output)
+        self.executed_count += 1
+        if vertex_id.replica_index == self.index:
+            self.send(command.client_address, ClientReply(
+                client_pseudonym=command.client_pseudonym,
+                client_id=command.client_id, result=output))
+
+    def _make_recover_timer(self, vertex_id: VertexId) -> object:
+        def fire():
+            self._recover_vertex(vertex_id)
+            timer.start()
+
+        timer = self.timer(f"recoverVertex {vertex_id}",
+                           self.rng.uniform(self.recover_min,
+                                            self.recover_max), fire)
+        timer.start()
+        return timer
+
+    def _recover_vertex(self, vertex_id: VertexId) -> None:
+        """Classic phase 1 in a round we own (Leader.scala:280-330)."""
+        state = self.states.get(vertex_id)
+        if isinstance(state, (_Committed, _Phase1, _Phase2Classic)):
+            return
+        round = self._round_system(vertex_id).next_classic_round(
+            self.index, 1)
+        self._stop_timers(vertex_id)
+        phase1a = Phase1a(vertex_id=vertex_id, round=round)
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, phase1a)
+        self.states[vertex_id] = _Phase1(
+            round, VoteValue(NOOP, frozenset()), {},
+            self._make_resend_timer(f"resendPhase1a {vertex_id}",
+                                    self.config.acceptor_addresses,
+                                    phase1a))
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientRequest):
+            self._handle_client_request(src, message)
+        elif isinstance(message, Phase2bFast):
+            self._handle_phase2b_fast(src, message)
+        elif isinstance(message, Phase1b):
+            self._handle_phase1b(src, message)
+        elif isinstance(message, Phase2bClassic):
+            self._handle_phase2b_classic(src, message)
+        elif isinstance(message, Nack):
+            self._handle_nack(src, message)
+        elif isinstance(message, Commit):
+            self._commit(message.vertex_id, message.value,
+                         inform_others=False)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        command = request.command
+        identity = (command.client_address, command.client_pseudonym)
+        executed = self.client_table.executed(identity, command.client_id)
+        if isinstance(executed, Executed):
+            if executed.output is not None:
+                self.send(src, ClientReply(command.client_pseudonym,
+                                           command.client_id,
+                                           executed.output))
+            return
+        vertex_id = VertexId(self.index, self.next_vertex_id)
+        self.next_vertex_id += 1
+        dep_request = DependencyRequest(vertex_id, command)
+        for node in self.config.dep_service_node_addresses:
+            self.send(node, dep_request)
+        self.states[vertex_id] = _Phase2Fast(
+            command, {},
+            self._make_resend_timer(
+                f"resendDeps {vertex_id}",
+                self.config.dep_service_node_addresses, dep_request))
+        self.recover_vertex_timers[vertex_id] = \
+            self._make_recover_timer(vertex_id)
+
+    def _handle_phase2b_fast(self, src: Address,
+                             phase2b: Phase2bFast) -> None:
+        state = self.states.get(phase2b.vertex_id)
+        if not isinstance(state, _Phase2Fast):
+            return
+        state.phase2b_fasts[phase2b.acceptor_id] = phase2b
+        if len(state.phase2b_fasts) < self.config.fast_quorum_size:
+            return
+        deps_set = {v.vote_value.dependencies
+                    for v in state.phase2b_fasts.values()}
+        if len(deps_set) == 1:
+            # Unanimous: fast-path commit.
+            self._commit(phase2b.vertex_id,
+                         VoteValue(state.command, next(iter(deps_set))),
+                         inform_others=True)
+            return
+        # Coordinated recovery: skip phase 1, propose the union in round 1
+        # (Leader.scala:660-695).
+        union = frozenset().union(*deps_set)
+        value = VoteValue(state.command, union)
+        state.resend.stop()
+        phase2a = Phase2a(phase2b.vertex_id, 1, value)
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, phase2a)
+        self.states[phase2b.vertex_id] = _Phase2Classic(
+            1, value, {},
+            self._make_resend_timer(f"resendPhase2a {phase2b.vertex_id}",
+                                    self.config.acceptor_addresses,
+                                    phase2a))
+        timer = self.recover_vertex_timers.pop(phase2b.vertex_id, None)
+        if timer is not None:
+            timer.stop()
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        state = self.states.get(phase1b.vertex_id)
+        if not isinstance(state, _Phase1):
+            return
+        if phase1b.round != state.round:
+            return
+        state.phase1bs[phase1b.acceptor_id] = phase1b
+        if len(state.phase1bs) < self.config.classic_quorum_size:
+            return
+        max_vote_round = max(r.vote_round for r in state.phase1bs.values())
+        if max_vote_round >= 0:
+            value = next(r.vote_value for r in state.phase1bs.values()
+                         if r.vote_round == max_vote_round)
+        else:
+            value = VoteValue(NOOP, frozenset())
+        state.resend.stop()
+        phase2a = Phase2a(phase1b.vertex_id, state.round, value)
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, phase2a)
+        self.states[phase1b.vertex_id] = _Phase2Classic(
+            state.round, value, {},
+            self._make_resend_timer(f"resendPhase2a {phase1b.vertex_id}",
+                                    self.config.acceptor_addresses,
+                                    phase2a))
+
+    def _handle_phase2b_classic(self, src: Address,
+                                phase2b: Phase2bClassic) -> None:
+        state = self.states.get(phase2b.vertex_id)
+        if not isinstance(state, _Phase2Classic):
+            return
+        if phase2b.round != state.round:
+            return
+        state.phase2bs[phase2b.acceptor_id] = phase2b
+        if len(state.phase2bs) < self.config.classic_quorum_size:
+            return
+        self._commit(phase2b.vertex_id, state.value, inform_others=True)
+
+    def _handle_nack(self, src: Address, nack: Nack) -> None:
+        state = self.states.get(nack.vertex_id)
+        if state is None or isinstance(state, _Committed):
+            return
+        round = getattr(state, "round", 0)
+        if nack.higher_round <= round:
+            return
+        new_round = self._round_system(nack.vertex_id).next_classic_round(
+            self.index, nack.higher_round)
+        self._stop_timers(nack.vertex_id)
+        value = getattr(state, "value", None)
+        if value is None:  # was Phase2Fast
+            value = VoteValue(state.command, frozenset())
+        phase1a = Phase1a(nack.vertex_id, new_round)
+        for acceptor in self.config.acceptor_addresses:
+            self.send(acceptor, phase1a)
+        self.states[nack.vertex_id] = _Phase1(
+            new_round, value, {},
+            self._make_resend_timer(f"resendPhase1a {nack.vertex_id}",
+                                    self.config.acceptor_addresses, phase1a))
+
+
+class UnanimousBPaxosDepServiceNode(Actor):
+    """Computes deps and forwards a FastProposal to its colocated acceptor
+    (DepServiceNode.scala:121-152)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: UnanimousBPaxosConfig,
+                 state_machine: StateMachine):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.dep_service_node_addresses).index(address)
+        self.conflict_index = state_machine.conflict_index()
+        self.dependencies_cache: dict[VertexId, frozenset] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, DependencyRequest):
+            self.logger.fatal(f"unexpected dep node message {message!r}")
+        vertex_id = message.vertex_id
+        dependencies = self.dependencies_cache.get(vertex_id)
+        if dependencies is None:
+            payload = message.command.command
+            dependencies = frozenset(
+                self.conflict_index.get_conflicts(payload)) - {vertex_id}
+            self.conflict_index.put(vertex_id, payload)
+            self.dependencies_cache[vertex_id] = dependencies
+        self.send(self.config.acceptor_addresses[self.index],
+                  FastProposal(vertex_id,
+                               VoteValue(message.command, dependencies)))
+
+
+@dataclasses.dataclass
+class _AcceptorState:
+    round: int = 0
+    vote_round: int = -1
+    vote_value: Optional[VoteValue] = None
+
+
+class UnanimousBPaxosAcceptor(Actor):
+    """(Acceptor.scala:21-280): implicit any in round 0."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: UnanimousBPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.states: dict[VertexId, _AcceptorState] = {}
+
+    def _leader_of(self, vertex_id: VertexId) -> Address:
+        return self.config.leader_addresses[vertex_id.replica_index]
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, FastProposal):
+            self._handle_fast_proposal(src, message)
+        elif isinstance(message, Phase1a):
+            self._handle_phase1a(src, message)
+        elif isinstance(message, Phase2a):
+            self._handle_phase2a(src, message)
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+    def _handle_fast_proposal(self, src: Address,
+                              proposal: FastProposal) -> None:
+        state = self.states.get(proposal.vertex_id)
+        if state is None:
+            self.states[proposal.vertex_id] = _AcceptorState(
+                round=0, vote_round=0, vote_value=proposal.value)
+            self.send(self._leader_of(proposal.vertex_id),
+                      Phase2bFast(vertex_id=proposal.vertex_id,
+                                  acceptor_id=self.index,
+                                  vote_value=proposal.value))
+        elif state.round > 0:
+            self.send(self._leader_of(proposal.vertex_id),
+                      Nack(proposal.vertex_id, state.round))
+        # Already voted in round 0: ignore.
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        state = self.states.setdefault(phase1a.vertex_id, _AcceptorState())
+        if phase1a.round < state.round:
+            self.send(src, Nack(phase1a.vertex_id, state.round))
+            return
+        state.round = phase1a.round
+        self.send(src, Phase1b(vertex_id=phase1a.vertex_id,
+                               acceptor_id=self.index, round=phase1a.round,
+                               vote_round=state.vote_round,
+                               vote_value=state.vote_value))
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        state = self.states.setdefault(phase2a.vertex_id, _AcceptorState())
+        if phase2a.round < state.round:
+            self.send(src, Nack(phase2a.vertex_id, state.round))
+            return
+        state.round = phase2a.round
+        state.vote_round = phase2a.round
+        state.vote_value = phase2a.vote_value
+        self.send(src, Phase2bClassic(vertex_id=phase2a.vertex_id,
+                                      acceptor_id=self.index,
+                                      round=phase2a.round))
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class UnanimousBPaxosClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: UnanimousBPaxosConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.ids: dict[int, int] = {}
+        self.pending: dict[int, _Pending] = {}
+
+    def propose(self, pseudonym: int, command: bytes,
+                callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self.pending:
+            raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(Command(self.address, pseudonym, id,
+                                        command))
+        leader = self.config.leader_addresses[
+            self.rng.randrange(len(self.config.leader_addresses))]
+        self.send(leader, request)
+
+        def resend():
+            target = self.config.leader_addresses[
+                self.rng.randrange(len(self.config.leader_addresses))]
+            self.send(target, request)
+            timer.start()
+
+        timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.pending[pseudonym] = _Pending(id, command,
+                                           callback or (lambda _: None),
+                                           timer)
+        self.ids[pseudonym] = id + 1
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.get(message.client_pseudonym)
+        if pending is None or pending.id != message.client_id:
+            return
+        pending.resend.stop()
+        del self.pending[message.client_pseudonym]
+        pending.callback(message.result)

@@ -1,0 +1,185 @@
+"""SimpleBPaxos: integration + property-based simulation."""
+
+import random
+from typing import Optional
+
+import pytest
+
+from frankenpaxos_tpu.runtime import (
+    FakeLogger,
+    LogLevel,
+    PickleSerializer,
+    SimTransport,
+)
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
+from frankenpaxos_tpu.protocols.simplebpaxos import (
+    BPaxosAcceptor,
+    BPaxosClient,
+    BPaxosDepServiceNode,
+    BPaxosLeader,
+    BPaxosProposer,
+    BPaxosReplica,
+    SimpleBPaxosConfig,
+)
+
+SER = PickleSerializer()
+
+
+def make_bpaxos(f=1, num_clients=1, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    n = 2 * f + 1
+    config = SimpleBPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        proposer_addresses=tuple(f"proposer-{i}" for i in range(f + 1)),
+        dep_service_node_addresses=tuple(f"dep-{i}" for i in range(n)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)),
+        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)))
+    leaders = [BPaxosLeader(a, transport, logger, config, seed=seed + i)
+               for i, a in enumerate(config.leader_addresses)]
+    proposers = [BPaxosProposer(a, transport, logger, config,
+                                seed=seed + 10 + i)
+                 for i, a in enumerate(config.proposer_addresses)]
+    dep_nodes = [BPaxosDepServiceNode(a, transport, logger, config,
+                                      KeyValueStore())
+                 for a in config.dep_service_node_addresses]
+    acceptors = [BPaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    replicas = [BPaxosReplica(a, transport, logger, config,
+                              KeyValueStore(), seed=seed + 30 + i)
+                for i, a in enumerate(config.replica_addresses)]
+    clients = [BPaxosClient(f"client-{i}", transport, logger, config,
+                            seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return transport, config, replicas, clients
+
+
+class TestSimpleBPaxos:
+    def test_single_command(self):
+        transport, _, replicas, clients = make_bpaxos()
+        got = []
+        clients[0].propose(0, SER.to_bytes(SetRequest((("k", "v"),))),
+                           got.append)
+        transport.deliver_all()
+        assert len(got) == 1
+        for replica in replicas:
+            assert replica.state_machine.get() == {"k": "v"}
+
+    def test_sequential_commands(self):
+        transport, _, replicas, clients = make_bpaxos()
+        got = []
+        for i in range(5):
+            clients[0].propose(0, SER.to_bytes(SetRequest((("k", str(i)),))),
+                               got.append)
+            transport.deliver_all()
+        assert len(got) == 5
+        for replica in replicas:
+            assert replica.state_machine.get() == {"k": "4"}
+
+    def test_concurrent_conflicting_commands(self):
+        transport, _, replicas, clients = make_bpaxos(num_clients=3)
+        for i, client in enumerate(clients):
+            client.propose(0, SER.to_bytes(SetRequest((("k", str(i)),))))
+        transport.deliver_all()
+        states = [r.state_machine.get() for r in replicas]
+        assert states[0] == states[1]
+
+    def test_read_after_write(self):
+        transport, _, replicas, clients = make_bpaxos()
+        clients[0].propose(0, SER.to_bytes(SetRequest((("x", "9"),))))
+        transport.deliver_all()
+        got = []
+        clients[0].propose(0, SER.to_bytes(GetRequest(("x",))),
+                           lambda r: got.append(SER.from_bytes(r)))
+        transport.deliver_all()
+        assert got and got[0].key_values == (("x", "9"),)
+
+    def test_f2(self):
+        transport, _, replicas, clients = make_bpaxos(f=2)
+        got = []
+        clients[0].propose(0, SER.to_bytes(SetRequest((("k", "v"),))),
+                           got.append)
+        transport.deliver_all()
+        assert len(got) == 1
+
+
+class ProposeCmd:
+    def __init__(self, client, pseudonym, key, value):
+        self.client = client
+        self.pseudonym = pseudonym
+        self.key = key
+        self.value = value
+
+    def __repr__(self):
+        return (f"Propose({self.client}, {self.pseudonym}, "
+                f"{self.key}={self.value})")
+
+
+class TransportCmd:
+    def __init__(self, command):
+        self.command = command
+
+    def __repr__(self):
+        return f"Transport({self.command!r})"
+
+
+class BPaxosSimulated(SimulatedSystem):
+    """Invariant: replicas agree on committed (value, deps) per vertex."""
+
+    KEYS = ["a", "b"]
+
+    def new_system(self, seed):
+        transport, config, replicas, clients = make_bpaxos(
+            num_clients=2, seed=seed)
+        return dict(transport=transport, replicas=replicas,
+                    clients=clients, counter=0)
+
+    def generate_command(self, system, rng: random.Random):
+        choices = []
+        idle = [(c, p) for c, client in enumerate(system["clients"])
+                for p in (0, 1) if p not in client.pending]
+        if idle:
+            choices.append("propose")
+        transport_cmd = system["transport"].generate_command(rng)
+        if transport_cmd is not None:
+            choices.extend(["transport"] * 6)
+        if not choices:
+            return None
+        if rng.choice(choices) == "propose":
+            client, pseudonym = rng.choice(idle)
+            system["counter"] += 1
+            return ProposeCmd(client, pseudonym, rng.choice(self.KEYS),
+                              str(system["counter"]))
+        return TransportCmd(transport_cmd)
+
+    def run_command(self, system, command):
+        if isinstance(command, ProposeCmd):
+            client = system["clients"][command.client]
+            if command.pseudonym not in client.pending:
+                client.propose(command.pseudonym, SER.to_bytes(
+                    SetRequest(((command.key, command.value),))))
+        else:
+            system["transport"].run_command(command.command)
+        return system
+
+    def state_invariant(self, system) -> Optional[str]:
+        per_vertex: dict = {}
+        for replica in system["replicas"]:
+            for vertex_id, committed in replica.commands.items():
+                value = (committed.command_or_noop,
+                         tuple(sorted(committed.dependencies.materialize())))
+                if vertex_id in per_vertex:
+                    if per_vertex[vertex_id] != value:
+                        return (f"replicas disagree on {vertex_id}: "
+                                f"{per_vertex[vertex_id]} vs {value}")
+                else:
+                    per_vertex[vertex_id] = value
+        return None
+
+
+def test_simulation_committed_agreement():
+    failure = Simulator(BPaxosSimulated(), run_length=120, num_runs=15
+                        ).run(seed=0)
+    assert failure is None, str(failure)

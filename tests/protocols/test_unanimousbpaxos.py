@@ -1,0 +1,92 @@
+"""UnanimousBPaxos: fast path, slow path on dependency disagreement."""
+
+from frankenpaxos_tpu.runtime import (
+    FakeLogger,
+    LogLevel,
+    PickleSerializer,
+    SimTransport,
+)
+from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
+from frankenpaxos_tpu.protocols.unanimousbpaxos import (
+    UnanimousBPaxosAcceptor,
+    UnanimousBPaxosClient,
+    UnanimousBPaxosConfig,
+    UnanimousBPaxosDepServiceNode,
+    UnanimousBPaxosLeader,
+)
+
+SER = PickleSerializer()
+
+
+def make_unanimous(f=1, num_clients=1, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    n = 2 * f + 1
+    config = UnanimousBPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        dep_service_node_addresses=tuple(f"dep-{i}" for i in range(n)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)))
+    leaders = [UnanimousBPaxosLeader(a, transport, logger, config,
+                                     KeyValueStore(), seed=seed + i)
+               for i, a in enumerate(config.leader_addresses)]
+    dep_nodes = [UnanimousBPaxosDepServiceNode(a, transport, logger, config,
+                                               KeyValueStore())
+                 for a in config.dep_service_node_addresses]
+    acceptors = [UnanimousBPaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    clients = [UnanimousBPaxosClient(f"client-{i}", transport, logger,
+                                     config, seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return transport, config, leaders, clients
+
+
+def test_fast_path_single_command():
+    transport, _, leaders, clients = make_unanimous()
+    got = []
+    clients[0].propose(0, SER.to_bytes(SetRequest((("k", "v"),))),
+                       got.append)
+    transport.deliver_all()
+    assert len(got) == 1
+    # All leaders executed identically.
+    states = [l.state_machine.get() for l in leaders]
+    assert all(s == {"k": "v"} for s in states)
+
+
+def test_sequential_commands():
+    transport, _, leaders, clients = make_unanimous()
+    got = []
+    for i in range(5):
+        clients[0].propose(0, SER.to_bytes(SetRequest((("k", str(i)),))),
+                           got.append)
+        transport.deliver_all()
+    assert len(got) == 5
+    assert all(l.state_machine.get() == {"k": "4"} for l in leaders)
+
+
+def test_conflicting_concurrent_commands_converge():
+    transport, _, leaders, clients = make_unanimous(num_clients=3)
+    for i, client in enumerate(clients):
+        client.propose(0, SER.to_bytes(SetRequest((("k", str(i)),))))
+    transport.deliver_all()
+    # Pump recover/resend timers in case a slow path stalls.
+    for _ in range(10):
+        done = all(not c.pending for c in clients)
+        if done:
+            break
+        for timer in transport.running_timers():
+            transport.trigger_timer(timer.id)
+        transport.deliver_all()
+    states = [l.state_machine.get() for l in leaders]
+    assert states[0] == states[1]
+
+
+def test_read_after_write():
+    transport, _, leaders, clients = make_unanimous()
+    clients[0].propose(0, SER.to_bytes(SetRequest((("x", "3"),))))
+    transport.deliver_all()
+    got = []
+    clients[0].propose(0, SER.to_bytes(GetRequest(("x",))),
+                       lambda r: got.append(SER.from_bytes(r)))
+    transport.deliver_all()
+    assert got and got[0].key_values == (("x", "3"),)
