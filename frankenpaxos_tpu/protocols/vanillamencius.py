@@ -1,0 +1,609 @@
+"""Vanilla (coupled) Mencius: one Server role with skips and revocation.
+
+Reference behavior: vanillamencius/ (Server.scala:36-1180, Config.scala:
+2f+1 servers + mirrored heartbeats). Every server owns the slots
+congruent to its index. A client request is voted locally and Phase2a'd
+to the others in round 0 ("simple consensus" per slot). Key mechanics:
+
+  * skips (Server.scala:668-700): when a server learns of a slot beyond
+    its frontier, it chooses noops in all its owned slots up to it and
+    lazily broadcasts the skipped range (piggybacked on the next Phase2a
+    or flushed by a timer);
+  * revocation (Server.scala:390-430): if the heartbeat declares a
+    server dead and its unchosen frontier lags, a peer revokes a range
+    of the dead server's slots: Phase1a over the range in a round it
+    owns, then proposes the highest votes / noops;
+  * execution: in-order executeLog with a client table; only the slot
+    owner replies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions, HeartbeatParticipant
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+
+
+@dataclasses.dataclass(frozen=True)
+class VanillaMenciusConfig:
+    f: int
+    server_addresses: tuple
+    heartbeat_addresses: tuple
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.server_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 servers")
+        if len(self.heartbeat_addresses) != len(self.server_addresses):
+            raise ValueError("heartbeats must mirror servers")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Noop:
+    pass
+
+
+NOOP = Noop()
+CommandOrNoop = Union[Command, Noop]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+    start_slot_inclusive: int
+    stop_slot_exclusive: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PendingSlotInfo:
+    vote_round: int
+    vote_value: CommandOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class ChosenSlotInfo:
+    value: CommandOrNoop
+    is_revocation: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1bSlotInfo:
+    slot: int
+    info: Union[PendingSlotInfo, ChosenSlotInfo]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    server_index: int
+    round: int
+    start_slot_inclusive: int
+    stop_slot_exclusive: int
+    info: tuple[Phase1bSlotInfo, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    sending_server: int
+    slot: int
+    round: int
+    value: CommandOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Skip:
+    server_index: int
+    start_slot_inclusive: int
+    stop_slot_exclusive: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    server_index: int
+    slot: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    value: CommandOrNoop
+    is_revocation: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1Nack:
+    start_slot_inclusive: int
+    stop_slot_exclusive: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2Nack:
+    slot: int
+    round: int
+
+
+# Log entries (Server.scala:207-230).
+@dataclasses.dataclass
+class VotelessEntry:
+    round: int
+
+
+@dataclasses.dataclass
+class PendingEntry:
+    round: int
+    vote_round: int
+    vote_value: CommandOrNoop
+
+
+@dataclasses.dataclass
+class ChosenEntry:
+    value: CommandOrNoop
+    is_revocation: bool
+
+
+@dataclasses.dataclass
+class _Phase1State:
+    start_slot_inclusive: int
+    stop_slot_exclusive: int
+    round: int
+    phase1bs: dict[int, Phase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Phase2State:
+    round: int
+    value: CommandOrNoop
+    is_revocation: bool
+    phase2bs: set[int]
+
+
+class VanillaMenciusServer(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: VanillaMenciusConfig,
+                 state_machine: StateMachine, beta: int = 10,
+                 revoke_min_period_s: float = 30.0,
+                 revoke_max_period_s: float = 60.0,
+                 flush_skip_slots_period_s: float = 1.0,
+                 resend_phase1as_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.beta = beta
+        self.resend_phase1as_period_s = resend_phase1as_period_s
+        self.index = list(config.server_addresses).index(address)
+        self.other_servers = [a for a in config.server_addresses
+                              if a != address]
+        n = len(config.server_addresses)
+        self.slot_system = ClassicRoundRobin(n)
+        self.round_system = ClassicRoundRobin(n)
+        self.log: BufferMap = BufferMap()
+        self.executed_watermark = 0
+        self.client_table: dict[tuple, tuple[int, bytes]] = {}
+        self.next_slot = self.slot_system.next_classic_round(self.index, -1)
+        self.skip_slots: Optional[tuple[int, int]] = None
+        self.recover_round = self.round_system.next_classic_round(
+            self.index, 0)
+        self.phase1s: dict[int, _Phase1State] = {}
+        self.phase2s: dict[int, _Phase2State] = {}
+        self.largest_chosen_prefix_slots = [-1] * n
+
+        self.heartbeat = HeartbeatParticipant(
+            config.heartbeat_addresses[self.index], transport, logger,
+            list(config.heartbeat_addresses), HeartbeatOptions())
+        self.flush_skip_slots_timer = self.timer(
+            "flushSkipSlots", flush_skip_slots_period_s, self._flush_skips)
+        self.revocation_timers = {}
+        for i in range(n):
+            if i != self.index:
+                self.revocation_timers[i] = self._make_revocation_timer(
+                    i, revoke_min_period_s, revoke_max_period_s)
+
+    # --- helpers ----------------------------------------------------------
+    def _make_revocation_timer(self, revoked: int, min_s: float,
+                               max_s: float) -> object:
+        def fire():
+            first_unchosen = self.slot_system.next_classic_round(
+                revoked, self.largest_chosen_prefix_slots[revoked])
+            alive = self.heartbeat.unsafe_alive()
+            if self.config.heartbeat_addresses[revoked] in alive:
+                timer.start()
+            elif first_unchosen >= self.next_slot + self.beta:
+                timer.start()
+            else:
+                self._start_revocation(revoked, first_unchosen,
+                                       self.next_slot + 2 * self.beta)
+                # Timer restarts when the revocation finishes.
+
+        timer = self.timer(f"revocation-{revoked}",
+                           self.rng.uniform(min_s, max_s), fire)
+        timer.start()
+        return timer
+
+    def _start_revocation(self, revoked: int, start: int, stop: int) -> None:
+        phase1a = Phase1a(round=self.recover_round,
+                          start_slot_inclusive=start,
+                          stop_slot_exclusive=stop)
+        for server in self.config.server_addresses:
+            self.send(server, phase1a)
+
+        def resend():
+            for server in self.config.server_addresses:
+                self.send(server, phase1a)
+            timer.start()
+
+        timer = self.timer(f"resendPhase1as-{revoked}",
+                           self.resend_phase1as_period_s, resend)
+        timer.start()
+        self.phase1s[revoked] = _Phase1State(
+            start_slot_inclusive=start, stop_slot_exclusive=stop,
+            round=self.recover_round, phase1bs={}, resend=timer)
+        self.recover_round = self.round_system.next_classic_round(
+            self.index, self.recover_round)
+
+    def _flush_skips(self) -> None:
+        if self.skip_slots is None:
+            return
+        start, stop = self.skip_slots
+        for server in self.other_servers:
+            self.send(server, Skip(server_index=self.index,
+                                   start_slot_inclusive=start,
+                                   stop_slot_exclusive=stop))
+        self.skip_slots = None
+
+    def _is_chosen(self, slot: int) -> bool:
+        return isinstance(self.log.get(slot), ChosenEntry)
+
+    def _advance_with_skips(self, slot: int) -> None:
+        """Advance our frontier past ``slot``, choosing noops in our owned
+        slots along the way (Server.scala:668-700)."""
+        if self.next_slot > slot:
+            return
+        new_stop = slot + 1 if self.slot_system.leader(slot) == self.index \
+            else slot
+        if self.skip_slots is None:
+            self.flush_skip_slots_timer.start()
+            self.skip_slots = (self.next_slot, new_stop)
+        else:
+            self.skip_slots = (self.skip_slots[0], new_stop)
+        while self.next_slot < new_stop:
+            self.log.put(self.next_slot,
+                         ChosenEntry(NOOP, is_revocation=False))
+            self.next_slot = self.slot_system.next_classic_round(
+                self.index, self.next_slot)
+
+    def _choose(self, slot: int, value: CommandOrNoop,
+                is_revocation: bool) -> None:
+        self.log.put(slot, ChosenEntry(value, is_revocation))
+        self.phase2s.pop(slot, None)
+        owner = self.slot_system.leader(slot)
+        if owner != self.index:
+            frontier = self.slot_system.next_classic_round(
+                owner, self.largest_chosen_prefix_slots[owner])
+            while self._is_chosen(frontier):
+                self.largest_chosen_prefix_slots[owner] = frontier
+                frontier = self.slot_system.next_classic_round(owner,
+                                                               frontier)
+
+    def _execute_command(self, slot: int, command: Command,
+                         reply_if: Callable[[int], bool]) -> None:
+        cid = command.command_id
+        key = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(key)
+        if cached is not None and cid.client_id < cached[0]:
+            return
+        if cached is not None and cid.client_id == cached[0]:
+            self.send(cid.client_address,
+                      ClientReply(command_id=cid, result=cached[1]))
+            return
+        result = self.state_machine.run(command.command)
+        self.client_table[key] = (cid.client_id, result)
+        if reply_if(slot):
+            self.send(cid.client_address,
+                      ClientReply(command_id=cid, result=result))
+
+    def _execute_log(self, reply_if: Callable[[int], bool]) -> None:
+        while True:
+            entry = self.log.get(self.executed_watermark)
+            if not isinstance(entry, ChosenEntry):
+                return
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            if isinstance(entry.value, Command):
+                self._execute_command(slot, entry.value, reply_if)
+
+    def _reply_if_mine(self, slot: int) -> bool:
+        return self.slot_system.leader(slot) == self.index
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        handlers = {
+            ClientRequest: self._handle_client_request,
+            Phase1a: self._handle_phase1a,
+            Phase1b: self._handle_phase1b,
+            Phase2a: self._handle_phase2a,
+            Phase2b: self._handle_phase2b,
+            Skip: self._handle_skip,
+            Chosen: self._handle_chosen,
+            Phase1Nack: self._handle_phase1_nack,
+            Phase2Nack: self._handle_phase2_nack,
+        }
+        handler = handlers.get(type(message))
+        if handler is None:
+            self.logger.fatal(f"unexpected server message {message!r}")
+        handler(src, message)
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        """(Server.scala:767-830)."""
+        value = request.command
+        self.log.put(self.next_slot,
+                     PendingEntry(round=0, vote_round=0, vote_value=value))
+        self._flush_skips()
+        self.flush_skip_slots_timer.stop()
+        phase2a = Phase2a(sending_server=self.index, slot=self.next_slot,
+                          round=0, value=value)
+        for server in self.other_servers:
+            self.send(server, phase2a)
+        self.phase2s[self.next_slot] = _Phase2State(
+            round=0, value=value, is_revocation=False,
+            phase2bs={self.index})
+        self.next_slot = self.slot_system.next_classic_round(
+            self.index, self.next_slot)
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        """(Server.scala:831-915)."""
+        revoked = self.slot_system.leader(phase1a.start_slot_inclusive)
+        if revoked == self.index:
+            # Someone thinks we're dead; fill our slots so every revoked
+            # entry holds something.
+            self._advance_with_skips(phase1a.stop_slot_exclusive - 1)
+            self._execute_log(self._reply_if_mine)
+        infos: list[Phase1bSlotInfo] = []
+        slot = phase1a.start_slot_inclusive
+        while slot < phase1a.stop_slot_exclusive:
+            entry = self.log.get(slot)
+            if entry is None:
+                self.log.put(slot, VotelessEntry(phase1a.round))
+            elif isinstance(entry, VotelessEntry):
+                if phase1a.round < entry.round:
+                    self.send(src, Phase1Nack(
+                        phase1a.start_slot_inclusive,
+                        phase1a.stop_slot_exclusive, entry.round))
+                    return
+                self.log.put(slot, VotelessEntry(phase1a.round))
+            elif isinstance(entry, PendingEntry):
+                if phase1a.round < entry.round:
+                    self.send(src, Phase1Nack(
+                        phase1a.start_slot_inclusive,
+                        phase1a.stop_slot_exclusive, entry.round))
+                    return
+                infos.append(Phase1bSlotInfo(slot, PendingSlotInfo(
+                    entry.vote_round, entry.vote_value)))
+                entry.round = phase1a.round
+            else:
+                infos.append(Phase1bSlotInfo(slot, ChosenSlotInfo(
+                    entry.value, entry.is_revocation)))
+            slot = self.slot_system.next_classic_round(revoked, slot)
+        self.send(src, Phase1b(
+            server_index=self.index, round=phase1a.round,
+            start_slot_inclusive=phase1a.start_slot_inclusive,
+            stop_slot_exclusive=phase1a.stop_slot_exclusive,
+            info=tuple(infos)))
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        """(Server.scala:916-1000)."""
+        revoked = self.slot_system.leader(phase1b.start_slot_inclusive)
+        phase1 = self.phase1s.get(revoked)
+        if phase1 is None or phase1b.round != phase1.round:
+            return
+        phase1.phase1bs[phase1b.server_index] = phase1b
+        if len(phase1.phase1bs) < self.config.f + 1:
+            return
+        slot = phase1.start_slot_inclusive
+        while slot < phase1.stop_slot_exclusive:
+            infos = [i.info for p in phase1.phase1bs.values()
+                     for i in p.info if i.slot == slot]
+            chosen = [i for i in infos if isinstance(i, ChosenSlotInfo)]
+            pending = [i for i in infos if isinstance(i, PendingSlotInfo)]
+            if chosen:
+                self._choose(slot, chosen[0].value, chosen[0].is_revocation)
+                if not chosen[0].is_revocation:
+                    self._advance_with_skips(slot)
+            elif not pending:
+                self._propose(phase1.round, slot, NOOP)
+            else:
+                best = max(pending, key=lambda i: i.vote_round)
+                self._propose(phase1.round, slot, best.vote_value)
+            slot = self.slot_system.next_classic_round(revoked, slot)
+        self._execute_log(lambda _: False)
+        phase1.resend.stop()
+        del self.phase1s[revoked]
+        self.revocation_timers[revoked].start()
+
+    def _propose(self, round: int, slot: int, value: CommandOrNoop) -> None:
+        """Revocation proposal (Server.scala:620-668)."""
+        self.logger.check_ne(self.index, self.slot_system.leader(slot))
+        if slot in self.phase2s:
+            return
+        entry = self.log.get(slot)
+        if isinstance(entry, ChosenEntry):
+            return
+        if isinstance(entry, (VotelessEntry, PendingEntry)) \
+                and round < entry.round:
+            return
+        self.log.put(slot, PendingEntry(round=round, vote_round=round,
+                                        vote_value=value))
+        for server in self.other_servers:
+            self.send(server, Phase2a(sending_server=self.index, slot=slot,
+                                      round=round, value=value))
+        self.phase2s[slot] = _Phase2State(round=round, value=value,
+                                          is_revocation=True,
+                                          phase2bs={self.index})
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        """(Server.scala:1000-1062)."""
+        owner = self.slot_system.leader(phase2a.slot)
+        if owner == self.index:
+            self._advance_with_skips(phase2a.slot)
+            self._execute_log(self._reply_if_mine)
+        entry = self.log.get(phase2a.slot)
+        if isinstance(entry, ChosenEntry):
+            self.send(src, Chosen(slot=phase2a.slot, value=entry.value,
+                                  is_revocation=entry.is_revocation))
+            return
+        round = -1 if entry is None else entry.round
+        if phase2a.round < round:
+            self.send(src, Phase2Nack(slot=phase2a.slot, round=round))
+            return
+        self.log.put(phase2a.slot,
+                     PendingEntry(round=phase2a.round,
+                                  vote_round=phase2a.round,
+                                  vote_value=phase2a.value))
+        if owner != self.index and owner == phase2a.sending_server:
+            self._advance_with_skips(phase2a.slot)
+            self._execute_log(self._reply_if_mine)
+        self._flush_skips()
+        self.flush_skip_slots_timer.stop()
+        self.send(src, Phase2b(server_index=self.index, slot=phase2a.slot,
+                               round=phase2a.round))
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        """(Server.scala:1063-1110)."""
+        if isinstance(self.log.get(phase2b.slot), ChosenEntry):
+            return
+        phase2 = self.phase2s.get(phase2b.slot)
+        if phase2 is None or phase2b.round < phase2.round:
+            return
+        self.logger.check_eq(phase2b.round, phase2.round)
+        phase2.phase2bs.add(phase2b.server_index)
+        if len(phase2.phase2bs) < self.config.f + 1:
+            return
+        for server in self.other_servers:
+            self.send(server, Chosen(slot=phase2b.slot, value=phase2.value,
+                                     is_revocation=phase2.is_revocation))
+        self._choose(phase2b.slot, phase2.value, phase2.is_revocation)
+        self._execute_log(self._reply_if_mine)
+
+    def _handle_skip(self, src: Address, skip: Skip) -> None:
+        slot = skip.start_slot_inclusive
+        coordinator = self.slot_system.leader(skip.start_slot_inclusive)
+        while slot < skip.stop_slot_exclusive:
+            self._choose(slot, NOOP, is_revocation=False)
+            slot = self.slot_system.next_classic_round(coordinator, slot)
+        self._execute_log(self._reply_if_mine)
+
+    def _handle_chosen(self, src: Address, chosen: Chosen) -> None:
+        owner = self.slot_system.leader(chosen.slot)
+        if owner == self.index and not chosen.is_revocation:
+            self._advance_with_skips(chosen.slot)
+        self._choose(chosen.slot, chosen.value, chosen.is_revocation)
+        self._execute_log(self._reply_if_mine)
+
+    def _handle_phase1_nack(self, src: Address, nack: Phase1Nack) -> None:
+        revoked = self.slot_system.leader(nack.start_slot_inclusive)
+        phase1 = self.phase1s.pop(revoked, None)
+        if phase1 is None:
+            return
+        phase1.resend.stop()
+        self.recover_round = self.round_system.next_classic_round(
+            self.index, max(self.recover_round, nack.round))
+        self.revocation_timers[revoked].start()
+
+    def _handle_phase2_nack(self, src: Address, nack: Phase2Nack) -> None:
+        phase2 = self.phase2s.pop(nack.slot, None)
+        if phase2 is None:
+            return
+        self.recover_round = self.round_system.next_classic_round(
+            self.index, max(self.recover_round, nack.round))
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class VanillaMenciusClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: VanillaMenciusConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.ids: dict[int, int] = {}
+        self.pending: dict[int, _Pending] = {}
+
+    def write(self, pseudonym: int, command: bytes,
+              callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self.pending:
+            raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(Command(
+            CommandId(self.address, pseudonym, id), command))
+        server = self.config.server_addresses[
+            self.rng.randrange(len(self.config.server_addresses))]
+        self.send(server, request)
+
+        def resend():
+            target = self.config.server_addresses[
+                self.rng.randrange(len(self.config.server_addresses))]
+            self.send(target, request)
+            timer.start()
+
+        timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.pending[pseudonym] = _Pending(id, command,
+                                           callback or (lambda _: None),
+                                           timer)
+        self.ids[pseudonym] = id + 1
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.get(message.command_id.client_pseudonym)
+        if pending is None or pending.id != message.command_id.client_id:
+            return
+        pending.resend.stop()
+        del self.pending[message.command_id.client_pseudonym]
+        pending.callback(message.result)

@@ -1,0 +1,103 @@
+"""FastMultiPaxos: fast path via direct acceptor proposals, stuck-round
+recovery, and raft election."""
+
+import random
+
+from frankenpaxos_tpu.roundsystem import RoundZeroFast
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.fastmultipaxos import (
+    FastMultiPaxosAcceptor,
+    FastMultiPaxosClient,
+    FastMultiPaxosConfig,
+    FastMultiPaxosLeader,
+)
+from frankenpaxos_tpu.election.raft import (
+    RaftElectionOptions,
+    RaftElectionParticipant,
+)
+
+
+def make_fmp(f=1, num_clients=2, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    n = 2 * f + 1
+    config = FastMultiPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        leader_election_addresses=tuple(
+            f"election-{i}" for i in range(f + 1)),
+        leader_heartbeat_addresses=tuple(f"lhb-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)),
+        acceptor_heartbeat_addresses=tuple(
+            f"ahb-{i}" for i in range(n)),
+        round_system=RoundZeroFast(f + 1))
+    leaders = [FastMultiPaxosLeader(a, transport, logger, config,
+                                    AppendLog(), seed=seed + i)
+               for i, a in enumerate(config.leader_addresses)]
+    acceptors = [FastMultiPaxosAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    clients = [FastMultiPaxosClient(f"client-{i}", transport, logger,
+                                    config, seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return transport, config, leaders, acceptors, clients
+
+
+def pump(transport, predicate, rounds=12):
+    for _ in range(rounds):
+        if predicate():
+            return True
+        for timer in transport.running_timers():
+            if not timer.name.startswith(("noPing", "notEnoughVotes",
+                                          "fail", "success")):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+    return predicate()
+
+
+def test_fast_path_single_client():
+    transport, _, leaders, acceptors, clients = make_fmp()
+    # Let round 0 phase 1 + anySuffix propagate.
+    transport.deliver_all()
+    got = []
+    clients[0].propose(b"fast!", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
+    assert leaders[0].log  # chosen in the log
+    assert leaders[0].state_machine.get() == [b"fast!"]
+
+
+def test_sequential_fast_commands():
+    transport, _, leaders, _, clients = make_fmp()
+    transport.deliver_all()
+    got = []
+    for i in range(5):
+        clients[0].propose(b"c%d" % i, got.append)
+        transport.deliver_all()
+        assert pump(transport, lambda: len(got) == i + 1)
+    assert leaders[0].state_machine.get() == [b"c%d" % i for i in range(5)]
+
+
+def test_conflicting_fast_proposals_recover():
+    transport, _, leaders, _, clients = make_fmp(num_clients=3)
+    transport.deliver_all()
+    got = []
+    for i, client in enumerate(clients):
+        client.propose(b"x%d" % i, got.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: len(got) == 3, rounds=25)
+    # All three commands executed in some order, identically at leaders
+    # that executed them.
+    log = leaders[0].state_machine.get()
+    assert {b"x0", b"x1", b"x2"} <= set(log)
+
+
+def test_standby_leader_learns_choices():
+    transport, _, leaders, _, clients = make_fmp()
+    transport.deliver_all()
+    got = []
+    clients[0].propose(b"shared", got.append)
+    transport.deliver_all()
+    assert got
+    # ValueChosen gossip reached the standby leader's log.
+    assert any(slot in leaders[1].log for slot in leaders[0].log)

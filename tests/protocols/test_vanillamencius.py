@@ -1,0 +1,143 @@
+"""VanillaMencius: coupled Mencius with skips + revocation."""
+
+import random
+from typing import Optional
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.vanillamencius import (
+    ChosenEntry,
+    VanillaMenciusClient,
+    VanillaMenciusConfig,
+    VanillaMenciusServer,
+)
+
+
+def make_vanilla(f=1, num_clients=2, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    n = 2 * f + 1
+    config = VanillaMenciusConfig(
+        f=f,
+        server_addresses=tuple(f"server-{i}" for i in range(n)),
+        heartbeat_addresses=tuple(f"hb-{i}" for i in range(n)))
+    servers = [VanillaMenciusServer(a, transport, logger, config,
+                                    AppendLog(), seed=seed + i)
+               for i, a in enumerate(config.server_addresses)]
+    clients = [VanillaMenciusClient(f"client-{i}", transport, logger,
+                                    config, seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return transport, config, servers, clients
+
+
+def executed_prefix(server):
+    out = []
+    for slot in range(server.executed_watermark):
+        entry = server.log.get(slot)
+        assert isinstance(entry, ChosenEntry)
+        out.append(entry.value)
+    return out
+
+
+def test_single_write():
+    transport, _, servers, clients = make_vanilla()
+    got = []
+    clients[0].write(0, b"hello", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
+
+
+def test_writes_via_different_servers_agree():
+    transport, _, servers, clients = make_vanilla(num_clients=3)
+    results = []
+    for round in range(4):
+        for client in clients:
+            client.write(0, b"w%d" % round, results.append)
+        transport.deliver_all()
+    assert len(results) == 12
+    logs = [executed_prefix(s) for s in servers]
+    n = min(len(l) for l in logs)
+    assert logs[0][:n] == logs[1][:n] == logs[2][:n]
+    # Skips chose noops in lagging servers' slots.
+    from frankenpaxos_tpu.protocols.vanillamencius import Noop
+    assert any(isinstance(v, Noop) for v in logs[0])
+
+
+def test_skip_flush_timer():
+    transport, _, servers, clients = make_vanilla()
+    clients[0].write(0, b"x")
+    transport.deliver_all()
+    # Some server may hold unflushed skip slots; firing the flush timer
+    # must deliver them without error.
+    for timer in transport.running_timers():
+        if timer.name == "flushSkipSlots":
+            transport.trigger_timer(timer.id)
+    transport.deliver_all()
+
+
+class WriteCmd:
+    def __init__(self, client, pseudonym, payload):
+        self.client = client
+        self.pseudonym = pseudonym
+        self.payload = payload
+
+    def __repr__(self):
+        return f"Write({self.client}, {self.pseudonym}, {self.payload!r})"
+
+
+class TransportCmd:
+    def __init__(self, command):
+        self.command = command
+
+    def __repr__(self):
+        return f"Transport({self.command!r})"
+
+
+class VanillaMenciusSimulated(SimulatedSystem):
+    def new_system(self, seed):
+        transport, config, servers, clients = make_vanilla(seed=seed)
+        return dict(transport=transport, servers=servers, clients=clients,
+                    counter=0)
+
+    def generate_command(self, system, rng: random.Random):
+        choices = []
+        idle = [(c, p) for c, client in enumerate(system["clients"])
+                for p in (0, 1) if p not in client.pending]
+        if idle:
+            choices.append("write")
+        transport_cmd = system["transport"].generate_command(rng)
+        if transport_cmd is not None:
+            choices.extend(["transport"] * 6)
+        if not choices:
+            return None
+        if rng.choice(choices) == "write":
+            client, pseudonym = rng.choice(idle)
+            system["counter"] += 1
+            return WriteCmd(client, pseudonym, b"w%d" % system["counter"])
+        return TransportCmd(transport_cmd)
+
+    def run_command(self, system, command):
+        if isinstance(command, WriteCmd):
+            client = system["clients"][command.client]
+            if command.pseudonym not in client.pending:
+                client.write(command.pseudonym, command.payload)
+        else:
+            system["transport"].run_command(command.command)
+        return system
+
+    def state_invariant(self, system) -> Optional[str]:
+        logs = [executed_prefix(s) for s in system["servers"]]
+        for i in range(len(logs)):
+            for j in range(i + 1, len(logs)):
+                n = min(len(logs[i]), len(logs[j]))
+                if logs[i][:n] != logs[j][:n]:
+                    return (f"server logs diverge: {logs[i]!r} vs "
+                            f"{logs[j]!r}")
+        return None
+
+
+def test_simulation_no_divergence():
+    failure = Simulator(VanillaMenciusSimulated(), run_length=150,
+                        num_runs=15).run(seed=0)
+    assert failure is None, str(failure)
