@@ -1,0 +1,465 @@
+"""Faster Paxos: delegate-based multi-leader MultiPaxos.
+
+Reference behavior: fasterpaxos/ (FasterPaxos.proto:1-130 protocol
+cheatsheet, Server.scala ~2,200 LoC, Client.scala). 2f+1 servers; in
+each round one server is the *leader* and picks f+1 *delegates*
+(including itself). The leader runs Phase1 across the servers, repairs
+the log, then hands the suffix to the delegates (Phase2aAny). In normal
+operation clients send to any delegate, which assigns one of its
+round-robin-owned slots, votes, and gathers Phase2bs from the other
+delegates -- all f+1 delegates voting forms a classic quorum -- then
+broadcasts Phase3a (chosen) to all servers and answers the client.
+Stale clients discover the round/delegates via RoundInfo.
+
+(The reference's ackNoopsWithCommands / useF1Optimization flags and
+heartbeat-driven automatic round changes are simplified: round changes
+here are nack-driven.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+
+
+@dataclasses.dataclass(frozen=True)
+class FasterPaxosConfig:
+    f: int
+    server_addresses: tuple
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.server_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 servers")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Noop:
+    pass
+
+
+NOOP = Noop()
+CommandOrNoop = Union[Command, Noop]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    round: int
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+    chosen_watermark: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1bSlotInfo:
+    slot: int
+    vote_round: int
+    vote_value: CommandOrNoop
+    chosen: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    server_index: int
+    round: int
+    info: tuple[Phase1bSlotInfo, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    slot: int
+    round: int
+    value: CommandOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    server_index: int
+    slot: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase3a:
+    slot: int
+    value: CommandOrNoop
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2aAny:
+    round: int
+    delegates: tuple[int, ...]
+    start_slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2aAnyAck:
+    server_index: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundInfo:
+    round: int
+    delegates: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Nack:
+    round: int
+
+
+@dataclasses.dataclass
+class _LogEntry:
+    vote_round: int
+    vote_value: CommandOrNoop
+    chosen: bool = False
+
+
+class FasterPaxosServer(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: FasterPaxosConfig,
+                 state_machine: StateMachine, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = list(config.server_addresses).index(address)
+        self.round_system = ClassicRoundRobin(len(config.server_addresses))
+        self.round = 0
+        # Round 0: server 0 leads with delegates 0..f.
+        self.delegates: tuple[int, ...] = tuple(range(config.f + 1))
+        self.log: BufferMap = BufferMap()
+        self.executed_watermark = 0
+        self.client_table: dict[tuple, tuple[int, bytes]] = {}
+        # Delegate state: our next owned slot and pending vote collection.
+        self.delegate_start = 0
+        self.next_owned_slot: Optional[int] = None
+        self.pending_votes: dict[int, set[int]] = {}  # slot -> voters
+        self.pending_values: dict[int, CommandOrNoop] = {}
+        # Leader round-change state.
+        self.phase1bs: dict[int, Phase1b] = {}
+        self.in_phase1 = False
+        if self.index in self.delegates:
+            self._set_delegate_slots(0)
+
+    # --- helpers ----------------------------------------------------------
+    @property
+    def is_delegate(self) -> bool:
+        return self.index in self.delegates
+
+    @property
+    def is_leader(self) -> bool:
+        return self.round_system.leader(self.round) == self.index
+
+    def _set_delegate_slots(self, start_slot: int) -> None:
+        """Delegate i of the round owns slots start + i, start + i + (f+1),
+        ... (the Mencius-style stripe among delegates)."""
+        position = self.delegates.index(self.index)
+        self.delegate_start = start_slot
+        self.next_owned_slot = start_slot + position
+
+    def _advance_owned_slot(self) -> None:
+        self.next_owned_slot += len(self.delegates)
+
+    def _delegate_addresses(self) -> list[Address]:
+        return [self.config.server_addresses[i] for i in self.delegates]
+
+    def _execute_log(self) -> None:
+        while True:
+            entry = self.log.get(self.executed_watermark)
+            if entry is None or not entry.chosen:
+                return
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            value = entry.vote_value
+            if isinstance(value, Noop):
+                continue
+            cid = value.command_id
+            key = (cid.client_address, cid.client_pseudonym)
+            cached = self.client_table.get(key)
+            if cached is not None and cid.client_id < cached[0]:
+                continue
+            if cached is not None and cid.client_id == cached[0]:
+                result = cached[1]
+            else:
+                result = self.state_machine.run(value.command)
+                self.client_table[key] = (cid.client_id, result)
+            # The delegate owning the slot replies (cheatsheet: delegate
+            # sends ClientReply).
+            if self.is_delegate and (slot - self.delegate_start) \
+                    % len(self.delegates) \
+                    == self.delegates.index(self.index):
+                self.send(cid.client_address,
+                          ClientReply(command_id=cid, result=result))
+
+    # --- round change (leader) --------------------------------------------
+    def start_round_change(self, new_round: int) -> None:
+        """Become leader of ``new_round`` (Phase1 across servers)."""
+        self.round = new_round
+        self.in_phase1 = True
+        self.phase1bs = {}
+        phase1a = Phase1a(round=new_round,
+                          chosen_watermark=self.executed_watermark)
+        for server in self.config.server_addresses:
+            self.send(server, phase1a)
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        handlers = {
+            ClientRequest: self._handle_client_request,
+            Phase1a: self._handle_phase1a,
+            Phase1b: self._handle_phase1b,
+            Phase2a: self._handle_phase2a,
+            Phase2b: self._handle_phase2b,
+            Phase3a: self._handle_phase3a,
+            Phase2aAny: self._handle_phase2a_any,
+            Phase2aAnyAck: lambda s, m: None,
+            Nack: self._handle_nack,
+        }
+        handler = handlers.get(type(message))
+        if handler is None:
+            self.logger.fatal(f"unexpected server message {message!r}")
+        handler(src, message)
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        if request.round < self.round or not self.is_delegate:
+            # Stale client or not a delegate: only the leader answers with
+            # RoundInfo (FasterPaxos.proto "Learning Who the Delegates
+            # Are").
+            if self.is_leader and not self.in_phase1:
+                self.send(src, RoundInfo(round=self.round,
+                                         delegates=self.delegates))
+            return
+        slot = self.next_owned_slot
+        self._advance_owned_slot()
+        value = request.command
+        self.log.put(slot, _LogEntry(vote_round=self.round,
+                                     vote_value=value))
+        self.pending_votes[slot] = {self.index}
+        self.pending_values[slot] = value
+        for i in self.delegates:
+            if i != self.index:
+                self.send(self.config.server_addresses[i],
+                          Phase2a(slot=slot, round=self.round, value=value))
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        if phase1a.round < self.round:
+            self.send(src, Nack(round=self.round))
+            return
+        self.round = phase1a.round
+        info = tuple(
+            Phase1bSlotInfo(slot=slot, vote_round=entry.vote_round,
+                            vote_value=entry.vote_value,
+                            chosen=entry.chosen)
+            for slot, entry in self.log.items(
+                start=phase1a.chosen_watermark))
+        self.send(src, Phase1b(server_index=self.index,
+                               round=phase1a.round, info=info))
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        if not self.in_phase1 or phase1b.round != self.round:
+            return
+        self.phase1bs[phase1b.server_index] = phase1b
+        if len(self.phase1bs) < self.config.f + 1:
+            return
+        self.in_phase1 = False
+        # Repair every seen slot: chosen values stay; else highest vote.
+        max_slot = max((i.slot for p in self.phase1bs.values()
+                        for i in p.info), default=-1)
+        for slot in range(self.executed_watermark, max_slot + 1):
+            infos = [i for p in self.phase1bs.values()
+                     for i in p.info if i.slot == slot]
+            chosen = next((i for i in infos if i.chosen), None)
+            if chosen is not None:
+                value = chosen.vote_value
+            elif infos:
+                value = max(infos, key=lambda i: i.vote_round).vote_value
+            else:
+                value = NOOP
+            entry = _LogEntry(vote_round=self.round, vote_value=value,
+                              chosen=True)
+            self.log.put(slot, entry)
+            for server in self.config.server_addresses:
+                if server != self.address:
+                    self.send(server, Phase3a(slot=slot, value=value))
+        self._execute_log()
+        # Pick delegates: ourselves + f random others, hand them the
+        # suffix.
+        others = [i for i in range(len(self.config.server_addresses))
+                  if i != self.index]
+        self.delegates = tuple([self.index]
+                               + sorted(self.rng.sample(others,
+                                                        self.config.f)))
+        start = max_slot + 1
+        any_message = Phase2aAny(round=self.round,
+                                 delegates=self.delegates,
+                                 start_slot=start)
+        for i in self.delegates:
+            self.send(self.config.server_addresses[i], any_message)
+        if self.is_delegate:
+            self._set_delegate_slots(start)
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        if phase2a.round < self.round:
+            self.send(src, Nack(round=self.round))
+            return
+        self.round = phase2a.round
+        entry = self.log.get(phase2a.slot)
+        if entry is not None and entry.chosen:
+            self.send(src, Phase3a(slot=phase2a.slot,
+                                   value=entry.vote_value))
+            return
+        self.log.put(phase2a.slot, _LogEntry(vote_round=phase2a.round,
+                                             vote_value=phase2a.value))
+        self.send(src, Phase2b(server_index=self.index, slot=phase2a.slot,
+                               round=phase2a.round))
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        if phase2b.round != self.round:
+            return
+        voters = self.pending_votes.get(phase2b.slot)
+        if voters is None:
+            return
+        voters.add(phase2b.server_index)
+        # All f+1 delegates voting forms a classic quorum.
+        if len(voters) < len(self.delegates):
+            return
+        value = self.pending_values.pop(phase2b.slot)
+        del self.pending_votes[phase2b.slot]
+        entry = self.log.get(phase2b.slot)
+        entry.chosen = True
+        entry.vote_value = value
+        for server in self.config.server_addresses:
+            if server != self.address:
+                self.send(server, Phase3a(slot=phase2b.slot, value=value))
+        self._execute_log()
+
+    def _handle_phase3a(self, src: Address, phase3a: Phase3a) -> None:
+        entry = self.log.get(phase3a.slot)
+        if entry is not None and entry.chosen:
+            return
+        self.log.put(phase3a.slot,
+                     _LogEntry(vote_round=self.round,
+                               vote_value=phase3a.value, chosen=True))
+        self._execute_log()
+
+    def _handle_phase2a_any(self, src: Address,
+                            message: Phase2aAny) -> None:
+        if message.round < self.round:
+            self.send(src, Nack(round=self.round))
+            return
+        self.round = message.round
+        self.delegates = message.delegates
+        if self.is_delegate:
+            self._set_delegate_slots(message.start_slot)
+        self.send(src, Phase2aAnyAck(server_index=self.index,
+                                     round=message.round))
+
+    def _handle_nack(self, src: Address, nack: Nack) -> None:
+        if nack.round <= self.round:
+            return
+        # Take over in a round we own above the nack.
+        self.start_round_change(
+            self.round_system.next_classic_round(self.index, nack.round))
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class FasterPaxosClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: FasterPaxosConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.round = 0
+        self.delegates: tuple[int, ...] = tuple(range(config.f + 1))
+        self.ids: dict[int, int] = {}
+        self.pending: dict[int, _Pending] = {}
+
+    def _send_request(self, request: ClientRequest) -> None:
+        delegate = self.delegates[self.rng.randrange(len(self.delegates))]
+        self.send(self.config.server_addresses[delegate],
+                  dataclasses.replace(request, round=self.round))
+
+    def write(self, pseudonym: int, command: bytes,
+              callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self.pending:
+            raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(self.round, Command(
+            CommandId(self.address, pseudonym, id), command))
+        self._send_request(request)
+
+        def resend():
+            # Broadcast to rediscover the round if we're stale.
+            for server in self.config.server_addresses:
+                self.send(server, dataclasses.replace(request,
+                                                      round=self.round))
+            timer.start()
+
+        timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.pending[pseudonym] = _Pending(id, command,
+                                           callback or (lambda _: None),
+                                           timer)
+        self.ids[pseudonym] = id + 1
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientReply):
+            pending = self.pending.get(message.command_id.client_pseudonym)
+            if pending is None \
+                    or pending.id != message.command_id.client_id:
+                return
+            pending.resend.stop()
+            del self.pending[message.command_id.client_pseudonym]
+            pending.callback(message.result)
+        elif isinstance(message, RoundInfo):
+            if message.round >= self.round:
+                self.round = message.round
+                self.delegates = message.delegates
+        else:
+            self.logger.fatal(f"unexpected client message {message!r}")

@@ -1,0 +1,526 @@
+"""Horizontal MultiPaxos: reconfigurable log chunks.
+
+Reference behavior: horizontal/ (Leader.scala:38-1110, Acceptor.scala:
+31-240, Replica.scala:34-420, Config.scala). The log is split into
+*chunks*, each owned by its own quorum system over the acceptor pool. A
+``Reconfigure(quorum_system)`` request is chosen INTO the log as a
+Configuration value at slot s; once executed, a new chunk with the new
+quorum system becomes active at slot ``s + alpha`` (the horizontal
+reconfiguration rule: alpha bounds how far ahead proposals may run).
+The active leader keeps one Phase1/Phase2 state per chunk; acceptors
+key their state by (chunk first_slot, slot); replicas execute the
+chosen log in order, skipping Configuration values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
+from frankenpaxos_tpu.quorums import (
+    QuorumSystem,
+    SimpleMajority,
+    quorum_system_from_dict,
+    quorum_system_to_dict,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+
+
+@dataclasses.dataclass(frozen=True)
+class HorizontalConfig:
+    f: int
+    leader_addresses: tuple
+    leader_election_addresses: tuple
+    acceptor_addresses: tuple
+    replica_addresses: tuple
+    alpha: int = 3
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.leader_election_addresses) \
+                != len(self.leader_addresses):
+            raise ValueError("elections must mirror leaders")
+        if len(self.acceptor_addresses) < 2 * self.f + 1:
+            raise ValueError("need >= 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Noop:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Configuration:
+    quorum_system: dict  # wire form
+
+
+NOOP = Noop()
+Value = Union[Command, Noop, Configuration]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class Reconfigure:
+    quorum_system: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+    first_slot: int
+    chosen_watermark: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1bSlotInfo:
+    slot: int
+    vote_round: int
+    vote_value: Value
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    round: int
+    first_slot: int
+    acceptor_index: int
+    info: tuple[Phase1bSlotInfo, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    slot: int
+    round: int
+    first_slot: int
+    value: Value
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    slot: int
+    round: int
+    acceptor_index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    value: Value
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Nack:
+    round: int
+
+
+@dataclasses.dataclass
+class _Chunk:
+    first_slot: int
+    last_slot: Optional[int]
+    quorum_system: QuorumSystem
+    # phase: ("phase1", {acceptor: Phase1b}) or
+    #        ("phase2", next_slot, {slot: value}, {slot: set of voters})
+    phase: list
+
+
+class HorizontalLeader(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: HorizontalConfig,
+                 election_options: ElectionOptions = ElectionOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.index = list(config.leader_addresses).index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.log: BufferMap = BufferMap()
+        self.chosen_watermark = 0
+        self.round = 0
+        self.active = False
+        self.chunks: list[_Chunk] = []
+
+        self.election = ElectionParticipant(
+            config.leader_election_addresses[self.index], transport, logger,
+            config.leader_election_addresses, initial_leader_index=0,
+            options=election_options, seed=seed)
+        self.election.register(self._on_leader_change)
+
+        if self.index == 0:
+            # Round 0: the initial chunk covers slot 0.. with a simple
+            # majority over the first 2f+1 acceptors; phase 1 is skippable
+            # in round 0 (nothing was ever proposed).
+            quorum_system = SimpleMajority(range(2 * config.f + 1))
+            self.active = True
+            self.chunks = [_Chunk(0, None, quorum_system,
+                                  ["phase2", 0, {}, {}])]
+
+    # --- helpers ----------------------------------------------------------
+    def _on_leader_change(self, leader_index: int) -> None:
+        if leader_index == self.index:
+            self._become_leader(
+                self.round_system.next_classic_round(self.index, self.round))
+        else:
+            self.active = False
+            self.chunks = []
+
+    def _become_leader(self, round: int) -> None:
+        self.round = round
+        self.active = True
+        # One chunk per active configuration; conservatively restart with
+        # the last known chunk boundaries (fresh leaders re-learn via
+        # phase 1 from the chosen watermark).
+        if not self.chunks:
+            quorum_system = SimpleMajority(range(2 * self.config.f + 1))
+            self.chunks = [_Chunk(self.chosen_watermark, None,
+                                  quorum_system, ["phase1", {}])]
+        else:
+            for chunk in self.chunks:
+                chunk.phase = ["phase1", {}]
+        for chunk in self.chunks:
+            self._send_phase1as(chunk)
+
+    def _send_phase1as(self, chunk: _Chunk) -> None:
+        phase1a = Phase1a(round=self.round, first_slot=chunk.first_slot,
+                          chosen_watermark=self.chosen_watermark)
+        for i in chunk.quorum_system.nodes():
+            self.send(self.config.acceptor_addresses[i], phase1a)
+
+    def _chunk_of(self, slot: int) -> Optional[_Chunk]:
+        for chunk in reversed(self.chunks):
+            if slot >= chunk.first_slot:
+                return chunk
+        return None
+
+    def _active_chunk(self) -> _Chunk:
+        return self.chunks[-1] if self.chunks else None
+
+    def _propose(self, chunk: _Chunk, value: Value) -> None:
+        assert chunk.phase[0] == "phase2"
+        slot = chunk.phase[1]
+        chunk.phase[1] = slot + 1
+        chunk.phase[2][slot] = value
+        chunk.phase[3][slot] = set()
+        phase2a = Phase2a(slot=slot, round=self.round,
+                          first_slot=chunk.first_slot, value=value)
+        for i in chunk.quorum_system.nodes():
+            self.send(self.config.acceptor_addresses[i], phase2a)
+
+    def _choose(self, slot: int, value: Value) -> None:
+        already = self.log.get(slot) is not None
+        self.log.put(slot, value)
+        for replica in self.config.replica_addresses:
+            self.send(replica, Chosen(slot=slot, value=value))
+        for leader in self.config.leader_addresses:
+            if leader != self.address:
+                self.send(leader, Chosen(slot=slot, value=value))
+        if not already:
+            self._advance_watermark()
+
+    def _advance_watermark(self) -> None:
+        while self.log.get(self.chosen_watermark) is not None:
+            value = self.log.get(self.chosen_watermark)
+            slot = self.chosen_watermark
+            self.chosen_watermark += 1
+            if isinstance(value, Configuration) and self.active:
+                # Activate a new chunk at slot + alpha
+                # (Leader.scala:450-470 choose()).
+                first_slot = slot + self.config.alpha
+                current = self._active_chunk()
+                if current is not None and current.first_slot < first_slot:
+                    current.last_slot = first_slot - 1
+                    # Fill this chunk's unproposed slots with noops so the
+                    # log up to the boundary completes.
+                    if current.phase[0] == "phase2":
+                        while current.phase[1] < first_slot:
+                            self._propose(current, NOOP)
+                quorum_system = quorum_system_from_dict(value.quorum_system)
+                chunk = _Chunk(first_slot, None, quorum_system,
+                               ["phase2", first_slot, {}, {}])
+                self.chunks.append(chunk)
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientRequest):
+            self._handle_client_request(src, message)
+        elif isinstance(message, Reconfigure):
+            self._handle_reconfigure(src, message)
+        elif isinstance(message, Phase1b):
+            self._handle_phase1b(src, message)
+        elif isinstance(message, Phase2b):
+            self._handle_phase2b(src, message)
+        elif isinstance(message, Chosen):
+            if self.log.get(message.slot) is None:
+                self.log.put(message.slot, message.value)
+                self._advance_watermark()
+        elif isinstance(message, Nack):
+            self._handle_nack(src, message)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        if not self.active:
+            return
+        chunk = self._active_chunk()
+        if chunk is None or chunk.phase[0] != "phase2":
+            return  # phase 1 pending; client will resend
+        self._propose(chunk, request.command)
+
+    def _handle_reconfigure(self, src: Address,
+                            reconfigure: Reconfigure) -> None:
+        """Choose the new configuration as a log value
+        (Leader.scala:1006-1018)."""
+        if not self.active:
+            return
+        chunk = self._active_chunk()
+        if chunk is None or chunk.phase[0] != "phase2":
+            return
+        self._propose(chunk, Configuration(reconfigure.quorum_system))
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        if not self.active or phase1b.round != self.round:
+            return
+        chunk = next((c for c in self.chunks
+                      if c.first_slot == phase1b.first_slot), None)
+        if chunk is None or chunk.phase[0] != "phase1":
+            return
+        chunk.phase[1][phase1b.acceptor_index] = phase1b
+        responders = set(chunk.phase[1])
+        if not chunk.quorum_system.is_superset_of_read_quorum(responders):
+            return
+        # Adopt highest votes; fill holes with noops up to max voted slot.
+        phase1bs = chunk.phase[1]
+        max_slot = max((i.slot for p in phase1bs.values() for i in p.info),
+                      default=chunk.first_slot - 1)
+        chunk.phase = ["phase2", max(chunk.first_slot,
+                                     self.chosen_watermark), {}, {}]
+        for slot in range(chunk.first_slot, max_slot + 1):
+            if self.log.get(slot) is not None:
+                continue
+            infos = [i for p in phase1bs.values() for i in p.info
+                     if i.slot == slot]
+            value = (max(infos, key=lambda i: i.vote_round).vote_value
+                     if infos else NOOP)
+            if slot >= chunk.phase[1]:
+                chunk.phase[1] = slot + 1
+            chunk.phase[2][slot] = value
+            chunk.phase[3][slot] = set()
+            phase2a = Phase2a(slot=slot, round=self.round,
+                              first_slot=chunk.first_slot, value=value)
+            for i in chunk.quorum_system.nodes():
+                self.send(self.config.acceptor_addresses[i], phase2a)
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        if not self.active or phase2b.round != self.round:
+            return
+        chunk = self._chunk_of(phase2b.slot)
+        if chunk is None or chunk.phase[0] != "phase2":
+            return
+        voters = chunk.phase[3].get(phase2b.slot)
+        if voters is None:
+            return
+        voters.add(phase2b.acceptor_index)
+        if not chunk.quorum_system.is_superset_of_write_quorum(voters):
+            return
+        value = chunk.phase[2].pop(phase2b.slot)
+        del chunk.phase[3][phase2b.slot]
+        self._choose(phase2b.slot, value)
+
+    def _handle_nack(self, src: Address, nack: Nack) -> None:
+        if nack.round <= self.round:
+            return
+        if self.active:
+            self._become_leader(
+                self.round_system.next_classic_round(self.index,
+                                                     nack.round))
+        else:
+            self.round = nack.round
+
+
+@dataclasses.dataclass
+class _AcceptorState:
+    round: int = -1
+    vote_round: int = -1
+    vote_value: Optional[Value] = None
+
+
+class HorizontalAcceptor(Actor):
+    """Per-chunk rounds: state keyed by (first_slot) for rounds and slot
+    for votes (Acceptor.scala:31-240)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: HorizontalConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.chunk_rounds: dict[int, int] = {}
+        self.votes: dict[int, _AcceptorState] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1a):
+            round = self.chunk_rounds.get(message.first_slot, -1)
+            if message.round < round:
+                self.send(src, Nack(round=round))
+                return
+            self.chunk_rounds[message.first_slot] = message.round
+            info = tuple(
+                Phase1bSlotInfo(slot=slot, vote_round=state.vote_round,
+                                vote_value=state.vote_value)
+                for slot, state in sorted(self.votes.items())
+                if slot >= max(message.first_slot,
+                               message.chosen_watermark)
+                and state.vote_value is not None)
+            self.send(src, Phase1b(round=message.round,
+                                   first_slot=message.first_slot,
+                                   acceptor_index=self.index, info=info))
+        elif isinstance(message, Phase2a):
+            round = self.chunk_rounds.get(message.first_slot, -1)
+            if message.round < round:
+                self.send(src, Nack(round=round))
+                return
+            self.chunk_rounds[message.first_slot] = message.round
+            state = self.votes.setdefault(message.slot, _AcceptorState())
+            state.round = message.round
+            state.vote_round = message.round
+            state.vote_value = message.value
+            self.send(src, Phase2b(slot=message.slot, round=message.round,
+                                   acceptor_index=self.index))
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+
+class HorizontalReplica(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: HorizontalConfig,
+                 state_machine: StateMachine):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.index = list(config.replica_addresses).index(address)
+        self.log: BufferMap = BufferMap()
+        self.executed_watermark = 0
+        self.client_table: dict[tuple, tuple[int, bytes]] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, Chosen):
+            self.logger.fatal(f"unexpected replica message {message!r}")
+        if self.log.get(message.slot) is None:
+            self.log.put(message.slot, message.value)
+        while True:
+            value = self.log.get(self.executed_watermark)
+            if value is None:
+                return
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            if isinstance(value, (Noop, Configuration)):
+                continue
+            cid = value.command_id
+            key = (cid.client_address, cid.client_pseudonym)
+            cached = self.client_table.get(key)
+            if cached is not None and cid.client_id < cached[0]:
+                continue
+            if cached is not None and cid.client_id == cached[0]:
+                result = cached[1]
+            else:
+                result = self.state_machine.run(value.command)
+                self.client_table[key] = (cid.client_id, result)
+            if slot % len(self.config.replica_addresses) == self.index:
+                self.send(cid.client_address,
+                          ClientReply(command_id=cid, result=result))
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class HorizontalClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: HorizontalConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.ids: dict[int, int] = {}
+        self.pending: dict[int, _Pending] = {}
+
+    def write(self, pseudonym: int, command: bytes,
+              callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self.pending:
+            raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(Command(
+            CommandId(self.address, pseudonym, id), command))
+
+        def send_it():
+            for leader in self.config.leader_addresses:
+                self.send(leader, request)
+
+        def resend():
+            send_it()
+            timer.start()
+
+        send_it()
+        timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.pending[pseudonym] = _Pending(id, command,
+                                           callback or (lambda _: None),
+                                           timer)
+        self.ids[pseudonym] = id + 1
+
+    def reconfigure(self, quorum_system: QuorumSystem) -> None:
+        for leader in self.config.leader_addresses:
+            self.send(leader,
+                      Reconfigure(quorum_system_to_dict(quorum_system)))
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.get(message.command_id.client_pseudonym)
+        if pending is None or pending.id != message.command_id.client_id:
+            return
+        pending.resend.stop()
+        del self.pending[message.command_id.client_pseudonym]
+        pending.callback(message.result)

@@ -1,0 +1,590 @@
+"""Matchmaker MultiPaxos: MultiPaxos with live acceptor reconfiguration.
+
+Reference behavior: matchmakermultipaxos/ (~4,900 LoC Scala: Leader,
+Matchmaker.scala:79-700, Reconfigurer.scala:98-500, Acceptor, Replica;
+SURVEY.md section 2.2). Every round has its own quorum system over an
+arbitrary acceptor set, registered with 2f+1 matchmakers:
+
+  * to start round r, the leader matchmakes: MatchRequest(r, config) to
+    the matchmakers; f+1 MatchReplies return all prior-round
+    configurations; phase 1 reads a read quorum of every prior
+    configuration (for the whole log suffix); phase 2 writes through the
+    new round's own configuration -- the per-round quorum-systems shape
+    that ops/quorum.py's MultiConfigQuorumChecker batches on device;
+  * a Reconfigurer drives acceptor-set changes mid-stream by handing the
+    leader a new configuration, which the leader adopts in its next
+    round (the reference's Stop/Bootstrap/Phase1/Phase2 matchmaker
+    self-reconfiguration and GarbageCollect pruning are simplified to
+    this leader-driven path here);
+  * Die messages support chaos testing of matchmakers
+    (Matchmaker.scala:664).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional, Union
+
+from frankenpaxos_tpu.quorums import (
+    QuorumSystem,
+    SimpleMajority,
+    quorum_system_from_dict,
+    quorum_system_to_dict,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchmakerMultiPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    matchmaker_addresses: tuple
+    reconfigurer_addresses: tuple
+    acceptor_addresses: tuple
+    replica_addresses: tuple
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.matchmaker_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 matchmakers")
+        if len(self.reconfigurer_addresses) < 1:
+            raise ValueError("need >= 1 reconfigurer")
+        if len(self.acceptor_addresses) < 2 * self.f + 1:
+            raise ValueError("need >= 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    client_address: Address
+    client_pseudonym: int
+    client_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Noop:
+    pass
+
+
+NOOP = Noop()
+Value = Union[Command, Noop]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchRequest:
+    round: int
+    quorum_system: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchReply:
+    round: int
+    matchmaker_index: int
+    configurations: tuple[tuple[int, dict], ...]  # (round, quorum system)
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchmakerNack:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class GarbageCollect:
+    """Prune matchmaker configurations below ``round`` once phase 1 has
+    read everything it needs (Matchmaker GarbageCollect)."""
+
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+    chosen_watermark: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1bSlotInfo:
+    slot: int
+    vote_round: int
+    vote_value: Value
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    round: int
+    acceptor_index: int
+    info: tuple[Phase1bSlotInfo, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    slot: int
+    round: int
+    value: Value
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    slot: int
+    round: int
+    acceptor_index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    value: Value
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceptorNack:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Reconfigure:
+    quorum_system: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Die:
+    """Chaos: kill a matchmaker (Matchmaker.scala:664)."""
+
+
+@dataclasses.dataclass
+class _Matchmaking:
+    quorum_system: QuorumSystem
+    match_replies: dict[int, MatchReply]
+    pending_batches: list[ClientRequest]
+
+
+@dataclasses.dataclass
+class _Phase1:
+    quorum_system: QuorumSystem
+    previous: dict[int, QuorumSystem]
+    pending_rounds: set[int]
+    phase1bs: dict[int, Phase1b]
+    pending_batches: list[ClientRequest]
+
+
+@dataclasses.dataclass
+class _Phase2:
+    quorum_system: QuorumSystem
+    pending_values: dict[int, Value]
+    phase2bs: dict[int, set[int]]
+
+
+class MMPLeader(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig,
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.index = list(config.leader_addresses).index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.round = -1
+        self.next_slot = 0
+        self.chosen_watermark = 0
+        self.log: BufferMap = BufferMap()
+        self.state: object = None  # Inactive
+        # The configuration to adopt at the next matchmaking, set by the
+        # reconfigurer.
+        self.next_quorum_system: QuorumSystem = SimpleMajority(
+            range(2 * config.f + 1))
+        if self.index == 0:
+            self._start_matchmaking()
+
+    # --- matchmaking ------------------------------------------------------
+    def _start_matchmaking(self) -> None:
+        pending = []
+        if isinstance(self.state, (_Matchmaking, _Phase1)):
+            pending = self.state.pending_batches
+        self.round = self.round_system.next_classic_round(self.index,
+                                                          self.round)
+        request = MatchRequest(
+            round=self.round,
+            quorum_system=quorum_system_to_dict(self.next_quorum_system))
+        for matchmaker in self.config.matchmaker_addresses:
+            self.send(matchmaker, request)
+        self.state = _Matchmaking(self.next_quorum_system, {}, pending)
+
+    def _acceptor(self, index: int) -> Address:
+        return self.config.acceptor_addresses[index]
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, ClientRequest):
+            self._handle_client_request(src, message)
+        elif isinstance(message, MatchReply):
+            self._handle_match_reply(src, message)
+        elif isinstance(message, (MatchmakerNack, AcceptorNack)):
+            self._handle_nack(message.round)
+        elif isinstance(message, Phase1b):
+            self._handle_phase1b(src, message)
+        elif isinstance(message, Phase2b):
+            self._handle_phase2b(src, message)
+        elif isinstance(message, Reconfigure):
+            self._handle_reconfigure(src, message)
+        elif isinstance(message, Chosen):
+            self._learn(message.slot, message.value)
+        else:
+            self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _handle_client_request(self, src: Address,
+                               request: ClientRequest) -> None:
+        if self.state is None:
+            return
+        if isinstance(self.state, (_Matchmaking, _Phase1)):
+            self.state.pending_batches.append(request)
+            return
+        self._propose(request.command)
+
+    def _propose(self, value: Value) -> None:
+        state: _Phase2 = self.state
+        slot = self.next_slot
+        self.next_slot += 1
+        state.pending_values[slot] = value
+        state.phase2bs[slot] = set()
+        phase2a = Phase2a(slot=slot, round=self.round, value=value)
+        for i in state.quorum_system.random_write_quorum(self.rng):
+            self.send(self._acceptor(i), phase2a)
+
+    def _handle_match_reply(self, src: Address, reply: MatchReply) -> None:
+        if not isinstance(self.state, _Matchmaking) \
+                or reply.round != self.round:
+            return
+        state = self.state
+        state.match_replies[reply.matchmaker_index] = reply
+        if len(state.match_replies) < self.config.f + 1:
+            return
+        previous: dict[int, QuorumSystem] = {}
+        for r in state.match_replies.values():
+            for round, qs_dict in r.configurations:
+                previous[round] = quorum_system_from_dict(qs_dict)
+        pending_rounds = set(previous)
+        if not pending_rounds:
+            self.state = _Phase2(state.quorum_system, {}, {})
+            for request in state.pending_batches:
+                self._propose(request.command)
+            return
+        # Phase 1 over a read quorum of every prior configuration.
+        targets: set[int] = set()
+        for qs in previous.values():
+            targets |= qs.random_read_quorum(self.rng)
+        phase1a = Phase1a(round=self.round,
+                          chosen_watermark=self.chosen_watermark)
+        for i in targets:
+            self.send(self._acceptor(i), phase1a)
+        self.state = _Phase1(state.quorum_system, previous, pending_rounds,
+                             {}, state.pending_batches)
+
+    def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
+        if not isinstance(self.state, _Phase1) \
+                or phase1b.round != self.round:
+            return
+        state = self.state
+        state.phase1bs[phase1b.acceptor_index] = phase1b
+        responders = set(state.phase1bs)
+        for round in list(state.pending_rounds):
+            if state.previous[round].is_superset_of_read_quorum(responders):
+                state.pending_rounds.discard(round)
+        if state.pending_rounds:
+            return
+        # Phase 1 done: matchmaker state below this round is prunable.
+        for matchmaker in self.config.matchmaker_addresses:
+            self.send(matchmaker, GarbageCollect(round=self.round))
+        max_slot = max((i.slot for p in state.phase1bs.values()
+                        for i in p.info), default=-1)
+        phase2 = _Phase2(state.quorum_system, {}, {})
+        pending = state.pending_batches
+        self.state = phase2
+        for slot in range(self.chosen_watermark, max_slot + 1):
+            if self.log.get(slot) is not None:
+                continue
+            infos = [i for p in state.phase1bs.values() for i in p.info
+                     if i.slot == slot]
+            value = (max(infos, key=lambda i: i.vote_round).vote_value
+                     if infos else NOOP)
+            phase2.pending_values[slot] = value
+            phase2.phase2bs[slot] = set()
+            phase2a = Phase2a(slot=slot, round=self.round, value=value)
+            for i in phase2.quorum_system.random_write_quorum(self.rng):
+                self.send(self._acceptor(i), phase2a)
+        self.next_slot = max(self.next_slot, max_slot + 1,
+                             self.chosen_watermark)
+        for request in pending:
+            self._propose(request.command)
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        if not isinstance(self.state, _Phase2) \
+                or phase2b.round != self.round:
+            return
+        state = self.state
+        voters = state.phase2bs.get(phase2b.slot)
+        if voters is None:
+            return
+        voters.add(phase2b.acceptor_index)
+        if not state.quorum_system.is_superset_of_write_quorum(voters):
+            return
+        value = state.pending_values.pop(phase2b.slot)
+        del state.phase2bs[phase2b.slot]
+        self._learn(phase2b.slot, value)
+        for replica in self.config.replica_addresses:
+            self.send(replica, Chosen(slot=phase2b.slot, value=value))
+        for leader in self.config.leader_addresses:
+            if leader != self.address:
+                self.send(leader, Chosen(slot=phase2b.slot, value=value))
+
+    def _learn(self, slot: int, value: Value) -> None:
+        if self.log.get(slot) is None:
+            self.log.put(slot, value)
+        while self.log.get(self.chosen_watermark) is not None:
+            self.chosen_watermark += 1
+        self.next_slot = max(self.next_slot, self.chosen_watermark)
+
+    def _handle_nack(self, nack_round: int) -> None:
+        if nack_round <= self.round or self.state is None:
+            return
+        self._start_matchmaking()
+
+    def _handle_reconfigure(self, src: Address,
+                            reconfigure: Reconfigure) -> None:
+        """Adopt a new acceptor configuration in our next round
+        (the Reconfigurer's handoff)."""
+        if self.state is None:
+            return
+        self.next_quorum_system = quorum_system_from_dict(
+            reconfigure.quorum_system)
+        self._start_matchmaking()
+
+
+class MMPMatchmaker(Actor):
+    """Stores per-round configurations; monotone; supports GC and Die
+    (Matchmaker.scala:79-700)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.matchmaker_addresses).index(address)
+        self.configurations: dict[int, dict] = {}
+        self.gc_watermark = -1
+        self.dead = False
+
+    def receive(self, src: Address, message) -> None:
+        if self.dead:
+            return
+        if isinstance(message, MatchRequest):
+            if self.configurations \
+                    and message.round <= max(self.configurations):
+                self.send(src, MatchmakerNack(
+                    round=max(self.configurations)))
+                return
+            self.send(src, MatchReply(
+                round=message.round, matchmaker_index=self.index,
+                configurations=tuple(
+                    (r, self.configurations[r])
+                    for r in sorted(self.configurations)
+                    if r > self.gc_watermark)))
+            self.configurations[message.round] = message.quorum_system
+        elif isinstance(message, GarbageCollect):
+            self.gc_watermark = max(self.gc_watermark, message.round - 1)
+            for round in [r for r in self.configurations
+                          if r <= self.gc_watermark]:
+                del self.configurations[round]
+        elif isinstance(message, Die):
+            self.dead = True
+        else:
+            self.logger.fatal(f"unexpected matchmaker message {message!r}")
+
+
+class MMPReconfigurer(Actor):
+    """Drives acceptor-set changes (Reconfigurer.scala:98-500, condensed:
+    the new configuration is handed to the leaders, which matchmake it
+    into their next round)."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+
+    def reconfigure(self, quorum_system: QuorumSystem) -> None:
+        message = Reconfigure(quorum_system_to_dict(quorum_system))
+        for leader in self.config.leader_addresses:
+            self.send(leader, message)
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Reconfigure):
+            for leader in self.config.leader_addresses:
+                self.send(leader, message)
+        else:
+            self.logger.fatal(f"unexpected reconfigurer message {message!r}")
+
+
+@dataclasses.dataclass
+class _VoteState:
+    vote_round: int
+    vote_value: Value
+
+
+class MMPAcceptor(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.round = -1
+        self.votes: dict[int, _VoteState] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, Phase1a):
+            if message.round < self.round:
+                self.send(src, AcceptorNack(round=self.round))
+                return
+            self.round = message.round
+            info = tuple(
+                Phase1bSlotInfo(slot=slot, vote_round=state.vote_round,
+                                vote_value=state.vote_value)
+                for slot, state in sorted(self.votes.items())
+                if slot >= message.chosen_watermark)
+            self.send(src, Phase1b(round=message.round,
+                                   acceptor_index=self.index, info=info))
+        elif isinstance(message, Phase2a):
+            if message.round < self.round:
+                self.send(src, AcceptorNack(round=self.round))
+                return
+            self.round = message.round
+            self.votes[message.slot] = _VoteState(message.round,
+                                                  message.value)
+            self.send(src, Phase2b(slot=message.slot, round=message.round,
+                                   acceptor_index=self.index))
+        else:
+            self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+
+class MMPReplica(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig,
+                 state_machine: StateMachine):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.index = list(config.replica_addresses).index(address)
+        self.log: BufferMap = BufferMap()
+        self.executed_watermark = 0
+        self.client_table: dict[tuple, tuple[int, bytes]] = {}
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, Chosen):
+            self.logger.fatal(f"unexpected replica message {message!r}")
+        if self.log.get(message.slot) is None:
+            self.log.put(message.slot, message.value)
+        while True:
+            value = self.log.get(self.executed_watermark)
+            if value is None:
+                return
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            if isinstance(value, Noop):
+                continue
+            cid = value.command_id
+            key = (cid.client_address, cid.client_pseudonym)
+            cached = self.client_table.get(key)
+            if cached is not None and cid.client_id < cached[0]:
+                continue
+            if cached is not None and cid.client_id == cached[0]:
+                result = cached[1]
+            else:
+                result = self.state_machine.run(value.command)
+                self.client_table[key] = (cid.client_id, result)
+            if slot % len(self.config.replica_addresses) == self.index:
+                self.send(cid.client_address,
+                          ClientReply(command_id=cid, result=result))
+
+
+@dataclasses.dataclass
+class _Pending:
+    id: int
+    command: bytes
+    callback: Callable[[bytes], None]
+    resend: object
+
+
+class MMPClient(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig,
+                 resend_period_s: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period_s = resend_period_s
+        self.ids: dict[int, int] = {}
+        self.pending: dict[int, _Pending] = {}
+
+    def write(self, pseudonym: int, command: bytes,
+              callback: Optional[Callable[[bytes], None]] = None) -> None:
+        if pseudonym in self.pending:
+            raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(Command(
+            CommandId(self.address, pseudonym, id), command))
+
+        def send_it():
+            for leader in self.config.leader_addresses:
+                self.send(leader, request)
+
+        def resend():
+            send_it()
+            timer.start()
+
+        send_it()
+        timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
+                           resend)
+        timer.start()
+        self.pending[pseudonym] = _Pending(id, command,
+                                           callback or (lambda _: None),
+                                           timer)
+        self.ids[pseudonym] = id + 1
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReply):
+            self.logger.fatal(f"unexpected client message {message!r}")
+        pending = self.pending.get(message.command_id.client_pseudonym)
+        if pending is None or pending.id != message.command_id.client_id:
+            return
+        pending.resend.stop()
+        del self.pending[message.command_id.client_pseudonym]
+        pending.callback(message.result)

@@ -1,0 +1,76 @@
+"""Horizontal MultiPaxos: chunked log with live chunk reconfiguration."""
+
+from frankenpaxos_tpu.quorums import SimpleMajority
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.horizontal import (
+    Configuration,
+    HorizontalAcceptor,
+    HorizontalClient,
+    HorizontalConfig,
+    HorizontalLeader,
+    HorizontalReplica,
+)
+
+
+def make_horizontal(f=1, num_acceptors=5, num_clients=2, alpha=2, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    config = HorizontalConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        leader_election_addresses=tuple(
+            f"election-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(
+            f"acceptor-{i}" for i in range(num_acceptors)),
+        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)),
+        alpha=alpha)
+    leaders = [HorizontalLeader(a, transport, logger, config, seed=seed + i)
+               for i, a in enumerate(config.leader_addresses)]
+    acceptors = [HorizontalAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    replicas = [HorizontalReplica(a, transport, logger, config, AppendLog())
+                for a in config.replica_addresses]
+    clients = [HorizontalClient(f"client-{i}", transport, logger, config,
+                                seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return transport, config, leaders, acceptors, replicas, clients
+
+
+def test_writes_in_initial_chunk():
+    transport, _, _, _, replicas, clients = make_horizontal()
+    got = []
+    for i in range(3):
+        clients[0].write(0, b"w%d" % i, got.append)
+        transport.deliver_all()
+    assert len(got) == 3
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1] == [b"w0", b"w1", b"w2"]
+
+
+def test_reconfiguration_activates_new_chunk():
+    transport, config, leaders, acceptors, replicas, clients = \
+        make_horizontal(alpha=2)
+    clients[0].write(0, b"before")
+    transport.deliver_all()
+    # Reconfigure to a quorum system over acceptors {2, 3, 4}.
+    clients[0].reconfigure(SimpleMajority([2, 3, 4]))
+    transport.deliver_all()
+    leader = leaders[0]
+    assert len(leader.chunks) == 2
+    new_chunk = leader.chunks[-1]
+    assert new_chunk.quorum_system.nodes() == frozenset({2, 3, 4})
+    # Writes continue through the new chunk and execute.
+    got = []
+    for i in range(4):
+        clients[0].write(0, b"after%d" % i, got.append)
+        transport.deliver_all()
+    assert len(got) == 4
+    # Only the new quorum's acceptors voted for new-chunk slots.
+    new_first = new_chunk.first_slot
+    for acceptor in acceptors[:2]:
+        assert all(slot < new_first for slot in acceptor.votes)
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1]
+    assert logs[0][0] == b"before"
+    assert logs[0][-1] == b"after3"

@@ -1,0 +1,107 @@
+"""MatchmakerMultiPaxos: live acceptor reconfiguration mid-stream."""
+
+from frankenpaxos_tpu.quorums import SimpleMajority
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
+    Die,
+    MatchmakerMultiPaxosConfig,
+    MMPAcceptor,
+    MMPClient,
+    MMPLeader,
+    MMPMatchmaker,
+    MMPReconfigurer,
+    MMPReplica,
+)
+
+
+def make_mmp(f=1, num_acceptors=5, num_clients=2, seed=0):
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    config = MatchmakerMultiPaxosConfig(
+        f=f,
+        leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+        matchmaker_addresses=tuple(
+            f"matchmaker-{i}" for i in range(2 * f + 1)),
+        reconfigurer_addresses=("reconfigurer-0",),
+        acceptor_addresses=tuple(
+            f"acceptor-{i}" for i in range(num_acceptors)),
+        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)))
+    leaders = [MMPLeader(a, transport, logger, config, seed=seed + i)
+               for i, a in enumerate(config.leader_addresses)]
+    matchmakers = [MMPMatchmaker(a, transport, logger, config)
+                   for a in config.matchmaker_addresses]
+    reconfigurer = MMPReconfigurer("reconfigurer-0", transport, logger,
+                                   config)
+    acceptors = [MMPAcceptor(a, transport, logger, config)
+                 for a in config.acceptor_addresses]
+    replicas = [MMPReplica(a, transport, logger, config, AppendLog())
+                for a in config.replica_addresses]
+    clients = [MMPClient(f"client-{i}", transport, logger, config,
+                         seed=seed + 50 + i)
+               for i in range(num_clients)]
+    return (transport, config, leaders, matchmakers, reconfigurer,
+            acceptors, replicas, clients)
+
+
+def test_writes_through_matchmade_configuration():
+    transport, _, _, matchmakers, _, _, replicas, clients = make_mmp()
+    transport.deliver_all()  # matchmaking of round 0
+    got = []
+    for i in range(3):
+        clients[0].write(0, b"w%d" % i, got.append)
+        transport.deliver_all()
+    assert len(got) == 3
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1] == [b"w0", b"w1", b"w2"]
+    assert any(m.configurations for m in matchmakers)
+
+
+def test_live_reconfiguration():
+    (transport, config, leaders, matchmakers, reconfigurer, acceptors,
+     replicas, clients) = make_mmp(num_acceptors=6)
+    transport.deliver_all()
+    got = []
+    clients[0].write(0, b"before", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
+    # Switch the acceptor set to {3, 4, 5} mid-stream.
+    reconfigurer.reconfigure(SimpleMajority([3, 4, 5]))
+    transport.deliver_all()
+    clients[0].write(0, b"after", got.append)
+    transport.deliver_all()
+    assert got == [b"0", b"1"]
+    # New writes are voted only by the new acceptor set.
+    new_votes = [slot for a in acceptors[3:] for slot in a.votes]
+    assert new_votes, "new acceptors never voted"
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1] == [b"before", b"after"]
+
+
+def test_matchmaker_gc():
+    (transport, _, _, matchmakers, reconfigurer, _, _, clients) = make_mmp()
+    transport.deliver_all()
+    clients[0].write(0, b"x")
+    transport.deliver_all()
+    reconfigurer.reconfigure(SimpleMajority([0, 1, 2]))
+    transport.deliver_all()
+    # Phase 1 of the new round garbage collected older configurations.
+    for matchmaker in matchmakers:
+        if matchmaker.configurations:
+            assert min(matchmaker.configurations) > matchmaker.gc_watermark
+
+
+def test_survives_f_matchmaker_deaths():
+    (transport, _, _, matchmakers, reconfigurer, _, replicas, clients) = \
+        make_mmp()
+    transport.deliver_all()
+    # Kill one matchmaker (f = 1) via chaos Die.
+    matchmakers[0].receive("chaos", Die())
+    got = []
+    clients[0].write(0, b"resilient", got.append)
+    transport.deliver_all()
+    reconfigurer.reconfigure(SimpleMajority([0, 1, 2]))
+    transport.deliver_all()
+    clients[0].write(0, b"post-reconfig", got.append)
+    transport.deliver_all()
+    assert got == [b"0", b"1"]
