@@ -1,0 +1,171 @@
+"""ctypes loader for the native wire codec (with pure-Python fallback).
+
+Compiles ``codec.cpp`` with g++ on first use (cached as
+``libfpxcodec.so`` next to the source; rebuilds when the source is
+newer). Every entry point has a NumPy/struct fallback so the framework
+runs where no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cpp")
+_LIB = os.path.join(_DIR, "libfpxcodec.so")
+_LEN = struct.Struct(">I")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+        check=True, capture_output=True)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The codec library, building it if needed; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.fpx_encode_frame.restype = ctypes.c_longlong
+        lib.fpx_encode_frame.argtypes = [
+            u8p, ctypes.c_uint32, u8p, ctypes.c_uint32, u8p,
+            ctypes.c_uint64]
+        lib.fpx_encode_frames.restype = ctypes.c_longlong
+        lib.fpx_encode_frames.argtypes = [
+            u8p, ctypes.c_uint32, u8p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, u8p, ctypes.c_uint64]
+        lib.fpx_scan_frames.restype = ctypes.c_longlong
+        lib.fpx_scan_frames.argtypes = [
+            u8p, ctypes.c_uint64, u64p, ctypes.c_uint32, u64p]
+        lib.fpx_pack_votes.restype = ctypes.c_longlong
+        lib.fpx_pack_votes.argtypes = [
+            i32p, i32p, i32p, ctypes.c_uint32, u8p, ctypes.c_uint64]
+        lib.fpx_unpack_votes.restype = ctypes.c_longlong
+        lib.fpx_unpack_votes.argtypes = [
+            u8p, ctypes.c_uint64, i32p, i32p, i32p, ctypes.c_uint32]
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError):
+        _load_failed = True
+    return _lib
+
+
+def _as_u8p(buf) -> ctypes.POINTER(ctypes.c_uint8):  # type: ignore[misc]
+    return (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) if buf else \
+        ctypes.cast(0, ctypes.POINTER(ctypes.c_uint8))
+
+
+def encode_frame(header: bytes, payload: bytes) -> bytes:
+    """One wire frame: [u32 total][u32 hlen][header][payload]."""
+    lib = load()
+    if lib is None:
+        inner = _LEN.pack(len(header)) + header + payload
+        return _LEN.pack(len(inner)) + inner
+    out = (ctypes.c_uint8 * (12 + len(header) + len(payload)))()
+    n = lib.fpx_encode_frame(_as_u8p(header), len(header),
+                             _as_u8p(payload), len(payload), out, len(out))
+    if n == -2:
+        raise ValueError("frame exceeds the 10 MiB cap")
+    assert n >= 0
+    return bytes(out[:n])
+
+
+def encode_frames(header: bytes, payloads: list[bytes]) -> bytes:
+    """Coalesce many same-header frames into one write buffer."""
+    lib = load()
+    if lib is None:
+        return b"".join(encode_frame(header, p) for p in payloads)
+    blob = b"".join(payloads)
+    lens = (ctypes.c_uint32 * len(payloads))(*[len(p) for p in payloads])
+    cap = sum(12 + len(header) + len(p) for p in payloads)
+    out = (ctypes.c_uint8 * max(cap, 1))()
+    n = lib.fpx_encode_frames(_as_u8p(header), len(header), _as_u8p(blob),
+                              lens, len(payloads), out, len(out))
+    if n == -2:
+        raise ValueError("frame exceeds the 10 MiB cap")
+    assert n >= 0
+    return bytes(out[:n])
+
+
+def scan_frames(buf: bytes, max_frames: int = 4096
+                ) -> tuple[list[tuple[int, int]], int]:
+    """Complete frames' (start, end) inner offsets + consumed bytes."""
+    lib = load()
+    if lib is None:
+        frames, pos = [], 0
+        while pos + 4 <= len(buf):
+            (inner,) = _LEN.unpack_from(buf, pos)
+            if pos + 4 + inner > len(buf):
+                break
+            frames.append((pos + 4, pos + 4 + inner))
+            pos += 4 + inner
+        return frames, pos
+    offsets = (ctypes.c_uint64 * (2 * max_frames))()
+    consumed = ctypes.c_uint64()
+    n = lib.fpx_scan_frames(_as_u8p(buf), len(buf), offsets, max_frames,
+                            ctypes.byref(consumed))
+    if n == -2:
+        raise ValueError("frame exceeds the 10 MiB cap")
+    return ([(offsets[2 * i], offsets[2 * i + 1]) for i in range(n)],
+            consumed.value)
+
+
+def pack_votes(slots: np.ndarray, nodes: np.ndarray,
+               rounds: np.ndarray) -> bytes:
+    """Phase2b vote batch -> bytes (feeds TpuQuorumChecker directly)."""
+    slots = np.ascontiguousarray(slots, dtype=np.int32)
+    nodes = np.ascontiguousarray(nodes, dtype=np.int32)
+    rounds = np.ascontiguousarray(rounds, dtype=np.int32)
+    lib = load()
+    if lib is None:
+        out = np.empty((slots.shape[0], 3), dtype="<i4")
+        out[:, 0], out[:, 1], out[:, 2] = slots, nodes, rounds
+        return struct.pack("<I", slots.shape[0]) + out.tobytes()
+    n = slots.shape[0]
+    out = (ctypes.c_uint8 * (4 + 12 * n))()
+    written = lib.fpx_pack_votes(
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, out, len(out))
+    assert written == len(out)
+    return bytes(out)
+
+
+def unpack_votes(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    lib = load()
+    if lib is None:
+        (n,) = struct.unpack_from("<I", buf, 0)
+        flat = np.frombuffer(buf, dtype="<i4", count=3 * n, offset=4)
+        triples = flat.reshape(n, 3)
+        return (triples[:, 0].copy(), triples[:, 1].copy(),
+                triples[:, 2].copy())
+    (n,) = struct.unpack_from("<I", buf, 0)
+    slots = np.empty(n, dtype=np.int32)
+    nodes = np.empty(n, dtype=np.int32)
+    rounds = np.empty(n, dtype=np.int32)
+    got = lib.fpx_unpack_votes(
+        _as_u8p(buf), len(buf),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+    if got < 0:
+        raise ValueError("malformed vote batch")
+    return slots, nodes, rounds

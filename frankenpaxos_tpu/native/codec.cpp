@@ -1,0 +1,141 @@
+// Native wire codec for the frankenpaxos_tpu transport hot path.
+//
+// The reference's only performance-critical native-adjacent component is
+// its Netty NIO TCP stack (SURVEY.md section 0; build.sbt:31): framing and
+// byte shuffling on the JVM's native transport. This is our equivalent:
+// a small C++ library (loaded via ctypes) implementing
+//
+//   * length-prefixed frame encoding/decoding compatible with
+//     runtime/tcp_transport.py's format:
+//       [u32 total][u32 header_len][header "host:port"][payload]
+//     including batch encoding (coalesce many frames into one write
+//     buffer, the send_no_flush/flush path), and
+//
+//   * the Phase2b vote-batch codec: pack/unpack arrays of
+//     (slot, acceptor, round) int32 triples -- the wire format that feeds
+//     TpuQuorumChecker.record_and_check without any per-message Python
+//     object churn.
+//
+// Build: g++ -O3 -shared -fPIC codec.cpp -o libfpxcodec.so (done lazily by
+// native/__init__.py, cached next to the source).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline void put_u32_be(uint8_t* p, uint32_t x) {
+  p[0] = static_cast<uint8_t>(x >> 24);
+  p[1] = static_cast<uint8_t>(x >> 16);
+  p[2] = static_cast<uint8_t>(x >> 8);
+  p[3] = static_cast<uint8_t>(x);
+}
+
+inline uint32_t get_u32_be(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+
+constexpr uint32_t kMaxFrame = 10 * 1024 * 1024;  // NettyTcpTransport's cap
+
+}  // namespace
+
+extern "C" {
+
+// Encode one frame into `out`. Returns bytes written, or -1 if `out_cap`
+// is too small, or -2 if the frame would exceed the 10 MiB cap.
+long long fpx_encode_frame(const uint8_t* header, uint32_t header_len,
+                           const uint8_t* payload, uint32_t payload_len,
+                           uint8_t* out, uint64_t out_cap) {
+  const uint64_t inner = 4ull + header_len + payload_len;
+  const uint64_t total = 4ull + inner;
+  if (inner > kMaxFrame) return -2;
+  if (total > out_cap) return -1;
+  put_u32_be(out, static_cast<uint32_t>(inner));
+  put_u32_be(out + 4, header_len);
+  std::memcpy(out + 8, header, header_len);
+  std::memcpy(out + 8 + header_len, payload, payload_len);
+  return static_cast<long long>(total);
+}
+
+// Coalesce `n` frames (shared header) into one buffer. `payloads` is a
+// contiguous blob; `payload_lens[i]` gives each payload's length. Returns
+// total bytes written or -1/-2 as above.
+long long fpx_encode_frames(const uint8_t* header, uint32_t header_len,
+                            const uint8_t* payloads,
+                            const uint32_t* payload_lens, uint32_t n,
+                            uint8_t* out, uint64_t out_cap) {
+  uint64_t written = 0;
+  uint64_t offset = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    long long r =
+        fpx_encode_frame(header, header_len, payloads + offset,
+                         payload_lens[i], out + written, out_cap - written);
+    if (r < 0) return r;
+    written += static_cast<uint64_t>(r);
+    offset += payload_lens[i];
+  }
+  return static_cast<long long>(written);
+}
+
+// Scan `buf` for complete frames. Writes up to `max_frames` (start, end)
+// byte offsets of each frame's inner region (header_len prefix included)
+// into `offsets` (2 entries per frame). Returns the number of complete
+// frames found; `*consumed` is set to the end of the last complete frame.
+long long fpx_scan_frames(const uint8_t* buf, uint64_t len,
+                          uint64_t* offsets, uint32_t max_frames,
+                          uint64_t* consumed) {
+  uint64_t pos = 0;
+  uint32_t found = 0;
+  while (found < max_frames && pos + 4 <= len) {
+    const uint32_t inner = get_u32_be(buf + pos);
+    if (inner > kMaxFrame) return -2;
+    if (pos + 4 + inner > len) break;
+    offsets[2 * found] = pos + 4;
+    offsets[2 * found + 1] = pos + 4 + inner;
+    pos += 4ull + inner;
+    ++found;
+  }
+  *consumed = pos;
+  return found;
+}
+
+// --- Phase2b vote-batch codec ---------------------------------------------
+// Wire layout: [u32 count][count * (i32 slot, i32 node, i32 round)] with
+// little-endian fixed-width ints (the host side hands these straight to
+// TpuQuorumChecker as numpy arrays).
+
+long long fpx_pack_votes(const int32_t* slots, const int32_t* nodes,
+                         const int32_t* rounds, uint32_t n, uint8_t* out,
+                         uint64_t out_cap) {
+  const uint64_t total = 4ull + 12ull * n;
+  if (total > out_cap) return -1;
+  std::memcpy(out, &n, 4);
+  int32_t* p = reinterpret_cast<int32_t*>(out + 4);
+  for (uint32_t i = 0; i < n; ++i) {
+    p[3 * i] = slots[i];
+    p[3 * i + 1] = nodes[i];
+    p[3 * i + 2] = rounds[i];
+  }
+  return static_cast<long long>(total);
+}
+
+// Returns the vote count, filling the three output arrays (each with
+// capacity `cap`), or -1 on malformed input.
+long long fpx_unpack_votes(const uint8_t* buf, uint64_t len, int32_t* slots,
+                           int32_t* nodes, int32_t* rounds, uint32_t cap) {
+  if (len < 4) return -1;
+  uint32_t n;
+  std::memcpy(&n, buf, 4);
+  if (len < 4ull + 12ull * n || n > cap) return -1;
+  const int32_t* p = reinterpret_cast<const int32_t*>(buf + 4);
+  for (uint32_t i = 0; i < n; ++i) {
+    slots[i] = p[3 * i];
+    nodes[i] = p[3 * i + 1];
+    rounds[i] = p[3 * i + 2];
+  }
+  return n;
+}
+
+}  // extern "C"

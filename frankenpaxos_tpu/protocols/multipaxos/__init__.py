@@ -26,6 +26,10 @@ from frankenpaxos_tpu.protocols.multipaxos.proxy_replica import (
     ProxyReplica,
     ProxyReplicaOptions,
 )
+from frankenpaxos_tpu.protocols.multipaxos.read_batcher import (
+    ReadBatcher,
+    ReadBatchingScheme,
+)
 from frankenpaxos_tpu.protocols.multipaxos.replica import Replica, ReplicaOptions
 
 __all__ = [
@@ -43,6 +47,8 @@ __all__ = [
     "ProxyLeaderOptions",
     "ProxyReplica",
     "ProxyReplicaOptions",
+    "ReadBatcher",
+    "ReadBatchingScheme",
     "Replica",
     "ReplicaOptions",
 ]

@@ -131,6 +131,29 @@ class Client(Actor):
         self._check_idle(pseudonym)
         callback = callback or (lambda _: None)
         id = self.ids.get(pseudonym, 0)
+        if self.config.num_read_batchers > 0:
+            # Let a read batcher amortize the quorum round
+            # (Client.scala:665-690).
+            read_request = ReadRequest(
+                slot=-1,
+                command=Command(CommandId(self.address, pseudonym, id),
+                                command))
+            batcher = self.config.read_batcher_addresses[
+                self.rng.randrange(self.config.num_read_batchers)]
+            self.send(batcher, read_request)
+
+            def resend_batched():
+                self.send(batcher, read_request)
+                timer.start()
+
+            timer = self.timer(
+                f"resendRead{pseudonym}",
+                self.options.resend_read_request_period_s, resend_batched)
+            timer.start()
+            self.states[pseudonym] = _PendingRead(id, command, callback,
+                                                  timer)
+            self.ids[pseudonym] = id + 1
+            return
         request = MaxSlotRequest(CommandId(self.address, pseudonym, id))
         if not self.config.flexible:
             group_index = self.rng.randrange(self.config.num_acceptor_groups)

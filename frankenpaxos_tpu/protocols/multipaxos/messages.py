@@ -212,6 +212,23 @@ class ReadReplyBatch:
     batch: tuple[ReadReply, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class ReadRequestBatch:
+    slot: int
+    commands: tuple[Command, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SequentialReadRequestBatch:
+    slot: int
+    commands: tuple[Command, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class EventualReadRequestBatch:
+    commands: tuple[Command, ...]
+
+
 # --- read batcher -----------------------------------------------------------
 
 

@@ -31,12 +31,15 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Command,
     CommandBatch,
     EventualReadRequest,
+    EventualReadRequestBatch,
     Noop,
     ReadReply,
     ReadReplyBatch,
     ReadRequest,
+    ReadRequestBatch,
     Recover,
     SequentialReadRequest,
+    SequentialReadRequestBatch,
 )
 
 
@@ -180,8 +183,30 @@ class Replica(Actor):
             self._handle_sequential_read_request(src, message)
         elif isinstance(message, EventualReadRequest):
             self._handle_eventual_read_request(src, message)
+        elif isinstance(message, ReadRequestBatch):
+            self._handle_read_request_batch(src, message)
+        elif isinstance(message, SequentialReadRequestBatch):
+            self._handle_read_request_batch(src, ReadRequestBatch(
+                slot=message.slot, commands=message.commands))
+        elif isinstance(message, EventualReadRequestBatch):
+            self._send_read_replies(
+                [self._execute_read(c) for c in message.commands])
         else:
             self.logger.fatal(f"unexpected replica message {message!r}")
+
+    def _handle_read_request_batch(self, src: Address,
+                                   batch: ReadRequestBatch) -> None:
+        """Batched deferrable reads (Replica.scala:478-530
+        handleDeferrableReads)."""
+        if batch.slot >= self.executed_watermark:
+            reads = self.deferred_reads.get(batch.slot)
+            if reads is None:
+                self.deferred_reads.put(batch.slot, list(batch.commands))
+            else:
+                reads.extend(batch.commands)
+            return
+        self._send_read_replies(
+            [self._execute_read(c) for c in batch.commands])
 
     def _handle_chosen(self, src: Address, chosen: Chosen) -> None:
         """(Replica.scala:572-628)."""

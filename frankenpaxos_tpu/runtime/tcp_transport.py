@@ -39,12 +39,13 @@ _LEN = struct.Struct(">I")
 
 
 def _encode_frame(src: Address, data: bytes) -> bytes:
+    # The framing hot path runs through the native C++ codec when built
+    # (frankenpaxos_tpu/native/codec.cpp), with an identical pure-Python
+    # fallback inside `native.encode_frame`.
+    from frankenpaxos_tpu import native
+
     host, port = src
-    header = f"{host}:{port}".encode()
-    payload = _LEN.pack(len(header)) + header + data
-    if len(payload) > MAX_FRAME:
-        raise ValueError(f"frame of {len(payload)} bytes exceeds {MAX_FRAME}")
-    return _LEN.pack(len(payload)) + payload
+    return native.encode_frame(f"{host}:{port}".encode(), data)
 
 
 class TcpTimer(Timer):

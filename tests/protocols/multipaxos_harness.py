@@ -24,6 +24,8 @@ from frankenpaxos_tpu.protocols.multipaxos import (
     ProxyLeader,
     ProxyLeaderOptions,
     ProxyReplica,
+    ReadBatcher,
+    ReadBatchingScheme,
     Replica,
     ReplicaOptions,
 )
@@ -47,6 +49,9 @@ def make_multipaxos(
     num_clients: int = 1,
     num_acceptor_groups: int = 1,
     num_batchers: int = 0,
+    num_read_batchers: int = 0,
+    read_batching_scheme: ReadBatchingScheme = ReadBatchingScheme(
+        kind="size", batch_size=1),
     num_proxy_replicas: int = 0,
     flexible: bool = False,
     grid_shape: tuple[int, int] | None = None,
@@ -71,7 +76,8 @@ def make_multipaxos(
     config = MultiPaxosConfig(
         f=f,
         batcher_addresses=[f"batcher-{i}" for i in range(num_batchers)],
-        read_batcher_addresses=[],
+        read_batcher_addresses=[f"read-batcher-{i}"
+                                for i in range(num_read_batchers)],
         leader_addresses=[f"leader-{i}" for i in range(f + 1)],
         leader_election_addresses=[f"election-{i}" for i in range(f + 1)],
         proxy_leader_addresses=[f"proxy-leader-{i}" for i in range(f + 1)],
@@ -88,6 +94,10 @@ def make_multipaxos(
         Batcher(a, transport, logger, config,
                 BatcherOptions(batch_size=batch_size))
         for a in config.batcher_addresses]
+    read_batchers = [
+        ReadBatcher(a, transport, logger, config, read_batching_scheme,
+                    seed=seed + 40 + i)
+        for i, a in enumerate(config.read_batcher_addresses)]
     leaders = [
         Leader(a, transport, logger, config,
                LeaderOptions(resend_phase1as_period_s=5.0), seed=seed + i)

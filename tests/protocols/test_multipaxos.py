@@ -140,6 +140,51 @@ class TestMultiPaxosIntegration:
         assert seq and seq[0].key_values == (("k", "v"),)
         assert ev and ev[0].key_values == (("k", "v"),)
 
+    def test_read_batcher_linearizable(self):
+        from frankenpaxos_tpu.protocols.multipaxos import ReadBatchingScheme
+
+        sim = make_multipaxos(f=1, state_machine_factory=KeyValueStore,
+                              num_read_batchers=2,
+                              read_batching_scheme=ReadBatchingScheme(
+                                  kind="size", batch_size=2))
+        client = sim.clients[0]
+        client.write(0, SER.to_bytes(SetRequest((("k", "v"),))))
+        sim.transport.deliver_all()
+        reads = []
+        # Two reads from two pseudonyms fill one batch of two.
+        client.read(1, SER.to_bytes(GetRequest(("k",))),
+                    lambda r: reads.append(SER.from_bytes(r)))
+        client.read(2, SER.to_bytes(GetRequest(("k",))),
+                    lambda r: reads.append(SER.from_bytes(r)))
+        sim.transport.deliver_all()
+        for _ in range(5):
+            if len(reads) == 2:
+                break
+            for timer in sim.transport.running_timers():
+                if "Timer" in timer.name or timer.name.startswith(
+                        "resendRead"):
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all()
+        assert len(reads) == 2
+        assert all(r.key_values == (("k", "v"),) for r in reads)
+
+    def test_read_batcher_adaptive(self):
+        from frankenpaxos_tpu.protocols.multipaxos import ReadBatchingScheme
+
+        sim = make_multipaxos(f=1, state_machine_factory=KeyValueStore,
+                              num_read_batchers=2,
+                              read_batching_scheme=ReadBatchingScheme(
+                                  kind="adaptive"))
+        client = sim.clients[0]
+        client.write(0, SER.to_bytes(SetRequest((("k", "v"),))))
+        sim.transport.deliver_all()
+        reads = []
+        client.read(1, SER.to_bytes(GetRequest(("k",))),
+                    lambda r: reads.append(SER.from_bytes(r)))
+        sim.transport.deliver_all()
+        assert len(reads) == 1
+        assert reads[0].key_values == (("k", "v"),)
+
     def test_write_resend_is_deduplicated(self):
         sim = make_multipaxos(f=1)
         got = []

@@ -1,0 +1,88 @@
+"""Native C++ codec vs the Python fallback, and TcpTransport compatibility."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("g++ unavailable; native codec not built")
+    return lib
+
+
+def test_native_library_builds(lib):
+    assert lib is not None
+
+
+def test_encode_frame_matches_tcp_transport_format(lib):
+    from frankenpaxos_tpu.runtime.tcp_transport import _encode_frame
+
+    header = b"127.0.0.1:9000"
+    payload = b"payload-bytes"
+    native_frame = native.encode_frame(header, payload)
+    reference_frame = _encode_frame(("127.0.0.1", 9000), payload)
+    assert native_frame == reference_frame
+
+
+def test_encode_decode_roundtrip(lib):
+    header = b"h:1"
+    payloads = [b"a", b"bb" * 100, b"", b"xyz"]
+    blob = native.encode_frames(header, payloads)
+    frames, consumed = native.scan_frames(blob)
+    assert consumed == len(blob)
+    assert len(frames) == len(payloads)
+    for (start, end), payload in zip(frames, payloads):
+        (hlen,) = struct.unpack(">I", blob[start:start + 4])
+        assert blob[start + 4:start + 4 + hlen] == header
+        assert blob[start + 4 + hlen:end] == payload
+
+
+def test_scan_partial_frame(lib):
+    blob = native.encode_frames(b"h", [b"one", b"two"])
+    frames, consumed = native.scan_frames(blob[:-1])
+    assert len(frames) == 1
+    assert consumed < len(blob)
+
+
+def test_oversized_frame_rejected(lib):
+    with pytest.raises(ValueError):
+        native.encode_frame(b"h", b"x" * (10 * 1024 * 1024))
+
+
+def test_vote_batch_roundtrip(lib):
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, 1 << 20, 1000).astype(np.int32)
+    nodes = rng.integers(0, 6, 1000).astype(np.int32)
+    rounds = rng.integers(-1, 5, 1000).astype(np.int32)
+    packed = native.pack_votes(slots, nodes, rounds)
+    assert len(packed) == 4 + 12 * 1000
+    s, n, r = native.unpack_votes(packed)
+    np.testing.assert_array_equal(s, slots)
+    np.testing.assert_array_equal(n, nodes)
+    np.testing.assert_array_equal(r, rounds)
+
+
+def test_native_matches_python_fallback(lib, monkeypatch):
+    header, payloads = b"a:2", [b"p1", b"p2p2"]
+    slots = np.array([1, 2, 3], dtype=np.int32)
+    nodes = np.array([0, 1, 0], dtype=np.int32)
+    rounds = np.array([0, 0, 1], dtype=np.int32)
+    native_frames = native.encode_frames(header, payloads)
+    native_votes = native.pack_votes(slots, nodes, rounds)
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", True)
+    assert native.load() is None
+    assert native.encode_frames(header, payloads) == native_frames
+    assert native.pack_votes(slots, nodes, rounds) == native_votes
+    frames, consumed = native.scan_frames(native_frames)
+    assert consumed == len(native_frames)
+    assert len(frames) == 2
+    s, n, r = native.unpack_votes(native_votes)
+    np.testing.assert_array_equal(s, slots)
