@@ -1,0 +1,125 @@
+"""Benchmark orchestration harness.
+
+Reference behavior: benchmarks/ (proc.py:65-160 PopenProc, host.py:10-37,
+benchmark.py:73-335 SuiteDirectory/BenchmarkDirectory/Suite with
+latency/throughput output schemas, workload.py). This is the local-
+process slice of that harness: launch every role as its own OS process
+via the CLI (frankenpaxos_tpu/cli.py), drive a closed-loop workload from
+in-process clients, and record the reference-compatible stats
+(latency.median_ms, start_throughput_1s.p90 analogs) as JSON/CSV.
+SSH deployment (ParamikoProc) plugs in behind Proc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Proc:
+    """A managed subprocess (the PopenProc shape, proc.py:65-110)."""
+
+    def __init__(self, args: Sequence[str], out_path: str):
+        self._out = open(out_path, "w")
+        self._proc = subprocess.Popen(
+            list(args), stdout=self._out, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def kill(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._out.close()
+
+    def running(self) -> bool:
+        return self._proc.poll() is None
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalHost:
+    """(host.py:10-24)."""
+
+    ip: str = "127.0.0.1"
+
+    def popen(self, args: Sequence[str], out_path: str) -> Proc:
+        return Proc(args, out_path)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class BenchmarkDirectory:
+    """A directory collecting one benchmark's artifacts
+    (benchmark.py:220-340)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.procs: list[Proc] = []
+
+    def abspath(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+    def write_json(self, name: str, data) -> str:
+        path = self.abspath(name)
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, default=str)
+        return path
+
+    def popen(self, host: LocalHost, label: str,
+              args: Sequence[str]) -> Proc:
+        proc = host.popen(args, self.abspath(f"{label}.log"))
+        self.procs.append(proc)
+        return proc
+
+    def cleanup(self) -> None:
+        for proc in self.procs:
+            proc.kill()
+
+
+class SuiteDirectory:
+    """(benchmark.py:73-130)."""
+
+    def __init__(self, root: str, name: str):
+        self.path = os.path.join(root, f"{name}_{int(time.time())}")
+        os.makedirs(self.path, exist_ok=True)
+        self._counter = 0
+
+    def benchmark_directory(self) -> BenchmarkDirectory:
+        self._counter += 1
+        return BenchmarkDirectory(
+            os.path.join(self.path, f"{self._counter:03d}"))
+
+
+def latency_throughput_stats(latencies_s: Sequence[float],
+                             duration_s: float) -> dict:
+    """The reference's output schema essentials (benchmark.py:310-335)."""
+    lat = np.asarray(sorted(latencies_s))
+    if lat.size == 0:
+        return {"num_requests": 0}
+    return {
+        "num_requests": int(lat.size),
+        "latency.median_ms": float(np.median(lat) * 1000),
+        "latency.p90_ms": float(np.percentile(lat, 90) * 1000),
+        "latency.p99_ms": float(np.percentile(lat, 99) * 1000),
+        "start_throughput_1s.p90": float(lat.size / duration_s),
+    }

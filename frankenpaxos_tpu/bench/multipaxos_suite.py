@@ -1,0 +1,149 @@
+"""MultiPaxos deployment benchmark: every role its own OS process.
+
+The analog of benchmarks/multipaxos/multipaxos.py: compute a placement
+(ports on localhost; multipaxos.py:199-246), write the cluster config,
+launch every role via the CLI over real TCP (multipaxos.py:311-577),
+drive closed-loop clients, and report the reference-compatible stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+
+from frankenpaxos_tpu.bench.harness import (
+    BenchmarkDirectory,
+    LocalHost,
+    free_port,
+    latency_throughput_stats,
+)
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+from frankenpaxos_tpu.runtime.serializer import PickleSerializer
+from frankenpaxos_tpu.statemachine import SetRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiPaxosInput:
+    """(multipaxos.py:33-96)."""
+
+    f: int = 1
+    num_acceptor_groups: int = 1
+    num_clients: int = 2
+    duration_s: float = 2.0
+    quorum_backend: str = "dict"
+    state_machine: str = "KeyValueStore"
+
+
+def placement(input: MultiPaxosInput) -> dict:
+    def addrs(n):
+        return [["127.0.0.1", free_port()] for _ in range(n)]
+
+    f = input.f
+    return {
+        "f": f,
+        "batchers": [],
+        "read_batchers": [],
+        "leaders": addrs(f + 1),
+        "leader_elections": addrs(f + 1),
+        "proxy_leaders": addrs(f + 1),
+        "acceptors": [addrs(2 * f + 1)
+                      for _ in range(input.num_acceptor_groups)],
+        "replicas": addrs(f + 1),
+        "proxy_replicas": [],
+    }
+
+
+def run_benchmark(bench: BenchmarkDirectory,
+                  input: MultiPaxosInput) -> dict:
+    host = LocalHost()
+    config_raw = placement(input)
+    config_path = bench.write_json("config.json", config_raw)
+
+    labels = []
+
+    def launch(role: str, count: int, extra=()):
+        for index in range(count):
+            label = f"{role}_{index}"
+            labels.append(label)
+            bench.popen(host, label, [
+                sys.executable, "-m", "frankenpaxos_tpu.cli",
+                "--protocol", "multipaxos", "--role", role,
+                "--index", str(index), "--config", config_path,
+                "--state_machine", input.state_machine,
+                "--quorum_backend", input.quorum_backend, *extra])
+
+    f = input.f
+    launch("acceptor", (2 * f + 1) * input.num_acceptor_groups)
+    launch("replica", f + 1)
+    launch("proxy_leader", f + 1)
+    launch("leader", f + 1)
+
+    # Wait for every role to report it's listening (process startup --
+    # imports in particular -- dominates; poll rather than guess).
+    deadline = time.time() + 120
+    pending = set(labels)
+    while pending and time.time() < deadline:
+        for label in list(pending):
+            try:
+                with open(bench.abspath(f"{label}.log")) as f_log:
+                    if "listening" in f_log.read():
+                        pending.discard(label)
+            except OSError:
+                pass
+        time.sleep(0.25)
+    if pending:
+        bench.cleanup()
+        raise RuntimeError(f"roles never became ready: {sorted(pending)}")
+    time.sleep(1.0)  # let leader 0 finish phase 1 against live acceptors
+
+    # Closed-loop clients (in-process, real TCP).
+    from frankenpaxos_tpu.cli import load_multipaxos_config
+    from frankenpaxos_tpu.protocols.multipaxos import Client, ClientOptions
+
+    config = load_multipaxos_config(config_path)
+    serializer = PickleSerializer()
+    latencies: list[float] = []
+    lock = threading.Lock()
+    stop_at = time.time() + input.duration_s
+
+    def run_client(i: int) -> None:
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = TcpTransport(("127.0.0.1", free_port()), logger)
+        transport.start()
+        client = Client(transport.listen_address, transport, logger,
+                        config, ClientOptions(), seed=i)
+        try:
+            k = 0
+            while time.time() < stop_at:
+                done = threading.Event()
+                t0 = time.perf_counter()
+                transport.loop.call_soon_threadsafe(
+                    client.write, 0,
+                    serializer.to_bytes(
+                        SetRequest(((f"k{i}", str(k)),))),
+                    lambda _: done.set())
+                if not done.wait(timeout=10):
+                    break
+                with lock:
+                    latencies.append(time.perf_counter() - t0)
+                k += 1
+        finally:
+            transport.stop()
+
+    threads = [threading.Thread(target=run_client, args=(i,))
+               for i in range(input.num_clients)]
+    start = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.time() - start
+
+    bench.cleanup()
+    stats = latency_throughput_stats(latencies, elapsed)
+    stats["input"] = dataclasses.asdict(input)
+    bench.write_json("results.json", stats)
+    return stats

@@ -1,0 +1,40 @@
+"""Deployment smoke test: MultiPaxos over real localhost processes.
+
+The analog of benchmarks/multipaxos/smoke.py + scripts/benchmark_smoke.sh.
+
+Usage: python -m frankenpaxos_tpu.bench.smoke [--duration 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+
+from frankenpaxos_tpu.bench.harness import SuiteDirectory
+from frankenpaxos_tpu.bench.multipaxos_suite import (
+    MultiPaxosInput,
+    run_benchmark,
+)
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration", type=float, default=2.0)
+    parser.add_argument("--num_clients", type=int, default=2)
+    parser.add_argument("--suite_dir", default=None)
+    args = parser.parse_args(argv)
+
+    root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_smoke_")
+    suite = SuiteDirectory(root, "multipaxos_smoke")
+    stats = run_benchmark(
+        suite.benchmark_directory(),
+        MultiPaxosInput(duration_s=args.duration,
+                        num_clients=args.num_clients))
+    print(json.dumps(stats, indent=2))
+    assert stats["num_requests"] > 0, "smoke benchmark made no progress"
+    return stats
+
+
+if __name__ == "__main__":
+    main()

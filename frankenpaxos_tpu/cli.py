@@ -1,0 +1,185 @@
+"""Deployment CLI: one process per role over TcpTransport.
+
+The analog of the reference's 105 ``<Role>Main`` objects
+(jvm/src/main/scala/frankenpaxos/<proto>/<Role>Main.scala): parse flags
+(``--protocol``, ``--role``, ``--index``, ``--config``, ``--log_level``,
+``--prometheus_port``, ``--state_machine``; LeaderMain.scala:19-103),
+read a cluster config file (the prototext analog is JSON here;
+ConfigUtil.scala:7-43), construct the role actor over TcpTransport, and
+optionally expose Prometheus metrics (PrometheusUtil.scala:6-15).
+
+Usage::
+
+    python -m frankenpaxos_tpu.cli --protocol multipaxos --role acceptor \
+        --index 2 --config cluster.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from frankenpaxos_tpu.runtime import LogLevel, PrintLogger
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+from frankenpaxos_tpu.statemachine import state_machine_by_name
+
+
+def _addr(x) -> tuple:
+    return (x[0], int(x[1]))
+
+
+def load_multipaxos_config(path: str):
+    from frankenpaxos_tpu.protocols.multipaxos import (
+        DistributionScheme,
+        MultiPaxosConfig,
+    )
+
+    with open(path) as f:
+        raw = json.load(f)
+    config = MultiPaxosConfig(
+        f=raw["f"],
+        batcher_addresses=[_addr(a) for a in raw.get("batchers", [])],
+        read_batcher_addresses=[_addr(a)
+                                for a in raw.get("read_batchers", [])],
+        leader_addresses=[_addr(a) for a in raw["leaders"]],
+        leader_election_addresses=[_addr(a)
+                                   for a in raw["leader_elections"]],
+        proxy_leader_addresses=[_addr(a) for a in raw["proxy_leaders"]],
+        acceptor_addresses=[[_addr(a) for a in group]
+                            for group in raw["acceptors"]],
+        replica_addresses=[_addr(a) for a in raw["replicas"]],
+        proxy_replica_addresses=[_addr(a)
+                                 for a in raw.get("proxy_replicas", [])],
+        flexible=raw.get("flexible", False),
+        distribution_scheme=DistributionScheme(
+            raw.get("distribution_scheme", "hash")),
+    )
+    config.check_valid()
+    return config
+
+
+def make_multipaxos_role(role: str, index: int, config, transport, logger,
+                         args):
+    from frankenpaxos_tpu.protocols import multipaxos as mp
+
+    if role == "batcher":
+        return mp.Batcher(config.batcher_addresses[index], transport,
+                          logger, config,
+                          mp.BatcherOptions(batch_size=args.batch_size))
+    if role == "read_batcher":
+        return mp.ReadBatcher(config.read_batcher_addresses[index],
+                              transport, logger, config,
+                              mp.ReadBatchingScheme(
+                                  kind=args.read_batching_scheme,
+                                  batch_size=args.batch_size),
+                              seed=args.seed)
+    if role == "leader":
+        return mp.Leader(config.leader_addresses[index], transport, logger,
+                         config, mp.LeaderOptions(), seed=args.seed)
+    if role == "proxy_leader":
+        return mp.ProxyLeader(
+            config.proxy_leader_addresses[index], transport, logger, config,
+            mp.ProxyLeaderOptions(quorum_backend=args.quorum_backend),
+            seed=args.seed)
+    if role == "acceptor":
+        flat = [a for group in config.acceptor_addresses for a in group]
+        return mp.Acceptor(flat[index], transport, logger, config)
+    if role == "replica":
+        return mp.Replica(config.replica_addresses[index], transport,
+                          logger, state_machine_by_name(args.state_machine),
+                          config, mp.ReplicaOptions(), seed=args.seed)
+    if role == "proxy_replica":
+        return mp.ProxyReplica(config.proxy_replica_addresses[index],
+                               transport, logger, config)
+    raise ValueError(f"unknown multipaxos role {role!r}")
+
+
+def role_address(protocol: str, role: str, index: int, config):
+    if protocol == "multipaxos":
+        table = {
+            "batcher": config.batcher_addresses,
+            "read_batcher": config.read_batcher_addresses,
+            "leader": config.leader_addresses,
+            "proxy_leader": config.proxy_leader_addresses,
+            "acceptor": [a for group in config.acceptor_addresses
+                         for a in group],
+            "replica": config.replica_addresses,
+            "proxy_replica": config.proxy_replica_addresses,
+        }
+        return table[role][index]
+    if protocol in ("unreplicated", "echo"):
+        return _addr(config["server"])
+    raise ValueError(f"unknown protocol {protocol!r}")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="frankenpaxos_tpu")
+    parser.add_argument("--protocol", required=True,
+                        choices=["multipaxos", "unreplicated", "echo"])
+    parser.add_argument("--role", required=True)
+    parser.add_argument("--index", type=int, default=0)
+    parser.add_argument("--config", required=True,
+                        help="cluster config JSON")
+    parser.add_argument("--log_level", default="info",
+                        choices=["debug", "info", "warn", "error", "fatal"])
+    parser.add_argument("--state_machine", default="KeyValueStore")
+    parser.add_argument("--batch_size", type=int, default=1)
+    parser.add_argument("--read_batching_scheme", default="size")
+    parser.add_argument("--quorum_backend", default="dict",
+                        choices=["dict", "tpu"])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--prometheus_port", type=int, default=0,
+                        help="0 disables the metrics endpoint")
+    args = parser.parse_args(argv)
+
+    if args.quorum_backend != "tpu":
+        # Only the TPU quorum path needs an accelerator; everything else
+        # pins to CPU so role processes never contend for the chip.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    logger = PrintLogger(LogLevel[args.log_level.upper()])
+
+    if args.protocol == "multipaxos":
+        config = load_multipaxos_config(args.config)
+    else:
+        with open(args.config) as f:
+            config = json.load(f)
+
+    address = role_address(args.protocol, args.role, args.index, config)
+    transport = TcpTransport(address, logger)
+    transport.start()
+
+    if args.protocol == "multipaxos":
+        actor = make_multipaxos_role(args.role, args.index, config,
+                                     transport, logger, args)
+    elif args.protocol == "unreplicated":
+        from frankenpaxos_tpu.protocols.unreplicated import (
+            UnreplicatedServer,
+        )
+
+        actor = UnreplicatedServer(address, transport, logger,
+                                   state_machine_by_name(args.state_machine))
+    else:
+        from frankenpaxos_tpu.protocols.echo import EchoServer
+
+        actor = EchoServer(address, transport, logger)
+
+    if args.prometheus_port > 0:
+        import prometheus_client
+
+        prometheus_client.start_http_server(args.prometheus_port)
+
+    logger.info(f"{args.protocol} {args.role} {args.index} "
+                f"listening on {address}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        transport.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,10 @@ import numpy as np
 
 from frankenpaxos_tpu.quorums.spec import ANY, QuorumSpec
 
-_NEG_INF32 = jnp.int32(-(2**31) + 1)
+# Plain int (promoted inside jit): creating a device array at import
+# time would initialize the backend in every process that merely imports
+# a protocol module.
+_NEG_INF32 = -(2**31) + 1
 
 
 class VoteBoard(NamedTuple):

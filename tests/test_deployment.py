@@ -1,0 +1,21 @@
+"""End-to-end deployment: every MultiPaxos role as its own OS process
+over real TCP, driven by the benchmark harness (the analog of
+scripts/benchmark_smoke.sh)."""
+
+import tempfile
+
+from frankenpaxos_tpu.bench.harness import SuiteDirectory
+from frankenpaxos_tpu.bench.multipaxos_suite import (
+    MultiPaxosInput,
+    run_benchmark,
+)
+
+
+def test_multipaxos_deployment_smoke():
+    suite = SuiteDirectory(tempfile.mkdtemp(prefix="fpx_test_"),
+                           "multipaxos_smoke")
+    stats = run_benchmark(
+        suite.benchmark_directory(),
+        MultiPaxosInput(duration_s=1.0, num_clients=2))
+    assert stats["num_requests"] > 0
+    assert stats["latency.median_ms"] > 0
