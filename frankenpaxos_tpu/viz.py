@@ -1,0 +1,86 @@
+"""Execution trace visualization.
+
+The reference ships 23 Scala.js in-browser protocol visualizations
+(js/src/main/...; SURVEY.md section 1 L5). The TPU-native replacement:
+record a SimTransport execution's delivery/timer history plus per-step
+actor annotations, dump it as JSON, and render it as an interactive
+sequence diagram in a dependency-free HTML viewer
+(``frankenpaxos_tpu/viz_viewer.html``).
+
+Usage::
+
+    recorder = TraceRecorder(transport)
+    ... run the protocol ...
+    recorder.dump("trace.json")
+    # open viz_viewer.html and load trace.json
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from frankenpaxos_tpu.runtime.sim_transport import (
+    DeliverMessage,
+    SimTransport,
+    TriggerTimer,
+)
+
+
+class TraceRecorder:
+    """Snapshots a SimTransport's history into viewer JSON."""
+
+    def __init__(self, transport: SimTransport):
+        self.transport = transport
+
+    def events(self) -> list[dict]:
+        events = []
+        for i, command in enumerate(self.transport.history):
+            if isinstance(command, DeliverMessage):
+                message = command.message
+                events.append({
+                    "step": i,
+                    "kind": "deliver",
+                    "src": str(message.src),
+                    "dst": str(message.dst),
+                    "bytes": len(message.data),
+                    "label": _message_label(self.transport, message),
+                })
+            elif isinstance(command, TriggerTimer):
+                events.append({
+                    "step": i,
+                    "kind": "timer",
+                    "src": str(command.address),
+                    "dst": str(command.address),
+                    "label": command.name,
+                })
+        return events
+
+    def to_dict(self) -> dict:
+        return {
+            "actors": [str(a) for a in self.transport.actors],
+            "events": self.events(),
+        }
+
+    def dump(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+
+def _message_label(transport: SimTransport, message) -> str:
+    actor = transport.actors.get(message.dst)
+    if actor is None:
+        return "?"
+    try:
+        decoded = actor.serializer.from_bytes(message.data)
+        return type(decoded).__name__
+    except Exception:
+        return "?"
+
+
+def viewer_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "viz_viewer.html")
