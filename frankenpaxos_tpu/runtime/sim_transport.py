@@ -130,10 +130,12 @@ class SimTransport(Transport):
         except ValueError:
             self.logger.warn(f"delivering unbuffered message {message}")
             return
-        self.history.append(DeliverMessage(message))
         if (message.dst in self.partitioned
                 or message.src in self.partitioned):
+            # Dropped at the partition: not part of the delivered history
+            # (the trace viewer renders history entries as deliveries).
             return
+        self.history.append(DeliverMessage(message))
         actor = self.actors.get(message.dst)
         if actor is None:
             self.logger.warn(f"no actor registered at {message.dst}")

@@ -32,4 +32,16 @@ def test_trace_records_multipaxos_run(tmp_path):
 def test_viewer_exists():
     with open(viewer_path()) as f:
         content = f.read()
-    assert "<svg" in content or "svg" in content
+    assert "function render" in content
+    assert "esc(" in content  # labels must be escaped before innerHTML
+
+
+def test_partitioned_deliveries_not_in_trace():
+    sim = make_multipaxos(f=1)
+    sim.transport.partition("leader-0")
+    sim.clients[0].write(0, b"dropped")
+    sim.transport.deliver_all()
+    recorder = TraceRecorder(sim.transport)
+    # The ClientRequest to the partitioned leader was dropped; it must
+    # not appear as a delivered arrow.
+    assert not any(e["dst"] == "leader-0" for e in recorder.events())
