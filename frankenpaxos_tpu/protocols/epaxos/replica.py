@@ -19,7 +19,7 @@ from collections import Counter as _Counter
 from typing import Optional, Union
 
 from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable, Executed
-from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.depgraph import make_dependency_graph
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
@@ -87,6 +87,9 @@ class EPaxosReplicaOptions:
     recover_instance_max_period_s: float = 40.0
     unsafe_skip_graph_execution: bool = False
     num_blockers: Optional[int] = 1
+    # "tarjan", "incremental", or "zigzag" (the reference's ReplicaMain
+    # hardwires Zigzag, epaxos/ReplicaMain.scala:127).
+    dependency_graph: str = "tarjan"
 
 
 @dataclasses.dataclass
@@ -173,7 +176,8 @@ class EPaxosReplica(Actor):
         self.default_ballot: Ballot = (0, self.index)
         self.largest_ballot: Ballot = (0, self.index)
         self.leader_states: dict[Instance, object] = {}
-        self.dependency_graph = TarjanDependencyGraph()
+        self.dependency_graph = make_dependency_graph(
+            options.dependency_graph, num_leaders=config.n, make=Instance)
         self.client_table: ClientTable = ClientTable()
         self.conflict_index = state_machine.top_k_conflict_index(
             options.top_k_dependencies, config.n, INSTANCE_LIKE)

@@ -13,7 +13,7 @@ import random
 from typing import Callable, Optional
 
 from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable
-from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.depgraph import make_dependency_graph
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
@@ -42,7 +42,8 @@ class BPaxosReplica(Actor):
                  execute_graph_batch_size: int = 1,
                  recover_vertex_min_period_s: float = 10.0,
                  recover_vertex_max_period_s: float = 20.0,
-                 num_blockers: Optional[int] = 1, seed: int = 0):
+                 num_blockers: Optional[int] = 1, seed: int = 0,
+                 dependency_graph: str = "tarjan"):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
@@ -54,7 +55,9 @@ class BPaxosReplica(Actor):
         self.num_blockers = num_blockers
         self.index = list(config.replica_addresses).index(address)
         self.commands: dict[VertexId, _Committed] = {}
-        self.dependency_graph = TarjanDependencyGraph()
+        self.dependency_graph = make_dependency_graph(
+            dependency_graph,
+            num_leaders=len(config.leader_addresses), make=VertexId)
         self.client_table: ClientTable = ClientTable()
         self.recover_vertex_timers: dict[VertexId, object] = {}
         self.num_pending = 0

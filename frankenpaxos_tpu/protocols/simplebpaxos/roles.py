@@ -18,7 +18,7 @@ from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.utils.topk import VertexIdLike
+from frankenpaxos_tpu.utils.topk import TUPLE_VERTEX_LIKE
 from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     NOOP,
     ClientRequest,
@@ -39,7 +39,7 @@ from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     VoteValue,
 )
 
-VERTEX_LIKE = VertexIdLike(leader_index=lambda v: v[0], id=lambda v: v[1])
+VERTEX_LIKE = TUPLE_VERTEX_LIKE
 
 
 class BPaxosLeader(Actor):

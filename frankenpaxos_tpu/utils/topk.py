@@ -26,6 +26,12 @@ class VertexIdLike(Generic[V]):
     id: Callable[[V], int]
 
 
+# The standard view for tuple-shaped vertex ids ((leader_index, id)
+# tuples or NamedTuples like EPaxos Instance / BPaxos VertexId).
+TUPLE_VERTEX_LIKE: "VertexIdLike" = VertexIdLike(
+    leader_index=lambda v: v[0], id=lambda v: v[1])
+
+
 class TopOne(Generic[V]):
     """Per-leader ``max(id) + 1`` over everything put (TopOne.scala:6+)."""
 

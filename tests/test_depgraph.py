@@ -6,11 +6,14 @@ import random
 import pytest
 
 from frankenpaxos_tpu.depgraph import (
+    IncrementalTarjanDependencyGraph,
     NaiveDependencyGraph,
     TarjanDependencyGraph,
+    ZigzagTarjanDependencyGraph,
 )
 
-IMPLS = [TarjanDependencyGraph, NaiveDependencyGraph]
+IMPLS = [TarjanDependencyGraph, NaiveDependencyGraph,
+         IncrementalTarjanDependencyGraph]
 
 
 def valid_execution_order(executed, committed_deps, executed_before=()):
@@ -166,3 +169,177 @@ def test_blockers_limit():
         g.commit(f"v{i}", i, {f"missing{i}"})
     _, blockers = g.execute(num_blockers=3)
     assert 1 <= len(blockers) <= 4
+
+
+def test_incremental_resumes_after_pause():
+    """A paused walk resumes where it stopped and never redoes work."""
+    g = IncrementalTarjanDependencyGraph()
+    g.commit("c", 2, {"b"})
+    g.commit("b", 1, {"a"})
+    executables, blockers = g.execute()
+    assert executables == []
+    assert blockers == {"a"}
+    # Resume: a commits, the paused walk completes the whole chain.
+    g.commit("a", 0, set())
+    assert g.execute() == (["a", "b", "c"], set())
+
+
+def test_incremental_at_most_one_blocker_per_call():
+    g = IncrementalTarjanDependencyGraph()
+    g.commit("x", 0, {"mx"})
+    g.commit("y", 1, {"my"})
+    _, blockers = g.execute()
+    assert len(blockers) == 1
+
+
+# --- Zigzag (vertex-id keys: (leader_index, id) tuples) -------------------
+
+class TestZigzag:
+    def test_single_column_in_order(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=1)
+        g.commit((0, 0), 0, set())
+        g.commit((0, 1), 1, {(0, 0)})
+        # A drained column (no committed ids above the watermark) is not
+        # a blocker; only genuine holes are.
+        assert g.execute() == ([(0, 0), (0, 1)], set())
+        assert g.execute() == ([], set())
+
+    def test_hole_is_a_blocker_even_without_dependents(self):
+        """A missing id with committed ids above it in the same column is
+        reported as a blocker even if nothing depends on it -- the id
+        space is dense by construction, so the hole hides a real
+        instance the protocol must recover."""
+        g = ZigzagTarjanDependencyGraph(num_leaders=2)
+        g.commit((0, 0), 0, set())
+        g.commit((0, 2), 2, set())
+        executables, blockers = g.execute()
+        assert executables == [(0, 0)]
+        assert blockers == {(0, 1)}
+
+    def test_zigzag_across_columns(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=2)
+        g.commit((0, 0), 0, {(1, 0)})
+        g.commit((1, 0), 1, set())
+        g.commit((1, 1), 2, {(0, 0)})
+        executables, _ = g.execute()
+        assert executables.index((1, 0)) < executables.index((0, 0))
+        assert executables.index((0, 0)) < executables.index((1, 1))
+        assert set(executables) == {(0, 0), (1, 0), (1, 1)}
+
+    def test_cycle_across_columns(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=2)
+        g.commit((0, 0), 5, {(1, 0)})
+        g.commit((1, 0), 1, {(0, 0)})
+        components, blockers = g.execute_by_component()
+        assert components == [[(1, 0), (0, 0)]]  # sorted by (seq, key)
+        assert blockers == set()
+
+    def test_blocked_column_resumes(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=1)
+        g.commit((0, 1), 1, set())
+        executables, blockers = g.execute()
+        assert executables == []
+        assert blockers == {(0, 0)}
+        g.commit((0, 0), 0, set())
+        assert g.execute() == ([(0, 0), (0, 1)], set())
+
+    def test_update_executed_advances_watermark(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=1)
+        g.commit((0, 1), 1, {(0, 0)})
+        g.update_executed({(0, 0)})
+        assert g.execute() == ([(0, 1)], set())
+
+    def test_garbage_collection_drops_prefix(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=1, grow_size=4,
+                                        gc_every_n_commands=8)
+        for i in range(32):
+            g.commit((0, i), i, {(0, i - 1)} if i else set())
+            g.execute()
+        assert g.num_vertices == 0
+        assert g.vertices[0].watermark > 0
+
+    def test_ineligible_dependency_chain(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=2)
+        g.commit((0, 0), 0, {(1, 5)})  # depends deep into column 1
+        executables, blockers = g.execute()
+        assert executables == []
+        assert (1, 5) in blockers
+
+    def test_deep_chain_no_recursion_limit(self):
+        g = ZigzagTarjanDependencyGraph(num_leaders=1, grow_size=1000)
+        n = 50000
+        # Reverse chain: vertex i depends on i+1, so strongConnect from
+        # the watermark descends the full depth.
+        for i in range(n):
+            g.commit((0, i), i, {(0, i + 1)} if i < n - 1 else set())
+        executables, blockers = g.execute()
+        assert len(executables) == n
+        assert blockers == set()
+
+
+def test_randomized_zigzag_agrees_with_tarjan():
+    """Zigzag executes the same vertex sets as the from-scratch Tarjan
+    over random dense vertex-id graphs (mirrors
+    ZigzagTarjanDependencyGraphTest.scala's cross-impl agreement)."""
+    rng = random.Random(7)
+    for trial in range(20):
+        num_leaders = rng.randrange(1, 4)
+        per_leader = 15
+        zigzag = ZigzagTarjanDependencyGraph(num_leaders=num_leaders)
+        tarjan = TarjanDependencyGraph()
+        keys = [(l, i) for l in range(num_leaders) for i in range(per_leader)]
+        deps = {k: {rng.choice(keys) for _ in range(rng.randrange(3))} - {k}
+                for k in keys}
+        rng.shuffle(keys)
+        executed_z: set = set()
+        executed_t: set = set()
+        for key in keys:
+            zigzag.commit(key, key[1], deps[key])
+            tarjan.commit(key, key[1], deps[key])
+            if rng.random() < 0.3:
+                executed_z.update(zigzag.execute()[0])
+                executed_t.update(tarjan.execute()[0])
+        executed_z.update(zigzag.execute()[0])
+        executed_t.update(tarjan.execute()[0])
+        # All committed; both must drain everything.
+        assert executed_z == executed_t == set(deps)
+
+
+def test_randomized_incremental_agrees_with_tarjan():
+    rng = random.Random(13)
+    for trial in range(20):
+        inc = IncrementalTarjanDependencyGraph()
+        tarjan = TarjanDependencyGraph()
+        n = 40
+        keys = list(range(n))
+        deps = {k: {rng.randrange(n) for _ in range(rng.randrange(4))} - {k}
+                for k in keys}
+        rng.shuffle(keys)
+        executed_i: set = set()
+        executed_t: set = set()
+        for key in keys:
+            inc.commit(key, key, deps[key])
+            tarjan.commit(key, key, deps[key])
+            if rng.random() < 0.3:
+                executed_i.update(inc.execute()[0])
+                executed_t.update(tarjan.execute()[0])
+        # Tarjan drains in one call; incremental may need several (one
+        # blocker -- hence one resume -- per call).
+        executed_t.update(tarjan.execute()[0])
+        for _ in range(n + 1):
+            got, blockers = inc.execute()
+            executed_i.update(got)
+            if not got and not blockers:
+                break
+        assert executed_i == executed_t == set(range(n))
+
+
+def test_zigzag_no_starvation_across_columns_with_hole():
+    """A hole in one column must not stop other columns from executing
+    (regression: an early num_blockers exit starved later columns)."""
+    g = ZigzagTarjanDependencyGraph(num_leaders=2)
+    g.commit((0, 1), 1, set())  # hole at (0, 0)
+    g.commit((1, 0), 0, set())
+    executables, blockers = g.execute_by_component(num_blockers=1)
+    assert [(1, 0)] in executables
+    assert blockers == {(0, 0)}
