@@ -95,25 +95,28 @@ class ZigzagTarjanDependencyGraph(DependencyGraph[K]):
 
         columns = list(range(self.num_leaders))
         index = 0
-        skipped = 0
+        # GC is a prefix drop at the watermarks, so the GC trigger counts
+        # watermark *advances*: every vertex passes under its column's
+        # watermark exactly once -- via the skip loop (executed
+        # out-of-band or as a cross-column component member) or via the
+        # post-execute advance below -- never both.
+        advances = 0
         while columns:
             leader = columns[index]
             # Skip ids executed out-of-band (executed.leaderIndexWatermark
             # in the reference's watermark advance,
-            # ZigzagTarjanDependencyGraph.scala:334-337). These advances
-            # count toward the GC trigger: vertices executed via
-            # update_executed only become collectable once the watermark
-            # passes them here.
+            # ZigzagTarjanDependencyGraph.scala:334-337).
             while self.make(leader, self.executed_watermark[leader]) \
                     in self.executed:
                 self.executed.discard(
                     self.make(leader, self.executed_watermark[leader]))
                 self.executed_watermark[leader] += 1
-                skipped += 1
+                advances += 1
             vid = self.executed_watermark[leader]
             if self._execute_key(leader, vid, metadatas, stack,
                                  components, blockers):
                 self.executed_watermark[leader] += 1
+                advances += 1
                 index += 1
             else:
                 columns.pop(index)
@@ -125,9 +128,8 @@ class ZigzagTarjanDependencyGraph(DependencyGraph[K]):
             # numBlockers for the same reason,
             # ZigzagTarjanDependencyGraph.scala:330-348).
 
-        executed_now = sum(len(c) for c in components)
-        self._num_vertices -= executed_now
-        self._num_commands_since_gc += executed_now + skipped
+        self._num_vertices -= sum(len(c) for c in components)
+        self._num_commands_since_gc += advances
         if self._num_commands_since_gc >= self.gc_every_n_commands:
             self._garbage_collect()
             self._num_commands_since_gc = 0

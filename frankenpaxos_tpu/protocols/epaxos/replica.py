@@ -23,7 +23,7 @@ from frankenpaxos_tpu.depgraph import make_dependency_graph
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.utils.topk import VertexIdLike
+from frankenpaxos_tpu.utils.topk import TUPLE_VERTEX_LIKE
 from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
     Instance,
     InstancePrefixSet,
@@ -46,9 +46,6 @@ from frankenpaxos_tpu.protocols.epaxos.messages import (
     Prepare,
     PrepareOk,
 )
-
-INSTANCE_LIKE = VertexIdLike(leader_index=lambda v: v[0], id=lambda v: v[1])
-
 
 @dataclasses.dataclass(frozen=True)
 class EPaxosConfig:
@@ -180,7 +177,7 @@ class EPaxosReplica(Actor):
             options.dependency_graph, num_leaders=config.n, make=Instance)
         self.client_table: ClientTable = ClientTable()
         self.conflict_index = state_machine.top_k_conflict_index(
-            options.top_k_dependencies, config.n, INSTANCE_LIKE)
+            options.top_k_dependencies, config.n, TUPLE_VERTEX_LIKE)
         self.recover_instance_timers: dict[Instance, object] = {}
         self.num_pending_committed = 0
         self.executed_count = 0

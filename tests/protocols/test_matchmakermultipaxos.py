@@ -15,14 +15,16 @@ from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
 )
 
 
-def make_mmp(f=1, num_acceptors=5, num_clients=2, seed=0):
+def make_mmp(f=1, num_acceptors=5, num_clients=2, seed=0,
+             num_matchmakers=None):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     config = MatchmakerMultiPaxosConfig(
         f=f,
         leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
         matchmaker_addresses=tuple(
-            f"matchmaker-{i}" for i in range(2 * f + 1)),
+            f"matchmaker-{i}"
+            for i in range(num_matchmakers or 2 * f + 1)),
         reconfigurer_addresses=("reconfigurer-0",),
         acceptor_addresses=tuple(
             f"acceptor-{i}" for i in range(num_acceptors)),
@@ -85,10 +87,71 @@ def test_matchmaker_gc():
     transport.deliver_all()
     reconfigurer.reconfigure(SimpleMajority([0, 1, 2]))
     transport.deliver_all()
-    # Phase 1 of the new round garbage collected older configurations.
+    # Phase 1 of the new round garbage collected configurations below
+    # the new round (Matchmaker.scala:400-460: prune round < watermark).
+    assert any(m.gc_watermark > 0 for m in matchmakers)
     for matchmaker in matchmakers:
         if matchmaker.configurations:
-            assert min(matchmaker.configurations) > matchmaker.gc_watermark
+            assert min(matchmaker.configurations) >= matchmaker.gc_watermark
+
+
+def test_matchmaker_self_reconfiguration():
+    """Stop/Bootstrap/MatchPhase1/2: move the matchmakers to a new
+    epoch on fresh physical nodes mid-stream."""
+    (transport, config, leaders, matchmakers, reconfigurer, _, replicas,
+     clients) = make_mmp(num_matchmakers=5)
+    transport.deliver_all()
+    got = []
+    clients[0].write(0, b"before", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
+    # Reconfigure the matchmakers from {0,1,2} to {2,3,4}.
+    reconfigurer.reconfigure_matchmakers([2, 3, 4])
+    transport.deliver_all()
+    assert reconfigurer.state.configuration.epoch == 1
+    assert reconfigurer.state.configuration.matchmaker_indices == (2, 3, 4)
+    # Every leader learned the new epoch via MatchChosen.
+    for leader in leaders:
+        assert leader.matchmaker_configuration.epoch == 1
+    # The old epoch's configurations were carried over to the new
+    # matchmakers during Bootstrap.
+    assert matchmakers[3].configurations == matchmakers[2].configurations
+    # Matchmaking a new round goes through the new epoch only.
+    from frankenpaxos_tpu.quorums import SimpleMajority as SM
+    reconfigurer.reconfigure(SM([0, 1, 2]))
+    transport.deliver_all()
+    clients[0].write(0, b"after", got.append)
+    transport.deliver_all()
+    assert got == [b"0", b"1"]
+    assert any(0 in m.states and len(m.states) > 1 or 1 in m.states
+               for m in matchmakers[3:])
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1] == [b"before", b"after"]
+
+
+def test_stopped_epoch_bounces_leader_to_new_epoch():
+    """A leader matchmaking in a stopped epoch gets a Stopped bounce,
+    asks a reconfigurer, and retries in the new epoch
+    (Leader.scala:2229-2251)."""
+    from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
+        initial_matchmaker_configuration,
+    )
+    (transport, config, leaders, matchmakers, reconfigurer, _, replicas,
+     clients) = make_mmp(num_matchmakers=5)
+    transport.deliver_all()
+    reconfigurer.reconfigure_matchmakers([1, 2, 3])
+    transport.deliver_all()
+    # Force the active leader back to the stale epoch 0, then make it
+    # matchmake: the stopped epoch-0 matchmakers bounce it.
+    leaders[0].matchmaker_configuration = \
+        initial_matchmaker_configuration(config.f)
+    reconfigurer.reconfigure(SimpleMajority([2, 3, 4]))
+    transport.deliver_all()
+    assert leaders[0].matchmaker_configuration.epoch == 1
+    got = []
+    clients[0].write(0, b"bounced", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
 
 
 def test_survives_f_matchmaker_deaths():
