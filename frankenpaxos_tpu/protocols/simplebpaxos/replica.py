@@ -76,11 +76,18 @@ class BPaxosReplica(Actor):
         if timer is not None:
             timer.stop()
         self.dependency_graph.commit(
-            vertex_id, 0, message.dependencies.materialize())
+            vertex_id, 0,
+            self._unexecuted_dependencies(message.dependencies))
         self.num_pending += 1
         if self.num_pending % self.execute_graph_batch_size == 0:
             self._execute_graph()
             self.num_pending = 0
+
+    def _unexecuted_dependencies(self, dependencies):
+        """Iterable of dependencies to hand the graph. Subclasses that
+        track an executed-vertex set subtract it here so snapshot-sized
+        dependency sets don't materialize the whole history."""
+        return dependencies.materialize()
 
     def _execute_graph(self) -> None:
         executables, blockers = self.dependency_graph.execute(

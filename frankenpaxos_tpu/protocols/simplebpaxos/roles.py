@@ -67,10 +67,16 @@ class BPaxosLeader(Actor):
 
     def _handle_client_request(self, src: Address,
                                request: ClientRequest) -> None:
+        self._start_vertex(request.command)
+
+    def _start_vertex(self, command) -> VertexId:
+        """Allocate a vertex for ``command`` and ask a dep-service
+        quorum for its dependencies (Leader.scala:120-180). Subclasses
+        reuse this for non-client proposals (snapshot vertices)."""
         vertex_id = VertexId(self.index, self.next_vertex_id)
         self.next_vertex_id += 1
         dep_request = DependencyRequest(vertex_id=vertex_id,
-                                        command=request.command)
+                                        command=command)
         targets = list(self.config.dep_service_node_addresses)[
             :self.config.quorum_size]
         for node in targets:
@@ -84,7 +90,8 @@ class BPaxosLeader(Actor):
         timer = self.timer(f"resendDeps {vertex_id}",
                            self.resend_deps_period_s, resend)
         timer.start()
-        self.states[vertex_id] = ["waiting", request.command, {}, timer]
+        self.states[vertex_id] = ["waiting", command, {}, timer]
+        return vertex_id
 
     def _handle_dependency_reply(self, src: Address,
                                  reply: DependencyReply) -> None:
@@ -127,19 +134,27 @@ class BPaxosDepServiceNode(Actor):
         vertex_id = message.vertex_id
         dependencies = self.dependencies_cache.get(vertex_id)
         if dependencies is None:
-            payload = message.command.command
-            if self.top_k == 1:
-                dependencies = VertexIdPrefixSet.from_top_one(
-                    self.conflict_index.get_top_one_conflicts(payload))
-            else:
-                dependencies = VertexIdPrefixSet.from_top_k(
-                    self.conflict_index.get_top_k_conflicts(payload))
-            dependencies.subtract_one(vertex_id)
-            self.conflict_index.put(vertex_id, payload)
+            dependencies = self._compute_dependencies(vertex_id,
+                                                      message.command)
             self.dependencies_cache[vertex_id] = dependencies
         self.send(src, DependencyReply(
             vertex_id=vertex_id, dep_service_node_index=self.index,
             dependencies=dependencies.copy()))
+
+    def _compute_dependencies(self, vertex_id: VertexId,
+                              command) -> VertexIdPrefixSet:
+        """Conflict-index lookup for a new vertex; cached by receive so
+        re-asks are deterministic. Subclasses extend (snapshot deps)."""
+        payload = command.command
+        if self.top_k == 1:
+            dependencies = VertexIdPrefixSet.from_top_one(
+                self.conflict_index.get_top_one_conflicts(payload))
+        else:
+            dependencies = VertexIdPrefixSet.from_top_k(
+                self.conflict_index.get_top_k_conflicts(payload))
+        dependencies.subtract_one(vertex_id)
+        self.conflict_index.put(vertex_id, payload)
+        return dependencies
 
 
 @dataclasses.dataclass

@@ -8,12 +8,12 @@ from frankenpaxos_tpu.runtime import (
 )
 from frankenpaxos_tpu.statemachine import KeyValueStore, SetRequest
 from frankenpaxos_tpu.protocols.simplebpaxos.replica import BPaxosClient
-from frankenpaxos_tpu.protocols.simplebpaxos.roles import BPaxosLeader
 from frankenpaxos_tpu.protocols.simplegcbpaxos import (
     GarbageCollector,
     GcBPaxosAcceptor,
     GcBPaxosConfig,
     GcBPaxosDepServiceNode,
+    GcBPaxosLeader,
     GcBPaxosProposer,
     GcBPaxosReplica,
 )
@@ -21,19 +21,23 @@ from frankenpaxos_tpu.protocols.simplegcbpaxos import (
 SER = PickleSerializer()
 
 
-def make_gc_bpaxos(f=1, send_gc_every_n=3, seed=0):
+def make_gc_bpaxos(f=1, send_gc_every_n=3, seed=0, num_replicas=None,
+                   snapshot_every_n=0):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     n = 2 * f + 1
+    num_replicas = num_replicas or f + 1
     config = GcBPaxosConfig(
         f=f,
         leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
         proposer_addresses=tuple(f"proposer-{i}" for i in range(f + 1)),
         dep_service_node_addresses=tuple(f"dep-{i}" for i in range(n)),
         acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)),
-        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)),
-        garbage_collector_addresses=tuple(f"gc-{i}" for i in range(f + 1)))
-    leaders = [BPaxosLeader(a, transport, logger, config, seed=seed + i)
+        replica_addresses=tuple(f"replica-{i}"
+                                for i in range(num_replicas)),
+        garbage_collector_addresses=tuple(f"gc-{i}"
+                                          for i in range(num_replicas)))
+    leaders = [GcBPaxosLeader(a, transport, logger, config, seed=seed + i)
                for i, a in enumerate(config.leader_addresses)]
     proposers = [GcBPaxosProposer(a, transport, logger, config,
                                   seed=seed + 10 + i)
@@ -46,6 +50,7 @@ def make_gc_bpaxos(f=1, send_gc_every_n=3, seed=0):
     replicas = [GcBPaxosReplica(a, transport, logger, config,
                                 KeyValueStore(),
                                 send_gc_every_n=send_gc_every_n,
+                                snapshot_every_n=snapshot_every_n,
                                 seed=seed + 30 + i)
                 for i, a in enumerate(config.replica_addresses)]
     collectors = [GarbageCollector(a, transport, logger, config)
@@ -89,3 +94,51 @@ def test_gc_still_correct_after_pruning():
         transport.deliver_all()
     states = [r.state_machine.get() for r in replicas]
     assert all(s == {"x": "11"} for s in states)
+
+
+def test_snapshot_vertices_get_chosen_and_executed():
+    transport, _, _, _, replicas, clients = make_gc_bpaxos(
+        send_gc_every_n=2, snapshot_every_n=2)
+    for i in range(12):
+        clients[0].propose(i, SER.to_bytes(SetRequest((("x", str(i)),))))
+        transport.deliver_all()
+    # Some replica requested a snapshot; the snapshot vertex flowed
+    # through dep service + consensus and was executed everywhere.
+    assert any(r.snapshot is not None for r in replicas)
+    snapshots = [r.snapshot for r in replicas if r.snapshot is not None]
+    # History since the last snapshot is short -- it was cleared.
+    for replica in replicas:
+        if replica.snapshot is not None:
+            assert len(replica.history) < 12
+    assert all(s.state_machine for s in snapshots)
+
+
+def test_far_behind_replica_catches_up_via_commit_snapshot():
+    """A replica partitioned past the GC watermark recovers from a
+    peer's CommitSnapshot, not from (pruned) consensus state."""
+    transport, config, proposers, acceptors, replicas, clients = \
+        make_gc_bpaxos(send_gc_every_n=2, num_replicas=3,
+                       snapshot_every_n=2)
+    laggard = replicas[2]
+    transport.partition("replica-2")
+    for i in range(12):
+        clients[0].propose(i, SER.to_bytes(SetRequest((("x", str(i)),))))
+        transport.deliver_all()
+    # Replicas 0 and 1 formed the f+1 GC quorum: consensus state below
+    # the watermark was pruned and a snapshot exists.
+    assert any(any(w > 0 for w in p.gc_watermark) for p in proposers)
+    assert any(r.snapshot is not None for r in replicas[:2])
+    assert laggard.state_machine.get() == {}
+    # Heal; the next commit's dependencies point at vertices the laggard
+    # never saw, so it blocks and fires recovery.
+    transport.heal("replica-2")
+    clients[0].propose(100, SER.to_bytes(SetRequest((("x", "final"),))))
+    transport.deliver_all()
+    for timer in list(transport.running_timers()):
+        if timer.address == "replica-2" \
+                and timer.name.startswith("recoverVertex"):
+            transport.trigger_timer(timer.id)
+    transport.deliver_all()
+    assert laggard.snapshot is not None, "laggard never got a snapshot"
+    assert laggard.state_machine.get() == replicas[0].state_machine.get()
+    assert laggard.state_machine.get().get("x") == "final"
