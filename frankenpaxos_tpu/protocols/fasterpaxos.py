@@ -1,19 +1,29 @@
 """Faster Paxos: delegate-based multi-leader MultiPaxos.
 
 Reference behavior: fasterpaxos/ (FasterPaxos.proto:1-130 protocol
-cheatsheet, Server.scala ~2,200 LoC, Client.scala). 2f+1 servers; in
+cheatsheet, Server.scala ~1,900 LoC, Client.scala). 2f+1 servers; in
 each round one server is the *leader* and picks f+1 *delegates*
 (including itself). The leader runs Phase1 across the servers, repairs
 the log, then hands the suffix to the delegates (Phase2aAny). In normal
 operation clients send to any delegate, which assigns one of its
-round-robin-owned slots, votes, and gathers Phase2bs from the other
+round-robin-owned slots, noop-fills the unfilled slots just before it
+(Server.scala:808-855), votes, and gathers Phase2bs from the other
 delegates -- all f+1 delegates voting forms a classic quorum -- then
 broadcasts Phase3a (chosen) to all servers and answers the client.
 Stale clients discover the round/delegates via RoundInfo.
 
-(The reference's ackNoopsWithCommands / useF1Optimization flags and
-heartbeat-driven automatic round changes are simplified: round changes
-here are nack-driven.)
+Options (Server.scala:35-90):
+  * ``ack_noops_with_commands``: a delegate that receives a noop
+    Phase2a for a slot where it already voted a command replies with a
+    Phase2b carrying the command; the noop's proposer throws away its
+    noop votes and starts counting command votes
+    (Server.scala:1016-1110).
+  * ``use_f1_optimization``: with f=1 there are exactly two delegates,
+    so a delegate that votes for the other's Phase2a knows the value is
+    chosen immediately (Server.scala:1562-1600).
+  * heartbeat-driven round change: each server watches the delegates
+    via a heartbeat participant and starts Phase1 in its own next round
+    when one looks dead (Server.scala:500-527).
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ import dataclasses
 import random
 from typing import Callable, Optional, Union
 
+from frankenpaxos_tpu.heartbeat import HeartbeatParticipant
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
@@ -39,6 +50,18 @@ class FasterPaxosConfig:
             raise ValueError("f must be >= 1")
         if len(self.server_addresses) != 2 * self.f + 1:
             raise ValueError("need exactly 2f+1 servers")
+
+
+@dataclasses.dataclass(frozen=True)
+class FasterPaxosOptions:
+    """Server options (ServerOptions, Server.scala:35-90)."""
+
+    ack_noops_with_commands: bool = True
+    use_f1_optimization: bool = True
+    # How often each server checks the delegates for liveness (the
+    # reference picks uniformly in [min, max]).
+    leader_change_min_period_s: float = 5.0
+    leader_change_max_period_s: float = 10.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,6 +131,9 @@ class Phase2b:
     server_index: int
     slot: int
     round: int
+    # ack_noops_with_commands: set when acking a noop Phase2a with the
+    # command we already voted for (Server.scala:1613-1625).
+    command: Optional[Command] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,10 +176,14 @@ class _LogEntry:
 class FasterPaxosServer(Actor):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: FasterPaxosConfig,
-                 state_machine: StateMachine, seed: int = 0):
+                 state_machine: StateMachine,
+                 options: FasterPaxosOptions = FasterPaxosOptions(),
+                 heartbeat: Optional[HeartbeatParticipant] = None,
+                 heartbeat_addresses: tuple = (), seed: int = 0):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        self.options = options
         self.state_machine = state_machine
         self.rng = random.Random(seed)
         self.index = list(config.server_addresses).index(address)
@@ -174,6 +204,26 @@ class FasterPaxosServer(Actor):
         self.in_phase1 = False
         if self.index in self.delegates:
             self._set_delegate_slots(0)
+        # Heartbeat-driven leader change (Server.scala:500-527): watch
+        # the delegates; take over when one looks dead.
+        self.heartbeat = heartbeat
+        self.heartbeat_addresses = tuple(heartbeat_addresses)
+        if heartbeat is not None:
+            if len(self.heartbeat_addresses) \
+                    != len(config.server_addresses):
+                raise ValueError(
+                    "heartbeat_addresses must mirror server_addresses")
+
+            def leader_change():
+                self._maybe_change_leader()
+                self.leader_change_timer.start()
+
+            self.leader_change_timer = self.timer(
+                "leaderChange",
+                self.rng.uniform(options.leader_change_min_period_s,
+                                 options.leader_change_max_period_s),
+                leader_change)
+            self.leader_change_timer.start()
 
     # --- helpers ----------------------------------------------------------
     @property
@@ -190,9 +240,31 @@ class FasterPaxosServer(Actor):
         position = self.delegates.index(self.index)
         self.delegate_start = start_slot
         self.next_owned_slot = start_slot + position
+        self._skip_filled_slots()
 
     def _advance_owned_slot(self) -> None:
         self.next_owned_slot += len(self.delegates)
+        self._skip_filled_slots()
+
+    def _skip_filled_slots(self) -> None:
+        # getNextSlot (Server.scala:608-630): skip owned slots that were
+        # already filled (e.g. noop-filled by a faster delegate).
+        while self.log.get(self.next_owned_slot) is not None:
+            self.next_owned_slot += len(self.delegates)
+
+    def _owns_slot(self, slot: int) -> bool:
+        """ownsSlot (Server.scala:662-686): the leader owns everything
+        below the delegation watermark plus its stripe; delegates own
+        their stripe above it."""
+        if not self.is_delegate:
+            return False
+        position = self.delegates.index(self.index)
+        in_stripe = slot >= self.delegate_start \
+            and (slot - self.delegate_start) % len(self.delegates) \
+            == position
+        if self.is_leader:
+            return slot < self.delegate_start or in_stripe
+        return in_stripe
 
     def _delegate_addresses(self) -> list[Address]:
         return [self.config.server_addresses[i] for i in self.delegates]
@@ -219,11 +291,45 @@ class FasterPaxosServer(Actor):
                 self.client_table[key] = (cid.client_id, result)
             # The delegate owning the slot replies (cheatsheet: delegate
             # sends ClientReply).
-            if self.is_delegate and (slot - self.delegate_start) \
-                    % len(self.delegates) \
-                    == self.delegates.index(self.index):
+            if self._owns_slot(slot):
                 self.send(cid.client_address,
                           ClientReply(command_id=cid, result=result))
+
+    def _choose(self, slot: int, value: CommandOrNoop) -> None:
+        """Mark ``slot`` chosen locally (choose, Server.scala:633-660)."""
+        entry = self.log.get(slot)
+        if entry is not None and entry.chosen:
+            return
+        self.log.put(slot, _LogEntry(vote_round=self.round,
+                                     vote_value=value, chosen=True))
+        self.pending_votes.pop(slot, None)
+        self.pending_values.pop(slot, None)
+        if slot == self.next_owned_slot:
+            self._advance_owned_slot()
+        self._execute_log()
+
+    # --- proposing (delegate) ---------------------------------------------
+    def _propose_single(self, slot: int, value: CommandOrNoop) -> None:
+        """Vote for ``value`` in ``slot`` ourselves and send Phase2as to
+        the other delegates (Server.scala:765-806)."""
+        self.log.put(slot, _LogEntry(vote_round=self.round,
+                                     vote_value=value))
+        self.pending_values[slot] = value
+        self.pending_votes[slot] = {self.index}
+        phase2a = Phase2a(slot=slot, round=self.round, value=value)
+        for i in self.delegates:
+            if i != self.index:
+                self.send(self.config.server_addresses[i], phase2a)
+
+    def _propose(self, slot: int, value: CommandOrNoop) -> None:
+        """Noop-fill the unfilled slots just before ``slot`` so a slow
+        delegate can't stall the log, then propose ``value``
+        (proposeCommandOrNoop, Server.scala:808-855)."""
+        for previous in range(max(self.delegate_start,
+                                  slot - len(self.delegates) + 1), slot):
+            if self.log.get(previous) is None:
+                self._propose_single(previous, NOOP)
+        self._propose_single(slot, value)
 
     # --- round change (leader) --------------------------------------------
     def start_round_change(self, new_round: int) -> None:
@@ -231,10 +337,25 @@ class FasterPaxosServer(Actor):
         self.round = new_round
         self.in_phase1 = True
         self.phase1bs = {}
+        self.pending_votes.clear()
+        self.pending_values.clear()
         phase1a = Phase1a(round=new_round,
                           chosen_watermark=self.executed_watermark)
         for server in self.config.server_addresses:
             self.send(server, phase1a)
+
+    def _maybe_change_leader(self) -> None:
+        """leaderChangeTimer (Server.scala:500-527): if a delegate looks
+        dead, run Phase1 in our own next round."""
+        if self.heartbeat is None:
+            return
+        alive = self.heartbeat.unsafe_alive()
+        alive.add(self.heartbeat_addresses[self.index])
+        delegate_hbs = {self.heartbeat_addresses[i] for i in self.delegates}
+        if not delegate_hbs <= alive:
+            self.start_round_change(
+                self.round_system.next_classic_round(self.index,
+                                                     self.round))
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
@@ -256,7 +377,8 @@ class FasterPaxosServer(Actor):
 
     def _handle_client_request(self, src: Address,
                                request: ClientRequest) -> None:
-        if request.round < self.round or not self.is_delegate:
+        if request.round < self.round or not self.is_delegate \
+                or self.in_phase1:
             # Stale client or not a delegate: only the leader answers with
             # RoundInfo (FasterPaxos.proto "Learning Who the Delegates
             # Are").
@@ -266,15 +388,7 @@ class FasterPaxosServer(Actor):
             return
         slot = self.next_owned_slot
         self._advance_owned_slot()
-        value = request.command
-        self.log.put(slot, _LogEntry(vote_round=self.round,
-                                     vote_value=value))
-        self.pending_votes[slot] = {self.index}
-        self.pending_values[slot] = value
-        for i in self.delegates:
-            if i != self.index:
-                self.send(self.config.server_addresses[i],
-                          Phase2a(slot=slot, round=self.round, value=value))
+        self._propose(slot, request.command)
 
     def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
         if phase1a.round < self.round:
@@ -297,30 +411,20 @@ class FasterPaxosServer(Actor):
         if len(self.phase1bs) < self.config.f + 1:
             return
         self.in_phase1 = False
-        # Repair every seen slot: chosen values stay; else highest vote.
         max_slot = max((i.slot for p in self.phase1bs.values()
                         for i in p.info), default=-1)
-        for slot in range(self.executed_watermark, max_slot + 1):
-            infos = [i for p in self.phase1bs.values()
-                     for i in p.info if i.slot == slot]
-            chosen = next((i for i in infos if i.chosen), None)
-            if chosen is not None:
-                value = chosen.vote_value
-            elif infos:
-                value = max(infos, key=lambda i: i.vote_round).vote_value
-            else:
-                value = NOOP
-            entry = _LogEntry(vote_round=self.round, vote_value=value,
-                              chosen=True)
-            self.log.put(slot, entry)
-            for server in self.config.server_addresses:
-                if server != self.address:
-                    self.send(server, Phase3a(slot=slot, value=value))
-        self._execute_log()
-        # Pick delegates: ourselves + f random others, hand them the
-        # suffix.
+        # Pick delegates (ourselves + f others, preferring ones the
+        # heartbeat says are alive, pickDelegates Server.scala:609-617)
+        # and hand them the suffix BEFORE re-proposing the repaired
+        # prefix, so their votes land in delegate state.
         others = [i for i in range(len(self.config.server_addresses))
                   if i != self.index]
+        if self.heartbeat is not None:
+            alive = self.heartbeat.unsafe_alive()
+            alive_others = [i for i in others
+                            if self.heartbeat_addresses[i] in alive]
+            if len(alive_others) >= self.config.f:
+                others = alive_others
         self.delegates = tuple([self.index]
                                + sorted(self.rng.sample(others,
                                                         self.config.f)))
@@ -329,9 +433,30 @@ class FasterPaxosServer(Actor):
                                  delegates=self.delegates,
                                  start_slot=start)
         for i in self.delegates:
-            self.send(self.config.server_addresses[i], any_message)
-        if self.is_delegate:
-            self._set_delegate_slots(start)
+            if i != self.index:
+                self.send(self.config.server_addresses[i], any_message)
+        self._set_delegate_slots(start)
+        # Repair every seen slot (safeValue, Server.scala:860-940):
+        # already-chosen values are chosen directly; everything else is
+        # only *safe* and must go through Phase2 with the new delegates.
+        for slot in range(self.executed_watermark, max_slot + 1):
+            entry = self.log.get(slot)
+            if entry is not None and entry.chosen:
+                continue
+            infos = [i for p in self.phase1bs.values()
+                     for i in p.info if i.slot == slot]
+            chosen = next((i for i in infos if i.chosen), None)
+            if chosen is not None:
+                self._choose(slot, chosen.vote_value)
+                for server in self.config.server_addresses:
+                    if server != self.address:
+                        self.send(server, Phase3a(slot=slot,
+                                                  value=chosen.vote_value))
+                continue
+            value = (max(infos, key=lambda i: i.vote_round).vote_value
+                     if infos else NOOP)
+            self._propose_single(slot, value)
+        self._execute_log()
 
     def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
         if phase2a.round < self.round:
@@ -339,43 +464,88 @@ class FasterPaxosServer(Actor):
             return
         self.round = phase2a.round
         entry = self.log.get(phase2a.slot)
+        phase2b = Phase2b(server_index=self.index, slot=phase2a.slot,
+                          round=phase2a.round)
         if entry is not None and entry.chosen:
+            # Already chosen: skip the protocol, tell the sender.
             self.send(src, Phase3a(slot=phase2a.slot,
                                    value=entry.vote_value))
             return
-        self.log.put(phase2a.slot, _LogEntry(vote_round=phase2a.round,
-                                             vote_value=phase2a.value))
-        self.send(src, Phase2b(server_index=self.index, slot=phase2a.slot,
-                               round=phase2a.round))
+        if entry is None or isinstance(entry.vote_value, Noop):
+            # Nothing / noop voted: vote for the sender's value. (Re-
+            # voting a command over our noop is safe and special to
+            # Faster Paxos, Server.scala:1584-1605.) With f=1 both
+            # delegates have now voted, so the value is chosen
+            # (useF1Optimization, Server.scala:1562-1600).
+            if self.config.f == 1 and self.options.use_f1_optimization:
+                self._choose(phase2a.slot, phase2a.value)
+            else:
+                self.log.put(phase2a.slot,
+                             _LogEntry(vote_round=phase2a.round,
+                                       vote_value=phase2a.value))
+                if phase2a.slot == self.next_owned_slot:
+                    self._advance_owned_slot()
+            self.send(src, phase2b)
+            return
+        # We already voted for a command.
+        if isinstance(phase2a.value, Noop):
+            # ackNoopsWithCommands (Server.scala:1613-1625): tell the
+            # noop's proposer about our command (or stay silent).
+            if self.options.ack_noops_with_commands:
+                self.send(src, dataclasses.replace(
+                    phase2b, command=entry.vote_value))
+            return
+        # Command meets command (case e). Within a round, slot ownership
+        # makes the commands identical; across rounds a repair-window
+        # re-proposal can differ, so record the vote in the newer round
+        # like any Paxos acceptor before acking -- acking while keeping
+        # the old vote would let a later Phase1 resurrect it.
+        if phase2a.round > entry.vote_round:
+            self.log.put(phase2a.slot,
+                         _LogEntry(vote_round=phase2a.round,
+                                   vote_value=phase2a.value))
+        self.send(src, phase2b)
 
     def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
         if phase2b.round != self.round:
             return
+        entry = self.log.get(phase2b.slot)
+        if entry is not None and entry.chosen:
+            return
         voters = self.pending_votes.get(phase2b.slot)
         if voters is None:
             return
-        voters.add(phase2b.server_index)
+        pending = self.pending_values[phase2b.slot]
+        # processPhase2b's case table (Server.scala:1060-1096).
+        if isinstance(pending, Command) and phase2b.command is None \
+                and not self._owns_slot(phase2b.slot):
+            # Case (c): this Phase2b is for the noop we proposed before
+            # we switched to the command; it doesn't count.
+            return
+        if isinstance(pending, Noop) and phase2b.command is not None:
+            # Case (f): our noop lost to a command; start counting
+            # command votes (ours + the sender's).
+            value: CommandOrNoop = phase2b.command
+            self.log.put(phase2b.slot,
+                         _LogEntry(vote_round=phase2b.round,
+                                   vote_value=value))
+            self.pending_values[phase2b.slot] = value
+            voters = {self.index, phase2b.server_index}
+            self.pending_votes[phase2b.slot] = voters
+        else:
+            # Cases (a), (d), (e): count the vote.
+            voters.add(phase2b.server_index)
         # All f+1 delegates voting forms a classic quorum.
         if len(voters) < len(self.delegates):
             return
-        value = self.pending_values.pop(phase2b.slot)
-        del self.pending_votes[phase2b.slot]
-        entry = self.log.get(phase2b.slot)
-        entry.chosen = True
-        entry.vote_value = value
+        value = self.pending_values[phase2b.slot]
+        self._choose(phase2b.slot, value)
         for server in self.config.server_addresses:
             if server != self.address:
                 self.send(server, Phase3a(slot=phase2b.slot, value=value))
-        self._execute_log()
 
     def _handle_phase3a(self, src: Address, phase3a: Phase3a) -> None:
-        entry = self.log.get(phase3a.slot)
-        if entry is not None and entry.chosen:
-            return
-        self.log.put(phase3a.slot,
-                     _LogEntry(vote_round=self.round,
-                               vote_value=phase3a.value, chosen=True))
-        self._execute_log()
+        self._choose(phase3a.slot, phase3a.value)
 
     def _handle_phase2a_any(self, src: Address,
                             message: Phase2aAny) -> None:
@@ -384,6 +554,8 @@ class FasterPaxosServer(Actor):
             return
         self.round = message.round
         self.delegates = message.delegates
+        self.pending_votes.clear()
+        self.pending_values.clear()
         if self.is_delegate:
             self._set_delegate_slots(message.start_slot)
         self.send(src, Phase2aAnyAck(server_index=self.index,

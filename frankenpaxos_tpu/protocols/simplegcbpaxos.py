@@ -315,7 +315,9 @@ class GcBPaxosReplica(BPaxosReplica):
             state_machine=self.state_machine.to_bytes(),
             client_table=self.client_table.to_dict())
         self.history.clear()
-        watermarks = self.executed_vertices.watermarks()
+        self._prune_commands_below(self.executed_vertices.watermarks())
+
+    def _prune_commands_below(self, watermarks: list[int]) -> None:
         for vertex_id in [v for v in self.commands
                           if v.instance_number
                           < watermarks[v.replica_index]]:
@@ -323,18 +325,26 @@ class GcBPaxosReplica(BPaxosReplica):
 
     # --- recovery ---------------------------------------------------------
     def _make_recover_timer(self, vertex_id: VertexId) -> object:
+        attempt = [0]
+
         def fire():
             # Ask the vertex's proposer (noop if nothing was proposed)
-            # AND the other replicas: if proposers already garbage
-            # collected the vertex, only a peer's snapshot has it
-            # (Replica.scala:607-650).
+            # AND one peer replica, rotating per attempt: if proposers
+            # already garbage collected the vertex, only a peer's
+            # snapshot has it (Replica.scala:607-650) -- but asking
+            # every peer at once would pull one snapshot-sized reply
+            # per peer when the first suffices.
             self.send(self.config.proposer_addresses[
                 vertex_id.replica_index % len(
                     self.config.proposer_addresses)],
                 Recover(vertex_id=vertex_id))
-            for i, replica in enumerate(self.config.replica_addresses):
-                if i != self.index:
-                    self.send(replica, Recover(vertex_id=vertex_id))
+            peers = [i for i in range(len(self.config.replica_addresses))
+                     if i != self.index]
+            if peers:
+                peer = peers[attempt[0] % len(peers)]
+                attempt[0] += 1
+                self.send(self.config.replica_addresses[peer],
+                          Recover(vertex_id=vertex_id))
             timer.start()
 
         timer = self.timer(f"recoverVertex {vertex_id}",
@@ -397,10 +407,7 @@ class GcBPaxosReplica(BPaxosReplica):
             self.recover_vertex_timers.pop(vertex_id).stop()
         # Drop per-vertex state the snapshot covers.
         watermarks = watermark.watermarks()
-        for vertex_id in [v for v in self.commands
-                          if v.instance_number
-                          < watermarks[v.replica_index]]:
-            del self.commands[vertex_id]
+        self._prune_commands_below(watermarks)
         for column, mark in enumerate(watermarks):
             self._frontier[column] = max(self._frontier[column], mark)
         # Re-execute executed-but-unsnapshotted commands: their effects

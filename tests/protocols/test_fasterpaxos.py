@@ -1,28 +1,52 @@
 """FasterPaxos: delegate-striped slots, unanimous-delegate quorums,
 round changes."""
 
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions, HeartbeatParticipant
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.fasterpaxos import (
+    ClientRequest,
+    Command,
+    CommandId,
     FasterPaxosClient,
     FasterPaxosConfig,
+    FasterPaxosOptions,
     FasterPaxosServer,
+    Noop,
+    Phase2a,
 )
 
 
-def make_fasterpaxos(f=1, num_clients=2, seed=0):
+def make_fasterpaxos(f=1, num_clients=2, seed=0,
+                     options=FasterPaxosOptions(), with_heartbeat=False):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     config = FasterPaxosConfig(
         f=f,
         server_addresses=tuple(f"server-{i}" for i in range(2 * f + 1)))
-    servers = [FasterPaxosServer(a, transport, logger, config, AppendLog(),
-                                 seed=seed + i)
+    hb_addresses = tuple(f"hb-{i}" for i in range(2 * f + 1))
+    heartbeats = []
+    if with_heartbeat:
+        heartbeats = [
+            HeartbeatParticipant(a, transport, logger, hb_addresses,
+                                 HeartbeatOptions(num_retries=1))
+            for a in hb_addresses]
+    servers = [FasterPaxosServer(
+                   a, transport, logger, config, AppendLog(),
+                   options=options,
+                   heartbeat=heartbeats[i] if with_heartbeat else None,
+                   heartbeat_addresses=hb_addresses if with_heartbeat
+                   else (),
+                   seed=seed + i)
                for i, a in enumerate(config.server_addresses)]
     clients = [FasterPaxosClient(f"client-{i}", transport, logger, config,
                                  seed=seed + 50 + i)
                for i in range(num_clients)]
     return transport, config, servers, clients
+
+
+def cmd(i, client="client-x", pseudonym=0):
+    return Command(CommandId(client, pseudonym, i), b"c%d" % i)
 
 
 def pump(transport, predicate, rounds=15):
@@ -80,3 +104,96 @@ def test_round_change_recovers_log():
         log = server.state_machine.get()
         assert log.count(b"before") == 1
         assert log.count(b"after") == 1
+
+
+def test_noop_fill_keeps_log_dense_under_uneven_load():
+    """A delegate proposing in its stripe noop-fills the unfilled slots
+    just before it, so an idle co-delegate can't stall execution."""
+    transport, _, servers, _ = make_fasterpaxos()
+    # All load lands on delegate 1 (owns slots 1, 3, 5, ...).
+    servers[1].receive("client-x", ClientRequest(round=0, command=cmd(0)))
+    transport.deliver_all()
+    # Slot 0 (owned by idle delegate 0) was noop-filled and chosen;
+    # the command in slot 1 executed everywhere.
+    for server in servers:
+        assert server.executed_watermark >= 2, server.executed_watermark
+        assert isinstance(server.log.get(0).vote_value, Noop)
+        assert server.log.get(1).vote_value.command == b"c0"
+
+
+def test_ack_noops_with_commands_recovers_concurrent_command():
+    """A noop that races a command in the same slot is acked with the
+    command; the noop proposer switches to counting command votes."""
+    transport, _, servers, _ = make_fasterpaxos(
+        options=FasterPaxosOptions(use_f1_optimization=False))
+    # Delegate 0 proposes c0 in its slot 0; concurrently delegate 1
+    # proposes c1 in slot 1, noop-filling slot 0 (it has no entry yet).
+    servers[0].receive("client-x", ClientRequest(round=0, command=cmd(0)))
+    servers[1].receive("client-y", ClientRequest(round=0, command=cmd(1)))
+    transport.deliver_all()
+    for timer in list(transport.running_timers()):
+        if timer.name.startswith("resend"):
+            transport.trigger_timer(timer.id)
+    transport.deliver_all()
+    # Slot 0 must hold c0 (not the racing noop) on every server.
+    for server in servers:
+        assert server.log.get(0).chosen
+        assert server.log.get(0).vote_value.command == b"c0"
+        assert server.log.get(1).chosen
+        assert server.log.get(1).vote_value.command == b"c1"
+        assert server.executed_watermark >= 2
+
+
+def test_f1_optimization_chooses_on_receipt():
+    """With f=1, a delegate that votes for the other delegate's Phase2a
+    knows immediately that the value is chosen."""
+    transport, _, servers, _ = make_fasterpaxos()
+    servers[0].receive("client-x", ClientRequest(round=0, command=cmd(0)))
+    # Deliver ONLY the Phase2a from server 0 to server 1 -- no Phase2b
+    # back, no Phase3a.
+    for message in list(transport.messages):
+        if message.dst == "server-1":
+            payload = servers[1].serializer.from_bytes(message.data)
+            if isinstance(payload, Phase2a):
+                transport.deliver_message(message)
+    entry = servers[1].log.get(0)
+    assert entry is not None and entry.chosen
+    assert entry.vote_value.command == b"c0"
+
+
+def test_heartbeat_drives_round_change_off_dead_delegate():
+    """A server whose heartbeat declares a delegate dead runs Phase1 in
+    its own next round and excludes the dead server from delegation."""
+    transport, _, servers, clients = make_fasterpaxos(with_heartbeat=True)
+    transport.deliver_all()  # initial pings/pongs
+    got = []
+    clients[0].write(0, b"pre", got.append)
+    transport.deliver_all()
+    assert got == [b"0"]
+    # Kill server 0 (the round-0 leader) and its heartbeat.
+    transport.partition("server-0")
+    transport.partition("hb-0")
+    # Server 1's heartbeat re-pings hb-0 (success timer), the ping is
+    # dropped at the partition, and the fail timer marks it dead after
+    # num_retries=1.
+    for name in ("success-hb-0", "fail-hb-0", "fail-hb-0"):
+        for timer in list(transport.running_timers()):
+            if timer.address == "hb-1" and timer.name == name:
+                transport.trigger_timer(timer.id)
+                break
+        transport.deliver_all()
+    assert "hb-0" not in servers[1].heartbeat.unsafe_alive()
+    # Server 1's leaderChange timer fires: it takes over round 1.
+    for timer in list(transport.running_timers()):
+        if timer.address == "server-1" and timer.name == "leaderChange":
+            transport.trigger_timer(timer.id)
+    transport.deliver_all()
+    assert servers[1].is_leader
+    assert 0 not in servers[1].delegates
+    # Writes flow through the new delegates.
+    got2 = []
+    clients[1].write(0, b"post", got2.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: bool(got2), rounds=25)
+    for server in servers[1:]:
+        assert server.state_machine.get().count(b"post") == 1
