@@ -16,14 +16,24 @@ Phase-1 recovery uses Fast Paxos's rule: at the max vote round k, a
 unique value wins; else a value with >= majority-of-quorum votes wins;
 else any (noop). Chosen values are gossiped to other leaders
 (ValueChosen) so standbys maintain the log. Election is raft-style
-(election/raft); liveness knobs (wait/stagger buffers, thrifty quorums)
-are simplified here.
+(election/raft).
+
+Liveness/performance knobs:
+  * thrifty quorums (Leader.scala:464-500): the leader sends Phase1as
+    and classic Phase2as to only quorum-size acceptors chosen by a
+    ThriftySystem (with the reference's placeholder uniform delays);
+  * wait/stagger buffering (Acceptor.scala:60-90, 200-230): acceptors
+    optionally buffer direct client proposals and process them in
+    deterministically-sorted batches every wait_period, a heuristic
+    that cuts fast-path conflicts; resulting Phase2bs travel in one
+    Phase2bBuffer.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
+import time
 from collections import Counter
 from typing import Callable, Optional, Union
 
@@ -36,6 +46,7 @@ from frankenpaxos_tpu.roundsystem import RoundSystem, RoundType
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.thrifty import ThriftySystem
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,6 +165,14 @@ class Phase2b:
 
 
 @dataclasses.dataclass(frozen=True)
+class Phase2bBuffer:
+    """A batch of Phase2bs from one acceptor drain
+    (Acceptor.scala:215-229)."""
+
+    phase2bs: tuple[Phase2b, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class ValueChosen:
     slot: int
     value: Value
@@ -166,14 +185,29 @@ class _AcceptorEntry:
     any_round: Optional[int] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class FastMultiPaxosAcceptorOptions:
+    """Conflict-avoidance buffering of direct client proposals
+    (AcceptorOptions, Acceptor.scala:60-90). With both zero, proposals
+    are processed immediately."""
+
+    wait_period_s: float = 0.0
+    wait_stagger_s: float = 0.0
+
+
 class FastMultiPaxosAcceptor(Actor):
     """(fastmultipaxos/Acceptor.scala:60-520)."""
 
     def __init__(self, address: Address, transport: Transport,
-                 logger: Logger, config: FastMultiPaxosConfig):
+                 logger: Logger, config: FastMultiPaxosConfig,
+                 options: FastMultiPaxosAcceptorOptions =
+                 FastMultiPaxosAcceptorOptions(),
+                 clock: Callable[[], float] = time.monotonic):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        self.options = options
+        self.clock = clock
         self.acceptor_id = list(config.acceptor_addresses).index(address)
         self.round = -1
         self.log: dict[int, _AcceptorEntry] = {}
@@ -184,6 +218,19 @@ class FastMultiPaxosAcceptor(Actor):
             config.acceptor_heartbeat_addresses[self.acceptor_id], transport,
             logger, list(config.acceptor_heartbeat_addresses),
             HeartbeatOptions())
+        # Wait/stagger buffering (Acceptor.scala:140-160).
+        self.buffered_proposals: list[
+            tuple[float, Address, ProposeRequest]] = []
+        self._wait_timer = None
+        if options.wait_period_s > 0 or options.wait_stagger_s > 0:
+            def process():
+                self._process_buffered_proposals()
+                self._wait_timer.start()
+
+            self._wait_timer = self.timer(
+                "processBufferedProposeRequests", options.wait_period_s,
+                process)
+            self._wait_timer.start()
 
     def _entry(self, slot: int) -> _AcceptorEntry:
         entry = self.log.get(slot)
@@ -211,6 +258,16 @@ class FastMultiPaxosAcceptor(Actor):
 
     def _handle_propose_request(self, src: Address,
                                 request: ProposeRequest) -> None:
+        if self._wait_timer is not None:
+            self.buffered_proposals.append((self.clock(), src, request))
+            return
+        phase2b = self._process_propose_request(src, request)
+        if phase2b is not None:
+            self.send(self._leader_of(self.round), phase2b)
+
+    def _process_propose_request(self, src: Address,
+                                 request: ProposeRequest
+                                 ) -> Optional[Phase2b]:
         """Vote directly in our next open slot iff it carries the current
         round's any marker (Acceptor.scala:220-236)."""
         entry = self._entry(self.next_slot)
@@ -222,7 +279,35 @@ class FastMultiPaxosAcceptor(Actor):
                               slot=self.next_slot, round=self.round,
                               vote=request.command)
             self.next_slot += 1
-            self.send(self._leader_of(self.round), phase2b)
+            return phase2b
+        return None
+
+    def _process_buffered_proposals(self) -> None:
+        """Drain proposals older than the stagger cutoff in a
+        deterministic order (processBufferedProposeRequests,
+        Acceptor.scala:200-230): identically-configured acceptors that
+        buffered the same conflicting proposals vote on them in the
+        same order, avoiding fast-path conflicts."""
+        cutoff = self.clock() - self.options.wait_stagger_s
+        take = 0
+        while take < len(self.buffered_proposals) \
+                and self.buffered_proposals[take][0] <= cutoff:
+            take += 1
+        batch = self.buffered_proposals[:take]
+        del self.buffered_proposals[:take]
+        phase2bs = []
+        # Deterministic (hash-seed independent) sort key.
+        for _, src, request in sorted(
+                batch,
+                key=lambda b: (repr(b[1]),
+                               repr(b[2].command.command_id),
+                               b[2].command.command)):
+            phase2b = self._process_propose_request(src, request)
+            if phase2b is not None:
+                phase2bs.append(phase2b)
+        if phase2bs:
+            self.send(self._leader_of(self.round),
+                      Phase2bBuffer(tuple(phase2bs)))
 
     def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
         if phase1a.round <= self.round:
@@ -289,17 +374,28 @@ class _Phase2State:
     phase2bs: dict[int, dict[int, Phase2b]]
 
 
+@dataclasses.dataclass(frozen=True)
+class FastMultiPaxosLeaderOptions:
+    """LeaderOptions (Leader.scala:30-60). ``thrifty_system`` None
+    means send to every acceptor."""
+
+    thrifty_system: Optional[ThriftySystem] = None
+
+
 class FastMultiPaxosLeader(Actor):
     """(fastmultipaxos/Leader.scala:35-1350)."""
 
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: FastMultiPaxosConfig,
                  state_machine: StateMachine,
+                 options: FastMultiPaxosLeaderOptions =
+                 FastMultiPaxosLeaderOptions(),
                  election_options: RaftElectionOptions =
                  RaftElectionOptions(), seed: int = 0):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        self.options = options
         self.state_machine = state_machine
         self.rng = random.Random(seed)
         self.leader_id = list(config.leader_addresses).index(address)
@@ -309,10 +405,30 @@ class FastMultiPaxosLeader(Actor):
         self.chosen_watermark = 0
         self.next_slot = 0
         self.client_table: dict[Address, tuple[int, bytes]] = {}
+        # Leaders monitor the ACCEPTORS (Leader.scala:341-353): the
+        # alive count gates fast rounds and the delay estimates feed
+        # thrifty Closest selection.
         self.heartbeat = HeartbeatParticipant(
             config.leader_heartbeat_addresses[self.leader_id], transport,
-            logger, list(config.leader_heartbeat_addresses),
+            logger, list(config.acceptor_heartbeat_addresses),
             HeartbeatOptions())
+        # Liveness: thrifty sends target a bare quorum, so resends go to
+        # every acceptor (resendPhase1as/resendPhase2as timers,
+        # Leader.scala:355-376).
+
+        def resend_phase1as():
+            if isinstance(self.state, _Phase1State):
+                self._send_phase1as(thrifty=False)
+            self.resend_phase1as_timer.start()
+
+        def resend_phase2as():
+            self._resend_phase2as()
+            self.resend_phase2as_timer.start()
+
+        self.resend_phase1as_timer = self.timer(
+            "resendPhase1as", 5.0, resend_phase1as)
+        self.resend_phase2as_timer = self.timer(
+            "resendPhase2as", 5.0, resend_phase2as)
         self.election = RaftElectionParticipant(
             config.leader_election_addresses[self.leader_id], transport,
             logger, list(config.leader_election_addresses),
@@ -323,6 +439,7 @@ class FastMultiPaxosLeader(Actor):
         if self.round == 0:
             self._send_phase1as()
             self.state: object = _Phase1State({}, [])
+            self.resend_phase1as_timer.start()
         else:
             self.state = None  # Inactive
 
@@ -330,13 +447,40 @@ class FastMultiPaxosLeader(Actor):
     def _other_leaders(self):
         return [a for a in self.config.leader_addresses if a != self.address]
 
-    def _send_phase1as(self) -> None:
+    def _thrifty_acceptors(self, min_size: int) -> list[Address]:
+        """thriftyAcceptors (Leader.scala:464-483): pick at least
+        ``min_size`` acceptors via the thrifty system, fed by the
+        heartbeat's delay estimates (dead acceptors report infinite
+        delay, so Closest avoids them)."""
+        if self.options.thrifty_system is None:
+            return list(self.config.acceptor_addresses)
+        delays_by_hb = self.heartbeat.unsafe_network_delay()
+        delays = {
+            self.config.acceptor_addresses[i]: delays_by_hb.get(hb, 0.0)
+            for i, hb in enumerate(
+                self.config.acceptor_heartbeat_addresses)}
+        return sorted(self.options.thrifty_system.choose(
+            delays, min_size, self.rng))
+
+    def _resend_phase2as(self) -> None:
+        """Re-send every pending Phase2a to every acceptor
+        (Leader.scala:365-376)."""
+        if not isinstance(self.state, _Phase2State):
+            return
+        for slot, value in self.state.pending_entries.items():
+            phase2a = Phase2a(slot=slot, round=self.round, value=value)
+            for acceptor in self.config.acceptor_addresses:
+                self.send(acceptor, phase2a)
+
+    def _send_phase1as(self, thrifty: bool = False) -> None:
         phase1a = Phase1a(round=self.round,
                           chosen_watermark=self.chosen_watermark,
                           chosen_slots=tuple(
                               s for s in sorted(self.log)
                               if s >= self.chosen_watermark))
-        for acceptor in self.config.acceptor_addresses:
+        targets = (self._thrifty_acceptors(self.config.classic_quorum_size)
+                   if thrifty else self.config.acceptor_addresses)
+        for acceptor in targets:
             self.send(acceptor, phase1a)
 
     def _on_leader_change(self, leader_address: Address) -> None:
@@ -344,10 +488,13 @@ class FastMultiPaxosLeader(Actor):
                  == self.config.leader_election_addresses[self.leader_id])
         if not is_me:
             self.state = None
+            self.resend_phase1as_timer.stop()
+            self.resend_phase2as_timer.stop()
             return
-        self._bump_round_and_restart(self.round)
+        self._bump_round_and_restart(self.round, thrifty=False)
 
-    def _bump_round_and_restart(self, higher_than: int) -> None:
+    def _bump_round_and_restart(self, higher_than: int,
+                                thrifty: bool = True) -> None:
         rs = self.config.round_system
         if len(self.heartbeat.unsafe_alive()) >= self.config.fast_quorum_size:
             next_fast = rs.next_fast_round(self.leader_id, higher_than)
@@ -356,8 +503,12 @@ class FastMultiPaxosLeader(Actor):
                                                      higher_than))
         else:
             self.round = rs.next_classic_round(self.leader_id, higher_than)
-        self._send_phase1as()
+        # Nack/stuck-driven restarts are thrifty (Leader.scala:433); the
+        # initial round and election-driven takeovers are not (:359).
+        self._send_phase1as(thrifty=thrifty)
         self.state = _Phase1State({}, [])
+        self.resend_phase2as_timer.stop()
+        self.resend_phase1as_timer.start()
 
     def _choose_proposal(self, phase1bs: dict[int, Phase1b],
                          slot: int) -> Value:
@@ -424,6 +575,9 @@ class FastMultiPaxosLeader(Actor):
             self._handle_phase1b_nack(src, message)
         elif isinstance(message, Phase2b):
             self._handle_phase2b(src, message)
+        elif isinstance(message, Phase2bBuffer):
+            for phase2b in message.phase2bs:
+                self._handle_phase2b(src, phase2b)
         elif isinstance(message, ValueChosen):
             self._handle_value_chosen(src, message)
         else:
@@ -450,7 +604,8 @@ class FastMultiPaxosLeader(Actor):
         self.state.pending_entries[slot] = request.command
         phase2a = Phase2a(slot=slot, round=self.round,
                           value=request.command)
-        for acceptor in self.config.acceptor_addresses:
+        for acceptor in self._thrifty_acceptors(
+                self.config.quorum_size(self.round)):
             self.send(acceptor, phase2a)
 
     def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
@@ -471,12 +626,15 @@ class FastMultiPaxosLeader(Actor):
                 continue
             value = self._choose_proposal(state.phase1bs, slot)
             phase2.pending_entries[slot] = value
-            for acceptor in self.config.acceptor_addresses:
+            for acceptor in self._thrifty_acceptors(
+                    self.config.quorum_size(self.round)):
                 self.send(acceptor, Phase2a(slot=slot, round=self.round,
                                             value=value))
         self.next_slot = max(self.next_slot, max_slot + 1)
         pending = state.pending_proposals
         self.state = phase2
+        self.resend_phase1as_timer.stop()
+        self.resend_phase2as_timer.start()
         if self.config.round_system.round_type(self.round) \
                 == RoundType.FAST:
             # Open the suffix for direct client proposals.
