@@ -64,10 +64,47 @@ def normalized(d: DepSetBatch) -> DepSetBatch:
     present_from = jnp.where(ids[None, None, :] >= d.watermarks[:, :, None],
                              tails, jnp.uint8(1))
     run = jnp.cumprod(present_from, axis=-1).sum(axis=-1)       # [B, L]
-    new_wm = jnp.maximum(d.watermarks, d.tail_base + run)
+    # The run from the window start is contiguous with the watermark only
+    # when the watermark has reached the window (wm >= tail_base);
+    # otherwise ids in [wm, tail_base) are absent and nothing absorbs.
+    new_wm = jnp.where(d.watermarks >= d.tail_base,
+                       jnp.maximum(d.watermarks, d.tail_base + run),
+                       d.watermarks)
     covered2 = ids[None, None, :] < new_wm[:, :, None]
     return DepSetBatch(new_wm, jnp.where(covered2, jnp.uint8(0), tails),
                        d.tail_base)
+
+
+@jax.jit
+def union_reduce(d: DepSetBatch) -> DepSetBatch:
+    """Union of ALL rows as a normalized single-row batch.
+
+    The EPaxos slow path unions the dependency sets of every PreAcceptOk
+    in a quorum (epaxos/Replica.scala:795-813); here the whole reply set
+    reduces in one device step: max over watermark columns, OR over
+    tails, then IntPrefixSet compaction.
+    """
+    red = DepSetBatch(
+        watermarks=d.watermarks.max(axis=0, keepdims=True),
+        tails=d.tails.max(axis=0, keepdims=True),
+        tail_base=d.tail_base,
+    )
+    return normalized(red)
+
+
+@jax.jit
+def all_equal(d: DepSetBatch) -> jax.Array:
+    """[] bool: do all B rows denote the same set?
+
+    The EPaxos fast path commits when every counted PreAcceptOk carries
+    identical dependencies (epaxos/Replica.scala:1291-1420) -- with the
+    count threshold equal to the reply count, "k identical" reduces to
+    "all equal". Rows are normalized before comparison so representation
+    differences (tail bits vs watermark) don't break set equality.
+    """
+    n = normalized(d)
+    return (jnp.all(n.watermarks == n.watermarks[0])
+            & jnp.all(n.tails == n.tails[0]))
 
 
 @jax.jit

@@ -1,0 +1,98 @@
+"""Device-backed dependency-set algebra for the EPaxos replica.
+
+Bridges host ``InstancePrefixSet``s (one IntPrefixSet per replica column,
+epaxos/InstancePrefixSet.scala:12-60) to the batched ``DepSetBatch`` form
+of ``ops/depset.py`` so the replica's two hottest set computations run as
+single device reductions per call instead of per-reply host loops:
+
+  * slow-path dependency union across a quorum of PreAcceptOks
+    (epaxos/Replica.scala:795-813) -> :func:`union_many`;
+  * fast-path "all replies carry identical deps" test
+    (epaxos/Replica.scala:1291-1420) -> :func:`all_identical`.
+
+Sets whose sparse tails span more than ``MAX_TAIL_WINDOW`` ids fall back
+to the host path -- the device layout is a dense window and EPaxos tails
+are near the per-column watermarks in steady state, so the fallback is
+the rare case, not the common one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from frankenpaxos_tpu.compact import IntPrefixSet
+from frankenpaxos_tpu.ops import depset
+from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+    InstancePrefixSet,
+)
+
+MAX_TAIL_WINDOW = 2048
+
+
+def to_batch(sets: list[InstancePrefixSet],
+             num_replicas: int) -> depset.DepSetBatch | None:
+    """Pack host sets into one [B, L, W] device batch.
+
+    Returns None when the sparse tails span a window wider than
+    ``MAX_TAIL_WINDOW`` (callers fall back to host algebra).
+    """
+    import jax.numpy as jnp
+
+    values = [v for s in sets for c in s.columns for v in c.values]
+    base = min(values) if values else 0
+    spread = (max(values) - base + 1) if values else 1
+    width = 8
+    while width < spread:
+        width *= 2
+    if width > MAX_TAIL_WINDOW:
+        return None
+    watermarks = np.zeros((len(sets), num_replicas), dtype=np.int32)
+    tails = np.zeros((len(sets), num_replicas, width), dtype=np.uint8)
+    for b, instance_set in enumerate(sets):
+        for column_index, column in enumerate(instance_set.columns):
+            watermarks[b, column_index] = column.watermark
+            for v in column.values:
+                tails[b, column_index, v - base] = 1
+    return depset.DepSetBatch(jnp.asarray(watermarks), jnp.asarray(tails),
+                              jnp.int32(base))
+
+
+def from_row(watermarks: np.ndarray, tails: np.ndarray,
+             tail_base: int) -> InstancePrefixSet:
+    """Unpack one device row ([L], [L, W]) back into an InstancePrefixSet."""
+    columns = []
+    for column_index in range(watermarks.shape[0]):
+        present = np.nonzero(tails[column_index])[0]
+        columns.append(IntPrefixSet(
+            int(watermarks[column_index]),
+            {tail_base + int(i) for i in present}))
+    return InstancePrefixSet(len(columns), columns)
+
+
+def union_many(sets: list[InstancePrefixSet],
+               num_replicas: int) -> InstancePrefixSet:
+    """Union of all sets, reduced on device (host fallback on overflow)."""
+    batch = to_batch(sets, num_replicas)
+    if batch is None:
+        union = InstancePrefixSet(num_replicas)
+        for instance_set in sets:
+            union.add_all(instance_set)
+        return union
+    reduced = depset.union_reduce(batch)
+    return from_row(np.asarray(reduced.watermarks)[0],
+                    np.asarray(reduced.tails)[0],
+                    int(reduced.tail_base))
+
+
+def all_identical(seq_deps: list[tuple[int, InstancePrefixSet]],
+                  num_replicas: int) -> bool:
+    """Do all (sequence number, deps) pairs denote the same set?"""
+    if len(seq_deps) <= 1:
+        return True
+    if len({seq for seq, _ in seq_deps}) > 1:
+        return False
+    batch = to_batch([deps for _, deps in seq_deps], num_replicas)
+    if batch is None:
+        first = seq_deps[0][1]
+        return all(deps == first for _, deps in seq_deps[1:])
+    return bool(np.asarray(depset.all_equal(batch)))

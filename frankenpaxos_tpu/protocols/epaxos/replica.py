@@ -87,6 +87,10 @@ class EPaxosReplicaOptions:
     # "tarjan", "incremental", or "zigzag" (the reference's ReplicaMain
     # hardwires Zigzag, epaxos/ReplicaMain.scala:127).
     dependency_graph: str = "tarjan"
+    # "host": per-reply IntPrefixSet loops. "tpu": slow-path dep unions and
+    # fast-path identical-deps tests as batched ops/depset.py reductions
+    # (see device_deps.py).
+    dep_backend: str = "host"
 
 
 @dataclasses.dataclass
@@ -316,9 +320,15 @@ class EPaxosReplica(Actor):
                              self.config.slow_quorum_size)
         sequence_number = max(r.sequence_number
                               for r in state.responses.values())
-        dependencies = InstancePrefixSet(self.config.n)
-        for response in state.responses.values():
-            dependencies.add_all(response.dependencies)
+        if self.options.dep_backend == "tpu":
+            from frankenpaxos_tpu.protocols.epaxos import device_deps
+            dependencies = device_deps.union_many(
+                [r.dependencies for r in state.responses.values()],
+                self.config.n)
+        else:
+            dependencies = InstancePrefixSet(self.config.n)
+            for response in state.responses.values():
+                dependencies.add_all(response.dependencies)
         self._transition_to_accept(
             instance, state.ballot,
             Triple(state.command_or_noop, sequence_number, dependencies))
@@ -549,12 +559,25 @@ class EPaxosReplica(Actor):
             seq_deps = [(r.sequence_number, r.dependencies)
                         for i, r in state.responses.items()
                         if i != self.index]
-            counts = _Counter(seq_deps)
-            candidates = [sd for sd, c in counts.items()
-                          if c >= fast - 1]
-            if candidates:
-                self.logger.check_eq(len(candidates), 1)
-                sequence_number, dependencies = candidates[0]
+            if (self.options.dep_backend == "tpu"
+                    and len(seq_deps) == fast - 1):
+                # With threshold == reply count, "count >= fast-1"
+                # collapses to "all replies identical" -- one batched
+                # device equality over the normalized dep sets.
+                from frankenpaxos_tpu.protocols.epaxos import device_deps
+                winner = (seq_deps[0]
+                          if device_deps.all_identical(seq_deps,
+                                                       self.config.n)
+                          else None)
+            else:
+                counts = _Counter(seq_deps)
+                candidates = [sd for sd, c in counts.items()
+                              if c >= fast - 1]
+                if candidates:
+                    self.logger.check_eq(len(candidates), 1)
+                winner = candidates[0] if candidates else None
+            if winner is not None:
+                sequence_number, dependencies = winner
                 self._commit(ok.instance,
                              Triple(state.command_or_noop, sequence_number,
                                     dependencies.copy()),

@@ -33,6 +33,8 @@ import dataclasses
 import random
 from typing import Callable, Optional, Union
 
+import numpy as np
+
 from frankenpaxos_tpu.quorums import (
     QuorumSystem,
     SimpleMajority,
@@ -319,6 +321,10 @@ class _Phase1:
     pending_rounds: set[int]
     phase1bs: dict[int, Phase1b]
     pending_batches: list[ClientRequest]
+    # quorum_backend="tpu": (sorted prior rounds, MultiConfigQuorumChecker)
+    # evaluating "responders cover a read quorum" for every prior
+    # configuration as one padded [K, G, N] device batch.
+    checker: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -337,10 +343,13 @@ def initial_matchmaker_configuration(f: int) -> MatchmakerConfiguration:
 class MMPLeader(Actor):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: MatchmakerMultiPaxosConfig,
-                 seed: int = 0):
+                 seed: int = 0, quorum_backend: str = "dict"):
         super().__init__(address, transport, logger)
         config.check_valid()
+        if quorum_backend not in ("dict", "tpu"):
+            raise ValueError(f"unknown quorum backend {quorum_backend!r}")
         self.config = config
+        self.quorum_backend = quorum_backend
         self.rng = random.Random(seed)
         self.index = list(config.leader_addresses).index(address)
         self.round_system = ClassicRoundRobin(len(config.leader_addresses))
@@ -479,8 +488,21 @@ class MMPLeader(Actor):
                           chosen_watermark=self.chosen_watermark)
         for i in targets:
             self.send(self._acceptor(i), phase1a)
+        checker = None
+        if self.quorum_backend == "tpu":
+            # The quorum-matrix-reshape north star (SURVEY.md section 2.3):
+            # each prior round's read predicate becomes one plane of a
+            # padded [K, G, N] tensor; every Phase1b then re-checks all
+            # prior configurations in a single device batch instead of the
+            # per-round host loop (Leader.scala:1788-1999).
+            from frankenpaxos_tpu.ops.quorum import MultiConfigQuorumChecker
+            universe = tuple(range(len(self.config.acceptor_addresses)))
+            rounds_sorted = sorted(previous)
+            checker = (rounds_sorted, MultiConfigQuorumChecker(
+                [previous[r].read_spec().reindexed(universe)
+                 for r in rounds_sorted]))
         self.state = _Phase1(state.quorum_system, previous, pending_rounds,
-                             {}, state.pending_batches)
+                             {}, state.pending_batches, checker)
 
     def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
         if not isinstance(self.state, _Phase1) \
@@ -489,9 +511,22 @@ class MMPLeader(Actor):
         state = self.state
         state.phase1bs[phase1b.acceptor_index] = phase1b
         responders = set(state.phase1bs)
-        for round in list(state.pending_rounds):
-            if state.previous[round].is_superset_of_read_quorum(responders):
-                state.pending_rounds.discard(round)
+        if state.checker is not None:
+            rounds_sorted, checker = state.checker
+            present = np.zeros(
+                (len(rounds_sorted), len(self.config.acceptor_addresses)),
+                dtype=np.uint8)
+            present[:, sorted(responders)] = 1
+            hits = checker.check_batch(
+                present, np.arange(len(rounds_sorted), dtype=np.int32))
+            for round, hit in zip(rounds_sorted, hits):
+                if hit:
+                    state.pending_rounds.discard(round)
+        else:
+            for round in list(state.pending_rounds):
+                if state.previous[round].is_superset_of_read_quorum(
+                        responders):
+                    state.pending_rounds.discard(round)
         if state.pending_rounds:
             return
         max_slot = max((i.slot for p in state.phase1bs.values()

@@ -59,6 +59,9 @@ class LeaderOptions:
     noop_flush_period_s: float = 0.0  # 0 disables
     election_options: ElectionOptions = ElectionOptions()
     measure_latencies: bool = True
+    # "host": the reference's per-slot safeValue scan. "tpu": one batched
+    # ops/value.safe_values masked-argmax over the whole recovery window.
+    phase1_backend: str = "host"
 
 
 class _Inactive:
@@ -141,6 +144,64 @@ class Leader(Actor):
                 if info.slot == slot and info.vote_round > best_round:
                     best_round, best_value = info.vote_round, info.vote_value
         return NOOP if best_value is None else best_value
+
+    def _recover_values(self, phase1: "_Phase1", max_slot: int) -> list:
+        """Safe values for ``[chosen_watermark, max_slot]``, one per slot.
+
+        The host path replays ``_safe_value`` per slot; the tpu path lifts
+        the whole recovery window into one ``[S, N]`` masked argmax
+        (ops/value.safe_values) -- votes become (round, value-id) matrices,
+        the device returns each slot's highest-round value id, and the
+        host maps ids back to values (Leader.scala:504-576's scan as a
+        single reduction).
+        """
+        slots = range(self.chosen_watermark, max_slot + 1)
+        if self.options.phase1_backend != "tpu":
+            return [
+                self._safe_value(
+                    phase1.phase1bs[s % self.config.num_acceptor_groups]
+                    .values(), s)
+                for s in slots
+            ]
+
+        import numpy as np
+
+        from frankenpaxos_tpu.ops import value as value_ops
+
+        num_slots = max_slot + 1 - self.chosen_watermark
+        if num_slots <= 0:
+            return []
+        num_groups = self.config.num_acceptor_groups
+        n_cols = num_groups * self._row_size
+        padded = 1
+        while padded < num_slots:
+            padded *= 2
+        vote_rounds = np.full((padded, n_cols), value_ops.NO_VOTE,
+                              dtype=np.int32)
+        value_ids = np.zeros((padded, n_cols), dtype=np.int32)
+        values_by_id: list = []
+        id_by_value: dict = {}
+        for group_index, group in enumerate(phase1.phase1bs):
+            for acceptor_index, phase1b in group.items():
+                col = group_index * self._row_size + acceptor_index
+                for info in phase1b.info:
+                    if not (self.chosen_watermark <= info.slot <= max_slot):
+                        continue
+                    if info.slot % num_groups != group_index:
+                        continue
+                    vid = id_by_value.get(info.vote_value)
+                    if vid is None:
+                        vid = len(values_by_id)
+                        id_by_value[info.vote_value] = vid
+                        values_by_id.append(info.vote_value)
+                    row = info.slot - self.chosen_watermark
+                    vote_rounds[row, col] = info.vote_round
+                    value_ids[row, col] = vid
+        has_vote, chosen = value_ops.safe_values(vote_rounds, value_ids)
+        has_vote = np.asarray(has_vote)[:num_slots]
+        chosen = np.asarray(chosen)[:num_slots]
+        return [values_by_id[int(vid)] if hit else NOOP
+                for hit, vid in zip(has_vote, chosen)]
 
     def _send_phase2a(self, phase2a: Phase2a) -> None:
         dst = self._proxy_leader_address()
@@ -279,11 +340,11 @@ class Leader(Actor):
              for p1b in group.values()
              for info in p1b.info),
             default=-1)
-        for slot in range(self.chosen_watermark, max_slot + 1):
-            group = phase1.phase1bs[slot % self.config.num_acceptor_groups]
-            self._send_phase2a(Phase2a(
-                slot=slot, round=self.round,
-                value=self._safe_value(group.values(), slot)))
+        values = self._recover_values(phase1, max_slot)
+        for slot, value in zip(range(self.chosen_watermark, max_slot + 1),
+                               values):
+            self._send_phase2a(Phase2a(slot=slot, round=self.round,
+                                       value=value))
         self.next_slot = max_slot + 1
 
         phase1.resend_phase1as.stop()

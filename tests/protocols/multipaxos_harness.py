@@ -57,6 +57,7 @@ def make_multipaxos(
     grid_shape: tuple[int, int] | None = None,
     batch_size: int = 1,
     quorum_backend: str = "dict",
+    phase1_backend: str = "host",
     state_machine_factory=AppendLog,
     seed: int = 0,
     log_level: LogLevel = LogLevel.FATAL,
@@ -100,7 +101,9 @@ def make_multipaxos(
         for i, a in enumerate(config.read_batcher_addresses)]
     leaders = [
         Leader(a, transport, logger, config,
-               LeaderOptions(resend_phase1as_period_s=5.0), seed=seed + i)
+               LeaderOptions(resend_phase1as_period_s=5.0,
+                             phase1_backend=phase1_backend),
+               seed=seed + i)
         for i, a in enumerate(config.leader_addresses)]
     proxy_leaders = [
         ProxyLeader(a, transport, logger, config,

@@ -35,7 +35,8 @@ SER = PickleSerializer()
 
 
 def make_epaxos(f=1, num_clients=1, state_machine_factory=KeyValueStore,
-                seed=0, top_k=1, dependency_graph="tarjan"):
+                seed=0, top_k=1, dependency_graph="tarjan",
+                dep_backend="host"):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     config = EPaxosConfig(
@@ -43,7 +44,8 @@ def make_epaxos(f=1, num_clients=1, state_machine_factory=KeyValueStore,
     replicas = [
         EPaxosReplica(a, transport, logger, config, state_machine_factory(),
                       EPaxosReplicaOptions(top_k_dependencies=top_k,
-                                           dependency_graph=dependency_graph),
+                                           dependency_graph=dependency_graph,
+                                           dep_backend=dep_backend),
                       seed=seed + i)
         for i, a in enumerate(config.replica_addresses)]
     clients = [EPaxosClient(f"client-{i}", transport, logger, config,
@@ -128,6 +130,34 @@ class TestEPaxosIntegration:
         transport.deliver_all()
         assert len(got) == 1
 
+    @pytest.mark.parametrize("f", [1, 2])
+    def test_tpu_dep_backend_matches(self, f):
+        """dep_backend=tpu: conflicting proposals (slow-path device dep
+        unions + fast-path device equality) commit identically on every
+        replica, and match a host-backend run command for command."""
+        runs = {}
+        for backend in ("host", "tpu"):
+            transport, _, replicas, clients = make_epaxos(
+                f=f, num_clients=3, dep_backend=backend)
+            for i, client in enumerate(clients):
+                client.propose(0, SER.to_bytes(
+                    SetRequest(((f"k{i % 2}", str(i)),))))
+            transport.deliver_all()
+            for i, client in enumerate(clients):
+                client.propose(1, SER.to_bytes(
+                    SetRequest((("shared", str(i)),))))
+            transport.deliver_all()
+            states = [r.state_machine.get() for r in replicas]
+            assert all(s == states[0] for s in states[1:]), backend
+            committed = committed_triples(replicas[0])
+            runs[backend] = {
+                instance: (triple[0], triple[1],
+                           tuple(sorted(triple[2].materialize())))
+                for instance, triple in committed.items()}
+        # Same deterministic seed: both backends commit the same
+        # instances with the same values and dependency sets.
+        assert runs["host"] == runs["tpu"]
+
 
 # --- property-based simulation ---------------------------------------------
 
@@ -160,9 +190,12 @@ class EPaxosSimulated(SimulatedSystem):
 
     KEYS = ["a", "b"]
 
+    def __init__(self, dep_backend="host"):
+        self.dep_backend = dep_backend
+
     def new_system(self, seed):
         transport, config, replicas, clients = make_epaxos(
-            num_clients=2, seed=seed)
+            num_clients=2, seed=seed, dep_backend=self.dep_backend)
         system = dict(transport=transport, replicas=replicas,
                       clients=clients, counter=0)
         return system
@@ -213,6 +246,14 @@ class EPaxosSimulated(SimulatedSystem):
 def test_simulation_committed_agreement():
     failure = Simulator(EPaxosSimulated(), run_length=120, num_runs=20
                         ).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_simulation_committed_agreement_tpu_backend():
+    """The randomized interleaving sim with every dep-set reduction on
+    device (the dict-oracle equivalence bar from round 1)."""
+    failure = Simulator(EPaxosSimulated(dep_backend="tpu"),
+                        run_length=120, num_runs=5).run(seed=0)
     assert failure is None, str(failure)
 
 

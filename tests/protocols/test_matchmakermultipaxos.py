@@ -16,7 +16,7 @@ from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
 
 
 def make_mmp(f=1, num_acceptors=5, num_clients=2, seed=0,
-             num_matchmakers=None):
+             num_matchmakers=None, quorum_backend="dict"):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     config = MatchmakerMultiPaxosConfig(
@@ -29,7 +29,8 @@ def make_mmp(f=1, num_acceptors=5, num_clients=2, seed=0,
         acceptor_addresses=tuple(
             f"acceptor-{i}" for i in range(num_acceptors)),
         replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)))
-    leaders = [MMPLeader(a, transport, logger, config, seed=seed + i)
+    leaders = [MMPLeader(a, transport, logger, config, seed=seed + i,
+                         quorum_backend=quorum_backend)
                for i, a in enumerate(config.leader_addresses)]
     matchmakers = [MMPMatchmaker(a, transport, logger, config)
                    for a in config.matchmaker_addresses]
@@ -152,6 +153,58 @@ def test_stopped_epoch_bounces_leader_to_new_epoch():
     clients[0].write(0, b"bounced", got.append)
     transport.deliver_all()
     assert got == [b"0"]
+
+
+def test_live_reconfiguration_tpu_backend():
+    """Same reconfiguration flow with the phase-1 prior-config quorum
+    checks running through MultiConfigQuorumChecker on device."""
+    (transport, config, leaders, matchmakers, reconfigurer, acceptors,
+     replicas, clients) = make_mmp(num_acceptors=6, quorum_backend="tpu")
+    transport.deliver_all()
+    got = []
+    clients[0].write(0, b"before", got.append)
+    transport.deliver_all()
+    reconfigurer.reconfigure(SimpleMajority([3, 4, 5]))
+    transport.deliver_all()
+    clients[0].write(0, b"after", got.append)
+    transport.deliver_all()
+    assert got == [b"0", b"1"]
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1] == [b"before", b"after"]
+
+
+def test_multi_config_checker_matches_host_oracle():
+    """MultiConfigQuorumChecker == is_superset_of_read_quorum for random
+    prior-configuration sets and responder sets (the dict oracle)."""
+    import itertools
+    import random as _random
+
+    import numpy as np
+
+    from frankenpaxos_tpu.ops.quorum import MultiConfigQuorumChecker
+    from frankenpaxos_tpu.quorums import Grid, UnanimousWrites
+
+    rng = _random.Random(7)
+    num_acceptors = 8
+    universe = tuple(range(num_acceptors))
+    systems = [
+        SimpleMajority([0, 1, 2]),
+        SimpleMajority([2, 3, 4, 5, 6]),
+        Grid([[0, 1], [2, 3], [4, 5]]),
+        UnanimousWrites([5, 6, 7]),
+    ]
+    checker = MultiConfigQuorumChecker(
+        [qs.read_spec().reindexed(universe) for qs in systems])
+    for size in range(num_acceptors + 1):
+        for responders in itertools.islice(
+                itertools.combinations(range(num_acceptors), size), 20):
+            present = np.zeros((len(systems), num_acceptors), dtype=np.uint8)
+            present[:, list(responders)] = 1
+            hits = checker.check_batch(
+                present, np.arange(len(systems), dtype=np.int32))
+            for qs, hit in zip(systems, hits):
+                assert bool(hit) == qs.is_superset_of_read_quorum(
+                    set(responders)), (qs, responders)
 
 
 def test_survives_f_matchmaker_deaths():

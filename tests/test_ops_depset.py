@@ -1,0 +1,114 @@
+"""ops/depset.py + epaxos/device_deps.py vs the host IntPrefixSet oracle.
+
+The host InstancePrefixSet (epaxos/InstancePrefixSet.scala:12-60
+semantics) is the oracle: every device reduction must agree with the
+equivalent host set algebra on randomized inputs.
+"""
+
+import random
+
+import numpy as np
+
+from frankenpaxos_tpu.compact import IntPrefixSet
+from frankenpaxos_tpu.ops import depset
+from frankenpaxos_tpu.protocols.epaxos import device_deps
+from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+    Instance,
+    InstancePrefixSet,
+)
+
+
+def random_instance_set(rng: random.Random, num_replicas: int,
+                        max_id: int = 40) -> InstancePrefixSet:
+    columns = []
+    for _ in range(num_replicas):
+        watermark = rng.randrange(max_id // 2)
+        values = {rng.randrange(max_id) for _ in range(rng.randrange(5))}
+        columns.append(IntPrefixSet(watermark, values))
+    return InstancePrefixSet(num_replicas, columns)
+
+
+def test_to_batch_round_trips():
+    rng = random.Random(1)
+    for _ in range(25):
+        original = random_instance_set(rng, 3)
+        batch = device_deps.to_batch([original], 3)
+        assert batch is not None
+        back = device_deps.from_row(np.asarray(batch.watermarks)[0],
+                                    np.asarray(batch.tails)[0],
+                                    int(batch.tail_base))
+        assert back.materialize() == original.materialize()
+
+
+def test_union_reduce_matches_host_union():
+    rng = random.Random(2)
+    for trial in range(25):
+        num_sets = rng.randrange(2, 6)
+        sets = [random_instance_set(rng, 3) for _ in range(num_sets)]
+        device = device_deps.union_many(sets, 3)
+        host = InstancePrefixSet(3)
+        for s in sets:
+            host.add_all(s)
+        assert device.materialize() == host.materialize(), trial
+        # The reduced form must also be canonical (watermark absorbed).
+        assert device == host, trial
+
+
+def test_union_many_falls_back_on_wide_tails():
+    wide = InstancePrefixSet(
+        3, [IntPrefixSet(0, {0, device_deps.MAX_TAIL_WINDOW * 3}),
+            IntPrefixSet(), IntPrefixSet()])
+    other = InstancePrefixSet(3, [IntPrefixSet(2, set()),
+                                  IntPrefixSet(0, {5}), IntPrefixSet()])
+    assert device_deps.to_batch([wide, other], 3) is None
+    union = device_deps.union_many([wide, other], 3)
+    host = InstancePrefixSet(3)
+    host.add_all(wide)
+    host.add_all(other)
+    assert union.materialize() == host.materialize()
+
+
+def test_all_equal_matches_set_equality():
+    rng = random.Random(3)
+    for _ in range(25):
+        base = random_instance_set(rng, 3)
+        # Same set, different representation: watermark run as tail bits.
+        alias = InstancePrefixSet(3, [
+            IntPrefixSet(max(c.watermark - 1, 0),
+                         set(c.values)
+                         | ({c.watermark - 1} if c.watermark > 0 else set()))
+            for c in base.columns])
+        assert alias.materialize() == base.materialize()
+        batch = device_deps.to_batch([base, alias, base.copy()], 3)
+        assert bool(np.asarray(depset.all_equal(batch)))
+
+        different = base.copy()
+        different.add(Instance(1, 61))
+        batch = device_deps.to_batch([base, different], 3)
+        assert not bool(np.asarray(depset.all_equal(batch)))
+
+
+def test_all_identical_respects_sequence_numbers():
+    rng = random.Random(4)
+    deps = random_instance_set(rng, 3)
+    assert device_deps.all_identical([(0, deps), (0, deps.copy())], 3)
+    assert not device_deps.all_identical([(0, deps), (1, deps.copy())], 3)
+    assert device_deps.all_identical([(7, deps)], 3)
+    assert device_deps.all_identical([], 3)
+
+
+def test_contains_and_size_match_host():
+    rng = random.Random(5)
+    sets = [random_instance_set(rng, 3) for _ in range(8)]
+    batch = device_deps.to_batch(sets, 3)
+    normalized = depset.normalized(batch)
+    sizes = np.asarray(depset.size(normalized))
+    for b, instance_set in enumerate(sets):
+        assert int(sizes[b]) == len(instance_set.materialize())
+        for _ in range(10):
+            leader = rng.randrange(3)
+            vid = rng.randrange(45)
+            got = bool(np.asarray(depset.contains(
+                normalized, np.full(len(sets), leader, dtype=np.int32),
+                np.full(len(sets), vid, dtype=np.int32)))[b])
+            assert got == instance_set.contains(Instance(leader, vid))
