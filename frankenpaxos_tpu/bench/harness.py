@@ -110,16 +110,67 @@ class SuiteDirectory:
             os.path.join(self.path, f"{self._counter:03d}"))
 
 
+def rolling_throughput(starts_s: Sequence[float],
+                       window_s: float = 1.0) -> np.ndarray:
+    """Rolling-window throughput series (pd_util.py:35-86 semantics).
+
+    For each request start t, the count of starts in (t - window, t]
+    divided by the window, with the first window of samples trimmed
+    (they see a partially-filled window and read artificially low).
+    """
+    starts = np.asarray(sorted(starts_s), dtype=np.float64)
+    if starts.size == 0:
+        return starts
+    lo = np.searchsorted(starts, starts - window_s, side="right")
+    counts = np.arange(1, starts.size + 1) - lo
+    series = counts / window_s
+    keep = starts >= starts[0] + window_s
+    # Match pd_util.throughput's fallback: if everything happened within
+    # one window, trim the first 100 samples instead of all of them.
+    if not keep.any():
+        return series[100:]
+    return series[keep]
+
+
+def _dist(values: np.ndarray, prefix: str, scale: float = 1.0,
+          suffix: str = "") -> dict:
+    if values.size == 0:
+        return {}
+    q = lambda p: float(np.percentile(values, p) * scale)
+    return {
+        f"{prefix}.mean{suffix}": float(values.mean() * scale),
+        f"{prefix}.median{suffix}": q(50),
+        f"{prefix}.min{suffix}": float(values.min() * scale),
+        f"{prefix}.max{suffix}": float(values.max() * scale),
+        f"{prefix}.p90{suffix}": q(90),
+        f"{prefix}.p95{suffix}": q(95),
+        f"{prefix}.p99{suffix}": q(99),
+    }
+
+
 def latency_throughput_stats(latencies_s: Sequence[float],
-                             duration_s: float) -> dict:
-    """The reference's output schema essentials (benchmark.py:310-335)."""
+                             duration_s: float,
+                             starts_s: Optional[Sequence[float]] = None,
+                             ) -> dict:
+    """The reference's RecorderOutput schema (benchmark.py:308-341).
+
+    latency.* in milliseconds over per-request latencies;
+    start_throughput_1s.* as percentiles of the rolling 1-second-window
+    throughput series over request start times (benchmark.py:420) — NOT
+    a mean disguised as a percentile.
+    """
     lat = np.asarray(sorted(latencies_s))
     if lat.size == 0:
         return {"num_requests": 0}
-    return {
-        "num_requests": int(lat.size),
-        "latency.median_ms": float(np.median(lat) * 1000),
-        "latency.p90_ms": float(np.percentile(lat, 90) * 1000),
-        "latency.p99_ms": float(np.percentile(lat, 99) * 1000),
-        "start_throughput_1s.p90": float(lat.size / duration_s),
-    }
+    stats = {"num_requests": int(lat.size)}
+    stats.update(_dist(lat, "latency", scale=1000.0, suffix="_ms"))
+    series = (rolling_throughput(starts_s)
+              if starts_s is not None and len(starts_s) > 0
+              else np.empty(0))
+    if series.size > 0:
+        stats.update(_dist(series, "start_throughput_1s"))
+    else:
+        # No start timestamps recorded: report the honest mean under an
+        # honest name rather than a fake percentile.
+        stats["throughput_mean"] = float(lat.size / duration_s)
+    return stats

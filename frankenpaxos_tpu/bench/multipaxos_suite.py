@@ -97,15 +97,37 @@ def run_benchmark(bench: BenchmarkDirectory,
     if pending:
         bench.cleanup()
         raise RuntimeError(f"roles never became ready: {sorted(pending)}")
-    time.sleep(1.0)  # let leader 0 finish phase 1 against live acceptors
 
-    # Closed-loop clients (in-process, real TCP).
     from frankenpaxos_tpu.cli import load_multipaxos_config
     from frankenpaxos_tpu.protocols.multipaxos import Client, ClientOptions
 
     config = load_multipaxos_config(config_path)
     serializer = PickleSerializer()
+
+    # Explicit leader-ready probe: a warmup write with a short resend
+    # period retries until leader 0 has completed Phase 1 and can commit
+    # it. Only then does the measured run start (replaces the old
+    # sleep-and-hope, which raced under load).
+    probe_logger = FakeLogger(LogLevel.FATAL)
+    probe_transport = TcpTransport(("127.0.0.1", free_port()), probe_logger)
+    probe_transport.start()
+    probe = Client(probe_transport.listen_address, probe_transport,
+                   probe_logger, config,
+                   ClientOptions(resend_client_request_period_s=0.25),
+                   seed=0xBEEF)
+    ready = threading.Event()
+    probe_transport.loop.call_soon_threadsafe(
+        probe.write, 0, serializer.to_bytes(SetRequest((("warmup", "1"),))),
+        lambda _: ready.set())
+    ok = ready.wait(timeout=60)
+    probe_transport.stop()
+    if not ok:
+        bench.cleanup()
+        raise RuntimeError("leader never committed the warmup write")
+
+    # Closed-loop clients (in-process, real TCP).
     latencies: list[float] = []
+    starts: list[float] = []
     lock = threading.Lock()
     stop_at = time.time() + input.duration_s
 
@@ -120,6 +142,7 @@ def run_benchmark(bench: BenchmarkDirectory,
             while time.time() < stop_at:
                 done = threading.Event()
                 t0 = time.perf_counter()
+                wall0 = time.time()
                 transport.loop.call_soon_threadsafe(
                     client.write, 0,
                     serializer.to_bytes(
@@ -129,6 +152,7 @@ def run_benchmark(bench: BenchmarkDirectory,
                     break
                 with lock:
                     latencies.append(time.perf_counter() - t0)
+                    starts.append(wall0)
                 k += 1
         finally:
             transport.stop()
@@ -143,7 +167,7 @@ def run_benchmark(bench: BenchmarkDirectory,
     elapsed = time.time() - start
 
     bench.cleanup()
-    stats = latency_throughput_stats(latencies, elapsed)
+    stats = latency_throughput_stats(latencies, elapsed, starts_s=starts)
     stats["input"] = dataclasses.asdict(input)
     bench.write_json("results.json", stats)
     return stats

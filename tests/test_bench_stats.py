@@ -1,0 +1,56 @@
+"""Stats schema: rolling 1s-window throughput percentiles must match
+the reference's semantics (benchmark.py:308-341, pd_util.py:35-86) —
+a p90 of the windowed series, not a mean in disguise."""
+
+import numpy as np
+
+from frankenpaxos_tpu.bench.harness import (
+    latency_throughput_stats,
+    rolling_throughput,
+)
+
+
+def test_rolling_throughput_uniform():
+    # 8 req/s uniform for 5s (0.125 is exactly representable, so window
+    # boundaries don't jitter): every post-trim window holds 8 starts.
+    starts = [i * 0.125 for i in range(40)]
+    series = rolling_throughput(starts)
+    assert series.size > 0
+    assert np.allclose(series, 8.0)
+
+
+def test_rolling_throughput_bursty_p90_differs_from_mean():
+    # 1s quiet (1 req), then a 1000-req burst in the last second. The
+    # mean over 2s is ~500/s but the windowed p90 sees the burst rate.
+    starts = [0.0] + [1.5 + i * 0.0005 for i in range(1000)]
+    series = rolling_throughput(starts)
+    p90 = np.percentile(series, 90)
+    mean_rate = len(starts) / 2.0
+    assert p90 > mean_rate * 1.5
+
+
+def test_rolling_throughput_trims_first_window():
+    starts = [i * 0.125 for i in range(40)]
+    series = rolling_throughput(starts)
+    # Samples before t0+1s are trimmed: 40 starts, 8 in first second.
+    assert series.size == sum(1 for t in starts if t >= starts[0] + 1.0)
+
+
+def test_stats_schema_fields():
+    starts = [i * 0.01 for i in range(500)]
+    lats = [0.002] * 500
+    stats = latency_throughput_stats(lats, 5.0, starts_s=starts)
+    assert stats["num_requests"] == 500
+    for field in ("mean_ms", "median_ms", "min_ms", "max_ms",
+                  "p90_ms", "p95_ms", "p99_ms"):
+        assert f"latency.{field}" in stats
+    for field in ("mean", "median", "min", "max", "p90", "p95", "p99"):
+        assert f"start_throughput_1s.{field}" in stats
+    assert abs(stats["start_throughput_1s.median"] - 100.0) < 2.0
+    assert abs(stats["latency.median_ms"] - 2.0) < 1e-9
+
+
+def test_stats_without_starts_reports_honest_mean():
+    stats = latency_throughput_stats([0.01] * 10, 2.0)
+    assert "start_throughput_1s.p90" not in stats
+    assert stats["throughput_mean"] == 5.0
