@@ -5,8 +5,16 @@ steady-state Phase2 write path of compartmentalized MultiPaxos --
 propose -> acceptor votes -> quorum check -> chosen -> replica execute ->
 GC -- expressed as one jitted step over a ``[acceptors, window]`` vote
 board with a 1M-slot in-flight window, iterated under ``lax.fori_loop``
-with donated state. No host round-trips on the hot path (mandatory: the
-device link has ~10ms+ fetch latency; see .claude/skills/verify/SKILL.md).
+with donated state. No host round-trips on the hot path.
+
+The SAME ``steady_state_step`` function serves both single-chip execution
+(axes ``None``) and multi-chip ``shard_map`` execution over a
+``(group, slot)`` mesh: acceptor rows shard over ``group`` (quorum counts
+ride a psum over ICI), the slot window shards over ``slot`` (committed /
+sm-state counters psum over it). Global semantics are identical across
+mesh shapes because vote arrivals and proposed commands are functions of
+the *logical* (block-lane, acceptor) coordinates, which partition the
+same way under every sharding.
 
 Mapping to the reference's roles (SURVEY.md section 3.1):
 
@@ -15,7 +23,7 @@ Mapping to the reference's roles (SURVEY.md section 3.1):
     written into the window.
   * Acceptor.handlePhase2a (Acceptor.scala:184-220): vote arrivals land
     as a dense ``[n, B]`` bitmask OR'd into the board. Arrival patterns
-    are hash-derived per (iteration, acceptor, slot): ~87% of votes
+    are hash-derived per (iteration, acceptor, block-lane): ~87% of votes
     arrive in the drain after proposal, the rest one drain later --
     modeling cross-drain vote straggling.
   * ProxyLeader.handlePhase2b (ProxyLeader.scala:217-258): the quorum
@@ -30,7 +38,7 @@ Mapping to the reference's roles (SURVEY.md section 3.1):
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -59,72 +67,108 @@ def make_state(window: int, num_acceptors: int) -> PipelineState:
     )
 
 
-def _arrivals(i: jax.Array, start: jax.Array, n: int, block: int,
+def _arrivals(i: jax.Array, lanes: jax.Array, accs: jax.Array,
               salt: int) -> jax.Array:
-    """Deterministic pseudo-random [n, block] uint8 vote-arrival mask."""
-    lane = start + jnp.arange(block, dtype=jnp.int32)
-    acc = jnp.arange(n, dtype=jnp.int32)[:, None]
-    h = (lane[None, :] * 1103515245 + acc * 12820163
+    """Deterministic pseudo-random [len(accs), len(lanes)] uint8 arrival
+    mask, keyed by logical (block-lane, global-acceptor) coordinates so
+    every mesh sharding generates the same votes for the same slot."""
+    h = (lanes[None, :] * 1103515245 + accs[:, None] * 12820163
          + (i + salt) * 22695477) >> 7
     return ((h & 7) < 7).astype(jnp.uint8)  # ~87.5% arrive this drain
 
 
+def _psum(x, axis: Optional[str]):
+    return x if axis is None else jax.lax.psum(x, axis)
+
+
+def _axis_index(axis: Optional[str]) -> jax.Array:
+    return jnp.int32(0) if axis is None else jax.lax.axis_index(axis)
+
+
 def steady_state_step(state: PipelineState, i: jax.Array, *,
-                      block_size: int, masks: np.ndarray,
-                      threshold: int) -> PipelineState:
+                      block_size: int, masks: np.ndarray, threshold: int,
+                      group_axis: Optional[str] = None,
+                      slot_axis: Optional[str] = None,
+                      group_shards: int = 1,
+                      slot_shards: int = 1) -> PipelineState:
     """One event-loop drain: new proposals + straggler completion.
 
     Each block gets exactly two passes (drain t: most votes; drain t+1:
     the stragglers), so the window holds ~2 blocks of in-flight
-    vote-collection at the frontier plus the 1M-slot chosen/executing
-    tail behind it.
+    vote-collection at the frontier plus the chosen/executing tail
+    behind it.
+
+    ``block_size`` and ``masks`` are GLOBAL (whole-mesh) quantities; when
+    called inside ``shard_map``, ``state`` holds this shard's local view
+    and ``group_axis``/``slot_axis`` name the mesh axes (with their
+    static sizes in ``group_shards``/``slot_shards``).
     """
-    n, window = state.votes.shape
-    b = block_size
-    masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [1, N]
-    num_blocks = window // b
-    start_new = (i % num_blocks) * b
-    start_old = ((i - 1) % num_blocks) * b
+    n_local, w_local = state.votes.shape
+    b_local = block_size // slot_shards
+    masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [1, n_global]
+    assert masks_d.shape[0] == 1, (
+        "steady_state_step evaluates single-group (majority-style) specs; "
+        f"got {masks_d.shape[0]} mask rows")
+    assert masks_d.shape[1] == group_shards * n_local, (
+        f"masks cover {masks_d.shape[1]} acceptors but the mesh holds "
+        f"{group_shards} x {n_local}")
+    num_blocks = w_local // b_local
+    start_new = (i % num_blocks) * b_local
+    start_old = ((i - 1) % num_blocks) * b_local
+
+    slot_idx = _axis_index(slot_axis)
+    group_idx = _axis_index(group_axis)
+    # Logical coordinates: lane within the global block, global acceptor.
+    lanes_new = slot_idx * b_local + jnp.arange(b_local, dtype=jnp.int32)
+    accs = group_idx * n_local + jnp.arange(n_local, dtype=jnp.int32)
+    masks_local = jax.lax.dynamic_slice(
+        masks_d, (0, group_idx * n_local), (masks_d.shape[0], n_local))
 
     # --- Leader: assign slots, propose command ids --------------------------
-    proposed = (start_new + jnp.arange(b, dtype=jnp.int32)) * 7 + i
+    proposed = lanes_new * 7 + i * 13 + 1
     commands = jax.lax.dynamic_update_slice(state.commands, proposed,
                                             (start_new,))
 
     def quorum_pass(votes, chosen, committed, start, arrivals):
-        block = jax.lax.dynamic_slice(votes, (0, start), (n, b)) | arrivals
+        block = jax.lax.dynamic_slice(votes, (0, start),
+                                      (n_local, b_local)) | arrivals
         votes = jax.lax.dynamic_update_slice(votes, block, (0, start))
-        counts = (masks_d @ block.astype(jnp.int32))[0]     # [B]
+        counts = _psum((masks_local @ block.astype(jnp.int32))[0],
+                       group_axis)                          # [b_local]
         hit = counts >= threshold
-        old = jax.lax.dynamic_slice(chosen, (start,), (b,))
+        old = jax.lax.dynamic_slice(chosen, (start,), (b_local,))
         newly = hit & ~old
         chosen = jax.lax.dynamic_update_slice(chosen, hit | old, (start,))
-        return votes, chosen, committed + newly.sum(dtype=jnp.int32), newly
+        # Post-group-psum ``newly`` is replicated over group; summing the
+        # slot shards yields the global count, replicated everywhere.
+        committed = committed + _psum(newly.sum(dtype=jnp.int32), slot_axis)
+        return votes, chosen, committed
 
     # --- Acceptors + ProxyLeader: pass 1 on the new block -------------------
-    arr1 = _arrivals(i, start_new, n, b, salt=0)
-    votes, chosen, committed, newly1 = quorum_pass(
+    arr1 = _arrivals(i, lanes_new, accs, salt=0)
+    votes, chosen, committed = quorum_pass(
         state.votes, state.chosen, state.committed, start_new, arr1)
     # --- pass 2: stragglers complete the previous block ---------------------
-    arr2 = 1 - _arrivals(i - 1, start_old, n, b, salt=0)
-    votes, chosen, committed, newly2 = quorum_pass(
+    arr2 = 1 - _arrivals(i - 1, lanes_new, accs, salt=0)
+    votes, chosen, committed = quorum_pass(
         votes, chosen, committed, start_old, arr2)
 
     # --- Replica: execute the now fully-chosen previous block ---------------
-    cmds_old = jax.lax.dynamic_slice(commands, (start_old,), (b,))
+    cmds_old = jax.lax.dynamic_slice(commands, (start_old,), (b_local,))
     block_results = cmds_old * 3 + 7
     results = jax.lax.dynamic_update_slice(state.results, block_results,
                                            (start_old,))
-    sm_state = state.sm_state + cmds_old.sum(dtype=jnp.int32)
-    exec_wm = jnp.where(i >= 1, (i.astype(jnp.int32)) * b, 0)
+    sm_state = state.sm_state + _psum(cmds_old.sum(dtype=jnp.int32),
+                                      slot_axis)
+    exec_wm = jnp.where(i >= 1, i.astype(jnp.int32) * block_size, 0)
 
     # --- GC: release the block executed long ago so the ring can wrap -------
     # (Early iterations "GC" still-zero wrap-around blocks: harmless.)
-    start_gc = ((i - 2) % num_blocks) * b
+    start_gc = ((i - 2) % num_blocks) * b_local
     votes = jax.lax.dynamic_update_slice(
-        votes, jnp.zeros((n, b), jnp.uint8), (0, start_gc))
+        votes, jnp.zeros((n_local, b_local), jnp.uint8), (0, start_gc))
     chosen = jax.lax.dynamic_update_slice(
-        chosen, jnp.zeros((b,), jnp.bool_), (start_gc,))
+        chosen, jnp.zeros((b_local,), jnp.bool_), (start_gc,))
 
     return PipelineState(votes, chosen, commands, results, sm_state,
                          committed, exec_wm)
@@ -142,3 +186,62 @@ def run_steps(state: PipelineState, iters: int, block_size: int,
                                  threshold=threshold)
 
     return jax.lax.fori_loop(0, iters, body, state)
+
+
+# --------------------------------------------------------------------------
+# Multi-chip: the same step under shard_map over a (group, slot) mesh.
+# --------------------------------------------------------------------------
+
+PIPELINE_PARTITION = PipelineState(
+    votes=("group", "slot"),
+    chosen=("slot",),
+    commands=("slot",),
+    results=("slot",),
+    sm_state=(),
+    committed=(),
+    exec_wm=(),
+)
+
+
+def _shard_map_fn():
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:  # older jax
+        from jax.experimental.shard_map import shard_map as fn
+    return fn
+
+
+def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
+                      threshold: int):
+    """Jit ``steady_state_step`` under shard_map over ``mesh``.
+
+    ``mesh`` must have axes ``("group", "slot")``. Returns
+    ``(step, state_sharding)``: ``step(state, i)`` runs one drain with
+    quorum counts psum'd over the group axis and counters psum'd over the
+    slot axis; ``state_sharding`` is the matching ``NamedSharding`` tree
+    for ``jax.device_put``.
+    """
+    import inspect
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    group_shards = mesh.shape["group"]
+    slot_shards = mesh.shape["slot"]
+    step = functools.partial(
+        steady_state_step, block_size=block_size, masks=masks,
+        threshold=threshold, group_axis="group", slot_axis="slot",
+        group_shards=group_shards, slot_shards=slot_shards)
+
+    spec_tree = PipelineState(
+        *(P(*axes) for axes in PIPELINE_PARTITION))
+    shard_map = _shard_map_fn()
+    kwargs = {}
+    params = inspect.signature(shard_map).parameters
+    if "check_vma" in params:
+        kwargs["check_vma"] = False
+    elif "check_rep" in params:
+        kwargs["check_rep"] = False
+    sharded = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(spec_tree, P()), out_specs=spec_tree,
+        **kwargs), donate_argnums=(0,))
+    sharding = jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree)
+    return sharded, sharding

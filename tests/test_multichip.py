@@ -1,0 +1,140 @@
+"""Multi-device sharding tests on the forced 8-device CPU mesh.
+
+The SAME library function (``bench.pipeline.steady_state_step``) runs
+unsharded and under ``shard_map`` over several ``(group, slot)`` mesh
+shapes; results must agree exactly. Vote arrivals and proposed commands
+are functions of logical (block-lane, global-acceptor) coordinates, so
+the only difference between shardings is the physical column layout --
+undone here with an explicit permutation.
+
+This is the validation path for the driver's ``dryrun_multichip``
+(see ``__graft_entry__.py``), per SURVEY.md section 2.3's scaling axes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from frankenpaxos_tpu.bench.pipeline import (
+    make_sharded_step,
+    make_state,
+    steady_state_step,
+)
+from frankenpaxos_tpu.quorums import SimpleMajority
+
+
+def _spec(n_acc):
+    spec = SimpleMajority(range(n_acc)).write_spec()
+    return np.asarray(spec.masks, np.int32), int(spec.thresholds[0])
+
+
+def _perm(slot_shards: int, w_local: int, b_local: int,
+          block: int) -> np.ndarray:
+    """Logical column id for each physical column of the gathered window.
+
+    Physical layout concatenates shard windows; within shard ``s``, local
+    column ``j`` holds block ``j // b_local`` at block-lane
+    ``s * b_local + (j % b_local)``. Unsharded layout is block-major.
+    """
+    cols = np.arange(slot_shards * w_local)
+    s, j = cols // w_local, cols % w_local
+    bi, lane = j // b_local, s * b_local + (j % b_local)
+    return bi * block + lane
+
+
+def _run_unsharded(n_acc, window, block, iters):
+    masks, threshold = _spec(n_acc)
+    step = jax.jit(lambda s, i: steady_state_step(
+        s, i, block_size=block, masks=masks, threshold=threshold))
+    state = make_state(window, n_acc)
+    for t in range(iters):
+        state = step(state, jnp.int32(t))
+    return jax.device_get(state)
+
+
+def _run_sharded(group_dim, slot_dim, n_acc, window, block, iters):
+    devices = np.asarray(jax.devices()[:group_dim * slot_dim])
+    mesh = Mesh(devices.reshape(group_dim, slot_dim), ("group", "slot"))
+    masks, threshold = _spec(n_acc)
+    step, sharding = make_sharded_step(
+        mesh, block_size=block, masks=masks, threshold=threshold)
+    state = jax.device_put(make_state(window, n_acc), sharding)
+    for t in range(iters):
+        state = step(state, jnp.int32(t))
+    return jax.device_get(state)
+
+
+def _assert_equivalent(sharded, unsharded, slot_dim, window, block):
+    w_local, b_local = window // slot_dim, block // slot_dim
+    perm = _perm(slot_dim, w_local, b_local, block)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+
+    assert int(sharded.committed) == int(unsharded.committed)
+    assert int(sharded.sm_state) == int(unsharded.sm_state)
+    assert int(sharded.exec_wm) == int(unsharded.exec_wm)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.chosen)[inv], np.asarray(unsharded.chosen))
+    np.testing.assert_array_equal(
+        np.asarray(sharded.commands)[inv], np.asarray(unsharded.commands))
+    np.testing.assert_array_equal(
+        np.asarray(sharded.results)[inv], np.asarray(unsharded.results))
+    np.testing.assert_array_equal(
+        np.asarray(sharded.votes)[:, inv], np.asarray(unsharded.votes))
+
+
+@pytest.fixture(autouse=True)
+def _need_8_devices():
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device forced-CPU mesh (see conftest.py)")
+
+
+def test_slot_sharded_equivalence():
+    """1x8 mesh: the slot window shards 8 ways; acceptors replicated."""
+    n_acc, window, block, iters = 3, 1 << 10, 1 << 7, 5
+    un = _run_unsharded(n_acc, window, block, iters)
+    sh = _run_sharded(1, 8, n_acc, window, block, iters)
+    assert int(un.committed) > 0
+    _assert_equivalent(sh, un, 8, window, block)
+
+
+def test_grid_mesh_equivalence():
+    """2x4 mesh: acceptor rows AND the slot window both shard; quorum
+    counts cross the group axis via psum."""
+    n_acc, window, block, iters = 6, 1 << 10, 1 << 7, 6
+    un = _run_unsharded(n_acc, window, block, iters)
+    sh = _run_sharded(2, 4, n_acc, window, block, iters)
+    assert int(un.committed) > 0
+    _assert_equivalent(sh, un, 4, window, block)
+
+
+def test_group_sharded_equivalence():
+    """8x1 mesh: every quorum count is a pure cross-device psum over
+    sharded acceptor rows."""
+    n_acc, window, block, iters = 24, 1 << 9, 1 << 6, 4
+    un = _run_unsharded(n_acc, window, block, iters)
+    sh = _run_sharded(8, 1, n_acc, window, block, iters)
+    assert int(un.committed) > 0
+    _assert_equivalent(sh, un, 1, window, block)
+
+
+def test_ring_wraparound_equivalence():
+    """More drains than ring blocks: GC wrap + re-proposal must agree
+    across shardings."""
+    n_acc, window, block = 3, 1 << 9, 1 << 7  # 4 blocks in the ring
+    iters = 11
+    un = _run_unsharded(n_acc, window, block, iters)
+    sh = _run_sharded(2, 4, n_acc + 3, window, block, iters)
+    # Different acceptor count changes quorums; rerun matched config.
+    un6 = _run_unsharded(n_acc + 3, window, block, iters)
+    _assert_equivalent(sh, un6, 4, window, block)
+    assert int(un.committed) > 0 and int(un6.committed) > 0
+
+
+def test_dryrun_multichip_entry():
+    """The driver's dryrun path itself runs clean on 8 devices."""
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
