@@ -104,7 +104,12 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     static sizes in ``group_shards``/``slot_shards``).
     """
     n_local, w_local = state.votes.shape
+    assert block_size % slot_shards == 0, (
+        f"block_size {block_size} must divide over {slot_shards} slot "
+        f"shards")
     b_local = block_size // slot_shards
+    assert w_local % b_local == 0, (
+        f"local window {w_local} must hold whole {b_local}-slot blocks")
     masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [1, n_global]
     assert masks_d.shape[0] == 1, (
         "steady_state_step evaluates single-group (majority-style) specs; "

@@ -230,6 +230,27 @@ class FasterPaxosServer(Actor):
     def is_delegate(self) -> bool:
         return self.index in self.delegates
 
+    def _advance_round(self, new_round: int) -> None:
+        """Adopt ``new_round`` and leave any old-round delegate role.
+
+        A server whose round is advanced by another leader's Phase1a or
+        by a new delegate's Phase2a is, at that point, NOT a delegate of
+        the new round (only Phase2aAny grants that). Keeping the stale
+        ``delegates`` set would let it keep assigning its old owned
+        slots and proposing fresh commands in the new round -- two
+        different commands could then be chosen for one slot (found by
+        randomized simulation under round churn; the reference
+        transitions Delegate -> Idle on these messages,
+        Server.scala:941-999).
+        """
+        if new_round <= self.round:
+            return
+        self.round = new_round
+        self.delegates = ()
+        self.in_phase1 = False
+        self.pending_votes.clear()
+        self.pending_values.clear()
+
     @property
     def is_leader(self) -> bool:
         return self.round_system.leader(self.round) == self.index
@@ -243,6 +264,8 @@ class FasterPaxosServer(Actor):
         self._skip_filled_slots()
 
     def _advance_owned_slot(self) -> None:
+        if not self.is_delegate:  # delegates=() after _advance_round
+            return
         self.next_owned_slot += len(self.delegates)
         self._skip_filled_slots()
 
@@ -304,7 +327,7 @@ class FasterPaxosServer(Actor):
                                      vote_value=value, chosen=True))
         self.pending_votes.pop(slot, None)
         self.pending_values.pop(slot, None)
-        if slot == self.next_owned_slot:
+        if self.is_delegate and slot == self.next_owned_slot:
             self._advance_owned_slot()
         self._execute_log()
 
@@ -394,7 +417,7 @@ class FasterPaxosServer(Actor):
         if phase1a.round < self.round:
             self.send(src, Nack(round=self.round))
             return
-        self.round = phase1a.round
+        self._advance_round(phase1a.round)
         info = tuple(
             Phase1bSlotInfo(slot=slot, vote_round=entry.vote_round,
                             vote_value=entry.vote_value,
@@ -462,7 +485,7 @@ class FasterPaxosServer(Actor):
         if phase2a.round < self.round:
             self.send(src, Nack(round=self.round))
             return
-        self.round = phase2a.round
+        self._advance_round(phase2a.round)
         entry = self.log.get(phase2a.slot)
         phase2b = Phase2b(server_index=self.index, slot=phase2a.slot,
                           round=phase2a.round)
@@ -552,12 +575,17 @@ class FasterPaxosServer(Actor):
         if message.round < self.round:
             self.send(src, Nack(round=self.round))
             return
-        self.round = message.round
-        self.delegates = message.delegates
-        self.pending_votes.clear()
-        self.pending_values.clear()
-        if self.is_delegate:
-            self._set_delegate_slots(message.start_slot)
+        # Clears any stale in_phase1/delegate state on a round advance.
+        self._advance_round(message.round)
+        # Idempotent on duplicates: re-clearing pending votes for the
+        # same delegation would drop in-flight vote counts.
+        if (message.delegates != self.delegates
+                or self.delegate_start != message.start_slot):
+            self.delegates = message.delegates
+            self.pending_votes.clear()
+            self.pending_values.clear()
+            if self.is_delegate:
+                self._set_delegate_slots(message.start_slot)
         self.send(src, Phase2aAnyAck(server_index=self.index,
                                      round=message.round))
 

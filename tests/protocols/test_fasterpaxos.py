@@ -197,3 +197,72 @@ def test_heartbeat_drives_round_change_off_dead_delegate():
     assert pump(transport, lambda: bool(got2), rounds=25)
     for server in servers[1:]:
         assert server.state_machine.get().count(b"post") == 1
+
+
+# ---------------------------------------------------------------------------
+# Randomized simulation: delegate-striped writes under arbitrary
+# reordering/duplication/loss.
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+
+from frankenpaxos_tpu.sim import Simulator  # noqa: E402
+
+from .sim_util import (  # noqa: E402
+    ChaosCmd,
+    PrefixAgreementSim,
+    per_slot_agreement,
+)
+
+
+class FasterPaxosSimulated(PrefixAgreementSim):
+    def make_system(self, seed):
+        transport, config, servers, clients = make_fasterpaxos(
+            num_clients=2, seed=seed)
+        return dict(transport=transport, servers=servers, clients=clients)
+
+    def logs(self, system):
+        return [s.state_machine.get() for s in system["servers"]]
+
+    def state_invariant(self, system):
+        # Per-slot chosen-value agreement across all server logs.
+        error = per_slot_agreement(
+            (i, ((slot, entry.vote_value)
+                 for slot, entry in server.log.items() if entry.chosen))
+            for i, server in enumerate(system["servers"]))
+        return error or super().state_invariant(system)
+
+    def chaos_choices(self, system, rng: _random.Random):
+        # Round churn: a server becomes leader of a fresh round while
+        # the old leader's delegates may still be voting. This is where
+        # chosen-value conflicts can arise (Server.scala:500-527).
+        if rng.random() > 0.08:
+            return []
+        return [ChaosCmd("round_change", rng.randrange(
+            len(system["servers"])))]
+
+    def run_chaos(self, system, command: ChaosCmd):
+        server = system["servers"][command.payload]
+        top = max(s.round for s in system["servers"])
+        server.start_round_change(
+            server.round_system.next_classic_round(server.index, top))
+
+
+def test_simulation_no_divergence():
+    failure = Simulator(FasterPaxosSimulated(), run_length=250,
+                        num_runs=100).run(seed=0)
+    assert failure is None, str(failure)
+
+
+class FasterPaxosF1OptSimulated(FasterPaxosSimulated):
+    def make_system(self, seed):
+        transport, config, servers, clients = make_fasterpaxos(
+            num_clients=2, seed=seed,
+            options=FasterPaxosOptions(use_f1_optimization=True))
+        return dict(transport=transport, servers=servers, clients=clients)
+
+
+def test_simulation_f1_optimization_no_divergence():
+    failure = Simulator(FasterPaxosF1OptSimulated(), run_length=250,
+                        num_runs=100).run(seed=0)
+    assert failure is None, str(failure)

@@ -74,3 +74,46 @@ def test_reconfiguration_activates_new_chunk():
     assert logs[0] == logs[1]
     assert logs[0][0] == b"before"
     assert logs[0][-1] == b"after3"
+
+
+# ---------------------------------------------------------------------------
+# Randomized simulation: writes interleaved with chunk reconfigurations
+# under arbitrary reordering/duplication/loss (the driver-chaos shape of
+# jvm/.../horizontal/Driver.scala).
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+
+from frankenpaxos_tpu.sim import Simulator  # noqa: E402
+
+from .sim_util import ChaosCmd, PrefixAgreementSim  # noqa: E402
+
+
+class HorizontalSimulated(PrefixAgreementSim):
+    transport_weight = 14
+    NUM_ACCEPTORS = 5
+
+    def make_system(self, seed):
+        transport, config, leaders, acceptors, replicas, clients = \
+            make_horizontal(num_acceptors=self.NUM_ACCEPTORS, seed=seed)
+        return dict(transport=transport, replicas=replicas,
+                    clients=clients)
+
+    def logs(self, system):
+        return [r.state_machine.get() for r in system["replicas"]]
+
+    def chaos_choices(self, system, rng: _random.Random):
+        if rng.random() > 0.1:
+            return []
+        return [ChaosCmd("reconfigure",
+                         tuple(rng.sample(range(self.NUM_ACCEPTORS), 3)))]
+
+    def run_chaos(self, system, command: ChaosCmd):
+        client = system["clients"][0]
+        client.reconfigure(SimpleMajority(command.payload))
+
+
+def test_simulation_chunk_reconfiguration_no_divergence():
+    failure = Simulator(HorizontalSimulated(), run_length=250,
+                        num_runs=100).run(seed=0)
+    assert failure is None, str(failure)

@@ -221,3 +221,110 @@ def test_survives_f_matchmaker_deaths():
     clients[0].write(0, b"post-reconfig", got.append)
     transport.deliver_all()
     assert got == [b"0", b"1"]
+
+
+# ---------------------------------------------------------------------------
+# Randomized simulation: writes interleaved with acceptor reconfigurations,
+# matchmaker epoch changes, and Die-injected matchmaker deaths, under
+# arbitrary message reordering/duplication/loss. Mirrors the reference's
+# chaos experiments (benchmarks/vldb20_matchmaker/{chaos,leader_failure}).
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+
+from frankenpaxos_tpu.sim import Simulator  # noqa: E402
+
+from .sim_util import (  # noqa: E402
+    ChaosCmd,
+    PrefixAgreementSim,
+    per_slot_agreement,
+)
+
+
+class MMPSimulated(PrefixAgreementSim):
+    """Safety invariant: per-slot chosen values agree across all leader
+    and replica logs, executed logs prefix-agree and only grow, across
+    live acceptor reconfigurations, matchmaker epoch changes, leader
+    failovers, and up to f matchmaker deaths."""
+
+    transport_weight = 14
+
+    NUM_ACCEPTORS = 6
+    NUM_MATCHMAKERS = 5
+
+    def make_system(self, seed):
+        (transport, config, leaders, matchmakers, reconfigurer, acceptors,
+         replicas, clients) = make_mmp(
+             num_acceptors=self.NUM_ACCEPTORS,
+             num_matchmakers=self.NUM_MATCHMAKERS, seed=seed)
+        return dict(transport=transport, leaders=leaders,
+                    matchmakers=matchmakers, reconfigurer=reconfigurer,
+                    replicas=replicas, clients=clients, deaths=0)
+
+    def logs(self, system):
+        return [r.state_machine.get() for r in system["replicas"]]
+
+    def state_invariant(self, system):
+        # Every actor that has LEARNED a value for a slot (leader logs
+        # via _learn/Chosen, replica logs via Chosen) must agree on it.
+        actors = list(system["leaders"]) + list(system["replicas"])
+        error = per_slot_agreement(
+            (i, actor.log.items()) for i, actor in enumerate(actors))
+        return error or super().state_invariant(system)
+
+    # Two chaos profiles (mutation-verified): frequent reconfiguration
+    # keeps leaders in matchmaking/phase1, so phase2 quorum bugs only
+    # surface under LOW reconfig + HIGH leader churn; matchmaking/GC/
+    # bootstrap bugs need the opposite. Run both.
+    reconfig_p = 0.05
+    leader_churn_p = 0.10
+
+    def chaos_choices(self, system, rng: _random.Random):
+        out = []
+        if rng.random() < self.reconfig_p:
+            out.append(ChaosCmd(
+                "reconfigure",
+                tuple(rng.sample(range(self.NUM_ACCEPTORS), 3))))
+            out.append(ChaosCmd(
+                "reconfigure_matchmakers",
+                tuple(sorted(rng.sample(range(self.NUM_MATCHMAKERS), 3)))))
+            if system["deaths"] < 1:  # f = 1: at most one matchmaker death
+                out.append(ChaosCmd("die",
+                                    rng.randrange(self.NUM_MATCHMAKERS)))
+        if rng.random() < self.leader_churn_p:
+            out.append(ChaosCmd("leader_change",
+                                rng.randrange(len(system["leaders"]))))
+        return out
+
+    def run_chaos(self, system, command: ChaosCmd):
+        if command.label == "reconfigure":
+            system["reconfigurer"].reconfigure(
+                SimpleMajority(command.payload))
+        elif command.label == "reconfigure_matchmakers":
+            system["reconfigurer"].reconfigure_matchmakers(command.payload)
+        elif command.label == "die":
+            system["deaths"] += 1
+            system["matchmakers"][command.payload].receive("chaos", Die())
+        elif command.label == "leader_change":
+            # Model election-driven failover (Leader.scala:1398-1415):
+            # the named leader starts matchmaking above every known round.
+            leader = system["leaders"][command.payload]
+            top = max(l.round for l in system["leaders"])
+            leader._start_matchmaking(max(top, leader.round))
+
+
+class MMPReconfigHeavySimulated(MMPSimulated):
+    reconfig_p = 0.12
+    leader_churn_p = 0.03
+
+
+def test_simulation_churn_no_divergence():
+    failure = Simulator(MMPSimulated(), run_length=250,
+                        num_runs=300, minimize=False).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_simulation_reconfig_heavy_no_divergence():
+    failure = Simulator(MMPReconfigHeavySimulated(), run_length=250,
+                        num_runs=150, minimize=False).run(seed=0)
+    assert failure is None, str(failure)

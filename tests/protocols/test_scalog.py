@@ -94,3 +94,47 @@ def test_replica_dedups_resends():
     # instead assert replies deduped and logs agree.
     logs = [r.state_machine.get() for r in replicas]
     assert logs[0] == logs[1]
+
+
+# ---------------------------------------------------------------------------
+# Randomized simulation: shard pushes, cut ordering, and replica execution
+# under arbitrary reordering/duplication/loss.
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+
+from frankenpaxos_tpu.sim import Simulator  # noqa: E402
+
+from .sim_util import PrefixAgreementSim, WriteCmd  # noqa: E402
+
+
+class ScalogSimulated(PrefixAgreementSim):
+    transport_weight = 14
+    """Scalog clients have no pseudonym slots: every propose gets a fresh
+    command id, so we cap in-flight proposals per client instead."""
+
+    MAX_INFLIGHT = 2
+
+    def make_system(self, seed):
+        transport, config, servers, aggregator, replicas, clients = \
+            make_scalog(num_shards=2, num_clients=2, seed=seed)
+        return dict(transport=transport, replicas=replicas,
+                    clients=clients)
+
+    def logs(self, system):
+        return [r.state_machine.get() for r in system["replicas"]]
+
+    def idle_writers(self, system):
+        return [(c, 0) for c, client in enumerate(system["clients"])
+                if len(client.pending) < self.MAX_INFLIGHT]
+
+    def run_write(self, system, command: WriteCmd):
+        client = system["clients"][command.client]
+        if len(client.pending) < self.MAX_INFLIGHT:
+            client.propose(command.payload)
+
+
+def test_simulation_no_divergence():
+    failure = Simulator(ScalogSimulated(), run_length=250,
+                        num_runs=100).run(seed=0)
+    assert failure is None, str(failure)
