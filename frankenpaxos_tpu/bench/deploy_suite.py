@@ -1,0 +1,145 @@
+"""Generic deployment smoke: any protocol, every role its own process.
+
+The analog of scripts/benchmark_smoke.sh (which runs
+``benchmarks.<proto>.smoke`` for 18 protocols over SSH-to-localhost,
+benchmark_smoke.sh:5-18): compute a localhost placement from the
+deployment registry, launch every role via the CLI over real TCP, drive
+a few commands from an in-process client, and assert replies arrive.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from frankenpaxos_tpu.bench.harness import (
+    BenchmarkDirectory,
+    LocalHost,
+    free_port,
+)
+from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+
+def role_process_env() -> dict:
+    """Environment for role subprocesses: drop the TPU plugin's
+    sitecustomize from PYTHONPATH (it costs ~2s of import per process
+    and CPU-pinned roles never need the accelerator)."""
+    env = os.environ.copy()
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and os.path.basename(p.rstrip("/")) != ".axon_site"]
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    # Force cpu: the parent may carry JAX_PLATFORMS=axon, which would
+    # make every role process hunt for the (stripped) TPU plugin.
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    return env
+
+
+def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
+                 config_path: str, config, *, state_machine: str,
+                 overrides: "dict[str, str] | None" = None,
+                 ready_timeout_s: float = 120.0) -> list:
+    """Start every role of ``protocol_name`` as a subprocess and wait
+    until each reports it is listening."""
+    protocol = get_protocol(protocol_name)
+    host = LocalHost()
+    # TPU-backed roles need the accelerator plugin; everything else gets
+    # the stripped fast-start environment.
+    needs_tpu = any(v == "tpu" for v in (overrides or {}).values())
+    env = None if needs_tpu else role_process_env()
+    labels = []
+    for role_name, role in protocol.roles.items():
+        for index in range(len(role.addresses(config))):
+            label = f"{role_name}_{index}"
+            labels.append(label)
+            cmd = [sys.executable, "-m", "frankenpaxos_tpu.cli",
+                   "--protocol", protocol_name, "--role", role_name,
+                   "--index", str(index), "--config", config_path,
+                   "--state_machine", state_machine,
+                   "--seed", str(index)]
+            for key, value in (overrides or {}).items():
+                cmd.append(f"--options.{key}={value}")
+            bench.popen(host, label, cmd, env=env)
+
+    deadline = time.time() + ready_timeout_s
+    pending = set(labels)
+    while pending and time.time() < deadline:
+        for label in list(pending):
+            try:
+                with open(bench.abspath(f"{label}.log")) as f_log:
+                    if "listening" in f_log.read():
+                        pending.discard(label)
+            except OSError:
+                pass
+        time.sleep(0.1)
+    if pending:
+        bench.cleanup()
+        raise RuntimeError(
+            f"{protocol_name} roles never became ready: {sorted(pending)}")
+    return labels
+
+
+def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
+                       f: int = 1, num_commands: int = 3,
+                       state_machine: str = "AppendLog",
+                       overrides: "dict[str, str] | None" = None,
+                       command_timeout_s: float = 30.0) -> dict:
+    """Deploy ``protocol_name`` over localhost TCP and commit
+    ``num_commands`` commands through it."""
+    protocol = get_protocol(protocol_name)
+    raw = protocol.cluster(f, lambda: ["127.0.0.1", free_port()])
+    config_path = bench.write_json("config.json", raw)
+    config = protocol.load_config(raw)
+
+    # Leaders' very first Phase1as can race slower-starting acceptor
+    # processes; a fast resend rides that out without a long stall.
+    overrides = {"resend_phase1as_period_s": "0.5", **(overrides or {})}
+
+    t0 = time.time()
+    labels = launch_roles(bench, protocol_name, config_path, config,
+                          state_machine=state_machine,
+                          overrides=overrides)
+    ready_s = time.time() - t0
+
+    # In-process client over real TCP. A short resend period rides out
+    # any leader still finishing Phase1/matchmaking/elections. The
+    # try/finally starts HERE so a failed client-transport bind still
+    # kills the role processes.
+    transport = None
+    try:
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = TcpTransport(("127.0.0.1", free_port()), logger)
+        transport.start()
+        ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                        overrides={"resend_period_s": "0.5",
+                                   "repropose_period_s": "0.5",
+                                   "ping_period_s": "0.5"},
+                        seed=0xC11E47, state_machine=state_machine)
+        client = protocol.make_client(ctx, transport.listen_address)
+        latencies = []
+        for tag in range(num_commands):
+            done = threading.Event()
+            start = time.perf_counter()
+            transport.loop.call_soon_threadsafe(
+                protocol.drive, client, tag, lambda *_: done.set())
+            if not done.wait(timeout=command_timeout_s):
+                raise RuntimeError(
+                    f"{protocol_name}: command {tag} never completed "
+                    f"(roles: {labels})")
+            latencies.append(time.perf_counter() - start)
+    finally:
+        if transport is not None:
+            transport.stop()
+        bench.cleanup()
+
+    return {
+        "protocol": protocol_name,
+        "num_roles": len(labels),
+        "num_commands": num_commands,
+        "ready_s": round(ready_s, 3),
+        "latency_ms": [round(x * 1000, 3) for x in latencies],
+    }

@@ -28,10 +28,12 @@ import numpy as np
 class Proc:
     """A managed subprocess (the PopenProc shape, proc.py:65-110)."""
 
-    def __init__(self, args: Sequence[str], out_path: str):
+    def __init__(self, args: Sequence[str], out_path: str,
+                 env: Optional[dict] = None):
         self._out = open(out_path, "w")
         self._proc = subprocess.Popen(
             list(args), stdout=self._out, stderr=subprocess.STDOUT,
+            env=env,
             cwd=os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))))
 
@@ -57,8 +59,9 @@ class LocalHost:
 
     ip: str = "127.0.0.1"
 
-    def popen(self, args: Sequence[str], out_path: str) -> Proc:
-        return Proc(args, out_path)
+    def popen(self, args: Sequence[str], out_path: str,
+              env: Optional[dict] = None) -> Proc:
+        return Proc(args, out_path, env=env)
 
 
 def free_port() -> int:
@@ -86,8 +89,8 @@ class BenchmarkDirectory:
         return path
 
     def popen(self, host: LocalHost, label: str,
-              args: Sequence[str]) -> Proc:
-        proc = host.popen(args, self.abspath(f"{label}.log"))
+              args: Sequence[str], env: Optional[dict] = None) -> Proc:
+        proc = host.popen(args, self.abspath(f"{label}.log"), env=env)
         self.procs.append(proc)
         return proc
 

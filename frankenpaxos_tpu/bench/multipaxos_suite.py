@@ -9,13 +9,11 @@ drive closed-loop clients, and report the reference-compatible stats.
 from __future__ import annotations
 
 import dataclasses
-import sys
 import threading
 import time
 
 from frankenpaxos_tpu.bench.harness import (
     BenchmarkDirectory,
-    LocalHost,
     free_port,
     latency_throughput_stats,
 )
@@ -58,50 +56,16 @@ def placement(input: MultiPaxosInput) -> dict:
 
 def run_benchmark(bench: BenchmarkDirectory,
                   input: MultiPaxosInput) -> dict:
-    host = LocalHost()
-    config_raw = placement(input)
-    config_path = bench.write_json("config.json", config_raw)
-
-    labels = []
-
-    def launch(role: str, count: int, extra=()):
-        for index in range(count):
-            label = f"{role}_{index}"
-            labels.append(label)
-            bench.popen(host, label, [
-                sys.executable, "-m", "frankenpaxos_tpu.cli",
-                "--protocol", "multipaxos", "--role", role,
-                "--index", str(index), "--config", config_path,
-                "--state_machine", input.state_machine,
-                "--quorum_backend", input.quorum_backend, *extra])
-
-    f = input.f
-    launch("acceptor", (2 * f + 1) * input.num_acceptor_groups)
-    launch("replica", f + 1)
-    launch("proxy_leader", f + 1)
-    launch("leader", f + 1)
-
-    # Wait for every role to report it's listening (process startup --
-    # imports in particular -- dominates; poll rather than guess).
-    deadline = time.time() + 120
-    pending = set(labels)
-    while pending and time.time() < deadline:
-        for label in list(pending):
-            try:
-                with open(bench.abspath(f"{label}.log")) as f_log:
-                    if "listening" in f_log.read():
-                        pending.discard(label)
-            except OSError:
-                pass
-        time.sleep(0.25)
-    if pending:
-        bench.cleanup()
-        raise RuntimeError(f"roles never became ready: {sorted(pending)}")
-
-    from frankenpaxos_tpu.cli import load_multipaxos_config
+    from frankenpaxos_tpu.bench.deploy_suite import launch_roles
+    from frankenpaxos_tpu.deploy import get_protocol
     from frankenpaxos_tpu.protocols.multipaxos import Client, ClientOptions
 
-    config = load_multipaxos_config(config_path)
+    config_raw = placement(input)
+    config_path = bench.write_json("config.json", config_raw)
+    config = get_protocol("multipaxos").load_config(config_raw)
+    launch_roles(bench, "multipaxos", config_path, config,
+                 state_machine=input.state_machine,
+                 overrides={"quorum_backend": input.quorum_backend})
     serializer = PickleSerializer()
 
     # Explicit leader-ready probe: a warmup write with a short resend

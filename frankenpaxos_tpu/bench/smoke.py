@@ -1,8 +1,12 @@
-"""Deployment smoke test: MultiPaxos over real localhost processes.
+"""Deployment smoke over real localhost processes, any protocol.
 
-The analog of benchmarks/multipaxos/smoke.py + scripts/benchmark_smoke.sh.
+The analog of scripts/benchmark_smoke.sh (all 18 reference protocols,
+benchmark_smoke.sh:5-18) + benchmarks/multipaxos/smoke.py.
 
-Usage: python -m frankenpaxos_tpu.bench.smoke [--duration 2.0]
+Usage::
+
+    python -m frankenpaxos_tpu.bench.smoke --protocol all
+    python -m frankenpaxos_tpu.bench.smoke --protocol multipaxos --bench
 """
 
 from __future__ import annotations
@@ -11,29 +15,59 @@ import argparse
 import json
 import tempfile
 
-from frankenpaxos_tpu.bench.harness import SuiteDirectory
-from frankenpaxos_tpu.bench.multipaxos_suite import (
-    MultiPaxosInput,
-    run_benchmark,
-)
+from frankenpaxos_tpu.bench.deploy_suite import run_protocol_smoke
+from frankenpaxos_tpu.bench.harness import BenchmarkDirectory, SuiteDirectory
+from frankenpaxos_tpu.deploy import PROTOCOL_NAMES
 
 
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser()
+    parser.add_argument("--protocol", default="all",
+                        choices=["all", *PROTOCOL_NAMES])
+    parser.add_argument("--bench", action="store_true",
+                        help="run the measured multipaxos benchmark "
+                             "instead of the one-command smoke")
     parser.add_argument("--duration", type=float, default=2.0)
     parser.add_argument("--num_clients", type=int, default=2)
     parser.add_argument("--suite_dir", default=None)
     args = parser.parse_args(argv)
 
     root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_smoke_")
-    suite = SuiteDirectory(root, "multipaxos_smoke")
-    stats = run_benchmark(
-        suite.benchmark_directory(),
-        MultiPaxosInput(duration_s=args.duration,
-                        num_clients=args.num_clients))
-    print(json.dumps(stats, indent=2))
-    assert stats["num_requests"] > 0, "smoke benchmark made no progress"
-    return stats
+
+    if args.bench:
+        if args.protocol not in ("all", "multipaxos"):
+            raise SystemExit(
+                "--bench currently supports only --protocol multipaxos")
+        from frankenpaxos_tpu.bench.multipaxos_suite import (
+            MultiPaxosInput,
+            run_benchmark,
+        )
+
+        suite = SuiteDirectory(root, "multipaxos_bench")
+        stats = run_benchmark(
+            suite.benchmark_directory(),
+            MultiPaxosInput(duration_s=args.duration,
+                            num_clients=args.num_clients))
+        print(json.dumps(stats, indent=2))
+        assert stats["num_requests"] > 0, "benchmark made no progress"
+        return stats
+
+    names = PROTOCOL_NAMES if args.protocol == "all" else [args.protocol]
+    results, failures = {}, []
+    for name in names:
+        bench = BenchmarkDirectory(f"{root}/{name}")
+        try:
+            results[name] = run_protocol_smoke(bench, name)
+            print(f"{name}: ok "
+                  f"(ready {results[name]['ready_s']}s, "
+                  f"latency {results[name]['latency_ms']} ms)")
+        except Exception as e:  # noqa: BLE001 - report, then fail at end
+            failures.append(name)
+            print(f"{name}: FAILED: {e}")
+    print(json.dumps(results, indent=2))
+    if failures:
+        raise SystemExit(f"smoke failed for: {failures}")
+    return results
 
 
 if __name__ == "__main__":

@@ -1,17 +1,23 @@
-"""Deployment CLI: one process per role over TcpTransport.
+"""Deployment CLI: one process per role over TcpTransport, any protocol.
 
 The analog of the reference's 105 ``<Role>Main`` objects
 (jvm/src/main/scala/frankenpaxos/<proto>/<Role>Main.scala): parse flags
 (``--protocol``, ``--role``, ``--index``, ``--config``, ``--log_level``,
 ``--prometheus_port``, ``--state_machine``; LeaderMain.scala:19-103),
 read a cluster config file (the prototext analog is JSON here;
-ConfigUtil.scala:7-43), construct the role actor over TcpTransport, and
-optionally expose Prometheus metrics (PrometheusUtil.scala:6-15).
+ConfigUtil.scala:7-43), construct the role actor over TcpTransport via
+the deployment registry (frankenpaxos_tpu/deploy.py), and optionally
+expose Prometheus metrics (PrometheusUtil.scala:6-15).
+
+Per-role tunables use ``--options.<name> <value>`` (or ``=``-joined),
+matching the reference's scopt ``--options.*`` flags
+(LeaderMain.scala:52-80); they apply to both constructor keyword
+parameters and options-dataclass fields, coerced by declared type.
 
 Usage::
 
     python -m frankenpaxos_tpu.cli --protocol multipaxos --role acceptor \
-        --index 2 --config cluster.json
+        --index 2 --config cluster.json --options.flush_every_n 10
 """
 
 from __future__ import annotations
@@ -20,152 +26,107 @@ import argparse
 import json
 import time
 
+from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, DeployCtx, get_protocol
 from frankenpaxos_tpu.runtime import LogLevel, PrintLogger
 from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
-from frankenpaxos_tpu.statemachine import state_machine_by_name
+
+_TPU_BACKEND_KEYS = ("quorum_backend", "dep_backend", "phase1_backend")
 
 
-def _addr(x) -> tuple:
-    return (x[0], int(x[1]))
-
-
-def load_multipaxos_config(path: str):
-    from frankenpaxos_tpu.protocols.multipaxos import (
-        DistributionScheme,
-        MultiPaxosConfig,
-    )
-
-    with open(path) as f:
-        raw = json.load(f)
-    config = MultiPaxosConfig(
-        f=raw["f"],
-        batcher_addresses=[_addr(a) for a in raw.get("batchers", [])],
-        read_batcher_addresses=[_addr(a)
-                                for a in raw.get("read_batchers", [])],
-        leader_addresses=[_addr(a) for a in raw["leaders"]],
-        leader_election_addresses=[_addr(a)
-                                   for a in raw["leader_elections"]],
-        proxy_leader_addresses=[_addr(a) for a in raw["proxy_leaders"]],
-        acceptor_addresses=[[_addr(a) for a in group]
-                            for group in raw["acceptors"]],
-        replica_addresses=[_addr(a) for a in raw["replicas"]],
-        proxy_replica_addresses=[_addr(a)
-                                 for a in raw.get("proxy_replicas", [])],
-        flexible=raw.get("flexible", False),
-        distribution_scheme=DistributionScheme(
-            raw.get("distribution_scheme", "hash")),
-    )
-    config.check_valid()
-    return config
-
-
-def make_multipaxos_role(role: str, index: int, config, transport, logger,
-                         args):
-    from frankenpaxos_tpu.protocols import multipaxos as mp
-
-    if role == "batcher":
-        return mp.Batcher(config.batcher_addresses[index], transport,
-                          logger, config,
-                          mp.BatcherOptions(batch_size=args.batch_size))
-    if role == "read_batcher":
-        return mp.ReadBatcher(config.read_batcher_addresses[index],
-                              transport, logger, config,
-                              mp.ReadBatchingScheme(
-                                  kind=args.read_batching_scheme,
-                                  batch_size=args.batch_size),
-                              seed=args.seed)
-    if role == "leader":
-        return mp.Leader(config.leader_addresses[index], transport, logger,
-                         config, mp.LeaderOptions(), seed=args.seed)
-    if role == "proxy_leader":
-        return mp.ProxyLeader(
-            config.proxy_leader_addresses[index], transport, logger, config,
-            mp.ProxyLeaderOptions(quorum_backend=args.quorum_backend),
-            seed=args.seed)
-    if role == "acceptor":
-        flat = [a for group in config.acceptor_addresses for a in group]
-        return mp.Acceptor(flat[index], transport, logger, config)
-    if role == "replica":
-        return mp.Replica(config.replica_addresses[index], transport,
-                          logger, state_machine_by_name(args.state_machine),
-                          config, mp.ReplicaOptions(), seed=args.seed)
-    if role == "proxy_replica":
-        return mp.ProxyReplica(config.proxy_replica_addresses[index],
-                               transport, logger, config)
-    raise ValueError(f"unknown multipaxos role {role!r}")
-
-
-def role_address(protocol: str, role: str, index: int, config):
-    if protocol == "multipaxos":
-        table = {
-            "batcher": config.batcher_addresses,
-            "read_batcher": config.read_batcher_addresses,
-            "leader": config.leader_addresses,
-            "proxy_leader": config.proxy_leader_addresses,
-            "acceptor": [a for group in config.acceptor_addresses
-                         for a in group],
-            "replica": config.replica_addresses,
-            "proxy_replica": config.proxy_replica_addresses,
-        }
-        return table[role][index]
-    if protocol in ("unreplicated", "echo"):
-        return _addr(config["server"])
-    raise ValueError(f"unknown protocol {protocol!r}")
+def parse_option_overrides(extra: list) -> dict:
+    """``--options.name value`` / ``--options.name=value`` pairs."""
+    overrides: dict = {}
+    i = 0
+    while i < len(extra):
+        arg = extra[i]
+        if not arg.startswith("--options."):
+            raise SystemExit(f"unrecognized argument: {arg}")
+        key = arg[len("--options."):]
+        if "=" in key:
+            key, _, value = key.partition("=")
+        else:
+            i += 1
+            if i >= len(extra) or extra[i].startswith("--options."):
+                raise SystemExit(f"missing value for {arg}")
+            value = extra[i]
+        overrides[key] = value
+        i += 1
+    return overrides
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="frankenpaxos_tpu")
     parser.add_argument("--protocol", required=True,
-                        choices=["multipaxos", "unreplicated", "echo"])
+                        choices=PROTOCOL_NAMES)
     parser.add_argument("--role", required=True)
     parser.add_argument("--index", type=int, default=0)
     parser.add_argument("--config", required=True,
                         help="cluster config JSON")
     parser.add_argument("--log_level", default="info",
                         choices=["debug", "info", "warn", "error", "fatal"])
-    parser.add_argument("--state_machine", default="KeyValueStore")
-    parser.add_argument("--batch_size", type=int, default=1)
-    parser.add_argument("--read_batching_scheme", default="size")
-    parser.add_argument("--quorum_backend", default="dict",
-                        choices=["dict", "tpu"])
+    parser.add_argument("--state_machine", default="AppendLog")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--prometheus_port", type=int, default=0,
                         help="0 disables the metrics endpoint")
-    args = parser.parse_args(argv)
+    # Back-compat shorthands (now spelled --options.*):
+    parser.add_argument("--quorum_backend", default=None,
+                        choices=[None, "dict", "tpu"])
+    parser.add_argument("--batch_size", type=int, default=None)
+    args, extra = parser.parse_known_args(argv)
 
-    if args.quorum_backend != "tpu":
-        # Only the TPU quorum path needs an accelerator; everything else
-        # pins to CPU so role processes never contend for the chip.
-        import jax
+    overrides = parse_option_overrides(extra)
+    if args.quorum_backend is not None:
+        overrides.setdefault("quorum_backend", args.quorum_backend)
+    if args.batch_size is not None:
+        overrides.setdefault("batch_size", str(args.batch_size))
 
-        jax.config.update("jax_platforms", "cpu")
+    if not any(overrides.get(k) == "tpu" for k in _TPU_BACKEND_KEYS):
+        # Only TPU backends need an accelerator; everything else pins to
+        # CPU so role processes never contend for the chip. If the
+        # environment already pins it (the TPU plugin's sitecustomize is
+        # what overrides the env var), skip the jax import entirely --
+        # it costs ~2s of role startup.
+        import os
+        import sys
+
+        site_mod = sys.modules.get("sitecustomize")
+        plugin_loaded = site_mod is not None and ".axon_site" in (
+            getattr(site_mod, "__file__", "") or "")
+        if plugin_loaded or os.environ.get("JAX_PLATFORMS") != "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
 
     logger = PrintLogger(LogLevel[args.log_level.upper()])
+    protocol = get_protocol(args.protocol)
 
-    if args.protocol == "multipaxos":
-        config = load_multipaxos_config(args.config)
-    else:
-        with open(args.config) as f:
-            config = json.load(f)
+    with open(args.config) as f:
+        config = protocol.load_config(json.load(f))
 
-    address = role_address(args.protocol, args.role, args.index, config)
+    try:
+        role = protocol.roles[args.role]
+    except KeyError:
+        raise SystemExit(
+            f"unknown role {args.role!r} for {args.protocol}; "
+            f"known: {sorted(protocol.roles)}")
+    addresses = role.addresses(config)
+    if not 0 <= args.index < len(addresses):
+        raise SystemExit(
+            f"--index {args.index} out of range for {args.protocol} "
+            f"{args.role}: valid range 0..{len(addresses) - 1}")
+    address = addresses[args.index]
+
     transport = TcpTransport(address, logger)
     transport.start()
-
-    if args.protocol == "multipaxos":
-        actor = make_multipaxos_role(args.role, args.index, config,
-                                     transport, logger, args)
-    elif args.protocol == "unreplicated":
-        from frankenpaxos_tpu.protocols.unreplicated import (
-            UnreplicatedServer,
-        )
-
-        actor = UnreplicatedServer(address, transport, logger,
-                                   state_machine_by_name(args.state_machine))
-    else:
-        from frankenpaxos_tpu.protocols.echo import EchoServer
-
-        actor = EchoServer(address, transport, logger)
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides=overrides, seed=args.seed,
+                    state_machine=args.state_machine)
+    role.make(ctx, address, args.index)
+    unmatched = ctx.unmatched_overrides()
+    if unmatched:
+        # Overrides are shared across a deployment's roles, so an option
+        # aimed at another role lands here too -- note, don't fail.
+        logger.info(f"options not used by this role: {unmatched}")
 
     if args.prometheus_port > 0:
         import prometheus_client

@@ -422,9 +422,31 @@ class FastMultiPaxosLeader(Actor):
             self.resend_phase1as_timer.start()
 
         def resend_phase2as():
+            # Fast rounds can wedge without ever looking "stuck" to the
+            # per-slot conflict test: acceptors vote a command at their
+            # own next_slot, so offset acceptors spread one command over
+            # adjacent slots, each collecting an unchoosable-but-
+            # "possible" partial quorum forever. If a full resend period
+            # passes with votes outstanding and nothing chosen, fall
+            # back to coordinated recovery in the next (classic) round
+            # (Leader.scala:365-376 + the fast-stuck path of
+            # processPhase2b, Leader.scala:690-724).
+            progress = (self.chosen_watermark, len(self.log))
+            if (isinstance(self.state, _Phase2State)
+                    and self.state.phase2bs
+                    and progress == self._last_progress
+                    and self.config.round_system.round_type(self.round)
+                    == RoundType.FAST):
+                # Force a CLASSIC round: jumping to another fast round
+                # recreates the same offset-votes wedge.
+                self._bump_round_and_restart(self.round,
+                                             force_classic=True)
+                return
+            self._last_progress = progress
             self._resend_phase2as()
             self.resend_phase2as_timer.start()
 
+        self._last_progress = (-1, -1)
         self.resend_phase1as_timer = self.timer(
             "resendPhase1as", 5.0, resend_phase1as)
         self.resend_phase2as_timer = self.timer(
@@ -494,9 +516,11 @@ class FastMultiPaxosLeader(Actor):
         self._bump_round_and_restart(self.round, thrifty=False)
 
     def _bump_round_and_restart(self, higher_than: int,
-                                thrifty: bool = True) -> None:
+                                thrifty: bool = True,
+                                force_classic: bool = False) -> None:
         rs = self.config.round_system
-        if len(self.heartbeat.unsafe_alive()) >= self.config.fast_quorum_size:
+        if not force_classic and len(
+                self.heartbeat.unsafe_alive()) >= self.config.fast_quorum_size:
             next_fast = rs.next_fast_round(self.leader_id, higher_than)
             self.round = (next_fast if next_fast is not None
                           else rs.next_classic_round(self.leader_id,

@@ -376,6 +376,8 @@ class MMPLeader(Actor):
         # reconfigurer.
         self.next_quorum_system: QuorumSystem = SimpleMajority(
             range(2 * config.f + 1))
+        self.match_resend_period_s = 1.0
+        self._match_resend_timer = None
         if self.index == 0:
             self._start_matchmaking(self.round)
 
@@ -396,14 +398,34 @@ class MMPLeader(Actor):
         epoch (startMatchmaking, Leader.scala:905-935)."""
         self._gc_pending = None  # a new round supersedes any pending GC
         self.round = round
-        request = MatchRequest(
-            matchmaker_configuration=self.matchmaker_configuration,
-            round=round,
-            quorum_system=quorum_system_to_dict(quorum_system))
-        for i in self.matchmaker_configuration.matchmaker_indices:
-            self.send(self.config.matchmaker_addresses[i], request)
         self.state = _Matchmaking(quorum_system,
                                   self.matchmaker_configuration, {}, pending)
+        self._send_match_requests()
+        # Resend while still matchmaking: the initial MatchRequests can
+        # race matchmaker startup or be dropped (resendMatchRequests,
+        # Leader.scala:259-272). One reusable timer (created lazily once)
+        # whose callback reads current state, so churny reconfigurations
+        # don't allocate a timer per round.
+        if self._match_resend_timer is None:
+            def resend():
+                if isinstance(self.state, _Matchmaking):
+                    self._send_match_requests()
+                    self._match_resend_timer.start()
+
+            self._match_resend_timer = self.timer(
+                "resendMatchRequests", self.match_resend_period_s, resend)
+        self._match_resend_timer.stop()
+        self._match_resend_timer.start()
+
+    def _send_match_requests(self) -> None:
+        state = self.state
+        assert isinstance(state, _Matchmaking)
+        request = MatchRequest(
+            matchmaker_configuration=state.matchmaker_configuration,
+            round=self.round,
+            quorum_system=quorum_system_to_dict(state.quorum_system))
+        for i in state.matchmaker_configuration.matchmaker_indices:
+            self.send(self.config.matchmaker_addresses[i], request)
 
     def _acceptor(self, index: int) -> Address:
         return self.config.acceptor_addresses[index]

@@ -22,7 +22,6 @@ import abc
 
 import numpy as np
 
-from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker
 from frankenpaxos_tpu.quorums import QuorumSpec
 from frankenpaxos_tpu.quorums.spec import ANY
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
@@ -89,6 +88,10 @@ class TpuQuorumTracker(QuorumTracker):
                 combine=ANY,
                 universe=universe,
             )
+        # Lazy: keeps jax out of dict-backend role processes entirely
+        # (it costs seconds of startup per process).
+        from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker
+
         self.checker = TpuQuorumChecker(spec, window=window)
         self._slots: list[int] = []
         self._cols: list[int] = []

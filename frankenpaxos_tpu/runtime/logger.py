@@ -98,7 +98,7 @@ class PrintLogger(Logger):
     def emit(self, level: LogLevel, message: str) -> None:
         stream = sys.stderr if level >= LogLevel.WARN else sys.stdout
         print(f"[{level.name:5s}] {time.strftime('%H:%M:%S')} {message}",
-              file=stream)
+              file=stream, flush=True)
 
 
 class FileLogger(Logger):

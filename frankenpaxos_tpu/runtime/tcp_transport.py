@@ -102,7 +102,7 @@ class TcpTransport(Transport):
         self.actors: dict[Address, Actor] = {}
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._conns: dict[tuple[Address, Address], _Conn] = {}
-        self._server: Optional[asyncio.AbstractServer] = None
+        self._servers: dict[Address, asyncio.AbstractServer] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
 
@@ -111,14 +111,25 @@ class TcpTransport(Transport):
         """Bind (if a listen address was given) and run until cancelled."""
         self.loop = asyncio.get_running_loop()
         if self.listen_address is not None:
-            host, port = self.listen_address
-            self._server = await asyncio.start_server(
-                self._handle_conn, host, port)
+            await self._bind(self.listen_address)
+        for address in list(self.actors):
+            if isinstance(address, tuple):  # registered before start()
+                await self._bind(address)
         self._started.set()
         try:
             await asyncio.Event().wait()  # run forever
         finally:
             await self._shutdown()
+
+    async def _bind(self, address: Address) -> None:
+        if address in self._servers:
+            return
+        import functools
+
+        host, port = address
+        self._servers[address] = await asyncio.start_server(
+            functools.partial(self._handle_conn, local=address),
+            host, port)
 
     def start(self) -> None:
         """Spawn the event loop on a daemon thread and wait until bound."""
@@ -141,15 +152,16 @@ class TcpTransport(Transport):
             self._thread.join(timeout=5)
 
     async def _shutdown(self) -> None:
-        if self._server is not None:
-            self._server.close()
+        for server in self._servers.values():
+            server.close()
         for conn in self._conns.values():
             if conn.writer is not None:
                 conn.writer.close()
 
     # --- inbound ----------------------------------------------------------
     async def _handle_conn(self, reader: asyncio.StreamReader,
-                           writer: asyncio.StreamWriter) -> None:
+                           writer: asyncio.StreamWriter,
+                           local: Address) -> None:
         try:
             while True:
                 head = await reader.readexactly(4)
@@ -163,29 +175,52 @@ class TcpTransport(Transport):
                 host, _, port = header.rpartition(":")
                 src: Address = (host, int(port))
                 data = payload[4 + hlen:]
-                self._dispatch(src, data)
+                self._dispatch(local, src, data)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
 
-    def _dispatch(self, src: Address, data: bytes) -> None:
-        # Frames address the listening endpoint; with one actor per
-        # process-port (the deployment model, one role per process), the
-        # single registered actor on this transport receives it.
-        if self.listen_address is not None:
+    def _dispatch(self, local: Address, src: Address, data: bytes) -> None:
+        # Route by the address the frame arrived on: each registered
+        # actor (the role itself plus any embedded election/heartbeat
+        # participants) listens on its own port.
+        actor = self.actors.get(local)
+        if actor is None and self.listen_address is not None:
             actor = self.actors.get(self.listen_address)
-            if actor is not None:
-                actor.receive(src, actor.serializer.from_bytes(data))
-                actor.on_drain()
-                return
-        self.logger.warn(f"dropping frame from {src}: no registered actor")
+        if actor is not None:
+            actor.receive(src, actor.serializer.from_bytes(data))
+            actor.on_drain()
+            return
+        self.logger.warn(f"dropping frame from {src} to {local}: "
+                         f"no registered actor")
 
     # --- Transport API ----------------------------------------------------
     def register(self, address: Address, actor: Actor) -> None:
+        """Register ``actor`` and listen on its address.
+
+        A role process hosts one main role actor plus embedded
+        sub-actors (leader election, heartbeat participants), each with
+        its own (host, port) from the cluster config: every registered
+        address gets its own listener so remote peers can reach the
+        sub-actors too (the reference runs them as Netty-registered
+        actors on the shared event loop the same way).
+        """
         if address in self.actors:
             raise ValueError(f"an actor is already registered at {address}")
         self.actors[address] = actor
+        if self.loop is not None and address not in self._servers \
+                and isinstance(address, tuple):
+            if threading.get_ident() == getattr(self.loop, "_thread_id",
+                                                None):
+                task = self.loop.create_task(self._bind(address))
+                task.add_done_callback(
+                    lambda t: t.exception() and self.logger.error(
+                        f"bind {address} failed: {t.exception()!r}"))
+            else:
+                future = asyncio.run_coroutine_threadsafe(
+                    self._bind(address), self.loop)
+                future.result(timeout=10)
 
     def _conn_for(self, src: Address, dst: Address) -> _Conn:
         key = (src, dst)

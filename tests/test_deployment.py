@@ -1,17 +1,38 @@
-"""End-to-end deployment: every MultiPaxos role as its own OS process
-over real TCP, driven by the benchmark harness (the analog of
-scripts/benchmark_smoke.sh)."""
+"""End-to-end deployment: every protocol's roles as OS processes over
+real TCP (the analog of scripts/benchmark_smoke.sh, which smoke-runs all
+18 reference protocols over SSH-to-localhost)."""
 
 import tempfile
 
-from frankenpaxos_tpu.bench.harness import SuiteDirectory
+import pytest
+
+from frankenpaxos_tpu.bench.deploy_suite import run_protocol_smoke
+from frankenpaxos_tpu.bench.harness import BenchmarkDirectory, SuiteDirectory
 from frankenpaxos_tpu.bench.multipaxos_suite import (
     MultiPaxosInput,
     run_benchmark,
 )
+from frankenpaxos_tpu.deploy import PROTOCOL_NAMES
+
+# Per-protocol launch overrides keeping the smoke snappy.
+_OVERRIDES = {
+    "batchedunreplicated": {"batch_size": "1"},
+}
 
 
-def test_multipaxos_deployment_smoke():
+@pytest.mark.parametrize("protocol", PROTOCOL_NAMES)
+def test_protocol_deployment_smoke(protocol, tmp_path):
+    stats = run_protocol_smoke(
+        BenchmarkDirectory(str(tmp_path / protocol)), protocol,
+        overrides=_OVERRIDES.get(protocol))
+    # run_protocol_smoke raises if any command fails to complete; the
+    # latency list is the per-command evidence they all did.
+    assert len(stats["latency_ms"]) == 3
+    assert all(lat > 0 for lat in stats["latency_ms"])
+
+
+def test_multipaxos_deployment_benchmark():
+    """The full measured benchmark path (latency/throughput stats)."""
     suite = SuiteDirectory(tempfile.mkdtemp(prefix="fpx_test_"),
                            "multipaxos_smoke")
     stats = run_benchmark(
