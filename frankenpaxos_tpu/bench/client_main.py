@@ -1,0 +1,119 @@
+"""Benchmark client process: closed-loop workload driver.
+
+The analog of the reference's ClientMain + BenchmarkUtil
+(jvm/.../multipaxos/ClientMain.scala, BenchmarkUtil.scala:9-160): run
+``--num_clients`` closed loops (one per pseudonym) against a deployed
+cluster for ``--duration`` seconds, drawing ops from a ReadWriteWorkload,
+and write one CSV row per completed op:
+``kind,start_unix_s,latency_s`` (benchmark.py:310-335's recorder shape).
+
+Ops are chained on the transport's event loop -- each completion issues
+the pseudonym's next op -- so one process drives many concurrent closed
+loops without a thread per client.
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.client_main --config cluster.json \
+        --workload '{"name": "uniform_read_write", "read_fraction": 0.9}' \
+        --duration 5 --num_clients 20 --out client_data.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+
+from frankenpaxos_tpu.bench.harness import free_port
+from frankenpaxos_tpu.bench.workload import (
+    READ_METHODS,
+    WRITE,
+    WriteOnlyWorkload,
+    StringWorkload,
+    workload_from_dict,
+)
+from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+
+def run(protocol_name: str, config_raw: dict, workload, *,
+        num_clients: int, duration_s: float, read_consistency: str,
+        seed: int = 0, warmup_s: float = 0.25) -> list:
+    """Drive the workload; returns [(kind, start_unix_s, latency_s)]."""
+    protocol = get_protocol(protocol_name)
+    config = protocol.load_config(config_raw)
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = TcpTransport(("127.0.0.1", free_port()), logger)
+    transport.start()
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides={}, seed=seed)
+    client = protocol.make_client(ctx, transport.listen_address)
+    read_method = READ_METHODS[read_consistency]
+
+    rows: list = []
+    done = threading.Event()
+    stop_at = time.time() + warmup_s + duration_s
+    measure_from = time.time() + warmup_s
+    live = {"count": num_clients}
+
+    def issue(pseudonym: int, rng: random.Random) -> None:
+        now = time.time()
+        if now >= stop_at:
+            live["count"] -= 1
+            if live["count"] == 0:
+                done.set()
+            return
+        kind, command = workload.get(rng)
+        op = (client.write if kind == WRITE
+              else getattr(client, read_method))
+        t0 = time.perf_counter()
+
+        def finished(_reply) -> None:
+            if now >= measure_from:
+                rows.append((kind, now, time.perf_counter() - t0))
+            issue(pseudonym, rng)
+
+        op(pseudonym, command, finished)
+
+    for pseudonym in range(num_clients):
+        rng = random.Random((seed << 20) + pseudonym)
+        transport.loop.call_soon_threadsafe(issue, pseudonym, rng)
+    done.wait(timeout=warmup_s + duration_s + 30)
+    transport.stop()
+    return rows
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--protocol", default="multipaxos")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--workload", default=None,
+                        help="JSON workload spec (bench/workload.py)")
+    parser.add_argument("--num_clients", type=int, default=1)
+    parser.add_argument("--duration", type=float, required=True)
+    parser.add_argument("--read_consistency", default="linearizable")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        config_raw = json.load(f)
+    workload = (workload_from_dict(json.loads(args.workload))
+                if args.workload
+                else WriteOnlyWorkload(StringWorkload(size_mean=8)))
+
+    rows = run(args.protocol, config_raw, workload,
+               num_clients=args.num_clients, duration_s=args.duration,
+               read_consistency=args.read_consistency, seed=args.seed)
+    with open(args.out, "w") as f:
+        f.write("kind,start_unix_s,latency_s\n")
+        for kind, start, latency in rows:
+            f.write(f"{kind},{start!r},{latency!r}\n")
+    print(f"wrote {len(rows)} ops to {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

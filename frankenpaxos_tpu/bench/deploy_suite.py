@@ -42,9 +42,16 @@ def role_process_env() -> dict:
 def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  config_path: str, config, *, state_machine: str,
                  overrides: "dict[str, str] | None" = None,
+                 prometheus: bool = False,
                  ready_timeout_s: float = 120.0) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
-    until each reports it is listening."""
+    until each reports it is listening.
+
+    With ``prometheus=True`` each role gets a ``/metrics`` endpoint on a
+    fresh port; the ``{label: port}`` map lands in
+    ``bench.prometheus_ports`` and a generated scrape config in
+    ``prometheus.json`` (benchmarks/prometheus.py:10-60 semantics).
+    """
     protocol = get_protocol(protocol_name)
     host = LocalHost()
     # TPU-backed roles need the accelerator plugin; everything else gets
@@ -52,6 +59,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     needs_tpu = any(v == "tpu" for v in (overrides or {}).values())
     env = None if needs_tpu else role_process_env()
     labels = []
+    prometheus_ports: dict[str, int] = {}
     for role_name, role in protocol.roles.items():
         for index in range(len(role.addresses(config))):
             label = f"{role_name}_{index}"
@@ -61,9 +69,19 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                    "--index", str(index), "--config", config_path,
                    "--state_machine", state_machine,
                    "--seed", str(index)]
+            if prometheus:
+                prometheus_ports[label] = free_port()
+                cmd += ["--prometheus_port",
+                        str(prometheus_ports[label])]
             for key, value in (overrides or {}).items():
                 cmd.append(f"--options.{key}={value}")
             bench.popen(host, label, cmd, env=env)
+    bench.prometheus_ports = prometheus_ports
+    if prometheus:
+        from frankenpaxos_tpu.bench.metrics import scrape_config
+
+        bench.write_json("prometheus.json",
+                         scrape_config(prometheus_ports))
 
     deadline = time.time() + ready_timeout_s
     pending = set(labels)

@@ -52,6 +52,9 @@ class Proc:
     def running(self) -> bool:
         return self._proc.poll() is None
 
+    def wait(self, timeout: Optional[float] = None) -> int:
+        return self._proc.wait(timeout=timeout)
+
 
 @dataclasses.dataclass(frozen=True)
 class LocalHost:
@@ -78,6 +81,9 @@ class BenchmarkDirectory:
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.procs: list[Proc] = []
+        # label -> /metrics port, filled by deploy_suite.launch_roles
+        # when prometheus=True.
+        self.prometheus_ports: dict[str, int] = {}
 
     def abspath(self, name: str) -> str:
         return os.path.join(self.path, name)

@@ -1,0 +1,59 @@
+"""Prometheus plumbing for the benchmark harness.
+
+The analog of benchmarks/prometheus.py:10-132: every role process
+exposes a prometheus_client ``/metrics`` endpoint
+(``--prometheus_port``); the harness generates a Prometheus scrape
+config for them (for users running a real Prometheus server + the
+Grafana dashboards in ``grafana/``) and, for in-run results, scrapes the
+endpoints directly into ``{metric_name{labels}: value}`` dicts -- the
+query layer this environment supports without a Prometheus binary.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+
+def scrape(port: int, host: str = "127.0.0.1",
+           timeout_s: float = 5.0) -> dict:
+    """Fetch and parse one /metrics endpoint (text exposition format)."""
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=timeout_s) as resp:
+        text = resp.read().decode()
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def scrape_config(targets: "dict[str, int]", host: str = "127.0.0.1",
+                  scrape_interval: str = "1s") -> dict:
+    """A prometheus.yml dict scraping every role endpoint
+    (benchmarks/prometheus.py's generated config shape)."""
+    return {
+        "global": {"scrape_interval": scrape_interval},
+        "scrape_configs": [
+            {
+                "job_name": label,
+                "static_configs": [
+                    {"targets": [f"{host}:{port}"]}],
+            }
+            for label, port in sorted(targets.items())
+        ],
+    }
+
+
+def sum_metric(scrapes: "dict[str, dict]", metric: str) -> float:
+    """Sum a counter across scraped roles (ignoring label variants)."""
+    total = 0.0
+    for values in scrapes.values():
+        for name, value in values.items():
+            if name == metric or name.startswith(metric + "{"):
+                total += value
+    return total

@@ -29,10 +29,24 @@ class MultiPaxosInput:
 
     f: int = 1
     num_acceptor_groups: int = 1
+    num_replicas: int = 0  # 0 -> f + 1
     num_clients: int = 2
     duration_s: float = 2.0
     quorum_backend: str = "dict"
     state_machine: str = "KeyValueStore"
+    # A ReadWriteWorkload (bench/workload.py); None -> the legacy
+    # write-only SetRequest loop.
+    workload: object = None
+    # "linearizable" (quorum reads), "sequential", or "eventual"
+    # (Client.scala:851-933, :697+, :739+).
+    read_consistency: str = "linearizable"
+    # > 0: drive load from this many separate client OS processes
+    # (bench/client_main.py, the reference's ClientMain shape), each
+    # running ``num_clients`` closed loops. 0: in-process threads.
+    client_procs: int = 0
+    # Expose per-role /metrics endpoints and record them in the results
+    # (benchmarks/prometheus.py semantics).
+    prometheus: bool = False
 
 
 def placement(input: MultiPaxosInput) -> dict:
@@ -49,7 +63,7 @@ def placement(input: MultiPaxosInput) -> dict:
         "proxy_leaders": addrs(f + 1),
         "acceptors": [addrs(2 * f + 1)
                       for _ in range(input.num_acceptor_groups)],
-        "replicas": addrs(f + 1),
+        "replicas": addrs(max(input.num_replicas, f + 1)),
         "proxy_replicas": [],
     }
 
@@ -65,7 +79,8 @@ def run_benchmark(bench: BenchmarkDirectory,
     config = get_protocol("multipaxos").load_config(config_raw)
     launch_roles(bench, "multipaxos", config_path, config,
                  state_machine=input.state_machine,
-                 overrides={"quorum_backend": input.quorum_backend})
+                 overrides={"quorum_backend": input.quorum_backend},
+                 prometheus=input.prometheus)
     serializer = PickleSerializer()
 
     # Explicit leader-ready probe: a warmup write with a short resend
@@ -89,11 +104,24 @@ def run_benchmark(bench: BenchmarkDirectory,
         bench.cleanup()
         raise RuntimeError("leader never committed the warmup write")
 
-    # Closed-loop clients (in-process, real TCP).
-    latencies: list[float] = []
-    starts: list[float] = []
+    if input.client_procs > 0:
+        return _run_with_client_procs(bench, input, config_path)
+
+    # Closed-loop clients (in-process, real TCP). Each op comes from the
+    # workload: writes go through the Phase2 write path; reads through
+    # the configured consistency path (linearizable quorum reads /
+    # sequential / eventual, Client.scala:851-933, :697+, :739+).
+    import random as _random
+
+    from frankenpaxos_tpu.bench.workload import WRITE
+
+    samples: dict[str, tuple[list, list]] = {
+        "read": ([], []), "write": ([], [])}
     lock = threading.Lock()
     stop_at = time.time() + input.duration_s
+    from frankenpaxos_tpu.bench.workload import READ_METHODS
+
+    read_method = READ_METHODS[input.read_consistency]
 
     def run_client(i: int) -> None:
         logger = FakeLogger(LogLevel.FATAL)
@@ -101,22 +129,28 @@ def run_benchmark(bench: BenchmarkDirectory,
         transport.start()
         client = Client(transport.listen_address, transport, logger,
                         config, ClientOptions(), seed=i)
+        rng = _random.Random(1000 + i)
         try:
             k = 0
             while time.time() < stop_at:
+                if input.workload is not None:
+                    kind, command = input.workload.get(rng)
+                else:
+                    kind = WRITE
+                    command = serializer.to_bytes(
+                        SetRequest(((f"k{i}", str(k)),)))
+                op = (client.write if kind == WRITE
+                      else getattr(client, read_method))
                 done = threading.Event()
                 t0 = time.perf_counter()
                 wall0 = time.time()
                 transport.loop.call_soon_threadsafe(
-                    client.write, 0,
-                    serializer.to_bytes(
-                        SetRequest(((f"k{i}", str(k)),))),
-                    lambda _: done.set())
+                    op, 0, command, lambda _: done.set())
                 if not done.wait(timeout=10):
                     break
                 with lock:
-                    latencies.append(time.perf_counter() - t0)
-                    starts.append(wall0)
+                    samples[kind][0].append(time.perf_counter() - t0)
+                    samples[kind][1].append(wall0)
                 k += 1
         finally:
             transport.stop()
@@ -130,8 +164,115 @@ def run_benchmark(bench: BenchmarkDirectory,
         t.join()
     elapsed = time.time() - start
 
+    role_metrics = _scrape_role_metrics(bench, input)
     bench.cleanup()
-    stats = latency_throughput_stats(latencies, elapsed, starts_s=starts)
+    return _write_stats(bench, input, samples, elapsed, role_metrics,
+                        input.workload)
+
+
+def _run_with_client_procs(bench: BenchmarkDirectory,
+                           input: MultiPaxosInput,
+                           config_path: str) -> dict:
+    """Drive load from separate client OS processes and aggregate their
+    CSVs (the reference's ClientMain + parse-client-data shape,
+    multipaxos.py:632-785)."""
+    import json
+    import sys
+
+    from frankenpaxos_tpu.bench.deploy_suite import role_process_env
+    from frankenpaxos_tpu.bench.harness import LocalHost
+    from frankenpaxos_tpu.bench.workload import (
+        StringWorkload,
+        UniformReadWriteWorkload,
+        WriteOnlyWorkload,
+        workload_to_dict,
+    )
+
+    # Default workload must emit commands the deployed state machine can
+    # parse: KV stores take pickled Get/SetRequests, the string family
+    # (AppendLog/Noop/Register) takes raw bytes.
+    workload = input.workload or (
+        UniformReadWriteWorkload(num_keys=8, read_fraction=0.0)
+        if input.state_machine == "KeyValueStore"
+        else WriteOnlyWorkload(StringWorkload()))
+    host = LocalHost()
+    env = role_process_env()
+    procs = []
+    for i in range(input.client_procs):
+        out_csv = bench.abspath(f"client_{i}_data.csv")
+        procs.append((out_csv, bench.popen(host, f"client_{i}", [
+            sys.executable, "-m", "frankenpaxos_tpu.bench.client_main",
+            "--config", config_path,
+            "--workload", json.dumps(workload_to_dict(workload)),
+            "--num_clients", str(input.num_clients),
+            "--duration", str(input.duration_s),
+            "--read_consistency", input.read_consistency,
+            "--seed", str(i), "--out", out_csv], env=env)))
+    try:
+        deadline = input.duration_s + 90
+        for _, proc in procs:
+            code = proc.wait(timeout=deadline)
+            if code != 0:
+                raise RuntimeError(
+                    f"client process exited with code {code}; see "
+                    f"{bench.path}")
+
+        samples: dict[str, tuple[list, list]] = {
+            "read": ([], []), "write": ([], [])}
+        for out_csv, _ in procs:
+            with open(out_csv) as f:
+                next(f)  # header
+                for line in f:
+                    kind, start, latency = line.strip().split(",")
+                    samples[kind][0].append(float(latency))
+                    samples[kind][1].append(float(start))
+        role_metrics = _scrape_role_metrics(bench, input)
+    finally:
+        bench.cleanup()
+    return _write_stats(bench, input, samples, input.duration_s,
+                        role_metrics, workload)
+
+
+def _scrape_role_metrics(bench: BenchmarkDirectory,
+                         input: MultiPaxosInput) -> dict:
+    """Scrape every role's /metrics endpoint (framework metrics only);
+    must run before bench.cleanup() kills the roles."""
+    if not input.prometheus:
+        return {}
+    from frankenpaxos_tpu.bench.metrics import scrape
+
+    role_metrics = {}
+    for label, port in bench.prometheus_ports.items():
+        try:
+            role_metrics[label] = {
+                k: v for k, v in scrape(port).items()
+                if k.startswith("multipaxos_")}
+        except OSError:
+            role_metrics[label] = {}
+    return role_metrics
+
+
+def _write_stats(bench: BenchmarkDirectory, input: MultiPaxosInput,
+                 samples: dict, duration_s: float, role_metrics: dict,
+                 workload) -> dict:
+    """Aggregate per-kind samples into the reference-shaped results
+    (benchmark.py:308-341), tagged with the input and role metrics."""
+    from frankenpaxos_tpu.bench.workload import workload_to_dict
+
+    all_lat = samples["read"][0] + samples["write"][0]
+    all_starts = samples["read"][1] + samples["write"][1]
+    stats = latency_throughput_stats(all_lat, duration_s,
+                                     starts_s=all_starts)
+    for kind in ("read", "write"):
+        lat, starts = samples[kind]
+        if lat:
+            sub = latency_throughput_stats(lat, duration_s,
+                                           starts_s=starts)
+            stats.update({f"{kind}.{k}": v for k, v in sub.items()})
     stats["input"] = dataclasses.asdict(input)
+    if workload is not None:
+        stats["input"]["workload"] = workload_to_dict(workload)
+    if role_metrics:
+        stats["role_metrics"] = role_metrics
     bench.write_json("results.json", stats)
     return stats

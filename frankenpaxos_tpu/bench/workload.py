@@ -1,0 +1,212 @@
+"""Client workload generators.
+
+Reference behavior: jvm/src/main/scala/frankenpaxos/Workload.scala (the
+write-only family: StringWorkload, UniformSingleKeyWorkload,
+BernoulliSingleKeyWorkload), jvm/.../multipaxos/ReadWriteWorkload.scala
+(the read/write family: UniformReadWriteWorkload,
+PointSkewedReadWriteWorkload, UniformMultiKeyReadWriteWorkload, and
+WriteOnly wrappers), and their Python spec side benchmarks/workload.py +
+benchmarks/read_write_workload.py. Specs are JSON dicts here (the
+prototext analog), constructed via ``workload_from_dict``.
+
+Commands are bytes for the target state machine: raw strings for
+AppendLog/Noop/Register, pickled GetRequest/SetRequest for
+KeyValueStore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Union
+
+from frankenpaxos_tpu.runtime.serializer import PickleSerializer
+from frankenpaxos_tpu.statemachine import GetRequest, SetRequest
+
+_SER = PickleSerializer()
+
+
+def _sized_value(rng: random.Random, mean: int, std: int) -> str:
+    size = max(0, round(rng.gauss(mean, std)))
+    return "x" * size
+
+
+@dataclasses.dataclass(frozen=True)
+class StringWorkload:
+    """Write-only strings, sizes ~ N(mean, std) (Workload.scala:27-37)."""
+
+    size_mean: int = 8
+    size_std: int = 0
+
+    def get(self, rng: random.Random) -> bytes:
+        return _sized_value(rng, self.size_mean, self.size_std).encode()
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformSingleKeyWorkload:
+    """Coin-flip get/set over ``num_keys`` uniform keys
+    (Workload.scala:39-72)."""
+
+    num_keys: int = 1
+    size_mean: int = 8
+    size_std: int = 0
+
+    def get(self, rng: random.Random) -> bytes:
+        key = str(rng.randrange(self.num_keys))
+        if rng.random() < 0.5:
+            return _SER.to_bytes(GetRequest((key,)))
+        value = _sized_value(rng, self.size_mean, self.size_std)
+        return _SER.to_bytes(SetRequest(((key, value),)))
+
+
+@dataclasses.dataclass(frozen=True)
+class BernoulliSingleKeyWorkload:
+    """Set key "x" with probability ``conflict_rate``, else get key "y"
+    -- the conflict-rate dial for generalized protocols
+    (Workload.scala:74-103)."""
+
+    conflict_rate: float = 0.5
+    size_mean: int = 8
+    size_std: int = 0
+
+    def get(self, rng: random.Random) -> bytes:
+        if rng.random() <= self.conflict_rate:
+            value = _sized_value(rng, self.size_mean, self.size_std)
+            return _SER.to_bytes(SetRequest((("x", value),)))
+        return _SER.to_bytes(GetRequest(("y",)))
+
+
+Workload = Union[StringWorkload, UniformSingleKeyWorkload,
+                 BernoulliSingleKeyWorkload]
+
+
+# --- read/write workloads --------------------------------------------------
+
+READ = "read"
+WRITE = "write"
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformReadWriteWorkload:
+    """``read_fraction`` of ops are reads; keys uniform over
+    ``num_keys`` (multipaxos/ReadWriteWorkload.scala:19-58)."""
+
+    num_keys: int = 1
+    read_fraction: float = 0.5
+    write_size_mean: int = 8
+    write_size_std: int = 0
+
+    def get(self, rng: random.Random) -> tuple[str, bytes]:
+        key = str(rng.randrange(self.num_keys))
+        if rng.random() < self.read_fraction:
+            return READ, _SER.to_bytes(GetRequest((key,)))
+        value = _sized_value(rng, self.write_size_mean,
+                             self.write_size_std)
+        return WRITE, _SER.to_bytes(SetRequest(((key, value),)))
+
+
+@dataclasses.dataclass(frozen=True)
+class PointSkewedReadWriteWorkload:
+    """``point_fraction`` of ops hit one hot key; the rest are uniform
+    (multipaxos/ReadWriteWorkload.scala:60-110)."""
+
+    num_keys: int = 1
+    read_fraction: float = 0.5
+    point_fraction: float = 0.5
+    write_size_mean: int = 8
+    write_size_std: int = 0
+
+    def get(self, rng: random.Random) -> tuple[str, bytes]:
+        if rng.random() < self.point_fraction:
+            key = "point"
+        else:
+            key = str(rng.randrange(self.num_keys))
+        if rng.random() < self.read_fraction:
+            return READ, _SER.to_bytes(GetRequest((key,)))
+        value = _sized_value(rng, self.write_size_mean,
+                             self.write_size_std)
+        return WRITE, _SER.to_bytes(SetRequest(((key, value),)))
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformMultiKeyReadWriteWorkload:
+    """Each op touches ``num_operations`` distinct uniform keys
+    (multipaxos/ReadWriteWorkload.scala:112-163)."""
+
+    num_keys: int = 2
+    num_operations: int = 2
+    read_fraction: float = 0.5
+    write_size_mean: int = 8
+    write_size_std: int = 0
+
+    def get(self, rng: random.Random) -> tuple[str, bytes]:
+        n = min(self.num_operations, self.num_keys)
+        keys = [str(k) for k in rng.sample(range(self.num_keys), n)]
+        if rng.random() < self.read_fraction:
+            return READ, _SER.to_bytes(GetRequest(tuple(keys)))
+        pairs = tuple(
+            (key, _sized_value(rng, self.write_size_mean,
+                               self.write_size_std))
+            for key in keys)
+        return WRITE, _SER.to_bytes(SetRequest(pairs))
+
+
+@dataclasses.dataclass(frozen=True)
+class WriteOnlyWorkload:
+    """Wrap a write-only Workload as a ReadWriteWorkload
+    (multipaxos/ReadWriteWorkload.scala:165-170)."""
+
+    workload: Workload
+
+    def get(self, rng: random.Random) -> tuple[str, bytes]:
+        return WRITE, self.workload.get(rng)
+
+
+ReadWriteWorkload = Union[UniformReadWriteWorkload,
+                          PointSkewedReadWriteWorkload,
+                          UniformMultiKeyReadWriteWorkload,
+                          WriteOnlyWorkload]
+
+
+# Client read-consistency level -> multipaxos Client method name
+# (Client.scala:851-933, :697+, :739+).
+READ_METHODS = {
+    "linearizable": "read",
+    "sequential": "sequential_read",
+    "eventual": "eventual_read",
+}
+
+_BY_NAME = {
+    "string": StringWorkload,
+    "uniform_single_key": UniformSingleKeyWorkload,
+    "bernoulli_single_key": BernoulliSingleKeyWorkload,
+    "uniform_read_write": UniformReadWriteWorkload,
+    "point_skewed_read_write": PointSkewedReadWriteWorkload,
+    "uniform_multi_key_read_write": UniformMultiKeyReadWriteWorkload,
+    "write_only": WriteOnlyWorkload,
+}
+
+
+def workload_from_dict(spec: dict):
+    """Build a workload from a JSON spec: ``{"name": ..., **params}``
+    (the prototext-config analog, Workload.scala:105-147)."""
+    spec = dict(spec)
+    name = spec.pop("name")
+    try:
+        cls = _BY_NAME[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r}; known: {sorted(_BY_NAME)}"
+        ) from None
+    if cls is WriteOnlyWorkload:
+        return WriteOnlyWorkload(workload_from_dict(spec["workload"]))
+    return cls(**spec)
+
+
+def workload_to_dict(workload) -> dict:
+    name = next(n for n, cls in _BY_NAME.items()
+                if cls is type(workload))
+    if isinstance(workload, WriteOnlyWorkload):
+        return {"name": name,
+                "workload": workload_to_dict(workload.workload)}
+    return {"name": name, **dataclasses.asdict(workload)}

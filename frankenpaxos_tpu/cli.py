@@ -116,11 +116,18 @@ def main(argv=None) -> None:
             f"{args.role}: valid range 0..{len(addresses) - 1}")
     address = addresses[args.index]
 
+    collectors = None
+    if args.prometheus_port > 0:
+        from frankenpaxos_tpu.runtime.monitoring import PrometheusCollectors
+
+        collectors = PrometheusCollectors()
+
     transport = TcpTransport(address, logger)
     transport.start()
     ctx = DeployCtx(config=config, transport=transport, logger=logger,
                     overrides=overrides, seed=args.seed,
-                    state_machine=args.state_machine)
+                    state_machine=args.state_machine,
+                    collectors=collectors)
     role.make(ctx, address, args.index)
     unmatched = ctx.unmatched_overrides()
     if unmatched:

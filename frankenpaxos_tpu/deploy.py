@@ -80,6 +80,7 @@ class DeployCtx:
     overrides: dict
     seed: int = 0
     state_machine: str = "AppendLog"
+    collectors: Any = None  # monitoring.Collectors; None -> fakes
     consumed: set = dataclasses.field(default_factory=set)
 
     def sm(self):
@@ -341,7 +342,8 @@ def _multipaxos() -> Protocol:
                 lambda c: list(c.batcher_addresses),
                 lambda ctx, a, i: mp.Batcher(
                     a, ctx.transport, ctx.logger, ctx.config,
-                    ctx.opts(mp.BatcherOptions))),
+                    ctx.opts(mp.BatcherOptions),
+                    collectors=ctx.collectors)),
             "read_batcher": Role(
                 lambda c: list(c.read_batcher_addresses),
                 lambda ctx, a, i: mp.ReadBatcher(
@@ -351,27 +353,32 @@ def _multipaxos() -> Protocol:
                 lambda c: list(c.leader_addresses),
                 lambda ctx, a, i: mp.Leader(
                     a, ctx.transport, ctx.logger, ctx.config,
-                    ctx.opts(mp.LeaderOptions), seed=ctx.seed)),
+                    ctx.opts(mp.LeaderOptions), seed=ctx.seed,
+                    collectors=ctx.collectors)),
             "proxy_leader": Role(
                 lambda c: list(c.proxy_leader_addresses),
                 lambda ctx, a, i: mp.ProxyLeader(
                     a, ctx.transport, ctx.logger, ctx.config,
-                    ctx.opts(mp.ProxyLeaderOptions), seed=ctx.seed)),
+                    ctx.opts(mp.ProxyLeaderOptions), seed=ctx.seed,
+                    collectors=ctx.collectors)),
             "acceptor": Role(
                 flat_acceptors,
                 lambda ctx, a, i: mp.Acceptor(
                     a, ctx.transport, ctx.logger, ctx.config,
-                    ctx.opts(mp.AcceptorOptions))),
+                    ctx.opts(mp.AcceptorOptions),
+                    collectors=ctx.collectors)),
             "replica": Role(
                 lambda c: list(c.replica_addresses),
                 lambda ctx, a, i: mp.Replica(
                     a, ctx.transport, ctx.logger, ctx.sm(), ctx.config,
-                    ctx.opts(mp.ReplicaOptions), seed=ctx.seed)),
+                    ctx.opts(mp.ReplicaOptions), seed=ctx.seed,
+                    collectors=ctx.collectors)),
             "proxy_replica": Role(
                 lambda c: list(c.proxy_replica_addresses),
                 lambda ctx, a, i: mp.ProxyReplica(
                     a, ctx.transport, ctx.logger, ctx.config,
-                    ctx.opts(mp.ProxyReplicaOptions))),
+                    ctx.opts(mp.ProxyReplicaOptions),
+                    collectors=ctx.collectors)),
         },
         make_client=lambda ctx, a: mp.Client(
             a, ctx.transport, ctx.logger, ctx.config,

@@ -49,7 +49,10 @@ class Acceptor(Actor):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        self.options = options
         collectors = collectors or FakeCollectors()
+        self.metrics_latency = collectors.summary(
+            "multipaxos_acceptor_requests_latency_seconds", labels=("type",))
         self.metrics_requests = collectors.counter(
             "multipaxos_acceptor_requests_total", labels=("type",))
         self.group_index = next(
@@ -63,6 +66,15 @@ class Acceptor(Actor):
         self.max_voted_slot = -1
 
     def receive(self, src: Address, message) -> None:
+        # timed(label) handler latency summaries (Leader.scala:281-293).
+        if self.options.measure_latencies:
+            with self.metrics_latency.labels(
+                    type(message).__name__).time():
+                self._receive_impl(src, message)
+        else:
+            self._receive_impl(src, message)
+
+    def _receive_impl(self, src: Address, message) -> None:
         if isinstance(message, Phase1a):
             self.metrics_requests.labels("Phase1a").inc()
             self._handle_phase1a(src, message)

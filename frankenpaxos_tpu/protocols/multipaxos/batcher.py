@@ -44,6 +44,8 @@ class Batcher(Actor):
         self.config = config
         self.options = options
         collectors = collectors or FakeCollectors()
+        self.metrics_latency = collectors.summary(
+            "multipaxos_batcher_requests_latency_seconds", labels=("type",))
         self.metrics_batches = collectors.counter(
             "multipaxos_batcher_batches_sent_total")
         self.round_system = ClassicRoundRobin(config.num_leaders)
@@ -56,6 +58,15 @@ class Batcher(Actor):
             self.round)]
 
     def receive(self, src: Address, message) -> None:
+        # timed(label) handler latency summaries (Leader.scala:281-293).
+        if self.options.measure_latencies:
+            with self.metrics_latency.labels(
+                    type(message).__name__).time():
+                self._receive_impl(src, message)
+        else:
+            self._receive_impl(src, message)
+
+    def _receive_impl(self, src: Address, message) -> None:
         if isinstance(message, ClientRequest):
             self._handle_client_request(src, message)
         elif isinstance(message, NotLeaderBatcher):

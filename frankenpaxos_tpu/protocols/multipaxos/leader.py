@@ -94,6 +94,8 @@ class Leader(Actor):
         self.options = options
         self.rng = random.Random(seed)
         collectors = collectors or FakeCollectors()
+        self.metrics_latency = collectors.summary(
+            "multipaxos_leader_requests_latency_seconds", labels=("type",))
         self.metrics_requests = collectors.counter(
             "multipaxos_leader_requests_total", labels=("type",))
         self.index = list(config.leader_addresses).index(address)
@@ -289,6 +291,15 @@ class Leader(Actor):
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
+        # timed(label) handler latency summaries (Leader.scala:281-293).
+        if self.options.measure_latencies:
+            with self.metrics_latency.labels(
+                    type(message).__name__).time():
+                self._receive_impl(src, message)
+        else:
+            self._receive_impl(src, message)
+
+    def _receive_impl(self, src: Address, message) -> None:
         handlers = [
             (Phase1b, "Phase1b", self._handle_phase1b),
             (ClientRequest, "ClientRequest", self._handle_client_request),

@@ -52,6 +52,8 @@ class ProxyLeader(Actor):
         self.options = options
         self.rng = random.Random(seed)
         collectors = collectors or FakeCollectors()
+        self.metrics_latency = collectors.summary(
+            "multipaxos_proxy_leader_requests_latency_seconds", labels=("type",))
         self.metrics_requests = collectors.counter(
             "multipaxos_proxy_leader_requests_total", labels=("type",))
         self.grid = config.quorum_grid() if config.flexible else None
@@ -68,6 +70,15 @@ class ProxyLeader(Actor):
             self.tracker = DictQuorumTracker(config)
 
     def receive(self, src: Address, message) -> None:
+        # timed(label) handler latency summaries (Leader.scala:281-293).
+        if self.options.measure_latencies:
+            with self.metrics_latency.labels(
+                    type(message).__name__).time():
+                self._receive_impl(src, message)
+        else:
+            self._receive_impl(src, message)
+
+    def _receive_impl(self, src: Address, message) -> None:
         if isinstance(message, Phase2a):
             self.metrics_requests.labels("Phase2a").inc()
             self._handle_phase2a(src, message)

@@ -37,6 +37,8 @@ class ProxyReplica(Actor):
         self.config = config
         self.options = options
         collectors = collectors or FakeCollectors()
+        self.metrics_latency = collectors.summary(
+            "multipaxos_proxy_replica_requests_latency_seconds", labels=("type",))
         self.metrics_requests = collectors.counter(
             "multipaxos_proxy_replica_requests_total", labels=("type",))
         self._unflushed = 0
@@ -56,6 +58,15 @@ class ProxyReplica(Actor):
             self._unflushed = 0
 
     def receive(self, src: Address, message) -> None:
+        # timed(label) handler latency summaries (Leader.scala:281-293).
+        if self.options.measure_latencies:
+            with self.metrics_latency.labels(
+                    type(message).__name__).time():
+                self._receive_impl(src, message)
+        else:
+            self._receive_impl(src, message)
+
+    def _receive_impl(self, src: Address, message) -> None:
         if isinstance(message, ClientReplyBatch):
             self.metrics_requests.labels("ClientReplyBatch").inc()
             for reply in message.batch:

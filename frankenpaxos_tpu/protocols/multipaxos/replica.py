@@ -68,8 +68,12 @@ class Replica(Actor):
         self.state_machine = state_machine
         self.rng = random.Random(seed)
         collectors = collectors or FakeCollectors()
+        self.metrics_latency = collectors.summary(
+            "multipaxos_replica_requests_latency_seconds", labels=("type",))
         self.metrics_executed = collectors.counter(
             "multipaxos_replica_executed_commands_total")
+        self.metrics_reads = collectors.counter(
+            "multipaxos_replica_executed_reads_total")
         self.index = list(config.replica_addresses).index(address)
         self.log: BufferMap = BufferMap(options.log_grow_size)
         self.deferred_reads: BufferMap = BufferMap(options.log_grow_size)
@@ -159,6 +163,7 @@ class Replica(Actor):
 
     def _execute_read(self, command: Command) -> ReadReply:
         result = self.state_machine.run(command.command)
+        self.metrics_reads.inc()
         return ReadReply(command_id=command.command_id,
                          slot=self.executed_watermark - 1, result=result)
 
@@ -175,6 +180,15 @@ class Replica(Actor):
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
+        # timed(label) handler latency summaries (Leader.scala:281-293).
+        if self.options.measure_latencies:
+            with self.metrics_latency.labels(
+                    type(message).__name__).time():
+                self._receive_impl(src, message)
+        else:
+            self._receive_impl(src, message)
+
+    def _receive_impl(self, src: Address, message) -> None:
         if isinstance(message, Chosen):
             self._handle_chosen(src, message)
         elif isinstance(message, ReadRequest):

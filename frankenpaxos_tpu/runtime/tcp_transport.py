@@ -215,7 +215,8 @@ class TcpTransport(Transport):
                                                 None):
                 task = self.loop.create_task(self._bind(address))
                 task.add_done_callback(
-                    lambda t: t.exception() and self.logger.error(
+                    lambda t: (not t.cancelled() and t.exception())
+                    and self.logger.error(
                         f"bind {address} failed: {t.exception()!r}"))
             else:
                 future = asyncio.run_coroutine_threadsafe(

@@ -40,3 +40,46 @@ def test_multipaxos_deployment_benchmark():
         MultiPaxosInput(duration_s=1.0, num_clients=2))
     assert stats["num_requests"] > 0
     assert stats["latency.median_ms"] > 0
+
+
+def test_multipaxos_read_write_benchmark_with_metrics():
+    """Client-process workload driving + per-role /metrics scraping:
+    reads spread across replicas (the Evelyn read-scale mechanism)."""
+    from frankenpaxos_tpu.bench.workload import UniformReadWriteWorkload
+
+    suite = SuiteDirectory(tempfile.mkdtemp(prefix="fpx_test_"),
+                           "multipaxos_rw")
+    stats = run_benchmark(
+        suite.benchmark_directory(),
+        MultiPaxosInput(
+            duration_s=1.5, num_clients=4, client_procs=2,
+            num_replicas=3,
+            workload=UniformReadWriteWorkload(num_keys=8,
+                                              read_fraction=0.8),
+            read_consistency="eventual", prometheus=True))
+    assert stats["read.num_requests"] > 0
+    assert stats["write.num_requests"] > 0
+    reads = {
+        label: metrics.get("multipaxos_replica_executed_reads_total", 0.0)
+        for label, metrics in stats["role_metrics"].items()
+        if label.startswith("replica_")}
+    assert len(reads) == 3
+    # Reads go to a uniformly random replica; every replica served some.
+    assert all(count > 0 for count in reads.values()), reads
+
+
+def test_multipaxos_linearizable_reads():
+    """Quorum reads (MaxSlot -> replica) through the deployed cluster."""
+    from frankenpaxos_tpu.bench.workload import UniformReadWriteWorkload
+
+    suite = SuiteDirectory(tempfile.mkdtemp(prefix="fpx_test_"),
+                           "multipaxos_linread")
+    stats = run_benchmark(
+        suite.benchmark_directory(),
+        MultiPaxosInput(
+            duration_s=1.0, num_clients=2,
+            workload=UniformReadWriteWorkload(num_keys=4,
+                                              read_fraction=0.5),
+            read_consistency="linearizable"))
+    assert stats["read.num_requests"] > 0
+    assert stats["write.num_requests"] > 0

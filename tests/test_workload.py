@@ -1,0 +1,109 @@
+"""Workload generators (jvm/.../Workload.scala +
+multipaxos/ReadWriteWorkload.scala semantics)."""
+
+import random
+
+import pytest
+
+from frankenpaxos_tpu.bench.workload import (
+    READ,
+    WRITE,
+    BernoulliSingleKeyWorkload,
+    PointSkewedReadWriteWorkload,
+    StringWorkload,
+    UniformMultiKeyReadWriteWorkload,
+    UniformReadWriteWorkload,
+    UniformSingleKeyWorkload,
+    WriteOnlyWorkload,
+    workload_from_dict,
+    workload_to_dict,
+)
+from frankenpaxos_tpu.runtime.serializer import PickleSerializer
+from frankenpaxos_tpu.statemachine import (
+    GetRequest,
+    KeyValueStore,
+    SetRequest,
+)
+
+SER = PickleSerializer()
+
+
+def test_string_workload_sizes():
+    w = StringWorkload(size_mean=10, size_std=0)
+    rng = random.Random(0)
+    assert all(len(w.get(rng)) == 10 for _ in range(50))
+
+
+def test_uniform_single_key_commands_run_on_kv_store():
+    w = UniformSingleKeyWorkload(num_keys=3, size_mean=4)
+    rng = random.Random(1)
+    sm = KeyValueStore()
+    kinds = set()
+    for _ in range(100):
+        cmd = SER.from_bytes(w.get(rng))
+        kinds.add(type(cmd))
+        sm.typed_run(cmd)
+    assert kinds == {GetRequest, SetRequest}
+
+
+def test_bernoulli_conflict_rate():
+    w = BernoulliSingleKeyWorkload(conflict_rate=0.25)
+    rng = random.Random(2)
+    sets = sum(isinstance(SER.from_bytes(w.get(rng)), SetRequest)
+               for _ in range(2000))
+    assert 0.2 < sets / 2000 < 0.3
+
+
+def test_uniform_read_write_fraction():
+    w = UniformReadWriteWorkload(num_keys=4, read_fraction=0.8)
+    rng = random.Random(3)
+    ops = [w.get(rng) for _ in range(2000)]
+    reads = sum(kind == READ for kind, _ in ops)
+    assert 0.75 < reads / 2000 < 0.85
+    for kind, payload in ops[:20]:
+        cmd = SER.from_bytes(payload)
+        assert isinstance(cmd, GetRequest if kind == READ else SetRequest)
+
+
+def test_point_skewed_hits_hot_key():
+    w = PointSkewedReadWriteWorkload(num_keys=4, read_fraction=0.0,
+                                     point_fraction=1.0)
+    rng = random.Random(4)
+    for _ in range(20):
+        kind, payload = w.get(rng)
+        assert kind == WRITE
+        assert SER.from_bytes(payload).key_values[0][0] == "point"
+
+
+def test_multi_key_ops_touch_distinct_keys():
+    w = UniformMultiKeyReadWriteWorkload(num_keys=8, num_operations=3,
+                                         read_fraction=1.0)
+    rng = random.Random(5)
+    for _ in range(20):
+        kind, payload = w.get(rng)
+        keys = SER.from_bytes(payload).keys
+        assert kind == READ and len(set(keys)) == 3
+
+
+def test_write_only_wrapper():
+    w = WriteOnlyWorkload(StringWorkload(size_mean=5))
+    rng = random.Random(6)
+    kind, payload = w.get(rng)
+    assert kind == WRITE and payload == b"xxxxx"
+
+
+@pytest.mark.parametrize("workload", [
+    StringWorkload(size_mean=3, size_std=1),
+    UniformSingleKeyWorkload(num_keys=7),
+    BernoulliSingleKeyWorkload(conflict_rate=0.1),
+    UniformReadWriteWorkload(num_keys=2, read_fraction=0.9),
+    PointSkewedReadWriteWorkload(point_fraction=0.3),
+    UniformMultiKeyReadWriteWorkload(num_keys=5, num_operations=2),
+])
+def test_dict_round_trip(workload):
+    assert workload_from_dict(workload_to_dict(workload)) == workload
+
+
+def test_unknown_workload_name():
+    with pytest.raises(ValueError, match="unknown workload"):
+        workload_from_dict({"name": "nope"})
