@@ -42,7 +42,7 @@ def role_process_env() -> dict:
 def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  config_path: str, config, *, state_machine: str,
                  overrides: "dict[str, str] | None" = None,
-                 prometheus: bool = False,
+                 prometheus: bool = False, supernode: bool = False,
                  ready_timeout_s: float = 120.0) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
     until each reports it is listening.
@@ -51,6 +51,9 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     fresh port; the ``{label: port}`` map lands in
     ``bench.prometheus_ports`` and a generated scrape config in
     ``prometheus.json`` (benchmarks/prometheus.py:10-60 semantics).
+
+    With ``supernode=True`` all roles run colocated in ONE process (the
+    coupled baseline, SuperNode.scala:22+).
     """
     protocol = get_protocol(protocol_name)
     host = LocalHost()
@@ -60,22 +63,27 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     env = None if needs_tpu else role_process_env()
     labels = []
     prometheus_ports: dict[str, int] = {}
-    for role_name, role in protocol.roles.items():
-        for index in range(len(role.addresses(config))):
-            label = f"{role_name}_{index}"
-            labels.append(label)
-            cmd = [sys.executable, "-m", "frankenpaxos_tpu.cli",
-                   "--protocol", protocol_name, "--role", role_name,
-                   "--index", str(index), "--config", config_path,
-                   "--state_machine", state_machine,
-                   "--seed", str(index)]
-            if prometheus:
-                prometheus_ports[label] = free_port()
-                cmd += ["--prometheus_port",
-                        str(prometheus_ports[label])]
-            for key, value in (overrides or {}).items():
-                cmd.append(f"--options.{key}={value}")
-            bench.popen(host, label, cmd, env=env)
+    if supernode:
+        launch_plan = [("supernode", 0)]
+    else:
+        launch_plan = [(role_name, index)
+                       for role_name, role in protocol.roles.items()
+                       for index in range(len(role.addresses(config)))]
+    for role_name, index in launch_plan:
+        label = f"{role_name}_{index}"
+        labels.append(label)
+        cmd = [sys.executable, "-m", "frankenpaxos_tpu.cli",
+               "--protocol", protocol_name, "--role", role_name,
+               "--index", str(index), "--config", config_path,
+               "--state_machine", state_machine,
+               "--seed", str(index)]
+        if prometheus:
+            prometheus_ports[label] = free_port()
+            cmd += ["--prometheus_port",
+                    str(prometheus_ports[label])]
+        for key, value in (overrides or {}).items():
+            cmd.append(f"--options.{key}={value}")
+        bench.popen(host, label, cmd, env=env)
     bench.prometheus_ports = prometheus_ports
     if prometheus:
         from frankenpaxos_tpu.bench.metrics import scrape_config

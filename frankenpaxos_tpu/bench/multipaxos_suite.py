@@ -47,6 +47,10 @@ class MultiPaxosInput:
     # Expose per-role /metrics endpoints and record them in the results
     # (benchmarks/prometheus.py semantics).
     prometheus: bool = False
+    # Coupled baseline: all roles colocated in one process
+    # (SuperNode.scala:22+). Compartmentalized (False) vs coupled (True)
+    # is the reference's headline 4-8x shape (BASELINE.md).
+    supernode: bool = False
 
 
 def placement(input: MultiPaxosInput) -> dict:
@@ -80,7 +84,7 @@ def run_benchmark(bench: BenchmarkDirectory,
     launch_roles(bench, "multipaxos", config_path, config,
                  state_machine=input.state_machine,
                  overrides={"quorum_backend": input.quorum_backend},
-                 prometheus=input.prometheus)
+                 prometheus=input.prometheus, supernode=input.supernode)
     serializer = PickleSerializer()
 
     # Explicit leader-ready probe: a warmup write with a short resend

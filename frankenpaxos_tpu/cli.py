@@ -103,32 +103,58 @@ def main(argv=None) -> None:
     with open(args.config) as f:
         config = protocol.load_config(json.load(f))
 
-    try:
-        role = protocol.roles[args.role]
-    except KeyError:
-        raise SystemExit(
-            f"unknown role {args.role!r} for {args.protocol}; "
-            f"known: {sorted(protocol.roles)}")
-    addresses = role.addresses(config)
-    if not 0 <= args.index < len(addresses):
-        raise SystemExit(
-            f"--index {args.index} out of range for {args.protocol} "
-            f"{args.role}: valid range 0..{len(addresses) - 1}")
-    address = addresses[args.index]
-
     collectors = None
     if args.prometheus_port > 0:
         from frankenpaxos_tpu.runtime.monitoring import PrometheusCollectors
 
         collectors = PrometheusCollectors()
 
-    transport = TcpTransport(address, logger)
+    if args.role == "supernode":
+        listen_address = None
+    else:
+        try:
+            role = protocol.roles[args.role]
+        except KeyError:
+            raise SystemExit(
+                f"unknown role {args.role!r} for {args.protocol}; "
+                f"known: {sorted(protocol.roles)} or 'supernode'")
+        addresses = role.addresses(config)
+        if not 0 <= args.index < len(addresses):
+            raise SystemExit(
+                f"--index {args.index} out of range for {args.protocol} "
+                f"{args.role}: valid range 0..{len(addresses) - 1}")
+        listen_address = addresses[args.index]
+
+    transport = TcpTransport(listen_address, logger)
     transport.start()
     ctx = DeployCtx(config=config, transport=transport, logger=logger,
                     overrides=overrides, seed=args.seed,
                     state_machine=args.state_machine,
                     collectors=collectors)
-    role.make(ctx, address, args.index)
+
+    if args.role == "supernode":
+        # Coupled baseline: every role of the protocol colocated in one
+        # process on one event loop (the reference's SuperNode mains,
+        # jvm/.../multipaxos/SuperNode.scala:22+). Bind every role
+        # address FIRST so construction-time sends (a leader's Phase1a)
+        # always find their targets listening, then construct in
+        # declaration order with a distinct seed per actor (matching the
+        # per-process --seed diversity of compartmentalized mode --
+        # identical seeds would sync the elections' randomized
+        # timeouts).
+        count = 0
+        for role_name, role in protocol.roles.items():
+            for role_address in role.addresses(config):
+                transport.listen_on(role_address)
+        for role_name, role in protocol.roles.items():
+            for index, role_address in enumerate(role.addresses(config)):
+                ctx.seed = args.seed + count
+                role.make(ctx, role_address, index)
+                count += 1
+        address = f"supernode ({count} roles)"
+    else:
+        address = listen_address
+        role.make(ctx, address, args.index)
     unmatched = ctx.unmatched_overrides()
     if unmatched:
         # Overrides are shared across a deployment's roles, so an option

@@ -195,6 +195,14 @@ class TcpTransport(Transport):
         self.logger.warn(f"dropping frame from {src} to {local}: "
                          f"no registered actor")
 
+    def listen_on(self, address: Address) -> None:
+        """Bind a listener for ``address`` ahead of actor registration
+        (used by supernode mode to make every role address reachable
+        before any actor's construction-time sends go out)."""
+        assert self.loop is not None, "transport not started"
+        asyncio.run_coroutine_threadsafe(
+            self._bind(address), self.loop).result(timeout=10)
+
     # --- Transport API ----------------------------------------------------
     def register(self, address: Address, actor: Actor) -> None:
         """Register ``actor`` and listen on its address.

@@ -83,3 +83,13 @@ def test_multipaxos_linearizable_reads():
             read_consistency="linearizable"))
     assert stats["read.num_requests"] > 0
     assert stats["write.num_requests"] > 0
+
+
+def test_multipaxos_supernode_benchmark():
+    """Coupled baseline: all roles in one process (SuperNode.scala:22+)."""
+    suite = SuiteDirectory(tempfile.mkdtemp(prefix="fpx_test_"),
+                           "multipaxos_supernode")
+    stats = run_benchmark(
+        suite.benchmark_directory(),
+        MultiPaxosInput(duration_s=1.0, num_clients=2, supernode=True))
+    assert stats["num_requests"] > 0
