@@ -1,0 +1,174 @@
+"""Latency-throughput sweep: the real actor framework, dict vs tpu.
+
+The analog of the reference's LT-curve methodology
+(benchmarks/multipaxos/multipaxos.py:292-785 + e1_lt_surprise.py):
+sweep offered load (client processes x closed loops) over the deployed
+multipaxos cluster and record throughput/latency per point, for both
+quorum backends:
+
+  * ``dict``  -- host-dict vote tracking in the proxy leader (the
+    reference's semantics; CPU-pinned role processes).
+  * ``tpu``   -- the proxy leader's Phase2b votes collected on the
+    accelerator via TpuQuorumTracker (dense record_block runs + sparse
+    scatter tail), one device call per event-loop drain.
+
+Also runs an in-process SimTransport comparison (no TCP, same actor
+code) isolating the per-drain tracker cost from network effects.
+
+NOTE on this environment: the TPU is reached through a tunnel with
+~10-100ms per device round-trip (see .claude/skills/verify/SKILL.md), so
+per-drain device calls carry that RTT on the deployed path; the
+committed results record it honestly alongside the device-pipeline
+ceiling (bench.py).
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.lt_suite \
+        --out bench_results/multipaxos_lt.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+from frankenpaxos_tpu.bench.harness import SuiteDirectory
+from frankenpaxos_tpu.bench.multipaxos_suite import (
+    MultiPaxosInput,
+    run_benchmark,
+)
+
+
+def sim_transport_cmds_per_sec(quorum_backend: str,
+                               num_commands: int = 300) -> float:
+    """Drive the full actor pipeline over SimTransport (single process,
+    no TCP): client -> leader -> proxy leader -> acceptors -> replicas,
+    with the chosen quorum backend."""
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tests.protocols.multipaxos_harness import make_multipaxos
+
+    sim = make_multipaxos(f=1, quorum_backend=quorum_backend)
+    results = []
+    # Warm up (compiles the device kernels on the tpu backend).
+    sim.clients[0].write(0, b"warmup", results.append)
+    sim.transport.deliver_all()
+    t0 = time.perf_counter()
+    for i in range(num_commands):
+        sim.clients[0].write(0, b"w%d" % i, results.append)
+        sim.transport.deliver_all()
+    elapsed = time.perf_counter() - t0
+    assert len(results) == num_commands + 1
+    return num_commands / elapsed
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--scales", type=str, default="1x5,2x10,4x10",
+                        help="comma-separated client_procs x loops points")
+    parser.add_argument("--tpu_scales", type=str, default="2x10",
+                        help="sweep points to also run with the tpu "
+                             "backend (each device drain pays the "
+                             "tunnel RTT; keep this small)")
+    parser.add_argument("--sim_commands", type=int, default=300)
+    parser.add_argument("--suite_dir", default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    def parse_scales(text):
+        out = []
+        for part in text.split(","):
+            procs, loops = part.lower().split("x")
+            out.append((int(procs), int(loops)))
+        return out
+
+    root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_lt_")
+    suite = SuiteDirectory(root, "multipaxos_lt")
+
+    points = []
+    for backend in ("dict", "tpu"):
+        scales = parse_scales(args.scales if backend == "dict"
+                              else args.tpu_scales)
+        for procs, loops in scales:
+            stats = run_benchmark(
+                suite.benchmark_directory(),
+                MultiPaxosInput(num_clients=loops, client_procs=procs,
+                                duration_s=args.duration,
+                                quorum_backend=backend))
+            point = {
+                "quorum_backend": backend,
+                "client_procs": procs,
+                "loops_per_proc": loops,
+                "throughput_p90_1s": stats.get("start_throughput_1s.p90"),
+                "latency_median_ms": stats.get("latency.median_ms"),
+                "latency_p99_ms": stats.get("latency.p99_ms"),
+                "num_requests": stats["num_requests"],
+            }
+            points.append(point)
+            print(json.dumps(point))
+
+    sim_rows = {
+        backend: round(sim_transport_cmds_per_sec(
+            backend, args.sim_commands), 1)
+        for backend in ("dict", "tpu")}
+    # The same tpu-backend actor pipeline against LOCAL XLA (cpu) in a
+    # subprocess: separates the per-drain kernel cost from the ~10-100ms
+    # accelerator-tunnel RTT of this environment.
+    import subprocess
+    import sys as _sys
+
+    from frankenpaxos_tpu.bench.deploy_suite import role_process_env
+
+    local = subprocess.run(
+        [_sys.executable, "-c",
+         "from frankenpaxos_tpu.bench.lt_suite import "
+         "sim_transport_cmds_per_sec; "
+         f"print(sim_transport_cmds_per_sec('tpu', {args.sim_commands}))"],
+        capture_output=True, text=True, env=role_process_env(),
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    if local.returncode == 0:
+        sim_rows["tpu_local_xla"] = round(float(
+            local.stdout.strip().splitlines()[-1]), 1)
+    else:
+        print(f"tpu_local_xla measurement failed "
+              f"(rc={local.returncode}): {local.stderr[-500:]}",
+              file=_sys.stderr)
+    print(json.dumps({"sim_transport_cmds_per_sec": sim_rows}))
+
+    result = {
+        "benchmark": "multipaxos_lt",
+        "host_cpus": os.cpu_count(),
+        "duration_s": args.duration,
+        "deployed_points": points,
+        "sim_transport_cmds_per_sec": sim_rows,
+        "note": ("deployed tpu-backend points pay a ~10-100ms "
+                 "accelerator-tunnel RTT per proxy-leader drain in this "
+                 "environment"
+                 + (": tpu_local_xla runs the same actor pipeline "
+                    f"against local XLA at "
+                    f"{sim_rows['tpu_local_xla']:.0f} cmds/s vs "
+                    f"{sim_rows['tpu']:.0f} over the tunnel, so the "
+                    "tunnel, not the kernel, dominates the gap"
+                    if "tpu_local_xla" in sim_rows else "")
+                 + ". Per-message drains cannot amortize a device call; "
+                 "bench.py records the device-resident pipeline ceiling "
+                 "where drains are block-granular."),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()

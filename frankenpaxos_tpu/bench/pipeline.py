@@ -121,13 +121,23 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     start_new = (i % num_blocks) * b_local
     start_old = ((i - 1) % num_blocks) * b_local
 
-    slot_idx = _axis_index(slot_axis)
-    group_idx = _axis_index(group_axis)
     # Logical coordinates: lane within the global block, global acceptor.
-    lanes_new = slot_idx * b_local + jnp.arange(b_local, dtype=jnp.int32)
-    accs = group_idx * n_local + jnp.arange(n_local, dtype=jnp.int32)
-    masks_local = jax.lax.dynamic_slice(
-        masks_d, (0, group_idx * n_local), (masks_d.shape[0], n_local))
+    # The unsharded case avoids the (traced-index) slice/offset ops so
+    # XLA sees pure iota inputs and fuses everything into the matmul.
+    if slot_axis is None:
+        lanes_new = jnp.arange(b_local, dtype=jnp.int32)
+    else:
+        lanes_new = (_axis_index(slot_axis) * b_local
+                     + jnp.arange(b_local, dtype=jnp.int32))
+    if group_axis is None:
+        accs = jnp.arange(n_local, dtype=jnp.int32)
+        masks_local = masks_d
+    else:
+        group_idx = _axis_index(group_axis)
+        accs = group_idx * n_local + jnp.arange(n_local, dtype=jnp.int32)
+        masks_local = jax.lax.dynamic_slice(
+            masks_d, (0, group_idx * n_local),
+            (masks_d.shape[0], n_local))
 
     # --- Leader: assign slots, propose command ids --------------------------
     proposed = lanes_new * 7 + i * 13 + 1

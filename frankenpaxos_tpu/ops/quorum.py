@@ -130,23 +130,30 @@ def _record_block(
     The steady-state Phase2b stream (Leader.scala:331-408 allocates slots
     contiguously; ProxyLeader collects in slot order) maps here: no
     scatter, only slicing. Returns the ``[B]`` newly-chosen mask.
+
+    Columns with no vote in ``block`` (gap slots inside the run, or
+    bucket padding) are left untouched -- in particular their rounds are
+    NOT bumped, so an older-round slot mid-run keeps collecting its own
+    round's votes (matching the per-(slot, round) dict semantics).
     """
     masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
     thresholds, combine_any = meta
     thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
     n = board.votes.shape[0]
 
+    touched = block.any(axis=0)                                # [B]
     old_rounds = jax.lax.dynamic_slice(board.rounds, (start,), (block_size,))
-    new_rounds = jnp.maximum(old_rounds, vote_round)
+    new_rounds = jnp.where(touched,
+                           jnp.maximum(old_rounds, vote_round), old_rounds)
     preempted = new_rounds > old_rounds
     cols = jax.lax.dynamic_slice(board.votes, (0, start), (n, block_size))
     cols = jnp.where(preempted[None, :], jnp.uint8(0), cols)
-    live = vote_round == new_rounds                            # [B]
+    live = touched & (vote_round == new_rounds)                # [B]
     cols = cols | (block & live[None, :].astype(jnp.uint8))
 
     hit = _quorum_hit(cols, masks, thresholds, combine_any)
     old_chosen = jax.lax.dynamic_slice(board.chosen, (start,), (block_size,))
-    newly = hit & ~old_chosen
+    newly = hit & ~old_chosen & touched
     return VoteBoard(
         votes=jax.lax.dynamic_update_slice(board.votes, cols, (0, start)),
         rounds=jax.lax.dynamic_update_slice(board.rounds, new_rounds,
@@ -242,10 +249,23 @@ class TpuQuorumChecker:
             raise ValueError(
                 f"block [{start}, {start + b}) straddles the ring end "
                 f"(window {self.window}); split it")
+        # Bucket the width to powers of two so variable drain sizes
+        # compile O(log max_width) kernels, not one per width (the same
+        # plan as record_and_check's pad_to). Padding columns are
+        # all-zero, which the kernel leaves untouched.
+        padded = 64
+        while padded < b:
+            padded *= 2
+        if padded != b and start + padded <= self.window:
+            block = np.concatenate(
+                [np.asarray(block, dtype=np.uint8),
+                 np.zeros((n, padded - b), dtype=np.uint8)], axis=1)
+        else:
+            padded = b
         self.board, newly = _record_block(
             self.board, jnp.int32(start), jnp.asarray(block, dtype=jnp.uint8),
-            jnp.int32(vote_round), b, self._masks_t, self._meta)
-        return np.asarray(newly)
+            jnp.int32(vote_round), padded, self._masks_t, self._meta)
+        return np.asarray(newly)[:b]
 
     def record_and_check(
         self,

@@ -389,3 +389,72 @@ def test_simulation_with_tpu_backend():
     simulated = MultiPaxosSimulated(f=1, quorum_backend="tpu")
     failure = Simulator(simulated, run_length=60, num_runs=3).run(seed=0)
     assert failure is None, str(failure)
+
+
+def test_quorum_tracker_dense_and_sparse_paths_match_dict():
+    """TpuQuorumTracker (dense record_block runs + sparse scatter tail)
+    reports exactly what DictQuorumTracker reports, over random mixes of
+    contiguous-slot drains and scattered straggler drains."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        DictQuorumTracker,
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    config = sim.config
+    for seed in range(4):
+        rng = random.Random(100 + seed)
+        dict_tracker = DictQuorumTracker(config)
+        tpu_tracker = TpuQuorumTracker(config, window=1 << 12)
+        cursor = 0
+        for _ in range(15):
+            votes = []
+            if rng.random() < 0.6 or cursor == 0:
+                # Contiguous frontier run: the dense record_block shape.
+                run_len = rng.randrange(1, 24)
+                for slot in range(cursor, cursor + run_len):
+                    for acc in rng.sample(range(3),
+                                          rng.randrange(1, 4)):
+                        votes.append((slot, acc))
+                cursor += run_len
+            else:
+                # Scattered stragglers over already-seen slots.
+                for _ in range(rng.randrange(1, 16)):
+                    votes.append((rng.randrange(cursor),
+                                  rng.randrange(3)))
+            rng.shuffle(votes)
+            for slot, acc in votes:
+                dict_tracker.record(slot, 0, 0, acc)
+                tpu_tracker.record(slot, 0, 0, acc)
+            assert sorted(dict_tracker.drain()) == \
+                sorted(tpu_tracker.drain()), (seed, cursor)
+
+
+def test_quorum_tracker_gap_slot_keeps_old_round_votes():
+    """Reviewer-found regression: the dense record_block path must not
+    bump the round of gap slots inside the run (they received no vote
+    this drain) -- an older-round slot mid-run keeps its votes and can
+    still commit in its own round, exactly as the dict oracle does."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        DictQuorumTracker,
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    trackers = [DictQuorumTracker(sim.config),
+                TpuQuorumTracker(sim.config, window=1 << 12)]
+    # Drain 1: slot 10 gets 1 of 2 round-0 votes.
+    for t in trackers:
+        t.record(10, 0, 0, 0)
+    assert [t.drain() for t in trackers] == [[], []]
+    # Drain 2: round-1 votes for slots 8 and 12 only (slot 10 is a gap
+    # inside the dense run and must be untouched).
+    for t in trackers:
+        t.record(8, 1, 0, 0)
+        t.record(12, 1, 0, 1)
+    assert [t.drain() for t in trackers] == [[], []]
+    # Drain 3: slot 10's second round-0 vote completes its quorum.
+    for t in trackers:
+        t.record(10, 0, 0, 1)
+    dict_out, tpu_out = [t.drain() for t in trackers]
+    assert dict_out == tpu_out == [(10, 0)]

@@ -93,3 +93,11 @@ def test_multipaxos_supernode_benchmark():
         suite.benchmark_directory(),
         MultiPaxosInput(duration_s=1.0, num_clients=2, supernode=True))
     assert stats["num_requests"] > 0
+
+
+def test_lt_suite_sim_transport_dict():
+    """The LT suite's in-process pipeline measure runs and is sane."""
+    from frankenpaxos_tpu.bench.lt_suite import sim_transport_cmds_per_sec
+
+    rate = sim_transport_cmds_per_sec("dict", num_commands=50)
+    assert rate > 10
