@@ -895,7 +895,9 @@ def _scalog() -> Protocol:
             aggregator_address=_addr(raw["aggregator"]),
             leader_addresses=tuple(_addrs(raw["leaders"])),
             acceptor_addresses=tuple(_addrs(raw["acceptors"])),
-            replica_addresses=tuple(_addrs(raw["replicas"])))
+            replica_addresses=tuple(_addrs(raw["replicas"])),
+            proxy_replica_addresses=tuple(
+                _addrs(raw.get("proxy_replicas", []))))
 
     def flat_servers(c):
         return [a for shard in c.server_addresses for a in shard]
@@ -926,6 +928,11 @@ def _scalog() -> Protocol:
                 lambda c: list(c.replica_addresses),
                 lambda ctx, a, i: m.ScalogReplica(
                     a, ctx.transport, ctx.logger, ctx.config, ctx.sm())),
+            "proxy_replica": Role(
+                lambda c: list(c.proxy_replica_addresses),
+                lambda ctx, a, i: m.ScalogProxyReplica(
+                    a, ctx.transport, ctx.logger, ctx.config,
+                    **ctx.kw(m.ScalogProxyReplica))),
         },
         make_client=lambda ctx, a: m.ScalogClient(
             a, ctx.transport, ctx.logger, ctx.config, seed=ctx.seed,
@@ -939,6 +946,7 @@ def _scalog() -> Protocol:
             "leaders": [port() for _ in range(f + 1)],
             "acceptors": [port() for _ in range(2 * f + 1)],
             "replicas": [port() for _ in range(f + 1)],
+            "proxy_replicas": [port() for _ in range(f + 1)],
         },
     )
 

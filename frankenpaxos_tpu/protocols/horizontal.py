@@ -93,6 +93,12 @@ class Reconfigure:
 
 
 @dataclasses.dataclass(frozen=True)
+class Die:
+    """Chaos: the receiving leader stops processing messages
+    (LeaderInbound.withDie, used by the driver's failure schedules)."""
+
+
+@dataclasses.dataclass(frozen=True)
 class Phase1a:
     round: int
     first_slot: int
@@ -190,6 +196,8 @@ class HorizontalLeader(Actor):
 
     # --- helpers ----------------------------------------------------------
     def _on_leader_change(self, leader_index: int) -> None:
+        if getattr(self, "dead", False):
+            return  # a killed leader must not be re-activated
         if leader_index == self.index:
             self._become_leader(
                 self.round_system.next_classic_round(self.index, self.round))
@@ -274,7 +282,11 @@ class HorizontalLeader(Actor):
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
-        if isinstance(message, ClientRequest):
+        if getattr(self, "dead", False):
+            return
+        if isinstance(message, Die):
+            self.dead = True
+        elif isinstance(message, ClientRequest):
             self._handle_client_request(src, message)
         elif isinstance(message, Reconfigure):
             self._handle_reconfigure(src, message)
@@ -524,3 +536,161 @@ class HorizontalClient(Actor):
         pending.resend.stop()
         del self.pending[message.command_id.client_pseudonym]
         pending.callback(message.result)
+
+
+# --- driver-based chaos workloads ------------------------------------------
+# (jvm/.../horizontal/Driver.scala + DriverWorkload.proto: scripted
+# schedules of reconfigurations, forced leader changes, and leader
+# failures, used for the chunk-reconfiguration experiments.)
+
+
+@dataclasses.dataclass(frozen=True)
+class DoNothing:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RepeatedLeaderReconfiguration:
+    """Every ``period_s`` (after ``delay_s``), leader 0 reconfigures to
+    a 2f+1 acceptor subset (DriverWorkload.proto:12-17)."""
+
+    acceptors: tuple
+    delay_s: float
+    period_s: float
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderReconfiguration:
+    """Warmup reconfigurations, then measured ones, then an acceptor
+    failure + recovery (DriverWorkload.proto:19-29)."""
+
+    reconfiguration_warmup_delay_s: float
+    reconfiguration_warmup_period_s: float
+    reconfiguration_warmup_num: int
+    reconfiguration_delay_s: float
+    reconfiguration_period_s: float
+    reconfiguration_num: int
+    failure_delay_s: float
+    recover_delay_s: float
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderFailure:
+    """Forced leader-change warmups, then kill leader 0
+    (DriverWorkload.proto:31-36)."""
+
+    leader_change_warmup_delay_s: float
+    leader_change_warmup_period_s: float
+    leader_change_warmup_num: int
+    failure_delay_s: float
+
+
+DriverWorkload = Union[DoNothing, RepeatedLeaderReconfiguration,
+                       LeaderReconfiguration, LeaderFailure]
+
+
+class HorizontalDriver(Actor):
+    """Executes a scripted chaos schedule against the deployment
+    (Driver.scala:30-312): reconfigure via Reconfigure to leader 0,
+    force leader changes via ForceNoPing to election participants, kill
+    leaders via Die."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: HorizontalConfig,
+                 workload: DriverWorkload, seed: int = 0):
+        super().__init__(address, transport, logger)
+        self.config = config
+        self.workload = workload
+        self.rng = random.Random(seed)
+        self.timers: list = []
+        self._start()
+
+    # --- actions (Driver.scala:130-150) -----------------------------------
+    def reconfigure(self, acceptors=None) -> None:
+        if acceptors is None:
+            n = len(self.config.acceptor_addresses)
+            acceptors = self.rng.sample(range(n), 2 * self.config.f + 1)
+        self.send(self.config.leader_addresses[0], Reconfigure(
+            quorum_system_to_dict(SimpleMajority(acceptors))))
+
+    def become_leader(self, index: int) -> None:
+        from frankenpaxos_tpu.election.basic import ForceNoPing
+
+        self.send(self.config.leader_election_addresses[index],
+                  ForceNoPing())
+
+    def kill_leader(self, index: int) -> None:
+        self.send(self.config.leader_addresses[index], Die())
+
+    # --- schedule wiring (Driver.scala:98-129) -----------------------------
+    def _delayed_repeating(self, name: str, delay_s: float,
+                           period_s: float, n: int, fire,
+                           on_last=None) -> None:
+        from frankenpaxos_tpu.protocols.driver_util import delayed_repeating
+
+        self.timers += delayed_repeating(self, name, delay_s, period_s, n,
+                                         fire, on_last)
+
+    def _start(self) -> None:
+        w = self.workload
+        if isinstance(w, DoNothing):
+            return
+        if isinstance(w, RepeatedLeaderReconfiguration):
+            def fire():
+                self.send(self.config.leader_addresses[0], Reconfigure(
+                    quorum_system_to_dict(SimpleMajority(w.acceptors))))
+                repeat.start()
+
+            repeat = self.timer("reconfigureRepeat", w.period_s, fire)
+            delay = self.timer("reconfigureDelay", w.delay_s, repeat.start)
+            delay.start()
+            self.timers += [delay, repeat]
+            return
+        if isinstance(w, LeaderReconfiguration):
+            self._delayed_repeating(
+                "warmupReconfigure", w.reconfiguration_warmup_delay_s,
+                w.reconfiguration_warmup_period_s,
+                w.reconfiguration_warmup_num,
+                self.reconfigure, self.reconfigure)
+            self._delayed_repeating(
+                "reconfigure", w.reconfiguration_delay_s,
+                w.reconfiguration_period_s, w.reconfiguration_num,
+                self.reconfigure, self.reconfigure)
+            # Failure + recovery: drop to a bare quorum that excludes
+            # acceptor 0 (possible only when spare acceptors exist),
+            # then return to the initial set.
+            n = len(self.config.acceptor_addresses)
+            quorum = 2 * self.config.f + 1
+
+            def fail():
+                if n > quorum:
+                    self.reconfigure(list(range(1, quorum + 1)))
+                else:
+                    self.logger.warn(
+                        "no spare acceptors; failure step skipped")
+
+            def recover():
+                self.reconfigure(list(range(quorum)))
+
+            t_fail = self.timer("failure", w.failure_delay_s, fail)
+            t_recover = self.timer("recover", w.recover_delay_s, recover)
+            t_fail.start()
+            t_recover.start()
+            self.timers += [t_fail, t_recover]
+            return
+        if isinstance(w, LeaderFailure):
+            self._delayed_repeating(
+                "leaderChangeWarmup", w.leader_change_warmup_delay_s,
+                w.leader_change_warmup_period_s,
+                w.leader_change_warmup_num,
+                lambda: self.become_leader(1),
+                lambda: self.become_leader(0))
+            t_fail = self.timer("failure", w.failure_delay_s, lambda: (
+                self.kill_leader(0), self.become_leader(1)))
+            t_fail.start()
+            self.timers.append(t_fail)
+            return
+        self.logger.fatal(f"unknown driver workload {w!r}")
+
+    def receive(self, src: Address, message) -> None:
+        self.logger.fatal(f"driver got unexpected message {message!r}")

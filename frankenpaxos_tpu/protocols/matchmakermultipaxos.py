@@ -1332,3 +1332,172 @@ class MMPClient(Actor):
         pending.resend.stop()
         del self.pending[message.command_id.client_pseudonym]
         pending.callback(message.result)
+
+
+# --- driver-based chaos workloads ------------------------------------------
+# (jvm/.../matchmakermultipaxos/Driver.scala + DriverWorkload.proto: the
+# scripted schedules behind the VLDB'20 matchmaker experiments --
+# repeated acceptor reconfiguration, matchmaker epoch changes, leader
+# failure, and the combined Chaos schedule.)
+
+
+@dataclasses.dataclass(frozen=True)
+class DriverDoNothing:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class DriverRepeatedReconfiguration:
+    """Every ``period_s`` (after ``delay_s``), reconfigure the acceptor
+    set to a random 2f+1 subset (DriverWorkload.proto:14-18)."""
+
+    delay_s: float
+    period_s: float
+
+
+@dataclasses.dataclass(frozen=True)
+class DriverMatchmakerReconfiguration:
+    """Warmup acceptor reconfigurations, then matchmaker epoch changes
+    (DriverWorkload.proto:31-41)."""
+
+    warmup_delay_s: float
+    warmup_period_s: float
+    warmup_num: int
+    matchmaker_delay_s: float
+    matchmaker_period_s: float
+    matchmaker_num: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DriverChaos:
+    """The combined chaos schedule (DriverWorkload.proto:50-66):
+    warmups, then a matchmaker failure and recovery-by-epoch-change,
+    plus an acceptor-set failure and recovery."""
+
+    warmup_delay_s: float
+    warmup_period_s: float
+    warmup_num: int
+    matchmaker_failure_delay_s: float
+    matchmaker_recover_delay_s: float
+    acceptor_failure_delay_s: float
+    acceptor_recover_delay_s: float
+
+
+MMPDriverWorkload = Union[DriverDoNothing, DriverRepeatedReconfiguration,
+                          DriverMatchmakerReconfiguration, DriverChaos]
+
+
+class MMPDriver(Actor):
+    """Executes a scripted chaos schedule against a MatchmakerMultiPaxos
+    deployment (Driver.scala:30+): acceptor reconfigurations via the
+    reconfigurer's Reconfigure broadcast, matchmaker epoch changes via
+    ReconfigureMatchmakers, matchmaker deaths via Die."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: MatchmakerMultiPaxosConfig,
+                 workload: MMPDriverWorkload, seed: int = 0):
+        super().__init__(address, transport, logger)
+        self.config = config
+        self.workload = workload
+        self.rng = random.Random(seed)
+        self.timers: list = []
+        # Last known matchmaker epoch; refreshed by MatchChosen bounces
+        # from the reconfigurer when this falls behind.
+        self.matchmaker_configuration = initial_matchmaker_configuration(
+            config.f)
+        self._killed: set[int] = set()
+        self._start()
+
+    # --- actions -----------------------------------------------------------
+    def reconfigure_acceptors(self) -> None:
+        n = len(self.config.acceptor_addresses)
+        subset = self.rng.sample(range(n), 2 * self.config.f + 1)
+        message = Reconfigure(
+            quorum_system_to_dict(SimpleMajority(subset)))
+        for leader in self.config.leader_addresses:
+            self.send(leader, message)
+
+    def reconfigure_matchmakers(self) -> None:
+        # Never bootstrap an epoch onto a matchmaker this driver killed:
+        # Bootstrap needs every new matchmaker to ack.
+        candidates = [i for i in range(len(
+            self.config.matchmaker_addresses)) if i not in self._killed]
+        needed = 2 * self.config.f + 1
+        if len(candidates) < needed:
+            self.logger.warn(
+                f"only {len(candidates)} live matchmakers; epoch change "
+                f"needs {needed} -- skipped")
+            return
+        subset = sorted(self.rng.sample(candidates, needed))
+        self.send(self.config.reconfigurer_addresses[0],
+                  ReconfigureMatchmakers(
+                      matchmaker_configuration=
+                      self.matchmaker_configuration,
+                      new_matchmaker_indices=tuple(subset)))
+
+    def kill_matchmaker(self, index: int) -> None:
+        self._killed.add(index)
+        self.send(self.config.matchmaker_addresses[index], Die())
+
+    # --- schedule wiring ---------------------------------------------------
+    def _delayed_repeating(self, name: str, delay_s: float,
+                           period_s: float, n: int, fire) -> None:
+        from frankenpaxos_tpu.protocols.driver_util import delayed_repeating
+
+        self.timers += delayed_repeating(self, name, delay_s, period_s, n,
+                                         fire)
+
+    def _once(self, name: str, delay_s: float, fire) -> None:
+        t = self.timer(name, delay_s, fire)
+        t.start()
+        self.timers.append(t)
+
+    def _start(self) -> None:
+        w = self.workload
+        if isinstance(w, DriverDoNothing):
+            return
+        if isinstance(w, DriverRepeatedReconfiguration):
+            def fire():
+                self.reconfigure_acceptors()
+                repeat.start()
+
+            repeat = self.timer("reconfigureRepeat", w.period_s, fire)
+            delay = self.timer("reconfigureDelay", w.delay_s,
+                               repeat.start)
+            delay.start()
+            self.timers += [delay, repeat]
+            return
+        if isinstance(w, DriverMatchmakerReconfiguration):
+            self._delayed_repeating("warmup", w.warmup_delay_s,
+                                    w.warmup_period_s, w.warmup_num,
+                                    self.reconfigure_acceptors)
+            self._delayed_repeating("mmReconfigure", w.matchmaker_delay_s,
+                                    w.matchmaker_period_s,
+                                    w.matchmaker_num,
+                                    self.reconfigure_matchmakers)
+            return
+        if isinstance(w, DriverChaos):
+            self._delayed_repeating("warmup", w.warmup_delay_s,
+                                    w.warmup_period_s, w.warmup_num,
+                                    self.reconfigure_acceptors)
+            self._once("matchmakerFailure", w.matchmaker_failure_delay_s,
+                       lambda: self.kill_matchmaker(
+                           self.rng.randrange(
+                               len(self.config.matchmaker_addresses))))
+            self._once("matchmakerRecover", w.matchmaker_recover_delay_s,
+                       self.reconfigure_matchmakers)
+            self._once("acceptorFailure", w.acceptor_failure_delay_s,
+                       self.reconfigure_acceptors)
+            self._once("acceptorRecover", w.acceptor_recover_delay_s,
+                       self.reconfigure_acceptors)
+            return
+        self.logger.fatal(f"unknown driver workload {w!r}")
+
+    def receive(self, src: Address, message) -> None:
+        if isinstance(message, MatchChosen):
+            # The reconfigurer bounced a stale-epoch request; retry with
+            # the fresh epoch so scheduled churn isn't silently halved.
+            self.matchmaker_configuration = message.value
+            self.reconfigure_matchmakers()
+            return
+        self.logger.fatal(f"driver got unexpected message {message!r}")

@@ -39,6 +39,10 @@ class ScalogConfig:
     leader_addresses: tuple
     acceptor_addresses: tuple
     replica_addresses: tuple
+    # Optional reply fan-out stage (scalog/ProxyReplica.scala): replicas
+    # batch client replies to a proxy replica, which forwards them with
+    # write coalescing. Empty = replicas reply directly.
+    proxy_replica_addresses: tuple = ()
 
     def check_valid(self) -> None:
         if self.f < 1:
@@ -147,6 +151,14 @@ class ClientReply:
     command_id: CommandId
     slot: int
     result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReplyBatch:
+    """A replica's replies from one Chosen batch, routed through a
+    ProxyReplica (scalog/ProxyReplica.scala:130-147)."""
+
+    batch: tuple
 
 
 class ScalogServer(Actor):
@@ -402,10 +414,11 @@ class ScalogReplica(Actor):
             self.logger.fatal(f"unexpected replica message {message!r}")
         for offset, command in enumerate(message.commands):
             self.log.put(message.slot + offset, command)
+        replies: list[ClientReply] = []
         while True:
             command = self.log.get(self.executed_watermark)
             if command is None:
-                return
+                break
             slot = self.executed_watermark
             self.executed_watermark += 1
             cid = command.command_id
@@ -419,9 +432,49 @@ class ScalogReplica(Actor):
                 self.client_table[cid.client_address] = (cid.client_id,
                                                          result)
             if slot % len(self.config.replica_addresses) == self.index:
-                self.send(cid.client_address,
-                          ClientReply(command_id=cid, slot=slot,
-                                      result=result))
+                replies.append(ClientReply(command_id=cid, slot=slot,
+                                           result=result))
+        if not replies:
+            return
+        proxies = self.config.proxy_replica_addresses
+        if proxies:
+            # Route each replica's replies to "its" proxy (the Hash
+            # scheme of ProxyReplica fan-out).
+            self.send(proxies[self.index % len(proxies)],
+                      ClientReplyBatch(batch=tuple(replies)))
+        else:
+            for reply in replies:
+                self.send(reply.command_id.client_address, reply)
+
+
+class ScalogProxyReplica(Actor):
+    """Reply fan-out stage (scalog/ProxyReplica.scala:64-148): forwards
+    a replica's ClientReplyBatch to the clients, coalescing writes per
+    batch."""
+
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, config: ScalogConfig,
+                 batch_flush: bool = True):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.batch_flush = batch_flush
+
+    def receive(self, src: Address, message) -> None:
+        if not isinstance(message, ClientReplyBatch):
+            self.logger.fatal(
+                f"unexpected proxy replica message {message!r}")
+        if not self.batch_flush:
+            for reply in message.batch:
+                self.send(reply.command_id.client_address, reply)
+            return
+        clients = set()
+        for reply in message.batch:
+            client = reply.command_id.client_address
+            clients.add(client)
+            self.send_no_flush(client, reply)
+        for client in clients:
+            self.flush(client)
 
 
 @dataclasses.dataclass

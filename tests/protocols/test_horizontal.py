@@ -117,3 +117,108 @@ def test_simulation_chunk_reconfiguration_no_divergence():
     failure = Simulator(HorizontalSimulated(), run_length=250,
                         num_runs=100).run(seed=0)
     assert failure is None, str(failure)
+
+
+def test_driver_leader_failure_schedule():
+    """HorizontalDriver's LeaderFailure schedule: forced leader-change
+    warmups, then Die to leader 0; writes keep committing via leader 1
+    (jvm/.../horizontal/Driver.scala:249-290)."""
+    from frankenpaxos_tpu.protocols.horizontal import (
+        HorizontalDriver,
+        LeaderFailure,
+    )
+
+    transport, config, leaders, acceptors, replicas, clients = \
+        make_horizontal()
+    driver = HorizontalDriver("driver", transport, logger=leaders[0].logger,
+                              config=config,
+                              workload=LeaderFailure(
+                                  leader_change_warmup_delay_s=1.0,
+                                  leader_change_warmup_period_s=1.0,
+                                  leader_change_warmup_num=2,
+                                  failure_delay_s=5.0))
+    got = []
+    clients[0].write(0, b"before", got.append)
+    transport.deliver_all()
+
+    def fire(name):
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith(name):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+
+    fire("leaderChangeWarmupDelay")
+    fire("leaderChangeWarmupRepeat")   # become_leader(1)
+    fire("leaderChangeWarmupRepeat")   # last: become_leader(0)
+    fire("failure")                    # Die leader 0 + become_leader(1)
+    assert getattr(leaders[0], "dead", False)
+    clients[0].write(0, b"after", got.append)
+    for _ in range(12):
+        if len(got) >= 2:
+            break
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith("resend"):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+    assert len(got) == 2, got
+    logs = [r.state_machine.get() for r in replicas]
+    assert logs[0] == logs[1]
+    assert logs[0][0] == b"before" and logs[0][-1] == b"after"
+
+
+def test_driver_repeated_reconfiguration_schedule():
+    from frankenpaxos_tpu.protocols.horizontal import (
+        HorizontalDriver,
+        RepeatedLeaderReconfiguration,
+    )
+
+    transport, config, leaders, acceptors, replicas, clients = \
+        make_horizontal()
+    HorizontalDriver("driver", transport, logger=leaders[0].logger,
+                     config=config,
+                     workload=RepeatedLeaderReconfiguration(
+                         acceptors=(2, 3, 4), delay_s=1.0, period_s=1.0))
+    got = []
+
+    def fire(name):
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith(name):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+
+    clients[0].write(0, b"w0", got.append)
+    transport.deliver_all()
+    fire("reconfigureDelay")
+    fire("reconfigureRepeat")
+    clients[0].write(0, b"w1", got.append)
+    transport.deliver_all()
+    assert len(got) == 2
+    # The new chunk's quorum system is over acceptors {2, 3, 4}.
+    leader = leaders[0]
+    assert len(leader.chunks) >= 2
+    assert set(leader.chunks[-1].quorum_system.nodes()) == {2, 3, 4}
+
+
+def test_dead_leader_cannot_be_reelected():
+    """Reviewer-found: Die must also disable the election callback, or a
+    killed leader can be re-elected and wedge the cluster."""
+    from frankenpaxos_tpu.protocols.horizontal import Die
+
+    transport, config, leaders, _, replicas, clients = make_horizontal()
+    leaders[0].receive("chaos", Die())
+    assert leaders[0].dead
+    # A (spurious) election back to index 0 must not reactivate it.
+    leaders[0]._on_leader_change(0)
+    assert not leaders[0].active or leaders[0].dead
+    leaders[1]._on_leader_change(1)
+    transport.deliver_all()
+    got = []
+    clients[0].write(0, b"survives", got.append)
+    for _ in range(12):
+        if got:
+            break
+        for timer in transport.running_timers():
+            if timer.name.startswith("resend"):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+    assert got == [b"0"]

@@ -328,3 +328,106 @@ def test_simulation_reconfig_heavy_no_divergence():
     failure = Simulator(MMPReconfigHeavySimulated(), run_length=250,
                         num_runs=150, minimize=False).run(seed=0)
     assert failure is None, str(failure)
+
+
+def test_driver_chaos_schedule():
+    """MMPDriver's Chaos schedule (Driver.scala + DriverWorkload.proto):
+    warmup reconfigurations, a matchmaker death, recovery via a
+    matchmaker epoch change, and acceptor-set churn -- writes keep
+    committing and replicas agree throughout."""
+    from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
+        DriverChaos,
+        MMPDriver,
+    )
+
+    (transport, config, leaders, matchmakers, reconfigurer, acceptors,
+     replicas, clients) = make_mmp(num_acceptors=6, num_matchmakers=5)
+    driver = MMPDriver("driver", transport, logger=leaders[0].logger,
+                       config=config,
+                       workload=DriverChaos(
+                           warmup_delay_s=1.0, warmup_period_s=1.0,
+                           warmup_num=2,
+                           matchmaker_failure_delay_s=2.0,
+                           matchmaker_recover_delay_s=3.0,
+                           acceptor_failure_delay_s=4.0,
+                           acceptor_recover_delay_s=5.0),
+                       seed=5)
+    transport.deliver_all()
+    got = []
+
+    def fire(name):
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith(name):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+
+    def write(payload):
+        clients[0].write(0, payload, got.append)
+        for _ in range(12):
+            for timer in list(transport.running_timers()):
+                if timer.name.startswith("resend"):
+                    transport.trigger_timer(timer.id)
+            transport.deliver_all()
+            if got and got[-1] is not None:
+                break
+
+    write(b"w0")
+    fire("warmupDelay")
+    fire("warmupRepeat")      # acceptor reconfiguration 1
+    write(b"w1")
+    fire("warmupRepeat")      # acceptor reconfiguration 2
+    fire("matchmakerFailure")  # Die a matchmaker
+    write(b"w2")
+    fire("matchmakerRecover")  # matchmaker epoch change
+    write(b"w3")
+    fire("acceptorFailure")
+    fire("acceptorRecover")
+    write(b"w4")
+    assert len(got) == 5, got
+    logs = [r.state_machine.get() for r in replicas]
+    n = min(len(l) for l in logs)
+    assert logs[0][:n] == logs[1][:n]
+    assert logs[0] and logs[0][-1] == b"w4"
+
+
+def test_driver_chaos_minimal_matchmaker_cluster():
+    """Reviewer-found: on a bare 2f+1-matchmaker cluster the driver
+    kills one and must SKIP (not crash on) the epoch change that can no
+    longer form a live 2f+1 epoch."""
+    from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
+        DriverChaos,
+        MMPDriver,
+    )
+
+    (transport, config, leaders, matchmakers, reconfigurer, acceptors,
+     replicas, clients) = make_mmp()  # 3 matchmakers
+    MMPDriver("driver", transport, logger=leaders[0].logger,
+              config=config,
+              workload=DriverChaos(
+                  warmup_delay_s=1.0, warmup_period_s=1.0, warmup_num=1,
+                  matchmaker_failure_delay_s=2.0,
+                  matchmaker_recover_delay_s=3.0,
+                  acceptor_failure_delay_s=4.0,
+                  acceptor_recover_delay_s=5.0), seed=1)
+    transport.deliver_all()
+
+    def fire(name):
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith(name):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+
+    fire("warmupDelay")
+    fire("warmupRepeat")
+    fire("matchmakerFailure")
+    fire("matchmakerRecover")  # must skip gracefully, not ValueError
+    got = []
+    clients[0].write(0, b"alive", got.append)
+    for _ in range(12):
+        if got:
+            break
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith("resend"):
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+    assert got == [b"0"]

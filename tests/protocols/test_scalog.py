@@ -138,3 +138,41 @@ def test_simulation_no_divergence():
     failure = Simulator(ScalogSimulated(), run_length=250,
                         num_runs=100).run(seed=0)
     assert failure is None, str(failure)
+
+
+def test_proxy_replica_fans_out_replies():
+    """Replicas route reply batches through ProxyReplicas
+    (scalog/ProxyReplica.scala:64-148)."""
+    from frankenpaxos_tpu.protocols.scalog import ScalogProxyReplica
+
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    config = ScalogConfig(
+        f=1,
+        server_addresses=(("server-0-0", "server-0-1"),),
+        aggregator_address="aggregator",
+        leader_addresses=("leader-0", "leader-1"),
+        acceptor_addresses=("acceptor-0", "acceptor-1", "acceptor-2"),
+        replica_addresses=("replica-0", "replica-1"),
+        proxy_replica_addresses=("proxy-0", "proxy-1"))
+    servers = [ScalogServer(a, transport, logger, config, push_size=1)
+               for a in config.all_servers()]
+    ScalogAggregator("aggregator", transport, logger, config,
+                     num_shard_cuts_per_proposal=1)
+    [ScalogLeader(a, transport, logger, config)
+     for a in config.leader_addresses]
+    [ScalogAcceptor(a, transport, logger, config)
+     for a in config.acceptor_addresses]
+    replicas = [ScalogReplica(a, transport, logger, config, AppendLog())
+                for a in config.replica_addresses]
+    proxies = [ScalogProxyReplica(a, transport, logger, config)
+               for a in config.proxy_replica_addresses]
+    client = ScalogClient("client-0", transport, logger, config, seed=1)
+    got = []
+    for i in range(4):
+        client.propose(b"w%d" % i, got.append)
+        transport.deliver_all()
+    assert len(got) == 4
+    for replica in replicas:
+        assert replica.state_machine.get() == [b"w%d" % i
+                                               for i in range(4)]
