@@ -43,6 +43,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  config_path: str, config, *, state_machine: str,
                  overrides: "dict[str, str] | None" = None,
                  prometheus: bool = False, supernode: bool = False,
+                 profiled: bool = False,
                  ready_timeout_s: float = 120.0) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
     until each reports it is listening.
@@ -54,6 +55,11 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
 
     With ``supernode=True`` all roles run colocated in ONE process (the
     coupled baseline, SuperNode.scala:22+).
+
+    With ``profiled=True`` every role runs under cProfile (the
+    benchmarks/perf_util.py:37 perf-wrap analog for Python roles); the
+    role's SIGTERM handler exits cleanly so ``{label}.prof`` dumps at
+    kill time -- render it with ``write_profile_reports``.
     """
     protocol = get_protocol(protocol_name)
     host = LocalHost()
@@ -72,11 +78,14 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     for role_name, index in launch_plan:
         label = f"{role_name}_{index}"
         labels.append(label)
-        cmd = [sys.executable, "-m", "frankenpaxos_tpu.cli",
-               "--protocol", protocol_name, "--role", role_name,
-               "--index", str(index), "--config", config_path,
-               "--state_machine", state_machine,
-               "--seed", str(index)]
+        cmd = [sys.executable]
+        if profiled:
+            cmd += ["-m", "cProfile", "-o", bench.abspath(f"{label}.prof")]
+        cmd += ["-m", "frankenpaxos_tpu.cli",
+                "--protocol", protocol_name, "--role", role_name,
+                "--index", str(index), "--config", config_path,
+                "--state_machine", state_machine,
+                "--seed", str(index)]
         if prometheus:
             prometheus_ports[label] = free_port()
             cmd += ["--prometheus_port",
@@ -169,3 +178,29 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
         "ready_s": round(ready_s, 3),
         "latency_ms": [round(x * 1000, 3) for x in latencies],
     }
+
+
+def write_profile_reports(bench: BenchmarkDirectory,
+                          top: int = 25) -> "dict[str, str]":
+    """Render each role's cProfile dump (from ``profiled=True``) to a
+    cumulative-time text report, the flamegraph-summary analog of
+    benchmarks/perf_util.py. Returns {label: report_path}."""
+    import glob
+    import io
+    import pstats
+
+    reports = {}
+    for prof in glob.glob(bench.abspath("*.prof")):
+        label = os.path.basename(prof)[:-len(".prof")]
+        out = io.StringIO()
+        try:
+            stats = pstats.Stats(prof, stream=out)
+        except Exception as e:  # noqa: BLE001 - truncated dump (SIGKILL)
+            print(f"skipping unreadable profile {prof}: {e!r}")
+            continue
+        stats.sort_stats("cumulative").print_stats(top)
+        path = bench.abspath(f"{label}.profile.txt")
+        with open(path, "w") as f:
+            f.write(out.getvalue())
+        reports[label] = path
+    return reports

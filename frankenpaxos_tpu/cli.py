@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, DeployCtx, get_protocol
@@ -168,6 +169,12 @@ def main(argv=None) -> None:
 
     logger.info(f"{args.protocol} {args.role} {args.index} "
                 f"listening on {address}")
+    # Exit cleanly on SIGTERM so wrappers that dump state at interpreter
+    # exit (cProfile's -m runner, the perf_util.py:37 analog) get to
+    # write their output when the harness kills the role.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     try:
         while True:
             time.sleep(3600)

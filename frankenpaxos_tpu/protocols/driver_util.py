@@ -29,3 +29,17 @@ def delayed_repeating(actor, name: str, delay_s: float, period_s: float,
     delay = actor.timer(f"{name}Delay", delay_s, repeat.start)
     delay.start()
     return [delay, repeat]
+
+
+def repeating(actor, name: str, delay_s: float, period_s: float,
+              fire: Callable[[], None]) -> list:
+    """After ``delay_s``, fire every ``period_s`` forever. Returns the
+    created timers."""
+    def tick():
+        fire()
+        repeat.start()
+
+    repeat = actor.timer(f"{name}Repeat", period_s, tick)
+    delay = actor.timer(f"{name}Delay", delay_s, repeat.start)
+    delay.start()
+    return [delay, repeat]

@@ -636,15 +636,14 @@ class HorizontalDriver(Actor):
         if isinstance(w, DoNothing):
             return
         if isinstance(w, RepeatedLeaderReconfiguration):
-            def fire():
-                self.send(self.config.leader_addresses[0], Reconfigure(
-                    quorum_system_to_dict(SimpleMajority(w.acceptors))))
-                repeat.start()
+            from frankenpaxos_tpu.protocols.driver_util import repeating
 
-            repeat = self.timer("reconfigureRepeat", w.period_s, fire)
-            delay = self.timer("reconfigureDelay", w.delay_s, repeat.start)
-            delay.start()
-            self.timers += [delay, repeat]
+            self.timers += repeating(
+                self, "reconfigure", w.delay_s, w.period_s,
+                lambda: self.send(
+                    self.config.leader_addresses[0],
+                    Reconfigure(quorum_system_to_dict(
+                        SimpleMajority(w.acceptors)))))
             return
         if isinstance(w, LeaderReconfiguration):
             self._delayed_repeating(

@@ -1457,15 +1457,11 @@ class MMPDriver(Actor):
         if isinstance(w, DriverDoNothing):
             return
         if isinstance(w, DriverRepeatedReconfiguration):
-            def fire():
-                self.reconfigure_acceptors()
-                repeat.start()
+            from frankenpaxos_tpu.protocols.driver_util import repeating
 
-            repeat = self.timer("reconfigureRepeat", w.period_s, fire)
-            delay = self.timer("reconfigureDelay", w.delay_s,
-                               repeat.start)
-            delay.start()
-            self.timers += [delay, repeat]
+            self.timers += repeating(self, "reconfigure", w.delay_s,
+                                     w.period_s,
+                                     self.reconfigure_acceptors)
             return
         if isinstance(w, DriverMatchmakerReconfiguration):
             self._delayed_repeating("warmup", w.warmup_delay_s,
@@ -1481,9 +1477,9 @@ class MMPDriver(Actor):
                                     w.warmup_period_s, w.warmup_num,
                                     self.reconfigure_acceptors)
             self._once("matchmakerFailure", w.matchmaker_failure_delay_s,
-                       lambda: self.kill_matchmaker(
-                           self.rng.randrange(
-                               len(self.config.matchmaker_addresses))))
+                       lambda: self.kill_matchmaker(self.rng.choice(
+                           self.matchmaker_configuration
+                           .matchmaker_indices)))
             self._once("matchmakerRecover", w.matchmaker_recover_delay_s,
                        self.reconfigure_matchmakers)
             self._once("acceptorFailure", w.acceptor_failure_delay_s,

@@ -101,3 +101,42 @@ def test_lt_suite_sim_transport_dict():
 
     rate = sim_transport_cmds_per_sec("dict", num_commands=50)
     assert rate > 10
+
+
+def test_profiled_roles_dump_profiles():
+    """profiled=True wraps each role in cProfile (the perf_util.py:37
+    analog); SIGTERM-killed roles still dump, and reports render."""
+    import threading
+
+    from frankenpaxos_tpu.bench.deploy_suite import (
+        launch_roles,
+        write_profile_reports,
+    )
+    from frankenpaxos_tpu.bench.harness import free_port
+    from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+    proto = get_protocol("paxos")
+    bench = BenchmarkDirectory(tempfile.mkdtemp(prefix="fpx_prof_") + "/b")
+    raw = proto.cluster(1, lambda: ["127.0.0.1", free_port()])
+    path = bench.write_json("config.json", raw)
+    config = proto.load_config(raw)
+    launch_roles(bench, "paxos", path, config, state_machine="AppendLog",
+                 profiled=True)
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = TcpTransport(("127.0.0.1", free_port()), logger)
+    transport.start()
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides={"repropose_period_s": "0.5"}, seed=9)
+    client = proto.make_client(ctx, transport.listen_address)
+    done = threading.Event()
+    transport.loop.call_soon_threadsafe(proto.drive, client, 0,
+                                        lambda *_: done.set())
+    assert done.wait(20)
+    transport.stop()
+    bench.cleanup()  # SIGTERM -> clean exit -> cProfile dumps
+    reports = write_profile_reports(bench)
+    assert len(reports) == 5  # 2 leaders + 3 acceptors
+    sample = open(next(iter(reports.values()))).read()
+    assert "cumulative" in sample and "function calls" in sample
