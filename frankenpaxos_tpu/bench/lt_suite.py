@@ -74,10 +74,11 @@ def main(argv=None) -> dict:
     parser.add_argument("--duration", type=float, default=3.0)
     parser.add_argument("--scales", type=str, default="1x5,2x10,4x10",
                         help="comma-separated client_procs x loops points")
-    parser.add_argument("--tpu_scales", type=str, default="2x10",
+    parser.add_argument("--tpu_scales", type=str, default="1x4",
                         help="sweep points to also run with the tpu "
                              "backend (each device drain pays the "
-                             "tunnel RTT; keep this small)")
+                             "tunnel RTT; keep the load small enough "
+                             "that ops complete within it)")
     parser.add_argument("--sim_commands", type=int, default=300)
     parser.add_argument("--suite_dir", default=None)
     parser.add_argument("--out", default=None)
@@ -101,10 +102,17 @@ def main(argv=None) -> dict:
             stats = run_benchmark(
                 suite.benchmark_directory(),
                 MultiPaxosInput(num_clients=loops, client_procs=procs,
-                                duration_s=args.duration,
-                                quorum_backend=backend))
+                                # The tpu point needs a longer window
+                                # (first drains pay kernel compiles over
+                                # the device link) and pipelined drains.
+                                duration_s=(args.duration
+                                            if backend == "dict"
+                                            else max(args.duration, 15.0)),
+                                quorum_backend=backend,
+                                tpu_pipelined=(backend == "tpu")))
             point = {
                 "quorum_backend": backend,
+                "tpu_pipelined": backend == "tpu",
                 "client_procs": procs,
                 "loops_per_proc": loops,
                 "throughput_p90_1s": stats.get("start_throughput_1s.p90"),

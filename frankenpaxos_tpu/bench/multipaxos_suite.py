@@ -33,6 +33,9 @@ class MultiPaxosInput:
     num_clients: int = 2
     duration_s: float = 2.0
     quorum_backend: str = "dict"
+    # Pipelined device drains for the tpu backend (hide the device-link
+    # RTT behind the event loop; see ProxyLeaderOptions.tpu_pipelined).
+    tpu_pipelined: bool = False
     state_machine: str = "KeyValueStore"
     # A ReadWriteWorkload (bench/workload.py); None -> the legacy
     # write-only SetRequest loop.
@@ -81,10 +84,17 @@ def run_benchmark(bench: BenchmarkDirectory,
     config_raw = placement(input)
     config_path = bench.write_json("config.json", config_raw)
     config = get_protocol("multipaxos").load_config(config_raw)
+    overrides = {"quorum_backend": input.quorum_backend}
+    if input.tpu_pipelined:
+        overrides["tpu_pipelined"] = "true"
     launch_roles(bench, "multipaxos", config_path, config,
                  state_machine=input.state_machine,
-                 overrides={"quorum_backend": input.quorum_backend},
-                 prometheus=input.prometheus, supernode=input.supernode)
+                 overrides=overrides,
+                 prometheus=input.prometheus, supernode=input.supernode,
+                 # tpu role startup pre-compiles kernels over the
+                 # device link, which takes minutes under contention.
+                 ready_timeout_s=(120.0 if input.quorum_backend == "dict"
+                                  else 300.0))
     serializer = PickleSerializer()
 
     # Explicit leader-ready probe: a warmup write with a short resend
@@ -94,9 +104,14 @@ def run_benchmark(bench: BenchmarkDirectory,
     probe_logger = FakeLogger(LogLevel.FATAL)
     probe_transport = TcpTransport(("127.0.0.1", free_port()), probe_logger)
     probe_transport.start()
+    # A gentle resend for the tpu backend: rapid duplicate requests
+    # during its first (compile-paying) drains each get proposed to a
+    # fresh slot, snowballing the very backlog the probe waits on.
+    probe_resend_s = 0.25 if input.quorum_backend == "dict" else 2.0
     probe = Client(probe_transport.listen_address, probe_transport,
                    probe_logger, config,
-                   ClientOptions(resend_client_request_period_s=0.25),
+                   ClientOptions(
+                       resend_client_request_period_s=probe_resend_s),
                    seed=0xBEEF)
     ready = threading.Event()
     probe_transport.loop.call_soon_threadsafe(

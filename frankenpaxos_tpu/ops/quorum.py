@@ -235,11 +235,16 @@ class TpuQuorumChecker:
         self._masks_t, self._meta = _spec_statics(spec)
         self.board = make_vote_board(window, spec.num_nodes)
 
-    def record_block(self, start_slot: int, block: np.ndarray,
-                     vote_round: int = 0) -> np.ndarray:
-        """Dense path: record ``block[n, B]`` arrivals for slots
-        ``[start_slot, start_slot + B)`` (must not straddle the ring end);
-        return the ``[B]`` newly-chosen mask."""
+    def record_block_async(self, start_slot: int, block: np.ndarray,
+                           vote_round: int = 0) -> jax.Array:
+        """Like :meth:`record_block` but returns the DEVICE newly-chosen
+        mask without waiting -- callers overlap the device round-trip
+        with host work and fetch later (np.asarray).
+
+        The returned array keeps the PADDED bucket length (entries past
+        the input width are padding) -- slicing it on device would
+        dispatch a fresh variable-shape executable per width; slice on
+        the host after fetching instead."""
         n, b = block.shape
         if n != self.num_nodes:
             raise ValueError(f"block has {n} acceptor rows, spec has "
@@ -249,10 +254,6 @@ class TpuQuorumChecker:
             raise ValueError(
                 f"block [{start}, {start + b}) straddles the ring end "
                 f"(window {self.window}); split it")
-        # Bucket the width to powers of two so variable drain sizes
-        # compile O(log max_width) kernels, not one per width (the same
-        # plan as record_and_check's pad_to). Padding columns are
-        # all-zero, which the kernel leaves untouched.
         padded = 64
         while padded < b:
             padded *= 2
@@ -265,22 +266,32 @@ class TpuQuorumChecker:
         self.board, newly = _record_block(
             self.board, jnp.int32(start), jnp.asarray(block, dtype=jnp.uint8),
             jnp.int32(vote_round), padded, self._masks_t, self._meta)
-        return np.asarray(newly)[:b]
+        return newly
 
-    def record_and_check(
+    def record_block(self, start_slot: int, block: np.ndarray,
+                     vote_round: int = 0) -> np.ndarray:
+        """Dense path: record ``block[n, B]`` arrivals for slots
+        ``[start_slot, start_slot + B)`` (must not straddle the ring end);
+        return the ``[B]`` newly-chosen mask.
+
+        Widths are bucketed to powers of two so variable drain sizes
+        compile O(log max_width) kernels, not one per width. Padding
+        columns are all-zero, which the kernel leaves untouched.
+        """
+        b = block.shape[1]
+        return np.asarray(self.record_block_async(start_slot, block,
+                                                  vote_round))[:b]
+
+    def record_and_check_async(
         self,
         slots: Sequence[int] | np.ndarray,
         node_cols: Sequence[int] | np.ndarray,
         rounds: Sequence[int] | np.ndarray | None = None,
         pad_to: int | None = None,
-    ) -> np.ndarray:
-        """Sparse path: record out-of-order votes; return per-vote "slot
-        newly has quorum".
-
-        Duplicate slots in one batch each report quorum; callers dedup
-        (the host side keeps the small pending-slot dict, as ProxyLeader
-        keeps `states`, ProxyLeader.scala:135).
-        """
+    ) -> jax.Array:
+        """Like :meth:`record_and_check` but returns the DEVICE per-vote
+        mask without waiting. The returned array keeps the PADDED batch
+        length (see :meth:`record_block_async`); slice on the host."""
         slots = np.asarray(slots, dtype=np.int32)
         b = slots.shape[0]
         if rounds is None:
@@ -304,7 +315,25 @@ class TpuQuorumChecker:
             self.board, jnp.asarray(slots_p), jnp.asarray(nodes_p),
             jnp.asarray(rounds_p), jnp.asarray(valid),
             self._masks_t, self._meta)
-        return np.asarray(newly)[:b]
+        return newly
+
+    def record_and_check(
+        self,
+        slots: Sequence[int] | np.ndarray,
+        node_cols: Sequence[int] | np.ndarray,
+        rounds: Sequence[int] | np.ndarray | None = None,
+        pad_to: int | None = None,
+    ) -> np.ndarray:
+        """Sparse path: record out-of-order votes; return per-vote "slot
+        newly has quorum".
+
+        Duplicate slots in one batch each report quorum; callers dedup
+        (the host side keeps the small pending-slot dict, as ProxyLeader
+        keeps `states`, ProxyLeader.scala:135).
+        """
+        b = np.asarray(slots).shape[0]
+        return np.asarray(self.record_and_check_async(
+            slots, node_cols, rounds, pad_to))[:b]
 
     def release(self, slots: Sequence[int] | np.ndarray) -> None:
         """GC slot columns below the chosen watermark so the ring can wrap."""

@@ -39,6 +39,12 @@ class ProxyLeaderOptions:
     # "dict" (host oracle) or "tpu" (batched vote board).
     quorum_backend: str = "dict"
     tpu_window: int = 1 << 20
+    # Pipelined device drains: dispatch this drain's votes async and
+    # emit the PREVIOUS drain's results, hiding the device-link RTT
+    # behind the event loop (one drain of extra choose latency). A
+    # flush timer collects the final dispatch during quiescence.
+    tpu_pipelined: bool = False
+    tpu_flush_period_s: float = 0.005
 
 
 class ProxyLeader(Actor):
@@ -65,9 +71,34 @@ class ProxyLeader(Actor):
         self._unflushed_phase2as = 0
         if options.quorum_backend == "tpu":
             self.tracker: QuorumTracker = TpuQuorumTracker(
-                config, window=options.tpu_window)
+                config, window=options.tpu_window,
+                pipelined=options.tpu_pipelined)
         else:
             self.tracker = DictQuorumTracker(config)
+        self._flush_timer = None
+        self._collector = None
+        if options.quorum_backend == "tpu" and options.tpu_pipelined:
+            loop = getattr(transport, "loop", None)
+            if loop is not None:
+                # Real transport: fetch device results on ONE worker
+                # thread (preserving dispatch order) and post each
+                # completion back onto the event loop, so the loop never
+                # blocks on the device link.
+                import concurrent.futures
+
+                self._collector = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tpu-collect")
+            else:
+                # SimTransport: a flush timer collects synchronously
+                # (tests fire it explicitly).
+                def flush_pending():
+                    self._collect_all()
+                    if self.tracker.has_pending():
+                        self._flush_timer.start()
+
+                self._flush_timer = self.timer(
+                    "tpuDrainFlush", options.tpu_flush_period_s,
+                    flush_pending)
 
     def receive(self, src: Address, message) -> None:
         # timed(label) handler latency summaries (Leader.scala:281-293).
@@ -132,7 +163,43 @@ class ProxyLeader(Actor):
                             phase2b.group_index, phase2b.acceptor_index)
 
     def on_drain(self) -> None:
-        for key in self.tracker.drain():
+        self._emit_chosen(self.tracker.drain())
+        if self._collector is not None:
+            while True:
+                dispatch = self.tracker.take_dispatch()
+                if dispatch is None:
+                    break
+                self._collector.submit(self._collect_and_post, dispatch)
+        elif self._flush_timer is not None:
+            # (Re)arm the quiescence flush while a dispatch is in
+            # flight; the timer collects it if no further messages come.
+            self._flush_timer.stop()
+            if self.tracker.has_pending():
+                self._flush_timer.start()
+
+    def _collect_and_post(self, dispatch) -> None:
+        """Runs on the collector thread: block on the device fetch, then
+        hand the results back to the single-threaded event loop."""
+        try:
+            results = self.tracker.collect(dispatch)
+        except Exception as e:  # noqa: BLE001 - surface, don't swallow
+            # A swallowed collector error would silently drop this
+            # dispatch's Chosen broadcasts and wedge its clients.
+            self.logger.error(f"tpu collect failed: {e!r}")
+            return
+        if results:
+            self.transport.loop.call_soon_threadsafe(
+                self._emit_chosen, results)
+
+    def _collect_all(self) -> None:
+        while True:
+            dispatch = self.tracker.take_dispatch()
+            if dispatch is None:
+                return
+            self._emit_chosen(self.tracker.collect(dispatch))
+
+    def _emit_chosen(self, keys) -> None:
+        for key in keys:
             value = self.pending.pop(key, None)
             if value is None:
                 continue

@@ -74,8 +74,25 @@ class DictQuorumTracker(QuorumTracker):
 
 
 class TpuQuorumTracker(QuorumTracker):
-    def __init__(self, config: MultiPaxosConfig, window: int = 1 << 20):
+    """``pipelined=True`` decouples device round-trips from the event
+    loop: each drain DISPATCHES its votes asynchronously (returning [])
+    and enqueues an in-flight record; the caller collects completed
+    dispatches via :meth:`take_dispatch` + :meth:`collect` -- from a
+    worker thread (ProxyLeader posts results back onto the event loop)
+    or a flush timer. This hides the device-link latency behind the
+    event loop -- essential when the accelerator sits across a high-RTT
+    link -- at the cost of one dispatch of added choose latency."""
+
+    def __init__(self, config: MultiPaxosConfig, window: int = 1 << 20,
+                 pipelined: bool = False):
+        import collections
+
         self.config = config
+        self.pipelined = pipelined
+        # In-flight dispatches: (slots, rounds, device per-vote masks).
+        # append/popleft are GIL-atomic, so a collector thread may pop
+        # while the event loop appends.
+        self._inflight = collections.deque()
         self._row_size = len(config.acceptor_addresses[0])
         num_cols = config.num_acceptor_groups * self._row_size
         universe = tuple(range(num_cols))
@@ -96,6 +113,24 @@ class TpuQuorumTracker(QuorumTracker):
         self._slots: list[int] = []
         self._cols: list[int] = []
         self._rounds: list[int] = []
+        # Pre-compile the smallest (64-wide) dense and sparse kernels at
+        # construction -- before client traffic -- so the first real
+        # drains don't stall several seconds on XLA compiles. Votes land
+        # at round -1 (below any real round), and release() clears the
+        # touched columns.
+        # Max columns per device call: oversized drains are chunked to
+        # this, so ONLY the prewarmed kernel buckets (64, max_chunk)
+        # ever compile -- an unexpected width compiling mid-run stalls
+        # the event loop for seconds over a remote device link.
+        self.max_chunk = 256
+        for width in (1, self.max_chunk):
+            warm = np.zeros((self.checker.num_nodes, width),
+                            dtype=np.uint8)
+            warm[0, 0] = 1
+            self.checker.record_block(0, warm, vote_round=-1)
+            self.checker.record_and_check([0] * width, [0] * width,
+                                          [-1] * width)
+        self.checker.release(np.arange(self.max_chunk))
 
     def record(self, slot, round, group_index, acceptor_index) -> None:
         self._slots.append(slot)
@@ -116,7 +151,7 @@ class TpuQuorumTracker(QuorumTracker):
         slots = np.asarray(self._slots, dtype=np.int64)
         cols = np.asarray(self._cols, dtype=np.int32)
         rounds = np.asarray(self._rounds, dtype=np.int32)
-        hits = np.zeros(slots.shape[0], dtype=bool)
+        device_parts = []  # (index array into this drain, device mask)
 
         # Dense candidate: the drain's dominant round.
         round_values, round_counts = np.unique(rounds, return_counts=True)
@@ -126,27 +161,68 @@ class TpuQuorumTracker(QuorumTracker):
         hi = int(slots[dense].max())
         width = hi - lo + 1
         window = self.checker.window
-        # Worth the dense path when the run is reasonably filled and
-        # doesn't straddle the ring end (record_block's contract).
-        if (width <= max(64, 4 * int(dense.sum()))
-                and lo % window + width <= window):
-            block = np.zeros((self.checker.num_nodes, width),
+        # Worth the dense path when the run is reasonably filled, fits a
+        # prewarmed kernel bucket, and doesn't straddle the ring end
+        # (record_block's contract).
+        bucket = 64 if width <= 64 else self.max_chunk
+        if (width <= min(self.max_chunk, max(64, 4 * int(dense.sum())))
+                and lo % window + bucket <= window):
+            # Build the block at the prewarmed bucket width directly
+            # (all-zero padding columns are untouched by the kernel).
+            block = np.zeros((self.checker.num_nodes, bucket),
                              dtype=np.uint8)
             block[cols[dense], slots[dense] - lo] = 1
-            newly = self.checker.record_block(lo, block, vote_round=dom)
-            hits[dense] = newly[slots[dense] - lo]
+            newly = self.checker.record_block_async(lo, block,
+                                                    vote_round=dom)
+            # Device results stay at the padded bucket shape; the
+            # per-vote positions are applied host-side in collect() (a
+            # device gather here would compile per distinct length).
+            device_parts.append((np.flatnonzero(dense), newly,
+                                 slots[dense] - lo))
             rest = ~dense
         else:
             rest = np.ones(slots.shape[0], dtype=bool)
-        if rest.any():
-            hits[rest] = self.checker.record_and_check(
-                slots[rest], cols[rest], rounds[rest])
+        rest_index = np.flatnonzero(rest)
+        # Chunk the sparse tail so only prewarmed buckets ever run.
+        for at in range(0, rest_index.size, self.max_chunk):
+            chunk = rest_index[at:at + self.max_chunk]
+            device_parts.append((chunk,
+                                 self.checker.record_and_check_async(
+                                     slots[chunk], cols[chunk],
+                                     rounds[chunk],
+                                     pad_to=(64 if chunk.size <= 64
+                                             else self.max_chunk)),
+                                 np.arange(chunk.size)))
 
+        dispatch = (self._slots, self._rounds, device_parts)
+        self._slots, self._cols, self._rounds = [], [], []
+        if self.pipelined:
+            self._inflight.append(dispatch)
+            return []
+        return self.collect(dispatch)
+
+    def has_pending(self) -> bool:
+        return bool(self._inflight)
+
+    def take_dispatch(self):
+        """Pop the oldest in-flight dispatch (None if empty); pass it to
+        :meth:`collect`. Safe to call from a collector thread."""
+        try:
+            return self._inflight.popleft()
+        except IndexError:
+            return None
+
+    def collect(self, dispatch) -> list[tuple[int, int]]:
+        """Fetch a dispatch's results (blocking on the device if they
+        are not done yet) and dedup per slot."""
+        drain_slots, drain_rounds, device_parts = dispatch
+        hits = np.zeros(len(drain_slots), dtype=bool)
+        for index, mask, positions in device_parts:
+            hits[index] = np.asarray(mask)[positions]
         out: list[tuple[int, int]] = []
         seen: set[int] = set()
-        for slot, round, hit in zip(self._slots, self._rounds, hits):
+        for slot, round, hit in zip(drain_slots, drain_rounds, hits):
             if hit and slot not in seen:
                 seen.add(slot)
                 out.append((slot, round))
-        self._slots, self._cols, self._rounds = [], [], []
         return out

@@ -103,6 +103,7 @@ class TcpTransport(Transport):
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._conns: dict[tuple[Address, Address], _Conn] = {}
         self._servers: dict[Address, asyncio.AbstractServer] = {}
+        self._drain_scheduled: set = set()
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
 
@@ -190,10 +191,21 @@ class TcpTransport(Transport):
             actor = self.actors.get(self.listen_address)
         if actor is not None:
             actor.receive(src, actor.serializer.from_bytes(data))
-            actor.on_drain()
+            # Defer on_drain to the end of this event-loop pass so every
+            # frame already buffered (a burst of Phase2bs) lands in ONE
+            # drain -- the batching the device kernels amortize over
+            # (the reference's event loop drains similarly: all readable
+            # frames, then flush).
+            if actor not in self._drain_scheduled:
+                self._drain_scheduled.add(actor)
+                self.loop.call_soon(self._drain_actor, actor)
             return
         self.logger.warn(f"dropping frame from {src} to {local}: "
                          f"no registered actor")
+
+    def _drain_actor(self, actor: Actor) -> None:
+        self._drain_scheduled.discard(actor)
+        actor.on_drain()
 
     def listen_on(self, address: Address) -> None:
         """Bind a listener for ``address`` ahead of actor registration

@@ -57,6 +57,7 @@ def make_multipaxos(
     grid_shape: tuple[int, int] | None = None,
     batch_size: int = 1,
     quorum_backend: str = "dict",
+    tpu_pipelined: bool = False,
     phase1_backend: str = "host",
     state_machine_factory=AppendLog,
     seed: int = 0,
@@ -108,7 +109,8 @@ def make_multipaxos(
     proxy_leaders = [
         ProxyLeader(a, transport, logger, config,
                     ProxyLeaderOptions(quorum_backend=quorum_backend,
-                                       tpu_window=1 << 12),
+                                       tpu_window=1 << 12,
+                                       tpu_pipelined=tpu_pipelined),
                     seed=seed + 10 + i)
         for i, a in enumerate(config.proxy_leader_addresses)]
     acceptors = [
