@@ -39,10 +39,53 @@ from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
 from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
 
 
+
+def _closed_loops(transport, num_loops: int, duration_s: float,
+                  warmup_s: float, issue_op) -> list:
+    """Shared closed-loop machinery: run ``num_loops`` callback-chained
+    loops on the transport's event loop for ``duration_s`` (after a
+    ``warmup_s`` settling window), recording one row per completed op.
+
+    ``issue_op(i, finished)`` issues loop ``i``'s next op and arranges
+    for ``finished(kind)`` on completion. Reissues are rescheduled via
+    call_soon rather than recursed: a protocol that answers
+    synchronously (an already-chosen single-decree value) would
+    otherwise blow the stack.
+    """
+    rows: list = []
+    done = threading.Event()
+    stop_at = time.time() + warmup_s + duration_s
+    measure_from = time.time() + warmup_s
+    live = {"count": num_loops}
+
+    def issue(i: int) -> None:
+        now = time.time()
+        if now >= stop_at:
+            live["count"] -= 1
+            if live["count"] == 0:
+                done.set()
+            return
+        t0 = time.perf_counter()
+
+        def finished(kind: str) -> None:
+            if now >= measure_from:
+                rows.append((kind, now, time.perf_counter() - t0))
+            transport.loop.call_soon(issue, i)
+
+        issue_op(i, finished)
+
+    for i in range(num_loops):
+        transport.loop.call_soon_threadsafe(issue, i)
+    done.wait(timeout=warmup_s + duration_s + 30)
+    transport.stop()
+    return rows
+
+
 def run(protocol_name: str, config_raw: dict, workload, *,
         num_clients: int, duration_s: float, read_consistency: str,
         seed: int = 0, warmup_s: float = 0.25) -> list:
-    """Drive the workload; returns [(kind, start_unix_s, latency_s)]."""
+    """Drive the workload against multipaxos (pseudonym-keyed write/read
+    client loops); returns [(kind, start_unix_s, latency_s)]."""
     protocol = get_protocol(protocol_name)
     config = protocol.load_config(config_raw)
     logger = FakeLogger(LogLevel.FATAL)
@@ -52,38 +95,49 @@ def run(protocol_name: str, config_raw: dict, workload, *,
                     overrides={}, seed=seed)
     client = protocol.make_client(ctx, transport.listen_address)
     read_method = READ_METHODS[read_consistency]
+    rngs = [random.Random((seed << 20) + p) for p in range(num_clients)]
 
-    rows: list = []
-    done = threading.Event()
-    stop_at = time.time() + warmup_s + duration_s
-    measure_from = time.time() + warmup_s
-    live = {"count": num_clients}
-
-    def issue(pseudonym: int, rng: random.Random) -> None:
-        now = time.time()
-        if now >= stop_at:
-            live["count"] -= 1
-            if live["count"] == 0:
-                done.set()
-            return
-        kind, command = workload.get(rng)
+    def issue_op(pseudonym: int, finished) -> None:
+        kind, command = workload.get(rngs[pseudonym])
         op = (client.write if kind == WRITE
               else getattr(client, read_method))
-        t0 = time.perf_counter()
+        op(pseudonym, command, lambda _reply: finished(kind))
 
-        def finished(_reply) -> None:
-            if now >= measure_from:
-                rows.append((kind, now, time.perf_counter() - t0))
-            issue(pseudonym, rng)
+    return _closed_loops(transport, num_clients, duration_s, warmup_s,
+                         issue_op)
 
-        op(pseudonym, command, finished)
 
-    for pseudonym in range(num_clients):
-        rng = random.Random((seed << 20) + pseudonym)
-        transport.loop.call_soon_threadsafe(issue, pseudonym, rng)
-    done.wait(timeout=warmup_s + duration_s + 30)
-    transport.stop()
-    return rows
+def run_drive(protocol_name: str, config_raw: dict, *,
+              num_clients: int, duration_s: float, seed: int = 0,
+              warmup_s: float = 0.25) -> list:
+    """Protocol-agnostic closed loops: one client actor per loop (each
+    on its own port via the transport's multi-bind), driven through the
+    registry's ``drive`` entry -- works for every protocol the smoke
+    deploys. Returns [("write", start_unix_s, latency_s)]."""
+    protocol = get_protocol(protocol_name)
+    config = protocol.load_config(config_raw)
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = TcpTransport(("127.0.0.1", free_port()), logger)
+    transport.start()
+    clients = []
+    for i in range(num_clients):
+        ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                        overrides={"resend_period_s": "1.0",
+                                   "repropose_period_s": "1.0"},
+                        seed=(seed << 8) + i)
+        address = (transport.listen_address if i == 0
+                   else ("127.0.0.1", free_port()))
+        clients.append(protocol.make_client(ctx, address))
+
+    tags = {"next": 0}
+
+    def issue_op(i: int, finished) -> None:
+        tag = tags["next"]
+        tags["next"] += 1
+        protocol.drive(clients[i], tag, lambda *_reply: finished("write"))
+
+    return _closed_loops(transport, num_clients, duration_s, warmup_s,
+                         issue_op)
 
 
 def main(argv=None) -> None:
@@ -101,13 +155,22 @@ def main(argv=None) -> None:
 
     with open(args.config) as f:
         config_raw = json.load(f)
-    workload = (workload_from_dict(json.loads(args.workload))
-                if args.workload
-                else WriteOnlyWorkload(StringWorkload(size_mean=8)))
 
-    rows = run(args.protocol, config_raw, workload,
-               num_clients=args.num_clients, duration_s=args.duration,
-               read_consistency=args.read_consistency, seed=args.seed)
+    if args.protocol != "multipaxos" and args.workload is None:
+        # Generic closed loops via the registry's drive() -- any
+        # protocol the smoke can deploy can be benchmarked.
+        rows = run_drive(args.protocol, config_raw,
+                         num_clients=args.num_clients,
+                         duration_s=args.duration, seed=args.seed)
+    else:
+        workload = (workload_from_dict(json.loads(args.workload))
+                    if args.workload
+                    else WriteOnlyWorkload(StringWorkload(size_mean=8)))
+        rows = run(args.protocol, config_raw, workload,
+                   num_clients=args.num_clients,
+                   duration_s=args.duration,
+                   read_consistency=args.read_consistency,
+                   seed=args.seed)
     with open(args.out, "w") as f:
         f.write("kind,start_unix_s,latency_s\n")
         for kind, start, latency in rows:

@@ -99,15 +99,14 @@ def main(argv=None) -> dict:
         scales = parse_scales(args.scales if backend == "dict"
                               else args.tpu_scales)
         for procs, loops in scales:
+            # The tpu point needs a longer window (first drains pay
+            # kernel compiles over the device link) + pipelined drains.
+            point_duration = (args.duration if backend == "dict"
+                              else max(args.duration, 15.0))
             stats = run_benchmark(
                 suite.benchmark_directory(),
                 MultiPaxosInput(num_clients=loops, client_procs=procs,
-                                # The tpu point needs a longer window
-                                # (first drains pay kernel compiles over
-                                # the device link) and pipelined drains.
-                                duration_s=(args.duration
-                                            if backend == "dict"
-                                            else max(args.duration, 15.0)),
+                                duration_s=point_duration,
                                 quorum_backend=backend,
                                 tpu_pipelined=(backend == "tpu")))
             point = {
@@ -115,6 +114,7 @@ def main(argv=None) -> dict:
                 "tpu_pipelined": backend == "tpu",
                 "client_procs": procs,
                 "loops_per_proc": loops,
+                "duration_s": point_duration,
                 "throughput_p90_1s": stats.get("start_throughput_1s.p90"),
                 "latency_median_ms": stats.get("latency.median_ms"),
                 "latency_p99_ms": stats.get("latency.p99_ms"),

@@ -1,0 +1,170 @@
+"""Deployed benchmark for ANY protocol (the per-protocol suites analog).
+
+The reference ships a benchmark suite per protocol
+(benchmarks/<proto>/<proto>.py, 18 of them); here one generic suite
+serves every protocol the deployment registry knows: launch the roles
+over localhost TCP, drive closed loops from client OS processes through
+the registry's ``drive`` entry (bench/client_main.py ``run_drive``), and
+report the reference-shaped stats.
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.protocol_suite --protocol epaxos
+    python -m frankenpaxos_tpu.bench.protocol_suite --protocol all \
+        --out bench_results/protocol_lt.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+from frankenpaxos_tpu.bench.deploy_suite import (
+    launch_roles,
+    role_process_env,
+)
+from frankenpaxos_tpu.bench.harness import (
+    BenchmarkDirectory,
+    LocalHost,
+    SuiteDirectory,
+    free_port,
+    latency_throughput_stats,
+)
+from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, get_protocol
+
+
+# Single-decree protocols livelock under concurrent dueling proposers
+# (phase-1 preemption cycles); drive them with one serial loop. The
+# batching baseline needs batch_size=1 so ops don't wait on batch fill.
+SINGLE_DECREE = ("paxos", "fastpaxos", "matchmakerpaxos")
+LAUNCH_OVERRIDES = {
+    "batchedunreplicated": {"batch_size": "1"},
+}
+
+
+def run_protocol_benchmark(bench: BenchmarkDirectory, protocol_name: str,
+                           *, f: int = 1, client_procs: int = 2,
+                           clients_per_proc: int = 5,
+                           duration_s: float = 3.0,
+                           state_machine: str = "AppendLog") -> dict:
+    if protocol_name in SINGLE_DECREE:
+        client_procs, clients_per_proc = 1, 1
+    protocol = get_protocol(protocol_name)
+    raw = protocol.cluster(f, lambda: ["127.0.0.1", free_port()])
+    config_path = bench.write_json("config.json", raw)
+    config = protocol.load_config(raw)
+    launch_roles(bench, protocol_name, config_path, config,
+                 state_machine=state_machine,
+                 overrides={"resend_phase1as_period_s": "0.5",
+                            **LAUNCH_OVERRIDES.get(protocol_name, {})})
+
+    host = LocalHost()
+    env = role_process_env()
+    procs = []
+    try:
+        for i in range(client_procs):
+            out_csv = bench.abspath(f"client_{i}_data.csv")
+            procs.append((out_csv, bench.popen(host, f"client_{i}", [
+                sys.executable, "-m", "frankenpaxos_tpu.bench.client_main",
+                "--protocol", protocol_name,
+                "--config", config_path,
+                "--num_clients", str(clients_per_proc),
+                "--duration", str(duration_s),
+                "--seed", str(i + 1), "--out", out_csv], env=env)))
+        latencies, starts = [], []
+        for out_csv, proc in procs:
+            code = proc.wait(timeout=duration_s + 90)
+            if code != 0:
+                raise RuntimeError(
+                    f"client process exited with code {code}; see "
+                    f"{bench.path}")
+            with open(out_csv) as f_csv:
+                next(f_csv)
+                for line in f_csv:
+                    _, start, latency = line.strip().split(",")
+                    latencies.append(float(latency))
+                    starts.append(float(start))
+    finally:
+        bench.cleanup()
+
+    stats = latency_throughput_stats(latencies, duration_s,
+                                     starts_s=starts)
+    stats["protocol"] = protocol_name
+    stats["client_procs"] = client_procs
+    stats["clients_per_proc"] = clients_per_proc
+    stats["duration_s"] = duration_s
+    bench.write_json("results.json", stats)
+    return stats
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--protocol", default="all",
+                        choices=["all", *PROTOCOL_NAMES])
+    parser.add_argument("--client_procs", type=int, default=2)
+    parser.add_argument("--clients_per_proc", type=int, default=5)
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--suite_dir", default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_plt_")
+    suite = SuiteDirectory(root, "protocol_lt")
+    names = PROTOCOL_NAMES if args.protocol == "all" else [args.protocol]
+
+    results, failures = {}, []
+    for name in names:
+        t0 = time.time()
+        try:
+            stats = run_protocol_benchmark(
+                suite.benchmark_directory(), name,
+                client_procs=args.client_procs,
+                clients_per_proc=args.clients_per_proc,
+                duration_s=args.duration)
+            results[name] = {
+                "throughput_p90_1s": stats.get("start_throughput_1s.p90"),
+                "throughput_mean": stats.get(
+                    "throughput_mean",
+                    stats["num_requests"] / args.duration),
+                "latency_median_ms": stats.get("latency.median_ms"),
+                "num_requests": stats["num_requests"],
+                # The load actually applied (SINGLE_DECREE runs 1x1
+                # regardless of the requested flags).
+                "client_procs": stats["client_procs"],
+                "clients_per_proc": stats["clients_per_proc"],
+            }
+            if name in SINGLE_DECREE:
+                results[name]["note"] = (
+                    "single-decree: after the first decision the closed "
+                    "loop measures cached-chosen-value replies, not "
+                    "consensus decisions")
+            print(f"{name}: {stats['num_requests']} reqs in "
+                  f"{round(time.time() - t0, 1)}s")
+        except Exception as e:  # noqa: BLE001 - report, then fail at end
+            failures.append(name)
+            print(f"{name}: FAILED: {e}")
+
+    import os
+
+    out = {
+        "benchmark": "protocol_lt",
+        "host_cpus": os.cpu_count(),
+        "client_procs": args.client_procs,
+        "clients_per_proc": args.clients_per_proc,
+        "duration_s": args.duration,
+        "protocols": results,
+    }
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=2)
+    print(json.dumps(out, indent=2))
+    if failures:
+        raise SystemExit(f"benchmark failed for: {failures}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -80,14 +80,24 @@ class ProxyLeader(Actor):
         if options.quorum_backend == "tpu" and options.tpu_pipelined:
             loop = getattr(transport, "loop", None)
             if loop is not None:
-                # Real transport: fetch device results on ONE worker
-                # thread (preserving dispatch order) and post each
-                # completion back onto the event loop, so the loop never
-                # blocks on the device link.
-                import concurrent.futures
+                # Real transport: fetch device results on ONE daemon
+                # worker thread (preserving dispatch order) and post
+                # each completion back onto the event loop, so the loop
+                # never blocks on the device link. A daemon thread (vs a
+                # ThreadPoolExecutor, whose threads are joined at
+                # interpreter exit) cannot wedge process shutdown on a
+                # dead device link.
+                import queue
+                import threading
 
-                self._collector = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="tpu-collect")
+                self._collector = queue.Queue()
+
+                def collect_loop():
+                    while True:
+                        self._collect_and_post(self._collector.get())
+
+                threading.Thread(target=collect_loop, daemon=True,
+                                 name="tpu-collect").start()
             else:
                 # SimTransport: a flush timer collects synchronously
                 # (tests fire it explicitly).
@@ -169,7 +179,7 @@ class ProxyLeader(Actor):
                 dispatch = self.tracker.take_dispatch()
                 if dispatch is None:
                     break
-                self._collector.submit(self._collect_and_post, dispatch)
+                self._collector.put(dispatch)
         elif self._flush_timer is not None:
             # (Re)arm the quiescence flush while a dispatch is in
             # flight; the timer collects it if no further messages come.
@@ -182,14 +192,17 @@ class ProxyLeader(Actor):
         hand the results back to the single-threaded event loop."""
         try:
             results = self.tracker.collect(dispatch)
+            if results:
+                self.transport.loop.call_soon_threadsafe(
+                    self._emit_chosen, results)
+        except RuntimeError as e:
+            # Loop closed during teardown: dropping in-flight results is
+            # expected, but say so.
+            self.logger.debug(f"tpu collect post skipped: {e!r}")
         except Exception as e:  # noqa: BLE001 - surface, don't swallow
             # A swallowed collector error would silently drop this
             # dispatch's Chosen broadcasts and wedge its clients.
             self.logger.error(f"tpu collect failed: {e!r}")
-            return
-        if results:
-            self.transport.loop.call_soon_threadsafe(
-                self._emit_chosen, results)
 
     def _collect_all(self) -> None:
         while True:

@@ -140,3 +140,17 @@ def test_profiled_roles_dump_profiles():
     assert len(reports) == 5  # 2 leaders + 3 acceptors
     sample = open(next(iter(reports.values()))).read()
     assert "cumulative" in sample and "function calls" in sample
+
+
+def test_protocol_benchmark_generic_drive():
+    """The generic per-protocol benchmark (registry drive() closed
+    loops) measures a real deployment for a non-multipaxos protocol."""
+    from frankenpaxos_tpu.bench.protocol_suite import (
+        run_protocol_benchmark,
+    )
+
+    stats = run_protocol_benchmark(
+        BenchmarkDirectory(tempfile.mkdtemp(prefix="fpx_plt_") + "/craq"),
+        "craq", client_procs=1, clients_per_proc=3, duration_s=1.5)
+    assert stats["num_requests"] > 0
+    assert stats["latency.median_ms"] > 0
