@@ -1,25 +1,38 @@
 """Execution trace visualization.
 
 The reference ships 23 Scala.js in-browser protocol visualizations
-(js/src/main/...; SURVEY.md section 1 L5). The TPU-native replacement:
-record a SimTransport execution's delivery/timer history plus per-step
-actor annotations, dump it as JSON, and render it as an interactive
-sequence diagram in a dependency-free HTML viewer
-(``frankenpaxos_tpu/viz_viewer.html``).
+(js/src/main/...; SURVEY.md section 1 L5): every protocol wired over a
+JsTransport, stepped interactively, with live actor state rendered by
+Vue. The TPU-native replacement covers the same ground without a
+browser runtime dependency:
+
+  * :class:`TraceRecorder` -- post-hoc: snapshot a SimTransport's
+    delivery/timer history as viewer JSON.
+  * :class:`LiveTraceRecorder` -- attached: wraps the transport's
+    ``deliver_message``/``trigger_timer`` so every step also captures
+    the receiving actor's state (shallow field summary), giving the
+    viewer per-step state panels like the reference's ``@JSExportAll``
+    state rendering.
+  * :func:`record_scenario` -- wire ANY registry protocol over a
+    SimTransport (the deployment registry supplies config + roles +
+    client + drive), run a seeded random interleaving of commands and
+    deliveries, and record it. One command visualizes any of the 20
+    protocols -- the analog of the reference's per-protocol pages.
+  * :func:`dump_html` -- emit a SELF-CONTAINED interactive HTML page
+    (viewer + inlined trace): actor lanes, step slider, in-flight
+    messages, per-actor state at the selected step.
 
 Usage::
 
-    recorder = TraceRecorder(transport)
-    ... run the protocol ...
-    recorder.dump("trace.json")
-    # open viz_viewer.html and load trace.json
+    python -m frankenpaxos_tpu.viz --protocol multipaxos --steps 120 \
+        --out multipaxos_trace.html
 """
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
+import random
 from typing import Optional
 
 from frankenpaxos_tpu.runtime.sim_transport import (
@@ -28,9 +41,38 @@ from frankenpaxos_tpu.runtime.sim_transport import (
     TriggerTimer,
 )
 
+_SKIP_FIELDS = ("transport", "logger", "serializer", "rng", "config",
+                "state_machine", "heartbeat", "election", "checker",
+                "tracker", "collectors")
+_MAX_REPR = 160
+
+
+def _fmt(value) -> str:
+    try:
+        text = repr(value)
+    except Exception:  # noqa: BLE001 - reprs of live state may fail
+        text = f"<{type(value).__name__}>"
+    if len(text) > _MAX_REPR:
+        text = text[:_MAX_REPR - 1] + "…"
+    return text
+
+
+def snapshot_actor(actor) -> dict:
+    """A shallow, repr-truncated view of an actor's protocol state (the
+    reference renders actor fields the same way, via @JSExportAll)."""
+    out = {}
+    for key, value in vars(actor).items():
+        if key.startswith("_") or key in _SKIP_FIELDS:
+            continue
+        if callable(value):
+            continue
+        out[key] = _fmt(value)
+    return out
+
 
 class TraceRecorder:
-    """Snapshots a SimTransport's history into viewer JSON."""
+    """Snapshots a SimTransport's history into viewer JSON (post-hoc:
+    events only, no per-step state)."""
 
     def __init__(self, transport: SimTransport):
         self.transport = transport
@@ -70,6 +112,90 @@ class TraceRecorder:
         return path
 
 
+class LiveTraceRecorder:
+    """Wraps a SimTransport so each delivery/timer step records the
+    event AND the receiving actor's post-step state snapshot.
+
+    ``labels`` maps raw transport addresses to human-readable names
+    (role_index); unmapped addresses stringify as-is.
+    """
+
+    def __init__(self, transport: SimTransport,
+                 protocol: Optional[str] = None,
+                 labels: Optional[dict] = None):
+        self.transport = transport
+        self.protocol = protocol
+        self.labels = labels or {}
+        self.events: list[dict] = []
+        self._attached = False
+
+    def _name(self, address) -> str:
+        return self.labels.get(address, str(address))
+
+    def attach(self) -> "LiveTraceRecorder":
+        if self._attached:
+            return self
+        self._attached = True
+        transport = self.transport
+        deliver, trigger = (transport.deliver_message,
+                            transport.trigger_timer)
+
+        def recording_deliver(message):
+            event = {
+                "step": len(self.events),
+                "kind": "deliver",
+                "src": self._name(message.src),
+                "dst": self._name(message.dst),
+                "bytes": len(message.data),
+                "label": _message_label(transport, message),
+            }
+            before = len(transport.history)
+            deliver(message)
+            # Dropped deliveries (partitioned/unknown destination) never
+            # reach history and must not appear in the trace either
+            # (sim_transport.py:135-137; mirrors the post-hoc recorder).
+            if len(transport.history) > before:
+                self._finish(event, message.dst)
+
+        def recording_trigger(timer_id):
+            timer = transport.timers.get(timer_id)
+            event = {
+                "step": len(self.events),
+                "kind": "timer",
+                "src": self._name(timer.address) if timer else "?",
+                "dst": self._name(timer.address) if timer else "?",
+                "label": timer.name if timer else "?",
+            }
+            before = len(transport.history)
+            trigger(timer_id)
+            if len(transport.history) > before:
+                self._finish(event,
+                             timer.address if timer is not None else None)
+
+        transport.deliver_message = recording_deliver
+        transport.trigger_timer = recording_trigger
+        return self
+
+    def _finish(self, event: dict, dst) -> None:
+        actor = self.transport.actors.get(dst)
+        if actor is not None:
+            event["state"] = snapshot_actor(actor)
+        event["inflight"] = len(self.transport.messages)
+        self.events.append(event)
+
+    def mark(self, label: str) -> None:
+        """Insert an annotation event (e.g. 'client issues write 3')."""
+        self.events.append({"step": len(self.events), "kind": "mark",
+                            "src": "", "dst": "", "label": label})
+
+    def to_dict(self) -> dict:
+        return {
+            "protocol": self.protocol,
+            "actors": [self._name(a) for a in self.transport.actors],
+            "events": self.events,
+        }
+
+
 def _message_label(transport: SimTransport, message) -> str:
     actor = transport.actors.get(message.dst)
     if actor is None:
@@ -81,6 +207,141 @@ def _message_label(transport: SimTransport, message) -> str:
         return "?"
 
 
+def record_scenario(protocol_name: str, *, steps: int = 120,
+                    num_commands: int = 5, f: int = 1,
+                    seed: int = 0) -> dict:
+    """Wire ``protocol_name`` over a SimTransport via the deployment
+    registry, run a seeded interleaving of client commands and message
+    deliveries/timers, and return the recorded trace dict."""
+    from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+
+    protocol = get_protocol(protocol_name)
+    # Fake "ports": the registry's cluster generator just needs unique
+    # addresses; SimTransport treats them as opaque keys.
+    counter = {"next": 0}
+
+    def fake_port():
+        counter["next"] += 1
+        return ["sim", counter["next"]]
+
+    raw = protocol.cluster(f, fake_port)
+    config = protocol.load_config(raw)
+
+    # Human-readable lane names: role_index from the cluster layout
+    # (covers embedded sub-actors like elections/heartbeats too).
+    labels: dict = {}
+    counts: dict = {}
+
+    def walk(key, node):
+        if (isinstance(node, list) and len(node) == 2
+                and not isinstance(node[0], list)):
+            prefix = key.rstrip("s")
+            index = counts.get(prefix, 0)
+            counts[prefix] = index + 1
+            labels[(node[0], int(node[1]))] = f"{prefix}_{index}"
+        elif isinstance(node, list):
+            for item in node:
+                walk(key, item)
+
+    for key, node in raw.items():
+        if isinstance(node, list):
+            walk(key, node)
+
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    recorder = LiveTraceRecorder(transport, protocol=protocol_name,
+                                 labels=labels)
+    recorder.attach()
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides={}, seed=seed, state_machine="AppendLog")
+    for role_name, role in protocol.roles.items():
+        for index, address in enumerate(role.addresses(config)):
+            ctx.seed = seed + index
+            role.make(ctx, address, index)
+    client_ctx = DeployCtx(config=config, transport=transport,
+                           logger=logger, overrides={}, seed=seed + 100)
+    client_address = ("sim", "client-0")
+    labels[client_address] = "client_0"
+    client = protocol.make_client(client_ctx, client_address)
+
+    rng = random.Random(seed)
+    issued = completed = 0
+    replies = []
+    for _ in range(steps):
+        # One outstanding command: drive() reuses pseudonym 0, and a
+        # client allows one pending op per pseudonym.
+        can_issue = issued < num_commands and issued == len(replies)
+        command = transport.generate_command(rng)
+        if can_issue and (command is None or rng.random() < 0.2):
+            recorder.mark(f"client issues command {issued}")
+            protocol.drive(client, issued,
+                           lambda *_: replies.append(True))
+            issued += 1
+        elif command is not None:
+            transport.run_command(command)
+        else:
+            break
+        completed = len(replies)
+    # Settle: drain residual messages (and resend timers, which recover
+    # anything the random phase left stranded) so the trace ends with
+    # completed commands.
+    for _ in range(8):
+        transport.deliver_all()
+        if len(replies) >= issued:
+            break
+        for timer in list(transport.running_timers()):
+            if timer.name.startswith(("resend", "repropose")):
+                transport.trigger_timer(timer.id)
+    completed = len(replies)
+    recorder.mark(f"{completed}/{issued} commands completed")
+    return recorder.to_dict()
+
+
 def viewer_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "viz_viewer.html")
+
+
+def dump_html(trace: dict, path: str) -> str:
+    """Write a self-contained interactive page: the viewer with the
+    trace JSON inlined (no fetch/CORS, opens anywhere)."""
+    with open(viewer_path()) as f:
+        html = f.read()
+    payload = json.dumps(trace).replace("</", "<\\/")
+    html = html.replace("/*__TRACE_JSON__*/null", payload)
+    with open(path, "w") as f:
+        f.write(html)
+    return path
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    from frankenpaxos_tpu.deploy import PROTOCOL_NAMES
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--protocol", required=True,
+                        choices=PROTOCOL_NAMES)
+    parser.add_argument("--steps", type=int, default=120)
+    parser.add_argument("--num_commands", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default=None,
+                        help=".html (self-contained) or .json")
+    args = parser.parse_args(argv)
+
+    trace = record_scenario(args.protocol, steps=args.steps,
+                            num_commands=args.num_commands,
+                            seed=args.seed)
+    out = args.out or f"{args.protocol}_trace.html"
+    if out.endswith(".json"):
+        with open(out, "w") as f:
+            json.dump(trace, f, indent=2)
+    else:
+        dump_html(trace, out)
+    print(f"wrote {out} ({len(trace['events'])} events, "
+          f"{len(trace['actors'])} actors)")
+
+
+if __name__ == "__main__":
+    main()

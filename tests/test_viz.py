@@ -32,7 +32,7 @@ def test_trace_records_multipaxos_run(tmp_path):
 def test_viewer_exists():
     with open(viewer_path()) as f:
         content = f.read()
-    assert "function render" in content
+    assert "function buildStatic" in content
     assert "esc(" in content  # labels must be escaped before innerHTML
 
 
@@ -45,3 +45,49 @@ def test_partitioned_deliveries_not_in_trace():
     # The ClientRequest to the partitioned leader was dropped; it must
     # not appear as a delivered arrow.
     assert not any(e["dst"] == "leader-0" for e in recorder.events())
+
+
+def test_live_recorder_snapshots_state(tmp_path):
+    from frankenpaxos_tpu.viz import LiveTraceRecorder
+
+    sim = make_multipaxos(f=1)
+    recorder = LiveTraceRecorder(sim.transport,
+                                 protocol="multipaxos").attach()
+    got = []
+    sim.clients[0].write(0, b"snap", got.append)
+    sim.transport.deliver_all()
+    assert got
+    trace = recorder.to_dict()
+    assert trace["protocol"] == "multipaxos"
+    delivered = [e for e in trace["events"] if e["kind"] == "deliver"]
+    assert delivered
+    # Every delivery snapshots the receiving actor's state.
+    assert all("state" in e and "inflight" in e for e in delivered)
+    replica_states = [e["state"] for e in delivered
+                      if e["dst"].startswith("replica")]
+    assert any("executed_watermark" in s for s in replica_states)
+
+
+def test_record_scenario_all_registry_protocols(tmp_path):
+    """Any registry protocol can be wired over SimTransport and traced
+    (spot-check a protocol per architecture family)."""
+    from frankenpaxos_tpu.viz import dump_html, record_scenario
+
+    for protocol in ("multipaxos", "epaxos", "craq",
+                     "matchmakermultipaxos"):
+        trace = record_scenario(protocol, steps=80, num_commands=3,
+                                seed=1)
+        assert trace["protocol"] == protocol
+        assert len(trace["events"]) > 10
+        kinds = {e["kind"] for e in trace["events"]}
+        assert "deliver" in kinds and "mark" in kinds
+        # Commands actually completed end-to-end.
+        final = trace["events"][-1]["label"]
+        completed = int(final.split("/")[0])
+        assert completed >= 1, final
+
+        path = dump_html(trace, str(tmp_path / f"{protocol}.html"))
+        html = open(path).read()
+        assert "/*__TRACE_JSON__*/null" not in html
+        assert f'"protocol": null' not in html
+        assert protocol in html
