@@ -45,6 +45,12 @@ class Write:
 @dataclasses.dataclass(frozen=True)
 class WriteBatch:
     writes: tuple[Write, ...]
+    # Head-assigned sequence number. CRAQ's consistency argument assumes
+    # FIFO links (the reference rides Netty TCP's ordering); explicit
+    # sequencing keeps the chain consistent under ANY delivery order --
+    # the randomized sim reorders chain hops and caught value regression
+    # without it.
+    seq: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,7 +87,8 @@ class ReadReply:
 
 class ChainNode(Actor):
     def __init__(self, address: Address, transport: Transport,
-                 logger: Logger, config: CraqConfig):
+                 logger: Logger, config: CraqConfig,
+                 resend_period_s: float = 1.0):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
@@ -91,9 +98,78 @@ class ChainNode(Actor):
         self.pending_writes: list[WriteBatch] = []
         self.state_machine: dict[str, str] = {}
         self.versions = 0
+        # Head-side sequencer + per-node in-order apply state: batches
+        # propagate down (and acks back up) in ``seq`` order regardless
+        # of per-hop delivery order. Duplicate deliveries re-ack, and an
+        # unacked-head resend timer retransmits, so lost hop messages
+        # heal rather than wedging the chain.
+        self._next_seq = 0               # head: next seq to assign
+        self._next_in = 0                # next batch seq to accept
+        self._in_buffer: dict[int, WriteBatch] = {}
+        self._next_ack = 0               # next ack seq to apply
+        self._ack_buffer: dict[int, Ack] = {}
+        # Head-side at-most-once: (client, pseudonym) -> (largest client
+        # id sequenced, its chain seq). A late duplicate of an old
+        # client Write must NOT be re-sequenced -- it would resurrect a
+        # stale value over a newer committed one. Retries of the LATEST
+        # write re-reply once it has committed (a lost ClientReply must
+        # not wedge the client stream).
+        self._sequenced: dict[tuple, tuple[int, int]] = {}
+        self._resend_timer = None
+        if not self.is_tail:
+            def resend():
+                if self.pending_writes:
+                    self.send(
+                        self.config.chain_node_addresses[self.index + 1],
+                        self.pending_writes[0])
+                self._resend_timer.start()
+
+            self._resend_timer = self.timer("resendChain",
+                                            resend_period_s, resend)
+            self._resend_timer.start()
 
     # --- write path (ChainNode.scala:135-161) -----------------------------
     def _process_write_batch(self, batch: WriteBatch) -> None:
+        if self.is_head:
+            fresh = []
+            for write in batch.writes:
+                key = (write.command_id.client_address,
+                       write.command_id.client_pseudonym)
+                last_id, last_seq = self._sequenced.get(key, (-1, -1))
+                if write.command_id.client_id < last_id:
+                    continue  # stale duplicate
+                if write.command_id.client_id == last_id:
+                    # Retry of the latest write: if it already committed
+                    # (fully acked, or applied directly on a single-node
+                    # chain), the client's reply was lost -- re-reply.
+                    if self.is_tail or last_seq < self._next_ack:
+                        self.send(write.command_id.client_address,
+                                  ClientReply(write.command_id))
+                    continue
+                self._sequenced[key] = (write.command_id.client_id,
+                                        self._next_seq)
+                fresh.append(write)
+            if not fresh:
+                return
+            batch = WriteBatch(writes=tuple(fresh), seq=self._next_seq)
+            self._next_seq += 1
+            self._accept_in_order(batch)
+            return
+        if batch.seq < self._next_in:
+            # Already accepted: a duplicate means the sender may have
+            # missed our Ack -- re-ack anything we've already acked.
+            if batch.seq < self._next_ack or self.is_tail:
+                self.send(self.config.chain_node_addresses[self.index - 1],
+                          Ack(batch))
+            return
+        if batch.seq in self._in_buffer:
+            return
+        self._in_buffer[batch.seq] = batch
+        while self._next_in in self._in_buffer:
+            self._accept_in_order(self._in_buffer.pop(self._next_in))
+
+    def _accept_in_order(self, batch: WriteBatch) -> None:
+        self._next_in = batch.seq + 1
         if not self.is_tail:
             self.pending_writes.append(batch)
             self.send(self.config.chain_node_addresses[self.index + 1],
@@ -110,10 +186,22 @@ class ChainNode(Actor):
                       Ack(batch))
 
     def _handle_ack(self, ack: Ack) -> None:
+        seq = ack.write_batch.seq
+        if seq < self._next_ack or seq in self._ack_buffer:
+            return
+        self._ack_buffer[seq] = ack
+        while self._next_ack in self._ack_buffer:
+            self._apply_ack(self._ack_buffer.pop(self._next_ack))
+
+    def _apply_ack(self, ack: Ack) -> None:
+        self._next_ack = ack.write_batch.seq + 1
         for write in ack.write_batch.writes:
             self.state_machine[write.key] = write.value
-        if ack.write_batch in self.pending_writes:
-            self.pending_writes.remove(ack.write_batch)
+        # In-order accept + in-order ack application make the acked
+        # batch the oldest pending one.
+        if self.pending_writes \
+                and self.pending_writes[0].seq == ack.write_batch.seq:
+            self.pending_writes.pop(0)
         if not self.is_head:
             self.send(self.config.chain_node_addresses[self.index - 1], ack)
 

@@ -84,6 +84,10 @@ class PrefixAgreementSim(SimulatedSystem):
         raise NotImplementedError
 
     def logs(self, system: dict) -> list:
+        """Executed prefixes to check. Subclasses either implement this
+        or explicitly opt out (return []) and supply their own
+        state_invariant -- forgetting both must fail loudly, not pass
+        silently."""
         raise NotImplementedError
 
     def chaos_choices(self, system: dict,

@@ -339,3 +339,194 @@ class TestCraq:
             clients[1].read(1, "x", got.append)
             transport.deliver_all()
             assert got == ["1"]
+
+
+# ---------------------------------------------------------------------------
+# Randomized simulations: CRAQ chain consistency and UnanimousBPaxos
+# vertex agreement under arbitrary reordering/duplication/loss.
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+from typing import Optional  # noqa: E402
+
+from frankenpaxos_tpu.sim import Simulator  # noqa: E402
+
+from .sim_util import PrefixAgreementSim, WriteCmd  # noqa: E402
+
+
+class CraqSimulated(PrefixAgreementSim):
+    """Invariant: for any key with no pending write anywhere in the
+    chain, every node agrees on its value (apportioned reads would all
+    see the same committed version)."""
+
+    transport_weight = 12
+    KEYS = ("a", "b", "c")
+
+    def make_system(self, seed):
+        from frankenpaxos_tpu.protocols.craq import (
+            ChainNode,
+            CraqClient,
+            CraqConfig,
+        )
+        from frankenpaxos_tpu.runtime import (
+            FakeLogger,
+            LogLevel,
+            SimTransport,
+        )
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        config = CraqConfig(chain_node_addresses=(
+            "chain-0", "chain-1", "chain-2"))
+        nodes = [ChainNode(a, transport, logger, config)
+                 for a in config.chain_node_addresses]
+        clients = [CraqClient(f"client-{i}", transport, logger, config,
+                              seed=seed + i) for i in range(2)]
+        return dict(transport=transport, nodes=nodes, clients=clients)
+
+    def make_write(self, system, rng: _random.Random):
+        client, pseudonym = rng.choice(self.idle_writers(system))
+        system["counter"] += 1
+        # Values encode their writer stream: concurrent writers may
+        # legitimately commit in either order (head-arrival decides),
+        # but within one (client, pseudonym) stream versions are
+        # monotone -- a regression means a stale duplicate was
+        # re-sequenced.
+        return WriteCmd(client, pseudonym,
+                        (rng.choice(self.KEYS),
+                         f"{client}.{pseudonym}.{system['counter']}"))
+
+    def run_write(self, system, command: WriteCmd):
+        client = system["clients"][command.client]
+        if command.pseudonym not in client.pending:
+            key, value = command.payload
+            client.write(command.pseudonym, key, value)
+
+    def logs(self, system):
+        return []  # explicit opt-out: invariants below cover safety
+
+    def get_state(self, system):
+        # Tail state snapshot: committed values must never regress.
+        tail = system["nodes"][-1]
+        return tuple(sorted(tail.state_machine.items()))
+
+    def step_invariant(self, old_state, new_state) -> Optional[str]:
+        old_d, new_d = dict(old_state), dict(new_state)
+        for key, value in old_d.items():
+            new_value = new_d.get(key)
+            if new_value is None or new_value == value:
+                continue
+            old_writer, old_n = value.rsplit(".", 1)[0], value.rsplit(".", 1)[1]
+            new_writer, new_n = new_value.rsplit(".", 1)[0], new_value.rsplit(".", 1)[1]
+            if old_writer == new_writer and int(new_n) < int(old_n):
+                return (f"tail regressed {key!r}: {value} -> "
+                        f"{new_value} (stale write resurrected)")
+        return None
+
+    def state_invariant(self, system) -> Optional[str]:
+        nodes = system["nodes"]
+        pending_keys = {
+            write.key
+            for node in nodes
+            for batch in node.pending_writes
+            for write in batch.writes}
+        for key in self.KEYS:
+            if key in pending_keys:
+                continue
+            values = {node.state_machine.get(key) for node in nodes}
+            if len(values) > 1:
+                return (f"chain disagrees on quiescent key {key!r}: "
+                        f"{[node.state_machine.get(key) for node in nodes]}")
+        return None
+
+
+def test_craq_simulation_chain_consistency():
+    failure = Simulator(CraqSimulated(), run_length=250,
+                        num_runs=100, minimize=False).run(seed=0)
+    assert failure is None, str(failure)
+
+
+class UnanimousBPaxosSimulated(PrefixAgreementSim):
+    """Invariant: leaders agree on every committed vertex's value."""
+
+    transport_weight = 12
+
+    def make_system(self, seed):
+        from frankenpaxos_tpu.protocols.unanimousbpaxos import (
+            UnanimousBPaxosAcceptor,
+            UnanimousBPaxosClient,
+            UnanimousBPaxosConfig,
+            UnanimousBPaxosDepServiceNode,
+            UnanimousBPaxosLeader,
+        )
+        from frankenpaxos_tpu.runtime import (
+            FakeLogger,
+            LogLevel,
+            SimTransport,
+        )
+        from frankenpaxos_tpu.statemachine import KeyValueStore
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        n = 3
+        config = UnanimousBPaxosConfig(
+            f=1,
+            leader_addresses=("leader-0", "leader-1"),
+            dep_service_node_addresses=tuple(
+                f"dep-{i}" for i in range(n)),
+            acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)))
+        leaders = [UnanimousBPaxosLeader(a, transport, logger, config,
+                                         KeyValueStore(), seed=seed + i)
+                   for i, a in enumerate(config.leader_addresses)]
+        [UnanimousBPaxosDepServiceNode(a, transport, logger, config,
+                                       KeyValueStore())
+         for a in config.dep_service_node_addresses]
+        [UnanimousBPaxosAcceptor(a, transport, logger, config)
+         for a in config.acceptor_addresses]
+        clients = [UnanimousBPaxosClient(f"client-{i}", transport,
+                                         logger, config, seed=seed + 50 + i)
+                   for i in range(2)]
+        return dict(transport=transport, leaders=leaders,
+                    clients=clients)
+
+    def run_write(self, system, command: WriteCmd):
+        from frankenpaxos_tpu.runtime import PickleSerializer
+        from frankenpaxos_tpu.statemachine import SetRequest
+
+        client = system["clients"][command.client]
+        if command.pseudonym not in client.pending:
+            client.propose(command.pseudonym, PickleSerializer().to_bytes(
+                SetRequest((("k", command.payload.decode()),))))
+
+    def logs(self, system):
+        return []  # explicit opt-out: vertex agreement below
+
+    def get_state(self, system):
+        return None
+
+    def step_invariant(self, old, new):
+        return None
+
+    def state_invariant(self, system) -> Optional[str]:
+        from frankenpaxos_tpu.protocols.unanimousbpaxos import _Committed
+
+        per_vertex: dict = {}
+        for i, leader in enumerate(system["leaders"]):
+            for vertex_id, state in leader.states.items():
+                if not isinstance(state, _Committed):
+                    continue
+                if vertex_id in per_vertex:
+                    other, j = per_vertex[vertex_id]
+                    if other != state.value:
+                        return (f"leaders disagree on {vertex_id}: "
+                                f"[{j}] {other!r} vs [{i}] "
+                                f"{state.value!r}")
+                else:
+                    per_vertex[vertex_id] = (state.value, i)
+        return None
+
+
+def test_unanimousbpaxos_simulation_vertex_agreement():
+    failure = Simulator(UnanimousBPaxosSimulated(), run_length=250,
+                        num_runs=100, minimize=False).run(seed=0)
+    assert failure is None, str(failure)
