@@ -120,9 +120,19 @@ class GarbageCollector(Actor):
 
 class _GcWatermarkMixin:
     """Fold GarbageCollect frontiers into an f+1 quorum watermark vector
-    and prune per-vertex state below it."""
+    and prune per-vertex state below it.
 
-    def _init_gc(self, config: GcBPaxosConfig) -> None:
+    ``gc_backend="tpu"`` evaluates the quorum-watermark reduction on
+    device (ops/watermark.py: sort + index over the [replicas x leaders]
+    frontier matrix -- the QuorumWatermark.scala:31-50 math as one
+    batched kernel); ``"host"`` is the numpy oracle.
+    """
+
+    def _init_gc(self, config: GcBPaxosConfig,
+                 gc_backend: str = "host") -> None:
+        if gc_backend not in ("host", "tpu"):
+            raise ValueError(f"unknown gc backend {gc_backend!r}")
+        self._gc_backend = gc_backend
         self._gc_vector = QuorumWatermarkVector(
             n=len(config.replica_addresses),
             depth=len(config.leader_addresses))
@@ -131,7 +141,7 @@ class _GcWatermarkMixin:
     def _handle_garbage_collect(self, message: GarbageCollect) -> None:
         self._gc_vector.update(message.replica_index, message.frontier)
         self.gc_watermark = self._gc_vector.watermark(
-            quorum_size=self.config.f + 1)
+            quorum_size=self.config.f + 1, backend=self._gc_backend)
         self._prune()
 
     def _collectable(self, vertex_id: VertexId) -> bool:
@@ -159,9 +169,9 @@ class GcBPaxosLeader(BPaxosLeader):
 
 
 class GcBPaxosProposer(_GcWatermarkMixin, BPaxosProposer):
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, gc_backend: str = "host", **kwargs):
         super().__init__(*args, **kwargs)
-        self._init_gc(self.config)
+        self._init_gc(self.config, gc_backend)
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, GarbageCollect):
@@ -178,9 +188,9 @@ class GcBPaxosProposer(_GcWatermarkMixin, BPaxosProposer):
 
 
 class GcBPaxosAcceptor(_GcWatermarkMixin, BPaxosAcceptor):
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, gc_backend: str = "host", **kwargs):
         super().__init__(*args, **kwargs)
-        self._init_gc(self.config)
+        self._init_gc(self.config, gc_backend)
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, GarbageCollect):

@@ -51,8 +51,18 @@ class QuorumWatermarkVector:
         self._watermarks[index, :w.shape[0]] = np.maximum(
             self._watermarks[index, :w.shape[0]], w)
 
-    def watermark(self, quorum_size: int) -> list[int]:
+    def watermark(self, quorum_size: int,
+                  backend: str = "host") -> list[int]:
+        """``backend="tpu"`` evaluates the reduction through the device
+        twin (ops/watermark.py); ``"host"`` is the numpy oracle."""
         n = self._watermarks.shape[0]
         if not 1 <= quorum_size <= n:
             raise ValueError(f"quorum_size {quorum_size} out of [1, {n}]")
+        if backend == "tpu":
+            from frankenpaxos_tpu.ops.watermark import (
+                quorum_watermark_vector,
+            )
+
+            return quorum_watermark_vector(
+                self._watermarks, quorum_size=quorum_size).tolist()
         return np.sort(self._watermarks, axis=0)[n - quorum_size].tolist()

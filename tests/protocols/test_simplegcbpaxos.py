@@ -22,7 +22,7 @@ SER = PickleSerializer()
 
 
 def make_gc_bpaxos(f=1, send_gc_every_n=3, seed=0, num_replicas=None,
-                   snapshot_every_n=0):
+                   snapshot_every_n=0, gc_backend="host"):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     n = 2 * f + 1
@@ -40,12 +40,14 @@ def make_gc_bpaxos(f=1, send_gc_every_n=3, seed=0, num_replicas=None,
     leaders = [GcBPaxosLeader(a, transport, logger, config, seed=seed + i)
                for i, a in enumerate(config.leader_addresses)]
     proposers = [GcBPaxosProposer(a, transport, logger, config,
-                                  seed=seed + 10 + i)
+                                  seed=seed + 10 + i,
+                                  gc_backend=gc_backend)
                  for i, a in enumerate(config.proposer_addresses)]
     dep_nodes = [GcBPaxosDepServiceNode(a, transport, logger, config,
                                         KeyValueStore())
                  for a in config.dep_service_node_addresses]
-    acceptors = [GcBPaxosAcceptor(a, transport, logger, config)
+    acceptors = [GcBPaxosAcceptor(a, transport, logger, config,
+                                  gc_backend=gc_backend)
                  for a in config.acceptor_addresses]
     replicas = [GcBPaxosReplica(a, transport, logger, config,
                                 KeyValueStore(),
@@ -204,3 +206,42 @@ def test_simulation_gc_no_divergence():
     failure = Simulator(GcBPaxosSimulated(), run_length=250,
                         num_runs=100).run(seed=0)
     assert failure is None, str(failure)
+
+
+def test_gc_watermark_tpu_backend_matches_host():
+    """gc_backend=tpu runs the quorum-watermark reduction on device; it
+    must match the host oracle through the full GC+prune flow."""
+    import random as _rng
+
+    import numpy as np
+
+    from frankenpaxos_tpu.ops.watermark import quorum_watermark_vector
+    from frankenpaxos_tpu.utils.watermark import QuorumWatermarkVector
+
+    rng = _rng.Random(3)
+    for _ in range(20):
+        n, depth = rng.randint(1, 5), rng.randint(1, 4)
+        host = QuorumWatermarkVector(n=n, depth=depth)
+        mat = np.array([[rng.randint(0, 50) for _ in range(depth)]
+                        for _ in range(n)])
+        for i in range(n):
+            host.update(i, mat[i])
+        q = rng.randint(1, n)
+        assert host.watermark(q) == quorum_watermark_vector(
+            mat, quorum_size=q).tolist()
+
+    # End-to-end: the GC flow with device watermarks prunes identically.
+    transport, config, proposers, acceptors, replicas, clients = \
+        make_gc_bpaxos(send_gc_every_n=2, seed=5)
+    transport_t, config_t, proposers_t, acceptors_t, replicas_t, \
+        clients_t = make_gc_bpaxos(send_gc_every_n=2, seed=5,
+                                   gc_backend="tpu")
+    for sim_clients, sim_transport in ((clients, transport),
+                                       (clients_t, transport_t)):
+        for i in range(6):
+            sim_clients[0].propose(0, SER.to_bytes(
+                SetRequest((("k", str(i)),))))
+            sim_transport.deliver_all()
+    assert proposers[0].gc_watermark == proposers_t[0].gc_watermark
+    assert proposers[0].gc_watermark[0] > 0
+    assert set(proposers[0].states) == set(proposers_t[0].states)
