@@ -652,7 +652,8 @@ def _simplebpaxos(gc: bool = False) -> Protocol:
         "acceptor": Role(
             lambda c: list(c.acceptor_addresses),
             lambda ctx, a, i: acceptor_cls(
-                a, ctx.transport, ctx.logger, ctx.config)),
+                a, ctx.transport, ctx.logger, ctx.config,
+                **ctx.kw(acceptor_cls))),
         "replica": Role(
             lambda c: list(c.replica_addresses),
             lambda ctx, a, i: replica_cls(

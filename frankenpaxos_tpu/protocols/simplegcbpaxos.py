@@ -199,13 +199,10 @@ class GcBPaxosAcceptor(_GcWatermarkMixin, BPaxosAcceptor):
         super().receive(src, message)
 
 
-class GcBPaxosDepServiceNode(BPaxosDepServiceNode):
-    def __init__(self, *args, **kwargs):
+class GcBPaxosDepServiceNode(_GcWatermarkMixin, BPaxosDepServiceNode):
+    def __init__(self, *args, gc_backend: str = "host", **kwargs):
         super().__init__(*args, **kwargs)
-        self._gc_vector = QuorumWatermarkVector(
-            n=len(self.config.replica_addresses),
-            depth=len(self.config.leader_addresses))
-        self.gc_watermark = [0] * len(self.config.leader_addresses)
+        self._init_gc(self.config, gc_backend)
         # Highest vertex id + 1 seen per leader column, and the latest
         # snapshot vertex: a snapshot depends on everything seen before
         # it, and everything after depends on the snapshot
@@ -213,19 +210,18 @@ class GcBPaxosDepServiceNode(BPaxosDepServiceNode):
         self._high_watermark = [0] * len(self.config.leader_addresses)
         self._last_snapshot: Optional[VertexId] = None
 
+    def _prune(self) -> None:
+        # Dep nodes prune the dependency cache, not per-vertex consensus
+        # state. Top-k conflict indexes don't support removal; stale
+        # entries only add extra dependencies, which is safe
+        # (DepServiceNode "fast conflict indexes don't remove").
+        for vertex_id in [v for v in self.dependencies_cache
+                          if self._collectable(v)]:
+            del self.dependencies_cache[vertex_id]
+
     def receive(self, src: Address, message) -> None:
         if isinstance(message, GarbageCollect):
-            self._gc_vector.update(message.replica_index, message.frontier)
-            self.gc_watermark = self._gc_vector.watermark(
-                quorum_size=self.config.f + 1)
-            for vertex_id in [
-                    v for v in self.dependencies_cache
-                    if v.instance_number
-                    < self.gc_watermark[v.replica_index]]:
-                del self.dependencies_cache[vertex_id]
-                # Top-k conflict indexes don't support removal; stale
-                # entries only add extra dependencies, which is safe
-                # (DepServiceNode "fast conflict indexes don't remove").
+            self._handle_garbage_collect(message)
             return
         super().receive(src, message)
 

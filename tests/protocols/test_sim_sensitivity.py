@@ -1,0 +1,124 @@
+"""Mutation sensitivity of the randomized simulations.
+
+A property-based sim is only as good as what it can catch. These tests
+inject known-fatal weakenings (via monkeypatching, restored afterwards)
+and assert the corresponding sim FAILS -- guarding the sims' bug-finding
+power against future decay (e.g. an invariant accidentally weakened, or
+chaos rates tuned into a blind spot). Each mutation mirrors one the sims
+caught during development.
+"""
+
+import pytest
+
+from frankenpaxos_tpu.sim import Simulator
+
+from .test_matchmakermultipaxos import MMPSimulated
+from .test_small_protocols import CraqSimulated
+
+
+class MMPChurnProbe(MMPSimulated):
+    """MMPSimulated with the leaders' liveness-only resendMatchRequests
+    timers kept stopped: every running timer dilutes the per-step
+    command distribution, and this one (a safety no-op) measurably
+    shrinks the probability of the phase2 conflict interleavings this
+    probe exists to reach (seeds 229/274 catch with it stopped; none of
+    1,500 catch with it running)."""
+
+    def make_system(self, seed):
+        system = super().make_system(seed)
+        for leader in system["leaders"]:
+            original = leader._matchmake
+
+            def quiet(*args, _leader=leader, _original=original, **kw):
+                _original(*args, **kw)
+                if _leader._match_resend_timer is not None:
+                    _leader._match_resend_timer.stop()
+
+            leader._matchmake = quiet
+            if leader._match_resend_timer is not None:
+                leader._match_resend_timer.stop()
+        return system
+
+
+def test_mmp_sim_catches_weakened_write_quorum(monkeypatch):
+    """A single Phase2b vote must not constitute a write quorum; the
+    leader-churn chaos profile catches this within its seed budget.
+
+    Read quorums must stay HONEST: SimpleMajority's read check delegates
+    to the write check, and weakening phase-1 reads too masks the bug
+    (recovery then reads 1-of-n and the conflict window closes).
+    """
+    from frankenpaxos_tpu.quorums import SimpleMajority
+
+    monkeypatch.setattr(
+        SimpleMajority, "is_superset_of_read_quorum",
+        lambda self, xs: len(set(xs) & self.members) >= self.quorum_size)
+    monkeypatch.setattr(SimpleMajority, "is_superset_of_write_quorum",
+                        lambda self, nodes: len(nodes) >= 1)
+    failure = Simulator(MMPChurnProbe(), run_length=250,
+                        num_runs=300, minimize=False).run(seed=0)
+    assert failure is not None, (
+        "the MMP churn sim no longer catches a weakened write quorum -- "
+        "its chaos rates or invariants have decayed")
+    assert "chosen twice" in failure.error or "diverge" in failure.error
+
+
+def test_craq_sim_catches_unordered_chain(monkeypatch):
+    """Accepting chain batches out of order must regress values; the
+    per-writer tail monotonicity / chain agreement invariants catch it."""
+    from frankenpaxos_tpu.protocols import craq
+
+    def unordered_process(self, batch):
+        if self.is_head:
+            fresh_batch = craq.WriteBatch(writes=batch.writes,
+                                          seq=self._next_seq)
+            self._next_seq += 1
+            self._accept_in_order(fresh_batch)
+            return
+        self._accept_in_order(batch)  # no ordering, no dedup
+
+    monkeypatch.setattr(craq.ChainNode, "_process_write_batch",
+                        unordered_process)
+    failure = Simulator(CraqSimulated(), run_length=250,
+                        num_runs=100, minimize=False).run(seed=0)
+    assert failure is not None, (
+        "the CRAQ sim no longer catches out-of-order chain application")
+
+
+def test_craq_sim_catches_missing_head_dedup(monkeypatch):
+    """Re-sequencing duplicate client writes resurrects stale values;
+    per-writer tail monotonicity catches it."""
+    from frankenpaxos_tpu.protocols import craq
+
+    original = craq.ChainNode._process_write_batch
+
+    def no_dedup(self, batch):
+        if self.is_head:
+            self._sequenced.clear()  # forget every sequenced write
+        original(self, batch)
+
+    monkeypatch.setattr(craq.ChainNode, "_process_write_batch", no_dedup)
+    failure = Simulator(CraqSimulated(), run_length=250,
+                        num_runs=100, minimize=False).run(seed=0)
+    assert failure is not None, (
+        "the CRAQ sim no longer catches stale-write resurrection")
+
+
+@pytest.mark.parametrize("weakened", [True, False])
+def test_horizontal_sim_catches_weakened_quorum(monkeypatch, weakened):
+    """Sanity pair: the weakened run fails, the honest run passes."""
+    from frankenpaxos_tpu.quorums import SimpleMajority
+
+    from .test_horizontal import HorizontalSimulated
+
+    if weakened:
+        monkeypatch.setattr(SimpleMajority,
+                            "is_superset_of_write_quorum",
+                            lambda self, nodes: len(nodes) >= 1)
+    failure = Simulator(HorizontalSimulated(), run_length=250,
+                        num_runs=100, minimize=False).run(seed=0)
+    if weakened:
+        assert failure is not None, (
+            "the Horizontal sim no longer catches a weakened quorum")
+    else:
+        assert failure is None, str(failure)

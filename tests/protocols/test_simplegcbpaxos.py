@@ -44,7 +44,8 @@ def make_gc_bpaxos(f=1, send_gc_every_n=3, seed=0, num_replicas=None,
                                   gc_backend=gc_backend)
                  for i, a in enumerate(config.proposer_addresses)]
     dep_nodes = [GcBPaxosDepServiceNode(a, transport, logger, config,
-                                        KeyValueStore())
+                                        KeyValueStore(),
+                                        gc_backend=gc_backend)
                  for a in config.dep_service_node_addresses]
     acceptors = [GcBPaxosAcceptor(a, transport, logger, config,
                                   gc_backend=gc_backend)
