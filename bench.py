@@ -26,11 +26,14 @@ BASELINE_CMDS_PER_SEC = 934_000.0
 
 WINDOW = 1 << 20          # 1M in-flight slots
 NUM_ACCEPTORS = 3         # f = 1, SimpleMajority
-# 16K-slot drains hold the per-drain latency near ~31us -- comfortable
-# margin under the 50us target even on a noisy chip -- while keeping
-# throughput hundreds of times over the reference baseline.
-BLOCK = 1 << 14
-ITERS = 8192
+# 64K-slot drains are the throughput-optimal point of the committed
+# frontier sweep (bench_results/block_sweep.json) whose per-drain
+# latency still clears the 50us target (~40us measured, ~37us once the
+# tunnel RTT amortizes). ITERS is sized so ITERS*BLOCK = 2^30 total
+# commits: large enough to swamp the ~0.1s dispatch+fetch RTT, small
+# enough that the int32 committed counter cannot wrap (2^31).
+BLOCK = 1 << 16
+ITERS = 16384
 
 
 def main() -> None:
