@@ -50,13 +50,19 @@ class VoteBoard(NamedTuple):
     """Per-slot vote-collection state for a window of slots.
 
     The window is a ring over slot space: column ``slot % window`` holds
-    slot ``slot`` (callers must GC -- see ``release`` -- before wrapping,
-    the device analog of util/BufferMap.scala:8-66's watermark contract).
+    slot ``slot``. Each column carries its current OWNER slot number, so
+    wrapping is self-reclaiming: a vote for a newer slot landing on a
+    column still holding ``slot - window`` clears the stale state in the
+    same kernel pass, and a straggler vote for a slot the ring has moved
+    past is dropped. This replaces the host-driven watermark GC the
+    reference needs (util/BufferMap.scala:8-66) -- no release() plumbing
+    is required for correctness, only ``window`` > max slots in flight.
     """
 
     votes: jax.Array   # [n, window] uint8: acceptor voted in `rounds[slot]`
     rounds: jax.Array  # [window] int32: highest round seen per slot
     chosen: jax.Array  # [window] bool: quorum already reached
+    owner: jax.Array   # [window] int32: slot currently occupying the column
 
 
 def make_vote_board(window: int, num_nodes: int) -> VoteBoard:
@@ -64,6 +70,7 @@ def make_vote_board(window: int, num_nodes: int) -> VoteBoard:
         votes=jnp.zeros((num_nodes, window), dtype=jnp.uint8),
         rounds=jnp.full((window,), -1, dtype=jnp.int32),
         chosen=jnp.zeros((window,), dtype=jnp.bool_),
+        owner=jnp.full((window,), -1, dtype=jnp.int32),
     )
 
 
@@ -75,10 +82,11 @@ def _quorum_hit(votes_block: jax.Array, masks: jax.Array,
     return satisfied.any(0) if combine_any else satisfied.all(0)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(5, 6))
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6, 7))
 def _record_and_check(
     board: VoteBoard,
     slots: jax.Array,      # [B] int32, already reduced mod window
+    true_slots: jax.Array,  # [B] int32 un-modded slot numbers (owner ids)
     nodes: jax.Array,      # [B] int32 acceptor rows
     vote_rounds: jax.Array,  # [B] int32
     valid: jax.Array,      # [B] bool (padding mask for partial batches)
@@ -90,35 +98,54 @@ def _record_and_check(
     thresholds, combine_any = meta
     thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
 
-    old_rounds = board.rounds[slots]                            # [B]
-    new_rounds = board.rounds.at[slots].max(
-        jnp.where(valid, vote_rounds, _NEG_INF32))
+    # Ring self-reclaim: a newer slot claims its column (clearing stale
+    # state from `slot - k*window`); votes for slots the column has moved
+    # past are dropped. All per-column derived values are identical for
+    # duplicate batch entries, so duplicate scatters are deterministic.
+    old_owner = board.owner[slots]                              # [B]
+    owner = board.owner.at[slots].max(
+        jnp.where(valid, true_slots, _NEG_INF32))
+    cur_owner = owner[slots]                                    # [B]
+    reclaimed = cur_owner > old_owner                           # [B]
+    mine = valid & (true_slots == cur_owner)
+    cols0 = board.votes[:, slots]                               # [N, B]
+    cols0 = jnp.where(reclaimed[None, :], jnp.uint8(0), cols0)
+    votes0 = board.votes.at[:, slots].set(cols0)
+    rounds0 = board.rounds.at[slots].set(
+        jnp.where(reclaimed, jnp.int32(-1), board.rounds[slots]))
+    chosen0 = board.chosen.at[slots].set(
+        jnp.where(reclaimed, False, board.chosen[slots]))
+
+    old_rounds = rounds0[slots]                                 # [B]
+    new_rounds = rounds0.at[slots].max(
+        jnp.where(mine, vote_rounds, _NEG_INF32))
     cur = new_rounds[slots]                                     # [B]
     # A newer round preempts: clear the slot's votes (ProxyLeader state is
     # per (slot, round); an old column must not count toward the new
     # round). `preempted` depends only on slot-level values, so duplicate
     # batch entries for one slot all scatter identical columns.
     preempted = cur > old_rounds                                # [B]
-    cols = board.votes[:, slots]                                # [N, B]
+    cols = votes0[:, slots]                                     # [N, B]
     cols = jnp.where(preempted[None, :], jnp.uint8(0), cols)
-    votes = board.votes.at[:, slots].set(cols)
+    votes = votes0.at[:, slots].set(cols)
     # Record votes that are for the slot's (possibly new) current round.
-    live = valid & (vote_rounds == cur)
+    live = mine & (vote_rounds == cur)
     votes = votes.at[nodes, slots].max(live.astype(jnp.uint8))
 
     # Quorum predicate for exactly the touched columns (duplicates are
     # fine: they see identical post-scatter state).
     hit = _quorum_hit(votes[:, slots], masks, thresholds, combine_any)
-    hit = hit & valid
-    newly = hit & ~board.chosen[slots]
-    chosen = board.chosen.at[slots].max(hit)
-    return VoteBoard(votes, new_rounds, chosen), newly
+    hit = hit & mine
+    newly = hit & ~chosen0[slots]
+    chosen = chosen0.at[slots].max(hit)
+    return VoteBoard(votes, new_rounds, chosen, owner), newly
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5, 6))
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(5, 6, 7))
 def _record_block(
     board: VoteBoard,
     start: jax.Array,        # [] int32 ring offset of the block
+    true_start: jax.Array,   # [] int32 slot number of column `start`
     block: jax.Array,        # [N, B] uint8 vote arrivals for these slots
     vote_round: jax.Array,   # [] int32: round all these votes belong to
     block_size: int,         # static
@@ -142,17 +169,29 @@ def _record_block(
     n = board.votes.shape[0]
 
     touched = block.any(axis=0)                                # [B]
+    # Ring self-reclaim (see VoteBoard): claim columns still owned by an
+    # older slot; drop votes for slots the column has moved past.
+    slot_ids = true_start + jnp.arange(block_size, dtype=jnp.int32)
+    old_owner = jax.lax.dynamic_slice(board.owner, (start,), (block_size,))
+    claim = touched & (slot_ids > old_owner)
+    stale = touched & (slot_ids < old_owner)
+    touched = touched & ~stale
+    new_owner = jnp.where(claim, slot_ids, old_owner)
+    block = block & touched[None, :].astype(jnp.uint8)
+
     old_rounds = jax.lax.dynamic_slice(board.rounds, (start,), (block_size,))
+    old_rounds = jnp.where(claim, jnp.int32(-1), old_rounds)
     new_rounds = jnp.where(touched,
                            jnp.maximum(old_rounds, vote_round), old_rounds)
     preempted = new_rounds > old_rounds
     cols = jax.lax.dynamic_slice(board.votes, (0, start), (n, block_size))
-    cols = jnp.where(preempted[None, :], jnp.uint8(0), cols)
+    cols = jnp.where((claim | preempted)[None, :], jnp.uint8(0), cols)
     live = touched & (vote_round == new_rounds)                # [B]
     cols = cols | (block & live[None, :].astype(jnp.uint8))
 
     hit = _quorum_hit(cols, masks, thresholds, combine_any)
     old_chosen = jax.lax.dynamic_slice(board.chosen, (start,), (block_size,))
+    old_chosen = jnp.where(claim, False, old_chosen)
     newly = hit & ~old_chosen & touched
     return VoteBoard(
         votes=jax.lax.dynamic_update_slice(board.votes, cols, (0, start)),
@@ -160,6 +199,8 @@ def _record_block(
                                             (start,)),
         chosen=jax.lax.dynamic_update_slice(board.chosen, hit | old_chosen,
                                             (start,)),
+        owner=jax.lax.dynamic_update_slice(board.owner, new_owner,
+                                           (start,)),
     ), newly
 
 
@@ -173,7 +214,9 @@ def _release(board: VoteBoard, slots: jax.Array, valid: jax.Array) -> VoteBoard:
         jnp.where(valid, jnp.int32(-1), board.rounds[slots]))
     chosen = board.chosen.at[slots].set(
         jnp.where(valid, False, board.chosen[slots]))
-    return VoteBoard(votes, rounds, chosen)
+    owner = board.owner.at[slots].set(
+        jnp.where(valid, jnp.int32(-1), board.owner[slots]))
+    return VoteBoard(votes, rounds, chosen, owner)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -226,7 +269,15 @@ class TpuQuorumChecker:
     One call per event-loop drain, thousands of votes per call.
     """
 
-    def __init__(self, spec: QuorumSpec, window: int):
+    def __init__(self, spec: QuorumSpec, window: int, mesh=None):
+        """``mesh``: an optional ``jax.sharding.Mesh``. When given, the
+        vote board's SLOT axis shards over every mesh axis (the
+        slot-partitioning scaling axis, SURVEY.md section 2.3 /
+        multipaxos/DistributionScheme): each device holds
+        ``window / mesh.size`` columns and XLA's partitioner inserts the
+        collectives for cross-shard scatters and block updates. Results
+        are bit-identical to the unsharded board (asserted by
+        tests/test_multichip_checker.py)."""
         if window <= 0:
             raise ValueError("window must be positive")
         self.spec = spec
@@ -234,6 +285,22 @@ class TpuQuorumChecker:
         self.num_nodes = spec.num_nodes
         self._masks_t, self._meta = _spec_statics(spec)
         self.board = make_vote_board(window, spec.num_nodes)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if window % mesh.size != 0:
+                raise ValueError(f"window {window} must be a multiple of "
+                                 f"the mesh size {mesh.size}")
+            axes = tuple(mesh.axis_names)
+            slot_sharded = NamedSharding(mesh, PartitionSpec(axes))
+            self.board = VoteBoard(
+                votes=jax.device_put(
+                    self.board.votes,
+                    NamedSharding(mesh, PartitionSpec(None, axes))),
+                rounds=jax.device_put(self.board.rounds, slot_sharded),
+                chosen=jax.device_put(self.board.chosen, slot_sharded),
+                owner=jax.device_put(self.board.owner, slot_sharded),
+            )
 
     def record_block_async(self, start_slot: int, block: np.ndarray,
                            vote_round: int = 0) -> jax.Array:
@@ -264,7 +331,8 @@ class TpuQuorumChecker:
         else:
             padded = b
         self.board, newly = _record_block(
-            self.board, jnp.int32(start), jnp.asarray(block, dtype=jnp.uint8),
+            self.board, jnp.int32(start), jnp.int32(start_slot),
+            jnp.asarray(block, dtype=jnp.uint8),
             jnp.int32(vote_round), padded, self._masks_t, self._meta)
         return newly
 
@@ -304,15 +372,18 @@ class TpuQuorumChecker:
                 pad_to *= 2
         size = max(pad_to, b)
         slots_p = np.zeros(size, dtype=np.int32)
+        true_p = np.zeros(size, dtype=np.int32)
         nodes_p = np.zeros(size, dtype=np.int32)
         rounds_p = np.zeros(size, dtype=np.int32)
         valid = np.zeros(size, dtype=bool)
         slots_p[:b] = slots % self.window
+        true_p[:b] = slots
         nodes_p[:b] = np.asarray(node_cols, dtype=np.int32)
         rounds_p[:b] = np.asarray(rounds, dtype=np.int32)
         valid[:b] = True
         self.board, newly = _record_and_check(
-            self.board, jnp.asarray(slots_p), jnp.asarray(nodes_p),
+            self.board, jnp.asarray(slots_p), jnp.asarray(true_p),
+            jnp.asarray(nodes_p),
             jnp.asarray(rounds_p), jnp.asarray(valid),
             self._masks_t, self._meta)
         return newly

@@ -78,8 +78,12 @@ class ProxyLeader(Actor):
         self._flush_timer = None
         self._collector = None
         if options.quorum_backend == "tpu" and options.tpu_pipelined:
-            loop = getattr(transport, "loop", None)
-            if loop is not None:
+            # Branch on the transport's CAPABILITY (threaded event loop),
+            # not on whether its loop happens to exist yet: a TcpTransport
+            # actor constructed before start() must still get the
+            # collector thread, and a SimTransport must never (its actors
+            # run inline on the caller's thread).
+            if transport.threaded:
                 # Real transport: fetch device results on ONE daemon
                 # worker thread (preserving dispatch order) and post
                 # each completion back onto the event loop, so the loop

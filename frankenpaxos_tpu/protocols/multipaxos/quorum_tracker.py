@@ -84,7 +84,7 @@ class TpuQuorumTracker(QuorumTracker):
     link -- at the cost of one dispatch of added choose latency."""
 
     def __init__(self, config: MultiPaxosConfig, window: int = 1 << 20,
-                 pipelined: bool = False):
+                 pipelined: bool = False, mesh=None):
         import collections
 
         self.config = config
@@ -109,28 +109,40 @@ class TpuQuorumTracker(QuorumTracker):
         # (it costs seconds of startup per process).
         from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker
 
-        self.checker = TpuQuorumChecker(spec, window=window)
+        self.checker = TpuQuorumChecker(spec, window=window, mesh=mesh)
         self._slots: list[int] = []
         self._cols: list[int] = []
         self._rounds: list[int] = []
-        # Pre-compile the smallest (64-wide) dense and sparse kernels at
-        # construction -- before client traffic -- so the first real
-        # drains don't stall several seconds on XLA compiles. Votes land
-        # at round -1 (below any real round), and release() clears the
-        # touched columns.
-        # Max columns per device call: oversized drains are chunked to
-        # this, so ONLY the prewarmed kernel buckets (64, max_chunk)
-        # ever compile -- an unexpected width compiling mid-run stalls
-        # the event loop for seconds over a remote device link.
+        # Kernel width buckets. Drains are chunked to these so ONLY the
+        # prewarmed widths ever compile -- an unexpected width compiling
+        # mid-run stalls the event loop for seconds over a remote device
+        # link. Dense buckets go wide (a contiguous 4k-slot run is one
+        # slice+matmul call); the sparse scatter tail stays narrow.
         self.max_chunk = 256
-        for width in (1, self.max_chunk):
+        self.dense_buckets = tuple(
+            b for b in (64, 256, 1024, 4096) if b <= window)
+        if not self.dense_buckets:
+            raise ValueError(f"window must be >= 64 (got {window}): the "
+                             f"smallest prewarmed dense kernel bucket is "
+                             f"64 columns")
+        self.max_dense = self.dense_buckets[-1]
+        # A dominant-round cluster goes dense when it's at least this
+        # filled; emptier clusters cost fewer device calls via scatter.
+        self.min_fill = 0.25
+        # Pre-compile every bucket at construction -- before client
+        # traffic -- so the first real drains don't stall on XLA
+        # compiles. Votes land at round -1 (below any real round), and
+        # release() clears the touched columns (including the ring
+        # owners the prewarm claimed).
+        for width in self.dense_buckets:
             warm = np.zeros((self.checker.num_nodes, width),
                             dtype=np.uint8)
             warm[0, 0] = 1
             self.checker.record_block(0, warm, vote_round=-1)
+        for width in (1, self.max_chunk):
             self.checker.record_and_check([0] * width, [0] * width,
                                           [-1] * width)
-        self.checker.release(np.arange(self.max_chunk))
+        self.checker.release(np.arange(self.max_dense))
 
     def record(self, slot, round, group_index, acceptor_index) -> None:
         self._slots.append(slot)
@@ -138,61 +150,127 @@ class TpuQuorumTracker(QuorumTracker):
         self._rounds.append(round)
 
     def drain(self) -> list[tuple[int, int]]:
-        """One device call (ideally) per event-loop drain.
+        """A handful of device calls (ideally one) per event-loop drain.
 
-        Steady-state Phase2b streams cover a contiguous slot run in one
+        Steady-state Phase2b streams cover contiguous slot runs in one
         round (Leader.scala:331-408 allocates slots contiguously), which
-        maps onto the dense ``record_block`` path -- a slice update plus
-        one matmul, no scatter. Votes outside the dominant round or a
-        sufficiently dense run fall back to the sparse scatter path.
+        map onto the dense ``record_block`` path -- a slice update plus
+        one matmul, no scatter. The drain's dominant round is sorted and
+        clustered into dense runs chunked at prewarmed bucket widths (up
+        to ``max_dense`` slots per call); sparse stragglers and
+        off-round votes go through the scatter path. Sparse votes in
+        rounds OLDER than the dominant round dispatch BEFORE the dense
+        block so an old-round quorum completing in this drain is
+        reported before the newer round's preemption clears it
+        (matching DictQuorumTracker's arrival-order liveness).
         """
         if not self._slots:
             return []
         slots = np.asarray(self._slots, dtype=np.int64)
         cols = np.asarray(self._cols, dtype=np.int32)
         rounds = np.asarray(self._rounds, dtype=np.int32)
-        device_parts = []  # (index array into this drain, device mask)
+        device_parts = []  # (index array into this drain, device mask,
+        #                     positions into the mask)
 
-        # Dense candidate: the drain's dominant round.
-        round_values, round_counts = np.unique(rounds, return_counts=True)
-        dom = int(round_values[np.argmax(round_counts)])
-        dense = rounds == dom
-        lo = int(slots[dense].min())
-        hi = int(slots[dense].max())
-        width = hi - lo + 1
-        window = self.checker.window
-        # Worth the dense path when the run is reasonably filled, fits a
-        # prewarmed kernel bucket, and doesn't straddle the ring end
-        # (record_block's contract).
-        bucket = 64 if width <= 64 else self.max_chunk
-        if (width <= min(self.max_chunk, max(64, 4 * int(dense.sum())))
-                and lo % window + bucket <= window):
-            # Build the block at the prewarmed bucket width directly
-            # (all-zero padding columns are untouched by the kernel).
-            block = np.zeros((self.checker.num_nodes, bucket),
-                             dtype=np.uint8)
-            block[cols[dense], slots[dense] - lo] = 1
-            newly = self.checker.record_block_async(lo, block,
-                                                    vote_round=dom)
-            # Device results stay at the padded bucket shape; the
-            # per-vote positions are applied host-side in collect() (a
-            # device gather here would compile per distinct length).
-            device_parts.append((np.flatnonzero(dense), newly,
-                                 slots[dense] - lo))
-            rest = ~dense
+        # The drain's dominant round (fast path: single-round drain).
+        if rounds[0] == rounds[-1] and (rounds == rounds[0]).all():
+            dom = int(rounds[0])
+            # Steady-state fast path: one round, one reasonably filled
+            # contiguous run fitting one dense bucket -- skip the sort
+            # and cluster walk entirely (the common shape: a wave of
+            # Phase2bs for the leader's latest contiguous slot block).
+            lo = int(slots.min())
+            hi = int(slots.max())
+            width = hi - lo + 1
+            window = self.checker.window
+            bucket = next((b for b in self.dense_buckets if b >= width),
+                          None) if width <= self.max_dense else None
+            if (bucket is not None
+                    and slots.shape[0] >= width * self.min_fill
+                    and lo % window + bucket <= window):
+                block = np.zeros((self.checker.num_nodes, bucket),
+                                 dtype=np.uint8)
+                block[cols, slots - lo] = 1
+                newly = self.checker.record_block_async(lo, block,
+                                                        vote_round=dom)
+                device_parts.append((np.arange(slots.shape[0]), newly,
+                                     slots - lo))
+                dispatch = (self._slots, self._rounds, device_parts)
+                self._slots, self._cols, self._rounds = [], [], []
+                if self.pipelined:
+                    self._inflight.append(dispatch)
+                    return []
+                return self.collect(dispatch)
+            dense_idx = np.arange(slots.shape[0])
+            pre = post = None
         else:
-            rest = np.ones(slots.shape[0], dtype=bool)
-        rest_index = np.flatnonzero(rest)
-        # Chunk the sparse tail so only prewarmed buckets ever run.
-        for at in range(0, rest_index.size, self.max_chunk):
-            chunk = rest_index[at:at + self.max_chunk]
-            device_parts.append((chunk,
-                                 self.checker.record_and_check_async(
-                                     slots[chunk], cols[chunk],
-                                     rounds[chunk],
-                                     pad_to=(64 if chunk.size <= 64
-                                             else self.max_chunk)),
-                                 np.arange(chunk.size)))
+            round_values, round_counts = np.unique(rounds,
+                                                   return_counts=True)
+            dom = int(round_values[np.argmax(round_counts)])
+            dense_idx = np.flatnonzero(rounds == dom)
+            pre = np.flatnonzero(rounds < dom)
+            post = np.flatnonzero(rounds > dom)
+        if pre is not None and pre.size:
+            self._dispatch_sparse(device_parts, slots, cols, rounds, pre)
+
+        # Cluster the dominant round's slots into contiguous runs.
+        ds = slots[dense_idx]
+        if ds.size and np.all(ds[:-1] <= ds[1:]):  # arrival order is
+            sidx = dense_idx                       # already slot-sorted
+            ss = ds
+        else:
+            order = np.argsort(ds, kind="stable")
+            sidx = dense_idx[order]
+            ss = ds[order]
+        window = self.checker.window
+        sparse_leftover = []
+        cluster_bounds = np.flatnonzero(np.diff(ss) >= self.max_dense) + 1
+        for cluster in np.split(np.arange(sidx.size), cluster_bounds):
+            cl = sidx[cluster]
+            cs = ss[cluster]
+            hi = int(cs[-1])
+            width = hi - int(cs[0]) + 1
+            if cl.size < width * self.min_fill:
+                sparse_leftover.append(cl)
+                continue
+            # Chunk the run at bucket widths, breaking at the ring end
+            # (record_block's no-straddle contract). Each chunk starts
+            # at an actual member slot, so the loop is O(#chunks).
+            i = 0
+            while i < cs.size:
+                start = int(cs[i])
+                room = window - start % window
+                remaining = hi - start + 1
+                bucket = next((b for b in self.dense_buckets
+                               if b >= min(remaining, self.max_dense)
+                               and b <= room), None)
+                if bucket is None:
+                    bucket = max((b for b in self.dense_buckets
+                                  if b <= room), default=None)
+                    if bucket is None:  # < 64 columns to the ring end
+                        j = int(np.searchsorted(cs, start + room))
+                        sparse_leftover.append(cl[i:j])
+                        i = j
+                        continue
+                j = int(np.searchsorted(cs, start + bucket))
+                members = cl[i:j]
+                block = np.zeros(
+                    (self.checker.num_nodes, bucket), dtype=np.uint8)
+                block[cols[members], slots[members] - start] = 1
+                newly = self.checker.record_block_async(
+                    start, block, vote_round=dom)
+                # Device results stay at the padded bucket shape;
+                # per-vote positions are applied host-side in collect()
+                # (a device gather here would compile per distinct
+                # length).
+                device_parts.append((members, newly,
+                                     slots[members] - start))
+                i = j
+
+        for cl in sparse_leftover:
+            self._dispatch_sparse(device_parts, slots, cols, rounds, cl)
+        if post is not None and post.size:
+            self._dispatch_sparse(device_parts, slots, cols, rounds, post)
 
         dispatch = (self._slots, self._rounds, device_parts)
         self._slots, self._cols, self._rounds = [], [], []
@@ -200,6 +278,19 @@ class TpuQuorumTracker(QuorumTracker):
             self._inflight.append(dispatch)
             return []
         return self.collect(dispatch)
+
+    def _dispatch_sparse(self, device_parts, slots, cols, rounds,
+                         idx) -> None:
+        """Scatter-path dispatch, chunked so only prewarmed widths run."""
+        for at in range(0, idx.size, self.max_chunk):
+            chunk = idx[at:at + self.max_chunk]
+            device_parts.append((chunk,
+                                 self.checker.record_and_check_async(
+                                     slots[chunk], cols[chunk],
+                                     rounds[chunk],
+                                     pad_to=(64 if chunk.size <= 64
+                                             else self.max_chunk)),
+                                 np.arange(chunk.size)))
 
     def has_pending(self) -> bool:
         return bool(self._inflight)
@@ -214,15 +305,18 @@ class TpuQuorumTracker(QuorumTracker):
 
     def collect(self, dispatch) -> list[tuple[int, int]]:
         """Fetch a dispatch's results (blocking on the device if they
-        are not done yet) and dedup per slot."""
+        are not done yet) and dedup per slot (keeping each slot's first
+        reporting round in dispatch order, as the dict oracle does)."""
         drain_slots, drain_rounds, device_parts = dispatch
         hits = np.zeros(len(drain_slots), dtype=bool)
         for index, mask, positions in device_parts:
             hits[index] = np.asarray(mask)[positions]
-        out: list[tuple[int, int]] = []
-        seen: set[int] = set()
-        for slot, round, hit in zip(drain_slots, drain_rounds, hits):
-            if hit and slot not in seen:
-                seen.add(slot)
-                out.append((slot, round))
-        return out
+        hit_idx = np.flatnonzero(hits)
+        if hit_idx.size == 0:
+            return []
+        slots = np.asarray(drain_slots, dtype=np.int64)[hit_idx]
+        _, first = np.unique(slots, return_index=True)
+        sel = hit_idx[np.sort(first)]
+        rounds = np.asarray(drain_rounds, dtype=np.int64)
+        return list(zip(np.asarray(drain_slots, dtype=np.int64)[sel]
+                        .tolist(), rounds[sel].tolist()))

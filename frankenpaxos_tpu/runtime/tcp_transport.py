@@ -95,6 +95,8 @@ class TcpTransport(Transport):
     """Run the loop either externally (``await serve()``) or on a daemon
     thread (``start()``) for synchronous callers like the CLI mains."""
 
+    threaded = True
+
     def __init__(self, listen_address: Optional[Address] = None,
                  logger: Optional[Logger] = None):
         self.logger = logger or PrintLogger()
@@ -231,8 +233,14 @@ class TcpTransport(Transport):
         self.actors[address] = actor
         if self.loop is not None and address not in self._servers \
                 and isinstance(address, tuple):
-            if threading.get_ident() == getattr(self.loop, "_thread_id",
-                                                None):
+            # On-loop detection must not rely on private loop attributes
+            # (loop._thread_id is CPython-internal): ask asyncio whether
+            # THIS thread is currently running our loop.
+            try:
+                on_loop = asyncio.get_running_loop() is self.loop
+            except RuntimeError:
+                on_loop = False
+            if on_loop:
                 task = self.loop.create_task(self._bind(address))
                 task.add_done_callback(
                     lambda t: (not t.cancelled() and t.exception())

@@ -47,6 +47,12 @@ class Transport(abc.ABC):
     """Asynchronous, unordered, at-most-once message delivery between
     registered actors, plus timers -- all on one event loop."""
 
+    # True for transports that run a real event-loop thread (TcpTransport):
+    # actors may then offload blocking work to worker threads and post
+    # results back with call_soon_threadsafe. SimTransport runs inline on
+    # the caller's thread, so everything must stay synchronous.
+    threaded: bool = False
+
     @abc.abstractmethod
     def register(self, address: Address, actor: "Actor") -> None:
         """Register ``actor`` to receive messages addressed to ``address``.

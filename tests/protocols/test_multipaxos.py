@@ -430,6 +430,85 @@ def test_quorum_tracker_dense_and_sparse_paths_match_dict():
                 sorted(tpu_tracker.drain()), (seed, cursor)
 
 
+def test_quorum_tracker_ring_wrap_self_reclaims():
+    """Advisor-found wedge: once slot numbers pass the vote-board
+    window, the ring wraps onto columns still holding state from
+    ``slot - window``. The board's owner mechanism must reclaim those
+    columns in-kernel (no host GC plumbing), so quorums keep being
+    reported for many windows' worth of slots."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        DictQuorumTracker,
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    window = 256
+    dict_tracker = DictQuorumTracker(sim.config)
+    tpu_tracker = TpuQuorumTracker(sim.config, window=window)
+    # Drive 8 windows of slots through in dense runs of 32.
+    for base in range(0, 8 * window, 32):
+        for t in (dict_tracker, tpu_tracker):
+            for slot in range(base, base + 32):
+                t.record(slot, 0, 0, 0)
+                t.record(slot, 0, 0, 1)
+        assert sorted(dict_tracker.drain()) == sorted(tpu_tracker.drain())
+    # Sparse wrap: a straggler vote for a long-dead slot must be dropped
+    # (its column has moved on), not clear the column's current state.
+    half1 = window // 2
+    tpu_tracker.record(half1, 0, 0, 0)  # ancient slot, wrapped 7 times
+    assert tpu_tracker.drain() == []
+    live = 8 * window + 5
+    for t in (dict_tracker, tpu_tracker):
+        t.record(live, 0, 0, 0)
+        t.record(live, 0, 0, 2)
+    assert sorted(dict_tracker.drain()) == sorted(tpu_tracker.drain()) \
+        == [(live, 0)]
+
+
+def test_quorum_tracker_mixed_round_drain_reports_old_quorum():
+    """Advisor-found ordering gap: when one drain carries BOTH the
+    completing vote of an older round's quorum and a newer-round vote
+    for the same slot, the dict oracle (arrival order) reports the old
+    quorum; the device path must dispatch older-round sparse votes
+    before the dense dominant-round block so it reports it too."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        DictQuorumTracker,
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    for tracker_cls in (DictQuorumTracker,
+                        lambda c: TpuQuorumTracker(c, window=1 << 10)):
+        t = tracker_cls(sim.config)
+        # Round 0: slot 5 has one of two votes.
+        t.record(5, 0, 0, 0)
+        assert t.drain() == []
+        # One drain: slot 5's completing round-0 vote arrives first,
+        # then a wave of round-1 votes (the dominant round) including
+        # slot 5. Arrival-order semantics: (5, 0) reached quorum.
+        t.record(5, 0, 0, 1)
+        for slot in range(4, 8):
+            t.record(slot, 1, 0, 0)
+        out = t.drain()
+        assert (5, 0) in out, (tracker_cls, out)
+
+
+def test_sim_transport_coalesced_waves_match_serial():
+    """deliver_all_coalesced (event-loop drain granularity) commits the
+    same commands as per-message deliver_all."""
+    sim = make_multipaxos(f=1, quorum_backend="tpu")
+    got = []
+    for batch in range(3):
+        for p in range(8):
+            sim.clients[0].write(p, b"b%d.%d" % (batch, p), got.append)
+        sim.transport.deliver_all_coalesced()
+    assert len(got) == 24
+    from tests.protocols.multipaxos_harness import executed_prefix
+    logs = [executed_prefix(r) for r in sim.replicas]
+    assert logs[0] == logs[1]
+    assert len(logs[0]) >= 24
+
+
 def test_quorum_tracker_gap_slot_keeps_old_round_votes():
     """Reviewer-found regression: the dense record_block path must not
     bump the round of gap slots inside the run (they received no vote
