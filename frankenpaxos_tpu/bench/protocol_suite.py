@@ -42,6 +42,21 @@ from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, get_protocol
 SINGLE_DECREE = ("paxos", "fastpaxos", "matchmakerpaxos")
 LAUNCH_OVERRIDES = {
     "batchedunreplicated": {"batch_size": "1"},
+    # Idle leader groups must skip their slots PROMPTLY or every command
+    # waits on the replicas' ~1s hole-recover timer: the reference's own
+    # LT sweeps run with watermark gossip every 1-20 commands and a skip
+    # threshold of 1 slot (benchmarks/mencius/eurosys_lt.py:107-108
+    # sweep values; Leader.scala code defaults of 10000 are for paper
+    # peak-throughput points, not latency).
+    "mencius": {"send_high_watermark_every_n": "1",
+                "send_noop_range_if_lagging_by": "1"},
+    # Dueling-proposer nack backoff sized for localhost RTT (~0.1ms):
+    # the reference's 100ms-1s defaults (caspaxos/Leader.scala:29-30)
+    # assume datacenter links and park a nacked leader for seconds of
+    # benchmark time.
+    "caspaxos": {"resend_period_s": "0.25",
+                 "recover_min_period_s": "0.002",
+                 "recover_max_period_s": "0.02"},
 }
 
 

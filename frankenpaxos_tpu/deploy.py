@@ -547,6 +547,7 @@ def _fastmultipaxos() -> Protocol:
                 lambda c: list(c.leader_addresses),
                 lambda ctx, a, i: m.FastMultiPaxosLeader(
                     a, ctx.transport, ctx.logger, ctx.config, ctx.sm(),
+                    options=ctx.opts(m.FastMultiPaxosLeaderOptions),
                     seed=ctx.seed)),
             "acceptor": Role(
                 lambda c: list(c.acceptor_addresses),
@@ -560,6 +561,14 @@ def _fastmultipaxos() -> Protocol:
         drive=lambda client, tag, cb: client.propose(b"w%d" % tag, cb),
         cluster=lambda f, port: {
             "f": f,
+            # The reference's own committed benchmarks deploy
+            # FastMultiPaxos with the classic round-robin round system
+            # (benchmarks/fastmultipaxos/smoke.py:17,
+            # nsdi_fig1_lt.py:17): concurrent clients proposing
+            # directly to acceptors in a fast round vote at offset
+            # next_slots and wedge until recovery. Tests exercising the
+            # fast path build round_zero_fast configs directly.
+            "round_system": "classic_round_robin",
             "leaders": [port() for _ in range(f + 1)],
             "leader_elections": [port() for _ in range(f + 1)],
             "leader_heartbeats": [port() for _ in range(f + 1)],

@@ -87,9 +87,12 @@ class Nack:
 class CasPaxosLeader(Actor):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: CasPaxosConfig,
-                 resend_period_s: float = 5.0,
-                 recover_min_period_s: float = 5.0,
-                 recover_max_period_s: float = 10.0, seed: int = 0):
+                 resend_period_s: float = 1.0,
+                 recover_min_period_s: float = 0.1,
+                 recover_max_period_s: float = 1.0, seed: int = 0):
+        # Defaults mirror the reference (caspaxos/Leader.scala:27-30:
+        # resend 1s, nack sleep 100ms-1s); deployments tune them to
+        # their network RTT.
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config

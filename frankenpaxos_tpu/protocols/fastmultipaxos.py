@@ -117,6 +117,9 @@ class ProposeRequest:
 class ProposeReply:
     command_id: CommandId
     result: bytes
+    # The replying leader's round: clients track it to route classic-
+    # round proposals to the right leader (Client.scala:92-103, :182).
+    round: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -380,6 +383,10 @@ class FastMultiPaxosLeaderOptions:
     means send to every acceptor."""
 
     thrifty_system: Optional[ThriftySystem] = None
+    resend_phase1as_period_s: float = 5.0
+    # Also the fast-stuck detection period: a fast round that makes no
+    # progress for a full period falls back to a classic round.
+    resend_phase2as_period_s: float = 5.0
 
 
 class FastMultiPaxosLeader(Actor):
@@ -448,9 +455,11 @@ class FastMultiPaxosLeader(Actor):
 
         self._last_progress = (-1, -1)
         self.resend_phase1as_timer = self.timer(
-            "resendPhase1as", 5.0, resend_phase1as)
+            "resendPhase1as", options.resend_phase1as_period_s,
+            resend_phase1as)
         self.resend_phase2as_timer = self.timer(
-            "resendPhase2as", 5.0, resend_phase2as)
+            "resendPhase2as", options.resend_phase2as_period_s,
+            resend_phase2as)
         self.election = RaftElectionParticipant(
             config.leader_election_addresses[self.leader_id], transport,
             logger, list(config.leader_election_addresses),
@@ -587,7 +596,8 @@ class FastMultiPaxosLeader(Actor):
                                                          result)
             if self.state is not None:  # only the active leader replies
                 self.send(cid.client_address,
-                          ProposeReply(command_id=cid, result=result))
+                          ProposeReply(command_id=cid, result=result,
+                                       round=self.round))
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
@@ -613,7 +623,8 @@ class FastMultiPaxosLeader(Actor):
         cached = self.client_table.get(cid.client_address)
         if cached is not None and cid.client_id == cached[0]:
             self.send(cid.client_address,
-                      ProposeReply(command_id=cid, result=cached[1]))
+                      ProposeReply(command_id=cid, result=cached[1],
+                                   round=self.round))
             return
         if isinstance(self.state, _Phase1State):
             self.state.pending_proposals.append((src, request.command))
@@ -724,8 +735,12 @@ class _Pending:
 
 
 class FastMultiPaxosClient(Actor):
-    """Proposes to every acceptor (fast path) and falls back to the
-    leaders via resends."""
+    """Routes by its guess of the current round (Client.scala:92-103,
+    :216-223): FAST rounds propose straight to every acceptor; CLASSIC
+    rounds propose to the round's leader (acceptors ignore direct
+    proposals outside fast rounds, so sending them there would strand
+    the command until the resend timer). The guess updates from each
+    ProposeReply; resends cover a stale guess."""
 
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: FastMultiPaxosConfig,
@@ -735,8 +750,18 @@ class FastMultiPaxosClient(Actor):
         self.config = config
         self.rng = random.Random(seed)
         self.resend_period_s = resend_period_s
+        self.round = 0
         self.next_id = 0
         self.pending: Optional[_Pending] = None
+
+    def _send_proposal(self, request: ProposeRequest) -> None:
+        rs = self.config.round_system
+        if rs.round_type(self.round) == RoundType.FAST:
+            for acceptor in self.config.acceptor_addresses:
+                self.send(acceptor, request)
+        else:
+            self.send(self.config.leader_addresses[rs.leader(self.round)],
+                      request)
 
     def propose(self, command: bytes,
                 callback: Optional[Callable[[bytes], None]] = None) -> None:
@@ -746,8 +771,7 @@ class FastMultiPaxosClient(Actor):
         self.next_id += 1
         request = ProposeRequest(Command(CommandId(self.address, id),
                                          command))
-        for acceptor in self.config.acceptor_addresses:
-            self.send(acceptor, request)
+        self._send_proposal(request)
 
         def resend():
             for leader in self.config.leader_addresses:
@@ -764,6 +788,7 @@ class FastMultiPaxosClient(Actor):
     def receive(self, src: Address, message) -> None:
         if not isinstance(message, ProposeReply):
             self.logger.fatal(f"unexpected client message {message!r}")
+        self.round = max(self.round, message.round)
         if self.pending is None \
                 or message.command_id.client_id != self.pending.id:
             return

@@ -134,11 +134,9 @@ def test_thrifty_classic_phase2as_hit_quorum_size_acceptors():
     transport.deliver_all()  # phase 1 of classic round 0
     got = []
     client.propose(b"thrifty", got.append)
-    transport.deliver_all()  # acceptors ignore the fast-path attempt
-    # Classic rounds reach the leader via the client's resend fallback.
-    for timer in list(transport.running_timers()):
-        if timer.name.startswith("resend-"):
-            transport.trigger_timer(timer.id)
+    # The client routes classic-round proposals straight to the round's
+    # leader (Client.scala:216-223); pump until the leader's Phase2as
+    # are in flight to the acceptors.
     while transport.messages:
         message = transport.messages[0]
         if message.dst.startswith("acceptor-"):
