@@ -622,9 +622,15 @@ class FastMultiPaxosLeader(Actor):
         cid = request.command.command_id
         cached = self.client_table.get(cid.client_address)
         if cached is not None and cid.client_id == cached[0]:
-            self.send(cid.client_address,
-                      ProposeReply(command_id=cid, result=cached[1],
-                                   round=self.round))
+            # Only the ACTIVE leader replies (matching _execute_log): a
+            # deposed leader's self.round may never have been
+            # established at any acceptor, and the client adopts reply
+            # rounds monotonically -- a stale reply would permanently
+            # misroute its classic-round proposals to this dead leader.
+            if self.state is not None:
+                self.send(cid.client_address,
+                          ProposeReply(command_id=cid, result=cached[1],
+                                       round=self.round))
             return
         if isinstance(self.state, _Phase1State):
             self.state.pending_proposals.append((src, request.command))

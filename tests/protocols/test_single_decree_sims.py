@@ -1,0 +1,396 @@
+"""Simulator-framework harnesses for the single-decree protocols.
+
+The reference gives paxos, fastpaxos, caspaxos, and matchmakerpaxos full
+SimulatedSystem treatments (shared/src/test/scala/{paxos,fastpaxos,
+caspaxos,matchmakerpaxos}/*Test.scala: 500 runs x 250 steps under the
+Simulator with shrinking). These harnesses match that: randomized
+proposal/delivery/timer interleavings, a per-step chosen-value safety
+invariant, trace minimization on failure, and one mutation-sensitivity
+probe per protocol proving the sim can actually catch its protocol's
+core safety mechanism being broken.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+import pytest
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+
+from .sim_util import TransportCmd
+
+# Soak scale matching the reference Simulator defaults
+# (Simulator.scala:221-266 usage in the per-protocol tests).
+NUM_RUNS = 500
+RUN_LENGTH = 250
+
+
+class ProposeCmd:
+    def __init__(self, client: int, value):
+        self.client = client
+        self.value = value
+
+    def __repr__(self):
+        return f"Propose({self.client}, {self.value!r})"
+
+
+class SingleDecreeSim(SimulatedSystem):
+    """Interleaves one-shot client proposals with transport commands
+    (deliver any message, fire any timer); the invariant is the
+    single-decree contract: at most one value is ever chosen, and a
+    chosen value never changes."""
+
+    num_clients = 3
+    transport_weight = 8
+
+    def make_system(self, seed: int) -> dict:
+        raise NotImplementedError
+
+    def chosen_values(self, system: dict) -> set:
+        raise NotImplementedError
+
+    def propose(self, system: dict, command: ProposeCmd) -> None:
+        raise NotImplementedError
+
+    # --- SimulatedSystem ----------------------------------------------------
+    def new_system(self, seed: int) -> dict:
+        system = self.make_system(seed)
+        system.setdefault("proposed", set())
+        return system
+
+    def generate_command(self, system: dict, rng: random.Random):
+        choices = []
+        idle = [c for c in range(self.num_clients)
+                if c not in system["proposed"]]
+        if idle:
+            choices.append("propose")
+        transport_cmd = system["transport"].generate_command(rng)
+        if transport_cmd is not None:
+            choices.extend(["transport"] * self.transport_weight)
+        if not choices:
+            return None
+        if rng.choice(choices) == "propose":
+            client = rng.choice(idle)
+            return ProposeCmd(client, f"v{client}")
+        return TransportCmd(transport_cmd)
+
+    def run_command(self, system: dict, command) -> dict:
+        if isinstance(command, ProposeCmd):
+            if command.client not in system["proposed"]:
+                system["proposed"].add(command.client)
+                self.propose(system, command)
+        else:
+            system["transport"].run_command(command.command)
+        return system
+
+    def get_state(self, system: dict):
+        return frozenset(self.chosen_values(system))
+
+    def state_invariant(self, system: dict) -> Optional[str]:
+        chosen = self.chosen_values(system)
+        if len(chosen) > 1:
+            return f"more than one value chosen: {sorted(chosen)!r}"
+        return None
+
+    def step_invariant(self, old_state, new_state) -> Optional[str]:
+        if not old_state <= new_state:
+            return (f"a chosen value changed: {set(old_state)!r} -> "
+                    f"{set(new_state)!r}")
+        return None
+
+
+# --- Paxos ------------------------------------------------------------------
+
+
+class PaxosSimulated(SingleDecreeSim):
+    def make_system(self, seed: int) -> dict:
+        from frankenpaxos_tpu.protocols.paxos import (
+            PaxosAcceptor,
+            PaxosClient,
+            PaxosConfig,
+            PaxosLeader,
+        )
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        f = 1
+        config = PaxosConfig(
+            f=f,
+            leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+            acceptor_addresses=tuple(
+                f"acceptor-{i}" for i in range(2 * f + 1)))
+        leaders = [PaxosLeader(a, transport, logger, config)
+                   for a in config.leader_addresses]
+        acceptors = [PaxosAcceptor(a, transport, logger, config)
+                     for a in config.acceptor_addresses]
+        clients = [PaxosClient(f"client-{i}", transport, logger, config)
+                   for i in range(self.num_clients)]
+        return dict(transport=transport, leaders=leaders,
+                    acceptors=acceptors, clients=clients)
+
+    def chosen_values(self, system: dict) -> set:
+        return ({l.chosen_value for l in system["leaders"]
+                 if l.chosen_value is not None}
+                | {c.chosen_value for c in system["clients"]
+                   if c.chosen_value is not None})
+
+    def propose(self, system: dict, command: ProposeCmd) -> None:
+        system["clients"][command.client].propose(command.value)
+
+
+def test_paxos_simulation():
+    failure = Simulator(PaxosSimulated(), run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_paxos_sim_catches_skipped_vote_adoption(monkeypatch):
+    """Break THE Paxos safety rule -- a leader completing phase 1 must
+    adopt the highest-round vote, not its own value -- and the sim must
+    catch the resulting divergence (with a minimized trace)."""
+    from frankenpaxos_tpu.protocols import paxos as m
+
+    original = m.PaxosLeader._handle_phase1b
+
+    def no_adoption(self, src, response):
+        response = m.Phase1b(round=response.round,
+                             acceptor_id=response.acceptor_id,
+                             vote_round=-1, vote_value=None)
+        original(self, src, response)
+
+    monkeypatch.setattr(m.PaxosLeader, "_handle_phase1b", no_adoption)
+    failure = Simulator(PaxosSimulated(), run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is not None, (
+        "the sim failed to catch phase-1 vote adoption being disabled")
+
+
+# --- Fast Paxos -------------------------------------------------------------
+
+
+class FastPaxosSimulated(SingleDecreeSim):
+    def make_system(self, seed: int) -> dict:
+        from frankenpaxos_tpu.protocols.fastpaxos import (
+            FastPaxosAcceptor,
+            FastPaxosClient,
+            FastPaxosConfig,
+            FastPaxosLeader,
+        )
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        f = 1
+        config = FastPaxosConfig(
+            f=f,
+            leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+            acceptor_addresses=tuple(
+                f"acceptor-{i}" for i in range(2 * f + 1)))
+        leaders = [FastPaxosLeader(a, transport, logger, config)
+                   for a in config.leader_addresses]
+        acceptors = [FastPaxosAcceptor(a, transport, logger, config)
+                     for a in config.acceptor_addresses]
+        clients = [FastPaxosClient(f"client-{i}", transport, logger,
+                                   config)
+                   for i in range(self.num_clients)]
+        return dict(transport=transport, leaders=leaders,
+                    acceptors=acceptors, clients=clients)
+
+    def chosen_values(self, system: dict) -> set:
+        return ({l.chosen_value for l in system["leaders"]
+                 if l.chosen_value is not None}
+                | {c.chosen_value for c in system["clients"]
+                   if c.chosen_value is not None})
+
+    def propose(self, system: dict, command: ProposeCmd) -> None:
+        system["clients"][command.client].propose(command.value)
+
+
+def test_fastpaxos_simulation():
+    failure = Simulator(FastPaxosSimulated(), run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_fastpaxos_sim_catches_weak_fast_quorum(monkeypatch):
+    """Fast rounds need bigger quorums than classic majorities (any two
+    fast quorums + a classic quorum must intersect in a majority of the
+    classic quorum). Weakening the fast quorum to a classic majority
+    must be caught."""
+    from frankenpaxos_tpu.protocols import fastpaxos as m
+
+    monkeypatch.setattr(
+        m.FastPaxosConfig, "fast_quorum_size",
+        property(lambda self: self.classic_quorum_size))
+    failure = Simulator(FastPaxosSimulated(), run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is not None, (
+        "the sim failed to catch the fast quorum weakened to a classic "
+        "majority")
+
+
+# --- CASPaxos ---------------------------------------------------------------
+
+
+class CasPaxosSimulated(SingleDecreeSim):
+    """CASPaxos is a CAS register rather than a single decree: each
+    accepted state is the union of a client delta with the adopted
+    previous state, so every pair of observed register states must be
+    comparable under set inclusion (a total ⊆-chain). An incomparable
+    pair means an update was lost."""
+
+    def make_system(self, seed: int) -> dict:
+        from frankenpaxos_tpu.protocols.caspaxos import (
+            CasPaxosAcceptor,
+            CasPaxosClient,
+            CasPaxosConfig,
+            CasPaxosLeader,
+        )
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        f = 1
+        config = CasPaxosConfig(
+            f=f,
+            leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+            acceptor_addresses=tuple(
+                f"acceptor-{i}" for i in range(2 * f + 1)))
+        leaders = [CasPaxosLeader(a, transport, logger, config, seed=i)
+                   for i, a in enumerate(config.leader_addresses)]
+        acceptors = [CasPaxosAcceptor(a, transport, logger, config)
+                     for a in config.acceptor_addresses]
+        replies: list = []
+        clients = [CasPaxosClient(f"client-{i}", transport, logger,
+                                  config, seed=i)
+                   for i in range(self.num_clients)]
+        return dict(transport=transport, leaders=leaders,
+                    acceptors=acceptors, clients=clients, replies=replies)
+
+    def propose(self, system: dict, command: ProposeCmd) -> None:
+        system["clients"][command.client].propose(
+            {command.client}, system["replies"].append)
+
+    def chosen_values(self, system: dict) -> set:
+        return set()  # replaced by the chain invariant below
+
+    def state_invariant(self, system: dict) -> Optional[str]:
+        replies = system["replies"]
+        for i in range(len(replies)):
+            for j in range(i + 1, len(replies)):
+                a, b = replies[i], replies[j]
+                if not (a <= b or b <= a):
+                    return (f"register states incomparable: {set(a)!r} "
+                            f"vs {set(b)!r} (a CAS update was lost)")
+        return None
+
+    def get_state(self, system: dict):
+        return len(system["replies"])
+
+    def step_invariant(self, old_state, new_state) -> Optional[str]:
+        return None
+
+
+def test_caspaxos_simulation():
+    failure = Simulator(CasPaxosSimulated(), run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_caspaxos_sim_catches_dropped_previous_state(monkeypatch):
+    """A CASPaxos leader must apply its delta to the highest-round
+    adopted state; applying it to the empty set instead loses committed
+    updates, and the ⊆-chain invariant must catch it."""
+    from frankenpaxos_tpu.protocols import caspaxos as m
+
+    original = m.CasPaxosLeader._handle_phase1b
+
+    def fresh_state(self, src, phase1b):
+        phase1b = m.Phase1b(round=phase1b.round,
+                            acceptor_index=phase1b.acceptor_index,
+                            vote_round=-1, vote_value=None)
+        original(self, src, phase1b)
+
+    monkeypatch.setattr(m.CasPaxosLeader, "_handle_phase1b", fresh_state)
+    failure = Simulator(CasPaxosSimulated(), run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is not None, (
+        "the sim failed to catch phase-1 state adoption being disabled")
+
+
+# --- MatchmakerPaxos --------------------------------------------------------
+
+
+class MatchmakerPaxosSimulated(SingleDecreeSim):
+    def make_system(self, seed: int) -> dict:
+        from frankenpaxos_tpu.protocols.matchmakerpaxos import (
+            Matchmaker,
+            MatchmakerPaxosAcceptor,
+            MatchmakerPaxosClient,
+            MatchmakerPaxosConfig,
+            MatchmakerPaxosLeader,
+        )
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        f = 1
+        config = MatchmakerPaxosConfig(
+            f=f,
+            leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
+            matchmaker_addresses=tuple(
+                f"matchmaker-{i}" for i in range(2 * f + 1)),
+            acceptor_addresses=tuple(
+                f"acceptor-{i}" for i in range(2 * f + 2)))
+        leaders = [MatchmakerPaxosLeader(a, transport, logger, config,
+                                         seed=seed + i)
+                   for i, a in enumerate(config.leader_addresses)]
+        [Matchmaker(a, transport, logger, config)
+         for a in config.matchmaker_addresses]
+        [MatchmakerPaxosAcceptor(a, transport, logger, config)
+         for a in config.acceptor_addresses]
+        clients = [MatchmakerPaxosClient(f"client-{i}", transport,
+                                         logger, config, seed=seed + i)
+                   for i in range(self.num_clients)]
+        return dict(transport=transport, leaders=leaders, clients=clients)
+
+    def chosen_values(self, system: dict) -> set:
+        from frankenpaxos_tpu.protocols.matchmakerpaxos import _Chosen
+
+        return ({l.state.v for l in system["leaders"]
+                 if isinstance(l.state, _Chosen)}
+                | {c.chosen_value for c in system["clients"]
+                   if c.chosen_value is not None})
+
+    def propose(self, system: dict, command: ProposeCmd) -> None:
+        system["clients"][command.client].propose(command.value)
+
+
+def test_matchmakerpaxos_simulation():
+    failure = Simulator(MatchmakerPaxosSimulated(),
+                        run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is None, str(failure)
+
+
+def test_matchmakerpaxos_sim_catches_skipped_vote_adoption(monkeypatch):
+    """A matchmade leader completing phase 1 over every prior
+    configuration must adopt the highest vote it read; proposing its
+    own value regardless must be caught."""
+    from frankenpaxos_tpu.protocols import matchmakerpaxos as m
+
+    original = m.MatchmakerPaxosLeader._handle_phase1b
+
+    def no_adoption(self, src, phase1b):
+        phase1b = m.Phase1b(round=phase1b.round,
+                            acceptor_index=phase1b.acceptor_index,
+                            vote=None)
+        original(self, src, phase1b)
+
+    monkeypatch.setattr(m.MatchmakerPaxosLeader, "_handle_phase1b",
+                        no_adoption)
+    failure = Simulator(MatchmakerPaxosSimulated(),
+                        run_length=RUN_LENGTH,
+                        num_runs=NUM_RUNS).run(seed=0)
+    assert failure is not None, (
+        "the sim failed to catch phase-1 vote adoption being disabled")
