@@ -44,7 +44,8 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  overrides: "dict[str, str] | None" = None,
                  prometheus: bool = False, supernode: bool = False,
                  profiled: bool = False,
-                 ready_timeout_s: float = 120.0) -> list:
+                 ready_timeout_s: float = 120.0,
+                 host=None) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
     until each reports it is listening.
 
@@ -60,9 +61,16 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     benchmarks/perf_util.py:37 perf-wrap analog for Python roles); the
     role's SIGTERM handler exits cleanly so ``{label}.prof`` dumps at
     kill time -- render it with ``write_profile_reports``.
+
+    ``host`` (default a LocalHost) is the machine the roles launch on:
+    pass a ``bench.remote.RemoteHost`` to deploy through its shell
+    (ssh, or the loopback stand-in) -- the reference's SSH deployment
+    seam (benchmarks/host.py:36-50). The config/log paths are local
+    paths, so a remote host must share them (ssh-to-localhost or a
+    shared filesystem; see bench/remote.py).
     """
     protocol = get_protocol(protocol_name)
-    host = LocalHost()
+    host = host or LocalHost()
     # TPU-backed roles need the accelerator plugin; everything else gets
     # the stripped fast-start environment.
     needs_tpu = any(v == "tpu" for v in (overrides or {}).values())
@@ -122,9 +130,11 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
                        f: int = 1, num_commands: int = 3,
                        state_machine: str = "AppendLog",
                        overrides: "dict[str, str] | None" = None,
-                       command_timeout_s: float = 30.0) -> dict:
+                       command_timeout_s: float = 30.0,
+                       host=None) -> dict:
     """Deploy ``protocol_name`` over localhost TCP and commit
-    ``num_commands`` commands through it."""
+    ``num_commands`` commands through it. ``host`` launches the roles
+    on another machine (see ``launch_roles``)."""
     protocol = get_protocol(protocol_name)
     raw = protocol.cluster(f, lambda: ["127.0.0.1", free_port()])
     config_path = bench.write_json("config.json", raw)
@@ -137,7 +147,7 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
     t0 = time.time()
     labels = launch_roles(bench, protocol_name, config_path, config,
                           state_machine=state_machine,
-                          overrides=overrides)
+                          overrides=overrides, host=host)
     ready_s = time.time() - t0
 
     # In-process client over real TCP. A short resend period rides out

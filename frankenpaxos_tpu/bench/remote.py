@@ -1,0 +1,252 @@
+"""Remote (multi-host) deployment: hosts, shells, and remote procs.
+
+The reference deploys benchmarks by ssh'ing role processes onto cluster
+machines (benchmarks/host.py:10-37 ``Host``/``RemoteHost``/``Endpoint``,
+proc.py:110 ``ParamikoProc``, cluster.py:44 ``Cluster``). Here the same
+seam is a pluggable *shell*:
+
+  * :class:`SshShell` -- runs commands on a remote machine through the
+    system ``ssh`` client (ControlMaster-friendly; no paramiko
+    dependency).
+  * :class:`LoopbackShell` -- runs the IDENTICAL command strings through
+    a local ``bash -c``. This is the ssh-to-localhost stand-in for
+    environments without an sshd (it exercises every line of the
+    remote machinery: quoting, env exports, output redirection, pidfile
+    tracking, and remote kill).
+
+:class:`RemoteHost` plugs into the same ``popen(args, out_path, env)``
+surface as :class:`frankenpaxos_tpu.bench.harness.LocalHost`, so
+``BenchmarkDirectory``/``launch_roles`` deploy over it unchanged.
+
+Scope: ``launch_roles`` reads role logs / writes configs at LOCAL
+paths, so deploying through a RemoteHost requires those paths to be
+visible on the launch target -- ssh-to-localhost (the reference's own
+smoke topology, scripts/benchmark_smoke.sh:5-18) or a shared
+filesystem (the reference's EC2 setups mount one). Fully disjoint
+filesystems would additionally need config/log shipping, which this
+seam does not do.
+
+A launched command is wrapped as::
+
+    echo $$ > <pidfile>; (<exports> exec <cmd>) > <out> 2>&1
+
+The wrapper's pid lands in a pidfile scoped to the launch; ``kill()``
+terminates the wrapper's children then the wrapper through the shell
+(reference ParamikoProc kills via a nonce + pgrep, proc.py:100-150; a
+pidfile avoids pgrep matching the probe's own command line).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import json
+import shlex
+import subprocess
+import uuid
+from typing import Optional, Sequence
+
+from frankenpaxos_tpu.bench.harness import LocalHost
+
+
+class Shell(abc.ABC):
+    """Executes shell command strings somewhere (a remote machine, or
+    locally for the loopback stand-in)."""
+
+    @abc.abstractmethod
+    def spawn(self, command: str) -> subprocess.Popen:
+        """Start ``command`` without waiting; returns the local driver
+        process (the ssh client, or the local bash)."""
+
+    @abc.abstractmethod
+    def run(self, command: str, timeout: float = 10.0
+            ) -> tuple[int, str]:
+        """Run ``command`` to completion; (returncode, stdout)."""
+
+
+class LoopbackShell(Shell):
+    def spawn(self, command: str) -> subprocess.Popen:
+        return subprocess.Popen(["bash", "-c", command],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def run(self, command: str, timeout: float = 10.0) -> tuple[int, str]:
+        done = subprocess.run(["bash", "-c", command],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        return done.returncode, done.stdout
+
+
+class SshShell(Shell):
+    """System-``ssh`` backed shell. ``dest`` is anything the ssh client
+    accepts (``user@host``, a ``~/.ssh/config`` alias, ...)."""
+
+    def __init__(self, dest: str, ssh_args: Sequence[str] = (
+            "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no")):
+        self.dest = dest
+        self.ssh_args = list(ssh_args)
+
+    def _argv(self, command: str) -> list[str]:
+        return ["ssh", *self.ssh_args, self.dest, command]
+
+    def spawn(self, command: str) -> subprocess.Popen:
+        return subprocess.Popen(self._argv(command),
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def run(self, command: str, timeout: float = 10.0) -> tuple[int, str]:
+        done = subprocess.run(self._argv(command), capture_output=True,
+                              text=True, timeout=timeout)
+        return done.returncode, done.stdout
+
+
+class RemoteProc:
+    """A process launched through a :class:`Shell` (the ParamikoProc
+    analog, proc.py:110)."""
+
+    def __init__(self, shell: Shell, args: Sequence[str], out_path: str,
+                 env: Optional[dict] = None, cwd: Optional[str] = None):
+        import os
+        import re
+
+        self.shell = shell
+        self._pidfile = f"/tmp/fpx_remote_{uuid.uuid4().hex}.pid"
+        # Export only the DELTA vs this process' environment: callers
+        # (launch_roles) pass full os.environ copies, and replaying the
+        # local PATH/HOME onto a remote machine would clobber its own
+        # resolution -- while exported-bash-function keys
+        # ('BASH_FUNC_x%%') are not even valid identifiers. Note the
+        # semantic difference from Popen(env=...): a remote launch
+        # OVERLAYS the remote login environment rather than replacing
+        # it.
+        identifier = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+        exports = "".join(
+            f"export {key}={shlex.quote(str(value))}; "
+            for key, value in (env or {}).items()
+            if identifier.match(key)
+            and os.environ.get(key) != str(value))
+        cd = f"cd {shlex.quote(cwd)}; " if cwd else ""
+        cmd = " ".join(shlex.quote(str(a)) for a in args)
+        self._command = (f"echo $$ > {shlex.quote(self._pidfile)}; "
+                         f"({cd}{exports}exec {cmd}) "
+                         f"> {shlex.quote(out_path)} 2>&1")
+        self._driver = shell.spawn(self._command)
+
+    def pid(self) -> Optional[int]:
+        """The REMOTE wrapper pid (not the local driver's)."""
+        rc, out = self.shell.run(f"cat {shlex.quote(self._pidfile)}")
+        try:
+            return int(out.strip()) if rc == 0 else None
+        except ValueError:
+            return None
+
+    def running(self) -> bool:
+        return self._driver.poll() is None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        return self._driver.wait(timeout=timeout)
+
+    def kill(self) -> None:
+        import time
+
+        pid = self.pid()
+        # The wrapper writes its pidfile first thing, but a launch whose
+        # shell is still connecting may not have gotten there yet; a
+        # kill that only terminated the local driver would leak the
+        # remote role. Poll briefly before giving up on the remote side.
+        deadline = time.time() + 2.0
+        while pid is None and self._driver.poll() is None \
+                and time.time() < deadline:
+            time.sleep(0.1)
+            pid = self.pid()
+        if pid is not None:
+            # Children first (the exec'd role), then the wrapper, then
+            # drop the pidfile.
+            self.shell.run(f"pkill -TERM -P {pid} 2>/dev/null; "
+                           f"kill -TERM {pid} 2>/dev/null; "
+                           f"rm -f {shlex.quote(self._pidfile)}")
+        if self._driver.poll() is None:
+            try:
+                self._driver.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._driver.kill()
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoteHost:
+    """Drop-in for :class:`LocalHost` that launches through a shell
+    (host.py:36-50)."""
+
+    shell: Shell
+    ip: str = "127.0.0.1"
+    # Remote working directory for launched role processes (the repo
+    # checkout on the remote machine); None inherits the login dir.
+    cwd: Optional[str] = None
+
+    def popen(self, args: Sequence[str], out_path: str,
+              env: Optional[dict] = None) -> RemoteProc:
+        return RemoteProc(self.shell, args, out_path, env=env,
+                          cwd=self.cwd)
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    """(host.py:22-25)."""
+
+    host: object  # LocalHost | RemoteHost
+    port: int
+
+
+_LOCAL_ADDRESSES = ("localhost", "127.0.0.1", "::1")
+
+
+def default_connect(address: str) -> object:
+    """Address -> Host: local addresses run in-process; anything else
+    gets an ssh shell (reference's paramiko connect, cluster.py usage)."""
+    if address in _LOCAL_ADDRESSES:
+        return LocalHost()
+    return RemoteHost(SshShell(address), ip=address.rsplit("@", 1)[-1])
+
+
+class Cluster:
+    """A cluster file maps f -> role -> machine addresses
+    (cluster.py:15-44)::
+
+        {"1": {"leaders": ["10.0.0.1", "10.0.0.2"],
+               "acceptors": ["10.0.0.3", "10.0.0.4", "10.0.0.5"],
+               "clients": ["localhost"]}}
+
+    ``connect`` turns each distinct address into a Host exactly once
+    (so multiple roles on one machine share the ssh connection).
+    """
+
+    def __init__(self, data: dict, connect=default_connect):
+        self._hosts_by_address: dict[str, object] = {}
+        self._by_f: dict[int, dict[str, list]] = {}
+        for f_str, roles in data.items():
+            if not isinstance(roles, dict):
+                raise ValueError(f"cluster entry for f={f_str!r} must be "
+                                 f"an object, got {roles!r}")
+            by_role: dict[str, list] = {}
+            for role, addresses in roles.items():
+                if not isinstance(addresses, list) or not all(
+                        isinstance(a, str) for a in addresses):
+                    raise ValueError(
+                        f"addresses for role {role!r} (f={f_str}) must "
+                        f"be a list of strings, got {addresses!r}")
+                hosts = []
+                for address in addresses:
+                    if address not in self._hosts_by_address:
+                        self._hosts_by_address[address] = connect(address)
+                    hosts.append(self._hosts_by_address[address])
+                by_role[role] = hosts
+            self._by_f[int(f_str)] = by_role
+
+    @classmethod
+    def from_file(cls, path: str, connect=default_connect) -> "Cluster":
+        with open(path) as f:
+            return cls(json.load(f), connect=connect)
+
+    def f(self, f: int) -> dict[str, list]:
+        """Role -> hosts for the given fault tolerance."""
+        return self._by_f[f]

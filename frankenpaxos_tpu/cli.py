@@ -133,6 +133,17 @@ def main(argv=None) -> None:
                     state_machine=args.state_machine,
                     collectors=collectors)
 
+    def make_instrumented(role, role_name, role_address, index):
+        """Construct the role actor and, when metrics are on, wrap its
+        receive with the uniform per-role request metrics."""
+        actor = role.make(ctx, role_address, index)
+        if collectors is not None and actor is not None:
+            from frankenpaxos_tpu.runtime.monitoring import (
+                instrument_actor,
+            )
+
+            instrument_actor(actor, collectors, args.protocol, role_name)
+
     if args.role == "supernode":
         # Coupled baseline: every role of the protocol colocated in one
         # process on one event loop (the reference's SuperNode mains,
@@ -150,12 +161,12 @@ def main(argv=None) -> None:
         for role_name, role in protocol.roles.items():
             for index, role_address in enumerate(role.addresses(config)):
                 ctx.seed = args.seed + count
-                role.make(ctx, role_address, index)
+                make_instrumented(role, role_name, role_address, index)
                 count += 1
         address = f"supernode ({count} roles)"
     else:
         address = listen_address
-        role.make(ctx, address, args.index)
+        make_instrumented(role, args.role, address, args.index)
     unmatched = ctx.unmatched_overrides()
     if unmatched:
         # Overrides are shared across a deployment's roles, so an option

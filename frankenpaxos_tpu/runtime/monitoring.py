@@ -269,3 +269,54 @@ class _PromSummary(Summary):
 
     def get_sum(self) -> float:
         return self._m._sum.get()
+
+
+def instrument_actor(actor, collectors: Collectors, protocol: str,
+                     role: str) -> bool:
+    """Wrap ``actor.receive`` with the standard inbound metrics every
+    reference role exports (``<proto>_<role>_requests_total{type=...}``
+    and ``..._requests_latency_seconds``; e.g. Leader.scala:281-293):
+    uniform observability for roles that don't hand-register their own
+    collectors. Roles that DO (multipaxos) are left untouched; returns
+    False in that case.
+    """
+    prefix = f"{protocol}_{role}"
+    # Memoized per collectors instance so colocated roles of the same
+    # kind (supernode mode) share one metric family. A role that
+    # hand-registered its own request metrics at construction (all
+    # multipaxos roles) must NOT be wrapped on top -- that would double
+    # every count -- and PrometheusCollectors returns cached metrics
+    # rather than raising on re-registration, so detect prior
+    # registration via its name cache explicitly.
+    cache = getattr(collectors, "_instrument_cache", None)
+    if cache is None:
+        cache = {}
+        collectors._instrument_cache = cache
+    if prefix not in cache:
+        already = getattr(collectors, "_cache", {})
+        if (f"{prefix}_requests_total" in already
+                or f"{prefix}_requests_latency_seconds" in already):
+            cache[prefix] = None  # the role registers its own metrics
+        else:
+            cache[prefix] = (
+                collectors.counter(
+                    f"{prefix}_requests_total",
+                    help=f"Total {role} inbound messages",
+                    labels=("type",)),
+                collectors.summary(
+                    f"{prefix}_requests_latency_seconds",
+                    help=f"{role} handler latency", labels=("type",)))
+    if cache[prefix] is None:
+        return False
+    requests, latency = cache[prefix]
+
+    original = actor.receive
+
+    def receive(src, message):
+        name = type(message).__name__
+        with latency.labels(name).time():
+            original(src, message)
+        requests.labels(name).inc()
+
+    actor.receive = receive
+    return True

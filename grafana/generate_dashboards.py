@@ -1,0 +1,95 @@
+"""Generate per-protocol Grafana dashboards from the deploy registry.
+
+The reference provisions a hand-written dashboard per protocol
+(/grafana/dashboards/: echo, epaxos, mencius, scalog, ... 15 total).
+Here every deployed protocol gets one generated from its actual role
+list, charting the uniform per-role metrics the CLI exports for every
+role (``<protocol>_<role>_requests_total{type=...}`` and
+``..._requests_latency_seconds`` -- see
+``runtime.monitoring.instrument_actor``). The multipaxos and batching
+dashboards are hand-written (richer, protocol-specific) and are not
+regenerated.
+
+Run from the repo root::
+
+    python grafana/generate_dashboards.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, get_protocol  # noqa: E402
+
+HAND_WRITTEN = {"multipaxos"}
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "dashboards")
+
+_DATASOURCE = {"type": "prometheus", "uid": "${DS_PROMETHEUS}"}
+
+
+def _panel(panel_id: int, title: str, expr: str, legend: str, unit: str,
+           x: int, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "type": "timeseries",
+        "title": title,
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "datasource": _DATASOURCE,
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [{"expr": expr, "legendFormat": legend, "refId": "A"}],
+    }
+
+
+def dashboard(protocol: str, roles: list) -> dict:
+    panels = []
+    for row, role in enumerate(roles):
+        pretty = role.replace("_", " ").capitalize()
+        metric = f"{protocol}_{role}"
+        panels.append(_panel(
+            2 * row, f"{pretty} request throughput",
+            f"sum(rate({metric}_requests_total[1s])) by (type)",
+            "{{type}}", "ops", x=0, y=8 * row))
+        panels.append(_panel(
+            2 * row + 1, f"{pretty} handler latency (mean)",
+            f"sum(rate({metric}_requests_latency_seconds_sum[1s])) "
+            f"by (type) / "
+            f"sum(rate({metric}_requests_latency_seconds_count[1s])) "
+            f"by (type)",
+            "{{type}}", "s", x=12, y=8 * row))
+    return {
+        "uid": f"fpx-{protocol}",
+        "title": f"FrankenPaxos TPU / {protocol}",
+        "schemaVersion": 39,
+        "version": 1,
+        "editable": True,
+        "timezone": "browser",
+        "time": {"from": "now-5m", "to": "now"},
+        "refresh": "1s",
+        "templating": {"list": [{
+            "name": "DS_PROMETHEUS",
+            "type": "datasource",
+            "query": "prometheus",
+            "label": "Prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def main() -> None:
+    for protocol in PROTOCOL_NAMES:
+        if protocol in HAND_WRITTEN:
+            continue
+        roles = list(get_protocol(protocol).roles)
+        path = os.path.join(OUT_DIR, f"{protocol}.json")
+        with open(path, "w") as f:
+            json.dump(dashboard(protocol, roles), f, indent=2)
+            f.write("\n")
+        print(f"wrote {path} ({len(roles)} roles)")
+
+
+if __name__ == "__main__":
+    main()

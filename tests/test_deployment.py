@@ -154,3 +154,52 @@ def test_protocol_benchmark_generic_drive():
         "craq", client_procs=1, clients_per_proc=3, duration_s=1.5)
     assert stats["num_requests"] > 0
     assert stats["latency.median_ms"] > 0
+
+
+def test_generic_role_metrics_scrape(tmp_path):
+    """Every protocol's roles export the uniform per-role metrics
+    (instrument_actor in the CLI): deploy mencius with prometheus
+    endpoints and scrape <protocol>_<role>_requests_total counters."""
+    import threading
+
+    from frankenpaxos_tpu.bench.deploy_suite import launch_roles
+    from frankenpaxos_tpu.bench.harness import free_port
+    from frankenpaxos_tpu.bench.metrics import scrape
+    from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+    bench = BenchmarkDirectory(str(tmp_path / "mencius_metrics"))
+    protocol = get_protocol("mencius")
+    raw = protocol.cluster(1, lambda: ["127.0.0.1", free_port()])
+    config_path = bench.write_json("config.json", raw)
+    config = protocol.load_config(raw)
+    launch_roles(bench, "mencius", config_path, config,
+                 state_machine="AppendLog",
+                 overrides={"resend_phase1as_period_s": "0.5"},
+                 prometheus=True)
+    transport = None
+    try:
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = TcpTransport(("127.0.0.1", free_port()), logger)
+        transport.start()
+        ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                        overrides={"resend_period_s": "0.5"}, seed=7,
+                        state_machine="AppendLog")
+        client = protocol.make_client(ctx, transport.listen_address)
+        done = threading.Event()
+        transport.loop.call_soon_threadsafe(
+            protocol.drive, client, 0, lambda *_: done.set())
+        assert done.wait(20), "command never completed"
+        metric_names = set()
+        for label, port in bench.prometheus_ports.items():
+            metric_names.update(scrape(port))
+        assert any(name.startswith("mencius_leader_requests_total")
+                   for name in metric_names), sorted(metric_names)[:20]
+        assert any(name.startswith(
+            "mencius_acceptor_requests_latency_seconds")
+            for name in metric_names)
+    finally:
+        if transport is not None:
+            transport.stop()
+        bench.cleanup()

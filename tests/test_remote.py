@@ -1,0 +1,124 @@
+"""Remote deployment seam: shells, RemoteProc lifecycle, cluster files.
+
+The reference smoke-runs every protocol over SSH-to-localhost
+(scripts/benchmark_smoke.sh:5-18, benchmarks/proc.py:110 ParamikoProc,
+host.py:10-37). This image has no sshd, so the loopback shell runs the
+IDENTICAL command strings (quoting, env exports, redirection, pidfile
+kill) through a local bash; the real-ssh test self-skips when ssh or a
+localhost sshd is unavailable.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+from frankenpaxos_tpu.bench.harness import BenchmarkDirectory, LocalHost
+from frankenpaxos_tpu.bench.remote import (
+    Cluster,
+    LoopbackShell,
+    RemoteHost,
+    RemoteProc,
+    SshShell,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ssh_localhost_available() -> bool:
+    if shutil.which("ssh") is None:
+        return False
+    try:
+        with socket.create_connection(("127.0.0.1", 22), timeout=1):
+            pass
+    except OSError:
+        return False
+    probe = subprocess.run(
+        ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+         "-o", "ConnectTimeout=2", "127.0.0.1", "true"],
+        capture_output=True)
+    return probe.returncode == 0
+
+
+def _shells():
+    shells = [("loopback", LoopbackShell())]
+    if _ssh_localhost_available():
+        shells.append(("ssh", SshShell("127.0.0.1")))
+    return shells
+
+
+# Computed once at collection: the ssh probe costs a subprocess.
+SHELLS = _shells()
+SHELL_IDS = [n for n, _ in SHELLS]
+
+
+@pytest.mark.parametrize("name,shell", SHELLS, ids=SHELL_IDS)
+def test_remote_proc_lifecycle(name, shell, tmp_path):
+    """Launch, observe, and kill a process through the shell: output
+    redirects to the requested file, env exports apply, the pidfile
+    tracks the remote wrapper, and kill() terminates the exec'd child."""
+    out = str(tmp_path / "out.log")
+    proc = RemoteProc(shell, [
+        "python3", "-c",
+        "import os, time, sys; print('marker', os.environ['FPX_X']); "
+        "sys.stdout.flush(); time.sleep(60)"], out, env={"FPX_X": "42"})
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if os.path.exists(out) and "marker 42" in open(out).read():
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"child never wrote its marker to {out}")
+    assert proc.running()
+    pid = proc.pid()
+    assert pid is not None
+    proc.kill()
+    assert proc.wait(timeout=10) is not None
+    # The exec'd child (the sleep) must actually be gone.
+    rc, _ = shell.run(f"pkill -0 -P {pid}")
+    assert rc != 0, "child survived kill()"
+
+
+@pytest.mark.parametrize("name,shell", SHELLS, ids=SHELL_IDS)
+def test_protocol_deployment_through_remote_seam(name, shell, tmp_path):
+    """The full deployment path (launch_roles -> CLI roles -> TCP client
+    commands) with every role launched through the remote shell -- the
+    reference's ssh-to-localhost smoke (benchmark_smoke.sh:5-18)."""
+    from frankenpaxos_tpu.bench.deploy_suite import run_protocol_smoke
+
+    host = RemoteHost(shell, cwd=REPO_ROOT)
+    stats = run_protocol_smoke(
+        BenchmarkDirectory(str(tmp_path / "echo")), "echo", host=host)
+    assert len(stats["latency_ms"]) == 3
+    assert all(lat > 0 for lat in stats["latency_ms"])
+
+
+def test_cluster_file_role_mapping(tmp_path):
+    """Cluster files key f -> role -> machine addresses
+    (cluster.py:15-44); local addresses map to LocalHost, remote ones
+    to ssh-backed RemoteHosts, one Host per distinct machine."""
+    path = tmp_path / "cluster.json"
+    path.write_text("""{
+        "1": {"leaders": ["localhost", "10.0.0.2"],
+              "acceptors": ["10.0.0.2", "10.0.0.3", "localhost"],
+              "clients": ["localhost"]},
+        "2": {"leaders": ["localhost", "localhost", "localhost"]}
+    }""")
+    cluster = Cluster.from_file(str(path))
+    roles = cluster.f(1)
+    assert isinstance(roles["leaders"][0], LocalHost)
+    assert isinstance(roles["leaders"][1], RemoteHost)
+    assert roles["leaders"][1].ip == "10.0.0.2"
+    # One Host per distinct address: colocated roles share the shell.
+    assert roles["acceptors"][0] is roles["leaders"][1]
+    assert len(cluster.f(2)["leaders"]) == 3
+
+
+def test_cluster_file_rejects_malformed():
+    with pytest.raises(ValueError):
+        Cluster({"1": {"leaders": "not-a-list"}})
+    with pytest.raises(ValueError):
+        Cluster({"1": ["not", "an", "object"]})
