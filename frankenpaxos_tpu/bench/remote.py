@@ -111,20 +111,25 @@ class RemoteProc:
 
         self.shell = shell
         self._pidfile = f"/tmp/fpx_remote_{uuid.uuid4().hex}.pid"
-        # Export only the DELTA vs this process' environment: callers
+        # Export the DELTA vs this process' environment -- callers
         # (launch_roles) pass full os.environ copies, and replaying the
         # local PATH/HOME onto a remote machine would clobber its own
-        # resolution -- while exported-bash-function keys
-        # ('BASH_FUNC_x%%') are not even valid identifiers. Note the
-        # semantic difference from Popen(env=...): a remote launch
-        # OVERLAYS the remote login environment rather than replacing
-        # it.
+        # resolution, while exported-bash-function keys
+        # ('BASH_FUNC_x%%') are not even valid identifiers -- PLUS
+        # every runtime-shaping var regardless (the delta is computed
+        # against the LOCAL environment, not the remote login shell's:
+        # a var like PYTHONUNBUFFERED=1 that happens to match locally
+        # must still reach the remote role). Note the semantic
+        # difference from Popen(env=...): a remote launch OVERLAYS the
+        # remote login environment rather than replacing it.
         identifier = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+        always = re.compile(r"^(PYTHON|JAX_|XLA_|FPX_|TPU_)")
         exports = "".join(
             f"export {key}={shlex.quote(str(value))}; "
             for key, value in (env or {}).items()
             if identifier.match(key)
-            and os.environ.get(key) != str(value))
+            and (always.match(key)
+                 or os.environ.get(key) != str(value)))
         cd = f"cd {shlex.quote(cwd)}; " if cwd else ""
         cmd = " ".join(shlex.quote(str(a)) for a in args)
         self._command = (f"echo $$ > {shlex.quote(self._pidfile)}; "

@@ -108,19 +108,27 @@ class IntPrefixSet(CompactSet[int]):
     def __hash__(self):
         return hash((self.watermark, frozenset(self.values)))
 
-    def _compact(self) -> None:
-        # Absorb a contiguous run at the watermark into the watermark, and
-        # drop values below it.
-        self.values = {x for x in self.values if x >= self.watermark}
+    def _absorb(self) -> None:
+        # Absorb the contiguous run at the watermark into the watermark.
+        # Values strictly below the old watermark cannot appear here
+        # (add() refuses them; only construction/bulk ops introduce
+        # them, and those run the full _compact), so no filter pass is
+        # needed -- a rebuild per add() would make scattered adds
+        # quadratic (libbench caught exactly that).
         while self.watermark in self.values:
             self.values.discard(self.watermark)
             self.watermark += 1
+
+    def _compact(self) -> None:
+        # Drop values below the watermark, then absorb the run at it.
+        self.values = {x for x in self.values if x >= self.watermark}
+        self._absorb()
 
     def add(self, x: int) -> bool:
         if self.contains(x):
             return True
         self.values.add(x)
-        self._compact()
+        self._absorb()
         return False
 
     def contains(self, x: int) -> bool:
@@ -162,7 +170,7 @@ class IntPrefixSet(CompactSet[int]):
             self.values |= set(range(self.watermark))
             self.watermark = 0
         self.values.discard(x)
-        self._compact()
+        self._absorb()
         return self
 
     @property

@@ -31,6 +31,9 @@ from frankenpaxos_tpu.protocols.multipaxos.read_batcher import (
     ReadBatchingScheme,
 )
 from frankenpaxos_tpu.protocols.multipaxos.replica import Replica, ReplicaOptions
+# Importing registers the hot-path binary codecs with the hybrid
+# serializer (its module docstring explains the wire schema).
+from frankenpaxos_tpu.protocols.multipaxos import wire  # noqa: F401
 
 __all__ = [
     "Acceptor",

@@ -1,0 +1,238 @@
+"""Fixed-layout binary codecs for the MultiPaxos hot-path messages.
+
+The reference's every message is a protobuf with a per-role oneof
+envelope (ProtoSerializer.scala:3-11, multipaxos/MultiPaxos.proto:
+489-588). Here the hot-path messages -- the ones a steady-state write
+touches: ClientRequest -> Phase2a -> Phase2b -> Chosen -> ClientReply,
+plus the gossip/watermark traffic around them -- get hand-laid-out
+binary codecs registered with the runtime's HybridSerializer (see
+runtime/serializer.py); cold-path messages (Phase1*, reads,
+reconfiguration) stay pickled. Layouts are little-endian fixed-width
+structs with length-prefixed strings/bytes: decodable from any
+language, no code execution on decode, and several times faster than
+pickling dataclasses.
+
+Importing this module (protocols.multipaxos does) registers the codecs
+process-wide; both sides of every channel share the schema.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    Chosen,
+    ChosenWatermark,
+    ClientReply,
+    ClientRequest,
+    ClientRequestBatch,
+    Command,
+    CommandBatch,
+    CommandId,
+    Noop,
+    NOOP,
+    Phase2a,
+    Phase2b,
+)
+
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_I32 = struct.Struct("<i")
+_QI = struct.Struct("<qi")
+_QQII = struct.Struct("<qqii")
+
+
+def _put_bytes(out: bytearray, data: bytes) -> None:
+    out += _I32.pack(len(data))
+    out += data
+
+
+def _take_bytes(buf: bytes, at: int) -> tuple[bytes, int]:
+    (n,) = _I32.unpack_from(buf, at)
+    at += 4
+    return buf[at:at + n], at + n
+
+
+def _put_address(out: bytearray, address) -> None:
+    """Addresses are (host, port) tuples on TCP, plain strings in sims;
+    anything else (exotic sim addresses) rides a pickled escape hatch."""
+    if (isinstance(address, tuple) and len(address) == 2
+            and isinstance(address[0], str)
+            and isinstance(address[1], int)):
+        host, port = address
+        out.append(1)
+        _put_bytes(out, host.encode())
+        out += _I32.pack(port)
+    elif isinstance(address, str):
+        out.append(0)
+        _put_bytes(out, address.encode())
+    else:
+        import pickle
+
+        out.append(2)
+        _put_bytes(out, pickle.dumps(address,
+                                     protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _take_address(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    raw, at = _take_bytes(buf, at)
+    if kind == 1:
+        (port,) = _I32.unpack_from(buf, at)
+        return (raw.decode(), port), at + 4
+    if kind == 2:
+        import pickle
+
+        return pickle.loads(raw), at
+    return raw.decode(), at
+
+
+def _put_command(out: bytearray, command: Command) -> None:
+    cid = command.command_id
+    _put_address(out, cid.client_address)
+    out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+    _put_bytes(out, command.command)
+
+
+def _take_command(buf: bytes, at: int) -> tuple[Command, int]:
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    at += 16
+    payload, at = _take_bytes(buf, at)
+    return Command(CommandId(address, pseudonym, id), payload), at
+
+
+def _put_value(out: bytearray, value) -> None:
+    """CommandBatchOrNoop."""
+    if isinstance(value, Noop):
+        out.append(0)
+        return
+    out.append(1)
+    out += _I32.pack(len(value.commands))
+    for command in value.commands:
+        _put_command(out, command)
+
+
+def _take_value(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    if kind == 0:
+        return NOOP, at
+    (n,) = _I32.unpack_from(buf, at)
+    at += 4
+    commands = []
+    for _ in range(n):
+        command, at = _take_command(buf, at)
+        commands.append(command)
+    return CommandBatch(tuple(commands)), at
+
+
+class Phase2bCodec(MessageCodec):
+    """The single hottest message (2f+1 per slot)."""
+
+    message_type = Phase2b
+    tag = 1
+
+    def encode(self, out, message):
+        out += _QQII.pack(message.slot, message.round,
+                          message.group_index, message.acceptor_index)
+
+    def decode(self, buf, at):
+        slot, round, group, acceptor = _QQII.unpack_from(buf, at)
+        return Phase2b(group_index=group, acceptor_index=acceptor,
+                       slot=slot, round=round), at + 24
+
+
+class Phase2aCodec(MessageCodec):
+    message_type = Phase2a
+    tag = 2
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.slot, message.round)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        slot, round = _I64I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 16)
+        return Phase2a(slot=slot, round=round, value=value), at
+
+
+class ChosenCodec(MessageCodec):
+    message_type = Chosen
+    tag = 3
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 8)
+        return Chosen(slot=slot, value=value), at
+
+
+class ClientRequestCodec(MessageCodec):
+    message_type = ClientRequest
+    tag = 4
+
+    def encode(self, out, message):
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _take_command(buf, at)
+        return ClientRequest(command), at
+
+
+class ClientRequestBatchCodec(MessageCodec):
+    message_type = ClientRequestBatch
+    tag = 5
+
+    def encode(self, out, message):
+        _put_value(out, message.batch)
+
+    def decode(self, buf, at):
+        batch, at = _take_value(buf, at)
+        return ClientRequestBatch(batch), at
+
+
+class ClientReplyCodec(MessageCodec):
+    message_type = ClientReply
+    tag = 6
+
+    def encode(self, out, message):
+        cid = message.command_id
+        _put_address(out, cid.client_address)
+        out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+        out += _I64.pack(message.slot)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        pseudonym, id = _I64I64.unpack_from(buf, at)
+        (slot,) = _I64.unpack_from(buf, at + 16)
+        result, at = _take_bytes(buf, at + 24)
+        return ClientReply(CommandId(address, pseudonym, id), slot,
+                           result), at
+
+
+class ChosenWatermarkCodec(MessageCodec):
+    message_type = ChosenWatermark
+    tag = 7
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        return ChosenWatermark(slot=slot), at + 8
+
+
+for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
+               ClientRequestCodec(), ClientRequestBatchCodec(),
+               ClientReplyCodec(), ChosenWatermarkCodec()):
+    register_codec(_codec)

@@ -12,7 +12,10 @@ import abc
 from typing import Any, Callable, Generic, TypeVar
 
 from frankenpaxos_tpu.runtime.logger import Logger
-from frankenpaxos_tpu.runtime.serializer import PickleSerializer, Serializer
+from frankenpaxos_tpu.runtime.serializer import (
+    DEFAULT_SERIALIZER,
+    Serializer,
+)
 from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
 
 M = TypeVar("M")
@@ -49,7 +52,10 @@ class Actor(abc.ABC):
     actor registers with its transport at construction.
     """
 
-    serializer: Serializer = PickleSerializer()
+    # The hybrid default encodes registered hot message types with
+    # their fixed-layout binary codecs and pickles the long tail; a
+    # subclass can still pin its own serializer.
+    serializer: Serializer = DEFAULT_SERIALIZER
 
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger):
@@ -73,7 +79,7 @@ class Actor(abc.ABC):
     def chan(self, dst: Address,
              serializer: Serializer | None = None) -> Chan:
         return Chan(self.transport, self.address, dst,
-                    serializer or PickleSerializer())
+                    serializer or DEFAULT_SERIALIZER)
 
     def send(self, dst: Address, message: Any,
              serializer: Serializer | None = None) -> None:

@@ -40,3 +40,73 @@ class PickleSerializer(Serializer[M]):
 
     def from_bytes(self, data: bytes) -> M:
         return pickle.loads(data)
+
+
+class MessageCodec(abc.ABC):
+    """A fixed-layout binary codec for ONE message type (the
+    ProtoSerializer.scala:3-11 analog: schema'd, language-agnostic, no
+    arbitrary code execution on decode)."""
+
+    #: The message class this codec handles.
+    message_type: type
+    #: Wire tag, 1..127 (pickle streams start with 0x80, so one leading
+    #: byte discriminates binary-coded from pickled messages).
+    tag: int
+
+    @abc.abstractmethod
+    def encode(self, out: bytearray, message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def decode(self, buf: bytes, at: int) -> tuple:
+        """-> (message, next_offset)."""
+
+
+_CODECS_BY_TYPE: dict[type, MessageCodec] = {}
+_CODECS_BY_TAG: dict[int, MessageCodec] = {}
+
+
+def register_codec(codec: MessageCodec) -> None:
+    """Install a binary codec for its message type (process-global: the
+    codec IS the wire schema, so every actor must agree on it)."""
+    if not 1 <= codec.tag <= 127:
+        raise ValueError(f"tag {codec.tag} outside 1..127")
+    existing = _CODECS_BY_TAG.get(codec.tag)
+    if existing is not None and type(existing) is not type(codec):
+        raise ValueError(f"tag {codec.tag} already taken by {existing}")
+    _CODECS_BY_TYPE[codec.message_type] = codec
+    _CODECS_BY_TAG[codec.tag] = codec
+
+
+class HybridSerializer(Serializer[M]):
+    """Binary fixed-layout encoding for registered hot message types
+    (Phase2a/Phase2b/Chosen/ClientRequest...); pickle for the long tail.
+
+    The first byte discriminates: 1..127 selects a registered codec,
+    0x80+ is a pickle stream (every pickle protocol >= 2 starts with
+    the PROTO opcode 0x80). Senders and receivers therefore
+    interoperate in any mix of registered/unregistered types.
+    """
+
+    def to_bytes(self, message: M) -> bytes:
+        codec = _CODECS_BY_TYPE.get(type(message))
+        if codec is None:
+            return pickle.dumps(message,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        out = bytearray((codec.tag,))
+        codec.encode(out, message)
+        return bytes(out)
+
+    def from_bytes(self, data: bytes) -> M:
+        tag = data[0]
+        if tag >= 128:
+            return pickle.loads(data)
+        codec = _CODECS_BY_TAG.get(tag)
+        if codec is None:
+            raise ValueError(f"no codec registered for wire tag {tag}")
+        message, _ = codec.decode(data, 1)
+        return message
+
+
+#: Shared default: one instance so registrations apply everywhere.
+DEFAULT_SERIALIZER: HybridSerializer = HybridSerializer()

@@ -165,22 +165,58 @@ class TcpTransport(Transport):
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter,
                            local: Address) -> None:
+        # Chunked reads + a native frame scan (codec.cpp
+        # fpx_scan_frames) instead of two awaits per frame: a burst of
+        # small frames costs ONE read syscall and one scan, and every
+        # complete frame in the chunk dispatches in the same loop pass
+        # (so they land in one actor drain; see _dispatch).
+        from frankenpaxos_tpu import native
+
+        buf = bytearray()
+        # Declared total size of the frame at the head of `buf` (0 =
+        # not known yet). While the head frame is incomplete, chunks
+        # are appended WITHOUT rescanning -- a large frame arriving in
+        # many chunks must not re-copy/re-scan the whole buffer per
+        # chunk -- and the oversize check is against this declared
+        # length, never the buffer size (a near-cap frame followed by
+        # the next frame's first bytes is legitimate).
+        need = 0
         try:
             while True:
-                head = await reader.readexactly(4)
-                (length,) = _LEN.unpack(head)
-                if length > MAX_FRAME:
-                    self.logger.error(f"oversized frame ({length} bytes)")
+                chunk = await reader.read(1 << 16)
+                if not chunk:
                     break
-                payload = await reader.readexactly(length)
-                (hlen,) = _LEN.unpack(payload[:4])
-                header = payload[4:4 + hlen].decode()
-                host, _, port = header.rpartition(":")
-                src: Address = (host, int(port))
-                data = payload[4 + hlen:]
-                self._dispatch(local, src, data)
+                buf += chunk
+                if need == 0 and len(buf) >= 4:
+                    (inner,) = _LEN.unpack_from(buf, 0)
+                    if inner > MAX_FRAME:
+                        self.logger.error(
+                            f"oversized frame ({inner} bytes)")
+                        return
+                    need = 4 + inner
+                if not need or len(buf) < need:
+                    continue
+                frames, consumed = native.scan_frames(bytes(buf))
+                for start, end in frames:
+                    (hlen,) = _LEN.unpack_from(buf, start)
+                    header = bytes(buf[start + 4:start + 4 + hlen]).decode()
+                    host, _, port = header.rpartition(":")
+                    src: Address = (host, int(port))
+                    data = bytes(buf[start + 4 + hlen:end])
+                    self._dispatch(local, src, data)
+                del buf[:consumed]
+                need = 0
+                if len(buf) >= 4:
+                    (inner,) = _LEN.unpack_from(buf, 0)
+                    if inner > MAX_FRAME:
+                        self.logger.error(
+                            f"oversized frame ({inner} bytes)")
+                        return
+                    need = 4 + inner
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except ValueError as e:  # scan_frames: frame exceeds the cap
+            self.logger.error(str(e))
         finally:
             writer.close()
 

@@ -1,0 +1,84 @@
+"""The hybrid serializer and the MultiPaxos fixed-layout wire codecs.
+
+Reference parity: every reference message is a schema'd protobuf
+(ProtoSerializer.scala:3-11); here the hot-path messages get
+fixed-layout binary codecs behind the Serializer seam, with pickle for
+the long tail and first-byte discrimination between the two.
+"""
+
+import pickle
+
+import pytest
+
+import frankenpaxos_tpu.protocols.multipaxos  # noqa: F401 - registers codecs
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    NOOP,
+    Chosen,
+    ChosenWatermark,
+    ClientReply,
+    ClientRequest,
+    ClientRequestBatch,
+    Command,
+    CommandBatch,
+    CommandId,
+    Phase1a,
+    Phase2a,
+    Phase2b,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    DEFAULT_SERIALIZER,
+    PickleSerializer,
+)
+
+HOT_MESSAGES = [
+    Phase2b(group_index=1, acceptor_index=2, slot=1 << 40, round=3),
+    Phase2b(group_index=0, acceptor_index=0, slot=0, round=-1),
+    Phase2a(slot=5, round=0, value=CommandBatch((Command(
+        CommandId(("10.0.0.1", 5000), 2, 7), b"hello"),))),
+    Phase2a(slot=5, round=2, value=NOOP),
+    Chosen(slot=9, value=NOOP),
+    Chosen(slot=9, value=CommandBatch((
+        Command(CommandId("sim-client", 0, 0), b""),
+        Command(CommandId(("h", 80), 1, 2), b"\x00\xff" * 64)))),
+    ClientRequest(Command(CommandId("client-1", 0, 1), b"x" * 100)),
+    ClientRequestBatch(CommandBatch((Command(
+        CommandId("c", 1, 2), b"p"),))),
+    ClientReply(CommandId(("h", 1), 0, 4), 17, b"result"),
+    ChosenWatermark(slot=42),
+]
+
+
+@pytest.mark.parametrize("message", HOT_MESSAGES,
+                         ids=lambda m: type(m).__name__)
+def test_binary_round_trip(message):
+    data = DEFAULT_SERIALIZER.to_bytes(message)
+    # Registered types must take the binary path (tag byte < 0x80).
+    assert data[0] < 128
+    assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_unregistered_types_fall_back_to_pickle():
+    message = Phase1a(round=1, chosen_watermark=0)
+    data = DEFAULT_SERIALIZER.to_bytes(message)
+    assert data[0] >= 128  # pickle PROTO opcode
+    assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_pickled_stream_from_legacy_sender_decodes():
+    message = HOT_MESSAGES[0]
+    legacy = PickleSerializer().to_bytes(message)
+    assert DEFAULT_SERIALIZER.from_bytes(legacy) == message
+
+
+def test_binary_encoding_is_compact_and_stable():
+    """The Phase2b layout is part of the wire contract: 1 tag byte +
+    two i64 + two i32, little-endian."""
+    data = DEFAULT_SERIALIZER.to_bytes(
+        Phase2b(group_index=3, acceptor_index=4, slot=258, round=7))
+    assert len(data) == 25
+    assert data[0] == 1  # Phase2bCodec.tag
+    assert data[1:9] == (258).to_bytes(8, "little")
+    assert data[9:17] == (7).to_bytes(8, "little")
+    # And it is several times smaller than the pickle it replaces.
+    assert len(data) < len(pickle.dumps(
+        Phase2b(group_index=3, acceptor_index=4, slot=258, round=7))) / 3
