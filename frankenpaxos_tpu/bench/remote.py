@@ -123,7 +123,13 @@ class RemoteProc:
         # difference from Popen(env=...): a remote launch OVERLAYS the
         # remote login environment rather than replacing it.
         identifier = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
-        always = re.compile(r"^(PYTHON|JAX_|XLA_|FPX_|TPU_)")
+        # NOT the bare PYTHON prefix: PYTHONPATH/PYTHONHOME carry local
+        # filesystem paths and must only ship when genuinely changed
+        # (the delta rule) -- force-exporting them would clobber the
+        # remote interpreter's module resolution.
+        always = re.compile(
+            r"^(PYTHONUNBUFFERED$|PYTHONDONTWRITEBYTECODE$"
+            r"|JAX_|XLA_|FPX_|TPU_)")
         exports = "".join(
             f"export {key}={shlex.quote(str(value))}; "
             for key, value in (env or {}).items()

@@ -1,0 +1,331 @@
+"""Live interactive protocol driving in the browser.
+
+The reference's Scala.js pages let a user step messages, fire timers,
+and partition actors mid-run (JsTransport.scala:60-299; partitioned
+actors at :77), across 23 demo pages (index.html:12-36) including the
+election and heartbeat components. This is the analog without a
+browser-side runtime: the protocol runs over a SimTransport inside a
+small stdlib HTTP server, and the page (``live_viewer.html``) drives it
+through a JSON API --
+
+  * ``GET  /api/state``               -- actors (+ state snapshots,
+    partition flags), in-flight messages, running timers, reply count
+  * ``POST /api/deliver {"id": n}``   -- deliver one buffered message
+  * ``POST /api/drop {"id": n}``      -- drop it (loss injection)
+  * ``POST /api/timer {"id": n}``     -- fire a running timer
+  * ``POST /api/partition {"actor"}`` / ``/api/heal`` -- JsTransport:77
+  * ``POST /api/command``             -- issue a client command
+  * ``POST /api/step {"n": k}``       -- k random scheduler steps
+
+Every protocol in the deployment registry is drivable, plus the
+``election`` and ``heartbeat`` component demos (the reference's
+dedicated pages for them).
+
+Usage::
+
+    python -m frankenpaxos_tpu.live --protocol multipaxos --port 8123
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.viz import snapshot_actor
+
+#: Component demos served alongside the registry protocols
+#: (reference index.html lists election/heartbeat pages).
+COMPONENT_DEMOS = ("election", "heartbeat")
+
+
+def _build_component(name: str, seed: int) -> dict:
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    labels: dict = {}
+    if name == "election":
+        from frankenpaxos_tpu.election.basic import ElectionParticipant
+
+        addresses = [f"participant-{i}" for i in range(3)]
+        actors = [ElectionParticipant(a, transport, logger, addresses,
+                                      seed=seed + i)
+                  for i, a in enumerate(addresses)]
+        for actor in actors:
+            actor.ping_timer.start() if actor.index == 0 else \
+                actor.no_ping_timer.start()
+    else:
+        from frankenpaxos_tpu.heartbeat import HeartbeatParticipant
+
+        addresses = [f"participant-{i}" for i in range(3)]
+        actors = [HeartbeatParticipant(a, transport, logger, addresses)
+                  for a in addresses]
+    labels.update({a: a for a in addresses})
+    return dict(protocol=name, transport=transport, labels=labels,
+                client=None, drive=None, replies=[])
+
+
+def build_system(protocol_name: str, *, f: int = 1, seed: int = 0) -> dict:
+    """Wire ``protocol_name`` over a SimTransport (same registry path as
+    viz.record_scenario) and return the pieces the server drives."""
+    if protocol_name in COMPONENT_DEMOS:
+        return _build_component(protocol_name, seed)
+
+    from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+
+    protocol = get_protocol(protocol_name)
+    counter = {"next": 0}
+
+    def fake_port():
+        counter["next"] += 1
+        return ["sim", counter["next"]]
+
+    raw = protocol.cluster(f, fake_port)
+    config = protocol.load_config(raw)
+    labels: dict = {}
+    counts: dict = {}
+
+    def walk(key, node):
+        if (isinstance(node, list) and len(node) == 2
+                and not isinstance(node[0], list)):
+            prefix = key.rstrip("s")
+            index = counts.get(prefix, 0)
+            counts[prefix] = index + 1
+            labels[(node[0], int(node[1]))] = f"{prefix}_{index}"
+        elif isinstance(node, list):
+            for item in node:
+                walk(key, item)
+
+    for key, node in raw.items():
+        if isinstance(node, list):
+            walk(key, node)
+
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides={}, seed=seed, state_machine="AppendLog")
+    for role_name, role in protocol.roles.items():
+        for index, address in enumerate(role.addresses(config)):
+            ctx.seed = seed + index
+            role.make(ctx, address, index)
+    client_ctx = DeployCtx(config=config, transport=transport,
+                           logger=logger, overrides={}, seed=seed + 100)
+    client_address = ("sim", "client-0")
+    labels[client_address] = "client_0"
+    client = protocol.make_client(client_ctx, client_address)
+    return dict(protocol=protocol_name, transport=transport,
+                labels=labels, client=client, drive=protocol.drive,
+                replies=[])
+
+
+class LiveSession:
+    """One drivable system + the lock serializing browser actions onto
+    its single-threaded actors."""
+
+    def __init__(self, protocol_name: str, *, f: int = 1, seed: int = 0):
+        self.lock = threading.Lock()
+        self.rng = random.Random(seed)
+        self.protocol_name = protocol_name
+        self.f = f
+        self.seed = seed
+        self.system = build_system(protocol_name, f=f, seed=seed)
+        self.issued = 0
+
+    def _label(self, address) -> str:
+        labels = self.system["labels"]
+        if isinstance(address, list):
+            address = (address[0], address[1])
+        return labels.get(address, str(address))
+
+    # --- API actions (all under the lock) ---------------------------------
+    def state(self) -> dict:
+        with self.lock:
+            transport = self.system["transport"]
+            actors = []
+            for address, actor in transport.actors.items():
+                actors.append({
+                    "label": self._label(address),
+                    "partitioned": address in transport.partitioned,
+                    "state": snapshot_actor(actor),
+                })
+            actors.sort(key=lambda a: a["label"])
+            messages = [{
+                "id": m.id,
+                "src": self._label(m.src),
+                "dst": self._label(m.dst),
+                "label": type(self.system["transport"].actors[m.dst]
+                              .serializer.from_bytes(m.data)).__name__
+                if m.dst in transport.actors else "?",
+            } for m in transport.messages[:200]]
+            timers = [{
+                "id": t.id,
+                "actor": self._label(t.address),
+                "name": t.name,
+            } for t in transport.running_timers()]
+            return {
+                "protocol": self.protocol_name,
+                "has_client": self.system["client"] is not None,
+                "actors": actors,
+                "messages": messages,
+                "timers": timers,
+                "history_len": len(transport.history),
+                "issued": self.issued,
+                "completed": len(self.system["replies"]),
+            }
+
+    def command(self) -> None:
+        with self.lock:
+            client, drive = self.system["client"], self.system["drive"]
+            if client is None:
+                raise ValueError(
+                    f"{self.protocol_name} has no client to drive")
+            replies = self.system["replies"]
+            drive(client, self.issued, lambda *_: replies.append(True))
+            self.issued += 1
+
+    def deliver(self, message_id: int) -> None:
+        with self.lock:
+            transport = self.system["transport"]
+            for message in transport.messages:
+                if message.id == message_id:
+                    transport.deliver_message(message)
+                    return
+            raise ValueError(f"no buffered message {message_id}")
+
+    def drop(self, message_id: int) -> None:
+        with self.lock:
+            transport = self.system["transport"]
+            for message in transport.messages:
+                if message.id == message_id:
+                    transport.messages.remove(message)
+                    return
+            raise ValueError(f"no buffered message {message_id}")
+
+    def timer(self, timer_id: int) -> None:
+        with self.lock:
+            self.system["transport"].trigger_timer(timer_id)
+
+    def partition(self, label: str, heal: bool = False) -> None:
+        with self.lock:
+            transport = self.system["transport"]
+            for address in transport.actors:
+                if self._label(address) == label:
+                    (transport.heal if heal
+                     else transport.partition)(address)
+                    return
+            raise ValueError(f"no actor {label!r}")
+
+    def step(self, n: int = 1) -> None:
+        with self.lock:
+            transport = self.system["transport"]
+            for _ in range(n):
+                command = transport.generate_command(self.rng)
+                if command is None:
+                    break
+                transport.run_command(command)
+
+    def reset(self) -> None:
+        with self.lock:
+            self.system = build_system(self.protocol_name, f=self.f,
+                                       seed=self.seed)
+            self.issued = 0
+
+
+def make_handler(session: LiveSession):
+    import os
+
+    page = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "live_viewer.html")
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _json(self, payload, status=200):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/", "/index.html"):
+                with open(page, "rb") as f:
+                    body = f.read()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/api/state":
+                self._json(session.state())
+            else:
+                self._json({"error": "not found"}, 404)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            try:
+                if self.path == "/api/command":
+                    session.command()
+                elif self.path == "/api/deliver":
+                    session.deliver(int(body["id"]))
+                elif self.path == "/api/drop":
+                    session.drop(int(body["id"]))
+                elif self.path == "/api/timer":
+                    session.timer(int(body["id"]))
+                elif self.path == "/api/partition":
+                    session.partition(body["actor"])
+                elif self.path == "/api/heal":
+                    session.partition(body["actor"], heal=True)
+                elif self.path == "/api/step":
+                    session.step(int(body.get("n", 1)))
+                elif self.path == "/api/reset":
+                    session.reset()
+                else:
+                    self._json({"error": "not found"}, 404)
+                    return
+                self._json(session.state())
+            except (ValueError, KeyError) as e:
+                self._json({"error": str(e)}, 400)
+
+    return Handler
+
+
+def serve(protocol_name: str, port: int = 8123, *, f: int = 1,
+          seed: int = 0) -> ThreadingHTTPServer:
+    """Start the live server (non-blocking; returns the server)."""
+    session = LiveSession(protocol_name, f=f, seed=seed)
+    server = ThreadingHTTPServer(("127.0.0.1", port),
+                                 make_handler(session))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    from frankenpaxos_tpu.deploy import PROTOCOL_NAMES
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--protocol", default="multipaxos",
+                        choices=[*PROTOCOL_NAMES, *COMPONENT_DEMOS])
+    parser.add_argument("--port", type=int, default=8123)
+    parser.add_argument("--f", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    server = serve(args.protocol, args.port, f=args.f, seed=args.seed)
+    print(f"live {args.protocol} at http://127.0.0.1:{args.port}/ "
+          f"(ctrl-c to stop)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -173,50 +173,47 @@ class TcpTransport(Transport):
         from frankenpaxos_tpu import native
 
         buf = bytearray()
-        # Declared total size of the frame at the head of `buf` (0 =
-        # not known yet). While the head frame is incomplete, chunks
-        # are appended WITHOUT rescanning -- a large frame arriving in
-        # many chunks must not re-copy/re-scan the whole buffer per
-        # chunk -- and the oversize check is against this declared
-        # length, never the buffer size (a near-cap frame followed by
-        # the next frame's first bytes is legitimate).
-        need = 0
         try:
             while True:
                 chunk = await reader.read(1 << 16)
                 if not chunk:
                     break
                 buf += chunk
-                if need == 0 and len(buf) >= 4:
+                # Dispatch every complete frame currently buffered.
+                # The head-frame length check gates each scan: while a
+                # large frame is still arriving, each chunk costs one
+                # unpack and no rescan of the whole buffer; the
+                # oversize check is against the frame's DECLARED
+                # length, never the buffer size (a near-cap frame
+                # pipelined with the next frame's first bytes is
+                # legitimate). The inner loop re-scans because the
+                # native scanner caps one pass at 4096 frames -- a
+                # single pass over a deeper backlog would strand the
+                # remainder until the peer happened to send more.
+                while len(buf) >= 4:
                     (inner,) = _LEN.unpack_from(buf, 0)
                     if inner > MAX_FRAME:
                         self.logger.error(
                             f"oversized frame ({inner} bytes)")
                         return
-                    need = 4 + inner
-                if not need or len(buf) < need:
-                    continue
-                frames, consumed = native.scan_frames(bytes(buf))
-                for start, end in frames:
-                    (hlen,) = _LEN.unpack_from(buf, start)
-                    header = bytes(buf[start + 4:start + 4 + hlen]).decode()
-                    host, _, port = header.rpartition(":")
-                    src: Address = (host, int(port))
-                    data = bytes(buf[start + 4 + hlen:end])
-                    self._dispatch(local, src, data)
-                del buf[:consumed]
-                need = 0
-                if len(buf) >= 4:
-                    (inner,) = _LEN.unpack_from(buf, 0)
-                    if inner > MAX_FRAME:
-                        self.logger.error(
-                            f"oversized frame ({inner} bytes)")
+                    if len(buf) < 4 + inner:
+                        break
+                    try:
+                        frames, consumed = native.scan_frames(bytes(buf))
+                    except ValueError as e:  # a mid-buffer oversized frame
+                        self.logger.error(str(e))
                         return
-                    need = 4 + inner
+                    for start, end in frames:
+                        (hlen,) = _LEN.unpack_from(buf, start)
+                        header = bytes(
+                            buf[start + 4:start + 4 + hlen]).decode()
+                        host, _, port = header.rpartition(":")
+                        src: Address = (host, int(port))
+                        data = bytes(buf[start + 4 + hlen:end])
+                        self._dispatch(local, src, data)
+                    del buf[:consumed]
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
-        except ValueError as e:  # scan_frames: frame exceeds the cap
-            self.logger.error(str(e))
         finally:
             writer.close()
 

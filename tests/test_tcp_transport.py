@@ -143,3 +143,50 @@ def test_connect_failure_drops_and_logs(transports):
         assert wait_for(lambda: any("connect" in m for _, m in logger.records))
     finally:
         t.stop()
+
+
+def test_burst_beyond_scanner_frame_cap():
+    """A single flush of more frames than one native scan pass returns
+    (4096) must still dispatch every frame -- the receive loop re-scans
+    the backlog instead of waiting for more bytes."""
+    import threading
+
+    from frankenpaxos_tpu.bench.harness import free_port
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.actor import Actor
+
+    logger = FakeLogger(LogLevel.FATAL)
+    a_addr = ("127.0.0.1", free_port())
+    b_addr = ("127.0.0.1", free_port())
+    ta = TcpTransport(a_addr, logger)
+    ta.start()
+    tb = TcpTransport(b_addr, logger)
+    tb.start()
+    n = 6000
+    got = []
+    done = threading.Event()
+
+    class Sink(Actor):
+        def receive(self, src, message):
+            got.append(message)
+            if len(got) == n:
+                done.set()
+
+    class Src(Actor):
+        def receive(self, src, message):
+            pass
+
+    Sink(b_addr, tb, logger)
+    src = Src(a_addr, ta, logger)
+
+    def send():
+        for i in range(n):
+            src.send_no_flush(b_addr, b"m%d" % i)
+        src.flush(b_addr)
+
+    try:
+        ta.loop.call_soon_threadsafe(send)
+        assert done.wait(30), f"only {len(got)}/{n} delivered"
+    finally:
+        ta.stop()
+        tb.stop()
