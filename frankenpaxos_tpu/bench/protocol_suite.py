@@ -64,7 +64,8 @@ def run_protocol_benchmark(bench: BenchmarkDirectory, protocol_name: str,
                            *, f: int = 1, client_procs: int = 2,
                            clients_per_proc: int = 5,
                            duration_s: float = 3.0,
-                           state_machine: str = "AppendLog") -> dict:
+                           state_machine: str = "AppendLog",
+                           supernode: bool = False) -> dict:
     if protocol_name in SINGLE_DECREE:
         client_procs, clients_per_proc = 1, 1
     protocol = get_protocol(protocol_name)
@@ -74,7 +75,8 @@ def run_protocol_benchmark(bench: BenchmarkDirectory, protocol_name: str,
     launch_roles(bench, protocol_name, config_path, config,
                  state_machine=state_machine,
                  overrides={"resend_phase1as_period_s": "0.5",
-                            **LAUNCH_OVERRIDES.get(protocol_name, {})})
+                            **LAUNCH_OVERRIDES.get(protocol_name, {})},
+                 supernode=supernode)
 
     host = LocalHost()
     env = role_process_env()

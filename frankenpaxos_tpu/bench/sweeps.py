@@ -1,0 +1,248 @@
+"""Paper-experiment sweep families + plots.
+
+The reference commits parameterized sweeps and plot scripts per paper
+(benchmarks/{eurosys,nsdi,vldb20_matchmaker,vldb21_compartmentalized,
+vldb21_evelyn}/: fig1_multipaxos_lt_plot.py and friends). This is the
+analog: named families sweep offered load over deployed clusters, write
+tidy CSVs, and render the paper's latency-throughput figures with
+matplotlib.
+
+Families (reference analog in parens):
+
+  * ``eurosys_fig1`` -- compartmentalized vs coupled MultiPaxos vs
+    unreplicated LT curves (eurosys/fig1_multipaxos_lt_plot.py).
+  * ``eurosys_fig2`` -- the same shape for Mencius
+    (eurosys/fig2_mencius_lt_plot.py).
+  * ``matchmaker_lt`` -- MatchmakerMultiPaxos LT (vldb20_matchmaker).
+  * ``read_scale``   -- read throughput vs replica count at a
+    read-heavy mix (vldb21_evelyn; wraps bench/read_scale.py's
+    mechanism).
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.sweeps --family eurosys_fig1 \
+        --out_dir bench_results/sweeps
+
+NOTE: this host has one core, so absolute numbers mostly reflect
+scheduling, not the architectural ceiling (see bench/coupled.py's
+note); the sweeps exist so multi-core/multi-host runs have
+infrastructure to inherit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import tempfile
+
+from frankenpaxos_tpu.bench.harness import SuiteDirectory
+
+#: (client_procs, clients_per_proc) load points, smallest first.
+DEFAULT_POINTS = ((1, 2), (2, 5), (4, 5))
+
+
+def _lt_row(series: str, procs: int, loops: int, stats: dict) -> dict:
+    return {
+        "series": series,
+        "num_client_procs": procs,
+        "num_clients_per_proc": loops,
+        "num_clients": procs * loops,
+        "throughput_p90_1s": stats.get("start_throughput_1s.p90"),
+        "latency_median_ms": stats.get("latency.median_ms"),
+        "num_requests": stats.get("num_requests"),
+    }
+
+
+def _protocol_series(suite, series: str, protocol: str, points,
+                     duration_s: float, supernode: bool = False) -> list:
+    from frankenpaxos_tpu.bench.protocol_suite import (
+        run_protocol_benchmark,
+    )
+
+    rows = []
+    for procs, loops in points:
+        # One retry per point: a role process occasionally loses the
+        # startup race on a loaded single-core host; a lost point must
+        # not abort the whole family.
+        for attempt in (1, 2):
+            try:
+                stats = run_protocol_benchmark(
+                    suite.benchmark_directory(), protocol,
+                    client_procs=procs, clients_per_proc=loops,
+                    duration_s=duration_s, supernode=supernode)
+                rows.append(_lt_row(series, procs, loops, stats))
+                break
+            except RuntimeError as e:
+                print(f"point ({series}, {procs}x{loops}) attempt "
+                      f"{attempt} failed: {e}")
+        else:
+            rows.append(_lt_row(series, procs, loops, {}))
+        print(json.dumps(rows[-1]))
+    return rows
+
+
+def eurosys_fig(protocol: str, suite: SuiteDirectory, points,
+                duration_s: float) -> list:
+    """Compartmentalized vs coupled vs unreplicated (fig1/fig2 shape)."""
+    rows = []
+    rows += _protocol_series(suite, protocol, protocol, points,
+                             duration_s)
+    rows += _protocol_series(suite, f"coupled_{protocol}", protocol,
+                             points, duration_s, supernode=True)
+    rows += _protocol_series(suite, "unreplicated", "unreplicated",
+                             points, duration_s)
+    return rows
+
+
+def matchmaker_lt(suite: SuiteDirectory, points,
+                  duration_s: float) -> list:
+    return _protocol_series(suite, "matchmakermultipaxos",
+                            "matchmakermultipaxos", points, duration_s)
+
+
+def read_scale(suite: SuiteDirectory, points, duration_s: float) -> list:
+    """Read throughput vs replica count at a 95% read mix (the Evelyn
+    scaling claim: reads scale with replicas, writes don't pay). The
+    sweep axis is the replica count; the offered load is the LARGEST
+    of the requested load points (reads must saturate to show the
+    scaling)."""
+    from frankenpaxos_tpu.bench.multipaxos_suite import (
+        MultiPaxosInput,
+        run_benchmark,
+    )
+    from frankenpaxos_tpu.bench.workload import UniformReadWriteWorkload
+
+    procs, loops = max(points, key=lambda p: p[0] * p[1])
+    rows = []
+    for num_replicas in (2, 3, 4):
+        stats = run_benchmark(
+            suite.benchmark_directory(),
+            MultiPaxosInput(
+                num_clients=loops, client_procs=procs,
+                duration_s=duration_s,
+                num_replicas=num_replicas,
+                workload=UniformReadWriteWorkload(num_keys=16,
+                                                  read_fraction=0.95),
+                read_consistency="eventual", state_machine="KeyValueStore"))
+        rows.append({
+            "series": "eventual_reads",
+            "num_client_procs": procs,
+            "num_clients_per_proc": loops,
+            "num_replicas": num_replicas,
+            "read_throughput_p90_1s": stats.get(
+                "read.start_throughput_1s.p90"),
+            "write_throughput_p90_1s": stats.get(
+                "write.start_throughput_1s.p90"),
+            "latency_median_ms": stats.get("latency.median_ms"),
+            "num_requests": stats.get("num_requests"),
+        })
+        print(json.dumps(rows[-1]))
+    return rows
+
+
+FAMILIES = {
+    "eurosys_fig1": lambda suite, points, d: eurosys_fig(
+        "multipaxos", suite, points, d),
+    "eurosys_fig2": lambda suite, points, d: eurosys_fig(
+        "mencius", suite, points, d),
+    "matchmaker_lt": matchmaker_lt,
+    "read_scale": read_scale,
+}
+
+
+def write_csv(rows: list, path: str) -> None:
+    fields = sorted({key for row in rows for key in row},
+                    key=lambda k: (k != "series", k))
+    with open(path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=fields)
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def plot_lt(rows: list, path: str, title: str) -> None:
+    """Reference plot shape (fig1_multipaxos_lt_plot.py:22-49):
+    throughput (thousands cmds/s) on x, median latency (ms) on y, one
+    line per series."""
+    import matplotlib
+
+    matplotlib.use("pdf")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(1, 1, figsize=(6.4, 4.8))
+    markers = {series: marker for series, marker in zip(
+        dict.fromkeys(row["series"] for row in rows),
+        ("o-", "^-", "s-", "d-", "v-"))}
+    for series in dict.fromkeys(row["series"] for row in rows):
+        pts = sorted((row for row in rows if row["series"] == series),
+                     key=lambda row: row.get("num_clients", 0))
+        xs = [(row.get("throughput_p90_1s") or 0) / 1000 for row in pts]
+        ys = [row.get("latency_median_ms") or 0 for row in pts]
+        ax.plot(xs, ys, markers[series], label=series, linewidth=2)
+    ax.set_xlabel("Throughput (thousands of commands per second)")
+    ax.set_ylabel("Median latency (ms)")
+    ax.set_title(title)
+    ax.legend(loc="best")
+    ax.grid()
+    fig.savefig(path, bbox_inches="tight")
+
+
+def plot_read_scale(rows: list, path: str) -> None:
+    import matplotlib
+
+    matplotlib.use("pdf")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(1, 1, figsize=(6.4, 4.8))
+    xs = [row["num_replicas"] for row in rows]
+    ax.plot(xs, [(row["read_throughput_p90_1s"] or 0) / 1000
+                 for row in rows], "o-", label="reads", linewidth=2)
+    ax.plot(xs, [(row["write_throughput_p90_1s"] or 0) / 1000
+                 for row in rows], "^-", label="writes", linewidth=2)
+    ax.set_xlabel("Number of replicas")
+    ax.set_ylabel("Throughput (thousands of commands per second)")
+    ax.set_title("read scaling (vldb21_evelyn shape)")
+    ax.legend(loc="best")
+    ax.grid()
+    fig.savefig(path, bbox_inches="tight")
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--family", default="all",
+                        choices=["all", *FAMILIES])
+    parser.add_argument("--points", type=str, default=None,
+                        help="comma-separated procsxloops load points")
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--out_dir", default="bench_results/sweeps")
+    parser.add_argument("--suite_dir", default=None)
+    args = parser.parse_args(argv)
+
+    points = DEFAULT_POINTS
+    if args.points:
+        points = tuple(tuple(int(x) for x in part.split("x"))
+                       for part in args.points.split(","))
+    os.makedirs(args.out_dir, exist_ok=True)
+    root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_sweeps_")
+    names = list(FAMILIES) if args.family == "all" else [args.family]
+
+    out = {}
+    for name in names:
+        suite = SuiteDirectory(root, name)
+        rows = FAMILIES[name](suite, points, args.duration)
+        csv_path = os.path.join(args.out_dir, f"{name}.csv")
+        pdf_path = os.path.join(args.out_dir, f"{name}.pdf")
+        write_csv(rows, csv_path)
+        if name == "read_scale":
+            plot_read_scale(rows, pdf_path)
+        else:
+            plot_lt(rows, pdf_path, name)
+        out[name] = {"rows": len(rows), "csv": csv_path,
+                     "plot": pdf_path}
+        print(json.dumps({name: out[name]}))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -111,6 +111,7 @@ class CasPaxosLeader(Actor):
         self.phase1bs: dict[int, Phase1b] = {}
         self.phase2bs: dict[int, Phase2b] = {}
         self.phase2_value: Optional[frozenset] = None
+        self.phase2_served: list = []
         self._resend_timer = None
         self._recover_timer = None
 
@@ -171,10 +172,19 @@ class CasPaxosLeader(Actor):
         best = max(self.phase1bs.values(), key=lambda r: r.vote_round)
         previous = (frozenset() if best.vote_round == -1
                     else best.vote_value)
-        new_value = frozenset(previous | self.client_requests[0].int_set)
+        # Serve EVERY queued update in this one consensus round: the
+        # register's CAS function is set union, which is associative,
+        # so previous ∪ delta_1 ∪ ... ∪ delta_k is exactly the state a
+        # serial execution of the k updates would reach, and each
+        # client's reply (the accepted state) contains its delta. Under
+        # contention this turns k dueling-prone rounds into one.
+        served = list(self.client_requests)
+        new_value = frozenset(previous.union(
+            *(request.int_set for request in served)))
         self._stop_timers()
         self.status = "phase2"
         self.phase2_value = new_value
+        self.phase2_served = served
         self.phase2bs.clear()
         phase2a = Phase2a(round=self.round, value=new_value)
         for acceptor in self.config.acceptor_addresses:
@@ -187,10 +197,15 @@ class CasPaxosLeader(Actor):
         self.phase2bs[phase2b.acceptor_index] = phase2b
         if len(self.phase2bs) < self.config.quorum_size:
             return
-        request = self.client_requests.pop(0)
-        self.send(request.client_address,
-                  ClientReply(client_id=request.client_id,
-                              value=self.phase2_value))
+        served = self.phase2_served
+        self.phase2_served = []
+        # Requests that arrived during phase 2 stay queued for the next
+        # round; the served prefix is acked with the accepted state.
+        del self.client_requests[:len(served)]
+        for request in served:
+            self.send(request.client_address,
+                      ClientReply(client_id=request.client_id,
+                                  value=self.phase2_value))
         self._stop_timers()
         self.round = self.round_system.next_classic_round(self.index,
                                                           self.round)

@@ -22,6 +22,11 @@ from frankenpaxos_tpu.protocols.mencius.roles import (
     MenciusLeader,
     MenciusProxyLeader,
 )
+# Importing registers the Mencius-specific binary codecs with the
+# hybrid serializer (the inner MultiPaxos machinery's types are
+# registered by protocols.multipaxos).
+from frankenpaxos_tpu.protocols.mencius import wire  # noqa: F401
+
 
 __all__ = [
     "DistributionScheme",

@@ -1,0 +1,114 @@
+"""Binary codecs for Mencius' own hot-path messages.
+
+Mencius reuses the MultiPaxos message types for its inner MultiPaxos
+machinery (common.py re-exports them), so those already ride the
+codecs in protocols/multipaxos/wire.py. This module covers the
+Mencius-specific stream: per-slot Chosen, the HighWatermark gossip
+(sent every command at LT settings), and the noop-range skip triplet
+(Mencius.proto:160-202) -- all pure fixed-width layouts.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols.mencius.common import (
+    Chosen,
+    ChosenNoopRange,
+    HighWatermark,
+    Phase2aNoopRange,
+    Phase2bNoopRange,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_value,
+    _take_value,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I64 = struct.Struct("<q")
+_QQI = struct.Struct("<qqi")
+_P2BNR = struct.Struct("<qqiiq")  # start, end, group, acceptor, round
+_I64I64 = struct.Struct("<qq")
+
+
+class MenciusChosenCodec(MessageCodec):
+    message_type = Chosen
+    tag = 8
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 8)
+        return Chosen(slot=slot, value=value), at
+
+
+class HighWatermarkCodec(MessageCodec):
+    message_type = HighWatermark
+    tag = 9
+
+    def encode(self, out, message):
+        out += _I64.pack(message.next_slot)
+
+    def decode(self, buf, at):
+        (next_slot,) = _I64.unpack_from(buf, at)
+        return HighWatermark(next_slot=next_slot), at + 8
+
+
+class Phase2aNoopRangeCodec(MessageCodec):
+    message_type = Phase2aNoopRange
+    tag = 10
+
+    def encode(self, out, message):
+        out += _QQI.pack(message.slot_start_inclusive,
+                         message.slot_end_exclusive, message.round)
+
+    def decode(self, buf, at):
+        start, end, round = _QQI.unpack_from(buf, at)
+        return Phase2aNoopRange(slot_start_inclusive=start,
+                                slot_end_exclusive=end,
+                                round=round), at + 20
+
+
+class Phase2bNoopRangeCodec(MessageCodec):
+    message_type = Phase2bNoopRange
+    tag = 11
+
+    def encode(self, out, message):
+        out += _P2BNR.pack(message.slot_start_inclusive,
+                           message.slot_end_exclusive,
+                           message.acceptor_group_index,
+                           message.acceptor_index, message.round)
+
+    def decode(self, buf, at):
+        start, end, group, acceptor, round = _P2BNR.unpack_from(buf, at)
+        return Phase2bNoopRange(acceptor_group_index=group,
+                                acceptor_index=acceptor,
+                                slot_start_inclusive=start,
+                                slot_end_exclusive=end,
+                                round=round), at + _P2BNR.size
+
+
+class ChosenNoopRangeCodec(MessageCodec):
+    message_type = ChosenNoopRange
+    tag = 12
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.slot_start_inclusive,
+                            message.slot_end_exclusive)
+
+    def decode(self, buf, at):
+        start, end = _I64I64.unpack_from(buf, at)
+        return ChosenNoopRange(slot_start_inclusive=start,
+                               slot_end_exclusive=end), at + 16
+
+
+for _codec in (MenciusChosenCodec(), HighWatermarkCodec(),
+               Phase2aNoopRangeCodec(), Phase2bNoopRangeCodec(),
+               ChosenNoopRangeCodec()):
+    register_codec(_codec)

@@ -27,12 +27,19 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase1bSlotInfo,
     Phase2a,
     Phase2b,
+    Phase2bRange,
 )
 
 
 @dataclasses.dataclass(frozen=True)
 class AcceptorOptions:
     measure_latencies: bool = True
+    # Ack contiguous same-round Phase2a runs voted within one event-loop
+    # drain as ONE Phase2bRange per proxy leader (see
+    # messages.Phase2bRange). Lone votes still go as plain Phase2bs, so
+    # per-message delivery (the adversarial sims) is byte-identical to
+    # the reference shape.
+    range_phase2bs: bool = True
 
 
 @dataclasses.dataclass
@@ -64,6 +71,8 @@ class Acceptor(Actor):
         self.round = -1
         self.states: SortedDict = SortedDict()  # slot -> _VoteState
         self.max_voted_slot = -1
+        # Phase2b acks staged during this drain: dst -> [(slot, round)].
+        self._pending_phase2bs: dict[Address, list] = {}
 
     def receive(self, src: Address, message) -> None:
         # timed(label) handler latency summaries (Leader.scala:281-293).
@@ -122,9 +131,42 @@ class Acceptor(Actor):
         self.states[phase2a.slot] = _VoteState(vote_round=self.round,
                                                vote_value=phase2a.value)
         self.max_voted_slot = max(self.max_voted_slot, phase2a.slot)
-        self.send(src, Phase2b(group_index=self.group_index,
-                               acceptor_index=self.index,
-                               slot=phase2a.slot, round=self.round))
+        if self.options.range_phase2bs:
+            # Stage the ack; on_drain coalesces contiguous runs per
+            # destination into Phase2bRanges.
+            self._pending_phase2bs.setdefault(src, []).append(
+                (phase2a.slot, self.round))
+        else:
+            self.send(src, Phase2b(group_index=self.group_index,
+                                   acceptor_index=self.index,
+                                   slot=phase2a.slot, round=self.round))
+
+    def on_drain(self) -> None:
+        if not self._pending_phase2bs:
+            return
+        pending, self._pending_phase2bs = self._pending_phase2bs, {}
+        for dst, acks in pending.items():
+            acks.sort()
+            start = 0
+            for i in range(1, len(acks) + 1):
+                if (i < len(acks)
+                        and acks[i][0] == acks[i - 1][0] + 1
+                        and acks[i][1] == acks[i - 1][1]):
+                    continue
+                run = acks[start:i]
+                start = i
+                if len(run) == 1:
+                    self.send(dst, Phase2b(
+                        group_index=self.group_index,
+                        acceptor_index=self.index,
+                        slot=run[0][0], round=run[0][1]))
+                else:
+                    self.send(dst, Phase2bRange(
+                        group_index=self.group_index,
+                        acceptor_index=self.index,
+                        slot_start_inclusive=run[0][0],
+                        slot_end_exclusive=run[-1][0] + 1,
+                        round=run[0][1]))
 
     def _handle_max_slot_request(self, src: Address,
                                  request: MaxSlotRequest) -> None:

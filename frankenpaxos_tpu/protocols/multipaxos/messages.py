@@ -132,6 +132,24 @@ class Phase2b:
 
 
 @dataclasses.dataclass(frozen=True)
+class Phase2bRange:
+    """One acceptor's votes for a contiguous slot run in one round.
+
+    A TPU-first departure from the reference's per-slot Phase2b
+    (MultiPaxos.proto Phase2b): an acceptor that voted a contiguous run
+    of Phase2as within one event-loop drain acks them in ONE message,
+    making vote traffic (and the ProxyLeader's per-vote Python) scale
+    with drains rather than slots -- the shape the vote board's dense
+    record_block path consumes directly."""
+
+    group_index: int
+    acceptor_index: int
+    slot_start_inclusive: int
+    slot_end_exclusive: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
 class Chosen:
     slot: int
     value: CommandBatchOrNoop

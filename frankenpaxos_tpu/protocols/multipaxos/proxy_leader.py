@@ -24,6 +24,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Chosen,
     Phase2a,
     Phase2b,
+    Phase2bRange,
 )
 from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
     DictQuorumTracker,
@@ -130,6 +131,9 @@ class ProxyLeader(Actor):
         elif isinstance(message, Phase2b):
             self.metrics_requests.labels("Phase2b").inc()
             self._handle_phase2b(src, message)
+        elif isinstance(message, Phase2bRange):
+            self.metrics_requests.labels("Phase2bRange").inc()
+            self._handle_phase2b_range(src, message)
         else:
             self.logger.fatal(f"unexpected proxy leader message {message!r}")
 
@@ -175,6 +179,17 @@ class ProxyLeader(Actor):
             return
         self.tracker.record(phase2b.slot, phase2b.round,
                             phase2b.group_index, phase2b.acceptor_index)
+
+    def _handle_phase2b_range(self, src: Address,
+                              r: Phase2bRange) -> None:
+        """A contiguous run of votes in one message: O(1) Python on the
+        device tracker (the dict oracle expands per slot). No per-slot
+        pending check here -- every slot in the range was a Phase2a THIS
+        proxy leader sent to that acceptor, so each is in ``pending`` or
+        already ``_done``; ``_emit_chosen`` dedups either way."""
+        self.tracker.record_range(r.slot_start_inclusive,
+                                  r.slot_end_exclusive, r.round,
+                                  r.group_index, r.acceptor_index)
 
     def on_drain(self) -> None:
         self._emit_chosen(self.tracker.drain())

@@ -35,6 +35,13 @@ class QuorumTracker(abc.ABC):
                acceptor_index: int) -> None:
         ...
 
+    def record_range(self, slot_start: int, slot_end: int, round: int,
+                     group_index: int, acceptor_index: int) -> None:
+        """One acceptor's votes for slots [slot_start, slot_end) in one
+        round (a Phase2bRange). Default: per-slot expansion."""
+        for slot in range(slot_start, slot_end):
+            self.record(slot, round, group_index, acceptor_index)
+
     @abc.abstractmethod
     def drain(self) -> list[tuple[int, int]]:
         """Flush buffered votes; return [(slot, round)] newly at quorum."""
@@ -113,6 +120,9 @@ class TpuQuorumTracker(QuorumTracker):
         self._slots: list[int] = []
         self._cols: list[int] = []
         self._rounds: list[int] = []
+        # Ranged votes (Phase2bRange): [(start, end, col, round)] --
+        # O(1) Python per message, expanded vectorized at drain time.
+        self._ranges: list[tuple[int, int, int, int]] = []
         # Kernel width buckets. Drains are chunked to these so ONLY the
         # prewarmed widths ever compile -- an unexpected width compiling
         # mid-run stalls the event loop for seconds over a remote device
@@ -149,6 +159,12 @@ class TpuQuorumTracker(QuorumTracker):
         self._cols.append(group_index * self._row_size + acceptor_index)
         self._rounds.append(round)
 
+    def record_range(self, slot_start, slot_end, round, group_index,
+                     acceptor_index) -> None:
+        self._ranges.append((slot_start, slot_end,
+                             group_index * self._row_size
+                             + acceptor_index, round))
+
     def drain(self) -> list[tuple[int, int]]:
         """A handful of device calls (ideally one) per event-loop drain.
 
@@ -164,11 +180,25 @@ class TpuQuorumTracker(QuorumTracker):
         reported before the newer round's preemption clears it
         (matching DictQuorumTracker's arrival-order liveness).
         """
-        if not self._slots:
+        if not self._slots and not self._ranges:
             return []
         slots = np.asarray(self._slots, dtype=np.int64)
         cols = np.asarray(self._cols, dtype=np.int32)
         rounds = np.asarray(self._rounds, dtype=np.int32)
+        if self._ranges:
+            # Expand ranged votes vectorized (the whole point of
+            # Phase2bRange: no per-slot Python before this point).
+            parts_s = [slots] if slots.size else []
+            parts_c = [cols] if slots.size else []
+            parts_r = [rounds] if slots.size else []
+            for start, end, col, rnd in self._ranges:
+                width = end - start
+                parts_s.append(np.arange(start, end, dtype=np.int64))
+                parts_c.append(np.full(width, col, dtype=np.int32))
+                parts_r.append(np.full(width, rnd, dtype=np.int32))
+            slots = np.concatenate(parts_s)
+            cols = np.concatenate(parts_c)
+            rounds = np.concatenate(parts_r)
         device_parts = []  # (index array into this drain, device mask,
         #                     positions into the mask)
 
@@ -195,8 +225,9 @@ class TpuQuorumTracker(QuorumTracker):
                                                         vote_round=dom)
                 device_parts.append((np.arange(slots.shape[0]), newly,
                                      slots - lo))
-                dispatch = (self._slots, self._rounds, device_parts)
+                dispatch = (slots, rounds, device_parts)
                 self._slots, self._cols, self._rounds = [], [], []
+                self._ranges = []
                 if self.pipelined:
                     self._inflight.append(dispatch)
                     return []
@@ -272,8 +303,9 @@ class TpuQuorumTracker(QuorumTracker):
         if post is not None and post.size:
             self._dispatch_sparse(device_parts, slots, cols, rounds, post)
 
-        dispatch = (self._slots, self._rounds, device_parts)
+        dispatch = (slots, rounds, device_parts)
         self._slots, self._cols, self._rounds = [], [], []
+        self._ranges = []
         if self.pipelined:
             self._inflight.append(dispatch)
             return []

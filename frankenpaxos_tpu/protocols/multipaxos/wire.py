@@ -37,6 +37,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     NOOP,
     Phase2a,
     Phase2b,
+    Phase2bRange,
 )
 
 _I64 = struct.Struct("<q")
@@ -232,7 +233,28 @@ class ChosenWatermarkCodec(MessageCodec):
         return ChosenWatermark(slot=slot), at + 8
 
 
+_P2BR = struct.Struct("<qqqii")  # start, end, round, group, acceptor
+
+
+class Phase2bRangeCodec(MessageCodec):
+    message_type = Phase2bRange
+    tag = 13
+
+    def encode(self, out, message):
+        out += _P2BR.pack(message.slot_start_inclusive,
+                          message.slot_end_exclusive, message.round,
+                          message.group_index, message.acceptor_index)
+
+    def decode(self, buf, at):
+        start, end, round, group, acceptor = _P2BR.unpack_from(buf, at)
+        return Phase2bRange(group_index=group, acceptor_index=acceptor,
+                            slot_start_inclusive=start,
+                            slot_end_exclusive=end,
+                            round=round), at + _P2BR.size
+
+
 for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                ClientRequestCodec(), ClientRequestBatchCodec(),
-               ClientReplyCodec(), ChosenWatermarkCodec()):
+               ClientReplyCodec(), ChosenWatermarkCodec(),
+               Phase2bRangeCodec()):
     register_codec(_codec)

@@ -493,6 +493,73 @@ def test_quorum_tracker_mixed_round_drain_reports_old_quorum():
         assert (5, 0) in out, (tracker_cls, out)
 
 
+def test_quorum_tracker_ranged_votes_match_dict():
+    """Phase2bRange votes (O(1) Python on the device tracker, per-slot
+    expansion on the dict oracle) report identical quorums across mixed
+    ranged/single/straggler drains."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        DictQuorumTracker,
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    config = sim.config
+    for seed in range(3):
+        rng = random.Random(500 + seed)
+        trackers = [DictQuorumTracker(config),
+                    TpuQuorumTracker(config, window=1 << 12)]
+        cursor = 0
+        for _ in range(12):
+            kind = rng.random()
+            if kind < 0.6 or cursor == 0:
+                width = rng.randrange(2, 64)
+                for acc in range(3):
+                    if rng.random() < 0.9:
+                        for t in trackers:
+                            t.record_range(cursor, cursor + width, 0,
+                                           0, acc)
+                cursor += width
+            elif kind < 0.8:
+                for t in trackers:
+                    t.record(cursor, 0, 0, rng.randrange(3))
+                cursor += 1
+            else:
+                for _ in range(rng.randrange(1, 8)):
+                    slot, acc = rng.randrange(cursor), rng.randrange(3)
+                    for t in trackers:
+                        t.record(slot, 0, 0, acc)
+            got = [sorted(t.drain()) for t in trackers]
+            assert got[0] == got[1], (seed, cursor)
+
+
+def test_acceptor_emits_phase2b_ranges_per_drain():
+    """Acceptors ack a drain's contiguous Phase2as as ONE Phase2bRange
+    per proxy leader; lone votes stay plain Phase2bs."""
+    from frankenpaxos_tpu.protocols.multipaxos.messages import (
+        NOOP,
+        Phase2a,
+        Phase2b,
+        Phase2bRange,
+    )
+
+    sim = make_multipaxos(f=1)
+    acceptor = sim.acceptors[0]
+    transport = sim.transport
+    transport.messages.clear()
+    for slot in (10, 11, 12, 20):
+        acceptor.receive("proxy-leader-0",
+                         Phase2a(slot=slot, round=0, value=NOOP))
+    acceptor.on_drain()
+    out = [acceptor.serializer.from_bytes(m.data)
+           for m in transport.messages if m.src == acceptor.address]
+    ranges = [m for m in out if isinstance(m, Phase2bRange)]
+    singles = [m for m in out if isinstance(m, Phase2b)]
+    assert len(ranges) == 1 and len(singles) == 1
+    assert ranges[0].slot_start_inclusive == 10
+    assert ranges[0].slot_end_exclusive == 13
+    assert singles[0].slot == 20
+
+
 def test_sim_transport_coalesced_waves_match_serial():
     """deliver_all_coalesced (event-loop drain granularity) commits the
     same commands as per-message deliver_all."""

@@ -1,0 +1,53 @@
+"""Paper-sweep families (bench/sweeps.py): registry, CSV, and plots.
+
+The deployment paths the families drive are covered by
+tests/test_deployment.py; here the sweep-specific plumbing (tidy rows,
+CSV schema, figure rendering) runs on synthetic rows, plus one real
+single-point family smoke."""
+
+import csv
+import os
+
+from frankenpaxos_tpu.bench.sweeps import (
+    FAMILIES,
+    plot_lt,
+    plot_read_scale,
+    write_csv,
+)
+
+
+def test_families_registry():
+    assert set(FAMILIES) == {"eurosys_fig1", "eurosys_fig2",
+                             "matchmaker_lt", "read_scale"}
+
+
+def test_csv_and_lt_plot(tmp_path):
+    rows = [
+        {"series": "multipaxos", "num_clients": 2,
+         "throughput_p90_1s": 900.0, "latency_median_ms": 5.0},
+        {"series": "multipaxos", "num_clients": 10,
+         "throughput_p90_1s": 1100.0, "latency_median_ms": 9.0},
+        {"series": "coupled_multipaxos", "num_clients": 2,
+         "throughput_p90_1s": 400.0, "latency_median_ms": 6.0},
+    ]
+    csv_path = str(tmp_path / "fig.csv")
+    pdf_path = str(tmp_path / "fig.pdf")
+    write_csv(rows, csv_path)
+    with open(csv_path) as f:
+        parsed = list(csv.DictReader(f))
+    assert len(parsed) == 3
+    assert parsed[0]["series"] == "multipaxos"
+    plot_lt(rows, pdf_path, "test")
+    assert os.path.getsize(pdf_path) > 1000
+
+
+def test_read_scale_plot(tmp_path):
+    rows = [
+        {"series": "eventual_reads", "num_replicas": n,
+         "read_throughput_p90_1s": 1000.0 * n,
+         "write_throughput_p90_1s": 100.0}
+        for n in (2, 3, 4)
+    ]
+    pdf_path = str(tmp_path / "reads.pdf")
+    plot_read_scale(rows, pdf_path)
+    assert os.path.getsize(pdf_path) > 1000

@@ -82,3 +82,41 @@ def test_binary_encoding_is_compact_and_stable():
     # And it is several times smaller than the pickle it replaces.
     assert len(data) < len(pickle.dumps(
         Phase2b(group_index=3, acceptor_index=4, slot=258, round=7))) / 3
+
+
+def test_mencius_codecs_round_trip():
+    """Mencius-specific hot messages (its inner MultiPaxos machinery
+    reuses the multipaxos codecs): Chosen, HighWatermark gossip, and
+    the noop-range skip triplet."""
+    import frankenpaxos_tpu.protocols.mencius  # noqa: F401 - registers
+    from frankenpaxos_tpu.protocols.mencius.common import (
+        Chosen as MChosen,
+        ChosenNoopRange,
+        HighWatermark,
+        Phase2aNoopRange,
+        Phase2bNoopRange,
+    )
+
+    messages = [
+        MChosen(slot=7, value=NOOP),
+        MChosen(slot=7, value=CommandBatch((Command(
+            CommandId(("h", 9), 0, 1), b"x"),))),
+        HighWatermark(next_slot=1 << 33),
+        Phase2aNoopRange(slot_start_inclusive=3, slot_end_exclusive=99,
+                         round=2),
+        Phase2bNoopRange(acceptor_group_index=1, acceptor_index=2,
+                         slot_start_inclusive=3, slot_end_exclusive=99,
+                         round=2),
+        ChosenNoopRange(slot_start_inclusive=0, slot_end_exclusive=50),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+    # mencius.Chosen and multipaxos.Chosen are DIFFERENT types and must
+    # decode to their own classes.
+    mp = DEFAULT_SERIALIZER.to_bytes(Chosen(slot=7, value=NOOP))
+    mn = DEFAULT_SERIALIZER.to_bytes(MChosen(slot=7, value=NOOP))
+    assert mp[0] != mn[0]
+    assert type(DEFAULT_SERIALIZER.from_bytes(mp)) is Chosen
+    assert type(DEFAULT_SERIALIZER.from_bytes(mn)) is MChosen
