@@ -86,9 +86,8 @@ def _assert_equivalent(sharded, unsharded, slot_dim, window, block):
 
 
 @pytest.fixture(autouse=True)
-def _need_8_devices():
-    if jax.device_count() < 8:
-        pytest.skip("needs the 8-device forced-CPU mesh (see conftest.py)")
+def _devices(need_8_devices):
+    """All tests here need the shared 8-device mesh (conftest.py)."""
 
 
 def test_slot_sharded_equivalence():

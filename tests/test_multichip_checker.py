@@ -24,14 +24,8 @@ from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
 
 
 @pytest.fixture(autouse=True)
-def _need_8_devices():
-    if jax.device_count() < 8:
-        pytest.skip("needs the 8-device forced-CPU mesh (see conftest.py)")
-
-
-def _mesh(group_dim: int, slot_dim: int) -> Mesh:
-    devices = np.asarray(jax.devices()[:group_dim * slot_dim])
-    return Mesh(devices.reshape(group_dim, slot_dim), ("group", "slot"))
+def _devices(need_8_devices):
+    """All tests here need the shared 8-device mesh (conftest.py)."""
 
 
 def record_real_vote_stream(num_batches: int = 12,
@@ -80,7 +74,7 @@ def replay(tracker, drains) -> list:
     return out
 
 
-def test_sharded_checker_matches_unsharded_on_real_stream():
+def test_sharded_checker_matches_unsharded_on_real_stream(mesh_factory):
     """2x4 (group, slot) mesh: the ProxyLeader's vote board shards its
     slot window 8 ways; per-drain chosen reports are bit-identical to
     the unsharded board and the dict oracle."""
@@ -88,19 +82,19 @@ def test_sharded_checker_matches_unsharded_on_real_stream():
     oracle = replay(DictQuorumTracker(config), drains)
     unsharded = replay(TpuQuorumTracker(config, window=1 << 10), drains)
     sharded = replay(
-        TpuQuorumTracker(config, window=1 << 10, mesh=_mesh(2, 4)), drains)
+        TpuQuorumTracker(config, window=1 << 10, mesh=mesh_factory(2, 4)), drains)
     assert unsharded == oracle
     assert sharded == oracle
     assert sum(len(d) for d in oracle) > 0
 
 
-def test_sharded_checker_ring_wrap_on_mesh():
+def test_sharded_checker_ring_wrap_on_mesh(mesh_factory):
     """Ring wrap under sharding: slots pass several multiples of the
     window, so column reclaim happens on every shard."""
     config, _ = record_real_vote_stream(num_batches=1, inflight=1)
     window = 256
     oracle = DictQuorumTracker(config)
-    sharded = TpuQuorumTracker(config, window=window, mesh=_mesh(1, 8))
+    sharded = TpuQuorumTracker(config, window=window, mesh=mesh_factory(1, 8))
     rng = random.Random(7)
     for base in range(0, 4 * window, 64):
         votes = []

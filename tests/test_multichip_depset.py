@@ -1,0 +1,95 @@
+"""EPaxos dependency-set kernels sharded over the device mesh.
+
+Completes the multichip story for the real protocol kernels: alongside
+the sharded TpuQuorumChecker (test_multichip_checker.py), the EPaxos
+dep-set algebra (ops/depset.py -- the device twin of
+epaxos/InstancePrefixSet.scala:12-60, driven by
+protocols/epaxos/device_deps.py) runs with its BATCH axis sharded
+across a (group, slot) mesh and must be bit-identical to the unsharded
+kernels on dep batches built from REAL InstancePrefixSets.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from frankenpaxos_tpu.ops import depset
+from frankenpaxos_tpu.protocols.epaxos.device_deps import to_batch
+
+
+@pytest.fixture(autouse=True)
+def _devices(need_8_devices):
+    """All tests here need the shared 8-device mesh (conftest.py)."""
+
+
+def _real_batches(batch: int, seed: int):
+    """Dep batches built through the REAL conversion path
+    (InstancePrefixSet -> DepSetBatch), as EPaxos replicas build them."""
+    from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+        Instance,
+        InstancePrefixSet,
+    )
+
+    rng = np.random.default_rng(seed)
+    num_replicas = 3
+
+    sets = []
+    for _ in range(2 * batch):
+        s = InstancePrefixSet(num_replicas)
+        for leader in range(num_replicas):
+            w = int(rng.integers(0, 64))
+            for i in range(w):
+                s.add(Instance(leader, i))
+            for extra in rng.integers(w, w + 16, size=3):
+                if rng.random() < 0.5:
+                    s.add(Instance(leader, int(extra)))
+        sets.append(s)
+    # One conversion for both halves so they share a tail_base (the
+    # union precondition; EPaxos replicas GC batches to a shared base).
+    combined = to_batch(sets, num_replicas)
+    a = depset.DepSetBatch(combined.watermarks[:batch],
+                           combined.tails[:batch], combined.tail_base)
+    b = depset.DepSetBatch(combined.watermarks[batch:],
+                           combined.tails[batch:], combined.tail_base)
+    return a, b
+
+
+def test_sharded_depset_algebra_bit_identical():
+    batch = 64  # divides the 8-way mesh
+    a, b = _real_batches(batch, seed=5)
+    devices = np.asarray(jax.devices()[:8])
+    mesh = Mesh(devices.reshape(2, 4), ("group", "slot"))
+    axes = ("group", "slot")
+
+    def shard(d):
+        return depset.DepSetBatch(
+            watermarks=jax.device_put(
+                d.watermarks, NamedSharding(mesh, PartitionSpec(axes))),
+            tails=jax.device_put(
+                d.tails, NamedSharding(mesh, PartitionSpec(axes))),
+            tail_base=jax.device_put(
+                d.tail_base, NamedSharding(mesh, PartitionSpec())),
+        )
+
+    sa, sb = shard(a), shard(b)
+
+    un_union = depset.union(a, b)
+    sh_union = depset.union(sa, sb)
+    np.testing.assert_array_equal(np.asarray(sh_union.watermarks),
+                                  np.asarray(un_union.watermarks))
+    np.testing.assert_array_equal(np.asarray(sh_union.tails),
+                                  np.asarray(un_union.tails))
+
+    sh_reduced = depset.union_reduce(sa)
+    un_reduced = depset.union_reduce(a)
+    np.testing.assert_array_equal(np.asarray(sh_reduced.watermarks),
+                                  np.asarray(un_reduced.watermarks))
+    np.testing.assert_array_equal(np.asarray(sh_reduced.tails),
+                                  np.asarray(un_reduced.tails))
+    np.testing.assert_array_equal(np.asarray(depset.equal(sa, sb)),
+                                  np.asarray(depset.equal(a, b)))
+    np.testing.assert_array_equal(np.asarray(depset.size(sa)),
+                                  np.asarray(depset.size(a)))
+    assert bool(np.asarray(depset.equal(sa, sa)).all())
