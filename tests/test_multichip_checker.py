@@ -12,10 +12,7 @@ host dict oracle on the same stream.
 
 import random
 
-import jax
-import numpy as np
 import pytest
-from jax.sharding import Mesh
 
 from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
     DictQuorumTracker,

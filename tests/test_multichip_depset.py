@@ -10,10 +10,9 @@ kernels on dep batches built from REAL InstancePrefixSets.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import NamedSharding, PartitionSpec
 
 from frankenpaxos_tpu.ops import depset
 from frankenpaxos_tpu.protocols.epaxos.device_deps import to_batch
@@ -56,11 +55,10 @@ def _real_batches(batch: int, seed: int):
     return a, b
 
 
-def test_sharded_depset_algebra_bit_identical():
+def test_sharded_depset_algebra_bit_identical(mesh_factory):
     batch = 64  # divides the 8-way mesh
     a, b = _real_batches(batch, seed=5)
-    devices = np.asarray(jax.devices()[:8])
-    mesh = Mesh(devices.reshape(2, 4), ("group", "slot"))
+    mesh = mesh_factory(2, 4)
     axes = ("group", "slot")
 
     def shard(d):

@@ -120,3 +120,45 @@ def test_mencius_codecs_round_trip():
     assert mp[0] != mn[0]
     assert type(DEFAULT_SERIALIZER.from_bytes(mp)) is Chosen
     assert type(DEFAULT_SERIALIZER.from_bytes(mn)) is MChosen
+
+
+def test_epaxos_codecs_round_trip():
+    """EPaxos command-path messages carry an InstancePrefixSet on every
+    hop; the binary layout packs each column as watermark + sparse
+    values (the DepSetBatch factorization)."""
+    import frankenpaxos_tpu.protocols.epaxos  # noqa: F401 - registers
+    from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+        Instance,
+        InstancePrefixSet,
+    )
+    from frankenpaxos_tpu.protocols.epaxos.messages import (
+        NOOP as ENOOP,
+        Accept,
+        AcceptOk,
+        ClientReply as EClientReply,
+        ClientRequest as EClientRequest,
+        Command as ECommand,
+        Commit,
+        PreAccept,
+        PreAcceptOk,
+    )
+
+    deps = InstancePrefixSet(3)
+    for leader in range(3):
+        for i in range(5):
+            deps.add(Instance(leader, i))
+    deps.add(Instance(1, 9))  # sparse tail above the watermark
+    messages = [
+        PreAccept(Instance(0, 4), (1, 0),
+                  ECommand("c", 0, 1, b"xyz"), 7, deps),
+        PreAcceptOk(Instance(0, 4), (1, 0), 2, 7, deps),
+        Accept(Instance(0, 4), (1, 0), ENOOP, 7, deps),
+        AcceptOk(Instance(0, 4), (1, 0), 2),
+        Commit(Instance(0, 4), ECommand(("h", 1), 0, 1, b""), 7, deps),
+        EClientRequest(ECommand("c", 0, 1, b"xyz")),
+        EClientReply(0, 1, b"r"),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
