@@ -162,3 +162,55 @@ def test_epaxos_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_bpaxos_codecs_round_trip():
+    """SimpleBPaxos / SimpleGcBPaxos command-path messages, including
+    the GcBPaxos SnapshotMarker sentinel riding the command escape
+    hatch."""
+    import frankenpaxos_tpu.protocols.simplebpaxos  # noqa: F401
+    from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+        NOOP as BNOOP,
+        ClientReply as BClientReply,
+        ClientRequest as BClientRequest,
+        Command as BCommand,
+        Commit as BCommit,
+        DependencyReply,
+        DependencyRequest,
+        Phase2a as BPhase2a,
+        Phase2b as BPhase2b,
+        Propose,
+        VertexId,
+        VertexIdPrefixSet,
+        VoteValue,
+    )
+    from frankenpaxos_tpu.protocols.simplegcbpaxos import SnapshotMarker
+
+    deps = VertexIdPrefixSet(2)
+    for leader in range(2):
+        for i in range(4):
+            deps.add(VertexId(leader, i))
+    command = BCommand("client-0", 1, 2, b"payload")
+    messages = [
+        BClientRequest(command),
+        DependencyRequest(VertexId(0, 3), command),
+        DependencyReply(VertexId(0, 3), 1, deps),
+        Propose(VertexId(1, 0), command, deps),
+        BPhase2a(VertexId(1, 0), 4, VoteValue(command, deps)),
+        BPhase2a(VertexId(1, 0), 4, VoteValue(BNOOP, deps)),
+        BPhase2b(VertexId(1, 0), 2, 4),
+        BCommit(VertexId(1, 0), command, deps),
+        BCommit(VertexId(1, 0), BNOOP, deps),
+        BClientReply(1, 2, b"result"),
+        # The GcBPaxos SnapshotMarker sentinel rides the command escape
+        # hatch on EVERY hop that can carry it (the leader proposes
+        # SNAPSHOT through the same path as commands).
+        DependencyRequest(VertexId(0, 3), SnapshotMarker()),
+        Propose(VertexId(1, 0), SnapshotMarker(), deps),
+        BPhase2a(VertexId(1, 0), 4, VoteValue(SnapshotMarker(), deps)),
+        BCommit(VertexId(1, 0), SnapshotMarker(), deps),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
