@@ -571,3 +571,7 @@ class UnanimousBPaxosClient(Actor):
         pending.resend.stop()
         del self.pending[message.client_pseudonym]
         pending.callback(message.result)
+
+# Importing registers the UnanimousBPaxos binary codecs with the
+# hybrid serializer (see unanimousbpaxos_wire.py).
+from frankenpaxos_tpu.protocols import unanimousbpaxos_wire  # noqa: E402,F401

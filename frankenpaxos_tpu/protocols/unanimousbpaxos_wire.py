@@ -1,0 +1,190 @@
+"""Binary codecs for the UnanimousBPaxos hot path.
+
+Dependencies here are plain frozensets of vertex ids (no prefix
+compaction -- unanimous fast quorums keep them small), packed as
+``[u32 n][n x (i32 leader, i64 id)]``. Commands reuse the BPaxos
+command helper (same Command class).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols import unanimousbpaxos as m
+from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+    Noop,
+    NOOP,
+    VertexId,
+)
+from frankenpaxos_tpu.protocols.simplebpaxos.wire import (
+    _put_command,
+    _take_command,
+    _put_vertex,
+    _take_vertex,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_bytes,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+
+
+def _put_dep_set(out: bytearray, deps: frozenset) -> None:
+    """[i32 n][n x vertex], reusing the shared vertex layout."""
+    out += _I32.pack(len(deps))
+    for vertex_id in sorted(deps):
+        _put_vertex(out, vertex_id)
+
+
+def _take_dep_set(buf: bytes, at: int):
+    (n,) = _I32.unpack_from(buf, at)
+    at += 4
+    deps = []
+    for _ in range(n):
+        vertex_id, at = _take_vertex(buf, at)
+        deps.append(vertex_id)
+    return frozenset(deps), at
+
+
+def _put_vote_value(out: bytearray, value: m.VoteValue) -> None:
+    if isinstance(value.command_or_noop, Noop):
+        out.append(0)
+    else:
+        out.append(1)
+        _put_command(out, value.command_or_noop)
+    _put_dep_set(out, value.dependencies)
+
+
+def _take_vote_value(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    if kind == 0:
+        command = NOOP
+    else:
+        command, at = _take_command(buf, at)
+    deps, at = _take_dep_set(buf, at)
+    return m.VoteValue(command, deps), at
+
+
+class _VertexValueCodec(MessageCodec):
+    """Shared (vertex_id, VoteValue) layout: FastProposal and Commit
+    are both message_type(vertex_id, value)."""
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        _put_vote_value(out, message.value)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        value, at = _take_vote_value(buf, at)
+        return self.message_type(vertex_id, value), at
+
+
+class UClientRequestCodec(MessageCodec):
+    message_type = m.ClientRequest
+    tag = 29
+
+    def encode(self, out, message):
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _take_command(buf, at)
+        return m.ClientRequest(command), at
+
+
+class UDependencyRequestCodec(MessageCodec):
+    message_type = m.DependencyRequest
+    tag = 30
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        command, at = _take_command(buf, at)
+        return m.DependencyRequest(vertex_id, command), at
+
+
+class UFastProposalCodec(_VertexValueCodec):
+    message_type = m.FastProposal
+    tag = 31
+
+
+class UPhase2bFastCodec(MessageCodec):
+    message_type = m.Phase2bFast
+    tag = 32
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        out += _I32.pack(message.acceptor_id)
+        _put_vote_value(out, message.vote_value)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        (acceptor,) = _I32.unpack_from(buf, at)
+        value, at = _take_vote_value(buf, at + 4)
+        return m.Phase2bFast(vertex_id, acceptor, value), at
+
+
+class UPhase2aCodec(MessageCodec):
+    message_type = m.Phase2a
+    tag = 33
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        out += _I64.pack(message.round)
+        _put_vote_value(out, message.vote_value)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        (round,) = _I64.unpack_from(buf, at)
+        value, at = _take_vote_value(buf, at + 8)
+        return m.Phase2a(vertex_id, round, value), at
+
+
+class UPhase2bClassicCodec(MessageCodec):
+    message_type = m.Phase2bClassic
+    tag = 34
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        out += _I64I64.pack(message.acceptor_id, message.round)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        acceptor, round = _I64I64.unpack_from(buf, at)
+        return m.Phase2bClassic(vertex_id, acceptor, round), at + 16
+
+
+class UCommitCodec(_VertexValueCodec):
+    message_type = m.Commit
+    tag = 35
+
+
+class UClientReplyCodec(MessageCodec):
+    message_type = m.ClientReply
+    tag = 36
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.client_pseudonym, message.client_id)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        pseudonym, id = _I64I64.unpack_from(buf, at)
+        result, at = _take_bytes(buf, at + 16)
+        return m.ClientReply(pseudonym, id, result), at
+
+
+for _codec in (UClientRequestCodec(), UDependencyRequestCodec(),
+               UFastProposalCodec(), UPhase2bFastCodec(),
+               UPhase2aCodec(), UPhase2bClassicCodec(), UCommitCodec(),
+               UClientReplyCodec()):
+    register_codec(_codec)

@@ -214,3 +214,32 @@ def test_bpaxos_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_unanimousbpaxos_codecs_round_trip():
+    """UnanimousBPaxos messages: frozenset dependency packing + the
+    shared BPaxos command helper."""
+    import frankenpaxos_tpu.protocols.unanimousbpaxos as m
+    from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+        NOOP as BNOOP,
+        Command as BCommand,
+        VertexId,
+    )
+
+    deps = frozenset({VertexId(0, 1), VertexId(1, 5)})
+    command = BCommand("c", 0, 1, b"x")
+    value = m.VoteValue(command, deps)
+    messages = [
+        m.ClientRequest(command),
+        m.DependencyRequest(VertexId(0, 2), command),
+        m.FastProposal(VertexId(0, 2), value),
+        m.Phase2bFast(VertexId(0, 2), 1, value),
+        m.Phase2a(VertexId(0, 2), 3, m.VoteValue(BNOOP, deps)),
+        m.Phase2bClassic(VertexId(0, 2), 1, 3),
+        m.Commit(VertexId(0, 2), value),
+        m.ClientReply(0, 1, b"r"),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
