@@ -693,3 +693,7 @@ class HorizontalDriver(Actor):
 
     def receive(self, src: Address, message) -> None:
         self.logger.fatal(f"driver got unexpected message {message!r}")
+
+# Importing registers the Horizontal binary codecs with the hybrid
+# serializer (see horizontal_wire.py).
+from frankenpaxos_tpu.protocols import horizontal_wire  # noqa: E402,F401

@@ -1,0 +1,143 @@
+"""Binary codecs for the Horizontal MultiPaxos hot path.
+
+The steady-state write path (ClientRequest -> Phase2a -> Phase2b ->
+Chosen -> ClientReply, horizontal/Horizontal.proto). A Value is a
+Command, Noop, or Configuration; configurations (rare: one per
+reconfiguration) ride a pickled escape hatch inside the value slot.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+
+from frankenpaxos_tpu.protocols import horizontal as m
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_QQQ = struct.Struct("<qqq")
+
+
+def _put_command(out: bytearray, command: m.Command) -> None:
+    cid = command.command_id
+    _put_address(out, cid.client_address)
+    out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+    _put_bytes(out, command.command)
+
+
+def _take_command(buf: bytes, at: int):
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    payload, at = _take_bytes(buf, at + 16)
+    return m.Command(m.CommandId(address, pseudonym, id), payload), at
+
+
+def _put_value(out: bytearray, value) -> None:
+    if isinstance(value, m.Noop):
+        out.append(0)
+    elif isinstance(value, m.Command):
+        out.append(1)
+        _put_command(out, value)
+    else:  # Configuration (one per reconfiguration -- cold)
+        out.append(2)
+        _put_bytes(out, pickle.dumps(value,
+                                     protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _take_value(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    if kind == 0:
+        return m.NOOP, at
+    if kind == 1:
+        return _take_command(buf, at)
+    raw, at = _take_bytes(buf, at)
+    return pickle.loads(raw), at
+
+
+class HClientRequestCodec(MessageCodec):
+    message_type = m.ClientRequest
+    tag = 43
+
+    def encode(self, out, message):
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _take_command(buf, at)
+        return m.ClientRequest(command), at
+
+
+class HPhase2aCodec(MessageCodec):
+    message_type = m.Phase2a
+    tag = 44
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.slot, message.round, message.first_slot)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        slot, round, first_slot = _QQQ.unpack_from(buf, at)
+        value, at = _take_value(buf, at + _QQQ.size)
+        return m.Phase2a(slot=slot, round=round, first_slot=first_slot,
+                         value=value), at
+
+
+class HPhase2bCodec(MessageCodec):
+    message_type = m.Phase2b
+    tag = 45
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.slot, message.round,
+                         message.acceptor_index)
+
+    def decode(self, buf, at):
+        slot, round, acceptor = _QQQ.unpack_from(buf, at)
+        return m.Phase2b(slot=slot, round=round,
+                         acceptor_index=acceptor), at + _QQQ.size
+
+
+class HChosenCodec(MessageCodec):
+    message_type = m.Chosen
+    tag = 46
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 8)
+        return m.Chosen(slot=slot, value=value), at
+
+
+class HClientReplyCodec(MessageCodec):
+    message_type = m.ClientReply
+    tag = 47
+
+    def encode(self, out, message):
+        cid = message.command_id
+        _put_address(out, cid.client_address)
+        out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        pseudonym, id = _I64I64.unpack_from(buf, at)
+        result, at = _take_bytes(buf, at + 16)
+        return m.ClientReply(m.CommandId(address, pseudonym, id),
+                             result), at
+
+
+for _codec in (HClientRequestCodec(), HPhase2aCodec(), HPhase2bCodec(),
+               HChosenCodec(), HClientReplyCodec()):
+    register_codec(_codec)

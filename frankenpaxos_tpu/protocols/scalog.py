@@ -521,3 +521,7 @@ class ScalogClient(Actor):
             return
         pending.resend.stop()
         pending.callback(message.result)
+
+# Importing registers the Scalog binary codecs with the hybrid
+# serializer (see scalog_wire.py).
+from frankenpaxos_tpu.protocols import scalog_wire  # noqa: E402,F401

@@ -1,0 +1,157 @@
+"""Binary codecs for the Scalog hot path.
+
+Scalog's steady state (scalog/Scalog.proto): clients write to shard
+servers (ClientRequest/Backup), servers gossip watermark vectors
+(ShardInfo), the aggregator proposes cuts, and replicas execute Chosen
+batches and reply. Watermark vectors pack as ``[i32 n][n x i64]``.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols import scalog as m
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_I32I64 = struct.Struct("<iq")
+
+
+def _put_command(out: bytearray, command: m.Command) -> None:
+    _put_address(out, command.command_id.client_address)
+    out += _I64.pack(command.command_id.client_id)
+    _put_bytes(out, command.command)
+
+
+def _take_command(buf: bytes, at: int):
+    address, at = _take_address(buf, at)
+    (client_id,) = _I64.unpack_from(buf, at)
+    payload, at = _take_bytes(buf, at + 8)
+    return m.Command(m.CommandId(address, client_id), payload), at
+
+
+def _put_watermark(out: bytearray, watermark: tuple) -> None:
+    out += _I32.pack(len(watermark))
+    for value in watermark:
+        out += _I64.pack(value)
+
+
+def _take_watermark(buf: bytes, at: int):
+    (n,) = _I32.unpack_from(buf, at)
+    at += 4
+    values = []
+    for _ in range(n):
+        (v,) = _I64.unpack_from(buf, at)
+        values.append(v)
+        at += 8
+    return tuple(values), at
+
+
+class SClientRequestCodec(MessageCodec):
+    message_type = m.ClientRequest
+    tag = 37
+
+    def encode(self, out, message):
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _take_command(buf, at)
+        return m.ClientRequest(command), at
+
+
+class BackupCodec(MessageCodec):
+    message_type = m.Backup
+    tag = 38
+
+    def encode(self, out, message):
+        out += _I32I64.pack(message.server_index, message.slot)
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        server, slot = _I32I64.unpack_from(buf, at)
+        command, at = _take_command(buf, at + _I32I64.size)
+        return m.Backup(server, slot, command), at
+
+
+class ShardInfoCodec(MessageCodec):
+    message_type = m.ShardInfo
+    tag = 39
+
+    def encode(self, out, message):
+        out += _I32.pack(message.shard_index)
+        out += _I32.pack(message.server_index)
+        _put_watermark(out, message.watermark)
+
+    def decode(self, buf, at):
+        (shard,) = _I32.unpack_from(buf, at)
+        (server,) = _I32.unpack_from(buf, at + 4)
+        watermark, at = _take_watermark(buf, at + 8)
+        return m.ShardInfo(shard, server, watermark), at
+
+
+class CutChosenCodec(MessageCodec):
+    message_type = m.CutChosen
+    tag = 40
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_watermark(out, message.cut.watermark)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        watermark, at = _take_watermark(buf, at + 8)
+        return m.CutChosen(slot, m.GlobalCut(watermark)), at
+
+
+class SChosenCodec(MessageCodec):
+    message_type = m.Chosen
+    tag = 41
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        out += _I32.pack(len(message.commands))
+        for command in message.commands:
+            _put_command(out, command)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        (n,) = _I32.unpack_from(buf, at + 8)
+        at += 12
+        commands = []
+        for _ in range(n):
+            command, at = _take_command(buf, at)
+            commands.append(command)
+        return m.Chosen(slot, tuple(commands)), at
+
+
+class SClientReplyCodec(MessageCodec):
+    message_type = m.ClientReply
+    tag = 42
+
+    def encode(self, out, message):
+        _put_address(out, message.command_id.client_address)
+        out += _I64I64.pack(message.command_id.client_id, message.slot)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        client_id, slot = _I64I64.unpack_from(buf, at)
+        result, at = _take_bytes(buf, at + 16)
+        return m.ClientReply(m.CommandId(address, client_id), slot,
+                             result), at
+
+
+for _codec in (SClientRequestCodec(), BackupCodec(), ShardInfoCodec(),
+               CutChosenCodec(), SChosenCodec(), SClientReplyCodec()):
+    register_codec(_codec)

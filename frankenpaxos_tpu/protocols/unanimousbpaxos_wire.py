@@ -14,7 +14,6 @@ from frankenpaxos_tpu.protocols import unanimousbpaxos as m
 from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     Noop,
     NOOP,
-    VertexId,
 )
 from frankenpaxos_tpu.protocols.simplebpaxos.wire import (
     _put_command,

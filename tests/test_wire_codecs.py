@@ -243,3 +243,46 @@ def test_unanimousbpaxos_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_scalog_codecs_round_trip():
+    """Scalog's shard-write/backup/gossip/cut/execute path, including
+    watermark-vector packing."""
+    import frankenpaxos_tpu.protocols.scalog as m
+
+    command = m.Command(m.CommandId(("h", 5), 3), b"x")
+    messages = [
+        m.ClientRequest(command),
+        m.Backup(1, 7, command),
+        m.ShardInfo(0, 1, (3, 5)),
+        m.CutChosen(2, m.GlobalCut((3, 5))),
+        m.Chosen(2, (command, m.Command(m.CommandId("sim", 0), b""))),
+        m.ClientReply(m.CommandId(("h", 5), 3), 9, b"r"),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_horizontal_codecs_round_trip():
+    """Horizontal's write path; Configuration values (one per
+    reconfiguration) ride the pickled escape hatch in the value slot."""
+    import frankenpaxos_tpu.protocols.horizontal as m
+
+    command = m.Command(m.CommandId(("h", 5), 1, 3), b"x")
+    config = m.Configuration({"kind": "simple", "members": [0, 1, 2]})
+    messages = [
+        m.ClientRequest(command),
+        m.Phase2a(slot=5, round=1, first_slot=0, value=command),
+        m.Phase2a(slot=5, round=1, first_slot=0, value=m.NOOP),
+        m.Phase2a(slot=5, round=1, first_slot=0, value=config),
+        m.Phase2b(slot=5, round=1, acceptor_index=2),
+        m.Chosen(slot=5, value=command),
+        m.Chosen(slot=5, value=config),
+        m.ClientReply(m.CommandId("c", 0, 1), b"r"),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
