@@ -1497,3 +1497,7 @@ class MMPDriver(Actor):
             self.reconfigure_matchmakers()
             return
         self.logger.fatal(f"driver got unexpected message {message!r}")
+
+# Importing registers the steady-state binary codecs with the hybrid
+# serializer (see matchmakermultipaxos_wire.py).
+from frankenpaxos_tpu.protocols import matchmakermultipaxos_wire  # noqa: E402,F401

@@ -1,0 +1,135 @@
+"""Binary codecs for the MatchmakerMultiPaxos steady-state write path.
+
+Only the per-command hot loop (ClientRequest -> Phase2a -> Phase2b ->
+Chosen -> ClientReply, Matchmaker.proto's MultiPaxos core); the
+matchmaking/reconfiguration traffic (MatchRequest/Stop/Bootstrap/...)
+is per-epoch, not per-command, and stays pickled.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols import matchmakermultipaxos as m
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_QQQ = struct.Struct("<qqq")
+
+
+def _put_command(out: bytearray, command: m.Command) -> None:
+    cid = command.command_id
+    _put_address(out, cid.client_address)
+    out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+    _put_bytes(out, command.command)
+
+
+def _take_command(buf: bytes, at: int):
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    payload, at = _take_bytes(buf, at + 16)
+    return m.Command(m.CommandId(address, pseudonym, id), payload), at
+
+
+def _put_value(out: bytearray, value) -> None:
+    if isinstance(value, m.Noop):
+        out.append(0)
+    else:
+        out.append(1)
+        _put_command(out, value)
+
+
+def _take_value(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    if kind == 0:
+        return m.NOOP, at
+    return _take_command(buf, at)
+
+
+class MMPClientRequestCodec(MessageCodec):
+    message_type = m.ClientRequest
+    tag = 48
+
+    def encode(self, out, message):
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _take_command(buf, at)
+        return m.ClientRequest(command), at
+
+
+class MMPPhase2aCodec(MessageCodec):
+    message_type = m.Phase2a
+    tag = 49
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.slot, message.round)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        slot, round = _I64I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 16)
+        return m.Phase2a(slot=slot, round=round, value=value), at
+
+
+class MMPPhase2bCodec(MessageCodec):
+    message_type = m.Phase2b
+    tag = 50
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.slot, message.round,
+                         message.acceptor_index)
+
+    def decode(self, buf, at):
+        slot, round, acceptor = _QQQ.unpack_from(buf, at)
+        return m.Phase2b(slot=slot, round=round,
+                         acceptor_index=acceptor), at + _QQQ.size
+
+
+class MMPChosenCodec(MessageCodec):
+    message_type = m.Chosen
+    tag = 51
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 8)
+        return m.Chosen(slot=slot, value=value), at
+
+
+class MMPClientReplyCodec(MessageCodec):
+    message_type = m.ClientReply
+    tag = 52
+
+    def encode(self, out, message):
+        cid = message.command_id
+        _put_address(out, cid.client_address)
+        out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        pseudonym, id = _I64I64.unpack_from(buf, at)
+        result, at = _take_bytes(buf, at + 16)
+        return m.ClientReply(m.CommandId(address, pseudonym, id),
+                             result), at
+
+
+for _codec in (MMPClientRequestCodec(), MMPPhase2aCodec(),
+               MMPPhase2bCodec(), MMPChosenCodec(),
+               MMPClientReplyCodec()):
+    register_codec(_codec)

@@ -286,3 +286,23 @@ def test_horizontal_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_matchmakermultipaxos_codecs_round_trip():
+    """MatchmakerMultiPaxos' steady-state write path (matchmaking /
+    reconfiguration epochs stay pickled -- per-epoch, not per-command)."""
+    import frankenpaxos_tpu.protocols.matchmakermultipaxos as m
+
+    command = m.Command(m.CommandId(("h", 5), 1, 3), b"x")
+    messages = [
+        m.ClientRequest(command),
+        m.Phase2a(slot=5, round=1, value=command),
+        m.Phase2a(slot=5, round=1, value=m.NOOP),
+        m.Phase2b(slot=5, round=1, acceptor_index=2),
+        m.Chosen(slot=5, value=command),
+        m.ClientReply(m.CommandId("c", 0, 1), b"r"),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
