@@ -663,3 +663,7 @@ class FasterPaxosClient(Actor):
                 self.delegates = message.delegates
         else:
             self.logger.fatal(f"unexpected client message {message!r}")
+
+# Importing registers the steady-state binary codecs with the hybrid
+# serializer (see fasterpaxos_wire.py).
+from frankenpaxos_tpu.protocols import fasterpaxos_wire  # noqa: E402,F401

@@ -1,0 +1,152 @@
+"""Binary codecs for the FasterPaxos steady-state write path.
+
+Per-command traffic only (ClientRequest -> Phase2a -> Phase2b ->
+Phase3a/Chosen -> ClientReply, fasterpaxos/FasterPaxos.proto); the
+round-change / delegate-discovery messages are per-failover and stay
+pickled. Phase2b optionally carries a command
+(ackNoopsWithCommands, Server.scala:1613-1625) behind a kind byte.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols import fasterpaxos as m
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_QQQ = struct.Struct("<qqq")
+
+
+def _put_command(out: bytearray, command: m.Command) -> None:
+    cid = command.command_id
+    _put_address(out, cid.client_address)
+    out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+    _put_bytes(out, command.command)
+
+
+def _take_command(buf: bytes, at: int):
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    payload, at = _take_bytes(buf, at + 16)
+    return m.Command(m.CommandId(address, pseudonym, id), payload), at
+
+
+def _put_value(out: bytearray, value) -> None:
+    if isinstance(value, m.Noop):
+        out.append(0)
+    else:
+        out.append(1)
+        _put_command(out, value)
+
+
+def _take_value(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    if kind == 0:
+        return m.NOOP, at
+    return _take_command(buf, at)
+
+
+class FPClientRequestCodec(MessageCodec):
+    message_type = m.ClientRequest
+    tag = 53
+
+    def encode(self, out, message):
+        out += _I64.pack(message.round)
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        (round,) = _I64.unpack_from(buf, at)
+        command, at = _take_command(buf, at + 8)
+        return m.ClientRequest(round, command), at
+
+
+class FPPhase2aCodec(MessageCodec):
+    message_type = m.Phase2a
+    tag = 54
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.slot, message.round)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        slot, round = _I64I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 16)
+        return m.Phase2a(slot=slot, round=round, value=value), at
+
+
+class FPPhase2bCodec(MessageCodec):
+    message_type = m.Phase2b
+    tag = 55
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.server_index, message.slot,
+                         message.round)
+        if message.command is None:
+            out.append(0)
+        else:
+            out.append(1)
+            _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        server, slot, round = _QQQ.unpack_from(buf, at)
+        at += _QQQ.size
+        kind = buf[at]
+        at += 1
+        command = None
+        if kind == 1:
+            command, at = _take_command(buf, at)
+        return m.Phase2b(server_index=server, slot=slot, round=round,
+                         command=command), at
+
+
+class FPPhase3aCodec(MessageCodec):
+    """The chosen-value broadcast -- the highest-fanout per-command
+    message (every choose fans to the other 2f servers)."""
+
+    message_type = m.Phase3a
+    tag = 57
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_value(out, message.value)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        value, at = _take_value(buf, at + 8)
+        return m.Phase3a(slot=slot, value=value), at
+
+
+class FPClientReplyCodec(MessageCodec):
+    message_type = m.ClientReply
+    tag = 56
+
+    def encode(self, out, message):
+        cid = message.command_id
+        _put_address(out, cid.client_address)
+        out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        pseudonym, id = _I64I64.unpack_from(buf, at)
+        result, at = _take_bytes(buf, at + 16)
+        return m.ClientReply(m.CommandId(address, pseudonym, id),
+                             result), at
+
+
+for _codec in (FPClientRequestCodec(), FPPhase2aCodec(),
+               FPPhase2bCodec(), FPPhase3aCodec(),
+               FPClientReplyCodec()):
+    register_codec(_codec)

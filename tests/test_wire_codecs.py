@@ -306,3 +306,25 @@ def test_matchmakermultipaxos_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_fasterpaxos_codecs_round_trip():
+    """FasterPaxos' steady-state path, including the optional command
+    piggybacked on a Phase2b (ackNoopsWithCommands)."""
+    import frankenpaxos_tpu.protocols.fasterpaxos as m
+
+    command = m.Command(m.CommandId(("h", 5), 1, 3), b"x")
+    messages = [
+        m.ClientRequest(2, command),
+        m.Phase2a(slot=5, round=1, value=command),
+        m.Phase2a(slot=5, round=1, value=m.NOOP),
+        m.Phase2b(server_index=0, slot=5, round=1),
+        m.Phase2b(server_index=0, slot=5, round=1, command=command),
+        m.Phase3a(slot=5, value=command),
+        m.Phase3a(slot=5, value=m.NOOP),
+        m.ClientReply(m.CommandId("c", 0, 1), b"r"),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
