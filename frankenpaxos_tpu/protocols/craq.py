@@ -319,3 +319,7 @@ class CraqClient(Actor):
             pending.callback()
         else:
             pending.callback(result)
+
+# Importing registers this protocol's binary codecs with the hybrid
+# serializer (see craq_wire.py).
+from frankenpaxos_tpu.protocols import craq_wire  # noqa: E402,F401

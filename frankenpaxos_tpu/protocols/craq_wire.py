@@ -1,0 +1,154 @@
+"""Binary codecs for the CRAQ steady-state path."""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols import craq as cq
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_QQQ = struct.Struct("<qqq")
+
+# --- CRAQ -------------------------------------------------------------------
+
+
+def _cq_put_cid(out: bytearray, cid: cq.CommandId) -> None:
+    _put_address(out, cid.client_address)
+    out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+
+
+def _cq_take_cid(buf: bytes, at: int):
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    return cq.CommandId(address, pseudonym, id), at + 16
+
+
+def _cq_put_write_batch(out: bytearray, batch: cq.WriteBatch) -> None:
+    out += _I64.pack(batch.seq)
+    out += _I32.pack(len(batch.writes))
+    for write in batch.writes:
+        _cq_put_cid(out, write.command_id)
+        _put_bytes(out, write.key.encode())
+        _put_bytes(out, write.value.encode())
+
+
+def _cq_take_write_batch(buf: bytes, at: int):
+    (seq,) = _I64.unpack_from(buf, at)
+    (n,) = _I32.unpack_from(buf, at + 8)
+    at += 12
+    writes = []
+    for _ in range(n):
+        cid, at = _cq_take_cid(buf, at)
+        key, at = _take_bytes(buf, at)
+        value, at = _take_bytes(buf, at)
+        writes.append(cq.Write(cid, key.decode(), value.decode()))
+    return cq.WriteBatch(tuple(writes), seq=seq), at
+
+
+def _cq_put_read_batch(out: bytearray, batch: cq.ReadBatch) -> None:
+    out += _I32.pack(len(batch.reads))
+    for read in batch.reads:
+        _cq_put_cid(out, read.command_id)
+        _put_bytes(out, read.key.encode())
+
+
+def _cq_take_read_batch(buf: bytes, at: int):
+    (n,) = _I32.unpack_from(buf, at)
+    at += 4
+    reads = []
+    for _ in range(n):
+        cid, at = _cq_take_cid(buf, at)
+        key, at = _take_bytes(buf, at)
+        reads.append(cq.Read(cid, key.decode()))
+    return cq.ReadBatch(tuple(reads)), at
+
+
+class CraqWriteBatchCodec(MessageCodec):
+    message_type = cq.WriteBatch
+    tag = 64
+
+    def encode(self, out, message):
+        _cq_put_write_batch(out, message)
+
+    def decode(self, buf, at):
+        return _cq_take_write_batch(buf, at)
+
+
+class CraqReadBatchCodec(MessageCodec):
+    message_type = cq.ReadBatch
+    tag = 65
+
+    def encode(self, out, message):
+        _cq_put_read_batch(out, message)
+
+    def decode(self, buf, at):
+        return _cq_take_read_batch(buf, at)
+
+
+class CraqTailReadCodec(MessageCodec):
+    message_type = cq.TailRead
+    tag = 66
+
+    def encode(self, out, message):
+        _cq_put_read_batch(out, message.read_batch)
+
+    def decode(self, buf, at):
+        batch, at = _cq_take_read_batch(buf, at)
+        return cq.TailRead(batch), at
+
+
+class CraqAckCodec(MessageCodec):
+    message_type = cq.Ack
+    tag = 67
+
+    def encode(self, out, message):
+        _cq_put_write_batch(out, message.write_batch)
+
+    def decode(self, buf, at):
+        batch, at = _cq_take_write_batch(buf, at)
+        return cq.Ack(batch), at
+
+
+class CraqClientReplyCodec(MessageCodec):
+    message_type = cq.ClientReply
+    tag = 68
+
+    def encode(self, out, message):
+        _cq_put_cid(out, message.command_id)
+
+    def decode(self, buf, at):
+        cid, at = _cq_take_cid(buf, at)
+        return cq.ClientReply(cid), at
+
+
+class CraqReadReplyCodec(MessageCodec):
+    message_type = cq.ReadReply
+    tag = 69
+
+    def encode(self, out, message):
+        _cq_put_cid(out, message.command_id)
+        _put_bytes(out, message.value.encode())
+
+    def decode(self, buf, at):
+        cid, at = _cq_take_cid(buf, at)
+        value, at = _take_bytes(buf, at)
+        return cq.ReadReply(cid, value.decode()), at
+
+
+
+for _codec in (CraqWriteBatchCodec(), CraqReadBatchCodec(),
+               CraqTailReadCodec(), CraqAckCodec(),
+               CraqClientReplyCodec(), CraqReadReplyCodec()):
+    register_codec(_codec)

@@ -802,3 +802,7 @@ class FastMultiPaxosClient(Actor):
         pending.resend.stop()
         self.pending = None
         pending.callback(message.result)
+
+# Importing registers this protocol's binary codecs with the hybrid
+# serializer (see fastmultipaxos_wire.py).
+from frankenpaxos_tpu.protocols import fastmultipaxos_wire  # noqa: E402,F401

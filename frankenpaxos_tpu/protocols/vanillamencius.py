@@ -607,3 +607,7 @@ class VanillaMenciusClient(Actor):
         pending.resend.stop()
         del self.pending[message.command_id.client_pseudonym]
         pending.callback(message.result)
+
+# Importing registers this protocol's binary codecs with the hybrid
+# serializer (see vanillamencius_wire.py).
+from frankenpaxos_tpu.protocols import vanillamencius_wire  # noqa: E402,F401

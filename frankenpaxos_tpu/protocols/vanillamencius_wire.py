@@ -1,0 +1,152 @@
+"""Binary codecs for the VanillaMencius steady-state path."""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.protocols import vanillamencius as vm
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+)
+from frankenpaxos_tpu.runtime.serializer import (
+    MessageCodec,
+    register_codec,
+)
+
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_I64I64 = struct.Struct("<qq")
+_QQQ = struct.Struct("<qqq")
+
+# --- VanillaMencius ---------------------------------------------------------
+
+
+def _vm_put_command(out: bytearray, command: vm.Command) -> None:
+    cid = command.command_id
+    _put_address(out, cid.client_address)
+    out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+    _put_bytes(out, command.command)
+
+
+def _vm_take_command(buf: bytes, at: int):
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    payload, at = _take_bytes(buf, at + 16)
+    return vm.Command(vm.CommandId(address, pseudonym, id), payload), at
+
+
+def _vm_put_value(out: bytearray, value) -> None:
+    if isinstance(value, vm.Noop):
+        out.append(0)
+    else:
+        out.append(1)
+        _vm_put_command(out, value)
+
+
+def _vm_take_value(buf: bytes, at: int):
+    kind = buf[at]
+    at += 1
+    if kind == 0:
+        return vm.NOOP, at
+    return _vm_take_command(buf, at)
+
+
+class VMClientRequestCodec(MessageCodec):
+    message_type = vm.ClientRequest
+    tag = 58
+
+    def encode(self, out, message):
+        _vm_put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _vm_take_command(buf, at)
+        return vm.ClientRequest(command), at
+
+
+class VMPhase2aCodec(MessageCodec):
+    message_type = vm.Phase2a
+    tag = 59
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.sending_server, message.slot,
+                         message.round)
+        _vm_put_value(out, message.value)
+
+    def decode(self, buf, at):
+        server, slot, round = _QQQ.unpack_from(buf, at)
+        value, at = _vm_take_value(buf, at + _QQQ.size)
+        return vm.Phase2a(sending_server=server, slot=slot, round=round,
+                          value=value), at
+
+
+class VMSkipCodec(MessageCodec):
+    message_type = vm.Skip
+    tag = 60
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.server_index,
+                         message.start_slot_inclusive,
+                         message.stop_slot_exclusive)
+
+    def decode(self, buf, at):
+        server, start, stop = _QQQ.unpack_from(buf, at)
+        return vm.Skip(server_index=server, start_slot_inclusive=start,
+                       stop_slot_exclusive=stop), at + _QQQ.size
+
+
+class VMPhase2bCodec(MessageCodec):
+    message_type = vm.Phase2b
+    tag = 61
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.server_index, message.slot,
+                         message.round)
+
+    def decode(self, buf, at):
+        server, slot, round = _QQQ.unpack_from(buf, at)
+        return vm.Phase2b(server_index=server, slot=slot,
+                          round=round), at + _QQQ.size
+
+
+class VMChosenCodec(MessageCodec):
+    message_type = vm.Chosen
+    tag = 62
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        out.append(1 if message.is_revocation else 0)
+        _vm_put_value(out, message.value)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        is_revocation = bool(buf[at + 8])
+        value, at = _vm_take_value(buf, at + 9)
+        return vm.Chosen(slot=slot, value=value,
+                         is_revocation=is_revocation), at
+
+
+class VMClientReplyCodec(MessageCodec):
+    message_type = vm.ClientReply
+    tag = 63
+
+    def encode(self, out, message):
+        cid = message.command_id
+        _put_address(out, cid.client_address)
+        out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+        _put_bytes(out, message.result)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        pseudonym, id = _I64I64.unpack_from(buf, at)
+        result, at = _take_bytes(buf, at + 16)
+        return vm.ClientReply(vm.CommandId(address, pseudonym, id),
+                              result), at
+
+
+
+for _codec in (VMClientRequestCodec(), VMPhase2aCodec(), VMSkipCodec(),
+               VMPhase2bCodec(), VMChosenCodec(), VMClientReplyCodec()):
+    register_codec(_codec)

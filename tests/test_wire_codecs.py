@@ -328,3 +328,63 @@ def test_fasterpaxos_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_steady_wire_codecs_round_trip():
+    """VanillaMencius, CRAQ, and FastMultiPaxos steady-state paths
+    (protocols/steady_wire.py)."""
+    import frankenpaxos_tpu.protocols.craq as cq
+    import frankenpaxos_tpu.protocols.fastmultipaxos as fmp
+    import frankenpaxos_tpu.protocols.vanillamencius as vm
+
+    command = vm.Command(vm.CommandId(("h", 5), 1, 3), b"x")
+    cid = cq.CommandId(("h", 5), 1, 3)
+    fcommand = fmp.Command(fmp.CommandId(("h", 5), 3), b"x")
+    messages = [
+        vm.ClientRequest(command),
+        vm.Phase2a(sending_server=0, slot=5, round=1, value=command),
+        vm.Phase2a(sending_server=0, slot=5, round=1, value=vm.NOOP),
+        vm.Skip(server_index=1, start_slot_inclusive=3,
+                stop_slot_exclusive=9),
+        vm.Phase2b(server_index=1, slot=5, round=1),
+        vm.Chosen(slot=5, value=command, is_revocation=False),
+        vm.Chosen(slot=5, value=vm.NOOP, is_revocation=True),
+        vm.ClientReply(vm.CommandId("c", 0, 1), b"r"),
+        cq.WriteBatch((cq.Write(cid, "k", "v"),), seq=7),
+        cq.ReadBatch((cq.Read(cid, "k"),)),
+        cq.TailRead(cq.ReadBatch((cq.Read(cid, "k"),))),
+        cq.Ack(cq.WriteBatch((cq.Write(cid, "k", "v"),), seq=7)),
+        cq.ClientReply(cid),
+        cq.ReadReply(cid, "v"),
+        fmp.ProposeRequest(fcommand),
+        fmp.ProposeReply(fmp.CommandId(("h", 5), 3), b"r", round=2),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_fastmultipaxos_hot_loop_codecs_round_trip():
+    """The leader/acceptor per-command loop: Phase2a with fast-round
+    any/anySuffix markers, Phase2b votes, acceptor-drain buffers, and
+    chosen-value gossip."""
+    import frankenpaxos_tpu.protocols.fastmultipaxos as fmp
+
+    command = fmp.Command(fmp.CommandId(("h", 5), 3), b"x")
+    messages = [
+        fmp.Phase2a(slot=5, round=1, value=command),
+        fmp.Phase2a(slot=5, round=1, value=fmp.NOOP),
+        fmp.Phase2a(slot=5, round=1, any=True),
+        fmp.Phase2a(slot=5, round=1, any_suffix=True),
+        fmp.Phase2a(slot=5, round=1),
+        fmp.Phase2b(acceptor_id=0, slot=5, round=1, vote=command),
+        fmp.Phase2bBuffer((
+            fmp.Phase2b(acceptor_id=0, slot=5, round=1, vote=command),
+            fmp.Phase2b(acceptor_id=1, slot=6, round=1, vote=fmp.NOOP))),
+        fmp.ValueChosen(slot=5, value=command),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
