@@ -283,6 +283,15 @@ class TpuQuorumChecker:
         self.spec = spec
         self.window = window
         self.num_nodes = spec.num_nodes
+        # Ring-invariant surveillance (the "window > max slots in
+        # flight" contract, see VoteBoard): a vote whose slot trails the
+        # newest recorded slot by >= window may land on a reclaimed
+        # column and be silently dropped on device -- which manifests as
+        # a permanently-unchosen slot. Detect it host-side from the slot
+        # numbers we already have (no kernel change, no sync): count
+        # violations and log the first occurrence loudly.
+        self._max_slot_seen = -1
+        self.window_violations = 0
         self._masks_t, self._meta = _spec_statics(spec)
         self.board = make_vote_board(window, spec.num_nodes)
         if mesh is not None:
@@ -321,6 +330,7 @@ class TpuQuorumChecker:
             raise ValueError(
                 f"block [{start}, {start + b}) straddles the ring end "
                 f"(window {self.window}); split it")
+        self._note_slot_span(start_slot, start_slot + b - 1)
         padded = 64
         while padded < b:
             padded *= 2
@@ -362,6 +372,8 @@ class TpuQuorumChecker:
         length (see :meth:`record_block_async`); slice on the host."""
         slots = np.asarray(slots, dtype=np.int32)
         b = slots.shape[0]
+        if b:
+            self._note_slot_span(int(slots.min()), int(slots.max()))
         if rounds is None:
             rounds = np.zeros(b, dtype=np.int32)
         if pad_to is None:
@@ -405,6 +417,27 @@ class TpuQuorumChecker:
         b = np.asarray(slots).shape[0]
         return np.asarray(self.record_and_check_async(
             slots, node_cols, rounds, pad_to))[:b]
+
+    def _note_slot_span(self, lowest: int, highest: int) -> None:
+        """Flag votes that trail the frontier by >= window (they may hit
+        a self-reclaimed column and be dropped on device). The batch's
+        own span counts too: two same-batch slots >= window apart alias
+        one column regardless of the prior frontier."""
+        if max(self._max_slot_seen, highest) - lowest >= self.window:
+            self.window_violations += 1
+            if self.window_violations == 1:
+                import warnings
+
+                warnings.warn(
+                    f"TpuQuorumChecker: vote for slot {lowest} trails the "
+                    f"frontier ({self._max_slot_seen}) by >= window "
+                    f"({self.window}); straggler votes may be silently "
+                    f"dropped -- raise `window` above the max slots in "
+                    f"flight (further violations counted in "
+                    f"`window_violations` without warning)",
+                    RuntimeWarning, stacklevel=3)
+        if highest > self._max_slot_seen:
+            self._max_slot_seen = highest
 
     def release(self, slots: Sequence[int] | np.ndarray) -> None:
         """GC slot columns below the chosen watermark so the ring can wrap."""

@@ -49,9 +49,10 @@ def _put_value(out: bytearray, value) -> None:
         out.append(1)
         _put_command(out, value)
     else:  # Configuration (one per reconfiguration -- cold)
+        from frankenpaxos_tpu.runtime import serializer
+
         out.append(2)
-        _put_bytes(out, pickle.dumps(value,
-                                     protocol=pickle.HIGHEST_PROTOCOL))
+        _put_bytes(out, serializer.guarded_pickle_dumps(value, "value"))
 
 
 def _take_value(buf: bytes, at: int):
@@ -61,8 +62,10 @@ def _take_value(buf: bytes, at: int):
         return m.NOOP, at
     if kind == 1:
         return _take_command(buf, at)
+    from frankenpaxos_tpu.runtime import serializer
+
     raw, at = _take_bytes(buf, at)
-    return pickle.loads(raw), at
+    return serializer.guarded_pickle_loads(raw, "value"), at
 
 
 class HClientRequestCodec(MessageCodec):

@@ -72,11 +72,10 @@ def _put_address(out: bytearray, address) -> None:
         out.append(0)
         _put_bytes(out, address.encode())
     else:
-        import pickle
+        from frankenpaxos_tpu.runtime import serializer
 
         out.append(2)
-        _put_bytes(out, pickle.dumps(address,
-                                     protocol=pickle.HIGHEST_PROTOCOL))
+        _put_bytes(out, serializer.guarded_pickle_dumps(address, "address"))
 
 
 def _take_address(buf: bytes, at: int):
@@ -87,9 +86,9 @@ def _take_address(buf: bytes, at: int):
         (port,) = _I32.unpack_from(buf, at)
         return (raw.decode(), port), at + 4
     if kind == 2:
-        import pickle
+        from frankenpaxos_tpu.runtime import serializer
 
-        return pickle.loads(raw), at
+        return serializer.guarded_pickle_loads(raw, "address"), at
     return raw.decode(), at
 
 

@@ -66,21 +66,20 @@ def _put_command(out: bytearray, command) -> None:
         out += _I64I64.pack(command.client_pseudonym, command.client_id)
         _put_bytes(out, command.command)
     else:
-        import pickle
+        from frankenpaxos_tpu.runtime import serializer
 
         out.append(1)
-        _put_bytes(out, pickle.dumps(command,
-                                     protocol=pickle.HIGHEST_PROTOCOL))
+        _put_bytes(out, serializer.guarded_pickle_dumps(command, "command"))
 
 
 def _take_command(buf: bytes, at: int):
     kind = buf[at]
     at += 1
     if kind == 1:
-        import pickle
+        from frankenpaxos_tpu.runtime import serializer
 
         raw, at = _take_bytes(buf, at)
-        return pickle.loads(raw), at
+        return serializer.guarded_pickle_loads(raw, "command"), at
     address, at = _take_address(buf, at)
     pseudonym, id = _I64I64.unpack_from(buf, at)
     payload, at = _take_bytes(buf, at + 16)

@@ -8,6 +8,17 @@ Protocol messages here are plain dataclasses; the default wire format is
 pickle (simple, complete). The framing layer (tcp_transport / the C++
 codec) is format-agnostic, so a fixed-layout binary codec can replace
 pickle per-message-type without touching protocol code.
+
+SECURITY: the no-code-execution-on-decode property holds ONLY for
+messages carried by a registered ``MessageCodec`` (wire tags 1..127).
+Unregistered message types -- and a handful of escape hatches inside
+binary codecs, e.g. exotic sim addresses -- fall back to pickle, and
+``pickle.loads`` on attacker-controlled bytes executes arbitrary code.
+The reference avoids this wholesale by using protobuf everywhere
+(ProtoSerializer.scala:3-11). Deployments whose transport crosses a
+trust boundary must call ``set_pickle_fallback(False)``: decoding then
+hard-errors on any pickle frame (first byte >= 0x80) instead of
+evaluating it, and encoding an unregistered type raises at the sender.
 """
 
 from __future__ import annotations
@@ -65,6 +76,45 @@ class MessageCodec(abc.ABC):
 _CODECS_BY_TYPE: dict[type, MessageCodec] = {}
 _CODECS_BY_TAG: dict[int, MessageCodec] = {}
 
+#: Whether HybridSerializer (and codec escape hatches) may pickle.
+#: Default True: sims and single-trust-domain deployments keep the
+#: complete-coverage fallback. See the module docstring for the
+#: security trade-off.
+_PICKLE_FALLBACK = True
+
+
+def set_pickle_fallback(enabled: bool) -> None:
+    """Globally allow/forbid the pickle wire fallback. With it disabled,
+    decode raises on pickle frames instead of executing them, and encode
+    raises on message types without a registered codec."""
+    global _PICKLE_FALLBACK
+    _PICKLE_FALLBACK = enabled
+
+
+def pickle_fallback_enabled() -> bool:
+    return _PICKLE_FALLBACK
+
+
+def guarded_pickle_loads(raw: bytes, what: str):
+    """The ONE entry point for pickle escape hatches inside binary
+    codecs (exotic addresses/values/commands): every hatch must decode
+    through here so ``set_pickle_fallback(False)`` covers it."""
+    if not _PICKLE_FALLBACK:
+        raise ValueError(
+            f"pickle fallback disabled: refusing pickled {what} inside "
+            f"binary frame")
+    return pickle.loads(raw)
+
+
+def guarded_pickle_dumps(obj, what: str) -> bytes:
+    """Encode-side twin of :func:`guarded_pickle_loads`: fail at the
+    sender instead of emitting a frame the receiver must refuse."""
+    if not _PICKLE_FALLBACK:
+        raise ValueError(
+            f"pickle fallback disabled: cannot encode {what} {obj!r} in "
+            f"a binary frame")
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
 
 def register_codec(codec: MessageCodec) -> None:
     """Install a binary codec for its message type (process-global: the
@@ -91,6 +141,10 @@ class HybridSerializer(Serializer[M]):
     def to_bytes(self, message: M) -> bytes:
         codec = _CODECS_BY_TYPE.get(type(message))
         if codec is None:
+            if not _PICKLE_FALLBACK:
+                raise ValueError(
+                    f"pickle fallback disabled and no codec registered "
+                    f"for {type(message).__name__}")
             return pickle.dumps(message,
                                 protocol=pickle.HIGHEST_PROTOCOL)
         out = bytearray((codec.tag,))
@@ -100,6 +154,10 @@ class HybridSerializer(Serializer[M]):
     def from_bytes(self, data: bytes) -> M:
         tag = data[0]
         if tag >= 128:
+            if not _PICKLE_FALLBACK:
+                raise ValueError(
+                    "pickle fallback disabled: refusing to decode a "
+                    "pickle frame (first byte >= 0x80)")
             return pickle.loads(data)
         codec = _CODECS_BY_TAG.get(tag)
         if codec is None:

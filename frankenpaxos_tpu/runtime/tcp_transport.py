@@ -169,7 +169,7 @@ class TcpTransport(Transport):
         # fpx_scan_frames) instead of two awaits per frame: a burst of
         # small frames costs ONE read syscall and one scan, and every
         # complete frame in the chunk dispatches in the same loop pass
-        # (so they land in one actor drain; see _dispatch).
+        # (so they land in one actor drain; see _deliver).
         from frankenpaxos_tpu import native
 
         buf = bytearray()
@@ -204,39 +204,68 @@ class TcpTransport(Transport):
                         self.logger.error(str(e))
                         return
                     for start, end in frames:
-                        (hlen,) = _LEN.unpack_from(buf, start)
-                        header = bytes(
-                            buf[start + 4:start + 4 + hlen]).decode()
-                        host, _, port = header.rpartition(":")
-                        src: Address = (host, int(port))
-                        data = bytes(buf[start + 4 + hlen:end])
-                        self._dispatch(local, src, data)
+                        # A corrupt frame (bad header length, non-UTF8
+                        # header, malformed port, message decode error)
+                        # must not kill the connection task with an
+                        # unretrieved exception: log it and drop the
+                        # connection cleanly. Only parse/decode runs
+                        # under this guard -- exceptions from the
+                        # actor's own receive() on a VALID frame are a
+                        # different failure class and propagate (a
+                        # FatalError from logger.fatal must stay fatal,
+                        # matching the reference's crash-the-process
+                        # check semantics, Logger.scala:62-117).
+                        try:
+                            (hlen,) = _LEN.unpack_from(buf, start)
+                            if hlen > end - start - 4:
+                                raise ValueError(
+                                    f"header length {hlen} exceeds frame "
+                                    f"payload {end - start - 4}")
+                            header = bytes(
+                                buf[start + 4:start + 4 + hlen]).decode()
+                            host, _, port = header.rpartition(":")
+                            src: Address = (host, int(port))
+                            data = bytes(buf[start + 4 + hlen:end])
+                            delivery = self._decode(local, src, data)
+                        except Exception as e:
+                            self.logger.error(
+                                f"dropping connection on corrupt frame: "
+                                f"{e!r}")
+                            return
+                        if delivery is not None:
+                            self._deliver(*delivery)
                     del buf[:consumed]
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
 
-    def _dispatch(self, local: Address, src: Address, data: bytes) -> None:
+    def _decode(self, local: Address, src: Address, data: bytes):
+        """Frame payload -> (actor, src, message), or None if no actor
+        is registered. Decode errors propagate to the caller's
+        corrupt-frame guard."""
         # Route by the address the frame arrived on: each registered
         # actor (the role itself plus any embedded election/heartbeat
         # participants) listens on its own port.
         actor = self.actors.get(local)
         if actor is None and self.listen_address is not None:
             actor = self.actors.get(self.listen_address)
-        if actor is not None:
-            actor.receive(src, actor.serializer.from_bytes(data))
-            # Defer on_drain to the end of this event-loop pass so every
-            # frame already buffered (a burst of Phase2bs) lands in ONE
-            # drain -- the batching the device kernels amortize over
-            # (the reference's event loop drains similarly: all readable
-            # frames, then flush).
-            if actor not in self._drain_scheduled:
-                self._drain_scheduled.add(actor)
-                self.loop.call_soon(self._drain_actor, actor)
-            return
-        self.logger.warn(f"dropping frame from {src} to {local}: "
-                         f"no registered actor")
+        if actor is None:
+            self.logger.warn(f"dropping frame from {src} to {local}: "
+                             f"no registered actor")
+            return None
+        return actor, src, actor.serializer.from_bytes(data)
+
+    def _deliver(self, actor: Actor, src: Address, message) -> None:
+        actor.receive(src, message)
+        # Defer on_drain to the end of this event-loop pass so every
+        # frame already buffered (a burst of Phase2bs) lands in ONE
+        # drain -- the batching the device kernels amortize over
+        # (the reference's event loop drains similarly: all readable
+        # frames, then flush).
+        if actor not in self._drain_scheduled:
+            self._drain_scheduled.add(actor)
+            self.loop.call_soon(self._drain_actor, actor)
 
     def _drain_actor(self, actor: Actor) -> None:
         self._drain_scheduled.discard(actor)
@@ -266,14 +295,7 @@ class TcpTransport(Transport):
         self.actors[address] = actor
         if self.loop is not None and address not in self._servers \
                 and isinstance(address, tuple):
-            # On-loop detection must not rely on private loop attributes
-            # (loop._thread_id is CPython-internal): ask asyncio whether
-            # THIS thread is currently running our loop.
-            try:
-                on_loop = asyncio.get_running_loop() is self.loop
-            except RuntimeError:
-                on_loop = False
-            if on_loop:
+            if self._on_loop():
                 task = self.loop.create_task(self._bind(address))
                 task.add_done_callback(
                     lambda t: (not t.cancelled() and t.exception())
@@ -334,9 +356,21 @@ class TcpTransport(Transport):
         self._call_on_loop(
             lambda: self._flush_conn(self._conn_for(src, dst)))
 
+    def _on_loop(self) -> bool:
+        """Is THIS thread currently running our event loop? Never
+        consults private loop attributes (loop._thread_id is
+        CPython-internal)."""
+        try:
+            return asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            return False
+
     def _call_on_loop(self, f: Callable[[], None]) -> None:
         assert self.loop is not None, "transport not started"
-        if threading.get_ident() == getattr(self.loop, "_thread_id", None):
+        # Running f() inline when already on the loop keeps same-pass
+        # sends in the current drain instead of deferring them to the
+        # next pass.
+        if self._on_loop():
             f()
         else:
             self.loop.call_soon_threadsafe(f)

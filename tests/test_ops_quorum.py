@@ -186,3 +186,49 @@ def test_multi_config_checker():
         expected.append(specs[k].check(xs))
     got = checker.check_batch(np.stack(rows), np.array(cfgs))
     np.testing.assert_array_equal(got, np.array(expected))
+
+
+def test_window_violation_counter():
+    """ADVICE r3: a straggler vote trailing the frontier by >= window is
+    silently droppable on device -- the checker must surface it."""
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=16)
+    checker.record_and_check([40], [0], [0])
+    assert checker.window_violations == 0
+    # Slot 40 - 16 = 24 is the lowest safe slot; 20 trails by >= window.
+    with pytest.warns(RuntimeWarning, match="trails the frontier"):
+        checker.record_and_check([20], [1], [0])
+    assert checker.window_violations == 1
+    # Subsequent violations count without re-warning.
+    checker.record_and_check([21], [1], [0])
+    assert checker.window_violations == 2
+    # In-window stragglers are fine.
+    checker.record_and_check([30], [1], [0])
+    assert checker.window_violations == 2
+
+
+def test_window_violation_counter_dense_path():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=64)
+    block = np.ones((3, 4), dtype=np.uint8)
+    checker.record_block(200, block)
+    with pytest.warns(RuntimeWarning):
+        checker.record_block(128, block)
+    assert checker.window_violations == 1
+
+
+def test_window_violation_intra_batch_and_rejected_block():
+    qs = SimpleMajority([0, 1, 2])
+    checker = TpuQuorumChecker(qs.write_spec(), window=16)
+    # Two same-batch slots >= window apart alias one column: flagged
+    # even with a fresh frontier.
+    with pytest.warns(RuntimeWarning):
+        checker.record_and_check([36, 20], [0, 1], [0, 0])
+    assert checker.window_violations == 1
+
+    # A rejected (ring-straddling) block must NOT advance the frontier.
+    checker2 = TpuQuorumChecker(qs.write_spec(), window=16)
+    with pytest.raises(ValueError, match="straddles"):
+        checker2.record_block(1000, np.ones((3, 10), dtype=np.uint8))
+    checker2.record_and_check([990], [0], [0])
+    assert checker2.window_violations == 0

@@ -16,6 +16,7 @@ from frankenpaxos_tpu.runtime import (
     LogLevel,
     SimTransport,
 )
+from frankenpaxos_tpu.runtime import serializer as serializer_mod
 from frankenpaxos_tpu.runtime.logger import FatalError
 from frankenpaxos_tpu.statemachine import AppendLog, KeyValueStore
 
@@ -220,3 +221,70 @@ class TestUnreplicated:
         client.propose(0, b"a")
         with pytest.raises(RuntimeError):
             client.propose(0, b"b")
+
+
+class TestPickleFallbackFlag:
+    """ADVICE r3: the no-code-exec guarantee only holds for registered
+    codec tags; crossing a trust boundary requires disabling the pickle
+    fallback entirely."""
+
+    def teardown_method(self):
+        serializer_mod.set_pickle_fallback(True)
+
+    def test_decode_refuses_pickle_frames_when_disabled(self):
+        import pickle
+
+        s = serializer_mod.HybridSerializer()
+        frame = pickle.dumps(("anything",), protocol=pickle.HIGHEST_PROTOCOL)
+        assert s.from_bytes(frame) == ("anything",)
+        serializer_mod.set_pickle_fallback(False)
+        with pytest.raises(ValueError, match="pickle fallback disabled"):
+            s.from_bytes(frame)
+
+    def test_encode_refuses_unregistered_types_when_disabled(self):
+        s = serializer_mod.HybridSerializer()
+        assert s.to_bytes(("unregistered",))  # fallback allowed by default
+        serializer_mod.set_pickle_fallback(False)
+        with pytest.raises(ValueError, match="no codec registered"):
+            s.to_bytes(("unregistered",))
+
+    def test_registered_codecs_still_work_when_disabled(self):
+        from frankenpaxos_tpu.protocols.multipaxos import messages as mp
+
+        s = serializer_mod.DEFAULT_SERIALIZER
+        serializer_mod.set_pickle_fallback(False)
+        msg = mp.Phase2b(group_index=0, acceptor_index=1, slot=7, round=2)
+        assert s.from_bytes(s.to_bytes(msg)) == msg
+
+    def test_wire_address_escape_hatch_respects_flag(self):
+        from frankenpaxos_tpu.protocols.multipaxos import wire
+
+        out = bytearray()
+        wire._put_address(out, frozenset({1}))  # exotic address -> pickle
+        addr, _ = wire._take_address(bytes(out), 0)
+        assert addr == frozenset({1})
+        serializer_mod.set_pickle_fallback(False)
+        with pytest.raises(ValueError, match="pickle fallback disabled"):
+            wire._take_address(bytes(out), 0)
+        with pytest.raises(ValueError, match="pickle fallback disabled"):
+            wire._put_address(bytearray(), frozenset({1}))
+
+    def test_all_codec_escape_hatches_respect_flag(self):
+        """Every pickled escape hatch inside binary codecs must decode
+        through guarded_pickle_loads (review r4 finding)."""
+        from frankenpaxos_tpu.protocols import horizontal_wire
+        from frankenpaxos_tpu.protocols.simplebpaxos import wire as sbp_wire
+
+        out = bytearray()
+        horizontal_wire._put_value(out, {"exotic": 1})
+        val, _ = horizontal_wire._take_value(bytes(out), 0)
+        assert val == {"exotic": 1}
+        out2 = bytearray()
+        sbp_wire._put_command(out2, ("sentinel",))
+        cmd, _ = sbp_wire._take_command(bytes(out2), 0)
+        assert cmd == ("sentinel",)
+        serializer_mod.set_pickle_fallback(False)
+        with pytest.raises(ValueError, match="pickle fallback disabled"):
+            horizontal_wire._take_value(bytes(out), 0)
+        with pytest.raises(ValueError, match="pickle fallback disabled"):
+            sbp_wire._take_command(bytes(out2), 0)

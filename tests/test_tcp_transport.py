@@ -190,3 +190,36 @@ def test_burst_beyond_scanner_frame_cap():
     finally:
         ta.stop()
         tb.stop()
+
+
+def test_corrupt_frame_drops_connection_not_server(transports):
+    """ADVICE r3: a corrupt frame (header length exceeding the frame)
+    must log + drop that connection, not kill the accept loop; a fresh
+    connection afterwards still works."""
+    import struct
+
+    server_addr = ("127.0.0.1", free_port())
+    client_addr = ("127.0.0.1", free_port())
+    server_t = transports(server_addr)
+    logger = FakeLogger()
+    server = EchoServer(server_addr, server_t, logger)
+
+    # Hand-craft a frame whose declared header length exceeds the frame.
+    payload = b"xx"
+    bad_inner = struct.pack(">I", 9999) + payload
+    bad = struct.pack(">I", len(bad_inner)) + bad_inner
+    with socket.create_connection(server_addr) as s:
+        s.sendall(bad)
+        # Server closes on the corrupt frame.
+        s.settimeout(5)
+        assert s.recv(1) == b""
+    assert wait_for(lambda: any("corrupt frame" in m
+                                for _, m in server_t.logger.records))
+
+    # The transport still accepts and serves new connections.
+    client_t = transports(client_addr)
+    client = EchoClient(client_addr, client_t, logger, server_addr)
+    got = []
+    client.echo("still alive", got.append)
+    assert wait_for(lambda: got == ["still alive"])
+    assert server.num_messages_received == 1
