@@ -185,3 +185,8 @@ class BatchedUnreplicatedClient(Actor):
             return
         pending.resend_timer.stop()
         pending.callback(message.result)
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

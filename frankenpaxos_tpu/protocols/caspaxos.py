@@ -319,3 +319,8 @@ class CasPaxosClient(Actor):
         timer.stop()
         self.pending = None
         callback(message.value)
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

@@ -60,3 +60,8 @@ class EchoClient(Actor):
         self.num_messages_received += 1
         if self._callbacks:
             self._callbacks.pop(0)(message.msg)
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

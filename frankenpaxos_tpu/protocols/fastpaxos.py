@@ -312,3 +312,8 @@ class FastPaxosClient(Actor):
             self._choose(self.proposed_value)
         else:
             self.logger.fatal(f"unexpected client message {message!r}")
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

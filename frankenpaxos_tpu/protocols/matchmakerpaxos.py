@@ -419,3 +419,8 @@ class MatchmakerPaxosClient(Actor):
             self.chosen_value = message.chosen
             self.repropose_timer.stop()
         self._deliver()
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

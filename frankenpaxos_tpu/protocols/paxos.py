@@ -229,3 +229,8 @@ class PaxosClient(Actor):
         for cb in self.callbacks:
             cb(message.chosen)
         self.callbacks.clear()
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

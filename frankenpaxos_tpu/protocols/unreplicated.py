@@ -124,3 +124,8 @@ class UnreplicatedClient(Actor):
         del self._pending[message.client_pseudonym]
         self._ids[message.client_pseudonym] = message.client_id + 1
         pending.callback(message.result)
+
+
+# Importing for side effect: registers this protocol's binary wire
+# codecs with the default serializer (see baseline_wire.py).
+from frankenpaxos_tpu.protocols import baseline_wire  # noqa: E402,F401

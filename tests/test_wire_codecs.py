@@ -388,3 +388,76 @@ def test_fastmultipaxos_hot_loop_codecs_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_baseline_protocol_codecs_round_trip():
+    """The last seven formerly pickle-only protocols: echo,
+    unreplicated, batchedunreplicated (the throughput-ceiling
+    baselines), paxos, fastpaxos, caspaxos, matchmakerpaxos. Every
+    message type rides a binary codec now."""
+    from frankenpaxos_tpu.protocols import (  # noqa: F401 - registers
+        batchedunreplicated as bu,
+        caspaxos as cp,
+        echo as ec,
+        fastpaxos as fp,
+        matchmakerpaxos as mp,
+        paxos as px,
+        unreplicated as ur,
+    )
+
+    messages = [
+        ec.EchoRequest("hello"),
+        ec.EchoReply("hello back é"),
+        ur.ClientRequest(("10.0.0.1", 9000), 3, 1 << 40, b"cmd"),
+        ur.ClientRequest("sim-client", 0, 0, b""),
+        ur.ClientReply(3, 1 << 40, b"result"),
+        bu.ClientRequest(bu.Command(bu.CommandId(("h", 1), 7), b"x")),
+        bu.ClientRequestBatch((
+            bu.Command(bu.CommandId("c1", 0), b"a"),
+            bu.Command(bu.CommandId(("h", 2), 1), b"b" * 100))),
+        bu.ClientReply(bu.CommandId("c1", 0), b"r"),
+        bu.ClientReplyBatch((
+            bu.ClientReply(bu.CommandId("c1", 0), b"r0"),
+            bu.ClientReply(bu.CommandId(("h", 2), 1), b"r1"))),
+        px.ProposeRequest("v"), px.ProposeReply("chosen"),
+        px.Phase1a(3), px.Phase1b(3, 1, -1, None),
+        px.Phase1b(3, 1, 2, "earlier"), px.Phase2a(3, "v"),
+        px.Phase2b(1, 3),
+        fp.ProposeRequest("v"), fp.ProposeReply("chosen"),
+        fp.Phase1a(4), fp.Phase1b(4, 0, 0, "fast"),
+        fp.Phase2a(4, None),  # None = the distinguished "any" value
+        fp.Phase2a(4, "v"), fp.Phase2b(2, 4),
+        cp.ClientRequest(("h", 5), 9, frozenset({1, 5, 9})),
+        cp.ClientRequest("sim", 0, frozenset()),
+        cp.ClientReply(9, frozenset({2})),
+        cp.Phase1a(1), cp.Phase1b(1, 0, -1, None),
+        cp.Phase1b(1, 2, 0, frozenset({4})),
+        cp.Phase2a(1, frozenset({1, 2})), cp.Phase2b(1, 0),
+        cp.Nack(7),
+        mp.ClientRequest("v"), mp.ClientReply("chosen"),
+        mp.MatchRequest(mp.AcceptorGroup(
+            2, {"kind": "simple_majority", "members": [0, 1, 2]})),
+        mp.MatchReply(2, 1, (
+            mp.AcceptorGroup(0, {"kind": "grid",
+                                 "grid": [[1, 0], [2, 3]]}),
+            mp.AcceptorGroup(1, {"kind": "unanimous_writes",
+                                 "members": [3, 4, 5]}))),
+        mp.Phase1a(2), mp.Phase1b(2, 0, None),
+        mp.Phase1b(2, 1, mp.Phase1bVote(0, "old")),
+        mp.Phase2a(2, "v"), mp.Phase2b(2, 1),
+        mp.MatchmakerNack(5), mp.AcceptorNack(6),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        decoded = DEFAULT_SERIALIZER.from_bytes(data)
+        assert decoded == message, type(message).__name__
+        assert type(decoded) is type(message)
+
+    # paxos and fastpaxos share shapes but NOT classes: same-looking
+    # messages must decode to their own types.
+    ppx = DEFAULT_SERIALIZER.to_bytes(px.Phase1a(3))
+    pfp = DEFAULT_SERIALIZER.to_bytes(fp.Phase1a(3))
+    assert ppx[0] != pfp[0]
+    assert type(DEFAULT_SERIALIZER.from_bytes(ppx)) is px.Phase1a
+    assert type(DEFAULT_SERIALIZER.from_bytes(pfp)) is fp.Phase1a
