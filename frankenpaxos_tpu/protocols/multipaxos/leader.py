@@ -56,6 +56,15 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
 class LeaderOptions:
     resend_phase1as_period_s: float = 5.0
     flush_phase2as_every_n: int = 1
+    # Assign this many CONSECUTIVE slots to one proxy leader before
+    # rotating to the next (Hash scheme only). The reference rotates
+    # per slot (Leader.scala:331-408); chunked rotation is the
+    # TPU-first layout: each proxy leader's slot space stays
+    # contiguous, so acceptors' ranged acks stay whole ranges and the
+    # device tracker's drain blocks stay dense instead of shredding
+    # into stride-N singles. Pure load balancing -- any proxy leader
+    # can handle any slot -- so protocol semantics are unchanged.
+    proxy_leader_chunk: int = 256
     noop_flush_period_s: float = 0.0  # 0 disables
     election_options: ElectionOptions = ElectionOptions()
     measure_latencies: bool = True
@@ -108,6 +117,7 @@ class Leader(Actor):
         self.chosen_watermark = 0
         self._current_proxy_leader = 0
         self._unflushed_phase2as = 0
+        self._chunk_sent = 0
 
         # Embedded election participant (Leader.scala:192-203).
         self.election = ElectionParticipant(
@@ -205,18 +215,32 @@ class Leader(Actor):
         return [values_by_id[int(vid)] if hit else NOOP
                 for hit, vid in zip(has_vote, chosen)]
 
-    def _send_phase2a(self, phase2a: Phase2a) -> None:
+    def _send_phase2a(self, phase2a: Phase2a,
+                      force_flush: bool = False) -> None:
         dst = self._proxy_leader_address()
         if self.options.flush_phase2as_every_n <= 1:
             self.send(dst, phase2a)
-            self._advance_proxy_leader()
         else:
             self.send_no_flush(dst, phase2a)
             self._unflushed_phase2as += 1
-            if self._unflushed_phase2as >= self.options.flush_phase2as_every_n:
+        # Rotate proxy leaders every `chunk` slots (>= the flush batch,
+        # so a no-flush run never strands bytes on a just-left dst).
+        self._chunk_sent += 1
+        chunk = max(self.options.proxy_leader_chunk,
+                    self.options.flush_phase2as_every_n)
+        if self._chunk_sent >= chunk:
+            if self._unflushed_phase2as:
                 self.flush(dst)
                 self._unflushed_phase2as = 0
-                self._advance_proxy_leader()
+            self._advance_proxy_leader()
+            self._chunk_sent = 0
+        elif (self._unflushed_phase2as
+              >= self.options.flush_phase2as_every_n):
+            self.flush(dst)
+            self._unflushed_phase2as = 0
+        if force_flush and self._unflushed_phase2as:
+            self.flush(dst)
+            self._unflushed_phase2as = 0
 
     def _process_client_request_batch(self, batch: ClientRequestBatch) -> None:
         if not isinstance(self.state, _Phase2):
@@ -262,10 +286,14 @@ class Leader(Actor):
         def flush_noop():
             if not isinstance(self.state, _Phase2):
                 self.logger.fatal("noop flush outside Phase2")
+            # force_flush: an anti-starvation noop must reach its
+            # acceptor group NOW, not sit in a no-flush buffer; and
+            # rotation is _send_phase2a's job (an extra advance here
+            # would split the proxy-leader chunk and strand buffered
+            # Phase2as on the just-left dst).
             self._send_phase2a(Phase2a(slot=self.next_slot, round=self.round,
-                                       value=NOOP))
+                                       value=NOOP), force_flush=True)
             self.next_slot += 1
-            self._advance_proxy_leader()
             timer.start()
 
         timer = self.timer("noopFlush", self.options.noop_flush_period_s,

@@ -40,6 +40,9 @@ class ProxyLeaderOptions:
     # "dict" (host oracle) or "tpu" (batched vote board).
     quorum_backend: str = "dict"
     tpu_window: int = 1 << 20
+    # Sync-mode host/device routing threshold (drain width in slots);
+    # 0 = auto-calibrate to the device platform (see TpuQuorumTracker).
+    tpu_min_device_slots: int = 0
     # Pipelined device drains: dispatch this drain's votes async and
     # emit the PREVIOUS drain's results, hiding the device-link RTT
     # behind the event loop (one drain of extra choose latency). A
@@ -73,7 +76,8 @@ class ProxyLeader(Actor):
         if options.quorum_backend == "tpu":
             self.tracker: QuorumTracker = TpuQuorumTracker(
                 config, window=options.tpu_window,
-                pipelined=options.tpu_pipelined)
+                pipelined=options.tpu_pipelined,
+                min_device_slots=options.tpu_min_device_slots)
         else:
             self.tracker = DictQuorumTracker(config)
         self._flush_timer = None

@@ -81,17 +81,37 @@ class DictQuorumTracker(QuorumTracker):
 
 
 class TpuQuorumTracker(QuorumTracker):
-    """``pipelined=True`` decouples device round-trips from the event
-    loop: each drain DISPATCHES its votes asynchronously (returning [])
-    and enqueues an in-flight record; the caller collects completed
-    dispatches via :meth:`take_dispatch` + :meth:`collect` -- from a
-    worker thread (ProxyLeader posts results back onto the event loop)
-    or a flush timer. This hides the device-link latency behind the
-    event loop -- essential when the accelerator sits across a high-RTT
-    link -- at the cost of one dispatch of added choose latency."""
+    """Two operating modes, chosen by ``pipelined``:
+
+    **Synchronous (default).** Each drain whose dominant-round span is
+    at least ``min_device_slots`` wide is decided by ONE stateless
+    predicate matmul over the drain's ``[n, B]`` vote block
+    (``TpuQuorumChecker.check_block``) -- no board state, no ring
+    bookkeeping, cost flat in B. Votes below quorum after that check
+    (quorums straddling drains) spill into a host tally (a
+    ``DictQuorumTracker``, the oracle itself) -- SURVEY.md section 7's
+    "overflow -> host-side spill path". Drains NARROWER than the
+    threshold skip the device entirely and go straight to the host
+    tally: a ~150us fixed device round-trip cannot beat ~0.6us/vote
+    Python below ~100 slots, exactly the small-batch host fallback
+    every accelerator framework keeps. The result: at trickle widths
+    the tracker matches the dict oracle, and past the threshold the
+    per-drain cost stays flat while the oracle's grows per vote.
+
+    **Pipelined.** Every dense run goes through the stateful on-device
+    vote board (``record_block``): the drain DISPATCHES asynchronously
+    (returning []) and enqueues an in-flight record; the caller
+    collects completed dispatches via :meth:`take_dispatch` +
+    :meth:`collect` -- from a worker thread (ProxyLeader posts results
+    back onto the event loop) or a flush timer. This hides the
+    device-link latency behind the event loop -- essential when the
+    accelerator sits across a high-RTT link -- at the cost of one
+    dispatch of added choose latency; the board must see every vote
+    because results are not available within the drain."""
 
     def __init__(self, config: MultiPaxosConfig, window: int = 1 << 20,
-                 pipelined: bool = False, mesh=None):
+                 pipelined: bool = False, mesh=None,
+                 min_device_slots: int = 0):
         import collections
 
         self.config = config
@@ -116,13 +136,33 @@ class TpuQuorumTracker(QuorumTracker):
         # (it costs seconds of startup per process).
         from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker
 
-        self.checker = TpuQuorumChecker(spec, window=window, mesh=mesh)
+        # Sync mode never records on the vote board (stateless checks +
+        # host spill), so don't allocate a full `window`-wide board
+        # there -- just enough columns for the largest dense bucket.
+        checker_window = window if pipelined else min(window, 4096)
+        self.checker = TpuQuorumChecker(spec, window=checker_window,
+                                        mesh=mesh)
         self._slots: list[int] = []
         self._cols: list[int] = []
         self._rounds: list[int] = []
         # Ranged votes (Phase2bRange): [(start, end, col, round)] --
         # O(1) Python per message, expanded vectorized at drain time.
         self._ranges: list[tuple[int, int, int, int]] = []
+        # Exactly-once reporting across drains, vectorized. The board's
+        # `chosen` bitmap provides this for board-recorded votes, but
+        # the stateless check_block path never touches the board, so a
+        # duplicate full-quorum drain (resent acks) would re-report. A
+        # host-side dedup ring keyed slot % window (owner slot + round
+        # per column, numpy fancy-indexed in collect()) restores the
+        # dict oracle's contract with O(batch) numpy instead of
+        # per-slot set ops. Like the vote board itself it forgets a
+        # slot once the ring wraps past it -- covered by the same
+        # "window > max slots in flight" invariant.
+        self._dedup_slot = np.full(window, -1, dtype=np.int64)
+        self._dedup_round = np.full(window, np.iinfo(np.int64).min,
+                                    dtype=np.int64)
+        self._frontier = -1
+        self._host_gc_cap = max(1 << 16, 2 * window)
         # Kernel width buckets. Drains are chunked to these so ONLY the
         # prewarmed widths ever compile -- an unexpected width compiling
         # mid-run stalls the event loop for seconds over a remote device
@@ -139,20 +179,47 @@ class TpuQuorumTracker(QuorumTracker):
         # A dominant-round cluster goes dense when it's at least this
         # filled; emptier clusters cost fewer device calls via scatter.
         self.min_fill = 0.25
+        if min_device_slots <= 0:
+            # Auto-calibrate the host/device routing threshold to the
+            # backend. On a real local TPU a stateless check is tens of
+            # microseconds -- engage it early. On the host-XLA CPU
+            # control, the call itself is ~150us but its AMBIENT cost
+            # on a small host is the real price (kernel execution and
+            # thread-pool churn timeshare with the single-threaded
+            # actor pipeline; measured ~2-4ms of surrounding-pipeline
+            # slowdown per call on a 1-CPU box), so the device must
+            # only engage when a drain carries enough votes to beat
+            # that: ~1k slots.
+            import jax
+
+            platform = jax.devices()[0].platform
+            min_device_slots = 96 if platform == "tpu" else 1024
+        self.min_device_slots = min_device_slots
+        # Host spill tally for the synchronous mode (narrow drains +
+        # below-quorum residue of stateless checks): the dict oracle
+        # itself, so cross-drain accumulation has one authority with
+        # proven semantics.
+        self._host = DictQuorumTracker(config)
         # Pre-compile every bucket at construction -- before client
         # traffic -- so the first real drains don't stall on XLA
-        # compiles. Votes land at round -1 (below any real round), and
-        # release() clears the touched columns (including the ring
+        # compiles. The board paths (record_block / record_and_check)
+        # only run in pipelined mode; prewarming them in sync mode
+        # would pay startup compiles for kernels that never execute.
+        # Board prewarm votes land at round -1 (below any real round),
+        # and release() clears the touched columns (including the ring
         # owners the prewarm claimed).
         for width in self.dense_buckets:
             warm = np.zeros((self.checker.num_nodes, width),
                             dtype=np.uint8)
             warm[0, 0] = 1
-            self.checker.record_block(0, warm, vote_round=-1)
-        for width in (1, self.max_chunk):
-            self.checker.record_and_check([0] * width, [0] * width,
-                                          [-1] * width)
-        self.checker.release(np.arange(self.max_dense))
+            self.checker.check_block(warm)
+            if pipelined:
+                self.checker.record_block(0, warm, vote_round=-1)
+        if pipelined:
+            for width in (1, self.max_chunk):
+                self.checker.record_and_check([0] * width, [0] * width,
+                                              [-1] * width)
+            self.checker.release(np.arange(self.max_dense))
 
     def record(self, slot, round, group_index, acceptor_index) -> None:
         self._slots.append(slot)
@@ -166,22 +233,233 @@ class TpuQuorumTracker(QuorumTracker):
                              + acceptor_index, round))
 
     def drain(self) -> list[tuple[int, int]]:
-        """A handful of device calls (ideally one) per event-loop drain.
-
-        Steady-state Phase2b streams cover contiguous slot runs in one
-        round (Leader.scala:331-408 allocates slots contiguously), which
-        map onto the dense ``record_block`` path -- a slice update plus
-        one matmul, no scatter. The drain's dominant round is sorted and
-        clustered into dense runs chunked at prewarmed bucket widths (up
-        to ``max_dense`` slots per call); sparse stragglers and
-        off-round votes go through the scatter path. Sparse votes in
-        rounds OLDER than the dominant round dispatch BEFORE the dense
-        block so an old-round quorum completing in this drain is
-        reported before the newer round's preemption clears it
-        (matching DictQuorumTracker's arrival-order liveness).
-        """
+        """At most a few device calls (usually one, often zero) per
+        event-loop drain; see the class docstring for the two modes."""
         if not self._slots and not self._ranges:
             return []
+        if self.pipelined:
+            return self._drain_pipelined()
+        return self._drain_sync()
+
+    # --- synchronous mode -------------------------------------------------
+
+    def _drain_sync(self) -> list[tuple[int, int]]:
+        """Stateless device check for wide single-round drains; host
+        tally for narrow drains, off-round votes, and the below-quorum
+        residue of device checks.
+
+        Steady-state Phase2b streams cover contiguous slot runs in one
+        round (Leader.scala:331-408 allocates slots contiguously) and a
+        slot's whole write quorum lands in ONE drain (the ProxyLeader
+        fans each Phase2a to its quorum in one pass; the acks coalesce
+        back together), so the common drain is one ``check_block``
+        matmul with an empty residue."""
+        ranges, self._ranges = self._ranges, []
+        sl, self._slots = self._slots, []
+        cl, self._cols = self._cols, []
+        rl, self._rounds = self._rounds, []
+
+        # Trickle drains (a serial client, quiescence dribbles): pure
+        # Python straight into the host tally -- no numpy conversions,
+        # no device. This is the regime where ANY fixed overhead is
+        # visible per command. An explicit tiny min_device_slots (the
+        # component benchmarks pin the device path on) lowers this
+        # cutoff too.
+        nvotes = len(sl) + sum(e - s for s, e, _, _ in ranges)
+        if nvotes < min(48, self.min_device_slots):
+            row = self._row_size
+            frontier = max(sl) if sl else -1
+            for k in range(len(sl)):
+                g, i = divmod(cl[k], row)
+                self._host.record(sl[k], rl[k], g, i)
+            if ranges:
+                frontier = max(frontier,
+                               max(e - 1 for _, e, _, _ in ranges))
+            self._spill_ranges(ranges)
+            self._note_frontier(frontier)
+            return self._host_results()
+
+        slots = np.asarray(sl, dtype=np.int64)
+        cols = np.asarray(cl, dtype=np.int32)
+        rounds = np.asarray(rl, dtype=np.int32)
+        # Ranges as an [R, 4] array: strided workloads shred ranged
+        # acks into many single-slot runs, so everything below must be
+        # vectorized over R, not Python-per-range.
+        ra = (np.asarray(ranges, dtype=np.int64) if ranges
+              else np.empty((0, 4), dtype=np.int64))
+
+        # Uniform-round test + slot span.
+        uniform = True
+        if ranges:
+            rnd0 = int(ra[0, 3])
+            uniform = bool((ra[:, 3] == rnd0).all())
+            lo = int(ra[:, 0].min())
+            hi = int(ra[:, 1].max()) - 1
+        else:
+            rnd0 = int(rounds[0])
+        if uniform and slots.size:
+            if not (rounds == rnd0).all():
+                uniform = False
+            else:
+                slo = int(slots.min())
+                shi = int(slots.max())
+                if ranges:
+                    lo = min(lo, slo)
+                    hi = max(hi, shi)
+                else:
+                    lo, hi = slo, shi
+        if not uniform:
+            # Mixed rounds: election churn, preemption -- rare and
+            # thin. Spill everything to the host tally in arrival
+            # order (preserving the oracle's old-round-before-new
+            # reporting liveness).
+            frontier = int(slots.max()) if slots.size else -1
+            if ranges:
+                frontier = max(frontier, int(ra[:, 1].max()) - 1)
+            self._spill_ranges(ranges)
+            self._spill_votes(slots, cols, rounds)
+            self._note_frontier(frontier)
+            return self._host_results()
+
+        width = hi - lo + 1
+        if width < self.min_device_slots:
+            # Narrow drain: the fixed device round-trip loses to
+            # per-vote Python here -- host tally.
+            self._spill_ranges(ranges)
+            self._spill_votes(slots, cols, rounds)
+            self._note_frontier(hi)
+            return self._host_results()
+
+        # Wide single-round drain: one stateless check per max_dense
+        # segment of the span (usually exactly one). Only segments
+        # containing votes are materialized, so a pathological sparse
+        # span costs O(active segments), not O(span).
+        out: list[tuple[int, int]] = []
+        seg = self.max_dense
+        # Single-slot runs (the strided-ack shape) fill vectorized;
+        # only genuinely multi-slot runs take the per-range slice loop.
+        single = ra[ra[:, 1] - ra[:, 0] == 1] if ranges else ra
+        multi = ([r for r in ranges if r[1] - r[0] > 1]
+                 if ranges and single.shape[0] != ra.shape[0] else [])
+        active = set()
+        if slots.size:
+            active.update(np.unique((slots - lo) // seg).tolist())
+        if single.shape[0]:
+            active.update(np.unique((single[:, 0] - lo) // seg).tolist())
+        for s, e, _, _ in multi:
+            active.update(range((s - lo) // seg, (e - 1 - lo) // seg + 1))
+        # Two phases: dispatch every segment's check first, THEN fetch
+        # -- k segments pay one overlap-able round-trip, not k
+        # serialized ones.
+        dispatched = []
+        for seg_idx in sorted(active):
+            seg_start = lo + seg_idx * seg
+            seg_end = min(seg_start + seg, hi + 1)
+            seg_width = seg_end - seg_start
+            bucket = next(b for b in self.dense_buckets
+                          if b >= seg_width)
+            block = np.zeros((self.checker.num_nodes, bucket),
+                             dtype=np.uint8)
+            if single.shape[0]:
+                inseg = ((single[:, 0] >= seg_start)
+                         & (single[:, 0] < seg_end))
+                block[single[inseg, 2],
+                      single[inseg, 0] - seg_start] = 1
+            for s, e, col, _ in multi:
+                cs, ce = max(s, seg_start), min(e, seg_end)
+                if cs < ce:
+                    block[col, cs - seg_start:ce - seg_start] = 1
+            if slots.size:
+                inseg = (slots >= seg_start) & (slots < seg_end)
+                block[cols[inseg], slots[inseg] - seg_start] = 1
+            dispatched.append((seg_start, seg_width, block,
+                               self.checker.check_block_async(block)))
+        for seg_start, seg_width, block, mask in dispatched:
+            hit = np.asarray(mask)[:seg_width]
+            touched = block[:, :seg_width].any(axis=0)
+            chosen = np.flatnonzero(hit & touched)
+            if chosen.size:
+                chosen_slots = seg_start + chosen.astype(np.int64)
+                fresh = self._fresh_mask(chosen_slots, rnd0)
+                out.extend(zip(chosen_slots[fresh].tolist(),
+                               (rnd0,) * int(fresh.sum())))
+            resid = touched & ~hit
+            if resid.any():
+                # Below-quorum residue: votes whose quorum straddles
+                # drains. Spill to the host tally (few by
+                # construction), which may complete earlier slots.
+                rcols, rpos = np.nonzero(block[:, :seg_width]
+                                         * resid[None, :])
+                for col, pos in zip(rcols.tolist(), rpos.tolist()):
+                    g, i = divmod(col, self._row_size)
+                    self._host.record(seg_start + pos, rnd0, g, i)
+        self._note_frontier(hi)
+        out.extend(self._host_results())
+        return out
+
+    def _spill_votes(self, slots, cols, rounds) -> None:
+        for k in range(slots.size):
+            g, i = divmod(int(cols[k]), self._row_size)
+            self._host.record(int(slots[k]), int(rounds[k]), g, i)
+
+    def _spill_ranges(self, ranges) -> None:
+        for s, e, col, r in ranges:
+            g, i = divmod(col, self._row_size)
+            for slot in range(s, e):
+                self._host.record(slot, r, g, i)
+
+    def _note_frontier(self, max_slot: int) -> None:
+        """Bound the host tally: the oracle's states dict never evicts,
+        which is fine for the oracle (parity with the reference's
+        per-slot maps) but the spill tally must not grow for the life
+        of the process. Once it exceeds the cap, prune entries the
+        dedup ring has forgotten anyway (slot < frontier - ring size)
+        -- the same windowed-staleness contract as the vote board's
+        self-reclaiming ring."""
+        if max_slot > self._frontier:
+            self._frontier = max_slot
+        if len(self._host.states) > self._host_gc_cap:
+            cutoff = self._frontier - self._dedup_slot.shape[0]
+            self._host.states = {
+                k: v for k, v in self._host.states.items()
+                if k[0] >= cutoff}
+
+    def _host_results(self) -> list[tuple[int, int]]:
+        """Drain the host tally, marking its completions in the dedup
+        ring so a later stateless re-ack of the same slot is not
+        re-reported."""
+        results = self._host.drain()
+        if not results:
+            return []
+        if len(results) <= 8:  # scalar ring ops beat array setup here
+            n = self._dedup_slot.shape[0]
+            out = []
+            for slot, rnd in results:
+                i = slot % n
+                if (self._dedup_slot[i] != slot
+                        or self._dedup_round[i] != rnd):
+                    self._dedup_slot[i] = slot
+                    self._dedup_round[i] = rnd
+                    out.append((slot, rnd))
+            return out
+        slots = np.asarray([s for s, _ in results], dtype=np.int64)
+        rounds = np.asarray([r for _, r in results], dtype=np.int64)
+        fresh = self._fresh_mask(slots, rounds)
+        if fresh.all():
+            return results
+        return [kv for kv, f in zip(results, fresh.tolist()) if f]
+
+    # --- pipelined mode ---------------------------------------------------
+
+    def _drain_pipelined(self) -> list[tuple[int, int]]:
+        """Dispatch this drain's votes onto the stateful vote board
+        asynchronously; results are collected later (take_dispatch +
+        collect). Sparse stragglers and off-round votes go through the
+        scatter path; votes in rounds OLDER than the dominant round
+        dispatch BEFORE the dense block so an old-round quorum
+        completing in this drain is reported before the newer round's
+        preemption clears it."""
+        parts: list[tuple] = []
         slots = np.asarray(self._slots, dtype=np.int64)
         cols = np.asarray(self._cols, dtype=np.int32)
         rounds = np.asarray(self._rounds, dtype=np.int32)
@@ -199,39 +477,26 @@ class TpuQuorumTracker(QuorumTracker):
             slots = np.concatenate(parts_s)
             cols = np.concatenate(parts_c)
             rounds = np.concatenate(parts_r)
-        device_parts = []  # (index array into this drain, device mask,
-        #                     positions into the mask)
 
         # The drain's dominant round (fast path: single-round drain).
         if rounds[0] == rounds[-1] and (rounds == rounds[0]).all():
             dom = int(rounds[0])
-            # Steady-state fast path: one round, one reasonably filled
-            # contiguous run fitting one dense bucket -- skip the sort
-            # and cluster walk entirely (the common shape: a wave of
-            # Phase2bs for the leader's latest contiguous slot block).
+            # Single-round drain within one dense bucket: one block.
             lo = int(slots.min())
             hi = int(slots.max())
             width = hi - lo + 1
-            window = self.checker.window
             bucket = next((b for b in self.dense_buckets if b >= width),
                           None) if width <= self.max_dense else None
             if (bucket is not None
-                    and slots.shape[0] >= width * self.min_fill
-                    and lo % window + bucket <= window):
+                    and slots.shape[0] >= width * self.min_fill):
                 block = np.zeros((self.checker.num_nodes, bucket),
                                  dtype=np.uint8)
                 block[cols, slots - lo] = 1
-                newly = self.checker.record_block_async(lo, block,
-                                                        vote_round=dom)
-                device_parts.append((np.arange(slots.shape[0]), newly,
-                                     slots - lo))
-                dispatch = (slots, rounds, device_parts)
+                self._record_board(parts, lo, block, bucket, dom)
                 self._slots, self._cols, self._rounds = [], [], []
                 self._ranges = []
-                if self.pipelined:
-                    self._inflight.append(dispatch)
-                    return []
-                return self.collect(dispatch)
+                self._inflight.append(parts)
+                return []
             dense_idx = np.arange(slots.shape[0])
             pre = post = None
         else:
@@ -242,7 +507,7 @@ class TpuQuorumTracker(QuorumTracker):
             pre = np.flatnonzero(rounds < dom)
             post = np.flatnonzero(rounds > dom)
         if pre is not None and pre.size:
-            self._dispatch_sparse(device_parts, slots, cols, rounds, pre)
+            self._dispatch_sparse(parts, slots, cols, rounds, pre)
 
         # Cluster the dominant round's slots into contiguous runs.
         ds = slots[dense_idx]
@@ -253,7 +518,6 @@ class TpuQuorumTracker(QuorumTracker):
             order = np.argsort(ds, kind="stable")
             sidx = dense_idx[order]
             ss = ds[order]
-        window = self.checker.window
         sparse_leftover = []
         cluster_bounds = np.flatnonzero(np.diff(ss) >= self.max_dense) + 1
         for cluster in np.split(np.arange(sidx.size), cluster_bounds):
@@ -264,65 +528,94 @@ class TpuQuorumTracker(QuorumTracker):
             if cl.size < width * self.min_fill:
                 sparse_leftover.append(cl)
                 continue
-            # Chunk the run at bucket widths, breaking at the ring end
-            # (record_block's no-straddle contract). Each chunk starts
-            # at an actual member slot, so the loop is O(#chunks).
+            # Chunk the run at prewarmed bucket widths. Each chunk
+            # starts at an actual member slot, so the loop is
+            # O(#chunks).
             i = 0
             while i < cs.size:
                 start = int(cs[i])
-                room = window - start % window
                 remaining = hi - start + 1
                 bucket = next((b for b in self.dense_buckets
-                               if b >= min(remaining, self.max_dense)
-                               and b <= room), None)
-                if bucket is None:
-                    bucket = max((b for b in self.dense_buckets
-                                  if b <= room), default=None)
-                    if bucket is None:  # < 64 columns to the ring end
-                        j = int(np.searchsorted(cs, start + room))
-                        sparse_leftover.append(cl[i:j])
-                        i = j
-                        continue
+                               if b >= min(remaining, self.max_dense)))
                 j = int(np.searchsorted(cs, start + bucket))
                 members = cl[i:j]
                 block = np.zeros(
                     (self.checker.num_nodes, bucket), dtype=np.uint8)
                 block[cols[members], slots[members] - start] = 1
-                newly = self.checker.record_block_async(
-                    start, block, vote_round=dom)
-                # Device results stay at the padded bucket shape;
-                # per-vote positions are applied host-side in collect()
-                # (a device gather here would compile per distinct
-                # length).
-                device_parts.append((members, newly,
-                                     slots[members] - start))
+                self._record_board(parts, start, block, bucket, dom)
                 i = j
 
         for cl in sparse_leftover:
-            self._dispatch_sparse(device_parts, slots, cols, rounds, cl)
+            self._dispatch_sparse(parts, slots, cols, rounds, cl)
         if post is not None and post.size:
-            self._dispatch_sparse(device_parts, slots, cols, rounds, post)
+            self._dispatch_sparse(parts, slots, cols, rounds, post)
 
-        dispatch = (slots, rounds, device_parts)
         self._slots, self._cols, self._rounds = [], [], []
         self._ranges = []
-        if self.pipelined:
-            self._inflight.append(dispatch)
-            return []
-        return self.collect(dispatch)
+        self._inflight.append(parts)
+        return []
 
-    def _dispatch_sparse(self, device_parts, slots, cols, rounds,
-                         idx) -> None:
+    def _record_board(self, parts: list, start: int, block: np.ndarray,
+                      bucket: int, rnd: int) -> None:
+        """Record a dense run on the vote board, splitting at the ring
+        end (record_block's no-straddle contract)."""
+        window = self.checker.window
+        room = window - start % window
+        if bucket <= room:
+            newly = self.checker.record_block_async(start, block,
+                                                    vote_round=rnd)
+            parts.append(("block", start, bucket, rnd, newly))
+        else:
+            self._record_board_split(parts, start, block, room, rnd)
+
+    def _record_board_split(self, parts: list, start: int,
+                            block: np.ndarray, room: int,
+                            rnd: int) -> None:
+        """Record a block that straddles the ring end WITHOUT compiling
+        any new kernel width: each piece is decomposed into prewarmed
+        bucket widths, and sub-bucket remainders take the (prewarmed)
+        scatter path. A mid-run XLA compile would stall the event loop
+        for seconds over a remote device link."""
+        self._record_board_bucketed(parts, start, block[:, :room], rnd)
+        rest = block[:, room:]
+        if rest.any():
+            self._record_board_bucketed(parts, start + room,
+                                        np.ascontiguousarray(rest), rnd)
+
+    def _record_board_bucketed(self, parts: list, start: int,
+                               block: np.ndarray, rnd: int) -> None:
+        width = block.shape[1]
+        i = 0
+        while i < width:
+            bucket = next((b for b in reversed(self.dense_buckets)
+                           if b <= width - i), None)
+            if bucket is None:
+                # Remainder narrower than the smallest bucket: scatter.
+                rows, pos = np.nonzero(block[:, i:])
+                if rows.size:
+                    self._dispatch_sparse(
+                        parts, (start + i + pos).astype(np.int64),
+                        rows.astype(np.int32),
+                        np.full(rows.size, rnd, dtype=np.int32),
+                        np.arange(rows.size))
+                return
+            sub = block[:, i:i + bucket]
+            if sub.any():
+                newly = self.checker.record_block_async(
+                    start + i, np.ascontiguousarray(sub), vote_round=rnd)
+                parts.append(("block", start + i, bucket, rnd, newly))
+            i += bucket
+
+    def _dispatch_sparse(self, parts, slots, cols, rounds, idx) -> None:
         """Scatter-path dispatch, chunked so only prewarmed widths run."""
         for at in range(0, idx.size, self.max_chunk):
             chunk = idx[at:at + self.max_chunk]
-            device_parts.append((chunk,
-                                 self.checker.record_and_check_async(
-                                     slots[chunk], cols[chunk],
-                                     rounds[chunk],
-                                     pad_to=(64 if chunk.size <= 64
-                                             else self.max_chunk)),
-                                 np.arange(chunk.size)))
+            parts.append(("votes", slots[chunk], rounds[chunk],
+                          self.checker.record_and_check_async(
+                              slots[chunk], cols[chunk], rounds[chunk],
+                              pad_to=(64 if chunk.size <= 64
+                                      else self.max_chunk)),
+                          chunk.size))
 
     def has_pending(self) -> bool:
         return bool(self._inflight)
@@ -336,19 +629,54 @@ class TpuQuorumTracker(QuorumTracker):
             return None
 
     def collect(self, dispatch) -> list[tuple[int, int]]:
-        """Fetch a dispatch's results (blocking on the device if they
-        are not done yet) and dedup per slot (keeping each slot's first
-        reporting round in dispatch order, as the dict oracle does)."""
-        drain_slots, drain_rounds, device_parts = dispatch
-        hits = np.zeros(len(drain_slots), dtype=bool)
-        for index, mask, positions in device_parts:
-            hits[index] = np.asarray(mask)[positions]
-        hit_idx = np.flatnonzero(hits)
-        if hit_idx.size == 0:
-            return []
-        slots = np.asarray(drain_slots, dtype=np.int64)[hit_idx]
-        _, first = np.unique(slots, return_index=True)
-        sel = hit_idx[np.sort(first)]
-        rounds = np.asarray(drain_rounds, dtype=np.int64)
-        return list(zip(np.asarray(drain_slots, dtype=np.int64)[sel]
-                        .tolist(), rounds[sel].tolist()))
+        """Fetch a dispatch's results (blocking on the device for any
+        part not done yet) and dedup per slot, keeping each slot's
+        first reporting round in part order (as the dict oracle's
+        arrival-order reporting does).
+
+        Parts come in two shapes: ``("block", start, width, round,
+        device_mask)`` -- a per-slot newly-chosen mask from the board;
+        ``("votes", slots, rounds, device_mask, n)`` -- a per-vote mask
+        from the scatter path."""
+        out: list[tuple[int, int]] = []
+        for part in dispatch:
+            kind = part[0]
+            if kind == "block":
+                _, start, width, rnd, mask = part
+                m = np.asarray(mask)[:width]
+                slots = start + np.flatnonzero(m).astype(np.int64)
+                if slots.size:
+                    fresh = self._fresh_mask(slots, rnd)
+                    out.extend(zip(slots[fresh].tolist(),
+                                   (rnd,) * int(fresh.sum())))
+            else:  # "votes"
+                _, vslots, vrounds, mask, n = part
+                m = np.asarray(mask)[:n]
+                hit = np.flatnonzero(m)
+                if hit.size:
+                    # Dedup duplicate slots within the part (keep the
+                    # first, as the per-vote mask reports per vote).
+                    hslots = np.asarray(vslots, dtype=np.int64)[hit]
+                    _, first = np.unique(hslots, return_index=True)
+                    sel = hit[np.sort(first)]
+                    slots = np.asarray(vslots, dtype=np.int64)[sel]
+                    rounds = np.asarray(vrounds, dtype=np.int64)[sel]
+                    fresh = self._fresh_mask(slots, rounds)
+                    out.extend(zip(slots[fresh].tolist(),
+                                   rounds[fresh].tolist()))
+        return out
+
+    def _fresh_mask(self, slots: np.ndarray, rounds) -> np.ndarray:
+        """Vectorized exactly-once filter: True where (slot, round) has
+        not been reported before (within the dedup ring's memory);
+        marks the fresh ones reported. ``slots`` must be unique within
+        the call."""
+        idx = slots % self._dedup_slot.shape[0]
+        dup = (self._dedup_slot[idx] == slots) \
+            & (self._dedup_round[idx] == rounds)
+        fresh = ~dup
+        fi = idx[fresh]
+        self._dedup_slot[fi] = slots[fresh]
+        self._dedup_round[fi] = np.asarray(rounds)[fresh] \
+            if isinstance(rounds, np.ndarray) else rounds
+        return fresh
