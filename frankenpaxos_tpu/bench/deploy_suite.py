@@ -65,9 +65,10 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     ``host`` (default a LocalHost) is the machine the roles launch on:
     pass a ``bench.remote.RemoteHost`` to deploy through its shell
     (ssh, or the loopback stand-in) -- the reference's SSH deployment
-    seam (benchmarks/host.py:36-50). The config/log paths are local
-    paths, so a remote host must share them (ssh-to-localhost or a
-    shared filesystem; see bench/remote.py).
+    seam (benchmarks/host.py:36-50). Config/log paths pass through
+    unchanged on shared filesystems; a RemoteHost with
+    ``staging_dir``/``local_root`` set ships them for disjoint
+    filesystems (see bench/remote.py).
     """
     protocol = get_protocol(protocol_name)
     host = host or LocalHost()
@@ -111,13 +112,14 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     deadline = time.time() + ready_timeout_s
     pending = set(labels)
     while pending and time.time() < deadline:
-        for label in list(pending):
-            try:
-                with open(bench.abspath(f"{label}.log")) as f_log:
-                    if "listening" in f_log.read():
-                        pending.discard(label)
-            except OSError:
-                pass
+        # Through the host (one round-trip for ALL pending labels) so
+        # remote logs -- possibly on a disjoint filesystem, see
+        # bench/remote.py RemoteHost -- are readable.
+        ready = host.grep_ready(
+            [bench.abspath(f"{label}.log") for label in pending],
+            "listening")
+        pending -= {label for label in pending
+                    if bench.abspath(f"{label}.log") in ready}
         time.sleep(0.1)
     if pending:
         bench.cleanup()

@@ -66,6 +66,20 @@ class LocalHost:
               env: Optional[dict] = None) -> Proc:
         return Proc(args, out_path, env=env)
 
+    def read_output(self, path: str) -> str:
+        """Current contents of a launched process' output file (the
+        ready-wait seam; RemoteHost reads through its shell instead)."""
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def grep_ready(self, paths: Sequence[str], needle: str) -> set:
+        """Which of ``paths`` currently contain ``needle`` (RemoteHost
+        answers this in one shell round-trip for the whole set)."""
+        return {p for p in paths if needle in self.read_output(p)}
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -81,6 +95,8 @@ class BenchmarkDirectory:
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.procs: list[Proc] = []
+        #: label -> Proc, for per-role accounting (CPU-time breakdowns).
+        self.labeled_procs: dict[str, Proc] = {}
         # label -> /metrics port, filled by deploy_suite.launch_roles
         # when prometheus=True.
         self.prometheus_ports: dict[str, int] = {}
@@ -98,7 +114,36 @@ class BenchmarkDirectory:
               args: Sequence[str], env: Optional[dict] = None) -> Proc:
         proc = host.popen(args, self.abspath(f"{label}.log"), env=env)
         self.procs.append(proc)
+        self.labeled_procs[label] = proc
         return proc
+
+    def role_cpu_seconds(self) -> dict:
+        """Per-role CPU time (user+sys, /proc/<pid>/stat) for every
+        still-running local role process. Call BEFORE cleanup(). The
+        per-stage accounting behind the compartmentalization
+        projection (bench/coupled.py): on a one-core host the 4-8x
+        decoupling win cannot show up in wall-clock, but the
+        parallelizable fraction is exactly this breakdown."""
+        tick = os.sysconf("SC_CLK_TCK")
+        out = {}
+        for label, proc in self.labeled_procs.items():
+            if not isinstance(proc, Proc):
+                # RemoteProc.pid() is a REMOTE pid: /proc/<it>/stat on
+                # the launcher machine would describe some unrelated
+                # local process. Per-role CPU accounting is
+                # local-launch only.
+                continue
+            pid = proc.pid()
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    fields = f.read().rsplit(") ", 1)[-1].split()
+                # utime, stime are fields 14,15 (1-indexed) = 11,12
+                # after the (comm) split leaves state at index 0.
+                out[label] = round(
+                    (int(fields[11]) + int(fields[12])) / tick, 3)
+            except (OSError, IndexError, ValueError):
+                pass
+        return out
 
     def cleanup(self) -> None:
         for proc in self.procs:

@@ -184,9 +184,10 @@ def run_benchmark(bench: BenchmarkDirectory,
     elapsed = time.time() - start
 
     role_metrics = _scrape_role_metrics(bench, input)
+    role_cpu = bench.role_cpu_seconds()
     bench.cleanup()
     return _write_stats(bench, input, samples, elapsed, role_metrics,
-                        input.workload)
+                        input.workload, role_cpu)
 
 
 def _run_with_client_procs(bench: BenchmarkDirectory,
@@ -246,10 +247,11 @@ def _run_with_client_procs(bench: BenchmarkDirectory,
                     samples[kind][0].append(float(latency))
                     samples[kind][1].append(float(start))
         role_metrics = _scrape_role_metrics(bench, input)
+        role_cpu = bench.role_cpu_seconds()
     finally:
         bench.cleanup()
     return _write_stats(bench, input, samples, input.duration_s,
-                        role_metrics, workload)
+                        role_metrics, workload, role_cpu)
 
 
 def _scrape_role_metrics(bench: BenchmarkDirectory,
@@ -273,7 +275,7 @@ def _scrape_role_metrics(bench: BenchmarkDirectory,
 
 def _write_stats(bench: BenchmarkDirectory, input: MultiPaxosInput,
                  samples: dict, duration_s: float, role_metrics: dict,
-                 workload) -> dict:
+                 workload, role_cpu: "dict | None" = None) -> dict:
     """Aggregate per-kind samples into the reference-shaped results
     (benchmark.py:308-341), tagged with the input and role metrics."""
     from frankenpaxos_tpu.bench.workload import workload_to_dict
@@ -293,5 +295,7 @@ def _write_stats(bench: BenchmarkDirectory, input: MultiPaxosInput,
         stats["input"]["workload"] = workload_to_dict(workload)
     if role_metrics:
         stats["role_metrics"] = role_metrics
+    if role_cpu:
+        stats["role_cpu_seconds"] = role_cpu
     bench.write_json("results.json", stats)
     return stats

@@ -37,8 +37,12 @@ def main(argv=None) -> dict:
                         help="closed loops per client process")
     parser.add_argument("--duration", type=float, default=3.0)
     parser.add_argument("--read_fraction", type=float, default=0.95)
-    parser.add_argument("--read_consistency", default="eventual",
-                        choices=["linearizable", "sequential", "eventual"])
+    parser.add_argument("--read_consistency", nargs="+",
+                        default=["linearizable", "eventual"],
+                        choices=["linearizable", "sequential", "eventual"],
+                        help="consistency levels to sweep (the "
+                             "linearizable rows exercise the MaxSlot "
+                             "quorum-read path, Client.scala:851-933)")
     parser.add_argument("--suite_dir", default=None)
     parser.add_argument("--out", default=None)
     args = parser.parse_args(argv)
@@ -49,38 +53,56 @@ def main(argv=None) -> dict:
         num_keys=16, read_fraction=args.read_fraction)
 
     rows = []
-    for num_replicas in args.replicas:
-        stats = run_benchmark(
-            suite.benchmark_directory(),
-            MultiPaxosInput(
-                num_replicas=num_replicas,
-                num_clients=args.num_clients,
-                client_procs=args.client_procs,
-                duration_s=args.duration,
-                workload=workload,
-                read_consistency=args.read_consistency,
-                prometheus=True))
-        # Per-replica served reads from the scraped role metrics: the
-        # Evelyn scaling mechanism is reads spreading over replicas
-        # (each serves ~1/N), independent of this host's core count.
-        per_replica_reads = {
-            label: metrics.get(
-                "multipaxos_replica_executed_reads_total", 0.0)
-            for label, metrics in stats.get("role_metrics", {}).items()
-            if label.startswith("replica_")}
-        row = {
-            "num_replicas": num_replicas,
-            "read_throughput": stats.get("read.start_throughput_1s.p90",
-                                         stats.get("read.throughput_mean")),
-            "read_latency_median_ms": stats.get("read.latency.median_ms"),
-            "write_throughput": stats.get(
-                "write.start_throughput_1s.p90",
-                stats.get("write.throughput_mean")),
-            "num_requests": stats["num_requests"],
-            "per_replica_reads": per_replica_reads,
-        }
-        rows.append(row)
-        print(json.dumps(row))
+    for read_consistency in args.read_consistency:
+        for num_replicas in args.replicas:
+            stats = run_benchmark(
+                suite.benchmark_directory(),
+                MultiPaxosInput(
+                    num_replicas=num_replicas,
+                    num_clients=args.num_clients,
+                    client_procs=args.client_procs,
+                    duration_s=args.duration,
+                    workload=workload,
+                    read_consistency=read_consistency,
+                    prometheus=True))
+            role_metrics = stats.get("role_metrics", {})
+            # Per-replica served reads from the scraped role metrics:
+            # the Evelyn scaling mechanism is reads spreading over
+            # replicas (each serves ~1/N), independent of this host's
+            # core count.
+            per_replica_reads = {
+                label: metrics.get(
+                    "multipaxos_replica_executed_reads_total", 0.0)
+                for label, metrics in role_metrics.items()
+                if label.startswith("replica_")}
+            # Per-acceptor MaxSlot requests: the linearizable quorum
+            # read fans out to acceptors BEFORE reading at a replica
+            # (Client.scala:851-933, Acceptor.scala:222-237); eventual
+            # reads never touch acceptors, so these counters make the
+            # fan-out visible per consistency level.
+            per_acceptor_max_slot = {
+                label: metrics.get(
+                    'multipaxos_acceptor_requests_total'
+                    '{type="MaxSlotRequest"}', 0.0)
+                for label, metrics in role_metrics.items()
+                if label.startswith("acceptor_")}
+            row = {
+                "read_consistency": read_consistency,
+                "num_replicas": num_replicas,
+                "read_throughput": stats.get(
+                    "read.start_throughput_1s.p90",
+                    stats.get("read.throughput_mean")),
+                "read_latency_median_ms": stats.get(
+                    "read.latency.median_ms"),
+                "write_throughput": stats.get(
+                    "write.start_throughput_1s.p90",
+                    stats.get("write.throughput_mean")),
+                "num_requests": stats["num_requests"],
+                "per_replica_reads": per_replica_reads,
+                "per_acceptor_max_slot_requests": per_acceptor_max_slot,
+            }
+            rows.append(row)
+            print(json.dumps(row))
 
     import os
 
@@ -92,8 +114,10 @@ def main(argv=None) -> dict:
                  "(the Evelyn mechanism). Aggregate throughput only "
                  "rises with N when replicas have their own cores/hosts; "
                  "on a single-core host all processes time-share one "
-                 "CPU."),
-        "read_consistency": args.read_consistency,
+                 "CPU. The linearizable rows run the MaxSlot quorum "
+                 "path (visible as per_acceptor_max_slot_requests > 0); "
+                 "the eventual rows never touch acceptors on reads."),
+        "read_consistency_levels": args.read_consistency,
         "read_fraction": args.read_fraction,
         "client_procs": args.client_procs,
         "num_clients": args.num_clients,

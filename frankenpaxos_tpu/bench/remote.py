@@ -18,13 +18,14 @@ seam is a pluggable *shell*:
 surface as :class:`frankenpaxos_tpu.bench.harness.LocalHost`, so
 ``BenchmarkDirectory``/``launch_roles`` deploy over it unchanged.
 
-Scope: ``launch_roles`` reads role logs / writes configs at LOCAL
-paths, so deploying through a RemoteHost requires those paths to be
-visible on the launch target -- ssh-to-localhost (the reference's own
-smoke topology, scripts/benchmark_smoke.sh:5-18) or a shared
-filesystem (the reference's EC2 setups mount one). Fully disjoint
-filesystems would additionally need config/log shipping, which this
-seam does not do.
+Scope: by default ``launch_roles`` reads role logs / writes configs at
+LOCAL paths, matching the reference's topologies (ssh-to-localhost,
+scripts/benchmark_smoke.sh:5-18, or a shared EC2 filesystem). For
+fully DISJOINT filesystems, construct the RemoteHost with
+``staging_dir`` + ``local_root``: configs ship to the staging dir
+before launch, role logs are read through the shell during the
+ready-wait, and ``fetch_outputs()`` pulls outputs home afterwards (no
+NFS/EFS required).
 
 A launched command is wrapped as::
 
@@ -62,6 +63,47 @@ class Shell(abc.ABC):
     def run(self, command: str, timeout: float = 10.0
             ) -> tuple[int, str]:
         """Run ``command`` to completion; (returncode, stdout)."""
+
+    def put(self, local_path: str, remote_path: str) -> None:
+        """Ship a local file to the shell's filesystem (scp analog;
+        the reference ships configs to EC2 the same way,
+        benchmarks/README.md:22-27). Creates parent dirs."""
+        import os
+
+        parent = os.path.dirname(remote_path) or "."
+        with open(local_path, "rb") as f:
+            data = f.read()
+        self._write_bytes(remote_path, parent, data)
+
+    def get(self, remote_path: str, local_path: str) -> bool:
+        """Fetch a remote file into ``local_path``; False if absent."""
+        import os
+
+        rc, out = self.run(
+            f"base64 < {shlex.quote(remote_path)} 2>/dev/null",
+            timeout=60.0)
+        if rc != 0:
+            return False
+        import base64
+
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(base64.b64decode(out))
+        return True
+
+    def _write_bytes(self, remote_path: str, parent: str,
+                     data: bytes) -> None:
+        import base64
+
+        encoded = base64.b64encode(data).decode()
+        # base64 keeps arbitrary bytes intact through the shell pipe
+        # (ssh or bash -c), no stdin plumbing needed.
+        rc, _ = self.run(
+            f"mkdir -p {shlex.quote(parent)} && "
+            f"echo {shlex.quote(encoded)} | base64 -d > "
+            f"{shlex.quote(remote_path)}", timeout=60.0)
+        if rc != 0:
+            raise RuntimeError(f"failed to ship {remote_path}")
 
 
 class LoopbackShell(Shell):
@@ -183,21 +225,117 @@ class RemoteProc:
                 self._driver.kill()
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass
 class RemoteHost:
     """Drop-in for :class:`LocalHost` that launches through a shell
-    (host.py:36-50)."""
+    (host.py:36-50).
+
+    With ``staging_dir`` + ``local_root`` set, the host works across
+    DISJOINT filesystems (no EFS/NFS needed, closing the gap
+    docs/PARITY.md used to admit): every launch argument and output
+    path under ``local_root`` is remapped under ``staging_dir``;
+    arguments naming files that exist locally (configs) are SHIPPED to
+    the staging dir before launch, and :meth:`fetch_outputs` pulls
+    every remapped path that materialized remotely (role logs, client
+    CSVs) back under ``local_root`` afterwards. Without them, paths
+    pass through unchanged (ssh-to-localhost / shared filesystem, the
+    reference's default topologies)."""
 
     shell: Shell
     ip: str = "127.0.0.1"
     # Remote working directory for launched role processes (the repo
     # checkout on the remote machine); None inherits the login dir.
     cwd: Optional[str] = None
+    # Remote scratch dir for shipped inputs + outputs (disjoint-fs
+    # mode); pairs with local_root.
+    staging_dir: Optional[str] = None
+    # The local directory whose paths get remapped into staging_dir.
+    local_root: Optional[str] = None
+
+    def __post_init__(self):
+        self._mapped: dict[str, str] = {}  # local path -> remote path
+        self._shipped: set[tuple[str, float]] = set()  # (path, mtime)
+        self._inputs: set[str] = set()  # shipped inputs: not fetched back
+
+    def _map(self, path: str) -> str:
+        import os
+
+        if (self.staging_dir is None or self.local_root is None
+                or not path.startswith(self.local_root.rstrip("/") + "/")):
+            return path
+        rel = os.path.relpath(path, self.local_root)
+        remote = os.path.join(self.staging_dir, rel)
+        self._mapped[path] = remote
+        return remote
 
     def popen(self, args: Sequence[str], out_path: str,
               env: Optional[dict] = None) -> RemoteProc:
-        return RemoteProc(self.shell, args, out_path, env=env,
-                          cwd=self.cwd)
+        import os
+
+        mapped_args = []
+        for arg in args:
+            arg = str(arg)
+            mapped = self._map(arg)
+            if mapped != arg and os.path.isfile(arg):
+                # Ship inputs (configs) once per content version; every
+                # role passes the same --config, so dedup by mtime.
+                key = (arg, os.path.getmtime(arg))
+                if key not in self._shipped:
+                    self.shell.put(arg, mapped)
+                    self._shipped.add(key)
+                self._inputs.add(arg)
+            mapped_args.append(mapped)
+        remote_out = self._map(out_path)
+        if remote_out != out_path:
+            # The wrapper redirects into this dir before anything else
+            # could create it; make it exist up front.
+            import os as _os
+
+            parent = _os.path.dirname(remote_out) or "."
+            self.shell.run(f"mkdir -p {shlex.quote(parent)}")
+        return RemoteProc(self.shell, mapped_args, remote_out,
+                          env=env, cwd=self.cwd)
+
+    def read_output(self, path: str) -> str:
+        """Read a (possibly remapped) output file's current contents --
+        the ready-wait seam (launch_roles polls role logs). Never
+        raises: a stalled shell reads as 'nothing yet' so the caller's
+        deadline logic (and its cleanup) stays in charge."""
+        remote = self._mapped.get(path, self._map(path))
+        try:
+            rc, out = self.shell.run(
+                f"cat {shlex.quote(remote)} 2>/dev/null")
+        except (OSError, subprocess.TimeoutExpired):
+            return ""
+        return out if rc == 0 else ""
+
+    def grep_ready(self, paths: Sequence[str], needle: str) -> set:
+        """Which of ``paths`` currently contain ``needle`` -- ONE shell
+        round-trip for the whole set (the ready-wait would otherwise
+        spawn one ssh per pending role per poll tick)."""
+        remotes = {self._mapped.get(p, self._map(p)): p for p in paths}
+        if not remotes:
+            return set()
+        quoted = " ".join(shlex.quote(r) for r in remotes)
+        try:
+            rc, out = self.shell.run(
+                f"grep -l -s -F {shlex.quote(needle)} {quoted}; true")
+        except (OSError, subprocess.TimeoutExpired):
+            return set()
+        return {remotes[line] for line in out.splitlines()
+                if line in remotes}
+
+    def fetch_outputs(self) -> int:
+        """Pull every remapped OUTPUT path that exists remotely back to
+        its local home (shipped inputs are skipped); returns how many
+        files landed."""
+        fetched = 0
+        for local, remote in sorted(set(self._mapped.items())):
+            if local in self._inputs:
+                continue
+            if self.shell.get(remote, local):
+                fetched += 1
+        return fetched
 
 
 @dataclasses.dataclass(frozen=True)

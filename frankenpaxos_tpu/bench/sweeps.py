@@ -17,6 +17,12 @@ Families (reference analog in parens):
   * ``read_scale``   -- read throughput vs replica count at a
     read-heavy mix (vldb21_evelyn; wraps bench/read_scale.py's
     mechanism).
+  * ``nsdi_fig1``    -- EPaxos vs MultiPaxos vs SimpleBPaxos LT
+    (nsdi/fig1_lt_*_results.csv), the generalized-protocol half of
+    the baseline table.
+  * ``nsdi_fig2``    -- SimpleBPaxos vs coupled ("super") BPaxos
+    ablation (nsdi/fig2_ablation_superbpaxos_results.csv,
+    benchmarks/simplebpaxos/nsdi_fig2_ablation.py:1-112).
 
 Usage::
 
@@ -142,6 +148,27 @@ def read_scale(suite: SuiteDirectory, points, duration_s: float) -> list:
     return rows
 
 
+def nsdi_fig1(suite: SuiteDirectory, points, duration_s: float) -> list:
+    """EPaxos vs MultiPaxos vs SimpleBPaxos latency-throughput (the
+    NSDI'21 fig1 comparison)."""
+    rows = []
+    for protocol in ("epaxos", "multipaxos", "simplebpaxos"):
+        rows += _protocol_series(suite, protocol, protocol, points,
+                                 duration_s)
+    return rows
+
+
+def nsdi_fig2(suite: SuiteDirectory, points, duration_s: float) -> list:
+    """SimpleBPaxos vs coupled ("super") BPaxos: the NSDI'21 fig2
+    ablation -- all five roles colocated in one process vs
+    compartmentalized."""
+    rows = _protocol_series(suite, "simplebpaxos", "simplebpaxos",
+                            points, duration_s)
+    rows += _protocol_series(suite, "superbpaxos", "simplebpaxos",
+                             points, duration_s, supernode=True)
+    return rows
+
+
 FAMILIES = {
     "eurosys_fig1": lambda suite, points, d: eurosys_fig(
         "multipaxos", suite, points, d),
@@ -149,6 +176,8 @@ FAMILIES = {
         "mencius", suite, points, d),
     "matchmaker_lt": matchmaker_lt,
     "read_scale": read_scale,
+    "nsdi_fig1": nsdi_fig1,
+    "nsdi_fig2": nsdi_fig2,
 }
 
 

@@ -122,3 +122,49 @@ def test_cluster_file_rejects_malformed():
         Cluster({"1": {"leaders": "not-a-list"}})
     with pytest.raises(ValueError):
         Cluster({"1": ["not", "an", "object"]})
+
+
+def test_shell_put_get_roundtrip(tmp_path):
+    shell = LoopbackShell()
+    src = tmp_path / "src" / "config.json"
+    src.parent.mkdir()
+    payload = b'{"x": 1}\x00\xffbinary-safe'
+    src.write_bytes(payload)
+    remote = tmp_path / "remote" / "nested" / "config.json"
+    shell.put(str(src), str(remote))  # creates parents
+    assert remote.read_bytes() == payload
+    back = tmp_path / "back" / "config.json"
+    assert shell.get(str(remote), str(back))
+    assert back.read_bytes() == payload
+    assert not shell.get(str(tmp_path / "absent"), str(back))
+
+
+def test_disjoint_filesystem_deployment(tmp_path):
+    """VERDICT r3 #7: a deployment where the 'remote' reads nothing
+    from the launcher's directory -- configs ship to a remote staging
+    dir, role logs are read through the shell during the ready-wait,
+    and outputs are fetched back after the run."""
+    from frankenpaxos_tpu.bench.deploy_suite import run_protocol_smoke
+
+    launcher = tmp_path / "launcher"   # the only dir the harness writes
+    staging = tmp_path / "remote_machine"  # the only dir roles touch
+    launcher.mkdir()
+    host = RemoteHost(LoopbackShell(), cwd=REPO_ROOT,
+                      staging_dir=str(staging), local_root=str(launcher))
+    bench = BenchmarkDirectory(str(launcher / "echo"))
+    stats = run_protocol_smoke(bench, "echo", host=host)
+    assert len(stats["latency_ms"]) == 3
+
+    # Every launched role's command line references ONLY staging paths:
+    # the remote machine never opens a launcher-dir file.
+    for proc in bench.procs:
+        if hasattr(proc, "_command"):
+            assert str(launcher) not in proc._command, proc._command
+            assert str(staging) in proc._command
+
+    # Outputs (role logs) come home on demand; shipped inputs (the
+    # config) are NOT pointlessly re-downloaded.
+    fetched = host.fetch_outputs()
+    assert fetched >= 1  # the server role log at least
+    logs = list((launcher / "echo").glob("*.log"))
+    assert logs and any("listening" in p.read_text() for p in logs)

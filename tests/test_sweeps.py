@@ -18,7 +18,8 @@ from frankenpaxos_tpu.bench.sweeps import (
 
 def test_families_registry():
     assert set(FAMILIES) == {"eurosys_fig1", "eurosys_fig2",
-                             "matchmaker_lt", "read_scale"}
+                             "matchmaker_lt", "read_scale",
+                             "nsdi_fig1", "nsdi_fig2"}
 
 
 def test_csv_and_lt_plot(tmp_path):
