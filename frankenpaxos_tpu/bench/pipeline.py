@@ -86,7 +86,8 @@ def _axis_index(axis: Optional[str]) -> jax.Array:
 
 
 def steady_state_step(state: PipelineState, i: jax.Array, *,
-                      block_size: int, masks: np.ndarray, threshold: int,
+                      block_size: int, masks: np.ndarray,
+                      thresholds, combine_any: bool = True,
                       group_axis: Optional[str] = None,
                       slot_axis: Optional[str] = None,
                       group_shards: int = 1,
@@ -97,6 +98,14 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     the stragglers), so the window holds ~2 blocks of in-flight
     vote-collection at the frontier plus the chosen/executing tail
     behind it.
+
+    The quorum predicate is the general factored form
+    (quorums/spec.py): ``masks`` is ``[G, N]`` over the global
+    acceptors, ``thresholds`` is ``[G]``, and per-slot satisfaction
+    combines over the G mask groups with any (``combine_any=True``) or
+    all. SimpleMajority is G=1; a Grid write spec is one mask per row
+    with threshold 1 combined with ALL ("one vote in every row",
+    quorums/Grid.scala:5-57).
 
     ``block_size`` and ``masks`` are GLOBAL (whole-mesh) quantities; when
     called inside ``shard_map``, ``state`` holds this shard's local view
@@ -110,10 +119,11 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     b_local = block_size // slot_shards
     assert w_local % b_local == 0, (
         f"local window {w_local} must hold whole {b_local}-slot blocks")
-    masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [1, n_global]
-    assert masks_d.shape[0] == 1, (
-        "steady_state_step evaluates single-group (majority-style) specs; "
-        f"got {masks_d.shape[0]} mask rows")
+    masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [G, n_global]
+    thresholds_d = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
+    assert thresholds_d.shape == (masks_d.shape[0],), (
+        f"{thresholds_d.shape} thresholds for {masks_d.shape[0]} mask "
+        f"groups")
     assert masks_d.shape[1] == group_shards * n_local, (
         f"masks cover {masks_d.shape[1]} acceptors but the mesh holds "
         f"{group_shards} x {n_local}")
@@ -148,9 +158,10 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
         block = jax.lax.dynamic_slice(votes, (0, start),
                                       (n_local, b_local)) | arrivals
         votes = jax.lax.dynamic_update_slice(votes, block, (0, start))
-        counts = _psum((masks_local @ block.astype(jnp.int32))[0],
-                       group_axis)                          # [b_local]
-        hit = counts >= threshold
+        counts = _psum(masks_local @ block.astype(jnp.int32),
+                       group_axis)                       # [G, b_local]
+        satisfied = counts >= thresholds_d[:, None]
+        hit = satisfied.any(0) if combine_any else satisfied.all(0)
         old = jax.lax.dynamic_slice(chosen, (start,), (b_local,))
         newly = hit & ~old
         chosen = jax.lax.dynamic_update_slice(chosen, hit | old, (start,))
@@ -189,16 +200,19 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
                          committed, exec_wm)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4),
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5),
                    donate_argnums=(0,))
 def run_steps(state: PipelineState, iters: int, block_size: int,
-              masks_t: tuple, threshold: int) -> PipelineState:
+              masks_t: tuple, thresholds_t: tuple,
+              combine_any: bool = True) -> PipelineState:
     """``iters`` drains in one dispatch (the bench hot loop)."""
     masks = np.asarray(masks_t, dtype=np.int32)
+    thresholds = np.asarray(thresholds_t, dtype=np.int32)
 
     def body(i, s):
         return steady_state_step(s, i, block_size=block_size, masks=masks,
-                                 threshold=threshold)
+                                 thresholds=thresholds,
+                                 combine_any=combine_any)
 
     return jax.lax.fori_loop(0, iters, body, state)
 
@@ -226,7 +240,7 @@ def _shard_map_fn():
 
 
 def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
-                      threshold: int):
+                      thresholds, combine_any: bool = True):
     """Jit ``steady_state_step`` under shard_map over ``mesh``.
 
     ``mesh`` must have axes ``("group", "slot")``. Returns
@@ -243,7 +257,8 @@ def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
     slot_shards = mesh.shape["slot"]
     step = functools.partial(
         steady_state_step, block_size=block_size, masks=masks,
-        threshold=threshold, group_axis="group", slot_axis="slot",
+        thresholds=thresholds, combine_any=combine_any,
+        group_axis="group", slot_axis="slot",
         group_shards=group_shards, slot_shards=slot_shards)
 
     spec_tree = PipelineState(

@@ -75,6 +75,15 @@ class QuorumSpec:
     def num_nodes(self) -> int:
         return self.masks.shape[1]
 
+    def as_arrays(self) -> "tuple[np.ndarray, np.ndarray, bool]":
+        """``(masks [G, N] int32, thresholds [G] int32, combine_any)``
+        -- the factored predicate form every device kernel consumes
+        (ops/quorum, bench/pipeline); one conversion point instead of
+        hand-rolled triples at each call site."""
+        return (np.asarray(self.masks, dtype=np.int32),
+                np.asarray(self.thresholds, dtype=np.int32),
+                self.combine == ANY)
+
     def column_of(self, node_id: int) -> int:
         return self.universe.index(node_id)
 
