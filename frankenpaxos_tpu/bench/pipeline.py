@@ -87,7 +87,7 @@ def _axis_index(axis: Optional[str]) -> jax.Array:
 
 def steady_state_step(state: PipelineState, i: jax.Array, *,
                       block_size: int, masks: np.ndarray,
-                      thresholds, combine_any: bool = True,
+                      thresholds, combine_any: bool,
                       group_axis: Optional[str] = None,
                       slot_axis: Optional[str] = None,
                       group_shards: int = 1,
@@ -204,7 +204,7 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
                    donate_argnums=(0,))
 def run_steps(state: PipelineState, iters: int, block_size: int,
               masks_t: tuple, thresholds_t: tuple,
-              combine_any: bool = True) -> PipelineState:
+              combine_any: bool) -> PipelineState:
     """``iters`` drains in one dispatch (the bench hot loop)."""
     masks = np.asarray(masks_t, dtype=np.int32)
     thresholds = np.asarray(thresholds_t, dtype=np.int32)
@@ -240,7 +240,7 @@ def _shard_map_fn():
 
 
 def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
-                      thresholds, combine_any: bool = True):
+                      thresholds, combine_any: bool):
     """Jit ``steady_state_step`` under shard_map over ``mesh``.
 
     ``mesh`` must have axes ``("group", "slot")``. Returns

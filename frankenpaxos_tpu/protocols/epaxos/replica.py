@@ -237,8 +237,14 @@ class EPaxosReplica(Actor):
 
     def _make_repeating_timer(self, name: str, period_s: float, body) -> object:
         def fire():
-            body()
+            # Re-arm BEFORE the body: a body that transitions state
+            # stops this timer via _stop_timers, and re-arming after it
+            # would resurrect a stopped timer -- the defaultToSlowPath
+            # timer then fires in the Accepting state and trips the
+            # fatal check (found by the 500x250 soak,
+            # tests/soak.py epaxos/f1).
             timer.start()
+            body()
 
         timer = self.timer(name, period_s, fire)
         timer.start()

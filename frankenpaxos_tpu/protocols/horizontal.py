@@ -303,6 +303,17 @@ class HorizontalLeader(Actor):
         else:
             self.logger.fatal(f"unexpected leader message {message!r}")
 
+    def _alpha_overflow(self, chunk: _Chunk) -> bool:
+        """At most alpha commands may be pending beyond the chosen
+        watermark (horizontal/Leader.scala:638-646): a Configuration
+        chosen at slot s governs slot s + alpha, so proposing past
+        chosen_watermark + alpha could land in a chunk whose
+        configuration is not yet known -- a later chunk activation
+        would then re-propose the slot under a different quorum system
+        and two values could be chosen for it (found by the 500x250
+        soak, horizontal/f1 seed 475: replica logs diverged)."""
+        return chunk.phase[1] >= self.chosen_watermark + self.config.alpha
+
     def _handle_client_request(self, src: Address,
                                request: ClientRequest) -> None:
         if not self.active:
@@ -310,6 +321,8 @@ class HorizontalLeader(Actor):
         chunk = self._active_chunk()
         if chunk is None or chunk.phase[0] != "phase2":
             return  # phase 1 pending; client will resend
+        if self._alpha_overflow(chunk):
+            return  # dropped; the client resends (Leader.scala:643-646)
         self._propose(chunk, request.command)
 
     def _handle_reconfigure(self, src: Address,
@@ -321,6 +334,8 @@ class HorizontalLeader(Actor):
         chunk = self._active_chunk()
         if chunk is None or chunk.phase[0] != "phase2":
             return
+        if self._alpha_overflow(chunk):
+            return  # dropped; the driver retries reconfigurations
         self._propose(chunk, Configuration(reconfigure.quorum_system))
 
     def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:

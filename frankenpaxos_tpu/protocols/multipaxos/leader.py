@@ -168,7 +168,19 @@ class Leader(Actor):
         single reduction).
         """
         slots = range(self.chosen_watermark, max_slot + 1)
+        # Non-flexible mode partitions slots over acceptor groups
+        # (slot % G owns the slot); in FLEXIBLE mode the "groups" are
+        # grid ROWS -- every acceptor votes on every slot, so recovery
+        # must scan ALL Phase1bs for every slot. Applying the
+        # partitioning rule to a grid dropped reported votes whose
+        # acceptor sat in the "wrong" row and recovered Noop over a
+        # chosen value (found by the 500x250 soak, multipaxos/f1-grid
+        # seed 493: replica logs diverged).
         if self.options.phase1_backend != "tpu":
+            if self.config.flexible:
+                all_phase1bs = [p for group in phase1.phase1bs
+                                for p in group.values()]
+                return [self._safe_value(all_phase1bs, s) for s in slots]
             return [
                 self._safe_value(
                     phase1.phase1bs[s % self.config.num_acceptor_groups]
@@ -199,7 +211,10 @@ class Leader(Actor):
                 for info in phase1b.info:
                     if not (self.chosen_watermark <= info.slot <= max_slot):
                         continue
-                    if info.slot % num_groups != group_index:
+                    # Slot-partitioning filter only in non-flexible
+                    # mode (see the host path above).
+                    if (not self.config.flexible
+                            and info.slot % num_groups != group_index):
                         continue
                     vid = id_by_value.get(info.vote_value)
                     if vid is None:

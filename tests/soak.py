@@ -189,15 +189,20 @@ def run_soak(num_runs: int = 500, run_length: int = 250, seed: int = 0,
         if only and only not in name:
             continue
         t0 = time.time()
-        failure = Simulator(factory(), run_length=run_length,
-                            num_runs=num_runs, minimize=True).run(seed=seed)
+        try:
+            failure = Simulator(factory(), run_length=run_length,
+                                num_runs=num_runs,
+                                minimize=True).run(seed=seed)
+            failure = str(failure) if failure is not None else None
+        except Exception as e:  # a crash IS a soak finding, not an abort
+            failure = f"crash: {type(e).__name__}: {e}"
         row = {
             "config": name,
             "num_runs": num_runs,
             "run_length": run_length,
             "seed": seed,
             "seconds": round(time.time() - t0, 1),
-            "failure": str(failure) if failure is not None else None,
+            "failure": failure,
         }
         rows.append(row)
         print(json.dumps(row), flush=True)
