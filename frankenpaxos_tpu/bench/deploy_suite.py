@@ -133,7 +133,7 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
                        state_machine: str = "AppendLog",
                        overrides: "dict[str, str] | None" = None,
                        command_timeout_s: float = 30.0,
-                       host=None) -> dict:
+                       host=None, prometheus: bool = False) -> dict:
     """Deploy ``protocol_name`` over localhost TCP and commit
     ``num_commands`` commands through it. ``host`` launches the roles
     on another machine (see ``launch_roles``)."""
@@ -149,7 +149,8 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
     t0 = time.time()
     labels = launch_roles(bench, protocol_name, config_path, config,
                           state_machine=state_machine,
-                          overrides=overrides, host=host)
+                          overrides=overrides, host=host,
+                          prometheus=prometheus)
     ready_s = time.time() - t0
 
     # In-process client over real TCP. A short resend period rides out

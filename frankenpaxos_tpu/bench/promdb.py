@@ -1,0 +1,248 @@
+"""An in-process Prometheus: periodic /metrics scraping into a tiny
+TSDB plus a PromQL subset queried into pandas.
+
+The reference spins a REAL Prometheus server per benchmark and queries
+PromQL through its HTTP API into DataFrames
+(benchmarks/prometheus.py:10-132, ``PrometheusQueryer.query`` -> a
+time-indexed DataFrame with one frozenset-labeled column per series).
+This environment has no prometheus binary, so this module provides the
+same query surface over samples the harness scrapes itself:
+
+    db = MetricsDB(scrape_interval_s=0.25)
+    db.start({"replica_0": 9001, "replica_1": 9002})
+    ... drive load ...
+    db.stop()
+    df = db.query('rate(multipaxos_replica_executed_commands_total[2s])')
+    df = db.query('sum(rate(foo_total[2s]))')
+    df = db.query('sum by (job) (rate(foo_total[2s]))')
+
+Query results mirror the reference's shape: a DataFrame indexed by
+sample time whose columns are ``frozenset({("__name__", name),
+("job", label), ...})``.
+
+Supported PromQL subset (the pieces the reference's benchmarks use):
+
+  * instant/range selectors: ``name`` or ``name{label="v", ...}``
+    (returns every collected sample, like the reference's ``up[24h]``);
+  * ``rate(selector[Ns])`` over counters, with Prometheus-style
+    counter-reset handling;
+  * ``sum(...)``, ``avg(...)``, ``max(...)``, ``min(...)``, optionally
+    ``by (label, ...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+Labels = frozenset  # of (key, value) pairs
+
+_SELECTOR = re.compile(
+    r"^\s*(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<matchers>[^}]*)\})?"
+    r"(?:\[(?P<window>\d+(?:\.\d+)?)(?P<unit>ms|s|m|h)\])?\s*$")
+_AGG = re.compile(
+    r"^\s*(?P<op>sum|avg|max|min)\s*"
+    r"(?:by\s*\((?P<by>[^)]*)\)\s*)?"
+    r"\((?P<inner>.*)\)\s*$", re.DOTALL)
+_RATE = re.compile(r"^\s*rate\s*\((?P<inner>.*)\)\s*$", re.DOTALL)
+_MATCHER = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"([^"]*)"')
+_SCRAPED_KEY = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)(?:\{(?P<labels>.*)\})?$")
+
+_UNIT_S = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def _parse_scraped_key(key: str, job: str) -> Optional[Labels]:
+    m = _SCRAPED_KEY.match(key)
+    if m is None:
+        return None
+    labels = [("__name__", m.group("name")), ("job", job)]
+    if m.group("labels"):
+        labels.extend(_MATCHER.findall(m.group("labels")))
+    return frozenset(labels)
+
+
+class MetricsDB:
+    """Scrapes ``{job_label: port}`` endpoints on a background thread;
+    answers the PromQL subset over everything collected."""
+
+    def __init__(self, scrape_interval_s: float = 0.25,
+                 scrape_fn: Optional[Callable[[int], dict]] = None):
+        if scrape_fn is None:
+            from frankenpaxos_tpu.bench.metrics import scrape as scrape_fn
+        self._scrape = scrape_fn
+        self.scrape_interval_s = scrape_interval_s
+        #: series -> [(unix time, value)] in scrape order.
+        self.series: dict[Labels, list[tuple[float, float]]] = {}
+        # Guards self.series between the scraper thread and
+        # query()/to_json() callers (dict iteration during insert would
+        # raise; a Series built from a list mid-append could get
+        # mismatched value/index lengths).
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- collection -------------------------------------------------------
+    def scrape_once(self, targets: dict) -> None:
+        now = time.time()
+        for job, port in targets.items():
+            try:
+                samples = self._scrape(port)
+            except Exception:
+                # Endpoint not up yet, mid-teardown truncated response
+                # (HTTPException, not OSError), parse garbage: skip the
+                # tick -- one bad scrape must never end collection.
+                continue
+            with self._lock:
+                for key, value in samples.items():
+                    labels = _parse_scraped_key(key, job)
+                    if labels is not None:
+                        self.series.setdefault(labels, []).append(
+                            (now, value))
+
+    def start(self, targets: dict) -> None:
+        def loop():
+            while not self._stop.is_set():
+                self.scrape_once(targets)
+                self._stop.wait(self.scrape_interval_s)
+
+        self._stop.clear()
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="metrics-db")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # --- persistence ------------------------------------------------------
+    def to_json(self, path: str) -> None:
+        with self._lock:
+            data = [{"labels": sorted(labels), "samples": list(samples)}
+                    for labels, samples in sorted(
+                        self.series.items(), key=lambda kv: sorted(kv[0]))]
+        with open(path, "w") as f:
+            json.dump(data, f)
+
+    @classmethod
+    def from_json(cls, path: str) -> "MetricsDB":
+        db = cls(scrape_fn=lambda port: {})
+        with open(path) as f:
+            for row in json.load(f):
+                db.series[frozenset(map(tuple, row["labels"]))] = [
+                    tuple(s) for s in row["samples"]]
+        return db
+
+    # --- query ------------------------------------------------------------
+    def query(self, q: str):
+        """Evaluate the PromQL subset; returns a time-indexed pandas
+        DataFrame with frozenset-labeled columns (the reference's
+        ``PrometheusQueryer.query`` shape, prometheus.py:81-132)."""
+        import pandas as pd
+
+        agg = _AGG.match(q)
+        if agg is not None:
+            inner = self.query(agg.group("inner"))
+            if inner.empty:
+                return inner
+            by = tuple(part.strip()
+                       for part in (agg.group("by") or "").split(",")
+                       if part.strip())
+            groups: dict[Labels, list] = {}
+            for col in inner.columns:
+                key = (frozenset((k, v) for k, v in col if k in by)
+                       if by else frozenset())
+                groups.setdefault(key, []).append(col)
+            op = agg.group("op")
+            out = {}
+            for key, cols in groups.items():
+                # Align series on the union index (scrapes of different
+                # jobs tick together but not identically); forward-fill
+                # like Prometheus's staleness-window lookup.
+                block = inner[cols].ffill()
+                out[key] = getattr(block, op if op != "avg" else "mean")(
+                    axis=1)
+            return pd.DataFrame(out)
+
+        rate = _RATE.match(q)
+        if rate is not None:
+            sel = _SELECTOR.match(rate.group("inner"))
+            if sel is None or sel.group("window") is None:
+                raise ValueError(
+                    f"rate() needs `selector[window]`: {q!r}")
+            window = (float(sel.group("window"))
+                      * _UNIT_S[sel.group("unit")])
+            out = {}
+            for labels, samples in self._select(sel):
+                # Prometheus-style: accumulate CONSECUTIVE-pair
+                # increases (a drop between adjacent samples is a
+                # counter reset; the post-reset value is the increase,
+                # and pre-reset growth inside the window is kept).
+                # Prefix sums + a monotone window-start pointer make
+                # the whole series O(n).
+                inc = [0.0] * len(samples)
+                for i in range(1, len(samples)):
+                    delta = samples[i][1] - samples[i - 1][1]
+                    inc[i] = inc[i - 1] + (delta if delta >= 0
+                                           else samples[i][1])
+                pts = []
+                j = 0
+                for i, (t, v) in enumerate(samples):
+                    lo = t - window
+                    while samples[j][0] < lo:
+                        j += 1
+                    if j >= i or t <= samples[j][0]:
+                        continue
+                    pts.append((t, (inc[i] - inc[j])
+                                / (t - samples[j][0])))
+                if pts:
+                    out[labels] = pd.Series(
+                        [v for _, v in pts],
+                        index=pd.to_datetime([t for t, _ in pts],
+                                             unit="s"))
+            return pd.DataFrame(out)
+
+        sel = _SELECTOR.match(q)
+        if sel is None:
+            raise ValueError(f"unsupported PromQL: {q!r}")
+        out = {}
+        for labels, samples in self._select(sel):
+            out[labels] = pd.Series(
+                [v for _, v in samples],
+                index=pd.to_datetime([t for t, _ in samples], unit="s"))
+        return pd.DataFrame(out)
+
+    def _select(self, sel) -> list:
+        name = sel.group("name")
+        raw = sel.group("matchers") or ""
+        # Only `name="value"` matchers are supported; anything else
+        # (!=, =~, !~) must ERROR, not silently match everything.
+        stripped = _MATCHER.sub("", raw).replace(",", "").strip()
+        if stripped:
+            raise ValueError(
+                f"unsupported label matchers {raw!r} (only "
+                f'`name="value"` equality is implemented)')
+        matchers = dict(_MATCHER.findall(raw))
+        hits = []
+        with self._lock:
+            items = [(labels, list(samples))
+                     for labels, samples in self.series.items()]
+        for labels, samples in items:
+            as_dict = dict(labels)
+            if as_dict.get("__name__") != name:
+                continue
+            if all(as_dict.get(k) == v for k, v in matchers.items()):
+                hits.append((labels, samples))
+        return hits

@@ -1,0 +1,173 @@
+"""The PromQL-over-scrapes shim (bench/promdb.py): selector matching,
+rate() with counter resets, aggregation, persistence -- the
+prometheus.py:10-132 query surface without a prometheus binary."""
+
+import math
+
+import pytest
+
+from frankenpaxos_tpu.bench.promdb import MetricsDB, _parse_scraped_key
+
+
+def make_db(ticks):
+    """ticks: list of {job: {metric_key: value}} scraped 1s apart."""
+    feeds = []
+    db = MetricsDB(scrape_fn=lambda port: feeds[port])
+    import time as _time
+
+    t0 = 1_000_000.0
+    real_time = _time.time
+    try:
+        for i, by_job in enumerate(ticks):
+            _time.time = lambda: t0 + i
+            feeds.clear()
+            jobs = sorted(by_job)
+            feeds.extend(by_job[j] for j in jobs)
+            db.scrape_once({job: idx for idx, job in enumerate(jobs)})
+    finally:
+        _time.time = real_time
+    return db
+
+
+def test_scraped_key_parsing():
+    labels = _parse_scraped_key('foo_total{type="Phase2b"}', "leader_0")
+    assert dict(labels) == {"__name__": "foo_total", "job": "leader_0",
+                            "type": "Phase2b"}
+    assert dict(_parse_scraped_key("bar", "j")) == {
+        "__name__": "bar", "job": "j"}
+
+
+def test_selector_and_label_matching():
+    db = make_db([
+        {"r0": {"cmds_total": 1.0, "other": 9.0},
+         "r1": {"cmds_total": 2.0}},
+        {"r0": {"cmds_total": 5.0, "other": 9.0},
+         "r1": {"cmds_total": 4.0}},
+    ])
+    df = db.query("cmds_total")
+    assert df.shape == (2, 2)
+    df = db.query('cmds_total{job="r0"}')
+    assert df.shape == (2, 1)
+    assert list(df.iloc[:, 0]) == [1.0, 5.0]
+    assert db.query('cmds_total{job="nope"}').empty
+
+
+def test_rate_and_counter_reset():
+    # 10/s counter, with a reset at t=3.
+    db = make_db([
+        {"r0": {"c_total": 0.0}},
+        {"r0": {"c_total": 10.0}},
+        {"r0": {"c_total": 20.0}},
+        {"r0": {"c_total": 5.0}},   # reset: process restarted
+    ])
+    df = db.query("rate(c_total[2s])")
+    rates = list(df.iloc[:, 0])
+    assert rates[0] == pytest.approx(10.0)
+    assert rates[1] == pytest.approx(10.0)
+    # Window [t1, t3]: pre-reset growth (10->20) is KEPT and the
+    # post-reset value (5) is the increase after the reset --
+    # Prometheus's consecutive-pair semantics: (10 + 5) / 2s.
+    assert rates[2] == pytest.approx(7.5)
+
+
+def test_rate_intra_window_reset_keeps_pre_reset_growth():
+    # A reset VISIBLE mid-window (110 -> 2), then growth past the old
+    # value. An endpoints-only comparison sees 100 -> 120 = 20; the
+    # consecutive-pair scan gets 10 (100->110) + 2 (reset) + 118
+    # (2->120) = 130 over 3s.
+    db = make_db([
+        {"r0": {"c_total": 100.0}},
+        {"r0": {"c_total": 110.0}},
+        {"r0": {"c_total": 2.0}},
+        {"r0": {"c_total": 120.0}},
+    ])
+    df = db.query("rate(c_total[6s])")
+    assert list(df.iloc[:, 0])[-1] == pytest.approx(130.0 / 3.0)
+
+
+def test_unsupported_matchers_raise():
+    db = make_db([{"r0": {"x_total": 1.0}}])
+    with pytest.raises(ValueError, match="matchers"):
+        db.query('x_total{job!="r0"}')
+    with pytest.raises(ValueError, match="matchers"):
+        db.query('x_total{job=~"r.*"}')
+
+
+def test_sum_and_sum_by():
+    db = make_db([
+        {"r0": {"c_total": 0.0}, "r1": {"c_total": 0.0}},
+        {"r0": {"c_total": 10.0}, "r1": {"c_total": 30.0}},
+        {"r0": {"c_total": 20.0}, "r1": {"c_total": 60.0}},
+    ])
+    total = db.query("sum(rate(c_total[1s]))")
+    assert total.shape[1] == 1
+    assert list(total.iloc[:, 0]) == pytest.approx([40.0, 40.0])
+    by_job = db.query("sum by (job) (rate(c_total[1s]))")
+    assert by_job.shape[1] == 2
+    cols = {dict(c).get("job"): list(by_job[c]) for c in by_job.columns}
+    assert cols["r0"] == pytest.approx([10.0, 10.0])
+    assert cols["r1"] == pytest.approx([30.0, 30.0])
+    avg = db.query("avg(c_total)")
+    assert list(avg.iloc[:, 0]) == pytest.approx([0.0, 20.0, 40.0])
+
+
+def test_persistence_round_trip(tmp_path):
+    db = make_db([
+        {"r0": {"c_total": 1.0}},
+        {"r0": {"c_total": 2.0}},
+    ])
+    path = str(tmp_path / "db.json")
+    db.to_json(path)
+    back = MetricsDB.from_json(path)
+    assert back.series == db.series
+    assert not back.query("c_total").empty
+
+
+def test_unsupported_query_raises():
+    db = make_db([{"r0": {"x": 1.0}}])
+    with pytest.raises(ValueError):
+        db.query("histogram_quantile(0.9, x)")
+
+
+def test_live_scrape_integration():
+    """End to end against a real /metrics endpoint: deploy echo over
+    TCP with prometheus on, watch it with the DB, and query a rate."""
+    import tempfile
+    import threading
+    import time
+
+    from frankenpaxos_tpu.bench.deploy_suite import run_protocol_smoke
+    from frankenpaxos_tpu.bench.harness import BenchmarkDirectory
+    from frankenpaxos_tpu.bench.promdb import MetricsDB
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bench = BenchmarkDirectory(tmp + "/echo")
+        db = MetricsDB(scrape_interval_s=0.1)
+
+        # run_protocol_smoke launches + kills the roles; scrape while
+        # it drives commands by starting the watcher from a hook on the
+        # bench's prometheus_ports (filled by launch_roles).
+        orig_cleanup = bench.cleanup
+
+        def cleanup():
+            db.scrape_once(bench.prometheus_ports)
+            db.stop()
+            orig_cleanup()
+
+        bench.cleanup = cleanup
+        started = threading.Event()
+
+        def watcher():
+            deadline = time.time() + 30
+            while not bench.prometheus_ports and time.time() < deadline:
+                time.sleep(0.05)
+            db.start(bench.prometheus_ports)
+            started.set()
+
+        threading.Thread(target=watcher, daemon=True).start()
+        run_protocol_smoke(bench, "echo", num_commands=5,
+                           prometheus=True)
+        assert started.wait(timeout=30)
+        df = db.query('echo_server_requests_total{type="EchoRequest"}')
+        assert not df.empty
+        assert df.iloc[-1].max() >= 5.0
