@@ -54,6 +54,9 @@ class MultiPaxosInput:
     # (SuperNode.scala:22+). Compartmentalized (False) vs coupled (True)
     # is the reference's headline 4-8x shape (BASELINE.md).
     supernode: bool = False
+    # Run every role under cProfile (bench/role_cost.py consumes the
+    # dumps; the perf_util.py flamegraph-wrap analog).
+    profiled: bool = False
 
 
 def placement(input: MultiPaxosInput) -> dict:
@@ -91,6 +94,7 @@ def run_benchmark(bench: BenchmarkDirectory,
                  state_machine=input.state_machine,
                  overrides=overrides,
                  prometheus=input.prometheus, supernode=input.supernode,
+                 profiled=input.profiled,
                  # tpu role startup pre-compiles kernels over the
                  # device link, which takes minutes under contention.
                  ready_timeout_s=(120.0 if input.quorum_backend == "dict"

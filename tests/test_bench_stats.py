@@ -54,3 +54,26 @@ def test_stats_without_starts_reports_honest_mean():
     stats = latency_throughput_stats([0.01] * 10, 2.0)
     assert "start_throughput_1s.p90" not in stats
     assert stats["throughput_mean"] == 5.0
+
+
+def test_role_cost_bucketing():
+    """role_cost buckets: idle poll and imports are not 'work'."""
+    from frankenpaxos_tpu.bench.role_cost import _bucket_of
+
+    assert _bucket_of("~", "<method 'poll' of 'select.epoll' objects>") \
+        == "idle_wait"
+    assert _bucket_of("~", "<built-in method builtins.compile>") \
+        == "startup_import"
+    assert _bucket_of("<frozen importlib._bootstrap>", "f") \
+        == "startup_import"
+    assert _bucket_of(".../multipaxos/wire.py", "encode") \
+        == "serialization"
+    assert _bucket_of("~", "<built-in method _pickle.dumps>") \
+        == "serialization"
+    assert _bucket_of("/usr/lib/python3.12/asyncio/events.py", "run") \
+        == "transport"
+    assert _bucket_of(".../frankenpaxos_tpu/runtime/tcp_transport.py",
+                      "_write") == "transport"
+    assert _bucket_of(".../frankenpaxos_tpu/protocols/multipaxos/leader.py",
+                      "receive") == "protocol"
+    assert _bucket_of("/usr/lib/python3.12/dataclasses.py", "x") == "other"
