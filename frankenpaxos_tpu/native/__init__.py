@@ -61,6 +61,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fpx_unpack_votes.restype = ctypes.c_longlong
         lib.fpx_unpack_votes.argtypes = [
             u8p, ctypes.c_uint64, i32p, i32p, i32p, ctypes.c_uint32]
+        lib.fpx_pack_votes2.restype = ctypes.c_longlong
+        lib.fpx_pack_votes2.argtypes = [
+            i32p, i32p, ctypes.c_uint32, u8p, ctypes.c_uint64]
+        lib.fpx_unpack_votes2.restype = ctypes.c_longlong
+        lib.fpx_unpack_votes2.argtypes = [
+            u8p, ctypes.c_uint64, i32p, i32p, ctypes.c_uint32]
         _lib = lib
     except (OSError, subprocess.CalledProcessError):
         _load_failed = True
@@ -147,6 +153,46 @@ def pack_votes(slots: np.ndarray, nodes: np.ndarray,
         n, out, len(out))
     assert written == len(out)
     return bytes(out)
+
+
+def pack_votes2(slots: np.ndarray, rounds: np.ndarray) -> bytes:
+    """Single-acceptor vote batch -> bytes (Phase2bVotes payload): two
+    columns only -- the acceptor identity rides the message header, so
+    no dead node column on the wire."""
+    slots = np.ascontiguousarray(slots, dtype=np.int32)
+    rounds = np.ascontiguousarray(rounds, dtype=np.int32)
+    lib = load()
+    if lib is None:
+        out = np.empty((slots.shape[0], 2), dtype="<i4")
+        out[:, 0], out[:, 1] = slots, rounds
+        return struct.pack("<I", slots.shape[0]) + out.tobytes()
+    n = slots.shape[0]
+    out = (ctypes.c_uint8 * (4 + 8 * n))()
+    written = lib.fpx_pack_votes2(
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, out, len(out))
+    assert written == len(out)
+    return bytes(out)
+
+
+def unpack_votes2(buf: bytes) -> tuple[np.ndarray, np.ndarray]:
+    lib = load()
+    if lib is None:
+        (n,) = struct.unpack_from("<I", buf, 0)
+        flat = np.frombuffer(buf, dtype="<i4", count=2 * n, offset=4)
+        pairs = flat.reshape(n, 2)
+        return pairs[:, 0].copy(), pairs[:, 1].copy()
+    (n,) = struct.unpack_from("<I", buf, 0)
+    slots = np.empty(n, dtype=np.int32)
+    rounds = np.empty(n, dtype=np.int32)
+    got = lib.fpx_unpack_votes2(
+        _as_u8p(buf), len(buf),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+    if got < 0:
+        raise ValueError("malformed vote batch")
+    return slots, rounds
 
 
 def unpack_votes(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

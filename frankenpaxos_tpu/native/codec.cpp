@@ -138,4 +138,35 @@ long long fpx_unpack_votes(const uint8_t* buf, uint64_t len, int32_t* slots,
   return n;
 }
 
+// Two-column variant for SINGLE-acceptor batches (Phase2bVotes): the
+// acceptor's identity travels in the message header, so packing a node
+// column would ship 4 dead bytes per vote.
+// Wire layout: [u32 count][count * (i32 slot, i32 round)].
+long long fpx_pack_votes2(const int32_t* slots, const int32_t* rounds,
+                          uint32_t n, uint8_t* out, uint64_t out_cap) {
+  const uint64_t total = 4ull + 8ull * n;
+  if (total > out_cap) return -1;
+  std::memcpy(out, &n, 4);
+  int32_t* p = reinterpret_cast<int32_t*>(out + 4);
+  for (uint32_t i = 0; i < n; ++i) {
+    p[2 * i] = slots[i];
+    p[2 * i + 1] = rounds[i];
+  }
+  return static_cast<long long>(total);
+}
+
+long long fpx_unpack_votes2(const uint8_t* buf, uint64_t len,
+                            int32_t* slots, int32_t* rounds, uint32_t cap) {
+  if (len < 4) return -1;
+  uint32_t n;
+  std::memcpy(&n, buf, 4);
+  if (len < 4ull + 8ull * n || n > cap) return -1;
+  const int32_t* p = reinterpret_cast<const int32_t*>(buf + 4);
+  for (uint32_t i = 0; i < n; ++i) {
+    slots[i] = p[2 * i];
+    rounds[i] = p[2 * i + 1];
+  }
+  return n;
+}
+
 }  // extern "C"

@@ -28,6 +28,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase2a,
     Phase2b,
     Phase2bRange,
+    Phase2bVotes,
 )
 
 
@@ -147,14 +148,28 @@ class Acceptor(Actor):
         pending, self._pending_phase2bs = self._pending_phase2bs, {}
         for dst, acks in pending.items():
             acks.sort()
-            start = 0
-            for i in range(1, len(acks) + 1):
-                if (i < len(acks)
-                        and acks[i][0] == acks[i - 1][0] + 1
-                        and acks[i][1] == acks[i - 1][1]):
-                    continue
-                run = acks[start:i]
-                start = i
+            runs = self._runs_of(acks)
+            # A heavily FRAGMENTED drain (thrifty sampling shreds the
+            # proxy's contiguous Phase2a run into short per-acceptor
+            # pieces) ships as ONE packed-array message instead of one
+            # message per run: the native vote codec packs here and the
+            # ProxyLeader unpacks straight into its tracker's arrays --
+            # per-vote Python disappears from both sides.
+            if len(runs) > 4 and len(acks) >= 16:
+                import numpy as np
+
+                from frankenpaxos_tpu import native
+
+                slots = np.fromiter((s for s, _ in acks), dtype=np.int32,
+                                    count=len(acks))
+                rounds = np.fromiter((r for _, r in acks), dtype=np.int32,
+                                     count=len(acks))
+                self.send(dst, Phase2bVotes(
+                    group_index=self.group_index,
+                    acceptor_index=self.index,
+                    packed=native.pack_votes2(slots, rounds)))
+                continue
+            for run in runs:
                 if len(run) == 1:
                     self.send(dst, Phase2b(
                         group_index=self.group_index,
@@ -167,6 +182,21 @@ class Acceptor(Actor):
                         slot_start_inclusive=run[0][0],
                         slot_end_exclusive=run[-1][0] + 1,
                         round=run[0][1]))
+
+    @staticmethod
+    def _runs_of(acks: list) -> list:
+        """Split sorted (slot, round) acks into contiguous same-round
+        runs."""
+        runs = []
+        start = 0
+        for i in range(1, len(acks) + 1):
+            if (i < len(acks)
+                    and acks[i][0] == acks[i - 1][0] + 1
+                    and acks[i][1] == acks[i - 1][1]):
+                continue
+            runs.append(acks[start:i])
+            start = i
+        return runs
 
     def _handle_max_slot_request(self, src: Address,
                                  request: MaxSlotRequest) -> None:

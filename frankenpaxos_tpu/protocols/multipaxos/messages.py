@@ -150,6 +150,22 @@ class Phase2bRange:
 
 
 @dataclasses.dataclass(frozen=True)
+class Phase2bVotes:
+    """One acceptor's votes for a FRAGMENTED slot set in one drain.
+
+    Thrifty quorum sampling shreds an acceptor's per-drain votes into
+    many short runs; rather than one Phase2b(Range) per run, the whole
+    drain ships as a single message whose payload is the native vote
+    codec's packed array form (native/codec.cpp fpx_pack_votes) -- the
+    ProxyLeader unpacks straight into the numpy arrays its quorum
+    tracker consumes, so neither side runs per-vote Python."""
+
+    group_index: int
+    acceptor_index: int
+    packed: bytes  # native.pack_votes2(slots, rounds)
+
+
+@dataclasses.dataclass(frozen=True)
 class Chosen:
     slot: int
     value: CommandBatchOrNoop

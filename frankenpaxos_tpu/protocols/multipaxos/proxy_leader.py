@@ -25,6 +25,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase2a,
     Phase2b,
     Phase2bRange,
+    Phase2bVotes,
 )
 from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
     DictQuorumTracker,
@@ -138,6 +139,9 @@ class ProxyLeader(Actor):
         elif isinstance(message, Phase2bRange):
             self.metrics_requests.labels("Phase2bRange").inc()
             self._handle_phase2b_range(src, message)
+        elif isinstance(message, Phase2bVotes):
+            self.metrics_requests.labels("Phase2bVotes").inc()
+            self._handle_phase2b_votes(src, message)
         else:
             self.logger.fatal(f"unexpected proxy leader message {message!r}")
 
@@ -194,6 +198,17 @@ class ProxyLeader(Actor):
         self.tracker.record_range(r.slot_start_inclusive,
                                   r.slot_end_exclusive, r.round,
                                   r.group_index, r.acceptor_index)
+
+    def _handle_phase2b_votes(self, src: Address, m) -> None:
+        """A packed fragmented-drain ack (Phase2bVotes): unpack with
+        the native codec straight into the tracker's arrays -- no
+        per-vote Python on either side (same no-pending-check rationale
+        as ranges)."""
+        from frankenpaxos_tpu import native
+
+        slots, rounds = native.unpack_votes2(m.packed)
+        self.tracker.record_votes(slots, rounds, m.group_index,
+                                  m.acceptor_index)
 
     def on_drain(self) -> None:
         self._emit_chosen(self.tracker.drain())

@@ -42,6 +42,15 @@ class QuorumTracker(abc.ABC):
         for slot in range(slot_start, slot_end):
             self.record(slot, round, group_index, acceptor_index)
 
+    def record_votes(self, slots, rounds, group_index: int,
+                     acceptor_index: int) -> None:
+        """One acceptor's votes for an ARBITRARY slot array (a packed
+        Phase2bVotes from a fragmented drain). Default: per-slot
+        expansion."""
+        for slot, round in zip(slots.tolist(), rounds.tolist()):
+            self.record(int(slot), int(round), group_index,
+                        acceptor_index)
+
     @abc.abstractmethod
     def drain(self) -> list[tuple[int, int]]:
         """Flush buffered votes; return [(slot, round)] newly at quorum."""
@@ -148,6 +157,10 @@ class TpuQuorumTracker(QuorumTracker):
         # Ranged votes (Phase2bRange): [(start, end, col, round)] --
         # O(1) Python per message, expanded vectorized at drain time.
         self._ranges: list[tuple[int, int, int, int]] = []
+        # Packed array votes (Phase2bVotes): [(slots, col, rounds)] --
+        # O(1) Python per message, arrays straight off the native
+        # codec's unpack.
+        self._array_votes: list = []
         # Exactly-once reporting across drains, vectorized. The board's
         # `chosen` bitmap provides this for board-recorded votes, but
         # the stateless check_block path never touches the board, so a
@@ -232,10 +245,22 @@ class TpuQuorumTracker(QuorumTracker):
                              group_index * self._row_size
                              + acceptor_index, round))
 
+    def record_votes(self, slots, rounds, group_index,
+                     acceptor_index) -> None:
+        slots = np.asarray(slots, dtype=np.int64)
+        if not slots.size:
+            # Drop empties at the door: every drain path assumes
+            # non-empty entries (round scans, frontier max, rounds[0]).
+            return
+        self._array_votes.append(
+            (slots, group_index * self._row_size + acceptor_index,
+             np.asarray(rounds, dtype=np.int32)))
+
     def drain(self) -> list[tuple[int, int]]:
         """At most a few device calls (usually one, often zero) per
         event-loop drain; see the class docstring for the two modes."""
-        if not self._slots and not self._ranges:
+        if not self._slots and not self._ranges \
+                and not self._array_votes:
             return []
         if self.pipelined:
             return self._drain_pipelined()
@@ -255,6 +280,7 @@ class TpuQuorumTracker(QuorumTracker):
         back together), so the common drain is one ``check_block``
         matmul with an empty residue."""
         ranges, self._ranges = self._ranges, []
+        av, self._array_votes = self._array_votes, []
         sl, self._slots = self._slots, []
         cl, self._cols = self._cols, []
         rl, self._rounds = self._rounds, []
@@ -265,7 +291,8 @@ class TpuQuorumTracker(QuorumTracker):
         # visible per command. An explicit tiny min_device_slots (the
         # component benchmarks pin the device path on) lowers this
         # cutoff too.
-        nvotes = len(sl) + sum(e - s for s, e, _, _ in ranges)
+        nvotes = (len(sl) + sum(e - s for s, e, _, _ in ranges)
+                  + sum(s.size for s, _, _ in av))
         if nvotes < min(48, self.min_device_slots):
             row = self._row_size
             frontier = max(sl) if sl else -1
@@ -275,7 +302,12 @@ class TpuQuorumTracker(QuorumTracker):
             if ranges:
                 frontier = max(frontier,
                                max(e - 1 for _, e, _, _ in ranges))
+            if av:
+                frontier = max(frontier,
+                               max(int(s.max()) for s, _, _ in av
+                                   if s.size))
             self._spill_ranges(ranges)
+            self._spill_arrays(av)
             self._note_frontier(frontier)
             return self._host_results()
 
@@ -290,24 +322,33 @@ class TpuQuorumTracker(QuorumTracker):
 
         # Uniform-round test + slot span.
         uniform = True
+        lo = hi = None
         if ranges:
             rnd0 = int(ra[0, 3])
             uniform = bool((ra[:, 3] == rnd0).all())
             lo = int(ra[:, 0].min())
             hi = int(ra[:, 1].max()) - 1
+        elif av:
+            rnd0 = int(av[0][2][0]) if av[0][2].size else 0
         else:
             rnd0 = int(rounds[0])
+        for s_arr, _, r_arr in av:
+            if not uniform or not s_arr.size:
+                break
+            if not (r_arr == rnd0).all():
+                uniform = False
+                break
+            alo, ahi = int(s_arr.min()), int(s_arr.max())
+            lo = alo if lo is None else min(lo, alo)
+            hi = ahi if hi is None else max(hi, ahi)
         if uniform and slots.size:
             if not (rounds == rnd0).all():
                 uniform = False
             else:
                 slo = int(slots.min())
                 shi = int(slots.max())
-                if ranges:
-                    lo = min(lo, slo)
-                    hi = max(hi, shi)
-                else:
-                    lo, hi = slo, shi
+                lo = slo if lo is None else min(lo, slo)
+                hi = shi if hi is None else max(hi, shi)
         if not uniform:
             # Mixed rounds: election churn, preemption -- rare and
             # thin. Spill everything to the host tally in arrival
@@ -316,7 +357,12 @@ class TpuQuorumTracker(QuorumTracker):
             frontier = int(slots.max()) if slots.size else -1
             if ranges:
                 frontier = max(frontier, int(ra[:, 1].max()) - 1)
+            if av:
+                frontier = max(frontier,
+                               max(int(s.max()) for s, _, _ in av
+                                   if s.size))
             self._spill_ranges(ranges)
+            self._spill_arrays(av)
             self._spill_votes(slots, cols, rounds)
             self._note_frontier(frontier)
             return self._host_results()
@@ -326,6 +372,7 @@ class TpuQuorumTracker(QuorumTracker):
             # Narrow drain: the fixed device round-trip loses to
             # per-vote Python here -- host tally.
             self._spill_ranges(ranges)
+            self._spill_arrays(av)
             self._spill_votes(slots, cols, rounds)
             self._note_frontier(hi)
             return self._host_results()
@@ -348,6 +395,9 @@ class TpuQuorumTracker(QuorumTracker):
             active.update(np.unique((single[:, 0] - lo) // seg).tolist())
         for s, e, _, _ in multi:
             active.update(range((s - lo) // seg, (e - 1 - lo) // seg + 1))
+        for s_arr, _, _ in av:
+            if s_arr.size:
+                active.update(np.unique((s_arr - lo) // seg).tolist())
         # Two phases: dispatch every segment's check first, THEN fetch
         # -- k segments pay one overlap-able round-trip, not k
         # serialized ones.
@@ -372,6 +422,9 @@ class TpuQuorumTracker(QuorumTracker):
             if slots.size:
                 inseg = (slots >= seg_start) & (slots < seg_end)
                 block[cols[inseg], slots[inseg] - seg_start] = 1
+            for s_arr, col, _ in av:
+                inseg = (s_arr >= seg_start) & (s_arr < seg_end)
+                block[col, s_arr[inseg] - seg_start] = 1
             dispatched.append((seg_start, seg_width, block,
                                self.checker.check_block_async(block)))
         for seg_start, seg_width, block, mask in dispatched:
@@ -406,6 +459,12 @@ class TpuQuorumTracker(QuorumTracker):
         for s, e, col, r in ranges:
             g, i = divmod(col, self._row_size)
             for slot in range(s, e):
+                self._host.record(slot, r, g, i)
+
+    def _spill_arrays(self, array_votes) -> None:
+        for s_arr, col, r_arr in array_votes:
+            g, i = divmod(col, self._row_size)
+            for slot, r in zip(s_arr.tolist(), r_arr.tolist()):
                 self._host.record(slot, r, g, i)
 
     def _note_frontier(self, max_slot: int) -> None:
@@ -463,9 +522,10 @@ class TpuQuorumTracker(QuorumTracker):
         slots = np.asarray(self._slots, dtype=np.int64)
         cols = np.asarray(self._cols, dtype=np.int32)
         rounds = np.asarray(self._rounds, dtype=np.int32)
-        if self._ranges:
-            # Expand ranged votes vectorized (the whole point of
-            # Phase2bRange: no per-slot Python before this point).
+        if self._ranges or self._array_votes:
+            # Expand ranged/packed votes vectorized (the whole point of
+            # Phase2bRange/Phase2bVotes: no per-slot Python before this
+            # point).
             parts_s = [slots] if slots.size else []
             parts_c = [cols] if slots.size else []
             parts_r = [rounds] if slots.size else []
@@ -474,6 +534,10 @@ class TpuQuorumTracker(QuorumTracker):
                 parts_s.append(np.arange(start, end, dtype=np.int64))
                 parts_c.append(np.full(width, col, dtype=np.int32))
                 parts_r.append(np.full(width, rnd, dtype=np.int32))
+            for s_arr, col, r_arr in self._array_votes:
+                parts_s.append(s_arr)
+                parts_c.append(np.full(s_arr.size, col, dtype=np.int32))
+                parts_r.append(r_arr)
             slots = np.concatenate(parts_s)
             cols = np.concatenate(parts_c)
             rounds = np.concatenate(parts_r)
@@ -495,6 +559,7 @@ class TpuQuorumTracker(QuorumTracker):
                 self._record_board(parts, lo, block, bucket, dom)
                 self._slots, self._cols, self._rounds = [], [], []
                 self._ranges = []
+                self._array_votes = []
                 self._inflight.append(parts)
                 return []
             dense_idx = np.arange(slots.shape[0])
@@ -552,6 +617,7 @@ class TpuQuorumTracker(QuorumTracker):
 
         self._slots, self._cols, self._rounds = [], [], []
         self._ranges = []
+        self._array_votes = []
         self._inflight.append(parts)
         return []
 

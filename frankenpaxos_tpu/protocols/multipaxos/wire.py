@@ -38,6 +38,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase2a,
     Phase2b,
     Phase2bRange,
+    Phase2bVotes,
 )
 
 _I64 = struct.Struct("<q")
@@ -252,8 +253,25 @@ class Phase2bRangeCodec(MessageCodec):
                             round=round), at + _P2BR.size
 
 
+class Phase2bVotesCodec(MessageCodec):
+    message_type = Phase2bVotes
+    tag = 113
+
+    def encode(self, out, message):
+        out += _I32.pack(message.group_index)
+        out += _I32.pack(message.acceptor_index)
+        _put_bytes(out, message.packed)
+
+    def decode(self, buf, at):
+        (group,) = _I32.unpack_from(buf, at)
+        (acceptor,) = _I32.unpack_from(buf, at + 4)
+        packed, at = _take_bytes(buf, at + 8)
+        return Phase2bVotes(group_index=group, acceptor_index=acceptor,
+                            packed=packed), at
+
+
 for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                ClientRequestCodec(), ClientRequestBatchCodec(),
                ClientReplyCodec(), ChosenWatermarkCodec(),
-               Phase2bRangeCodec()):
+               Phase2bRangeCodec(), Phase2bVotesCodec()):
     register_codec(_codec)

@@ -729,3 +729,66 @@ def test_quorum_tracker_straddling_board_split_uses_prewarmed_widths():
     while (d := tpu_tracker.take_dispatch()) is not None:
         got.extend(tpu_tracker.collect(d))
     assert sorted(got) == sorted(dict_tracker.drain())
+
+
+def test_acceptor_packs_fragmented_drains():
+    """A fragmented drain (>4 runs, >=16 acks) ships as ONE packed
+    Phase2bVotes; contiguous drains keep the Phase2bRange shape."""
+    from frankenpaxos_tpu import native
+    from frankenpaxos_tpu.protocols.multipaxos.messages import (
+        Phase2bRange,
+        Phase2bVotes,
+    )
+
+    sim = make_multipaxos(f=1)
+    acceptor = sim.acceptors[0]
+    # Fragmented: every other slot over a 40-slot span.
+    acceptor._pending_phase2bs = {"proxy": [(s, 0)
+                                            for s in range(0, 40, 2)]}
+    sent = []
+    acceptor.send = lambda dst, m: sent.append(m)
+    acceptor.on_drain()
+    assert len(sent) == 1 and isinstance(sent[0], Phase2bVotes)
+    slots, rounds = native.unpack_votes2(sent[0].packed)
+    assert list(slots) == list(range(0, 40, 2))
+    assert set(rounds.tolist()) == {0}
+
+    # Contiguous: one range.
+    acceptor._pending_phase2bs = {"proxy": [(s, 0) for s in range(20)]}
+    sent.clear()
+    acceptor.on_drain()
+    assert len(sent) == 1 and isinstance(sent[0], Phase2bRange)
+
+
+def test_quorum_tracker_record_votes_matches_dict():
+    """Packed array votes (record_votes) agree with the oracle across
+    both tpu-tracker modes and the dict default expansion."""
+    import numpy as np
+
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        DictQuorumTracker,
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    rng = random.Random(7)
+    for min_dev in (1, 1024):
+        dict_tracker = DictQuorumTracker(sim.config)
+        tpu_tracker = TpuQuorumTracker(sim.config, window=1 << 12,
+                                       min_device_slots=min_dev)
+        cursor = 0
+        for _ in range(10):
+            run_len = rng.randrange(8, 60)
+            # Each acceptor votes a random fragmented subset, delivered
+            # as packed arrays.
+            for acc in range(3):
+                picked = sorted(s for s in range(cursor,
+                                                 cursor + run_len)
+                                if rng.random() < 0.7)
+                slots = np.asarray(picked, dtype=np.int32)
+                rounds = np.zeros(len(picked), dtype=np.int32)
+                dict_tracker.record_votes(slots, rounds, 0, acc)
+                tpu_tracker.record_votes(slots, rounds, 0, acc)
+            cursor += run_len
+            assert sorted(dict_tracker.drain()) == \
+                sorted(tpu_tracker.drain()), (min_dev, cursor)

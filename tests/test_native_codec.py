@@ -86,3 +86,26 @@ def test_native_matches_python_fallback(lib, monkeypatch):
     assert len(frames) == 2
     s, n, r = native.unpack_votes(native_votes)
     np.testing.assert_array_equal(s, slots)
+
+
+def test_pack_votes2_round_trip_native_and_fallback():
+    """The two-column single-acceptor batch (Phase2bVotes payload):
+    native and pure-Python forms are byte-identical and round-trip."""
+    import numpy as np
+
+    from frankenpaxos_tpu import native
+
+    slots = np.array([3, 5, 9, 1000000], dtype=np.int32)
+    rounds = np.array([0, 0, 2, 7], dtype=np.int32)
+    packed = native.pack_votes2(slots, rounds)
+    assert len(packed) == 4 + 8 * 4
+    s, r = native.unpack_votes2(packed)
+    assert list(s) == list(slots) and list(r) == list(rounds)
+    # Fallback equivalence.
+    lib, native._lib, native._load_failed = native._lib, None, True
+    try:
+        assert native.pack_votes2(slots, rounds) == packed
+        s2, r2 = native.unpack_votes2(packed)
+        assert list(s2) == list(slots) and list(r2) == list(rounds)
+    finally:
+        native._lib, native._load_failed = lib, False
