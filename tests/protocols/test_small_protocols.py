@@ -361,6 +361,7 @@ class CraqSimulated(PrefixAgreementSim):
 
     transport_weight = 12
     KEYS = ("a", "b", "c")
+    CHAIN_LEN = 3
 
     def make_system(self, seed):
         from frankenpaxos_tpu.protocols.craq import (
@@ -376,8 +377,8 @@ class CraqSimulated(PrefixAgreementSim):
 
         logger = FakeLogger(LogLevel.FATAL)
         transport = SimTransport(logger)
-        config = CraqConfig(chain_node_addresses=(
-            "chain-0", "chain-1", "chain-2"))
+        config = CraqConfig(chain_node_addresses=tuple(
+            f"chain-{i}" for i in range(self.CHAIN_LEN)))
         nodes = [ChainNode(a, transport, logger, config)
                  for a in config.chain_node_addresses]
         clients = [CraqClient(f"client-{i}", transport, logger, config,
@@ -450,6 +451,8 @@ class UnanimousBPaxosSimulated(PrefixAgreementSim):
     """Invariant: leaders agree on every committed vertex's value."""
 
     transport_weight = 12
+    F = 1          # dep nodes / acceptors are 2F+1; leaders F+1
+    NUM_LEADERS = 2
 
     def make_system(self, seed):
         from frankenpaxos_tpu.protocols.unanimousbpaxos import (
@@ -468,10 +471,11 @@ class UnanimousBPaxosSimulated(PrefixAgreementSim):
 
         logger = FakeLogger(LogLevel.FATAL)
         transport = SimTransport(logger)
-        n = 3
+        n = 2 * self.F + 1
         config = UnanimousBPaxosConfig(
-            f=1,
-            leader_addresses=("leader-0", "leader-1"),
+            f=self.F,
+            leader_addresses=tuple(
+                f"leader-{i}" for i in range(self.NUM_LEADERS)),
             dep_service_node_addresses=tuple(
                 f"dep-{i}" for i in range(n)),
             acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)))

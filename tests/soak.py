@@ -14,10 +14,12 @@ or through pytest, gated behind an env var so it never slows CI::
 
     FPX_SOAK=1 python -m pytest tests/soak.py -q
 
-Each entry below is (name, factory) where the factory builds a
-SimulatedSystem configured like one row of the reference's soak matrix.
-Fixed-topology harnesses (Scalog's 2 shards, MMP's 6 acceptors) get
-small subclasses threading f=2 through their factories.
+Each entry below is (name, factory, runs_scale) where the factory
+builds a SimulatedSystem configured like one row of the reference's
+soak matrix and runs_scale multiplies --num_runs (device-backed rows
+run fewer: every drain pays a device call). Fixed-topology harnesses
+(Scalog's 2 shards, MMP's 6 acceptors) get small subclasses threading
+f=2 through their factories.
 """
 
 from __future__ import annotations
@@ -140,10 +142,19 @@ class FastMultiPaxosF2Simulated(FastMultiPaxosSimulated):
                     acceptors=sim[3], clients=sim[4])
 
 
+class UnanimousBPaxosF2Simulated(UnanimousBPaxosSimulated):
+    F = 2
+    NUM_LEADERS = 3
+
+
+class CraqChain5Simulated(CraqSimulated):
+    CHAIN_LEN = 5
+
+
 #: The soak matrix: the multi-role protocols VERDICT r3 called out
 #: (the single-decree sims already run at 500x250 in the regular suite,
 #: tests/protocols/test_single_decree_sims.py).
-CONFIGS: list[tuple[str, object]] = [
+CONFIGS: list[tuple] = [
     ("multipaxos/f1", lambda: MultiPaxosSimulated(f=1)),
     ("multipaxos/f1-groups2",
      lambda: MultiPaxosSimulated(f=1, num_acceptor_groups=2)),
@@ -178,27 +189,48 @@ CONFIGS: list[tuple[str, object]] = [
     ("fasterpaxos/f2", FasterPaxosF2Simulated),
     ("fastmultipaxos/f1", FastMultiPaxosSimulated),
     ("fastmultipaxos/f2", FastMultiPaxosF2Simulated),
+    ("unanimousbpaxos/f2", UnanimousBPaxosF2Simulated),
+    ("craq/chain5", CraqChain5Simulated),
+    # Device-backed configs: the TPU quorum tracker / dependency kernels
+    # under the full randomized interleaving exploration. Scaled to
+    # 0.25x runs: every drain pays a device call.
+    ("multipaxos/f1-tpu-backend",
+     lambda: MultiPaxosSimulated(f=1, quorum_backend="tpu"), 0.25),
+    ("multipaxos/f1-grid-tpu-backend",
+     lambda: MultiPaxosSimulated(f=1, flexible=True, grid_shape=(2, 2),
+                                 quorum_backend="tpu"), 0.25),
+    ("epaxos/f1-tpu-deps",
+     lambda: EPaxosSimulated(dep_backend="tpu"), 0.25),
 ]
+
+
+def _expand(entry, num_runs: int):
+    """(name, factory[, runs_scale]) -> (name, factory, scaled runs) --
+    the ONE place the optional scale element is interpreted."""
+    name, factory = entry[0], entry[1]
+    scale = entry[2] if len(entry) > 2 else 1.0
+    return name, factory, max(1, int(num_runs * scale))
 
 
 def run_soak(num_runs: int = 500, run_length: int = 250, seed: int = 0,
              only: str | None = None, out: str | None = None) -> dict:
     rows = []
     t_start = time.time()
-    for name, factory in CONFIGS:
+    for entry in CONFIGS:
+        name, factory, runs = _expand(entry, num_runs)
         if only and only not in name:
             continue
         t0 = time.time()
         try:
             failure = Simulator(factory(), run_length=run_length,
-                                num_runs=num_runs,
+                                num_runs=runs,
                                 minimize=True).run(seed=seed)
             failure = str(failure) if failure is not None else None
         except Exception as e:  # a crash IS a soak finding, not an abort
             failure = f"crash: {type(e).__name__}: {e}"
         row = {
             "config": name,
-            "num_runs": num_runs,
+            "num_runs": runs,
             "run_length": run_length,
             "seed": seed,
             "seconds": round(time.time() - t0, 1),
@@ -223,10 +255,11 @@ def run_soak(num_runs: int = 500, run_length: int = 250, seed: int = 0,
 
 @pytest.mark.skipif(not os.environ.get("FPX_SOAK"),
                     reason="full-scale soak; set FPX_SOAK=1 (takes hours)")
-@pytest.mark.parametrize("name,factory", CONFIGS,
-                         ids=[name for name, _ in CONFIGS])
-def test_soak(name, factory):
-    failure = Simulator(factory(), run_length=250, num_runs=500,
+@pytest.mark.parametrize("entry", CONFIGS,
+                         ids=[entry[0] for entry in CONFIGS])
+def test_soak(entry):
+    name, factory, runs = _expand(entry, 500)
+    failure = Simulator(factory(), run_length=250, num_runs=runs,
                         minimize=True).run(seed=0)
     assert failure is None, f"{name}: {failure}"
 
