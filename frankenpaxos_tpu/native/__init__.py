@@ -61,12 +61,13 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fpx_unpack_votes.restype = ctypes.c_longlong
         lib.fpx_unpack_votes.argtypes = [
             u8p, ctypes.c_uint64, i32p, i32p, i32p, ctypes.c_uint32]
+        i64p = ctypes.POINTER(ctypes.c_int64)
         lib.fpx_pack_votes2.restype = ctypes.c_longlong
         lib.fpx_pack_votes2.argtypes = [
-            i32p, i32p, ctypes.c_uint32, u8p, ctypes.c_uint64]
+            i64p, i32p, ctypes.c_uint32, u8p, ctypes.c_uint64]
         lib.fpx_unpack_votes2.restype = ctypes.c_longlong
         lib.fpx_unpack_votes2.argtypes = [
-            u8p, ctypes.c_uint64, i32p, i32p, ctypes.c_uint32]
+            u8p, ctypes.c_uint64, i64p, i32p, ctypes.c_uint32]
         _lib = lib
     except (OSError, subprocess.CalledProcessError):
         _load_failed = True
@@ -155,40 +156,68 @@ def pack_votes(slots: np.ndarray, nodes: np.ndarray,
     return bytes(out)
 
 
+# Packed 12-byte (i64 slot, i32 round) records -- the Phase2bVotes
+# payload entry. Slots are i64 to match the rest of the wire (the
+# Phase2b/Phase2bRange codecs carry '<q' slots).
+_VOTE2_DTYPE = np.dtype([("slot", "<i8"), ("round", "<i4")])
+
+
 def pack_votes2(slots: np.ndarray, rounds: np.ndarray) -> bytes:
     """Single-acceptor vote batch -> bytes (Phase2bVotes payload): two
     columns only -- the acceptor identity rides the message header, so
     no dead node column on the wire."""
-    slots = np.ascontiguousarray(slots, dtype=np.int32)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
     rounds = np.ascontiguousarray(rounds, dtype=np.int32)
     lib = load()
     if lib is None:
-        out = np.empty((slots.shape[0], 2), dtype="<i4")
-        out[:, 0], out[:, 1] = slots, rounds
+        out = np.empty(slots.shape[0], dtype=_VOTE2_DTYPE)
+        out["slot"], out["round"] = slots, rounds
         return struct.pack("<I", slots.shape[0]) + out.tobytes()
     n = slots.shape[0]
-    out = (ctypes.c_uint8 * (4 + 8 * n))()
+    out = (ctypes.c_uint8 * (4 + 12 * n))()
     written = lib.fpx_pack_votes2(
-        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         rounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         n, out, len(out))
     assert written == len(out)
     return bytes(out)
 
 
+def _check_count(buf: bytes, record_size: int) -> int:
+    """Validate a [u32 count][count * record] payload's framing WITHOUT
+    allocating anything proportional to the claimed count; returns the
+    count. Raising here (ValueError) is the defense against hostile
+    counts (a u32 count of 0xFFFFFFFF would otherwise drive a ~48 GB
+    numpy allocation before any bounds check ran)."""
+    if len(buf) < 4:
+        raise ValueError("malformed vote batch: short count header")
+    (n,) = struct.unpack_from("<I", buf, 0)
+    if len(buf) < 4 + record_size * n:
+        raise ValueError(
+            f"malformed vote batch: count {n} exceeds payload "
+            f"({len(buf)} bytes)")
+    return n
+
+
+def check_votes2(buf: bytes) -> int:
+    """Validate a packed Phase2bVotes payload; returns the count. The
+    message codec calls this inside decode so a malformed payload is
+    dropped by the transport's corrupt-frame guard, never reaching an
+    actor."""
+    return _check_count(buf, _VOTE2_DTYPE.itemsize)
+
+
 def unpack_votes2(buf: bytes) -> tuple[np.ndarray, np.ndarray]:
+    n = check_votes2(buf)
     lib = load()
     if lib is None:
-        (n,) = struct.unpack_from("<I", buf, 0)
-        flat = np.frombuffer(buf, dtype="<i4", count=2 * n, offset=4)
-        pairs = flat.reshape(n, 2)
-        return pairs[:, 0].copy(), pairs[:, 1].copy()
-    (n,) = struct.unpack_from("<I", buf, 0)
-    slots = np.empty(n, dtype=np.int32)
+        rec = np.frombuffer(buf, dtype=_VOTE2_DTYPE, count=n, offset=4)
+        return rec["slot"].copy(), rec["round"].copy()
+    slots = np.empty(n, dtype=np.int64)
     rounds = np.empty(n, dtype=np.int32)
     got = lib.fpx_unpack_votes2(
         _as_u8p(buf), len(buf),
-        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         rounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
     if got < 0:
         raise ValueError("malformed vote batch")
@@ -196,14 +225,13 @@ def unpack_votes2(buf: bytes) -> tuple[np.ndarray, np.ndarray]:
 
 
 def unpack_votes(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n = _check_count(buf, 12)  # 3 x i32 records
     lib = load()
     if lib is None:
-        (n,) = struct.unpack_from("<I", buf, 0)
         flat = np.frombuffer(buf, dtype="<i4", count=3 * n, offset=4)
         triples = flat.reshape(n, 3)
         return (triples[:, 0].copy(), triples[:, 1].copy(),
                 triples[:, 2].copy())
-    (n,) = struct.unpack_from("<I", buf, 0)
     slots = np.empty(n, dtype=np.int32)
     nodes = np.empty(n, dtype=np.int32)
     rounds = np.empty(n, dtype=np.int32)

@@ -140,31 +140,35 @@ long long fpx_unpack_votes(const uint8_t* buf, uint64_t len, int32_t* slots,
 
 // Two-column variant for SINGLE-acceptor batches (Phase2bVotes): the
 // acceptor's identity travels in the message header, so packing a node
-// column would ship 4 dead bytes per vote.
-// Wire layout: [u32 count][count * (i32 slot, i32 round)].
-long long fpx_pack_votes2(const int32_t* slots, const int32_t* rounds,
+// column would ship 4 dead bytes per vote. Slots are i64 like every
+// other slot on the wire (Phase2b/Phase2bRange carry '<q' slots); a
+// 12-byte packed record, memcpy'd because entries are unaligned.
+// Wire layout: [u32 count][count * (i64 slot, i32 round)].
+long long fpx_pack_votes2(const int64_t* slots, const int32_t* rounds,
                           uint32_t n, uint8_t* out, uint64_t out_cap) {
-  const uint64_t total = 4ull + 8ull * n;
+  const uint64_t total = 4ull + 12ull * n;
   if (total > out_cap) return -1;
   std::memcpy(out, &n, 4);
-  int32_t* p = reinterpret_cast<int32_t*>(out + 4);
+  uint8_t* p = out + 4;
   for (uint32_t i = 0; i < n; ++i) {
-    p[2 * i] = slots[i];
-    p[2 * i + 1] = rounds[i];
+    std::memcpy(p, &slots[i], 8);
+    std::memcpy(p + 8, &rounds[i], 4);
+    p += 12;
   }
   return static_cast<long long>(total);
 }
 
 long long fpx_unpack_votes2(const uint8_t* buf, uint64_t len,
-                            int32_t* slots, int32_t* rounds, uint32_t cap) {
+                            int64_t* slots, int32_t* rounds, uint32_t cap) {
   if (len < 4) return -1;
   uint32_t n;
   std::memcpy(&n, buf, 4);
-  if (len < 4ull + 8ull * n || n > cap) return -1;
-  const int32_t* p = reinterpret_cast<const int32_t*>(buf + 4);
+  if (len < 4ull + 12ull * n || n > cap) return -1;
+  const uint8_t* p = buf + 4;
   for (uint32_t i = 0; i < n; ++i) {
-    slots[i] = p[2 * i];
-    rounds[i] = p[2 * i + 1];
+    std::memcpy(&slots[i], p, 8);
+    std::memcpy(&rounds[i], p + 8, 4);
+    p += 12;
   }
   return n;
 }

@@ -160,7 +160,7 @@ class Acceptor(Actor):
 
                 from frankenpaxos_tpu import native
 
-                slots = np.fromiter((s for s, _ in acks), dtype=np.int32,
+                slots = np.fromiter((s for s, _ in acks), dtype=np.int64,
                                     count=len(acks))
                 rounds = np.fromiter((r for _, r in acks), dtype=np.int32,
                                      count=len(acks))

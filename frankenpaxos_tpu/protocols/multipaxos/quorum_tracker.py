@@ -241,6 +241,11 @@ class TpuQuorumTracker(QuorumTracker):
 
     def record_range(self, slot_start, slot_end, round, group_index,
                      acceptor_index) -> None:
+        if slot_end <= slot_start:
+            # Drop empties like record_votes does: an empty range as
+            # ra[0] would seed rnd0/lo from a zero-vote entry and yield
+            # hi = start - 1 in _drain_sync.
+            return
         self._ranges.append((slot_start, slot_end,
                              group_index * self._row_size
                              + acceptor_index, round))
@@ -493,7 +498,15 @@ class TpuQuorumTracker(QuorumTracker):
         if len(results) <= 8:  # scalar ring ops beat array setup here
             n = self._dedup_slot.shape[0]
             out = []
+            seen: set[int] = set()
             for slot, rnd in results:
+                if slot in seen:
+                    # Mixed-round churn can complete one slot at two
+                    # rounds in one drain; keep the first (oldest
+                    # round, arrival order) so the ring holds exactly
+                    # one (slot, round) pair per slot.
+                    continue
+                seen.add(slot)
                 i = slot % n
                 if (self._dedup_slot[i] != slot
                         or self._dedup_round[i] != rnd):
@@ -503,6 +516,20 @@ class TpuQuorumTracker(QuorumTracker):
             return out
         slots = np.asarray([s for s, _ in results], dtype=np.int64)
         rounds = np.asarray([r for _, r in results], dtype=np.int64)
+        # _fresh_mask requires unique slots (its last-wins fancy-indexed
+        # ring write forgets one pair otherwise, re-reporting a later
+        # duplicate re-ack): dedup to one entry per slot, keeping the
+        # first = oldest-round arrival, as the dict oracle reports.
+        # The DROPPED (slot, newer-round) pair is never reported -- a
+        # later re-ack completing it would be its FIRST report, which
+        # the per-(slot, round) exactly-once contract permits (the
+        # ring can only remember one round per slot).
+        uniq, first = np.unique(slots, return_index=True)
+        if uniq.size != slots.size:
+            first.sort()
+            slots = slots[first]
+            rounds = rounds[first]
+            results = [results[i] for i in first.tolist()]
         fresh = self._fresh_mask(slots, rounds)
         if fresh.all():
             return results

@@ -255,7 +255,11 @@ class Phase2bRangeCodec(MessageCodec):
 
 class Phase2bVotesCodec(MessageCodec):
     message_type = Phase2bVotes
-    tag = 113
+    # 114: payload records widened from (i32 slot, i32 round) to
+    # (i64 slot, i32 round). The tag bump makes any decoder that only
+    # knows the 8-byte layout drop the frame loudly (unknown tag)
+    # instead of silently mis-decoding 12-byte records.
+    tag = 114
 
     def encode(self, out, message):
         out += _I32.pack(message.group_index)
@@ -266,6 +270,14 @@ class Phase2bVotesCodec(MessageCodec):
         (group,) = _I32.unpack_from(buf, at)
         (acceptor,) = _I32.unpack_from(buf, at + 4)
         packed, at = _take_bytes(buf, at + 8)
+        # Validate the packed payload's count against its length HERE,
+        # inside decode, so a malformed/hostile payload raises in the
+        # transport's corrupt-frame guard (clean log-and-drop) instead
+        # of inside the ProxyLeader's handler -- and before
+        # unpack_votes2 sizes any allocation by the claimed count.
+        from frankenpaxos_tpu import native
+
+        native.check_votes2(packed)
         return Phase2bVotes(group_index=group, acceptor_index=acceptor,
                             packed=packed), at
 

@@ -513,6 +513,65 @@ def test_quorum_tracker_mixed_round_drain_reports_old_quorum():
         assert (5, 0) in out, (tracker_cls, out)
 
 
+def test_quorum_tracker_duplicate_slot_two_rounds_one_drain():
+    """Advisor-found: a mixed-round host drain completing ONE slot at
+    TWO rounds fed ``_fresh_mask`` duplicate slots, whose last-wins
+    fancy-indexed ring write forgot one (slot, round) pair -- a later
+    device re-ack of the forgotten pair was then re-reported,
+    violating exactly-once. The host drain now dedups to one entry per
+    slot (the first = oldest round, arrival order, as the oracle
+    reports). The dropped newer-round pair is simply never reported in
+    that drain; a later re-ack completing it would be that pair's
+    FIRST report, which the per-(slot, round) contract permits."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    t = TpuQuorumTracker(sim.config, window=1 << 10, min_device_slots=1)
+    # One mixed-round drain (mixed rounds always spill to the host
+    # tally): slot 5 completes at round 0 AND round 1, plus 10 more
+    # round-0 slots so the host drain takes the vectorized (>8) path.
+    t.record(5, 0, 0, 0)
+    t.record(5, 0, 0, 1)
+    t.record(5, 1, 0, 0)
+    t.record(5, 1, 0, 1)
+    for slot in range(10, 20):
+        t.record(slot, 0, 0, 0)
+        t.record(slot, 0, 0, 1)
+    out = t.drain()
+    assert [s for s, _ in out].count(5) == 1 and (5, 0) in out, out
+    # A wide dense round-0 re-ack containing slot 5 (the stateless
+    # device path, checked against the dedup ring) must not re-report
+    # any already-reported slot.
+    for slot in range(0, 200):
+        t.record(slot, 0, 0, 0)
+        t.record(slot, 0, 0, 2)
+    out2 = t.drain()
+    reported = {s for s, _ in out2}
+    assert 5 not in reported, out2
+    assert reported.isdisjoint(range(10, 20)), out2
+    assert set(range(0, 5)).issubset(reported)
+
+
+def test_quorum_tracker_empty_range_ignored():
+    """An empty Phase2bRange (slot_end <= slot_start) is dropped at the
+    door like empty packed votes: as ra[0] it would seed the drain's
+    round/lo from a zero-vote entry and skew hi to start - 1."""
+    from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
+        TpuQuorumTracker,
+    )
+
+    sim = make_multipaxos(f=1)
+    t = TpuQuorumTracker(sim.config, window=1 << 10)
+    t.record_range(7, 7, 0, 0, 0)
+    assert t.drain() == []
+    t.record_range(7, 3, 5, 0, 0)  # inverted: also dropped
+    t.record_range(3, 5, 0, 0, 0)
+    t.record_range(3, 5, 0, 0, 1)
+    assert sorted(t.drain()) == [(3, 0), (4, 0)]
+
+
 def test_quorum_tracker_ranged_votes_match_dict():
     """Phase2bRange votes (O(1) Python on the device tracker, per-slot
     expansion on the dict oracle) report identical quorums across mixed

@@ -95,10 +95,13 @@ def test_pack_votes2_round_trip_native_and_fallback():
 
     from frankenpaxos_tpu import native
 
-    slots = np.array([3, 5, 9, 1000000], dtype=np.int32)
+    # Slots past 2^31: the wire carries i64 slots like the sibling
+    # Phase2b/Phase2bRange codecs, so the packed path has no int32
+    # slot ceiling the rest of the framework lacks.
+    slots = np.array([3, 5, 9, 1 << 40], dtype=np.int64)
     rounds = np.array([0, 0, 2, 7], dtype=np.int32)
     packed = native.pack_votes2(slots, rounds)
-    assert len(packed) == 4 + 8 * 4
+    assert len(packed) == 4 + 12 * 4
     s, r = native.unpack_votes2(packed)
     assert list(s) == list(slots) and list(r) == list(rounds)
     # Fallback equivalence.
@@ -109,3 +112,34 @@ def test_pack_votes2_round_trip_native_and_fallback():
         assert list(s2) == list(slots) and list(r2) == list(rounds)
     finally:
         native._lib, native._load_failed = lib, False
+
+
+def test_unpack_votes2_rejects_hostile_count_without_allocating():
+    """A payload claiming u32-max votes must raise ValueError from the
+    length check -- never attempt a count-sized allocation."""
+    import struct as _struct
+
+    from frankenpaxos_tpu import native
+
+    hostile = _struct.pack("<I", 0xFFFFFFFF) + b"\x00" * 24
+    with pytest.raises(ValueError):
+        native.unpack_votes2(hostile)
+    with pytest.raises(ValueError):
+        native.unpack_votes(hostile)
+    with pytest.raises(ValueError):
+        native.check_votes2(b"\x01")  # short count header
+    # The message codec rejects it at decode time, inside the
+    # transport's corrupt-frame guard.
+    from frankenpaxos_tpu.protocols.multipaxos.wire import (
+        Phase2bVotesCodec,
+    )
+    from frankenpaxos_tpu.protocols.multipaxos.messages import (
+        Phase2bVotes,
+    )
+
+    codec = Phase2bVotesCodec()
+    out = bytearray()
+    codec.encode(out, Phase2bVotes(group_index=0, acceptor_index=1,
+                                   packed=hostile))
+    with pytest.raises(ValueError):
+        codec.decode(bytes(out), 0)
