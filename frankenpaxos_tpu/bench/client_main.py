@@ -83,7 +83,8 @@ def _closed_loops(transport, num_loops: int, duration_s: float,
 
 def run(protocol_name: str, config_raw: dict, workload, *,
         num_clients: int, duration_s: float, read_consistency: str,
-        seed: int = 0, warmup_s: float = 0.25) -> list:
+        seed: int = 0, warmup_s: float = 0.25,
+        overrides: dict | None = None) -> list:
     """Drive the workload against multipaxos (pseudonym-keyed write/read
     client loops); returns [(kind, start_unix_s, latency_s)]."""
     protocol = get_protocol(protocol_name)
@@ -92,7 +93,7 @@ def run(protocol_name: str, config_raw: dict, workload, *,
     transport = TcpTransport(("127.0.0.1", free_port()), logger)
     transport.start()
     ctx = DeployCtx(config=config, transport=transport, logger=logger,
-                    overrides={}, seed=seed)
+                    overrides=overrides or {}, seed=seed)
     client = protocol.make_client(ctx, transport.listen_address)
     read_method = READ_METHODS[read_consistency]
     rngs = [random.Random((seed << 20) + p) for p in range(num_clients)]
@@ -150,6 +151,9 @@ def main(argv=None) -> None:
     parser.add_argument("--duration", type=float, required=True)
     parser.add_argument("--read_consistency", default="linearizable")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--client_options", default=None,
+                        help="JSON dict of ClientOptions overrides "
+                             "(e.g. {\"coalesce_writes\": \"true\"})")
     parser.add_argument("--out", required=True)
     args = parser.parse_args(argv)
 
@@ -170,7 +174,9 @@ def main(argv=None) -> None:
                    num_clients=args.num_clients,
                    duration_s=args.duration,
                    read_consistency=args.read_consistency,
-                   seed=args.seed)
+                   seed=args.seed,
+                   overrides=(json.loads(args.client_options)
+                              if args.client_options else None))
     with open(args.out, "w") as f:
         f.write("kind,start_unix_s,latency_s\n")
         for kind, start, latency in rows:

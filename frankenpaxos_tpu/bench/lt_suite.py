@@ -101,35 +101,55 @@ def _drive_waves(sim, inflight: int, waves: int, tag: bytes,
     """Issue ``waves`` closed-loop waves of ``inflight`` writes each and
     deliver them in coalesced waves (the real event loop's drain
     granularity). Shared by every sim-pipeline benchmark here so the
-    driving protocol cannot drift between them."""
+    driving protocol cannot drift between them. ``flush_writes`` ships
+    a coalescing client's staged array (no-op otherwise), standing in
+    for the real event loop's end-of-pass flush."""
     for b in range(waves):
         for p in range(inflight):
             sim.clients[0].write(p, b"%s%d.%d" % (tag, b, p),
                                  results.append)
+        sim.clients[0].flush_writes()
         sim.transport.deliver_all_coalesced()
 
 
 def sim_ab_pipeline(inflights, reps: int = 6, waves: int = 0,
                     warm: int = 4) -> dict:
-    """Interleaved A/B of the full SimTransport actor pipeline, dict vs
-    tpu quorum backends, in ONE process with XLA resident for both.
+    """Interleaved A/B/C of the full SimTransport actor pipeline in ONE
+    process with XLA resident throughout:
 
-    Per in-flight width: ``reps`` pairs of runs, order alternating
-    (dict-first on even reps, tpu-first on odd), each pair yielding a
-    tpu/dict throughput ratio; the MEDIAN of paired ratios is robust to
-    the two confounds that made cross-process comparisons jitter
-    +-30% on this 1-CPU host: process-to-process variance and the
-    monotonic in-process slowdown drift."""
+      * ``dict``     -- the reference design: per-message Python
+        (ClientRequest/Phase2a/Phase2b/Chosen per slot), host-dict vote
+        tracking. The baseline.
+      * ``tpu``      -- the tpu-first design: the drain-granular run
+        pipeline (ClientRequestArray -> Phase2aRun -> Phase2bRange ->
+        ChosenRun -> ClientReplyArray; per-message Python scales with
+        drains, not commands) with the device-backed quorum tracker.
+      * ``dict+run`` -- ablation: the same run pipeline over the
+        host-dict tracker, isolating how much of tpu-vs-dict comes
+        from drain-granular message structure vs device vote tracking.
+
+    Per in-flight width: ``reps`` triples of runs with rotating order,
+    each yielding per-pair ratios; the MEDIAN of paired ratios is
+    robust to the two confounds that made cross-process comparisons
+    jitter +-30% on this 1-CPU host: process-to-process variance and
+    the monotonic in-process slowdown drift."""
     import gc
     import statistics
 
     from tests.protocols.multipaxos_harness import make_multipaxos
 
-    def measure(backend: str, inflight: int, w: int) -> float:
+    ARMS = {
+        "dict": dict(quorum_backend="dict", coalesced=False),
+        "tpu": dict(quorum_backend="tpu", coalesced=True),
+        "dict+run": dict(quorum_backend="dict", coalesced=True),
+    }
+
+    def measure(arm: str, inflight: int, w: int) -> float:
         gc.collect()
-        sim = make_multipaxos(f=1, quorum_backend=backend)
+        sim = make_multipaxos(f=1, **ARMS[arm])
         results = []
         sim.clients[0].write(0, b"warmup", results.append)
+        sim.clients[0].flush_writes()
         sim.transport.deliver_all_coalesced()
         _drive_waves(sim, inflight, warm, b"w", results)
         t0 = time.perf_counter()
@@ -139,6 +159,7 @@ def sim_ab_pipeline(inflights, reps: int = 6, waves: int = 0,
         return w * inflight / elapsed
 
     measure("tpu", 16, 4)  # XLA + tracker kernels resident before timing
+    order = ["dict", "tpu", "dict+run"]
     table = {}
     for inflight in inflights:
         # Enough waves that per-run noise stays small at narrow
@@ -146,21 +167,29 @@ def sim_ab_pipeline(inflights, reps: int = 6, waves: int = 0,
         # fewer waves keep a run to seconds.
         w = waves or max(12 if inflight >= 2048 else 24,
                          2048 // inflight)
-        dict_runs, tpu_runs, ratios = [], [], []
+        runs: dict[str, list] = {arm: [] for arm in ARMS}
+        ratios: dict[str, list] = {"tpu_over_dict": [],
+                                   "run_over_dict": [],
+                                   "tpu_over_run": []}
         for rep in range(reps):
-            if rep % 2 == 0:
-                d = measure("dict", inflight, w)
-                t = measure("tpu", inflight, w)
-            else:
-                t = measure("tpu", inflight, w)
-                d = measure("dict", inflight, w)
-            dict_runs.append(d)
-            tpu_runs.append(t)
-            ratios.append(t / d)
+            rot = order[rep % 3:] + order[:rep % 3]
+            got = {arm: measure(arm, inflight, w) for arm in rot}
+            for arm in ARMS:
+                runs[arm].append(got[arm])
+            ratios["tpu_over_dict"].append(got["tpu"] / got["dict"])
+            ratios["run_over_dict"].append(got["dict+run"] / got["dict"])
+            ratios["tpu_over_run"].append(got["tpu"] / got["dict+run"])
         table[str(inflight)] = {
-            "dict_cmds_per_sec": round(statistics.median(dict_runs), 1),
-            "tpu_cmds_per_sec": round(statistics.median(tpu_runs), 1),
-            "tpu_over_dict_ratio": round(statistics.median(ratios), 3),
+            "dict_cmds_per_sec": round(statistics.median(runs["dict"]), 1),
+            "tpu_cmds_per_sec": round(statistics.median(runs["tpu"]), 1),
+            "dict_run_cmds_per_sec": round(
+                statistics.median(runs["dict+run"]), 1),
+            "tpu_over_dict_ratio": round(
+                statistics.median(ratios["tpu_over_dict"]), 3),
+            "run_over_dict_ratio": round(
+                statistics.median(ratios["run_over_dict"]), 3),
+            "tpu_over_run_ratio": round(
+                statistics.median(ratios["tpu_over_run"]), 3),
         }
     return table
 
@@ -337,14 +366,22 @@ def main(argv=None) -> dict:
         if not rows:
             continue
         ratios = [r["tpu_over_dict_ratio"] for r in rows]
+        run_ratios = [r["run_over_dict_ratio"] for r in rows]
+        tpu_run_ratios = [r["tpu_over_run_ratio"] for r in rows]
         sim_ab[key] = {
             "tpu_over_dict_ratio": round(_stats.median(ratios), 3),
             "ratio_range": [min(ratios), max(ratios)],
+            "run_over_dict_ratio": round(_stats.median(run_ratios), 3),
+            "run_over_dict_range": [min(run_ratios), max(run_ratios)],
+            "tpu_over_run_ratio": round(
+                _stats.median(tpu_run_ratios), 3),
             "batches": len(rows),
             "dict_cmds_per_sec_med": round(_stats.median(
                 r["dict_cmds_per_sec"] for r in rows), 1),
             "tpu_cmds_per_sec_med": round(_stats.median(
                 r["tpu_cmds_per_sec"] for r in rows), 1),
+            "dict_run_cmds_per_sec_med": round(_stats.median(
+                r["dict_run_cmds_per_sec"] for r in rows), 1),
         }
     crossover = next((i for i in inflights
                       if sim_ab.get(str(i), {})
@@ -441,36 +478,33 @@ def main(argv=None) -> dict:
             "batches of each batch's paired-A/B median; ranges "
             "recorded"),
         "note": ("sim_ab_pipeline: full actor pipeline over "
-                 "SimTransport, dict vs tpu quorum backends, "
-                 "interleaved paired A/B medians (local XLA). The tpu "
-                 "tracker routes adaptively: trickle drains to a host "
-                 "tally (the fixed device round-trip cannot beat "
-                 "~0.6us/vote Python below ~100 slots -- the standard "
-                 "small-batch host fallback), wide drains to ONE "
-                 "stateless quorum matmul per drain with below-quorum "
-                 "residue spilling to the host tally. On this 1-CPU "
-                 "host each local-XLA device call additionally taxes "
-                 "the surrounding Python pipeline ~2-4ms (kernel "
-                 "execution and thread-pool churn timeshare with the "
-                 "event loop), so the auto threshold engages the "
-                 "device at ~1k-slot drains here; on real TPU "
-                 "hardware the threshold is 96. tracker_votes_per_sec "
-                 "isolates the ProxyLeader vote-collection component "
-                 "(ProxyLeader.scala:217-258) with the device path "
-                 "pinned on: per-slot Phase2b replays cross over at "
-                 "~1k-slot drains, RANGED ack replays "
-                 "(Phase2bRange, the acceptors' default batched "
-                 "shape) win from 256-slot drains up (measured up to "
-                 "~7x at 4096). The end-to-end sim ratios sit at "
-                 "parity-or-better because vote tracking is only "
-                 "~1-7% of per-command cost in this Python actor "
-                 "pipeline at f=1 -- the lift matters at the "
-                 "component level and in the block-granular "
-                 "device-resident pipeline (bench.py, ~1.6B cmds/s). "
-                 "Deployed tpu points run pipelined drains over the "
-                 "axon tunnel (~10-100ms RTT per round-trip, hidden "
-                 "behind the event loop but bounding choose "
-                 "latency)."),
+                 "SimTransport, interleaved paired A/B/C medians "
+                 "(local XLA). 'dict' is the reference design "
+                 "(per-message Python, host-dict vote tracking); "
+                 "'tpu' is the tpu-first drain-granular run pipeline "
+                 "(ClientRequestArray -> Phase2aRun -> Phase2bRange "
+                 "-> ChosenRun -> ClientReplyArray: per-message "
+                 "Python scales with event-loop drains, not "
+                 "commands; lazy value arrays mean forwarding roles "
+                 "never materialize Command objects) over the "
+                 "device-backed tracker; run_over_dict_ratio is the "
+                 "dict-tracker ablation of the same run pipeline, "
+                 "isolating message-structure wins from vote-"
+                 "tracking wins. The tpu tracker routes adaptively: "
+                 "trickle drains to a host tally, wide drains to ONE "
+                 "stateless quorum matmul per drain. On this 1-CPU "
+                 "host each local-XLA device call taxes the "
+                 "surrounding pipeline ~2-4ms, so the auto threshold "
+                 "engages the device at ~1k-slot drains; on real TPU "
+                 "hardware the threshold is 96. "
+                 "tracker_votes_per_sec isolates the ProxyLeader "
+                 "vote-collection component with the device path "
+                 "pinned on: per-slot replays cross over at ~1k-slot "
+                 "drains, RANGED ack replays win from 256 up "
+                 "(measured up to ~7x at 4096). Deployed tpu points "
+                 "run pipelined drains over the axon tunnel "
+                 "(~10-100ms RTT, hidden behind the event loop but "
+                 "bounding choose latency)."),
     }
     if args.out:
         with open(args.out, "w") as f:

@@ -36,6 +36,11 @@ class MultiPaxosInput:
     # Pipelined device drains for the tpu backend (hide the device-link
     # RTT behind the event loop; see ProxyLeaderOptions.tpu_pipelined).
     tpu_pipelined: bool = False
+    # The drain-granular run pipeline (ClientRequestArray -> Phase2aRun
+    # -> Phase2bRange -> ChosenRun -> ClientReplyArray): clients
+    # coalesce each event-loop pass's writes into one array and every
+    # downstream hop works in contiguous slot runs.
+    coalesced: bool = False
     state_machine: str = "KeyValueStore"
     # A ReadWriteWorkload (bench/workload.py); None -> the legacy
     # write-only SetRequest loop.
@@ -90,6 +95,8 @@ def run_benchmark(bench: BenchmarkDirectory,
     overrides = {"quorum_backend": input.quorum_backend}
     if input.tpu_pipelined:
         overrides["tpu_pipelined"] = "true"
+    if input.coalesced:
+        overrides["coalesce_writes"] = "true"
     launch_roles(bench, "multipaxos", config_path, config,
                  state_machine=input.state_machine,
                  overrides=overrides,
@@ -151,7 +158,9 @@ def run_benchmark(bench: BenchmarkDirectory,
         transport = TcpTransport(("127.0.0.1", free_port()), logger)
         transport.start()
         client = Client(transport.listen_address, transport, logger,
-                        config, ClientOptions(), seed=i)
+                        config,
+                        ClientOptions(coalesce_writes=input.coalesced),
+                        seed=i)
         rng = _random.Random(1000 + i)
         try:
             k = 0
@@ -231,7 +240,10 @@ def _run_with_client_procs(bench: BenchmarkDirectory,
             "--num_clients", str(input.num_clients),
             "--duration", str(input.duration_s),
             "--read_consistency", input.read_consistency,
-            "--seed", str(i), "--out", out_csv], env=env)))
+            "--seed", str(i), "--out", out_csv]
+            + (["--client_options",
+                json.dumps({"coalesce_writes": "true"})]
+               if input.coalesced else []), env=env)))
     try:
         deadline = input.duration_s + 90
         for _, proc in procs:

@@ -26,6 +26,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase1b,
     Phase1bSlotInfo,
     Phase2a,
+    Phase2aRun,
     Phase2b,
     Phase2bRange,
     Phase2bVotes,
@@ -71,6 +72,14 @@ class Acceptor(Actor):
         self.round_system = ClassicRoundRobin(config.num_leaders)
         self.round = -1
         self.states: SortedDict = SortedDict()  # slot -> _VoteState
+        # Run-voted state (Phase2aRun): start -> (end, round, values) --
+        # one O(1) record per run instead of per-slot _VoteStates. A
+        # slot's authoritative vote is the HIGHEST round across both
+        # stores (see _voted_info); the acceptor's monotone ``round``
+        # means later votes never have a lower round, and equal-round
+        # double-votes carry the same value (one proposal per
+        # (slot, round)), so max-round resolution is exact.
+        self._voted_runs: SortedDict = SortedDict()
         self.max_voted_slot = -1
         # Phase2b acks staged during this drain: dst -> [(slot, round)].
         self._pending_phase2bs: dict[Address, list] = {}
@@ -91,6 +100,9 @@ class Acceptor(Actor):
         elif isinstance(message, Phase2a):
             self.metrics_requests.labels("Phase2a").inc()
             self._handle_phase2a(src, message)
+        elif isinstance(message, Phase2aRun):
+            self.metrics_requests.labels("Phase2aRun").inc()
+            self._handle_phase2a_run(src, message)
         elif isinstance(message, MaxSlotRequest):
             self.metrics_requests.labels("MaxSlotRequest").inc()
             self._handle_max_slot_request(src, message)
@@ -108,14 +120,31 @@ class Acceptor(Actor):
             self.send(src, Nack(round=self.round))
             return
         self.round = phase1a.round
-        info = tuple(
-            Phase1bSlotInfo(slot=slot,
-                            vote_round=self.states[slot].vote_round,
-                            vote_value=self.states[slot].vote_value)
-            for slot in self.states.irange(minimum=phase1a.chosen_watermark))
-        self.send(src, Phase1b(group_index=self.group_index,
-                               acceptor_index=self.index,
-                               round=self.round, info=info))
+        self.send(src, Phase1b(
+            group_index=self.group_index, acceptor_index=self.index,
+            round=self.round,
+            info=self._voted_info(phase1a.chosen_watermark)))
+
+    def _voted_info(self, minimum: int) -> tuple:
+        """Every voted slot >= ``minimum`` with its HIGHEST-round vote,
+        merging the per-slot store and the run store (a failover that
+        ignored run votes would recover Noop over accepted values --
+        data loss). Recovery-only cold path, so runs expand per slot
+        here and nowhere else."""
+        best: dict[int, tuple] = {
+            slot: (self.states[slot].vote_round,
+                   self.states[slot].vote_value)
+            for slot in self.states.irange(minimum=minimum)}
+        for start, (end, rnd, values) in self._voted_runs.items():
+            if end <= minimum:
+                continue
+            for slot in range(max(start, minimum), end):
+                cur = best.get(slot)
+                if cur is None or rnd > cur[0]:
+                    best[slot] = (rnd, values[slot - start])
+        return tuple(
+            Phase1bSlotInfo(slot=slot, vote_round=rnd, vote_value=value)
+            for slot, (rnd, value) in sorted(best.items()))
 
     def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
         if phase2a.round < self.round:
@@ -141,6 +170,28 @@ class Acceptor(Actor):
             self.send(src, Phase2b(group_index=self.group_index,
                                    acceptor_index=self.index,
                                    slot=phase2a.slot, round=self.round))
+
+    def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
+        """A whole contiguous proposal run in one O(1) update: one round
+        check, one run record, one ranged ack -- the per-drain shape of
+        Acceptor.scala:184-220's per-slot handlePhase2a."""
+        if run.round < self.round:
+            leader = self.config.leader_addresses[
+                self.round_system.leader(run.round)]
+            self.send(leader, Nack(round=self.round))
+            return
+        self.round = run.round
+        end = run.start_slot + len(run.values)
+        self._voted_runs[run.start_slot] = (end, run.round, run.values)
+        self.max_voted_slot = max(self.max_voted_slot, end - 1)
+        # Ack immediately as one range: the run is already a contiguous
+        # same-round block, so drain-end staging (whose merge loop is
+        # per-slot) would cost Python without saving messages.
+        self.send(src, Phase2bRange(group_index=self.group_index,
+                                    acceptor_index=self.index,
+                                    slot_start_inclusive=run.start_slot,
+                                    slot_end_exclusive=end,
+                                    round=run.round))
 
     def on_drain(self) -> None:
         if not self._pending_phase2bs:

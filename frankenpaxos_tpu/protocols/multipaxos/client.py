@@ -28,7 +28,9 @@ from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ClientReply,
+    ClientReplyArray,
     ClientRequest,
+    ClientRequestArray,
     Command,
     CommandId,
     EventualReadRequest,
@@ -56,6 +58,12 @@ class ClientOptions:
     flush_writes_every_n: int = 1
     flush_reads_every_n: int = 1
     measure_latencies: bool = True
+    # Coalesce this event-loop pass's writes into ONE ClientRequestArray
+    # to the leader (each command still gets its own slot -- see
+    # messages.ClientRequestArray). Flushed by on_drain / flush_writes;
+    # resends still go per-request. Bypasses batchers: the array is
+    # transport-level coalescing, not slot sharing.
+    coalesce_writes: bool = False
 
 
 @dataclasses.dataclass
@@ -103,6 +111,13 @@ class Client(Actor):
         self.ids: dict[int, int] = {}               # pseudonym -> next id
         self.states: dict[int, object] = {}         # pseudonym -> pending op
         self.largest_seen_slots: dict[int, int] = {}  # pseudonym -> slot
+        # Writes staged by coalesce_writes, shipped on flush_writes().
+        self._staged_writes: list[Command] = []
+        self._flush_scheduled = False
+        # One reusable resend timer per pseudonym (vs a fresh Timer per
+        # write): timer construction was a measurable per-command cost
+        # at drain widths in the thousands.
+        self._write_timers: dict[int, object] = {}
 
     # --- public API -------------------------------------------------------
     def write(self, pseudonym: int, command: bytes,
@@ -112,18 +127,44 @@ class Client(Actor):
         id = self.ids.get(pseudonym, 0)
         request = ClientRequest(Command(
             CommandId(self.address, pseudonym, id), command))
-        self._send_client_request(request)
-
-        def resend():
+        if self.options.coalesce_writes:
+            self._staged_writes.append(request.command)
+            # On a real event-loop transport, flush at the END of this
+            # loop pass: writes issued in one pass (a burst of
+            # call_soon'd closed loops, or reissues inside a delivery
+            # drain) coalesce into one array. SimTransport has no loop;
+            # there on_drain / an explicit flush_writes() ships them.
+            loop = getattr(self.transport, "loop", None)
+            if loop is not None and not self._flush_scheduled:
+                self._flush_scheduled = True
+                # threadsafe: write() may be driven from off-loop
+                # threads (the in-process bench driver does).
+                loop.call_soon_threadsafe(self._deferred_flush)
+        else:
             self._send_client_request(request)
-            timer.start()
-
-        timer = self.timer(f"resendWrite{pseudonym}",
-                           self.options.resend_client_request_period_s,
-                           resend)
+        timer = self._write_resend_timer(pseudonym)
         timer.start()
         self.states[pseudonym] = _PendingWrite(id, command, callback, timer)
         self.ids[pseudonym] = id + 1
+
+    def _write_resend_timer(self, pseudonym: int):
+        timer = self._write_timers.get(pseudonym)
+        if timer is None:
+            def resend():
+                # Reads the CURRENT pending write (the timer outlives
+                # individual operations).
+                state = self.states.get(pseudonym)
+                if isinstance(state, _PendingWrite):
+                    self._send_client_request(ClientRequest(Command(
+                        CommandId(self.address, pseudonym, state.id),
+                        state.command)))
+                    timer.start()
+
+            timer = self.timer(
+                f"resendWrite{pseudonym}",
+                self.options.resend_client_request_period_s, resend)
+            self._write_timers[pseudonym] = timer
+        return timer
 
     def read(self, pseudonym: int, command: bytes,
              callback: Optional[Callback] = None) -> None:
@@ -232,6 +273,22 @@ class Client(Actor):
                 self.round_system.leader(self.round)]
         self.send(dst, request)
 
+    def flush_writes(self) -> None:
+        """Ship writes staged by ``coalesce_writes`` as one array."""
+        if not self._staged_writes:
+            return
+        staged, self._staged_writes = self._staged_writes, []
+        dst = self.config.leader_addresses[
+            self.round_system.leader(self.round)]
+        self.send(dst, ClientRequestArray(commands=tuple(staged)))
+
+    def _deferred_flush(self) -> None:
+        self._flush_scheduled = False
+        self.flush_writes()
+
+    def on_drain(self) -> None:
+        self.flush_writes()
+
     def _make_read_resend_timer(self, pseudonym: int, replica: Address,
                                 request) -> object:
         def resend():
@@ -247,6 +304,8 @@ class Client(Actor):
     def receive(self, src: Address, message) -> None:
         if isinstance(message, ClientReply):
             self._handle_client_reply(src, message)
+        elif isinstance(message, ClientReplyArray):
+            self._handle_client_reply_array(src, message)
         elif isinstance(message, MaxSlotReply):
             self._handle_max_slot_reply(src, message)
         elif isinstance(message, ReadReply):
@@ -271,6 +330,24 @@ class Client(Actor):
         del self.states[pseudonym]
         self.metrics_replies.inc()
         state.callback(reply.result)
+
+    def _handle_client_reply_array(self, src: Address,
+                                   array: ClientReplyArray) -> None:
+        """A replica's whole drain of replies to this client in one
+        message; per-entry resolution mirrors _handle_client_reply."""
+        for pseudonym, client_id, slot, result in array.entries:
+            state = self.states.get(pseudonym)
+            if not isinstance(state, _PendingWrite) \
+                    or client_id != state.id:
+                self.logger.debug(
+                    f"stale reply-array entry for pseudonym {pseudonym}")
+                continue
+            state.resend.stop()
+            self.largest_seen_slots[pseudonym] = max(
+                self.largest_seen_slots.get(pseudonym, -1), slot)
+            del self.states[pseudonym]
+            self.metrics_replies.inc()
+            state.callback(result)
 
     def _handle_max_slot_reply(self, src: Address,
                                reply: MaxSlotReply) -> None:

@@ -36,6 +36,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     NOOP,
     ChosenWatermark,
     ClientRequest,
+    ClientRequestArray,
     ClientRequestBatch,
     CommandBatch,
     LeaderInfoReplyBatcher,
@@ -48,6 +49,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase1a,
     Phase1b,
     Phase2a,
+    Phase2aRun,
     Recover,
 )
 
@@ -230,17 +232,12 @@ class Leader(Actor):
         return [values_by_id[int(vid)] if hit else NOOP
                 for hit, vid in zip(has_vote, chosen)]
 
-    def _send_phase2a(self, phase2a: Phase2a,
-                      force_flush: bool = False) -> None:
-        dst = self._proxy_leader_address()
-        if self.options.flush_phase2as_every_n <= 1:
-            self.send(dst, phase2a)
-        else:
-            self.send_no_flush(dst, phase2a)
-            self._unflushed_phase2as += 1
-        # Rotate proxy leaders every `chunk` slots (>= the flush batch,
-        # so a no-flush run never strands bytes on a just-left dst).
-        self._chunk_sent += 1
+    def _account_sent_slots(self, dst: Address, k: int) -> None:
+        """Rotate proxy leaders every `chunk` slots (>= the flush batch,
+        so a no-flush run never strands bytes on a just-left dst). The
+        ONE place the rotation schedule lives -- shared by the per-slot
+        and run proposal paths."""
+        self._chunk_sent += k
         chunk = max(self.options.proxy_leader_chunk,
                     self.options.flush_phase2as_every_n)
         if self._chunk_sent >= chunk:
@@ -253,6 +250,16 @@ class Leader(Actor):
               >= self.options.flush_phase2as_every_n):
             self.flush(dst)
             self._unflushed_phase2as = 0
+
+    def _send_phase2a(self, phase2a: Phase2a,
+                      force_flush: bool = False) -> None:
+        dst = self._proxy_leader_address()
+        if self.options.flush_phase2as_every_n <= 1:
+            self.send(dst, phase2a)
+        else:
+            self.send_no_flush(dst, phase2a)
+            self._unflushed_phase2as += 1
+        self._account_sent_slots(dst, 1)
         if force_flush and self._unflushed_phase2as:
             self.flush(dst)
             self._unflushed_phase2as = 0
@@ -346,6 +353,8 @@ class Leader(Actor):
         handlers = [
             (Phase1b, "Phase1b", self._handle_phase1b),
             (ClientRequest, "ClientRequest", self._handle_client_request),
+            (ClientRequestArray, "ClientRequestArray",
+             self._handle_client_request_array),
             (ClientRequestBatch, "ClientRequestBatch",
              self._handle_client_request_batch),
             (LeaderInfoRequestClient, "LeaderInfoRequestClient",
@@ -416,6 +425,41 @@ class Leader(Actor):
         else:
             self._process_client_request_batch(
                 ClientRequestBatch(CommandBatch((request.command,))))
+
+    def _handle_client_request_array(self, src: Address,
+                                     array: ClientRequestArray) -> None:
+        """A drain's worth of independent requests: assign each its own
+        slot from a CONTIGUOUS block and propose the whole block as one
+        Phase2aRun (the per-drain shape of Leader.scala:331-408's
+        per-slot processClientRequestBatch)."""
+        if not array.commands:
+            return
+        if isinstance(self.state, _Inactive):
+            self.send(src, NotLeaderClient())
+            return
+        if isinstance(self.state, _Phase1):
+            for command in array.commands:
+                self.state.pending_batches.append(
+                    ClientRequestBatch(CommandBatch((command,))))
+            return
+        if self.config.num_acceptor_groups > 1 and not self.config.flexible:
+            # Slots stripe over acceptor groups (slot % G) in this mode,
+            # so a contiguous run has no single acceptor audience; fall
+            # back to per-slot proposals.
+            for command in array.commands:
+                self._process_client_request_batch(
+                    ClientRequestBatch(CommandBatch((command,))))
+            return
+        run = Phase2aRun(
+            start_slot=self.next_slot, round=self.round,
+            values=tuple(CommandBatch((c,)) for c in array.commands))
+        k = len(array.commands)
+        self.next_slot += k
+        dst = self._proxy_leader_address()
+        self.send(dst, run)
+        # A run counts as k slots toward the proxy-leader chunk
+        # rotation (runs never use the no-flush buffer).
+        self._account_sent_slots(dst, k)
 
     def _handle_client_request_batch(self, src: Address,
                                      batch: ClientRequestBatch) -> None:

@@ -166,6 +166,59 @@ class Phase2bVotes:
 
 
 @dataclasses.dataclass(frozen=True)
+class ClientRequestArray:
+    """A transport-level coalescing of INDEPENDENT client requests.
+
+    Unlike ClientRequestBatch (the reference's batcher output,
+    Batcher.scala:60-90, where the whole batch shares ONE log slot and
+    so trades latency for throughput), every command here gets its OWN
+    slot at the leader -- the array only exists so a client's burst of
+    writes crosses the wire as one message per event-loop drain instead
+    of one per command. Latency semantics are identical to sending each
+    ClientRequest individually; this is the client edge of the
+    drain-granular run pipeline (Phase2aRun/ChosenRun)."""
+
+    commands: tuple  # tuple[Command, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2aRun:
+    """Phase2as for a CONTIGUOUS slot run in one round, one message.
+
+    The proposal-side twin of Phase2bRange: the reference proposes one
+    Phase2a per slot (Leader.scala:331-408, one protobuf + one send
+    each); a leader that assigned a whole drain's commands contiguous
+    slots proposes them in ONE message whose values array lines up with
+    [start_slot, start_slot + len(values)). Acceptors store the run as
+    one O(1) record and ack it with one Phase2bRange -- per-slot Python
+    disappears from the propose/ack path entirely."""
+
+    start_slot: int
+    round: int
+    values: tuple  # tuple[CommandBatchOrNoop, ...], one per slot
+
+
+@dataclasses.dataclass(frozen=True)
+class ChosenRun:
+    """Chosen values for a contiguous slot run, one message per replica
+    per drain (vs one Chosen per slot, Replica.scala:572-628)."""
+
+    start_slot: int
+    values: tuple  # tuple[CommandBatchOrNoop, ...], one per slot
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientReplyArray:
+    """One replica's drain of replies to ONE client, coalesced.
+
+    Entries are (pseudonym, client_id, slot, result) -- the client
+    address rides the wire header (the message is addressed to it), so
+    per-entry addresses would be dead bytes."""
+
+    entries: tuple  # tuple[(int, int, int, bytes), ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Chosen:
     slot: int
     value: CommandBatchOrNoop

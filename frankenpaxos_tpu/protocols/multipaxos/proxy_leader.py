@@ -14,15 +14,20 @@ drain (``on_drain``).
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import random
+
+import numpy as np
 
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Chosen,
+    ChosenRun,
     Phase2a,
+    Phase2aRun,
     Phase2b,
     Phase2bRange,
     Phase2bVotes,
@@ -72,6 +77,15 @@ class ProxyLeader(Actor):
         # (slot, round) -> pending value; moved to _done once chosen.
         self.pending: dict[tuple[int, int], object] = {}
         self._done: set[tuple[int, int]] = set()
+        # Pending Phase2aRuns: start -> [end, round, values, remaining
+        # (bool ndarray), left]. One O(1) record per run; chosen slots
+        # resolve against it by bisect instead of per-slot dict entries.
+        self._runs: dict[int, list] = {}
+        self._run_starts: list[int] = []  # sorted (bisect.insort)
+        # Completed runs' (start, end, round), kept for the stray-ack
+        # fatal check (the per-slot path keeps _done forever; this is
+        # the run equivalent, far smaller).
+        self._done_runs: list[tuple[int, int, int]] = []
         self.chosen_count = 0
         self._unflushed_phase2as = 0
         if options.quorum_backend == "tpu":
@@ -133,6 +147,9 @@ class ProxyLeader(Actor):
         if isinstance(message, Phase2a):
             self.metrics_requests.labels("Phase2a").inc()
             self._handle_phase2a(src, message)
+        elif isinstance(message, Phase2aRun):
+            self.metrics_requests.labels("Phase2aRun").inc()
+            self._handle_phase2a_run(src, message)
         elif isinstance(message, Phase2b):
             self.metrics_requests.labels("Phase2b").inc()
             self._handle_phase2b(src, message)
@@ -174,13 +191,53 @@ class ProxyLeader(Actor):
                 self._unflushed_phase2as = 0
         self.pending[key] = phase2a.value
 
+    def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
+        """One write quorum for the whole run (drain-granular thrifty:
+        the reference samples per slot, ProxyLeader.scala:67-120; one
+        sample per run keeps acceptor-side runs whole), one forwarded
+        message per quorum member, one O(1) pending record."""
+        k = len(run.values)
+        if k == 0 or run.start_slot in self._runs:
+            return  # empty or duplicate
+        if not self.config.flexible:
+            group = list(self.config.acceptor_addresses[0])
+            quorum = self.rng.sample(group, self.config.f + 1)
+        else:
+            write_quorum = self.grid.random_write_quorum(self.rng)
+            quorum = [
+                self.config.acceptor_addresses[flat // self._row_size]
+                [flat % self._row_size] for flat in write_quorum]
+        self.broadcast(quorum, run)  # encode the values ONCE
+        self._runs[run.start_slot] = [
+            run.start_slot + k, run.round, run.values,
+            np.ones(k, dtype=bool), k]
+        bisect.insort(self._run_starts, run.start_slot)
+
+    def _run_for(self, slot: int, round: int):
+        """The pending run covering (slot, round), else None."""
+        i = bisect.bisect_right(self._run_starts, slot) - 1
+        if i < 0:
+            return None
+        run = self._runs.get(self._run_starts[i])
+        if run is not None and slot < run[0] and run[1] == round:
+            return run
+        return None
+
+    def _in_done_runs(self, slot: int, round: int) -> bool:
+        i = bisect.bisect_right(self._done_runs, (slot, float("inf"),
+                                                  float("inf"))) - 1
+        if i < 0:
+            return False
+        start, end, rnd = self._done_runs[i]
+        return slot < end and rnd == round
+
     def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
         key = (phase2b.slot, phase2b.round)
-        if key not in self.pending:
+        if key not in self.pending and self._run_for(*key) is None:
             # Either never proposed here (a fatal bug in the reference,
             # ProxyLeader.scala:227-232) or already chosen. The tracker
             # dedups chosen slots; unknown (slot, round)s are fatal.
-            if key not in self._done:
+            if key not in self._done and not self._in_done_runs(*key):
                 self.logger.fatal(
                     f"ProxyLeader got Phase2b for {key} but never sent a "
                     f"Phase2a there")
@@ -250,11 +307,84 @@ class ProxyLeader(Actor):
             self._emit_chosen(self.tracker.collect(dispatch))
 
     def _emit_chosen(self, keys) -> None:
+        if self._runs and len(keys) > 1:
+            self._emit_chosen_grouped(keys)
+            return
         for key in keys:
-            value = self.pending.pop(key, None)
-            if value is None:
+            self._emit_one(key)
+
+    def _emit_one(self, key) -> None:
+        value = self.pending.pop(key, None)
+        if value is None:
+            run = self._run_for(*key)
+            if run is not None:
+                self._emit_run_segment(run, key[0], key[0] + 1)
+            return
+        self._done.add(key)
+        self.chosen_count += 1
+        self.broadcast(self.config.replica_addresses,
+                       Chosen(slot=key[0], value=value))
+
+    def _emit_chosen_grouped(self, keys) -> None:
+        """Group a drain's chosen (slot, round)s into contiguous
+        same-round segments (preserving the tracker's arrival-order
+        reporting -- no sort) and emit each run-covered segment as ONE
+        ChosenRun per replica; anything outside a run falls back to the
+        per-slot path."""
+        slots = np.fromiter((k[0] for k in keys), dtype=np.int64,
+                            count=len(keys))
+        rounds = np.fromiter((k[1] for k in keys), dtype=np.int64,
+                             count=len(keys))
+        breaks = np.flatnonzero((np.diff(slots) != 1)
+                                | (np.diff(rounds) != 0)) + 1
+        at = 0
+        for b in list(breaks.tolist()) + [len(keys)]:
+            if b == at:
                 continue
-            self._done.add(key)
-            self.chosen_count += 1
-            for replica in self.config.replica_addresses:
-                self.send(replica, Chosen(slot=key[0], value=value))
+            lo = int(slots[at])
+            hi = int(slots[b - 1]) + 1
+            rnd = int(rounds[at])
+            run = self._run_for(lo, rnd)
+            if run is not None and hi <= run[0]:
+                self._emit_run_segment(run, lo, hi)
+            else:
+                for i in range(at, b):
+                    self._emit_one((int(slots[i]), rnd))
+            at = b
+
+    def _emit_run_segment(self, run: list, lo: int, hi: int) -> None:
+        """Emit chosen slots [lo, hi) of one pending run: slice the
+        values, one ChosenRun per replica, O(1) bookkeeping."""
+        end, rnd, values, remaining, left = run
+        start = end - len(values)
+        seg = remaining[lo - start:hi - start]
+        if not seg.all():
+            # A re-report within the segment (cannot happen through the
+            # tracker's exactly-once contract, but a different tracker
+            # implementation might): emit only the fresh sub-slots.
+            for off in np.flatnonzero(seg).tolist():
+                self._emit_run_segment(run, lo + off, lo + off + 1)
+            return
+        seg[:] = False
+        n = hi - lo
+        run[4] = left - n
+        self.chosen_count += n
+        # Full-run emission (the steady state: the whole run's quorum
+        # completes in one drain) forwards the values object itself --
+        # for a LazyValueArray that re-encodes as a raw bytes copy,
+        # with no Command ever materialized on this actor.
+        seg_values = (values if lo == start and hi == end
+                      else values[lo - start:hi - start])
+        self.broadcast(self.config.replica_addresses,
+                       ChosenRun(start_slot=lo, values=seg_values))
+        if run[4] == 0:
+            self._retire_run(start)
+
+    def _retire_run(self, start: int) -> None:
+        """Fully-chosen run: drop its values, remember (start, end,
+        round) for the stray-ack check, prune the starts index."""
+        run = self._runs.pop(start)
+        bisect.insort(self._done_runs, (start, run[0], run[1]))
+        i = bisect.bisect_left(self._run_starts, start)
+        if i < len(self._run_starts) and self._run_starts[i] == start:
+            self._run_starts.pop(i)

@@ -25,8 +25,10 @@ from frankenpaxos_tpu.protocols.multipaxos.config import (
 )
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Chosen,
+    ChosenRun,
     ChosenWatermark,
     ClientReply,
+    ClientReplyArray,
     ClientReplyBatch,
     Command,
     CommandBatch,
@@ -191,6 +193,8 @@ class Replica(Actor):
     def _receive_impl(self, src: Address, message) -> None:
         if isinstance(message, Chosen):
             self._handle_chosen(src, message)
+        elif isinstance(message, ChosenRun):
+            self._handle_chosen_run(src, message)
         elif isinstance(message, ReadRequest):
             self._handle_read_request(src, message)
         elif isinstance(message, SequentialReadRequest):
@@ -236,6 +240,40 @@ class Replica(Actor):
             else:
                 for reply in replies:
                     self.send(reply.command_id.client_address, reply)
+        self._restart_recover_timer()
+
+    def _handle_chosen_run(self, src: Address, run: ChosenRun) -> None:
+        """A contiguous drain of chosen values in one message: log the
+        whole run, execute once, and ship each client ONE reply array
+        for the drain instead of one ClientReply per command."""
+        new = 0
+        slot = run.start_slot
+        for value in run.values:
+            if self.log.get(slot) is None:
+                self.log.put(slot, value)
+                new += 1
+            slot += 1
+        if new == 0:
+            return
+        self.num_chosen += new
+        replies = self._execute_log()
+        if replies:
+            proxy = self._proxy_replica_address()
+            if proxy is not None:
+                self.send(proxy, ClientReplyBatch(batch=tuple(replies)))
+            else:
+                by_client: dict = {}
+                for r in replies:
+                    cid = r.command_id
+                    by_client.setdefault(cid.client_address, []).append(
+                        (cid.client_pseudonym, cid.client_id, r.slot,
+                         r.result))
+                for address, entries in by_client.items():
+                    self.send(address,
+                              ClientReplyArray(entries=tuple(entries)))
+        self._restart_recover_timer()
+
+    def _restart_recover_timer(self) -> None:
         # Recover timer runs only while there are unexecuted chosen slots
         # above a hole.
         if self.recover_timer is not None:

@@ -26,9 +26,12 @@ from frankenpaxos_tpu.runtime.serializer import (
 )
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Chosen,
+    ChosenRun,
     ChosenWatermark,
     ClientReply,
+    ClientReplyArray,
     ClientRequest,
+    ClientRequestArray,
     ClientRequestBatch,
     Command,
     CommandBatch,
@@ -36,6 +39,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Noop,
     NOOP,
     Phase2a,
+    Phase2aRun,
     Phase2b,
     Phase2bRange,
     Phase2bVotes,
@@ -282,8 +286,232 @@ class Phase2bVotesCodec(MessageCodec):
                             packed=packed), at
 
 
+# --- run-pipeline array codecs ---------------------------------------------
+# Structure-of-arrays layouts: client addresses are hoisted into a
+# per-message dedup TABLE and commands reference them by index, so a
+# 1024-command run encodes its (usually one) client address once, not
+# 1024 times. Address encode/decode was the dominant per-command
+# serialization cost in the AoS form. Decoding yields a
+# LazyValueArray: hot-path consumers that only forward or store the
+# values (ProxyLeader, Acceptor) never materialize Command objects --
+# re-encoding a lazy array is a raw bytes copy.
+
+_CMD_ENTRY = struct.Struct("<iqq")  # address index, pseudonym, client id
+
+
+class LazyValueArray:
+    """Decode-on-demand view over an encoded value array segment.
+
+    Iteration/indexing (Replica execution, Phase1b recovery) decodes
+    the whole segment once and caches it; forwarding (ProxyLeader ->
+    acceptors, ChosenRun emission of a full run) re-encodes by copying
+    ``raw`` without ever parsing it."""
+
+    __slots__ = ("raw", "n", "_values")
+
+    def __init__(self, raw: bytes, n: int):
+        self.raw = raw
+        self.n = n
+        self._values = None
+
+    def _decode(self) -> tuple:
+        if self._values is None:
+            try:
+                self._values = _parse_value_array(self.raw, 0, self.n)[0]
+            except (struct.error, IndexError) as e:
+                raise ValueError(
+                    f"corrupt value array (n={self.n}): {e}") from e
+        return self._values
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __iter__(self):
+        return iter(self._decode())
+
+    def __getitem__(self, i):
+        return self._decode()[i]
+
+    def __eq__(self, other):
+        if isinstance(other, LazyValueArray):
+            return self._decode() == other._decode()
+        if isinstance(other, tuple):
+            return self._decode() == other
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"LazyValueArray(n={self.n})"
+
+
+def _put_value_array(out: bytearray, values) -> None:
+    """count + byte length + [address table | per-value body]. The byte
+    length lets decode wrap the segment lazily without parsing it."""
+    if isinstance(values, LazyValueArray):
+        out += _I32.pack(values.n)
+        out += _I32.pack(len(values.raw))
+        out += values.raw
+        return
+    table: dict = {}
+    table_bytes = bytearray()
+    body = bytearray()
+    for value in values:
+        if isinstance(value, Noop):
+            body.append(0)
+            continue
+        body.append(1)
+        body += _I32.pack(len(value.commands))
+        for command in value.commands:
+            cid = command.command_id
+            idx = table.get(cid.client_address)
+            if idx is None:
+                idx = len(table)
+                table[cid.client_address] = idx
+                _put_address(table_bytes, cid.client_address)
+            body += _CMD_ENTRY.pack(idx, cid.client_pseudonym,
+                                    cid.client_id)
+            _put_bytes(body, command.command)
+    out += _I32.pack(len(values))
+    out += _I32.pack(4 + len(table_bytes) + len(body))
+    out += _I32.pack(len(table))
+    out += table_bytes
+    out += body
+
+
+_I32I32 = struct.Struct("<ii")
+
+
+def _take_value_array(buf: bytes, at: int) -> tuple:
+    """-> (LazyValueArray, next offset).
+
+    The count and byte length are validated HERE, inside codec decode,
+    so a hostile frame claiming 2^30 values raises in the transport's
+    corrupt-frame guard before any consumer sizes an allocation by the
+    count (every value costs >= 1 body byte, so n is bounded by the
+    actual payload). CONTENT parsing stays deferred: a length-valid but
+    content-corrupt segment surfaces as ValueError at first access in
+    the consuming actor -- the same trust level as the pickled cold
+    path in this single-trust-domain deployment model."""
+    n, nbytes = _I32I32.unpack_from(buf, at)
+    at += 8
+    if n < 0 or nbytes < 4 or at + nbytes > len(buf) or n + 4 > nbytes:
+        raise ValueError(
+            f"malformed value array: count {n} / length {nbytes} "
+            f"exceed payload ({len(buf) - at} bytes left)")
+    return LazyValueArray(buf[at:at + nbytes], n), at + nbytes
+
+
+def _parse_value_array(buf: bytes, at: int, n: int) -> tuple:
+    (t,) = _I32.unpack_from(buf, at)
+    at += 4
+    addresses = []
+    for _ in range(t):
+        address, at = _take_address(buf, at)
+        addresses.append(address)
+    values = []
+    for _ in range(n):
+        kind = buf[at]
+        at += 1
+        if kind == 0:
+            values.append(NOOP)
+            continue
+        (k,) = _I32.unpack_from(buf, at)
+        at += 4
+        commands = []
+        for _ in range(k):
+            idx, pseudonym, id = _CMD_ENTRY.unpack_from(buf, at)
+            payload, at = _take_bytes(buf, at + 20)
+            commands.append(Command(
+                CommandId(addresses[idx], pseudonym, id), payload))
+        values.append(CommandBatch(tuple(commands)))
+    return tuple(values), at
+
+
+class ClientRequestArrayCodec(MessageCodec):
+    """All commands in one array come from ONE client by construction
+    (the client stages its own writes), so the address is encoded once
+    for the whole message."""
+
+    message_type = ClientRequestArray
+    tag = 115
+
+    def encode(self, out, message):
+        _put_address(out, message.commands[0].command_id.client_address)
+        out += _I32.pack(len(message.commands))
+        for command in message.commands:
+            cid = command.command_id
+            out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+            _put_bytes(out, command.command)
+
+    def decode(self, buf, at):
+        address, at = _take_address(buf, at)
+        (n,) = _I32.unpack_from(buf, at)
+        at += 4
+        commands = []
+        for _ in range(n):
+            pseudonym, id = _I64I64.unpack_from(buf, at)
+            payload, at = _take_bytes(buf, at + 16)
+            commands.append(Command(
+                CommandId(address, pseudonym, id), payload))
+        return ClientRequestArray(commands=tuple(commands)), at
+
+
+class Phase2aRunCodec(MessageCodec):
+    message_type = Phase2aRun
+    tag = 116
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.start_slot, message.round)
+        _put_value_array(out, message.values)
+
+    def decode(self, buf, at):
+        start, round = _I64I64.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 16)
+        return Phase2aRun(start_slot=start, round=round,
+                          values=values), at
+
+
+class ChosenRunCodec(MessageCodec):
+    message_type = ChosenRun
+    tag = 117
+
+    def encode(self, out, message):
+        out += _I64.pack(message.start_slot)
+        _put_value_array(out, message.values)
+
+    def decode(self, buf, at):
+        (start,) = _I64.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 8)
+        return ChosenRun(start_slot=start, values=values), at
+
+
+_REPLY_ENTRY = struct.Struct("<qqq")  # pseudonym, client_id, slot
+
+
+class ClientReplyArrayCodec(MessageCodec):
+    message_type = ClientReplyArray
+    tag = 118
+
+    def encode(self, out, message):
+        out += _I32.pack(len(message.entries))
+        for pseudonym, client_id, slot, result in message.entries:
+            out += _REPLY_ENTRY.pack(pseudonym, client_id, slot)
+            _put_bytes(out, result)
+
+    def decode(self, buf, at):
+        (n,) = _I32.unpack_from(buf, at)
+        at += 4
+        entries = []
+        for _ in range(n):
+            pseudonym, client_id, slot = _REPLY_ENTRY.unpack_from(buf, at)
+            result, at = _take_bytes(buf, at + 24)
+            entries.append((pseudonym, client_id, slot, result))
+        return ClientReplyArray(entries=tuple(entries)), at
+
+
 for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                ClientRequestCodec(), ClientRequestBatchCodec(),
                ClientReplyCodec(), ChosenWatermarkCodec(),
-               Phase2bRangeCodec(), Phase2bVotesCodec()):
+               Phase2bRangeCodec(), Phase2bVotesCodec(),
+               ClientRequestArrayCodec(), Phase2aRunCodec(),
+               ChosenRunCodec(), ClientReplyArrayCodec()):
     register_codec(_codec)

@@ -89,6 +89,17 @@ class Actor(abc.ABC):
                       serializer: Serializer | None = None) -> None:
         self.chan(dst, serializer).send_no_flush(message)
 
+    def broadcast(self, dsts, message: Any,
+                  serializer: Serializer | None = None) -> None:
+        """Send one message to many destinations, serializing it ONCE.
+        The per-destination Chan.send path re-encodes identical bytes N
+        times -- measurable when the message carries a whole drain's
+        values (Phase2aRun to a write quorum, ChosenRun to every
+        replica)."""
+        data = (serializer or DEFAULT_SERIALIZER).to_bytes(message)
+        for dst in dsts:
+            self.transport.send(self.address, dst, data)
+
     def flush(self, dst: Address) -> None:
         self.transport.flush(self.address, dst)
 

@@ -58,6 +58,8 @@ def make_multipaxos(
     batch_size: int = 1,
     quorum_backend: str = "dict",
     tpu_pipelined: bool = False,
+    tpu_min_device_slots: int = 0,
+    coalesced: bool = False,
     phase1_backend: str = "host",
     state_machine_factory=AppendLog,
     seed: int = 0,
@@ -108,9 +110,11 @@ def make_multipaxos(
         for i, a in enumerate(config.leader_addresses)]
     proxy_leaders = [
         ProxyLeader(a, transport, logger, config,
-                    ProxyLeaderOptions(quorum_backend=quorum_backend,
-                                       tpu_window=1 << 12,
-                                       tpu_pipelined=tpu_pipelined),
+                    ProxyLeaderOptions(
+                        quorum_backend=quorum_backend,
+                        tpu_window=1 << 12,
+                        tpu_pipelined=tpu_pipelined,
+                        tpu_min_device_slots=tpu_min_device_slots),
                     seed=seed + 10 + i)
         for i, a in enumerate(config.proxy_leader_addresses)]
     acceptors = [
@@ -126,7 +130,8 @@ def make_multipaxos(
         for a in config.proxy_replica_addresses]
     clients = [
         Client(f"client-{i}", transport, logger, config,
-               ClientOptions(), seed=seed + 30 + i)
+               ClientOptions(coalesce_writes=coalesced),
+               seed=seed + 30 + i)
         for i in range(num_clients)]
 
     return MultiPaxosSim(transport, config, batchers, leaders, proxy_leaders,

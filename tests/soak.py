@@ -29,9 +29,22 @@ import json
 import os
 import time
 
-import pytest
+# Pin JAX to local CPU XLA exactly like tests/conftest.py: the axon TPU
+# plugin's sitecustomize forces the tunneled device (jax.config.update
+# at import), and over the tunnel every device call in the
+# min_device_slots=1 soak rows would cost ~90ms. Must happen before
+# anything constructs a tracker.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
 
-from frankenpaxos_tpu.sim import Simulator
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from frankenpaxos_tpu.sim import Simulator  # noqa: E402
 
 from tests.protocols.test_epaxos import EPaxosSimulated, make_epaxos
 from tests.protocols.test_fasterpaxos import (
@@ -191,16 +204,40 @@ CONFIGS: list[tuple] = [
     ("fastmultipaxos/f2", FastMultiPaxosF2Simulated),
     ("unanimousbpaxos/f2", UnanimousBPaxosF2Simulated),
     ("craq/chain5", CraqChain5Simulated),
-    # Device-backed configs: the TPU quorum tracker / dependency kernels
-    # under the full randomized interleaving exploration. Scaled to
-    # 0.25x runs: every drain pays a device call.
+    # Device-backed configs at FULL scale (500x250 like every other
+    # row): the TPU quorum tracker / dependency kernels under the
+    # randomized interleaving exploration. min_device_slots=1 pins the
+    # device path ON (sim drains are narrow; the auto threshold would
+    # route them all to the host tally and the device kernels would
+    # never run under interleaving). The module-level platform pin
+    # keeps every device call on local CPU XLA.
     ("multipaxos/f1-tpu-backend",
-     lambda: MultiPaxosSimulated(f=1, quorum_backend="tpu"), 0.25),
+     lambda: MultiPaxosSimulated(f=1, quorum_backend="tpu",
+                                 tpu_min_device_slots=1)),
     ("multipaxos/f1-grid-tpu-backend",
      lambda: MultiPaxosSimulated(f=1, flexible=True, grid_shape=(2, 2),
-                                 quorum_backend="tpu"), 0.25),
+                                 quorum_backend="tpu",
+                                 tpu_min_device_slots=1)),
     ("epaxos/f1-tpu-deps",
-     lambda: EPaxosSimulated(dep_backend="tpu"), 0.25),
+     lambda: EPaxosSimulated(dep_backend="tpu")),
+    # Pipelined device drains (async dispatch + flush-timer collection,
+    # quorum_tracker._drain_pipelined) under sim interleaving: the
+    # flush timer is a real sim timer, so the exploration fires it at
+    # arbitrary points relative to deliveries.
+    ("multipaxos/f1-tpu-pipelined",
+     lambda: MultiPaxosSimulated(f=1, quorum_backend="tpu",
+                                 tpu_pipelined=True)),
+    # The drain-granular run pipeline (ClientRequestArray -> Phase2aRun
+    # -> ChosenRun -> ClientReplyArray), host + device trackers + grid.
+    ("multipaxos/f1-coalesced",
+     lambda: MultiPaxosSimulated(f=1, coalesced=True)),
+    ("multipaxos/f1-coalesced-tpu",
+     lambda: MultiPaxosSimulated(f=1, coalesced=True,
+                                 quorum_backend="tpu",
+                                 tpu_min_device_slots=1)),
+    ("multipaxos/f1-coalesced-grid",
+     lambda: MultiPaxosSimulated(f=1, coalesced=True, flexible=True,
+                                 grid_shape=(2, 2))),
 ]
 
 

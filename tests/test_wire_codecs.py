@@ -461,3 +461,78 @@ def test_baseline_protocol_codecs_round_trip():
     assert ppx[0] != pfp[0]
     assert type(DEFAULT_SERIALIZER.from_bytes(ppx)) is px.Phase1a
     assert type(DEFAULT_SERIALIZER.from_bytes(pfp)) is fp.Phase1a
+
+
+def test_run_pipeline_codecs_round_trip_and_reject_hostile_counts():
+    """The drain-granular run messages (ClientRequestArray, Phase2aRun,
+    ChosenRun, ClientReplyArray): SoA round trips, lazy re-encode as a
+    raw copy, and decode-time validation of hostile counts (a claimed
+    2^30-value array must raise inside codec decode -- the transport's
+    corrupt-frame guard -- before any consumer sizes an allocation by
+    the count)."""
+    import struct
+
+    import pytest
+
+    from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+    from frankenpaxos_tpu.protocols.multipaxos import wire
+    from frankenpaxos_tpu.protocols.multipaxos.messages import (
+        ChosenRun,
+        ClientReplyArray,
+        ClientRequestArray,
+        Command,
+        CommandBatch,
+        CommandId,
+        NOOP,
+        Phase2aRun,
+    )
+
+    cmd = lambda p, i: Command(  # noqa: E731
+        CommandId(("10.0.0.1", 9000), p, i), b"payload-%d" % i)
+    messages = [
+        ClientRequestArray(commands=(cmd(0, 0), cmd(1, 7))),
+        Phase2aRun(start_slot=5, round=2,
+                   values=(CommandBatch((cmd(0, 0),)), NOOP,
+                           CommandBatch((cmd(1, 1), cmd(2, 2))))),
+        ChosenRun(start_slot=9, values=(NOOP, CommandBatch((cmd(3, 3),)))),
+        ClientReplyArray(entries=((0, 1, 5, b"r0"), (2, 3, 6, b"r1"))),
+    ]
+    for message in messages:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128
+        decoded = DEFAULT_SERIALIZER.from_bytes(data)
+        assert type(decoded) is type(message)
+        if hasattr(message, "values"):
+            assert tuple(decoded.values) == tuple(message.values)
+            # Lazy arrays re-encode as a raw copy, byte-identically,
+            # WITHOUT materializing values first.
+            assert isinstance(decoded.values, wire.LazyValueArray)
+            re_encoded = DEFAULT_SERIALIZER.to_bytes(decoded)
+            assert re_encoded == data
+        else:
+            assert decoded == message
+
+    # Hostile count: n = 2^30 with a 4-byte body must raise at decode.
+    run = Phase2aRun(start_slot=0, round=0, values=(NOOP,))
+    data = bytearray(DEFAULT_SERIALIZER.to_bytes(run))
+    # Layout: tag(1) + start(8) + round(8) + n(4) + nbytes(4) + ...
+    struct.pack_into("<i", data, 17, 1 << 30)
+    with pytest.raises(ValueError):
+        DEFAULT_SERIALIZER.from_bytes(bytes(data))
+    # Hostile byte length overrunning the buffer must also raise.
+    data = bytearray(DEFAULT_SERIALIZER.to_bytes(run))
+    struct.pack_into("<i", data, 21, 1 << 20)
+    with pytest.raises(ValueError):
+        DEFAULT_SERIALIZER.from_bytes(bytes(data))
+    # Length-valid but content-corrupt (an inner command count
+    # overrunning the segment): surfaces as ValueError at first ACCESS
+    # (the lazy boundary), not a bare struct.error/IndexError.
+    payload = (struct.pack("<i", 0)       # empty address table
+               + b"\x01"                  # one CommandBatch value...
+               + struct.pack("<i", 1000))  # ...claiming 1000 commands
+    data = (bytes([wire.Phase2aRunCodec.tag])
+            + struct.pack("<qq", 0, 0)
+            + struct.pack("<ii", 1, len(payload)) + payload)
+    decoded = DEFAULT_SERIALIZER.from_bytes(data)  # lengths check out
+    with pytest.raises(ValueError):
+        list(decoded.values)
