@@ -19,7 +19,11 @@ sys.path.insert(0, ".")
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-from frankenpaxos_tpu.bench.pipeline import make_state, run_steps  # noqa: E402
+from frankenpaxos_tpu.bench.pipeline import (  # noqa: E402
+    drain_latency_distribution,
+    make_state,
+    run_steps,
+)
 from frankenpaxos_tpu.quorums import Grid, SimpleMajority  # noqa: E402
 
 BASELINE_CMDS_PER_SEC = 934_000.0
@@ -72,8 +76,17 @@ def _measure(spec, num_acceptors: int) -> tuple[float, float]:
 
 
 def main() -> None:
-    cmds_per_sec, batch_latency_us = _measure(
-        SimpleMajority(range(NUM_ACCEPTORS)).write_spec(), NUM_ACCEPTORS)
+    majority_spec = SimpleMajority(range(NUM_ACCEPTORS)).write_spec()
+    cmds_per_sec, batch_latency_us = _measure(majority_spec,
+                                              NUM_ACCEPTORS)
+    # True per-drain latency distribution (p50/p99) from host-timed
+    # chunked dispatches -- the fused loop above keeps the throughput
+    # figure; this replaces its mean-as-p50 proxy for the latency one.
+    masks, thresholds, combine_any = majority_spec.as_arrays()
+    dist = drain_latency_distribution(
+        (tuple(tuple(int(x) for x in row) for row in masks),
+         tuple(int(t) for t in thresholds), combine_any),
+        NUM_ACCEPTORS, WINDOW, BLOCK, batch_latency_us)
     # The grid (flexible-quorum) predicate at the same scale: a 2x3
     # grid's write quorums ("one vote in every row",
     # quorums/Grid.scala:5-57) evaluated as the factored [G, N] matmul
@@ -88,13 +101,15 @@ def main() -> None:
         "unit": "cmds/s",
         "vs_baseline": round(cmds_per_sec / BASELINE_CMDS_PER_SEC, 3),
         "mean_quorum_batch_latency_us": round(batch_latency_us, 2),
+        **dist,
         "grid_cmds_per_sec": round(grid_cmds_per_sec, 1),
         "grid_mean_batch_latency_us": round(grid_latency_us, 2),
-        "latency_note": ("mean over ITERS uniform drains in one "
-                         "dispatch (no per-drain distribution is "
-                         "observable inside fori_loop); reported "
-                         "against BASELINE.json's 50us p50 target as "
-                         "its proxy"),
+        "latency_note": ("mean_quorum_batch_latency_us is the fused-"
+                         "loop mean (throughput figure); p50/p99_"
+                         "drain_latency_us come from the chunked-"
+                         "dispatch distribution (see latency_method) "
+                         "-- the figure BASELINE.json's 50us p50 "
+                         "target is judged against"),
         "block_slots": BLOCK,
         "window_slots": WINDOW,
         "iters": ITERS,

@@ -18,7 +18,11 @@ import time
 
 import jax
 
-from frankenpaxos_tpu.bench.pipeline import make_state, run_steps
+from frankenpaxos_tpu.bench.pipeline import (
+    drain_latency_distribution,
+    make_state,
+    run_steps,
+)
 from frankenpaxos_tpu.quorums import SimpleMajority
 
 WINDOW = 1 << 20
@@ -61,6 +65,12 @@ def measure(block: int, iters: int, repeats: int = 3) -> dict:
             "drain_latency_us": round(elapsed / iters * 1e6, 2),
         })
     worst = min(runs, key=lambda r: r["cmds_per_sec"])
+    # True per-drain distribution at this block size (chunked
+    # host-timed dispatches; see pipeline.drain_latency_distribution).
+    dist = drain_latency_distribution(
+        (masks_t, thresholds_t, combine_any), NUM_ACCEPTORS, WINDOW,
+        block, worst["drain_latency_us"], time_budget_s=8.0,
+        target_samples=256)
     return {
         "block_slots": block,
         "iters": iters,
@@ -68,6 +78,10 @@ def measure(block: int, iters: int, repeats: int = 3) -> dict:
         "runs": runs,
         "cmds_per_sec": worst["cmds_per_sec"],
         "drain_latency_us": max(r["drain_latency_us"] for r in runs),
+        **{k: dist[k] for k in ("p50_drain_latency_us",
+                                "p99_drain_latency_us",
+                                "latency_samples",
+                                "drains_per_sample")},
     }
 
 

@@ -108,6 +108,52 @@ def run(protocol_name: str, config_raw: dict, workload, *,
                          issue_op)
 
 
+def run_skewed(protocol_name: str, config_raw: dict, *,
+               point_fraction: float, num_clients: int,
+               duration_s: float, seed: int = 0,
+               warmup_s: float = 0.25, num_keys: int = 16) -> list:
+    """Point-skewed KV write loops for the conflict-sensitivity sweep
+    (vldb21_compartmentalized/compartmentalized_skew, craq_skew):
+    ``point_fraction`` of writes hit ONE hot key, the rest uniform --
+    the knob that changes EPaxos fast-path conflict rates and CRAQ
+    chain contention. Commands are protocol-appropriate: CRAQ's native
+    chain KV write; pickled SetRequests against a KeyValueStore for
+    epaxos/multipaxos."""
+    import pickle
+
+    from frankenpaxos_tpu.statemachine import SetRequest
+
+    protocol = get_protocol(protocol_name)
+    config = protocol.load_config(config_raw)
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = TcpTransport(("127.0.0.1", free_port()), logger)
+    transport.start()
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides={}, seed=seed)
+    client = protocol.make_client(ctx, transport.listen_address)
+    rngs = [random.Random((seed << 20) + p) for p in range(num_clients)]
+    tags = {"next": 0}
+
+    def issue_op(i: int, finished) -> None:
+        rng = rngs[i]
+        key = ("point" if rng.random() < point_fraction
+               else str(rng.randrange(num_keys)))
+        tags["next"] += 1
+        value = "v%d" % tags["next"]
+        done = lambda *_reply: finished("write")  # noqa: E731
+        if protocol_name == "craq":
+            client.write(i, key, value, done)
+        elif protocol_name == "epaxos":
+            client.propose(i, pickle.dumps(SetRequest(((key, value),))),
+                           done)
+        else:  # multipaxos
+            client.write(i, pickle.dumps(SetRequest(((key, value),))),
+                         done)
+
+    return _closed_loops(transport, num_clients, duration_s, warmup_s,
+                         issue_op)
+
+
 def run_drive(protocol_name: str, config_raw: dict, *,
               num_clients: int, duration_s: float, seed: int = 0,
               warmup_s: float = 0.25) -> list:
@@ -154,13 +200,21 @@ def main(argv=None) -> None:
     parser.add_argument("--client_options", default=None,
                         help="JSON dict of ClientOptions overrides "
                              "(e.g. {\"coalesce_writes\": \"true\"})")
+    parser.add_argument("--point_skew", type=float, default=None,
+                        help="point-skewed KV write loops with this "
+                             "hot-key fraction (conflict sweep)")
     parser.add_argument("--out", required=True)
     args = parser.parse_args(argv)
 
     with open(args.config) as f:
         config_raw = json.load(f)
 
-    if args.protocol != "multipaxos" and args.workload is None:
+    if args.point_skew is not None:
+        rows = run_skewed(args.protocol, config_raw,
+                          point_fraction=args.point_skew,
+                          num_clients=args.num_clients,
+                          duration_s=args.duration, seed=args.seed)
+    elif args.protocol != "multipaxos" and args.workload is None:
         # Generic closed loops via the registry's drive() -- any
         # protocol the smoke can deploy can be benchmarked.
         rows = run_drive(args.protocol, config_raw,

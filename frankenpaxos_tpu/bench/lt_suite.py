@@ -261,6 +261,39 @@ def tracker_votes_per_sec(quorum_backend: str, drain_width: int,
     return drains * drain_width * acceptors / elapsed
 
 
+def _overlap_metrics(role_metrics: dict) -> dict:
+    """Aggregate the proxy leaders' pipelined-dispatch instrumentation
+    (scraped /metrics) into the overlap summary the deployed
+    tpu-pipelined point carries: how deep the in-flight dispatch queue
+    runs (0 = the link RTT is serialized per drain, i.e. pipelining is
+    NOT engaging) and what each device collect costs."""
+    sums = {"dispatches": 0.0, "inflight_sum": 0.0, "inflight_count": 0.0,
+            "collect_sum_s": 0.0, "collect_count": 0.0}
+    p = "multipaxos_proxy_leader_tpu_"
+    for label, metrics in role_metrics.items():
+        if not label.startswith("proxy_leader"):
+            continue
+        sums["dispatches"] += metrics.get(f"{p}dispatches_total", 0.0)
+        sums["inflight_sum"] += metrics.get(
+            f"{p}inflight_at_dispatch_sum", 0.0)
+        sums["inflight_count"] += metrics.get(
+            f"{p}inflight_at_dispatch_count", 0.0)
+        sums["collect_sum_s"] += metrics.get(
+            f"{p}collect_seconds_sum", 0.0)
+        sums["collect_count"] += metrics.get(
+            f"{p}collect_seconds_count", 0.0)
+    return {
+        "dispatches": sums["dispatches"],
+        "mean_inflight_at_dispatch": round(
+            sums["inflight_sum"] / sums["inflight_count"], 3)
+        if sums["inflight_count"] else None,
+        "collects": sums["collect_count"],
+        "mean_collect_ms": round(
+            1e3 * sums["collect_sum_s"] / sums["collect_count"], 1)
+        if sums["collect_count"] else None,
+    }
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser()
     parser.add_argument("--duration", type=float, default=3.0)
@@ -300,24 +333,44 @@ def main(argv=None) -> dict:
     root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_lt_")
     suite = SuiteDirectory(root, "multipaxos_lt")
 
+    # Deployed arms. The dict arm is the reference design; dict+run is
+    # the drain-granular pipeline on the host tracker; tpu+run is the
+    # sensible device config (sync adaptive routing: trickle drains
+    # never pay the device-link RTT); tpu-pipelined is the
+    # board-always mode, instrumented (prometheus) to measure dispatch
+    # overlap -- the round-4 open question of WHY it deployed at 7/s.
+    arms = [
+        ("dict", dict()),
+        ("dict+run", dict(coalesced=True)),
+        ("tpu+run", dict(quorum_backend="tpu", coalesced=True)),
+        ("tpu-pipelined", dict(quorum_backend="tpu", tpu_pipelined=True,
+                               prometheus=True)),
+    ]
     points = []
-    for backend in ("dict", "tpu"):
+    for arm, kwargs in arms:
+        backend = kwargs.get("quorum_backend", "dict")
         scales = parse_scales(args.scales if backend == "dict"
                               else args.tpu_scales)
         for procs, loops in scales:
-            # The tpu point needs a longer window (first drains pay
-            # kernel compiles over the device link) + pipelined drains.
+            # The tpu arms need a longer window (first drains pay
+            # kernel compiles over the device link).
             point_duration = (args.duration if backend == "dict"
                               else max(args.duration, 15.0))
-            stats = run_benchmark(
-                suite.benchmark_directory(),
-                MultiPaxosInput(num_clients=loops, client_procs=procs,
-                                duration_s=point_duration,
-                                quorum_backend=backend,
-                                tpu_pipelined=(backend == "tpu")))
+            try:
+                stats = run_benchmark(
+                    suite.benchmark_directory(),
+                    MultiPaxosInput(num_clients=loops,
+                                    client_procs=procs,
+                                    duration_s=point_duration,
+                                    **kwargs))
+            except RuntimeError as e:
+                print(json.dumps({"arm": arm, "error": str(e)[-300:]}))
+                continue
             point = {
+                "arm": arm,
                 "quorum_backend": backend,
-                "tpu_pipelined": backend == "tpu",
+                "tpu_pipelined": bool(kwargs.get("tpu_pipelined")),
+                "coalesced": bool(kwargs.get("coalesced")),
                 "client_procs": procs,
                 "loops_per_proc": loops,
                 "duration_s": point_duration,
@@ -326,6 +379,9 @@ def main(argv=None) -> dict:
                 "latency_p99_ms": stats.get("latency.p99_ms"),
                 "num_requests": stats["num_requests"],
             }
+            if kwargs.get("tpu_pipelined"):
+                point["overlap_metrics"] = _overlap_metrics(
+                    stats.get("role_metrics") or {})
             points.append(point)
             print(json.dumps(point))
 

@@ -30,6 +30,11 @@ class MultiPaxosInput:
     f: int = 1
     num_acceptor_groups: int = 1
     num_replicas: int = 0  # 0 -> f + 1
+    # Batchers between clients and leaders (Batcher.scala:60-90): the
+    # whole batch shares ONE log slot -- the eurosys fig4 ~4x lever.
+    # 0 disables (clients talk to leaders directly).
+    num_batchers: int = 0
+    batch_size: int = 1
     num_clients: int = 2
     duration_s: float = 2.0
     quorum_backend: str = "dict"
@@ -71,7 +76,7 @@ def placement(input: MultiPaxosInput) -> dict:
     f = input.f
     return {
         "f": f,
-        "batchers": [],
+        "batchers": addrs(input.num_batchers),
         "read_batchers": [],
         "leaders": addrs(f + 1),
         "leader_elections": addrs(f + 1),
@@ -97,6 +102,8 @@ def run_benchmark(bench: BenchmarkDirectory,
         overrides["tpu_pipelined"] = "true"
     if input.coalesced:
         overrides["coalesce_writes"] = "true"
+    if input.num_batchers:
+        overrides["batch_size"] = str(input.batch_size)
     launch_roles(bench, "multipaxos", config_path, config,
                  state_machine=input.state_machine,
                  overrides=overrides,

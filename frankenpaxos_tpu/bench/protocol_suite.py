@@ -65,9 +65,14 @@ def run_protocol_benchmark(bench: BenchmarkDirectory, protocol_name: str,
                            clients_per_proc: int = 5,
                            duration_s: float = 3.0,
                            state_machine: str = "AppendLog",
-                           supernode: bool = False) -> dict:
+                           supernode: bool = False,
+                           point_skew: float | None = None) -> dict:
     if protocol_name in SINGLE_DECREE:
         client_procs, clients_per_proc = 1, 1
+    if point_skew is not None and protocol_name != "craq":
+        # Skewed loops issue SetRequests; conflict sensitivity needs
+        # the KV conflict index (CRAQ's chain store is natively KV).
+        state_machine = "KeyValueStore"
     protocol = get_protocol(protocol_name)
     raw = protocol.cluster(f, lambda: ["127.0.0.1", free_port()])
     config_path = bench.write_json("config.json", raw)
@@ -90,7 +95,9 @@ def run_protocol_benchmark(bench: BenchmarkDirectory, protocol_name: str,
                 "--config", config_path,
                 "--num_clients", str(clients_per_proc),
                 "--duration", str(duration_s),
-                "--seed", str(i + 1), "--out", out_csv], env=env)))
+                "--seed", str(i + 1), "--out", out_csv]
+                + (["--point_skew", str(point_skew)]
+                   if point_skew is not None else []), env=env)))
         latencies, starts = [], []
         for out_csv, proc in procs:
             code = proc.wait(timeout=duration_s + 90)
@@ -104,11 +111,15 @@ def run_protocol_benchmark(bench: BenchmarkDirectory, protocol_name: str,
                     _, start, latency = line.strip().split(",")
                     latencies.append(float(latency))
                     starts.append(float(start))
+        role_cpu = bench.role_cpu_seconds()
     finally:
         bench.cleanup()
 
     stats = latency_throughput_stats(latencies, duration_s,
                                      starts_s=starts)
+    stats["role_cpu_seconds"] = {
+        label: cpu for label, cpu in role_cpu.items()
+        if not label.startswith("client_")}
     stats["protocol"] = protocol_name
     stats["client_procs"] = client_procs
     stats["clients_per_proc"] = clients_per_proc

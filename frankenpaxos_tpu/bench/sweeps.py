@@ -50,7 +50,7 @@ DEFAULT_POINTS = ((1, 2), (2, 5), (4, 5))
 
 
 def _lt_row(series: str, procs: int, loops: int, stats: dict) -> dict:
-    return {
+    row = {
         "series": series,
         "num_client_procs": procs,
         "num_clients_per_proc": loops,
@@ -59,6 +59,24 @@ def _lt_row(series: str, procs: int, loops: int, stats: dict) -> dict:
         "latency_median_ms": stats.get("latency.median_ms"),
         "num_requests": stats.get("num_requests"),
     }
+    # Per-role CPU + the decoupling projection (total/max over stages,
+    # the coupled_vs_compartmentalized.json formula): on this one-core
+    # host decoupled and coupled modes timeshare one CPU, so the
+    # ablation figures cannot show wall-clock separation -- the
+    # parallelizable fraction is what the row can honestly assert
+    # (DistributionScheme.scala:151-162).
+    role_cpu = stats.get("role_cpu_seconds") or {}
+    if role_cpu:
+        total = sum(role_cpu.values())
+        bottleneck = max(role_cpu.values())
+        row["role_cpu_s"] = round(total, 3)
+        row["bottleneck_stage"] = max(role_cpu, key=role_cpu.get)
+        row["bottleneck_cpu_s"] = round(bottleneck, 3)
+        if bottleneck > 0:
+            row["projected_stage_speedup"] = round(total / bottleneck, 2)
+            row["parallelizable_fraction"] = round(
+                1 - bottleneck / total, 3)
+    return row
 
 
 def _protocol_series(suite, series: str, protocol: str, points,
@@ -169,6 +187,380 @@ def nsdi_fig2(suite: SuiteDirectory, points, duration_s: float) -> list:
     return rows
 
 
+def eurosys_fig4(suite: SuiteDirectory, points,
+                 duration_s: float) -> list:
+    """The batching ablation (eurosys/fig4_multipaxos_ablation_plot.py,
+    vldb21_compartmentalized/batched_ablation/): batch size as the
+    swept axis -- including unbatched -- for compartmentalized and
+    coupled MultiPaxos. The reference counts batching as a ~4x lever
+    (BASELINE.md)."""
+    from frankenpaxos_tpu.bench.multipaxos_suite import (
+        MultiPaxosInput,
+        run_benchmark,
+    )
+
+    procs, loops = max(points, key=lambda p: p[0] * p[1])
+    rows = []
+    for supernode in (False, True):
+        series = "coupled" if supernode else "compartmentalized"
+        for batch_size in (0, 5, 20, 50):
+            for attempt in (1, 2):
+                try:
+                    stats = run_benchmark(
+                        suite.benchmark_directory(),
+                        MultiPaxosInput(
+                            num_clients=loops, client_procs=procs,
+                            duration_s=duration_s,
+                            num_batchers=2 if batch_size else 0,
+                            batch_size=batch_size or 1,
+                            supernode=supernode))
+                    break
+                except RuntimeError as e:
+                    print(f"fig4 ({series}, {batch_size}) attempt "
+                          f"{attempt} failed: {e}")
+                    stats = {}
+            rows.append({
+                "series": series,
+                "batch_size": batch_size,
+                "num_clients": procs * loops,
+                "throughput_p90_1s": stats.get(
+                    "start_throughput_1s.p90"),
+                "latency_median_ms": stats.get("latency.median_ms"),
+                "num_requests": stats.get("num_requests"),
+            })
+            print(json.dumps(rows[-1]))
+    return rows
+
+
+def evelyn(suite: SuiteDirectory, points, duration_s: float) -> list:
+    """The vldb21_evelyn characteristic experiments: read throughput as
+    a function of read FRACTION x replica count.
+
+      * ``lt_surprise`` shape: at a fixed replica count, sweeping the
+        read fraction shows write contention capping read scaling (the
+        paper's surprise: 90% reads is NOT ~10x the write ceiling).
+      * ``no_scale_fraction`` / ``scale_load`` shape: at each read
+        fraction, adding replicas scales reads but not writes.
+    """
+    from frankenpaxos_tpu.bench.multipaxos_suite import (
+        MultiPaxosInput,
+        run_benchmark,
+    )
+    from frankenpaxos_tpu.bench.workload import UniformReadWriteWorkload
+
+    procs, loops = max(points, key=lambda p: p[0] * p[1])
+    rows = []
+    for num_replicas in (2, 4):
+        for read_fraction in (0.0, 0.5, 0.9, 1.0):
+            for attempt in (1, 2):
+                try:
+                    stats = run_benchmark(
+                        suite.benchmark_directory(),
+                        MultiPaxosInput(
+                            num_clients=loops, client_procs=procs,
+                            duration_s=duration_s,
+                            num_replicas=num_replicas,
+                            workload=UniformReadWriteWorkload(
+                                num_keys=16,
+                                read_fraction=read_fraction),
+                            read_consistency="eventual",
+                            state_machine="KeyValueStore"))
+                    break
+                except RuntimeError as e:
+                    print(f"evelyn ({num_replicas}, {read_fraction}) "
+                          f"attempt {attempt} failed: {e}")
+                    stats = {}
+            rows.append({
+                "series": f"replicas_{num_replicas}",
+                "num_replicas": num_replicas,
+                "read_fraction": read_fraction,
+                "read_throughput_p90_1s": stats.get(
+                    "read.start_throughput_1s.p90"),
+                "write_throughput_p90_1s": stats.get(
+                    "write.start_throughput_1s.p90"),
+                "throughput_p90_1s": stats.get(
+                    "start_throughput_1s.p90"),
+                "latency_median_ms": stats.get("latency.median_ms"),
+            })
+            print(json.dumps(rows[-1]))
+    return rows
+
+
+def skew(suite: SuiteDirectory, points, duration_s: float) -> list:
+    """Conflict-rate sensitivity (vldb21_compartmentalized/
+    compartmentalized_skew/, craq_skew/): a PointSkewed read-write
+    workload swept over the skew point mass, for the protocols whose
+    behavior actually changes with conflicts (EPaxos fast-path
+    conflicts, CRAQ chain contention) against conflict-insensitive
+    MultiPaxos."""
+    from frankenpaxos_tpu.bench.protocol_suite import (
+        run_protocol_benchmark,
+    )
+
+    procs, loops = max(points, key=lambda p: p[0] * p[1])
+    rows = []
+    for protocol in ("multipaxos", "epaxos", "craq"):
+        for point_fraction in (0.0, 0.5, 0.9):
+            for attempt in (1, 2):
+                try:
+                    stats = run_protocol_benchmark(
+                        suite.benchmark_directory(), protocol,
+                        client_procs=procs, clients_per_proc=loops,
+                        duration_s=duration_s,
+                        point_skew=point_fraction)
+                    break
+                except RuntimeError as e:
+                    print(f"skew ({protocol}, {point_fraction}) attempt "
+                          f"{attempt} failed: {e}")
+                    stats = {}
+            rows.append({
+                "series": protocol,
+                "point_skew": point_fraction,
+                "num_clients": procs * loops,
+                "throughput_p90_1s": stats.get(
+                    "start_throughput_1s.p90"),
+                "latency_median_ms": stats.get("latency.median_ms"),
+                "num_requests": stats.get("num_requests"),
+            })
+            print(json.dumps(rows[-1]))
+    return rows
+
+
+def plot_param_sweep(rows: list, path: str, x_key: str, title: str,
+                     y_keys=("throughput_p90_1s",)) -> None:
+    """Generic swept-parameter figure: x = the swept axis, y =
+    throughput (thousands/s), one line per series (the fig4/evelyn/
+    skew plot shape)."""
+    import matplotlib
+
+    matplotlib.use("pdf")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(1, 1, figsize=(6.4, 4.8))
+    markers = ("o-", "^-", "s-", "d-", "v-", "x-")
+    i = 0
+    for series in dict.fromkeys(row["series"] for row in rows):
+        pts = sorted((r for r in rows if r["series"] == series),
+                     key=lambda r: r.get(x_key, 0))
+        for y_key in y_keys:
+            label = series if len(y_keys) == 1 else \
+                f"{series}:{y_key.split('_')[0]}"
+            ax.plot([r.get(x_key, 0) for r in pts],
+                    [(r.get(y_key) or 0) / 1000 for r in pts],
+                    markers[i % len(markers)], label=label, linewidth=2)
+            i += 1
+    ax.set_xlabel(x_key)
+    ax.set_ylabel("Throughput (thousands of commands per second)")
+    ax.set_title(title)
+    ax.legend(loc="best")
+    ax.grid()
+    fig.savefig(path, bbox_inches="tight")
+
+
+def vldb20_reconfig(suite: SuiteDirectory, points,
+                    duration_s: float) -> list:
+    """Throughput THROUGH live reconfigurations -- the vldb20 matchmaker
+    paper's headline capability (benchmarks/vldb20_matchmaker/
+    leader_reconfiguration/, matchmaker_reconfiguration/;
+    Reconfigurer.scala:98-155): drive steady closed-loop load, trigger
+    reconfigurations at fixed timestamps, and record a 1-second
+    throughput timeline showing the dip and recovery.
+
+      * matchmakermultipaxos: an ACCEPTOR-set change (Reconfigure to
+        the deployed reconfigurer, which hands every leader a new
+        quorum system to matchmake into its next round) -- the paper's
+        core experiment.
+      * horizontal: a chunk reconfiguration (Reconfigure chosen INTO
+        the log, starting a new active chunk).
+    """
+    import sys
+    import threading
+    import time as _time
+
+    from frankenpaxos_tpu.bench.deploy_suite import (
+        launch_roles,
+        role_process_env,
+    )
+    from frankenpaxos_tpu.bench.harness import LocalHost, free_port
+    from frankenpaxos_tpu.deploy import get_protocol
+    from frankenpaxos_tpu.quorums import SimpleMajority
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+    from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+    total_s = max(18.0, duration_s)
+    reconfig_at = [total_s * 0.35, total_s * 0.55, total_s * 0.75]
+
+    def trigger_messages(protocol_name, config, k):
+        if protocol_name == "matchmakermultipaxos":
+            from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
+                Reconfigure,
+                ReconfigureMatchmakers,
+                initial_matchmaker_configuration,
+            )
+            from frankenpaxos_tpu.quorums import quorum_system_to_dict
+
+            if k == 1:
+                # The heavier MATCHMAKER-set change: the full Stop ->
+                # Bootstrap -> MatchPhase1/2 -> MatchChosen epoch
+                # migration under load (Reconfigurer.scala:283-720).
+                # Epoch 0 is the live epoch for the first such change.
+                return [(tuple(config.reconfigurer_addresses[0]),
+                         ReconfigureMatchmakers(
+                             matchmaker_configuration=(
+                                 initial_matchmaker_configuration(
+                                     config.f)),
+                             new_matchmaker_indices=tuple(range(
+                                 2 * config.f + 1))))]
+            qs = quorum_system_to_dict(SimpleMajority(
+                range(len(config.acceptor_addresses))))
+            return [(tuple(config.reconfigurer_addresses[0]),
+                     Reconfigure(qs))]
+        from frankenpaxos_tpu.protocols.horizontal import Reconfigure
+        from frankenpaxos_tpu.quorums import quorum_system_to_dict
+
+        qs = quorum_system_to_dict(SimpleMajority(
+            range(len(config.acceptor_addresses))))
+        return [(tuple(addr), Reconfigure(qs))
+                for addr in config.leader_addresses]
+
+    rows = []
+    procs_n, loops = max(points, key=lambda p: p[0] * p[1])
+    for protocol_name in ("matchmakermultipaxos", "horizontal"):
+        bench = suite.benchmark_directory()
+        protocol = get_protocol(protocol_name)
+        raw = protocol.cluster(1, lambda: ["127.0.0.1", free_port()])
+        config_path = bench.write_json("config.json", raw)
+        config = protocol.load_config(raw)
+        launch_roles(bench, protocol_name, config_path, config,
+                     state_machine="AppendLog",
+                     overrides={"resend_phase1as_period_s": "0.5"})
+        host = LocalHost()
+        env = role_process_env()
+        client_procs = []
+        t_start = _time.time()
+        for i in range(procs_n):
+            out_csv = bench.abspath(f"client_{i}_data.csv")
+            client_procs.append((out_csv, bench.popen(
+                host, f"client_{i}", [
+                    sys.executable, "-m",
+                    "frankenpaxos_tpu.bench.client_main",
+                    "--protocol", protocol_name,
+                    "--config", config_path,
+                    "--num_clients", str(loops),
+                    "--duration", str(total_s),
+                    "--seed", str(i + 1), "--out", out_csv], env=env)))
+
+        fired: list[float] = []
+
+        def fire_reconfigs():
+            logger = FakeLogger(LogLevel.FATAL)
+            transport = TcpTransport(("127.0.0.1", free_port()), logger)
+            transport.start()
+            try:
+                for k, at in enumerate(reconfig_at):
+                    _time.sleep(max(0.0, t_start + at - _time.time()))
+                    for dst, message in trigger_messages(
+                            protocol_name, config, k):
+                        transport.send(transport.listen_address, dst,
+                                       DEFAULT_SERIALIZER.to_bytes(
+                                           message))
+                    fired.append(_time.time())
+                _time.sleep(0.5)  # let the last frame flush
+            finally:
+                transport.stop()
+
+        trigger = threading.Thread(target=fire_reconfigs, daemon=True)
+        trigger.start()
+        starts = []
+        failed = None
+        try:
+            for out_csv, proc in client_procs:
+                code = proc.wait(timeout=total_s + 90)
+                if code != 0:
+                    failed = f"client exited {code}; see {bench.path}"
+                    break
+                with open(out_csv) as f:
+                    next(f)
+                    for line in f:
+                        _, start, _lat = line.strip().split(",")
+                        starts.append(float(start))
+        finally:
+            trigger.join(timeout=total_s + 10)
+            bench.cleanup()
+        if failed:
+            print(json.dumps({"series": protocol_name, "error": failed}))
+            continue
+
+        # 1-second buckets from the first recorded op.
+        t0 = min(starts) if starts else t_start
+        buckets: dict[int, int] = {}
+        for s in starts:
+            buckets[int(s - t0)] = buckets.get(int(s - t0), 0) + 1
+        reconfig_seconds = [int(f - t0) for f in fired]
+        for second in range(int(total_s)):
+            rows.append({
+                "series": protocol_name,
+                "second": second,
+                "throughput": buckets.get(second, 0),
+                "reconfig": second in reconfig_seconds,
+            })
+        # Dip/recovery summary: steady = median of pre-reconfig seconds.
+        import statistics as _st
+
+        pre = [buckets.get(s, 0) for s in range(1, reconfig_seconds[0])] \
+            if reconfig_seconds else []
+        steady = _st.median(pre) if pre else 0
+        for k, rs in enumerate(reconfig_seconds):
+            window = [buckets.get(s, 0)
+                      for s in range(rs, min(rs + 3, int(total_s)))]
+            dip = min(window) if window else 0
+            recovery = next(
+                (s - rs for s in range(rs, int(total_s))
+                 if buckets.get(s, 0) >= 0.8 * steady), None)
+            rows.append({
+                "series": f"{protocol_name}_summary",
+                "second": rs,
+                "reconfig_index": k,
+                "steady_cmds_per_sec": steady,
+                "dip_cmds_per_sec": dip,
+                "recovery_seconds": recovery,
+            })
+        print(json.dumps([r for r in rows
+                          if r["series"] == f"{protocol_name}_summary"]))
+    return rows
+
+
+def plot_reconfig_timeline(rows: list, path: str) -> None:
+    """Throughput vs time with reconfiguration instants marked (the
+    vldb20 leader_reconfiguration figure shape)."""
+    import matplotlib
+
+    matplotlib.use("pdf")
+    import matplotlib.pyplot as plt
+
+    series = [s for s in dict.fromkeys(r["series"] for r in rows)
+              if not s.endswith("_summary")]
+    if not series:
+        return  # every protocol's clients failed; nothing to plot
+    fig, axes = plt.subplots(len(series), 1, figsize=(6.4, 3.2 * len(series)),
+                             squeeze=False)
+    for ax, name in zip(axes[:, 0], series):
+        pts = [r for r in rows if r["series"] == name]
+        ax.plot([r["second"] for r in pts],
+                [r["throughput"] for r in pts], "o-", linewidth=2,
+                markersize=3)
+        for r in pts:
+            if r.get("reconfig"):
+                ax.axvline(r["second"], color="red", linestyle="--",
+                           linewidth=1)
+        ax.set_ylabel("cmds/s (1s buckets)")
+        ax.set_title(f"{name}: throughput through reconfigurations")
+        ax.grid()
+    axes[-1, 0].set_xlabel("Seconds")
+    fig.savefig(path, bbox_inches="tight")
+
+
 FAMILIES = {
     "eurosys_fig1": lambda suite, points, d: eurosys_fig(
         "multipaxos", suite, points, d),
@@ -178,6 +570,10 @@ FAMILIES = {
     "read_scale": read_scale,
     "nsdi_fig1": nsdi_fig1,
     "nsdi_fig2": nsdi_fig2,
+    "vldb20_reconfig": vldb20_reconfig,
+    "eurosys_fig4": eurosys_fig4,
+    "evelyn": evelyn,
+    "skew": skew,
 }
 
 
@@ -265,6 +661,20 @@ def main(argv=None) -> dict:
         write_csv(rows, csv_path)
         if name == "read_scale":
             plot_read_scale(rows, pdf_path)
+        elif name == "vldb20_reconfig":
+            plot_reconfig_timeline(rows, pdf_path)
+        elif name == "eurosys_fig4":
+            plot_param_sweep(rows, pdf_path, "batch_size",
+                             "batching ablation (eurosys fig4 shape)")
+        elif name == "evelyn":
+            plot_param_sweep(
+                rows, pdf_path, "read_fraction",
+                "read fraction x replicas (vldb21_evelyn shapes)",
+                y_keys=("read_throughput_p90_1s",
+                        "write_throughput_p90_1s"))
+        elif name == "skew":
+            plot_param_sweep(rows, pdf_path, "point_skew",
+                             "conflict-rate sensitivity (skew sweeps)")
         else:
             plot_lt(rows, pdf_path, name)
         out[name] = {"rows": len(rows), "csv": csv_path,

@@ -30,6 +30,12 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
 @dataclasses.dataclass(frozen=True)
 class BatcherOptions:
     batch_size: int = 100
+    # Flush a PARTIAL batch after this long (0 disables). The reference
+    # only flushes on batch_size (Batcher.scala:100-135), which assumes
+    # offered load >> batch_size; under a closed-loop trickle a partial
+    # batch would otherwise strand its commands (and the client loops
+    # waiting on them) forever.
+    flush_period_s: float = 0.05
     measure_latencies: bool = True
 
 
@@ -52,10 +58,26 @@ class Batcher(Actor):
         self.round = 0
         self.growing_batch: list[Command] = []
         self.pending_resend_batches: list[ClientRequestBatch] = []
+        self._flush_timer = None
+        if options.flush_period_s > 0:
+            self._flush_timer = self.timer(
+                "batchFlush", options.flush_period_s, self._flush_partial)
 
     def _leader_address(self) -> Address:
         return self.config.leader_addresses[self.round_system.leader(
             self.round)]
+
+    def _flush_partial(self) -> None:
+        # One-shot: re-armed by _handle_client_request when the next
+        # batch starts growing.
+        if self.growing_batch:
+            self._send_batch()
+
+    def _send_batch(self) -> None:
+        self.send(self._leader_address(), ClientRequestBatch(
+            CommandBatch(tuple(self.growing_batch))))
+        self.growing_batch.clear()
+        self.metrics_batches.inc()
 
     def receive(self, src: Address, message) -> None:
         # timed(label) handler latency summaries (Leader.scala:281-293).
@@ -80,10 +102,12 @@ class Batcher(Actor):
                                request: ClientRequest) -> None:
         self.growing_batch.append(request.command)
         if len(self.growing_batch) >= self.options.batch_size:
-            self.send(self._leader_address(), ClientRequestBatch(
-                CommandBatch(tuple(self.growing_batch))))
-            self.growing_batch.clear()
-            self.metrics_batches.inc()
+            self._send_batch()
+        elif self._flush_timer is not None \
+                and len(self.growing_batch) == 1:
+            # Arm the partial-batch flush when a batch starts growing.
+            self._flush_timer.stop()
+            self._flush_timer.start()
 
     def _handle_not_leader(self, src: Address,
                            bounce: NotLeaderBatcher) -> None:
