@@ -346,9 +346,45 @@ def main(argv=None) -> dict:
         ("tpu-pipelined", dict(quorum_backend="tpu", tpu_pipelined=True,
                                prometheus=True)),
     ]
+    # Probe the accelerator BEFORE the tpu arms: a wedged device link
+    # (observed: jax.devices() itself hanging on the axon tunnel) must
+    # degrade this artifact to its dict arms, not hang the whole run.
+    # Popen + poll (NOT subprocess.run): after a timeout, run() waits
+    # unbounded for the killed child, and a child stuck in the wedged
+    # tunnel syscall never dies -- the guard must abandon it instead.
+    import subprocess
+    import sys as _sys
+
+    probe = subprocess.Popen(
+        [_sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 90
+    while probe.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if probe.poll() is None:
+        probe.kill()  # abandoned; do NOT wait on it
+        tpu_available = False
+        tpu_probe_note = "device probe timed out after 90s (wedged link)"
+    else:
+        out, err = probe.communicate()
+        platform = (out or "").strip().lower()
+        # The device must actually BE the accelerator: a silent CPU
+        # fallback with rc=0 must not count as tpu-available.
+        tpu_available = probe.returncode == 0 and platform in (
+            "tpu", "axon")
+        tpu_probe_note = (platform or (err or "").strip()[-120:])
+    if not tpu_available:
+        print(json.dumps({"tpu_probe": tpu_probe_note,
+                          "tpu_arms": "skipped"}))
+
     points = []
     for arm, kwargs in arms:
         backend = kwargs.get("quorum_backend", "dict")
+        if backend == "tpu" and not tpu_available:
+            points.append({"arm": arm, "skipped":
+                           f"device unavailable: {tpu_probe_note}"})
+            continue
         scales = parse_scales(args.scales if backend == "dict"
                               else args.tpu_scales)
         for procs, loops in scales:
@@ -392,8 +428,6 @@ def main(argv=None) -> dict:
     # state); the per-width ratio is the median over all batches'
     # pair medians, with the range recorded.
     import statistics as _stats
-    import subprocess
-    import sys as _sys
 
     from frankenpaxos_tpu.bench.deploy_suite import role_process_env
 
@@ -521,6 +555,8 @@ def main(argv=None) -> dict:
         "benchmark": "multipaxos_lt",
         "host_cpus": os.cpu_count(),
         "duration_s": args.duration,
+        "tpu_available": tpu_available,
+        "tpu_probe": tpu_probe_note,
         "deployed_points": points,
         "sim_ab_pipeline": sim_ab,
         "crossover_inflight": crossover,

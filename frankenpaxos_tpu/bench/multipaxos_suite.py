@@ -35,6 +35,7 @@ class MultiPaxosInput:
     # 0 disables (clients talk to leaders directly).
     num_batchers: int = 0
     batch_size: int = 1
+    batch_flush_period_s: float = 0.05  # partial-batch flush
     num_clients: int = 2
     duration_s: float = 2.0
     quorum_backend: str = "dict"
@@ -104,6 +105,7 @@ def run_benchmark(bench: BenchmarkDirectory,
         overrides["coalesce_writes"] = "true"
     if input.num_batchers:
         overrides["batch_size"] = str(input.batch_size)
+        overrides["flush_period_s"] = str(input.batch_flush_period_s)
     launch_roles(bench, "multipaxos", config_path, config,
                  state_machine=input.state_machine,
                  overrides=overrides,

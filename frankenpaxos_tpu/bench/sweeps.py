@@ -199,11 +199,15 @@ def eurosys_fig4(suite: SuiteDirectory, points,
         run_benchmark,
     )
 
-    procs, loops = max(points, key=lambda p: p[0] * p[1])
+    # High offered load relative to the batch sizes: with 2 batchers a
+    # size-B batch needs ~2B outstanding requests to fill without
+    # waiting on the partial-flush timer, so the swept axis measures
+    # BATCHING, not the timer. 40 closed loops cover up to B=10.
+    procs, loops = (4, 10)
     rows = []
     for supernode in (False, True):
         series = "coupled" if supernode else "compartmentalized"
-        for batch_size in (0, 5, 20, 50):
+        for batch_size in (0, 2, 5, 10):
             for attempt in (1, 2):
                 try:
                     stats = run_benchmark(
@@ -213,6 +217,7 @@ def eurosys_fig4(suite: SuiteDirectory, points,
                             duration_s=duration_s,
                             num_batchers=2 if batch_size else 0,
                             batch_size=batch_size or 1,
+                            batch_flush_period_s=0.01,
                             supernode=supernode))
                     break
                 except RuntimeError as e:
