@@ -483,10 +483,16 @@ def main(argv=None) -> dict:
     # accelerator sits across the axon tunnel. The adaptive
     # host/device threshold routes trickle drains to the host tally,
     # so even the serial workload no longer pays per-drain tunnel RTTs.
-    sim_rows = {
-        backend: round(sim_transport_cmds_per_sec(
-            backend, args.sim_commands), 1)
-        for backend in ("dict", "tpu")}
+    # Guarded by the SAME device probe as the deployed arms: this
+    # section initializes the axon backend in-process, which hangs
+    # indefinitely on a wedged link.
+    if tpu_available:
+        sim_rows = {
+            backend: round(sim_transport_cmds_per_sec(
+                backend, args.sim_commands), 1)
+            for backend in ("dict", "tpu")}
+    else:
+        sim_rows = {"skipped": tpu_probe_note}
     print(json.dumps({"sim_tunnel_cmds_per_sec": sim_rows}))
 
     import statistics
