@@ -462,6 +462,120 @@ class TestCoalescedRunPipeline:
                     if not isinstance(v, Noop) and v.commands]
         assert set(b"v%d" % p for p in range(48)) <= set(payloads)
 
+    def test_proxy_leader_partial_run_emission_and_stray_acks(self):
+        """Run-store edge paths: a run whose quorum completes in two
+        pieces emits two ChosenRuns covering it exactly once; stray
+        re-acks for a RETIRED run are recognized (no fatal, no
+        re-emission)."""
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            Command,
+            CommandBatch,
+            CommandId,
+            Phase2aRun,
+            Phase2b,
+            Phase2bRange,
+        )
+
+        sim = make_multipaxos(f=1)
+        proxy = sim.proxy_leaders[0]
+        v = lambda i: CommandBatch((Command(  # noqa: E731
+            CommandId("client-0", i, 0), b"v%d" % i),))
+        proxy.receive("leader-0", Phase2aRun(
+            start_slot=0, round=0, values=tuple(v(i) for i in range(8))))
+        sim.transport.messages.clear()  # drop the quorum forwards
+
+        def ack(acc, lo, hi):
+            proxy.receive(f"acceptor-0-{acc}", Phase2bRange(
+                group_index=0, acceptor_index=acc,
+                slot_start_inclusive=lo, slot_end_exclusive=hi, round=0))
+
+        # First piece: slots [0, 5) reach quorum; [5, 8) have 1 vote.
+        ack(0, 0, 8)
+        ack(1, 0, 5)
+        proxy.on_drain()
+        chosen1 = [proxy.serializer.from_bytes(m.data)
+                   for m in sim.transport.messages
+                   if m.dst == "replica-0"]
+        assert [(c.start_slot, len(c.values)) for c in chosen1] == [(0, 5)]
+        sim.transport.messages.clear()
+        # Second piece completes; run retires.
+        ack(1, 5, 8)
+        proxy.on_drain()
+        chosen2 = [proxy.serializer.from_bytes(m.data)
+                   for m in sim.transport.messages
+                   if m.dst == "replica-0"]
+        assert [(c.start_slot, len(c.values)) for c in chosen2] == [(5, 3)]
+        assert proxy._runs == {} and proxy._run_starts == []
+        assert proxy._done_runs == [(0, 8, 0)]
+        sim.transport.messages.clear()
+        # Stray re-acks for the retired run: ranged AND single-slot
+        # (the single-slot path runs the fatal check) -- must be
+        # swallowed without fatal or re-emission.
+        ack(2, 2, 6)
+        proxy.receive("acceptor-0-2", Phase2b(
+            group_index=0, acceptor_index=2, slot=3, round=0))
+        proxy.on_drain()
+        assert [m for m in sim.transport.messages
+                if m.dst.startswith("replica")] == []
+
+    def test_proxy_leader_duplicate_run_ignored(self):
+        """A resent Phase2aRun for a start slot already pending must
+        not re-forward or double-register."""
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            Command,
+            CommandBatch,
+            CommandId,
+            Phase2aRun,
+        )
+
+        sim = make_multipaxos(f=1)
+        proxy = sim.proxy_leaders[0]
+        run = Phase2aRun(start_slot=0, round=0,
+                         values=(CommandBatch((Command(
+                             CommandId("client-0", 0, 0), b"a"),)),))
+        sim.transport.messages.clear()  # drop startup Phase1a traffic
+        proxy.receive("leader-0", run)
+        forwards = len(sim.transport.messages)
+        assert forwards == sim.config.f + 1
+        proxy.receive("leader-0", run)
+        assert len(sim.transport.messages) == forwards
+        assert len(proxy._run_starts) == 1
+
+    def test_failover_with_proposals_stuck_at_proxies(self):
+        """Proposals die at PARTITIONED proxy leaders mid-run; a
+        failover plus client resends must still commit every write
+        exactly once, with replicas agreeing."""
+        sim = make_multipaxos(f=1, coalesced=True)
+        got = []
+        for p in range(16):
+            sim.clients[0].write(p, b"q%d" % p, got.append)
+        sim.clients[0].flush_writes()
+        for proxy in sim.config.proxy_leader_addresses:
+            sim.transport.partition(proxy)
+        sim.transport.deliver_all_coalesced()
+        assert got == []  # proposals stuck at the partitioned proxies
+        # Fail over and heal; clients resend on discovery (the resend
+        # path is per-request ClientRequests to the new round leader).
+        sim.leaders[1].leader_change(is_new_leader=True)
+        sim.leaders[0].leader_change(is_new_leader=False)
+        for proxy in sim.config.proxy_leader_addresses:
+            sim.transport.heal(proxy)
+        sim.transport.deliver_all_coalesced()
+        for t in list(sim.transport.running_timers()):
+            if t.name.startswith("resendWrite"):
+                t.run()
+        sim.transport.deliver_all_coalesced()
+        assert len(got) == 16
+        assert executed_prefix(sim.replicas[0]) \
+            == executed_prefix(sim.replicas[1])
+        # Exactly-once EXECUTION: a resend may legitimately occupy two
+        # log slots, but the client table must execute each write once
+        # (Replica.scala:300-344) -- the SM sees every payload exactly
+        # once.
+        executed = sim.replicas[0].state_machine.get()
+        for p in range(16):
+            assert executed.count(b"q%d" % p) == 1, (p, executed)
+
     def test_acceptor_phase1b_merges_run_votes(self):
         """An acceptor reports run-voted slots in Phase1b with the
         highest round winning over per-slot votes."""
