@@ -267,14 +267,12 @@ class EPaxosReplica(Actor):
                                sequence_number=sequence_number,
                                dependencies=dependencies.copy())
         targets = self._thrifty_others(self.config.fast_quorum_size - 1)
-        for replica in targets:
-            self.send(replica, pre_accept)
+        self.broadcast(targets, pre_accept)
 
         self._stop_timers(instance)
 
         def resend():
-            for replica in self.other_addresses:
-                self.send(replica, pre_accept)
+            self.broadcast(self.other_addresses, pre_accept)
 
         self.leader_states[instance] = PreAccepting(
             ballot=ballot,
@@ -301,14 +299,14 @@ class EPaxosReplica(Actor):
                         command_or_noop=triple.command_or_noop,
                         sequence_number=triple.sequence_number,
                         dependencies=triple.dependencies.copy())
-        for replica in self._thrifty_others(self.config.slow_quorum_size - 1):
-            self.send(replica, accept)
+        self.broadcast(
+            self._thrifty_others(self.config.slow_quorum_size - 1),
+            accept)
 
         self._stop_timers(instance)
 
         def resend():
-            for replica in self.other_addresses:
-                self.send(replica, accept)
+            self.broadcast(self.other_addresses, accept)
 
         self.leader_states[instance] = Accepting(
             ballot=ballot, triple=triple,
@@ -346,13 +344,10 @@ class EPaxosReplica(Actor):
         ballot = self.largest_ballot
         prepare = Prepare(instance=instance, ballot=ballot)
         targets = self._thrifty_others(self.config.slow_quorum_size - 1)
-        for replica in targets:
-            self.send(replica, prepare)
-        self.send(self.address, prepare)
+        self.broadcast([*targets, self.address], prepare)
 
         def resend():
-            for replica in self.config.replica_addresses:
-                self.send(replica, prepare)
+            self.broadcast(self.config.replica_addresses, prepare)
 
         self.leader_states[instance] = Preparing(
             ballot=ballot, responses={},
@@ -376,8 +371,7 @@ class EPaxosReplica(Actor):
                             command_or_noop=triple.command_or_noop,
                             sequence_number=triple.sequence_number,
                             dependencies=triple.dependencies.copy())
-            for replica in self.other_addresses:
-                self.send(replica, commit)
+            self.broadcast(self.other_addresses, commit)
 
         timer = self.recover_instance_timers.pop(instance, None)
         if timer is not None:

@@ -79,12 +79,11 @@ class BPaxosLeader(Actor):
                                         command=command)
         targets = list(self.config.dep_service_node_addresses)[
             :self.config.quorum_size]
-        for node in targets:
-            self.send(node, dep_request)
+        self.broadcast(targets, dep_request)
 
         def resend():
-            for node in self.config.dep_service_node_addresses:
-                self.send(node, dep_request)
+            self.broadcast(self.config.dep_service_node_addresses,
+                           dep_request)
             timer.start()
 
         timer = self.timer(f"resendDeps {vertex_id}",
@@ -200,8 +199,7 @@ class BPaxosProposer(Actor):
 
     def _make_resend_timer(self, name: str, message) -> object:
         def resend():
-            for acceptor in self.config.acceptor_addresses:
-                self.send(acceptor, message)
+            self.broadcast(self.config.acceptor_addresses, message)
             timer.start()
 
         timer = self.timer(name, self.resend_period_s, resend)
@@ -221,16 +219,14 @@ class BPaxosProposer(Actor):
         if round == 0:
             phase2a = Phase2a(vertex_id=vertex_id, round=round,
                               vote_value=value)
-            for acceptor in targets:
-                self.send(acceptor, phase2a)
+            self.broadcast(targets, phase2a)
             self.states[vertex_id] = _Phase2State(
                 round, value, {},
                 self._make_resend_timer(f"resendPhase2a {vertex_id}",
                                         phase2a))
         else:
             phase1a = Phase1a(vertex_id=vertex_id, round=round)
-            for acceptor in targets:
-                self.send(acceptor, phase1a)
+            self.broadcast(targets, phase1a)
             self.states[vertex_id] = _Phase1State(
                 round, value, {},
                 self._make_resend_timer(f"resendPhase1a {vertex_id}",
@@ -269,9 +265,9 @@ class BPaxosProposer(Actor):
                             if r.vote_round == max_vote_round)
         phase2a = Phase2a(vertex_id=phase1b.vertex_id, round=state.round,
                           vote_value=proposal)
-        for acceptor in list(self.config.acceptor_addresses)[
-                :self.config.quorum_size]:
-            self.send(acceptor, phase2a)
+        self.broadcast(
+            list(self.config.acceptor_addresses)[
+                :self.config.quorum_size], phase2a)
         state.resend.stop()
         self.states[phase1b.vertex_id] = _Phase2State(
             state.round, proposal, {},
@@ -290,11 +286,10 @@ class BPaxosProposer(Actor):
             return
         state.resend.stop()
         self.states[phase2b.vertex_id] = _ChosenState(state.value)
-        for replica in self.config.replica_addresses:
-            self.send(replica, Commit(
-                vertex_id=phase2b.vertex_id,
-                command_or_noop=state.value.command_or_noop,
-                dependencies=state.value.dependencies.copy()))
+        self.broadcast(self.config.replica_addresses, Commit(
+            vertex_id=phase2b.vertex_id,
+            command_or_noop=state.value.command_or_noop,
+            dependencies=state.value.dependencies.copy()))
 
     def _handle_nack(self, src: Address, nack: Nack) -> None:
         state = self.states.get(nack.vertex_id)
@@ -305,9 +300,9 @@ class BPaxosProposer(Actor):
         round = self._round_system(nack.vertex_id).next_classic_round(
             self.index, nack.higher_round)
         phase1a = Phase1a(vertex_id=nack.vertex_id, round=round)
-        for acceptor in list(self.config.acceptor_addresses)[
-                :self.config.quorum_size]:
-            self.send(acceptor, phase1a)
+        self.broadcast(
+            list(self.config.acceptor_addresses)[
+                :self.config.quorum_size], phase1a)
         state.resend.stop()
         self.states[nack.vertex_id] = _Phase1State(
             round, state.value, {},
