@@ -11,12 +11,49 @@ batched compartmentalized MultiPaxos throughput, ~934k cmds/s
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, ".")
 
+
+def _device_link_alive(timeout_s: float = 90.0) -> bool:
+    """Probe the accelerator in a THROWAWAY subprocess before this
+    process imports jax: a wedged axon tunnel (observed this round)
+    hangs jax.devices() itself, and a hung bench.py records nothing.
+    Popen + poll + abandon -- waiting on a child stuck in the wedged
+    syscall also never returns."""
+    probe = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    deadline = time.time() + timeout_s
+    while probe.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if probe.poll() is None:
+        probe.kill()  # abandoned
+        return False
+    out, _ = probe.communicate()
+    return probe.returncode == 0 and (out or "").strip().lower() in (
+        "tpu", "axon")
+
+
+_DEVICE_NOTE = ""
+if not _device_link_alive():
+    # Honest degradation: run the SAME pipeline on local CPU XLA and
+    # label it -- a recorded CPU number beats a hung driver recording
+    # nothing. vs_baseline is computed from whatever actually ran.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")).strip()
+    _DEVICE_NOTE = ("accelerator link unreachable (probe timed out); "
+                    "ran on local CPU XLA instead")
+
 import jax  # noqa: E402
+
+if _DEVICE_NOTE:
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 from frankenpaxos_tpu.bench.pipeline import (  # noqa: E402
@@ -41,7 +78,9 @@ NUM_ACCEPTORS = 3         # f = 1, SimpleMajority
 # large enough to swamp the ~0.1s dispatch+fetch RTT, small enough
 # that the int32 committed counter cannot wrap (2^31).
 BLOCK = 1 << 15
-ITERS = 32768
+# CPU fallback runs ~2 orders slower; 2^26 total commits keeps the
+# degraded run to seconds while the real-device run keeps 2^30.
+ITERS = 2048 if _DEVICE_NOTE else 32768
 
 
 def _measure(spec, num_acceptors: int) -> tuple[float, float]:
@@ -113,7 +152,8 @@ def main() -> None:
         "block_slots": BLOCK,
         "window_slots": WINDOW,
         "iters": ITERS,
-        "device": str(jax.devices()[0]),
+        "device": (f"{jax.devices()[0]} [{_DEVICE_NOTE}]"
+                   if _DEVICE_NOTE else str(jax.devices()[0])),
     }))
 
 

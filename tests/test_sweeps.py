@@ -18,8 +18,9 @@ from frankenpaxos_tpu.bench.sweeps import (
 
 def test_families_registry():
     assert set(FAMILIES) == {"eurosys_fig1", "eurosys_fig2",
-                             "matchmaker_lt", "read_scale",
-                             "nsdi_fig1", "nsdi_fig2"}
+                             "eurosys_fig4", "matchmaker_lt",
+                             "read_scale", "nsdi_fig1", "nsdi_fig2",
+                             "vldb20_reconfig", "evelyn", "skew"}
 
 
 def test_csv_and_lt_plot(tmp_path):
