@@ -11,43 +11,20 @@ batched compartmentalized MultiPaxos throughput, ~934k cmds/s
 """
 
 import json
-import os
-import subprocess
 import sys
 import time
 
 sys.path.insert(0, ".")
 
+from frankenpaxos_tpu.bench.device_probe import device_probe  # noqa: E402
 
-def _device_link_alive(timeout_s: float = 90.0) -> bool:
-    """Probe the accelerator in a THROWAWAY subprocess before this
-    process imports jax: a wedged axon tunnel (observed this round)
-    hangs jax.devices() itself, and a hung bench.py records nothing.
-    Popen + poll + abandon -- waiting on a child stuck in the wedged
-    syscall also never returns."""
-    probe = subprocess.Popen(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform)"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-    deadline = time.time() + timeout_s
-    while probe.poll() is None and time.time() < deadline:
-        time.sleep(1)
-    if probe.poll() is None:
-        probe.kill()  # abandoned
-        return False
-    out, _ = probe.communicate()
-    return probe.returncode == 0 and (out or "").strip().lower() in (
-        "tpu", "axon")
-
-
-_DEVICE_NOTE = ""
-if not _device_link_alive():
-    # Honest degradation: run the SAME pipeline on local CPU XLA and
-    # label it -- a recorded CPU number beats a hung driver recording
-    # nothing. vs_baseline is computed from whatever actually ran.
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")).strip()
-    _DEVICE_NOTE = ("accelerator link unreachable (probe timed out); "
-                    "ran on local CPU XLA instead")
+_available, _probe_note = device_probe()
+# Honest degradation: on a dead link, run the SAME pipeline on local
+# CPU XLA and label it with the probe's actual diagnosis -- a recorded
+# CPU number beats a hung driver recording nothing. vs_baseline is
+# computed from whatever actually ran.
+_DEVICE_NOTE = "" if _available else (
+    f"accelerator unavailable ({_probe_note}); ran on local CPU XLA")
 
 import jax  # noqa: E402
 

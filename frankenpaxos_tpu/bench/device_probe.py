@@ -1,0 +1,47 @@
+"""Accelerator-link probe shared by every benchmark entry point.
+
+The axon device link has been observed to wedge such that
+``jax.devices()`` itself hangs indefinitely; any artifact script that
+touches the device in-process must probe FIRST, in a throwaway
+subprocess, and degrade when the link is dead instead of hanging. The
+probe uses Popen + poll + abandon: after a timeout, ``subprocess.run``'s
+own cleanup blocks in an unbounded wait on a child stuck in the wedged
+syscall, so the child must be killed and abandoned, never waited on.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+#: Platforms that count as the real accelerator (a silent CPU fallback
+#: with rc=0 must NOT count as device-available).
+_ACCELERATOR_PLATFORMS = ("tpu", "axon")
+
+
+def device_probe(timeout_s: float = 90.0) -> tuple[bool, str]:
+    """-> (device_available, note). The note records what actually
+    happened -- the reported platform on success, the platform or
+    stderr tail on a non-accelerator result, or the timeout -- so the
+    artifact carries a true diagnosis."""
+    probe = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + timeout_s
+    while probe.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if probe.poll() is None:
+        probe.kill()  # abandoned; do NOT wait on it
+        return False, (f"device probe timed out after {timeout_s:.0f}s "
+                       f"(wedged link)")
+    out, err = probe.communicate()
+    platform = (out or "").strip().lower()
+    if probe.returncode == 0 and platform in _ACCELERATOR_PLATFORMS:
+        return True, platform
+    if probe.returncode == 0:
+        return False, (f"probe reported platform {platform!r} "
+                       f"(silent CPU fallback, not the accelerator)")
+    return False, (f"probe exited {probe.returncode}: "
+                   f"{(err or '').strip()[-120:]}")

@@ -349,31 +349,12 @@ def main(argv=None) -> dict:
     # Probe the accelerator BEFORE the tpu arms: a wedged device link
     # (observed: jax.devices() itself hanging on the axon tunnel) must
     # degrade this artifact to its dict arms, not hang the whole run.
-    # Popen + poll (NOT subprocess.run): after a timeout, run() waits
-    # unbounded for the killed child, and a child stuck in the wedged
-    # tunnel syscall never dies -- the guard must abandon it instead.
     import subprocess
     import sys as _sys
 
-    probe = subprocess.Popen(
-        [_sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform)"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    deadline = time.time() + 90
-    while probe.poll() is None and time.time() < deadline:
-        time.sleep(1)
-    if probe.poll() is None:
-        probe.kill()  # abandoned; do NOT wait on it
-        tpu_available = False
-        tpu_probe_note = "device probe timed out after 90s (wedged link)"
-    else:
-        out, err = probe.communicate()
-        platform = (out or "").strip().lower()
-        # The device must actually BE the accelerator: a silent CPU
-        # fallback with rc=0 must not count as tpu-available.
-        tpu_available = probe.returncode == 0 and platform in (
-            "tpu", "axon")
-        tpu_probe_note = (platform or (err or "").strip()[-120:])
+    from frankenpaxos_tpu.bench.device_probe import device_probe
+
+    tpu_available, tpu_probe_note = device_probe()
     if not tpu_available:
         print(json.dumps({"tpu_probe": tpu_probe_note,
                           "tpu_arms": "skipped"}))
