@@ -117,6 +117,29 @@ class BenchmarkDirectory:
         self.labeled_procs[label] = proc
         return proc
 
+    @staticmethod
+    def stage_projection(role_cpu: dict) -> dict:
+        """The decoupling projection from a per-role CPU split: once
+        every stage owns a core, pipeline wall time shrinks from
+        sum(stage cpu) to max(stage cpu) -- Amdahl on the stage graph
+        (DistributionScheme.scala:151-162). Returns {} when there is
+        nothing to project. The ONE implementation shared by the sweep
+        families and the protocol suite."""
+        if not role_cpu:
+            return {}
+        total = sum(role_cpu.values())
+        bottleneck_stage = max(role_cpu, key=role_cpu.get)
+        bottleneck = role_cpu[bottleneck_stage]
+        if bottleneck <= 0:
+            return {}
+        return {
+            "role_cpu_s": round(total, 3),
+            "bottleneck_stage": bottleneck_stage,
+            "bottleneck_cpu_s": round(bottleneck, 3),
+            "parallelizable_fraction": round(1 - bottleneck / total, 3),
+            "projected_stage_speedup": round(total / bottleneck, 2),
+        }
+
     def role_cpu_seconds(self) -> dict:
         """Per-role CPU time (user+sys, /proc/<pid>/stat) for every
         still-running local role process. Call BEFORE cleanup(). The

@@ -59,23 +59,14 @@ def _lt_row(series: str, procs: int, loops: int, stats: dict) -> dict:
         "latency_median_ms": stats.get("latency.median_ms"),
         "num_requests": stats.get("num_requests"),
     }
-    # Per-role CPU + the decoupling projection (total/max over stages,
-    # the coupled_vs_compartmentalized.json formula): on this one-core
-    # host decoupled and coupled modes timeshare one CPU, so the
-    # ablation figures cannot show wall-clock separation -- the
-    # parallelizable fraction is what the row can honestly assert
-    # (DistributionScheme.scala:151-162).
-    role_cpu = stats.get("role_cpu_seconds") or {}
-    if role_cpu:
-        total = sum(role_cpu.values())
-        bottleneck = max(role_cpu.values())
-        row["role_cpu_s"] = round(total, 3)
-        row["bottleneck_stage"] = max(role_cpu, key=role_cpu.get)
-        row["bottleneck_cpu_s"] = round(bottleneck, 3)
-        if bottleneck > 0:
-            row["projected_stage_speedup"] = round(total / bottleneck, 2)
-            row["parallelizable_fraction"] = round(
-                1 - bottleneck / total, 3)
+    # Per-role CPU + the decoupling projection: on this one-core host
+    # decoupled and coupled modes timeshare one CPU, so the ablation
+    # figures cannot show wall-clock separation -- the parallelizable
+    # fraction is what the row can honestly assert.
+    from frankenpaxos_tpu.bench.harness import BenchmarkDirectory
+
+    row.update(BenchmarkDirectory.stage_projection(
+        stats.get("role_cpu_seconds") or {}))
     return row
 
 
