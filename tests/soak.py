@@ -238,6 +238,8 @@ CONFIGS: list[tuple] = [
     ("multipaxos/f1-coalesced-grid",
      lambda: MultiPaxosSimulated(f=1, coalesced=True, flexible=True,
                                  grid_shape=(2, 2))),
+    ("multipaxos/f2-coalesced",
+     lambda: MultiPaxosSimulated(f=2, coalesced=True)),
 ]
 
 
