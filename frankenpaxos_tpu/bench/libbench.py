@@ -220,12 +220,31 @@ def main(argv=None) -> dict:
     parser.add_argument("--out", default=None)
     args = parser.parse_args(argv)
 
+    # Probe the accelerator link BEFORE any in-process jax use (a
+    # wedged link hangs jax.devices() itself). On a dead/absent link,
+    # run the device kernels on labeled local CPU XLA -- the same
+    # degradation policy as bench.py -- which also keeps the XLA
+    # runtime resident either way, so the serializer rows (measured
+    # after, and ~10% slower with XLA's thread pool live on a 1-CPU
+    # host) stay comparable round over round.
+    from frankenpaxos_tpu.bench.device_probe import device_probe
+
+    available, probe_note = device_probe()
+    if not available:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    device_ops = bench_device_ops()
+    if not available:
+        device_ops["note"] = (
+            f"accelerator unavailable ({probe_note}); ran on local "
+            f"CPU XLA -- not comparable to device-run rows")
     result = {
         "benchmark": "libbench",
         "buffer_map": bench_buffer_map(),
         "int_prefix_set": bench_int_prefix_set(),
         "depgraph": bench_depgraphs(),
-        "device_ops": bench_device_ops(),
+        "device_ops": device_ops,
         "serializer": bench_serializer(),
     }
     if args.out:
