@@ -59,7 +59,7 @@ def make_multipaxos(
     quorum_backend: str = "dict",
     tpu_pipelined: bool = False,
     tpu_min_device_slots: int = 0,
-    coalesced: bool = False,
+    coalesced: "bool | str" = False,
     phase1_backend: str = "host",
     state_machine_factory=AppendLog,
     seed: int = 0,
@@ -128,9 +128,19 @@ def make_multipaxos(
     proxy_replicas = [
         ProxyReplica(a, transport, logger, config)
         for a in config.proxy_replica_addresses]
+    # coalesced=True: every client stages writes into request arrays;
+    # "mixed": even-indexed clients coalesce while odd ones send
+    # per-message ClientRequests, so the run pipeline and the per-slot
+    # path interleave in one cluster (the adversarial shape for the
+    # proxy leader's dual pending stores). Reject anything else: a
+    # typo'd mode would silently run fully per-message and a config
+    # labeled "coalesced" would cover nothing.
+    assert coalesced in (False, True, "mixed"), coalesced
     clients = [
         Client(f"client-{i}", transport, logger, config,
-               ClientOptions(coalesce_writes=coalesced),
+               ClientOptions(coalesce_writes=(
+                   coalesced is True
+                   or (coalesced == "mixed" and i % 2 == 0))),
                seed=seed + 30 + i)
         for i in range(num_clients)]
 

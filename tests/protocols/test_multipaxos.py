@@ -388,8 +388,9 @@ class MultiPaxosSimulated(SimulatedSystem):
     dict(f=2),
     dict(f=1, coalesced=True),
     dict(f=1, coalesced=True, flexible=True, grid_shape=(2, 2)),
+    dict(f=1, coalesced="mixed"),
 ], ids=["f1", "groups2", "grid", "batched", "f2", "coalesced",
-        "coalesced-grid"])
+        "coalesced-grid", "coalesced-mixed"])
 def test_simulation_no_divergence(kwargs):
     simulated = MultiPaxosSimulated(**kwargs)
     failure = Simulator(simulated, run_length=150, num_runs=20).run(seed=0)

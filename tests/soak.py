@@ -240,6 +240,11 @@ CONFIGS: list[tuple] = [
                                  grid_shape=(2, 2))),
     ("multipaxos/f2-coalesced",
      lambda: MultiPaxosSimulated(f=2, coalesced=True)),
+    # Coalescing and per-message clients COEXISTING: the run pipeline
+    # and the per-slot path interleave against the proxy leader's dual
+    # pending stores under the randomized exploration.
+    ("multipaxos/f1-coalesced-mixed",
+     lambda: MultiPaxosSimulated(f=1, coalesced="mixed")),
 ]
 
 
