@@ -31,7 +31,6 @@ import jax  # noqa: E402
 if _DEVICE_NOTE:
     jax.config.update("jax_platforms", "cpu")
 
-import numpy as np  # noqa: E402
 
 from frankenpaxos_tpu.bench.pipeline import (  # noqa: E402
     drain_latency_distribution,

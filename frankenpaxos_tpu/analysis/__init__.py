@@ -1,0 +1,35 @@
+"""paxlint: AST-based contract checking for the actor runtime, TPU hot
+paths, and wire codecs.
+
+FrankenPaxos's value proposition is that every protocol is written once
+against a single-threaded actor/transport contract and runs unchanged in
+production, simulation, and visualization -- and the TPU-first rules
+behind the ``TpuQuorumChecker`` north star (no host syncs or retrace
+hazards inside the drain path) are what keep the run pipeline's
+multi-x win from silently regressing. Neither contract is expressible
+in the type system, so this package makes them machine-checked:
+
+  * ``actor_rules``  -- PAX1xx: the single-threaded actor contract
+    (no threads/locks/sleeps in handlers, transport-owned timers, no
+    shared module state, no sends from off-loop code).
+  * ``hotpath_rules`` -- TPU2xx: no host synchronization or retrace
+    hazards in code reachable from ``on_drain``, the run-pipeline
+    handlers (``Phase2aRun``/``Phase2bRange``/``ChosenRun``), or the
+    ``ops/`` kernels.
+  * ``codec_rules``  -- COD3xx: every wire-sent message has a
+    registered codec (or a recorded grandfathering), and each codec's
+    encode/decode cover the same field set.
+
+Run it with ``python -m frankenpaxos_tpu.analysis``; see
+``docs/ANALYSIS.md`` for rule IDs, suppression pragmas
+(``# paxlint: disable=<rule>``), and baseline management.
+"""
+
+from frankenpaxos_tpu.analysis.core import (
+    Finding,
+    Project,
+    RULES,
+    run_rules,
+)
+
+__all__ = ["Finding", "Project", "RULES", "run_rules"]

@@ -1,0 +1,96 @@
+"""``python -m frankenpaxos_tpu.analysis``: run paxlint, exit-code
+gated.
+
+Exit 0 when every finding is grandfathered in the baseline (or there
+are none); exit 1 on any new finding. See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from frankenpaxos_tpu.analysis import baseline as baseline_mod
+from frankenpaxos_tpu.analysis.core import (
+    _ensure_loaded,
+    Project,
+    RULES,
+    run_rules,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m frankenpaxos_tpu.analysis",
+        description="paxlint: actor-contract / TPU-hot-path / "
+                    "wire-codec static analysis")
+    parser.add_argument(
+        "--root", default=None,
+        help="repository root (default: auto-detected from this "
+             "package's location)")
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: <root>/.paxlint-baseline.json)")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from current findings and exit 0")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding as new")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print every rule ID with its description and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        _ensure_loaded()
+        for rule in sorted(RULES):
+            print(f"{rule}  {RULES[rule]}")
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    baseline_path = args.baseline or os.path.join(
+        root, ".paxlint-baseline.json")
+
+    project = Project(root)
+    findings = run_rules(project)
+
+    if args.write_baseline:
+        baseline_mod.write(baseline_path, findings)
+        print(f"paxlint: wrote {len(findings)} grandfathered finding(s) "
+              f"to {baseline_path}")
+        return 0
+
+    entries = [] if args.no_baseline else baseline_mod.load(baseline_path)
+    new, old, stale = baseline_mod.split(findings, entries)
+
+    if old:
+        print(f"paxlint: {len(old)} grandfathered finding(s) "
+              f"(baselined in {os.path.basename(baseline_path)}):")
+        for f in old:
+            print(f"  [baseline] {f.rule} {f.file} "
+                  f"[{f.scope}] {f.detail}")
+    if stale:
+        print(f"paxlint: {len(stale)} stale baseline entr(y/ies) -- "
+              f"the finding no longer exists; prune with "
+              f"--write-baseline:")
+        for k in stale:
+            print(f"  [stale] {' '.join(k)}")
+    if new:
+        print(f"paxlint: {len(new)} NEW finding(s):")
+        for f in new:
+            print(f"  {f.render()}")
+        print("\npaxlint: fix the finding, add a justified "
+              "`# paxlint: disable=<rule>` pragma, or (last resort) "
+              "re-baseline with --write-baseline.")
+        return 1
+    checked = len(project.modules)
+    print(f"paxlint: OK -- {checked} files, "
+          f"{len(old)} grandfathered, 0 new findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""PAX1xx: the single-threaded actor/transport contract.
+
+Reference behavior: every role is an ``Actor`` whose ``receive``/
+``on_drain``/timer callbacks run serially on ONE event loop
+(NettyTcpTransport.scala:240's single ``NioEventLoopGroup``; the sim
+transport runs actors inline). The contract is what lets a protocol run
+unchanged in production, simulation, and visualization -- so handler
+code must never block, spawn, or synchronize:
+
+  * PAX101 -- no ``threading``/``multiprocessing`` use inside handlers.
+  * PAX102 -- no lock creation or ``.acquire()`` inside handlers.
+  * PAX103 -- no blocking ``time.sleep`` inside handlers.
+  * PAX104 -- timers only via the transport (``self.timer``): no
+    ``threading.Timer``, ``loop.call_later``, or ``asyncio`` scheduling
+    anywhere in an actor class.
+  * PAX105 -- no module-level mutable state referenced from more than
+    one actor class (actors colocated in one process -- supernode mode,
+    sims -- must not share state behind the transport's back).
+  * PAX106 -- no ``send``/``broadcast``/``flush`` from code that runs
+    off the event loop (thread targets); post back with
+    ``loop.call_soon_threadsafe`` instead.
+
+"Handlers" are ``receive``/``on_drain`` plus everything reachable from
+them through ``self.*()`` calls, nested defs, and callbacks passed to
+``self.timer`` -- construction-time code (``__init__``) is exempt for
+PAX101-103 because the reference itself spawns infrastructure there
+(and sends Phase1as), but PAX104 applies class-wide.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    import_aliases,
+    Module,
+    Project,
+    register_rules,
+)
+
+RULES = {
+    "PAX101": "threading/multiprocessing use inside an actor handler",
+    "PAX102": "lock creation or acquire inside an actor handler",
+    "PAX103": "blocking time.sleep inside an actor handler",
+    "PAX104": "timer not created via the transport inside an actor",
+    "PAX105": "module-level mutable state shared across actor classes",
+    "PAX106": "send/broadcast/flush from off-event-loop code",
+}
+
+_HANDLER_SEEDS = ("receive", "on_drain")
+_MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "deque",
+                  "OrderedDict", "Counter"}
+_SEND_METHODS = {"send", "send_no_flush", "broadcast", "flush", "reply"}
+
+
+def _class_index(project: Project) -> dict:
+    """class name -> (Module, ClassDef, [base names]) across the
+    project (name-keyed; duplicate names keep the first, which is fine
+    for the Actor hierarchy)."""
+    out: dict = {}
+    for mod in project:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name not in out:
+                out[node.name] = (
+                    mod, node, [dotted(b).split(".")[-1]
+                                for b in node.bases])
+    return out
+
+
+def _actor_classes(project: Project) -> list:
+    """Every class transitively deriving from Actor: (Module, ClassDef)."""
+    index = _class_index(project)
+
+    def is_actor(name: str, seen: set) -> bool:
+        if name == "Actor":
+            return True
+        if name in seen or name not in index:
+            return False
+        seen.add(name)
+        return any(is_actor(b, seen) for b in index[name][2])
+
+    return [(mod, node) for name, (mod, node, bases) in index.items()
+            if name != "Actor" and is_actor(name, set())]
+
+
+def _methods(cls: ast.ClassDef) -> dict:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _timer_callbacks(func: ast.AST) -> list:
+    """Names of methods/functions passed as the callback to
+    ``self.timer(name, delay, f)``."""
+    out = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and dotted(node.func) in (
+                "self.timer",):
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                name = dotted(arg)
+                if name.startswith("self."):
+                    out.append(name.split(".", 1)[1])
+                elif isinstance(arg, ast.Name):
+                    out.append(arg.id)
+    return out
+
+
+def _handler_closure(cls: ast.ClassDef) -> dict:
+    """Handler methods: seeds + self-call/timer-callback closure.
+    Returns {method name: node}."""
+    methods = _methods(cls)
+    frontier = [m for m in _HANDLER_SEEDS if m in methods]
+    closure: dict = {}
+    while frontier:
+        name = frontier.pop()
+        if name in closure or name not in methods:
+            continue
+        closure[name] = methods[name]
+        for node in ast.walk(methods[name]):
+            if isinstance(node, ast.Call):
+                called = dotted(node.func)
+                if called.startswith("self.") and called.count(".") == 1:
+                    frontier.append(called.split(".", 1)[1])
+        frontier.extend(_timer_callbacks(methods[name]))
+    return closure
+
+
+def _thread_targets(cls: ast.ClassDef, methods: dict) -> list:
+    """Functions that run OFF the event loop: anything passed as
+    ``target=`` to a Thread (or submitted to an executor), plus their
+    self-call closure. Returns [(name, node)]."""
+    roots: list = []
+    nested: dict = {}
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested[node.name] = node
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name.endswith("Thread") or name.endswith(".submit"):
+            candidates = [kw.value for kw in node.keywords
+                          if kw.arg == "target"]
+            if name.endswith(".submit") and node.args:
+                candidates.append(node.args[0])
+            for cand in candidates:
+                cn = dotted(cand)
+                cn = cn.split(".", 1)[1] if cn.startswith("self.") else cn
+                if cn in nested:
+                    roots.append(cn)
+    out: list = []
+    seen: set = set()
+    while roots:
+        name = roots.pop()
+        if name in seen or name not in nested:
+            continue
+        seen.add(name)
+        out.append((name, nested[name]))
+        for node in ast.walk(nested[name]):
+            if isinstance(node, ast.Call):
+                called = dotted(node.func)
+                if called.startswith("self.") and called.count(".") == 1:
+                    roots.append(called.split(".", 1)[1])
+                elif called in nested:
+                    roots.append(called)
+    return out
+
+
+def _module_refs(mod: Module) -> dict:
+    """alias -> top-level module it came from ("threading", "time"...)."""
+    out = {}
+    for alias, target in import_aliases(mod.tree, mod.name).items():
+        out[alias] = target.split(".")[0]
+    return out
+
+
+def check(project: Project):
+    findings: list = []
+    actors = _actor_classes(project)
+    per_module_actors: dict = {}
+    for mod, cls in actors:
+        per_module_actors.setdefault(mod.path, []).append(cls)
+        refs = _module_refs(mod)
+
+        def flag(rule, node, scope, detail, message):
+            findings.append(Finding(
+                rule=rule, file=mod.path, line=node.lineno,
+                scope=scope, detail=detail, message=message))
+
+        handlers = _handler_closure(cls)
+        for name, func in handlers.items():
+            scope = f"{cls.name}.{name}"
+            for node in ast.walk(func):
+                if not isinstance(node, (ast.Call, ast.Attribute,
+                                         ast.Name)):
+                    continue
+                d = dotted(node)
+                root = d.split(".")[0]
+                resolved = refs.get(root, root)
+                if isinstance(node, ast.Call):
+                    if resolved in ("threading", "multiprocessing"):
+                        flag("PAX101", node, scope, d,
+                             f"handler uses {resolved} ({d}); actors are "
+                             f"single-threaded -- stage work and use "
+                             f"on_drain or transport timers")
+                    if (d.endswith(".acquire")
+                            or d.split(".")[-1] in ("Lock", "RLock",
+                                                    "Semaphore",
+                                                    "Condition")):
+                        flag("PAX102", node, scope, d,
+                             f"handler takes/creates a lock ({d}); the "
+                             f"event loop already serializes handlers")
+                    leaf = d.split(".")[-1]
+                    if leaf == "sleep" and resolved == "time":
+                        flag("PAX103", node, scope, d,
+                             "handler blocks in time.sleep; use a "
+                             "transport timer instead")
+                elif isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    if refs.get(node.id) in ("threading",
+                                             "multiprocessing") \
+                            and node.id != "TYPE_CHECKING":
+                        flag("PAX101", node, scope, node.id,
+                             f"handler references {refs[node.id]} "
+                             f"symbol {node.id}")
+
+        # PAX104: class-wide (timers wired at construction count too).
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node)
+            leaf = d.split(".")[-1]
+            if d in ("threading.Timer",) or leaf in ("call_later",
+                                                     "call_at"):
+                scope = cls.name
+                for m, fn in _methods(cls).items():
+                    if fn.lineno <= node.lineno <= getattr(
+                            fn, "end_lineno", fn.lineno):
+                        scope = f"{cls.name}.{m}"
+                        break
+                findings.append(Finding(
+                    rule="PAX104", file=mod.path, line=node.lineno,
+                    scope=scope, detail=d,
+                    message=f"timer created via {d}; actors must use "
+                            f"self.timer(...) so sims/viz can control "
+                            f"time"))
+
+        # PAX106: sends from thread targets.
+        for name, func in _thread_targets(cls, _methods(cls)):
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    if (d.startswith("self.")
+                            and d.split(".")[-1] in _SEND_METHODS
+                            and d.count(".") == 1):
+                        findings.append(Finding(
+                            rule="PAX106", file=mod.path,
+                            line=node.lineno,
+                            scope=f"{cls.name}.{name}", detail=d,
+                            message=f"{d} called from off-loop code "
+                                    f"({name} runs on a worker thread); "
+                                    f"post results back with "
+                                    f"loop.call_soon_threadsafe"))
+
+    # PAX105: module-level mutable state shared across actor classes.
+    for path, classes in per_module_actors.items():
+        if len(classes) < 2:
+            continue
+        mod = project.modules[path]
+        mutables: dict = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                v = node.value
+                is_mut = isinstance(v, (ast.List, ast.Dict, ast.Set)) \
+                    or (isinstance(v, ast.Call)
+                        and dotted(v.func).split(".")[-1]
+                        in _MUTABLE_CALLS)
+                if is_mut:
+                    mutables[node.targets[0].id] = node
+        if not mutables:
+            continue
+        users: dict = {}
+        for cls in classes:
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load) and node.id in mutables:
+                    users.setdefault(node.id, set()).add(cls.name)
+        for name, classes_using in users.items():
+            if len(classes_using) >= 2:
+                node = mutables[name]
+                findings.append(Finding(
+                    rule="PAX105", file=path, line=node.lineno,
+                    scope="<module>", detail=name,
+                    message=f"module-level mutable {name!r} is "
+                            f"referenced by actor classes "
+                            f"{sorted(classes_using)}; shared state "
+                            f"must flow through messages"))
+    return findings
+
+
+register_rules(RULES, check)

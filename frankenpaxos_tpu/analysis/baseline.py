@@ -1,0 +1,58 @@
+"""Checked-in baseline of grandfathered paxlint findings.
+
+The baseline is a JSON list of finding keys -- ``(rule, file, scope,
+detail)`` plus the human message for review -- NOT line numbers, so it
+survives unrelated edits. Semantics:
+
+  * a finding whose key is in the baseline is *suppressed* (listed in
+    the report as grandfathered, with its rule ID);
+  * a finding not in the baseline fails the run (exit 1);
+  * a baseline entry that no longer matches any finding is *stale* and
+    reported so it can be pruned (``--write-baseline`` regenerates).
+
+Regenerate with ``python -m frankenpaxos_tpu.analysis
+--write-baseline`` -- and justify any new entry in the PR; the whole
+point is that silent regressions must become loud diffs here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def load(path: str) -> list:
+    """Baseline entries as a list of dicts (empty when absent)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise SystemExit(f"paxlint: baseline {path} is not a JSON list")
+    return data
+
+
+def keys(entries: list) -> set:
+    return {(e["rule"], e["file"], e["scope"], e["detail"])
+            for e in entries}
+
+
+def write(path: str, findings: list) -> None:
+    entries = [
+        {"rule": f.rule, "file": f.file, "scope": f.scope,
+         "detail": f.detail, "message": f.message}
+        for f in findings
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def split(findings: list, baseline_entries: list) -> tuple:
+    """-> (new findings, grandfathered findings, stale baseline keys)."""
+    known = keys(baseline_entries)
+    new = [f for f in findings if f.key not in known]
+    old = [f for f in findings if f.key in known]
+    live = {f.key for f in findings}
+    stale = sorted(k for k in known if k not in live)
+    return new, old, stale

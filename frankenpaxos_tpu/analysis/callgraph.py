@@ -1,0 +1,201 @@
+"""A lightweight, name-based call graph over one Project.
+
+Purpose-built for the TPU hot-path rules: given entry points (every
+``on_drain``, the run-pipeline message handlers, the ``ops/`` kernels),
+compute the over-approximate set of package functions reachable from
+them. Resolution is intentionally duck-typed -- ``self.f()`` resolves
+within the class (and name-matched base classes), ``mod.f()`` through
+the import table, and ``obj.f()`` to every package method named ``f``
+-- because a checker would rather over-flag (the pragma/baseline
+machinery curates) than silently miss a host sync behind a strategy
+interface like ``QuorumTracker``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    import_aliases,
+    Module,
+    Project,
+    qualname_index,
+)
+
+#: Method names never duck-resolved: builtin-collection noise that would
+#: wire the graph to unrelated classes. Package functions with these
+#: names are still reachable via self./module-qualified calls.
+_DUCK_STOPLIST = frozenset({
+    "append", "extend", "pop", "popleft", "add", "discard", "clear",
+    "keys", "values", "items", "get", "set", "setdefault", "update",
+    "sort", "tolist", "join", "split", "read", "write", "close", "wait",
+    "put", "inc", "observe", "labels", "time", "info", "debug", "warn",
+    "error", "copy", "count", "index", "format", "strip", "encode",
+    "decode", "to_bytes", "from_bytes", "any", "all", "max", "min",
+})
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition in the project."""
+
+    module: Module
+    node: ast.AST            # FunctionDef / AsyncFunctionDef
+    qualname: str            # "Class.method" or "func"
+    cls: str | None          # enclosing class name, if a method
+
+    @property
+    def ref(self) -> str:
+        return f"{self.module.path}::{self.qualname}"
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        # function ref -> FuncInfo
+        self.funcs: dict[str, FuncInfo] = {}
+        # method name -> [refs] (for duck resolution)
+        self.by_method: dict[str, list] = {}
+        # (module path, bare name) -> ref (module-level functions)
+        self.module_level: dict[tuple, str] = {}
+        # class name -> {method name -> ref} (name-keyed; collisions
+        # keep every definition via by_method)
+        self.class_methods: dict[str, dict] = {}
+        # class name -> [base class names] (package-wide, name-keyed)
+        self.bases: dict[str, list] = {}
+        self._aliases: dict[str, dict] = {}
+        for mod in project:
+            self._index_module(mod)
+
+    # --- indexing ---------------------------------------------------------
+    def _index_module(self, mod: Module) -> None:
+        self._aliases[mod.path] = import_aliases(mod.tree, mod.name)
+        quals = qualname_index(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = quals[id(node)]
+            parts = qual.split(".")
+            cls = parts[-2] if len(parts) >= 2 else None
+            info = FuncInfo(module=mod, node=node, qualname=qual, cls=cls)
+            self.funcs[info.ref] = info
+            self.by_method.setdefault(node.name, []).append(info.ref)
+            if cls is None and len(parts) == 1:
+                self.module_level[(mod.path, node.name)] = info.ref
+            if cls is not None and len(parts) == 2:
+                self.class_methods.setdefault(cls, {})[node.name] = \
+                    info.ref
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                self.bases[node.name] = [
+                    dotted(b).split(".")[-1] for b in node.bases]
+
+    # --- resolution -------------------------------------------------------
+    def _method_in_hierarchy(self, cls: str, name: str,
+                             seen: set | None = None) -> str | None:
+        seen = seen or set()
+        while cls and cls not in seen:
+            seen.add(cls)
+            ref = self.class_methods.get(cls, {}).get(name)
+            if ref is not None:
+                return ref
+            parents = self.bases.get(cls, [])
+            for p in parents[1:]:
+                ref = self._method_in_hierarchy(p, name, seen)
+                if ref is not None:
+                    return ref
+            cls = parents[0] if parents else ""
+        return None
+
+    def resolve_call(self, caller: FuncInfo, call: ast.Call) -> list:
+        """Possible callee refs for ``call`` made inside ``caller``."""
+        name = dotted(call.func)
+        if not name:
+            return []
+        parts = name.split(".")
+        aliases = self._aliases.get(caller.module.path, {})
+
+        # self.f() / cls.f(): resolve within the class hierarchy.
+        if parts[0] in ("self", "cls") and len(parts) == 2 and caller.cls:
+            ref = self._method_in_hierarchy(caller.cls, parts[1])
+            return [ref] if ref else self._duck(parts[1])
+        if parts[0] in ("self", "cls"):
+            # self.obj.f(): duck-resolve the trailing method.
+            return self._duck(parts[-1]) if len(parts) > 2 else []
+
+        # Bare f(): module-level function here, or an import alias.
+        if len(parts) == 1:
+            ref = self.module_level.get((caller.module.path, parts[0]))
+            if ref is not None:
+                return [ref]
+            target = aliases.get(parts[0])
+            if target:
+                return self._resolve_qualified(target)
+            # A locally-defined nested function.
+            prefix = caller.qualname + "." + parts[0]
+            ref = f"{caller.module.path}::{prefix}"
+            return [ref] if ref in self.funcs else []
+
+        # mod.f() / pkg.mod.f() through the import table.
+        target = aliases.get(parts[0])
+        if target:
+            return self._resolve_qualified(
+                ".".join([target] + parts[1:]))
+
+        # ClassName.f() on a class defined in this project.
+        if parts[0] in self.class_methods and len(parts) == 2:
+            ref = self._method_in_hierarchy(parts[0], parts[1])
+            return [ref] if ref else []
+
+        # obj.f(): duck typing on the method name.
+        return self._duck(parts[-1])
+
+    def _resolve_qualified(self, qualified: str) -> list:
+        """Resolve a fully-qualified dotted name against project
+        modules: ``pkg.mod.func`` or ``pkg.mod.Class.method``."""
+        parts = qualified.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            mod = self.project.by_name.get(".".join(parts[:split]))
+            if mod is None:
+                continue
+            rest = parts[split:]
+            ref = f"{mod.path}::{'.'.join(rest)}"
+            if ref in self.funcs:
+                return [ref]
+            if len(rest) == 1:
+                # A symbol re-exported through __init__: duck on name.
+                return [r for r in self.by_method.get(rest[0], ())]
+        return []
+
+    def _duck(self, method: str) -> list:
+        if method in _DUCK_STOPLIST or method.startswith("__"):
+            return []
+        return list(self.by_method.get(method, ()))
+
+    # --- reachability -----------------------------------------------------
+    def reachable(self, roots: list) -> dict:
+        """BFS from ``roots`` (function refs); returns
+        ``{ref: root_ref}`` -- which root first reached each function."""
+        out: dict = {}
+        frontier = [(r, r) for r in roots if r in self.funcs]
+        while frontier:
+            nxt = []
+            for ref, root in frontier:
+                if ref in out:
+                    continue
+                out[ref] = root
+                info = self.funcs[ref]
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Call):
+                        for callee in self.resolve_call(info, node):
+                            if callee not in out:
+                                nxt.append((callee, root))
+            frontier = nxt
+        return out

@@ -1,0 +1,348 @@
+"""COD3xx: wire-codec exhaustiveness and encode/decode symmetry.
+
+The runtime's ``HybridSerializer`` encodes registered message types with
+fixed-layout binary codecs and silently pickles everything else
+(runtime/serializer.py). That fallback is deliberate for cold-path
+messages -- but it means a NEW hot-path message (or a codec whose
+encode and decode drift apart) fails soft: the wire still works, just
+slower or subtly wrong. These rules make the codec surface explicit:
+
+  * COD301 -- every message dataclass a protocol actually SENDS (it
+    appears in a ``send``/``send_no_flush``/``broadcast`` call) in a
+    package that registers codecs must have a registered codec.
+    Intentionally-pickled cold-path messages are grandfathered in the
+    checked-in baseline with this rule ID (see docs/ANALYSIS.md).
+  * COD302 -- for each codec class: the field set ``encode`` reads off
+    the message, the field set ``decode`` passes to the constructor,
+    and the dataclass's declared fields must all agree. A field added
+    to a message but not to its codec -- or encoded but dropped on
+    decode -- is caught here instead of in production.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    Project,
+    register_rules,
+)
+
+RULES = {
+    "COD301": "protocol-sent message dataclass has no registered codec",
+    "COD302": "codec encode/decode field sets disagree with the message",
+}
+
+_SEND_NAMES = frozenset({"send", "send_no_flush", "broadcast"})
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        d = dotted(dec)
+        if d.split(".")[-1] == "dataclass":
+            return True
+    return False
+
+
+def _fields(cls: ast.ClassDef) -> list:
+    """Declared dataclass field names, in order."""
+    out = []
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            ann = dotted(node.annotation)
+            if ann.split(".")[-1] == "ClassVar":
+                continue
+            out.append(node.target.id)
+    return out
+
+
+def _codec_classes(project: Project) -> list:
+    """Every codec class: (Module, ClassDef, message_type dotted name).
+
+    The dotted name is as written at the assignment (``Phase2b``,
+    ``ur.ClientReply``); :func:`_resolve_message_class` resolves it
+    through the codec module's imports -- several protocols define
+    same-named message classes (Phase2a, ClientReply), so a global
+    name index would check codecs against the wrong dataclass."""
+    out = []
+    for mod in project:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            assigns = {stmt.targets[0].id: stmt.value
+                       for stmt in node.body
+                       if isinstance(stmt, ast.Assign)
+                       and len(stmt.targets) == 1
+                       and isinstance(stmt.targets[0], ast.Name)}
+            # A concrete codec declares BOTH message_type and tag;
+            # encode/decode may live in a shared base class
+            # (epaxos _PhaseCodec-style layouts).
+            if "message_type" not in assigns or "tag" not in assigns:
+                continue
+            msg = dotted(assigns["message_type"])
+            if msg:
+                out.append((mod, node, msg))
+    return out
+
+
+def _find_method(mod, cls: ast.ClassDef, name: str):
+    """``name`` method on ``cls`` or a same-module base (one level of
+    the shared-layout pattern)."""
+    classes = {n.name: n for n in ast.walk(mod.tree)
+               if isinstance(n, ast.ClassDef)}
+    seen: set = set()
+    stack = [cls.name]
+    while stack:
+        cur = stack.pop(0)
+        if cur in seen or cur not in classes:
+            continue
+        seen.add(cur)
+        node = classes[cur]
+        for n in node.body:
+            if isinstance(n, ast.FunctionDef) and n.name == name:
+                return n
+        stack.extend(dotted(b).split(".")[-1] for b in node.bases)
+    return None
+
+
+def _class_in_module(project: Project, mod, name: str,
+                     follow: int = 2) -> tuple | None:
+    """A dataclass ``name`` defined in ``mod``, following re-exports
+    (``from x import name``) up to ``follow`` hops."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == name \
+                and _is_dataclass(node):
+            return (mod, node)
+    if follow > 0:
+        for node in mod.tree.body:
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if (a.asname or a.name) == name:
+                        src = project.by_name.get(node.module)
+                        if src is not None:
+                            return _class_in_module(
+                                project, src, a.name, follow - 1)
+    return None
+
+
+def _resolve_message_class(project: Project, codec_mod,
+                           name: str) -> tuple | None:
+    """(Module, ClassDef) for a codec's ``message_type`` expression,
+    resolved through the codec module's imports; None when statically
+    unresolvable (e.g. a namespace passed as a function parameter)."""
+    from frankenpaxos_tpu.analysis.core import import_aliases
+
+    parts = name.split(".")
+    aliases = import_aliases(codec_mod.tree, codec_mod.name)
+    if len(parts) == 1:
+        found = _class_in_module(project, codec_mod, parts[0])
+        if found:
+            return found
+        target = aliases.get(parts[0])
+        if target:
+            parts = target.split(".")
+        else:
+            return None
+    else:
+        target = aliases.get(parts[0])
+        if target is None:
+            return None  # e.g. `ns.Msg` where ns is a runtime value
+        parts = target.split(".") + parts[1:]
+    # parts is now fully qualified: find the longest module prefix.
+    for split in range(len(parts) - 1, 0, -1):
+        mod = project.by_name.get(".".join(parts[:split]))
+        if mod is not None and split == len(parts) - 1:
+            return _class_in_module(project, mod, parts[-1])
+    return None
+
+
+def _module_funcs(mod) -> dict:
+    return {n.name: n for n in mod.tree.body
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _attr_reads(func: ast.AST, param: str) -> set:
+    return {node.attr for node in ast.walk(func)
+            if isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == param}
+
+
+def _encode_reads(mod, cls: ast.ClassDef) -> set | None:
+    """Top-level message fields the codec's encode method reads --
+    directly, or via a module-level helper the whole message is passed
+    to (``_put_reply(out, message)``). None when no encode is found."""
+    encode = _find_method(mod, cls, "encode")
+    if encode is None:
+        return None
+    args = [a.arg for a in encode.args.args if a.arg != "self"]
+    if len(args) < 2:
+        return set()
+    msg = args[1]  # encode(self, out, message)
+    reads = _attr_reads(encode, msg)
+    helpers = _module_funcs(mod)
+    for node in ast.walk(encode):
+        if not isinstance(node, ast.Call):
+            continue
+        helper = helpers.get(dotted(node.func))
+        if helper is None:
+            continue
+        for pos, arg in enumerate(node.args):
+            if isinstance(arg, ast.Name) and arg.id == msg \
+                    and pos < len(helper.args.args):
+                reads |= _attr_reads(helper,
+                                     helper.args.args[pos].arg)
+    return reads
+
+
+def _decode_fields(mod, cls: ast.ClassDef, message: str,
+                   declared: list) -> list | None:
+    """Field sets the decode method passes to the message constructor
+    (one per constructor call site -- a shared-layout decode may build
+    the message differently per branch). Construction is matched by the
+    message's own name or via ``self.message_type(...)``; a one-hop
+    module-level helper call is followed. None when no construction is
+    statically visible."""
+    decode = _find_method(mod, cls, "decode")
+    if decode is None:
+        return None
+    helpers = _module_funcs(mod)
+    scopes = [decode] + [helpers[dotted(n.func)]
+                         for n in ast.walk(decode)
+                         if isinstance(n, ast.Call)
+                         and dotted(n.func) in helpers]
+    for scope in scopes:
+        sets = []
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name.split(".")[-1] != message \
+                    and name not in ("self.message_type",
+                                     "cls.message_type"):
+                continue
+            fields = {kw.arg for kw in node.keywords if kw.arg}
+            fields.update(declared[i] for i in range(len(node.args))
+                          if i < len(declared))
+            sets.append(fields)
+        if sets:
+            return sets
+    return None
+
+
+def _package_dataclasses(project: Project, pkg_dir: str) -> dict:
+    out: dict = {}
+    for mod in project:
+        if os.path.dirname(mod.path) == pkg_dir:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) \
+                        and _is_dataclass(node):
+                    out.setdefault(node.name, (mod, node))
+    return out
+
+
+def _sent_types(project: Project, pkg_dir: str, classes: dict) -> set:
+    """Message class names that appear in send/broadcast calls within
+    the package (directly constructed, or via a one-hop local alias)."""
+    sent: set = set()
+    for mod in project:
+        if os.path.dirname(mod.path) != pkg_dir:
+            continue
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            local_types: dict = {}
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    name = dotted(node.value.func).split(".")[-1]
+                    if name in classes:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_types[t.id] = name
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func).split(".")[-1] not in _SEND_NAMES:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Call):
+                        name = dotted(arg.func).split(".")[-1]
+                        if name in classes:
+                            sent.add(name)
+                    elif isinstance(arg, ast.Name) \
+                            and arg.id in local_types:
+                        sent.add(local_types[arg.id])
+    return sent
+
+
+def check(project: Project):
+    findings: list = []
+    codecs = _codec_classes(project)
+
+    # COD302: encode/decode/declared field symmetry.
+    for mod, cls, message_dotted in codecs:
+        message = message_dotted.split(".")[-1]
+        entry = _resolve_message_class(project, mod, message_dotted)
+        if entry is None:
+            continue
+        msg_mod, msg_cls = entry
+        declared = _fields(msg_cls)
+        if not declared:
+            continue
+        reads = _encode_reads(mod, cls)
+        decode_sets = _decode_fields(mod, cls, message, declared)
+        if reads is not None:
+            missing_enc = [f for f in declared if f not in reads]
+            if missing_enc:
+                findings.append(Finding(
+                    rule="COD302", file=mod.path, line=cls.lineno,
+                    scope=cls.name, detail=f"encode:{message}",
+                    message=f"encode never reads {message} field(s) "
+                            f"{missing_enc}: encoded frames silently "
+                            f"drop them"))
+        if decode_sets is not None:
+            union = set().union(*decode_sets)
+            common = set.intersection(*decode_sets)
+            missing_dec = [f for f in declared if f not in union]
+            extra_dec = sorted(common - set(declared))
+            if missing_dec:
+                findings.append(Finding(
+                    rule="COD302", file=mod.path, line=cls.lineno,
+                    scope=cls.name, detail=f"decode:{message}",
+                    message=f"decode never sets {message} field(s) "
+                            f"{missing_dec}"))
+            if extra_dec:
+                findings.append(Finding(
+                    rule="COD302", file=mod.path, line=cls.lineno,
+                    scope=cls.name, detail=f"decode-extra:{message}",
+                    message=f"decode passes unknown field(s) "
+                            f"{extra_dec} to {message}"))
+
+    # COD301: exhaustiveness per codec-registering package.
+    pkg_dirs = sorted({os.path.dirname(mod.path)
+                       for mod, _, _ in codecs})
+    for pkg_dir in pkg_dirs:
+        registered = {message.split(".")[-1]
+                      for mod, _, message in codecs
+                      if os.path.dirname(mod.path) == pkg_dir}
+        classes = _package_dataclasses(project, pkg_dir)
+        for name in sorted(_sent_types(project, pkg_dir, classes)
+                           - registered):
+            msg_mod, msg_cls = classes[name]
+            findings.append(Finding(
+                rule="COD301", file=msg_mod.path, line=msg_cls.lineno,
+                scope=name, detail=name,
+                message=f"{name} is sent by this protocol but has no "
+                        f"registered codec: it rides the pickle "
+                        f"fallback (slower, and refused under "
+                        f"set_pickle_fallback(False))"))
+    return findings
+
+
+register_rules(RULES, check)

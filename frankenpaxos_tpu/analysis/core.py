@@ -1,0 +1,238 @@
+"""paxlint core: project model, findings, pragmas, and the rule driver.
+
+A *rule* is a function ``rule(project) -> Iterable[Finding]`` registered
+with :func:`register_rule`. The driver parses every file once into a
+:class:`Project`, runs each rule family, then filters findings through
+per-line / per-scope ``# paxlint: disable=<rule>`` pragmas. Baseline
+handling (grandfathered findings) lives in ``baseline.py``.
+
+Findings carry a *stable key* -- (rule, file, scope qualname, detail) --
+rather than a line number, so a baseline survives unrelated edits to the
+same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "TPU201"
+    file: str          # repo-relative posix path
+    line: int          # 1-based, for display only
+    scope: str         # enclosing qualname ("Class.method" / "<module>")
+    detail: str        # stable short detail (call name, class name, ...)
+    message: str       # human explanation
+
+    @property
+    def key(self) -> tuple:
+        """Line-independent identity used by pragmas and the baseline."""
+        return (self.rule, self.file, self.scope, self.detail)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: {self.rule} [{self.scope}] "
+                f"{self.message}")
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file."""
+
+    path: str                  # repo-relative posix path
+    tree: ast.Module
+    lines: list                # source lines, 0-indexed
+    # module dotted name, e.g. "frankenpaxos_tpu.ops.quorum"
+    name: str
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Project:
+    """All parsed modules under a root directory (one package)."""
+
+    def __init__(self, root: str, package: str = "frankenpaxos_tpu",
+                 exclude: tuple = ("analysis",)):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.modules: dict[str, Module] = {}  # path -> Module
+        self.by_name: dict[str, Module] = {}  # dotted name -> Module
+        pkg_dir = os.path.join(self.root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__"
+                and os.path.relpath(os.path.join(dirpath, d), pkg_dir)
+                .replace(os.sep, "/") not in exclude)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    self._load(os.path.join(dirpath, fn))
+
+    def _load(self, abspath: str) -> None:
+        rel = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            raise SystemExit(f"paxlint: cannot parse {rel}: {e}")
+        name = rel[:-len(".py")].replace("/", ".")
+        if name.endswith(".__init__"):
+            name = name[:-len(".__init__")]
+        mod = Module(path=rel, tree=tree, lines=source.splitlines(),
+                     name=name)
+        self.modules[rel] = mod
+        self.by_name[name] = mod
+
+    def __iter__(self) -> Iterator[Module]:
+        return iter(self.modules.values())
+
+
+# --- rule registry ----------------------------------------------------------
+
+RULES: dict[str, str] = {}  # rule id -> one-line description
+_RULE_FUNCS: list = []
+
+
+def register_rules(ids: dict, func: Callable[[Project], Iterable[Finding]],
+                   ) -> None:
+    """Register a rule family: a checker function plus the IDs it can
+    emit (IDs feed ``--list-rules`` and pragma validation)."""
+    RULES.update(ids)
+    _RULE_FUNCS.append(func)
+
+
+def run_rules(project: Project) -> list:
+    """All findings from all registered rule families, pragma-filtered,
+    sorted by (file, line)."""
+    _ensure_loaded()
+    findings: list = []
+    seen: set = set()
+    for func in _RULE_FUNCS:
+        for f in func(project):
+            # One finding per stable key: a nested AST walk (or two
+            # rule paths) may flag the same construct twice.
+            if f.key not in seen:
+                seen.add(f.key)
+                findings.append(f)
+    findings = [f for f in findings if not _suppressed(project, f)]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def _ensure_loaded() -> None:
+    """Import the rule-family modules (each registers itself)."""
+    from frankenpaxos_tpu.analysis import (  # noqa: F401
+        actor_rules,
+        codec_rules,
+        hotpath_rules,
+    )
+
+
+# --- pragmas ----------------------------------------------------------------
+
+_PRAGMA = re.compile(r"#\s*paxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def pragma_rules(line: str) -> set:
+    """Rule IDs disabled by a ``# paxlint: disable=A,B`` comment on
+    ``line`` (empty set if none)."""
+    m = _PRAGMA.search(line)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def _suppressed(project: Project, finding: Finding) -> bool:
+    """A finding is suppressed by a pragma on its own line, on the
+    immediately preceding (comment) line, or on the ``def``/``class``
+    line of any enclosing scope."""
+    mod = project.modules.get(finding.file)
+    if mod is None:
+        return False
+    if finding.rule in pragma_rules(mod.line(finding.line)):
+        return True
+    line = finding.line - 1
+    while line >= 1:
+        prev = mod.line(line).strip()
+        if not prev.startswith("#"):
+            break
+        if finding.rule in pragma_rules(prev):
+            return True
+        line -= 1
+    for node in _enclosing_defs(mod.tree, finding.line):
+        if finding.rule in pragma_rules(mod.line(node.lineno)):
+            return True
+    return False
+
+
+def _enclosing_defs(tree: ast.Module, lineno: int) -> list:
+    """Every def/class whose span contains ``lineno``."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                out.append(node)
+    return out
+
+
+# --- shared AST helpers (used by every rule family) -------------------------
+
+
+def qualname_index(tree: ast.Module) -> dict:
+    """id(def-node) -> dotted qualname ("Class.method", "func.inner")."""
+    out: dict = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[id(child)] = q
+                visit(child, q)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ("jax.device_get",
+    "self.tracker.drain", "np.asarray"); "" when unnameable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted(node.func)
+
+
+def import_aliases(tree: ast.Module, package: str) -> dict:
+    """local alias -> fully qualified module or symbol name, for both
+    ``import x.y as z`` and ``from x import y [as z]``."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out

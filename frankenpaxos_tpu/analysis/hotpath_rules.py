@@ -1,0 +1,377 @@
+"""TPU2xx: no host syncs or retrace hazards in the drain hot path.
+
+The run pipeline's whole win (ClientRequestArray -> Phase2aRun ->
+Phase2bRange -> ChosenRun -> ClientReplyArray, one device dispatch per
+event-loop drain) evaporates if anything reachable from the drain path
+blocks on the device link or forces XLA to retrace. These rules walk a
+name-based call graph from three root sets --
+
+  * every actor's ``on_drain``,
+  * the run-pipeline message handlers (the call targets guarded by
+    ``isinstance(msg, Phase2aRun / Phase2bRange / Phase2bVotes /
+    ChosenRun / ClientRequestArray / ClientReplyArray)``),
+  * everything in ``ops/`` (the kernel package),
+
+-- and flag host-synchronization idioms inside the reachable set, plus
+retrace hazards inside any ``jax.jit``-ted function project-wide:
+
+  * TPU201 -- ``block_until_ready`` in the hot path.
+  * TPU202 -- ``jax.device_get`` in the hot path.
+  * TPU203 -- ``np.asarray``/``np.array`` of a device value (the result
+    of a ``*_async`` dispatch) in the hot path: a blocking fetch.
+  * TPU204 -- ``float()``/``int()``/``bool()`` of a traced value inside
+    a jitted function (forces a host sync at trace time).
+  * TPU205 -- Python ``if`` on a traced value inside a jitted function
+    (TracerBoolConversionError at best, silent retrace at worst).
+  * TPU206 -- retrace hazards: ``jax.jit`` invoked inside a hot/jitted
+    function body (fresh cache per call), or a static arg bound to a
+    non-hashable (list/dict/set) literal.
+  * TPU207 -- Python loop over a traced shape inside a jitted function
+    (unrolls and recompiles per shape).
+
+Intentional sync points (the drain's single fetch, explicit ``*_sync``
+wrappers) carry ``# paxlint: disable=<rule>`` pragmas with their
+justification -- new syncs have to declare themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.callgraph import CallGraph
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    import_aliases,
+    Project,
+    qualname_index,
+    register_rules,
+)
+
+RULES = {
+    "TPU201": "block_until_ready reachable from the drain hot path",
+    "TPU202": "jax.device_get reachable from the drain hot path",
+    "TPU203": "blocking np.asarray of a device value in the hot path",
+    "TPU204": "float/int/bool coercion of a traced value in a jitted fn",
+    "TPU205": "Python `if` on a traced value in a jitted fn",
+    "TPU206": "jit retrace hazard (nested jit / non-hashable static)",
+    "TPU207": "Python loop over a traced shape in a jitted fn",
+}
+
+RUN_PIPELINE_MESSAGES = frozenset({
+    "Phase2aRun", "Phase2bRange", "Phase2bVotes", "ChosenRun",
+    "ClientRequestArray", "ClientReplyArray",
+})
+
+
+# --- root discovery ---------------------------------------------------------
+
+
+def _roots(project: Project, graph: CallGraph) -> dict:
+    """{ref: reason} for every hot-path entry point."""
+    roots: dict = {}
+    ops_prefix = f"{project.package}/ops/"
+    for ref, info in graph.funcs.items():
+        if info.name == "on_drain":
+            roots[ref] = "on_drain"
+        if info.module.path.startswith(ops_prefix):
+            roots[ref] = "ops kernel"
+    # Run-pipeline handlers: calls guarded by isinstance checks against
+    # the run-pipeline message types.
+    for ref, info in list(graph.funcs.items()):
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.If):
+                continue
+            matched = _isinstance_messages(node.test)
+            if not matched:
+                continue
+            for sub in node.body:
+                for call in ast.walk(sub):
+                    if isinstance(call, ast.Call):
+                        for callee in graph.resolve_call(info, call):
+                            roots.setdefault(
+                                callee,
+                                f"handles {'/'.join(sorted(matched))}")
+    return roots
+
+
+def _isinstance_messages(test: ast.AST) -> set:
+    """Run-pipeline message names matched by an isinstance() test."""
+    out: set = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and dotted(node.func) \
+                == "isinstance" and len(node.args) == 2:
+            target = node.args[1]
+            names = [dotted(e) for e in (
+                target.elts if isinstance(target, ast.Tuple)
+                else [target])]
+            out.update(n.split(".")[-1] for n in names
+                       if n.split(".")[-1] in RUN_PIPELINE_MESSAGES)
+    return out
+
+
+# --- jit discovery ----------------------------------------------------------
+
+
+def _jit_info(func: ast.AST, aliases: dict) -> tuple | None:
+    """(static_argnums, static_argnames) if ``func`` is jit-decorated,
+    else None."""
+    for dec in getattr(func, "decorator_list", ()):
+        jit_call = None
+        if _is_jit_name(dec, aliases):
+            return ((), ())
+        if isinstance(dec, ast.Call):
+            if _is_jit_name(dec.func, aliases):
+                jit_call = dec
+            elif dotted(dec.func).split(".")[-1] == "partial" and \
+                    dec.args and _is_jit_name(dec.args[0], aliases):
+                jit_call = dec
+        if jit_call is not None:
+            return _static_args(jit_call)
+    return None
+
+
+def _is_jit_name(node: ast.AST, aliases: dict) -> bool:
+    d = dotted(node)
+    if d in ("jax.jit", "jit"):
+        return d != "jit" or aliases.get("jit", "").endswith("jax.jit") \
+            or aliases.get("jit") == "jax.jit"
+    return aliases.get(d, "") == "jax.jit"
+
+
+def _static_args(call: ast.Call) -> tuple:
+    nums: tuple = ()
+    names: tuple = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = tuple(_int_elts(kw.value))
+        elif kw.arg == "static_argnames":
+            names = tuple(_str_elts(kw.value))
+    return nums, names
+
+
+def _int_elts(node: ast.AST) -> list:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _str_elts(node: ast.AST) -> list:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _traced_params(func: ast.AST, statics: tuple) -> set:
+    """Parameter names that are traced under jit (not static, not
+    self/cls)."""
+    nums, names = statics
+    args = func.args
+    all_args = list(args.posonlyargs) + list(args.args)
+    traced = set()
+    for i, a in enumerate(all_args):
+        if a.arg in ("self", "cls"):
+            continue
+        if i in nums or a.arg in names:
+            continue
+        traced.add(a.arg)
+    for a in args.kwonlyargs:
+        if a.arg not in names:
+            traced.add(a.arg)
+    return traced
+
+
+def _root_names(expr: ast.AST) -> set:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+# --- the checker ------------------------------------------------------------
+
+
+def check(project: Project):
+    findings: list = []
+    graph = CallGraph(project)
+    roots = _roots(project, graph)
+    reachable = graph.reachable(list(roots))
+
+    def flag(rule, mod, node, scope, detail, message):
+        findings.append(Finding(
+            rule=rule, file=mod.path, line=node.lineno, scope=scope,
+            detail=detail, message=message))
+
+    # Host-sync idioms in the reachable set.
+    for ref, root in reachable.items():
+        info = graph.funcs[ref]
+        mod = info.module
+        via = roots.get(root)
+        root_name = graph.funcs[root].qualname
+        how = (f"reachable from {root_name} ({via})"
+               if ref != root else f"a hot-path root ({via})")
+        aliases = import_aliases(mod.tree, mod.name)
+        async_locals = _async_locals(info.node)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            if leaf == "block_until_ready":
+                flag("TPU201", mod, node, info.qualname, d,
+                     f"{d} blocks on the device link in code {how}; "
+                     f"dispatch async and fetch off the drain path")
+            elif leaf == "device_get":
+                flag("TPU202", mod, node, info.qualname, d,
+                     f"{d} synchronously fetches from device in code "
+                     f"{how}")
+            elif leaf in ("asarray", "array") and len(node.args) >= 1 \
+                    and _is_numpy(d, aliases):
+                arg = node.args[0]
+                src = None
+                if isinstance(arg, ast.Call) and \
+                        dotted(arg.func).split(".")[-1].endswith("_async"):
+                    src = dotted(arg.func)
+                elif isinstance(arg, ast.Name) and arg.id in async_locals:
+                    src = async_locals[arg.id]
+                if src is not None:
+                    flag("TPU203", mod, node, info.qualname,
+                         f"{d}({src})",
+                         f"{d} of the {src} dispatch blocks on the "
+                         f"device in code {how}; fetch outside the "
+                         f"drain (collector thread / flush timer)")
+
+    # Retrace / trace-coercion hazards in jitted functions, plus nested
+    # jit in hot code (project-wide: kernels are hot by definition).
+    for mod in project:
+        aliases = import_aliases(mod.tree, mod.name)
+        quals = qualname_index(mod.tree)
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = quals[id(func)]
+            statics = _jit_info(func, aliases)
+            ref = f"{mod.path}::{qual}"
+            if statics is None:
+                if ref in reachable:
+                    for node in _own_nodes(func):
+                        if isinstance(node, ast.Call) and \
+                                _is_jit_name(node.func, aliases):
+                            flag("TPU206", mod, node, qual, "nested jit",
+                                 "jax.jit called inside a hot-path "
+                                 "function: a fresh jit wrapper per "
+                                 "call retraces every time; hoist it "
+                                 "to module scope")
+                continue
+            traced = _traced_params(func, statics)
+            for node in _own_nodes(func):
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    if d in ("float", "int", "bool") and node.args:
+                        used = _root_names(node.args[0]) & traced
+                        if used:
+                            flag("TPU204", mod, node, qual,
+                                 f"{d}({'/'.join(sorted(used))})",
+                                 f"{d}() of traced value "
+                                 f"{sorted(used)} inside jit forces a "
+                                 f"host sync at trace time")
+                    elif _is_jit_name(node.func, aliases):
+                        flag("TPU206", mod, node, qual, "nested jit",
+                             "jax.jit created inside a jitted "
+                             "function body retraces per call")
+                elif isinstance(node, ast.If):
+                    used = _root_names(node.test) & traced
+                    if used and not _isinstance_test(node.test):
+                        flag("TPU205", mod, node, qual,
+                             f"if {'/'.join(sorted(used))}",
+                             f"Python `if` on traced value "
+                             f"{sorted(used)} inside jit; use "
+                             f"jnp.where/lax.cond")
+                elif isinstance(node, (ast.For, ast.While)):
+                    it = node.iter if isinstance(node, ast.For) \
+                        else node.test
+                    shape_dep = any(
+                        isinstance(sub, ast.Attribute)
+                        and sub.attr == "shape"
+                        and _root_names(sub) & traced
+                        for sub in ast.walk(it))
+                    if shape_dep or (_root_names(it) & traced
+                                     and isinstance(node, ast.For)):
+                        flag("TPU207", mod, node, qual,
+                             "loop over traced value",
+                             "Python loop over a traced value/shape "
+                             "inside jit unrolls the trace and "
+                             "recompiles per shape; use lax.scan or "
+                             "static shapes")
+
+    # Non-hashable static args at jit call sites: jax.jit(f,
+    # static_argnums=...) called with a list/dict/set literal there.
+    for mod in project:
+        aliases = import_aliases(mod.tree, mod.name)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    _is_jit_name(node.func, aliases):
+                for kw in node.keywords:
+                    if kw.arg in ("static_argnums", "static_argnames"):
+                        continue
+                    if isinstance(kw.value, (ast.List, ast.Dict,
+                                             ast.Set)):
+                        flag("TPU206", mod, node, "<module>",
+                             f"static {kw.arg}",
+                             f"non-hashable literal bound to jit "
+                             f"argument {kw.arg!r}: every call "
+                             f"retraces (statics must be hashable)")
+    return findings
+
+
+def _is_numpy(name: str, aliases: dict) -> bool:
+    root = name.split(".")[0]
+    return aliases.get(root, root) in ("numpy", "np") or root == "np"
+
+
+def _isinstance_test(test: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call)
+               and dotted(n.func) == "isinstance"
+               for n in ast.walk(test))
+
+
+def _own_nodes(func: ast.AST):
+    """Nodes of ``func`` excluding nested function/class bodies (they
+    are visited as their own scopes)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _async_locals(func: ast.AST) -> dict:
+    """Local names bound from a ``*_async(...)`` call result:
+    {name: dispatch call name}."""
+    out: dict = {}
+    for node in ast.walk(func):
+        value = None
+        targets = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                and getattr(node, "value", None) is not None:
+            value, targets = node.value, [node.target]
+        if isinstance(value, ast.Call):
+            d = dotted(value.func)
+            if d.split(".")[-1].endswith("_async"):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = d
+    return out
+
+
+register_rules(RULES, check)

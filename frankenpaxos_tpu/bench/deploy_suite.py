@@ -10,6 +10,7 @@ a few commands from an in-process client, and assert replies arrive.
 from __future__ import annotations
 
 import os
+import socket
 import sys
 import threading
 import time
@@ -76,6 +77,24 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     # the stripped fast-start environment.
     needs_tpu = any(v == "tpu" for v in (overrides or {}).values())
     env = None if needs_tpu else role_process_env()
+    # Explicit wait-for-listen handshake (local deployments): the
+    # launcher listens on an ephemeral port; each role connects back
+    # and reports its label AFTER binding its listeners, constructing
+    # its actors, and starting its metrics endpoint. This replaces the
+    # old sleep-and-grep of role logs for "listening", which raced log
+    # flushing under load (the deployment startup race behind the
+    # flaky read/write-benchmark test). Remote hosts keep the log-grep
+    # path through host.grep_ready: their roles can't necessarily dial
+    # back to a listener on this machine's loopback.
+    handshake = type(host) is LocalHost
+    ready_server = None
+    ready_args: list = []
+    if handshake:
+        ready_server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ready_server.bind(("127.0.0.1", 0))
+        ready_server.listen(128)
+        ready_args = ["--ready_addr",
+                      f"127.0.0.1:{ready_server.getsockname()[1]}"]
     labels = []
     prometheus_ports: dict[str, int] = {}
     if supernode:
@@ -94,7 +113,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                 "--protocol", protocol_name, "--role", role_name,
                 "--index", str(index), "--config", config_path,
                 "--state_machine", state_machine,
-                "--seed", str(index)]
+                "--seed", str(index)] + ready_args
         if prometheus:
             prometheus_ports[label] = free_port()
             cmd += ["--prometheus_port",
@@ -109,8 +128,51 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
         bench.write_json("prometheus.json",
                          scrape_config(prometheus_ports))
 
+    try:
+        pending = _wait_ready(bench, host, labels, ready_server,
+                              ready_timeout_s)
+    finally:
+        if ready_server is not None:
+            ready_server.close()
+    if pending:
+        bench.cleanup()
+        raise RuntimeError(
+            f"{protocol_name} roles never became ready: {sorted(pending)}")
+    return labels
+
+
+def _wait_ready(bench: BenchmarkDirectory, host, labels: list,
+                ready_server, ready_timeout_s: float) -> set:
+    """Wait for every role to become ready; returns the labels that
+    never did. With ``ready_server`` set, readiness is the role's own
+    connect-back handshake (and a role process that EXITS before
+    reporting fails immediately instead of burning the full timeout);
+    otherwise fall back to polling role logs for "listening"."""
     deadline = time.time() + ready_timeout_s
     pending = set(labels)
+    if ready_server is not None:
+        ready_server.settimeout(0.25)
+        while pending and time.time() < deadline:
+            dead = [label for label in sorted(pending)
+                    if not bench.labeled_procs[label].running()]
+            if dead:
+                bench.cleanup()
+                raise RuntimeError(
+                    f"role process(es) exited before becoming ready: "
+                    f"{dead}; see {bench.path}/<label>.log")
+            try:
+                conn, _ = ready_server.accept()
+            except socket.timeout:
+                continue
+            try:
+                conn.settimeout(5)
+                with conn, conn.makefile() as f:
+                    pending.discard(f.readline().strip())
+            except OSError:
+                # A half-open/reset connection reads as "not ready yet";
+                # the deadline still bounds the wait.
+                pass
+        return pending
     while pending and time.time() < deadline:
         # Through the host (one round-trip for ALL pending labels) so
         # remote logs -- possibly on a disjoint filesystem, see
@@ -121,11 +183,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
         pending -= {label for label in pending
                     if bench.abspath(f"{label}.log") in ready}
         time.sleep(0.1)
-    if pending:
-        bench.cleanup()
-        raise RuntimeError(
-            f"{protocol_name} roles never became ready: {sorted(pending)}")
-    return labels
+    return pending
 
 
 def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,

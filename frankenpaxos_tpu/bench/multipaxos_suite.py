@@ -9,6 +9,7 @@ drive closed-loop clients, and report the reference-compatible stats.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 
@@ -91,6 +92,41 @@ def placement(input: MultiPaxosInput) -> dict:
 
 def run_benchmark(bench: BenchmarkDirectory,
                   input: MultiPaxosInput) -> dict:
+    # Launch + leader warmup, with ONE retry on a fresh placement: a
+    # lost startup race (a free_port() stolen between allocation and
+    # bind, a role losing the scheduler lottery on a loaded 1-CPU
+    # host) is a deployment artifact, not a benchmark result, and a
+    # retry runs with entirely fresh ports. The per-role readiness
+    # itself is the launch_roles connect-back handshake.
+    for attempt in (1, 2):
+        try:
+            config_path, config = _launch_and_warm(bench, input)
+            break
+        except RuntimeError as e:
+            if attempt == 2:
+                raise
+            # Keep the failed attempt diagnosable: say what happened,
+            # and move its role logs aside before the relaunch reopens
+            # the same {label}.log paths with mode "w" (which would
+            # destroy the attempt-1 evidence).
+            print(f"deployment startup attempt {attempt} failed "
+                  f"({e}); retrying with fresh ports")
+            import glob
+
+            for log in glob.glob(os.path.join(bench.path, "*.log")):
+                os.replace(log, f"{log}.attempt{attempt}")
+
+    if input.client_procs > 0:
+        return _run_with_client_procs(bench, input, config_path)
+
+    return _run_with_client_threads(bench, input, config)
+
+
+def _launch_and_warm(bench: BenchmarkDirectory,
+                     input: MultiPaxosInput) -> tuple:
+    """One deployment startup attempt: launch every role (handshake
+    readiness) and commit a warmup write through leader 0. Raises
+    RuntimeError -- with the roles already cleaned up -- on failure."""
     from frankenpaxos_tpu.bench.deploy_suite import launch_roles
     from frankenpaxos_tpu.deploy import get_protocol
     from frankenpaxos_tpu.protocols.multipaxos import Client, ClientOptions
@@ -115,12 +151,11 @@ def run_benchmark(bench: BenchmarkDirectory,
                  # device link, which takes minutes under contention.
                  ready_timeout_s=(120.0 if input.quorum_backend == "dict"
                                   else 300.0))
-    serializer = PickleSerializer()
 
     # Explicit leader-ready probe: a warmup write with a short resend
     # period retries until leader 0 has completed Phase 1 and can commit
-    # it. Only then does the measured run start (replaces the old
-    # sleep-and-hope, which raced under load).
+    # it. Only then does the measured run start.
+    serializer = PickleSerializer()
     probe_logger = FakeLogger(LogLevel.FATAL)
     probe_transport = TcpTransport(("127.0.0.1", free_port()), probe_logger)
     probe_transport.start()
@@ -142,9 +177,14 @@ def run_benchmark(bench: BenchmarkDirectory,
     if not ok:
         bench.cleanup()
         raise RuntimeError("leader never committed the warmup write")
+    return config_path, config
 
-    if input.client_procs > 0:
-        return _run_with_client_procs(bench, input, config_path)
+
+def _run_with_client_threads(bench: BenchmarkDirectory,
+                             input: MultiPaxosInput, config) -> dict:
+    from frankenpaxos_tpu.protocols.multipaxos import Client, ClientOptions
+
+    serializer = PickleSerializer()
 
     # Closed-loop clients (in-process, real TCP). Each op comes from the
     # workload: writes go through the Phase2 write path; reads through

@@ -69,6 +69,11 @@ def main(argv=None) -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--prometheus_port", type=int, default=0,
                         help="0 disables the metrics endpoint")
+    parser.add_argument("--ready_addr", default=None,
+                        help="host:port the launcher listens on for the "
+                             "wait-for-listen handshake: once this role "
+                             "is fully constructed and listening, it "
+                             "connects there and reports its label")
     # Back-compat shorthands (now spelled --options.*):
     parser.add_argument("--quorum_backend", default=None,
                         choices=[None, "dict", "tpu"])
@@ -180,6 +185,25 @@ def main(argv=None) -> None:
 
     logger.info(f"{args.protocol} {args.role} {args.index} "
                 f"listening on {address}")
+    if args.ready_addr:
+        # Explicit readiness handshake (deploy_suite.launch_roles): by
+        # this point every listener is bound, every actor constructed,
+        # and the metrics endpoint (if any) serving -- so connecting
+        # back and reporting our label is a true end-to-end "ready",
+        # unlike grepping logs (which races log flushing and says
+        # nothing about whether the process can actually be reached).
+        import socket
+
+        ready_host, _, ready_port = args.ready_addr.rpartition(":")
+        try:
+            with socket.create_connection(
+                    (ready_host, int(ready_port)), timeout=10) as sock:
+                sock.sendall(f"{args.role}_{args.index}\n".encode())
+        except OSError as e:
+            # The launcher may have timed out and gone away; the role
+            # itself is healthy, so keep serving.
+            logger.warn(f"ready handshake to {args.ready_addr} "
+                        f"failed: {e}")
     # Exit cleanly on SIGTERM so wrappers that dump state at interpreter
     # exit (cProfile's -m runner, the perf_util.py:37 analog) get to
     # write their output when the harness kills the role.

@@ -11,8 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Union
-
 from frankenpaxos_tpu.runtime.transport import Address
 
 # Re-used value/message shapes identical to MultiPaxos.

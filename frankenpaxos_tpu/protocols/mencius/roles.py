@@ -8,9 +8,11 @@ from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Optional
 
-from sortedcontainers import SortedDict  # type: ignore[import-untyped]
+try:
+    from sortedcontainers import SortedDict  # type: ignore[import-untyped]
+except ImportError:  # stripped environments: pure-Python fallback
+    from frankenpaxos_tpu.utils.sorted_compat import SortedDict
 
 from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin

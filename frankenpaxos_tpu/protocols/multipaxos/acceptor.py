@@ -9,7 +9,10 @@ leader that forwarded it), ``max_voted_slot`` serving quorum reads.
 from __future__ import annotations
 
 import dataclasses
-from sortedcontainers import SortedDict  # type: ignore[import-untyped]
+try:
+    from sortedcontainers import SortedDict  # type: ignore[import-untyped]
+except ImportError:  # stripped environments: pure-Python fallback
+    from frankenpaxos_tpu.utils.sorted_compat import SortedDict
 
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger

@@ -8,7 +8,7 @@ in Python -- receive() dispatches on type).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Union
+from typing import Union
 
 from frankenpaxos_tpu.runtime.transport import Address
 

@@ -19,8 +19,6 @@ from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Optional
-
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig

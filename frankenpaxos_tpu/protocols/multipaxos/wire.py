@@ -30,12 +30,16 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ChosenWatermark,
     ClientReply,
     ClientReplyArray,
+    ClientReplyBatch,
     ClientRequest,
     ClientRequestArray,
     ClientRequestBatch,
     Command,
     CommandBatch,
     CommandId,
+    EventualReadRequest,
+    MaxSlotReply,
+    MaxSlotRequest,
     Noop,
     NOOP,
     Phase2a,
@@ -43,6 +47,10 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase2b,
     Phase2bRange,
     Phase2bVotes,
+    ReadReply,
+    ReadReplyBatch,
+    ReadRequest,
+    SequentialReadRequest,
 )
 
 _I64 = struct.Struct("<q")
@@ -97,19 +105,26 @@ def _take_address(buf: bytes, at: int):
     return raw.decode(), at
 
 
-def _put_command(out: bytearray, command: Command) -> None:
-    cid = command.command_id
+def _put_cid(out: bytearray, cid: CommandId) -> None:
     _put_address(out, cid.client_address)
     out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
+
+
+def _take_cid(buf: bytes, at: int) -> tuple[CommandId, int]:
+    address, at = _take_address(buf, at)
+    pseudonym, id = _I64I64.unpack_from(buf, at)
+    return CommandId(address, pseudonym, id), at + 16
+
+
+def _put_command(out: bytearray, command: Command) -> None:
+    _put_cid(out, command.command_id)
     _put_bytes(out, command.command)
 
 
 def _take_command(buf: bytes, at: int) -> tuple[Command, int]:
-    address, at = _take_address(buf, at)
-    pseudonym, id = _I64I64.unpack_from(buf, at)
-    at += 16
+    cid, at = _take_cid(buf, at)
     payload, at = _take_bytes(buf, at)
-    return Command(CommandId(address, pseudonym, id), payload), at
+    return Command(cid, payload), at
 
 
 def _put_value(out: bytearray, value) -> None:
@@ -210,19 +225,10 @@ class ClientReplyCodec(MessageCodec):
     tag = 6
 
     def encode(self, out, message):
-        cid = message.command_id
-        _put_address(out, cid.client_address)
-        out += _I64I64.pack(cid.client_pseudonym, cid.client_id)
-        out += _I64.pack(message.slot)
-        _put_bytes(out, message.result)
+        _put_reply(out, message)
 
     def decode(self, buf, at):
-        address, at = _take_address(buf, at)
-        pseudonym, id = _I64I64.unpack_from(buf, at)
-        (slot,) = _I64.unpack_from(buf, at + 16)
-        result, at = _take_bytes(buf, at + 24)
-        return ClientReply(CommandId(address, pseudonym, id), slot,
-                           result), at
+        return _take_reply(buf, at, ClientReply)
 
 
 class ChosenWatermarkCodec(MessageCodec):
@@ -508,10 +514,137 @@ class ClientReplyArrayCodec(MessageCodec):
         return ClientReplyArray(entries=tuple(entries)), at
 
 
+# --- read-path codecs -------------------------------------------------------
+# The read hot path (the Evelyn read-scale mechanism): MaxSlotRequest ->
+# MaxSlotReply quorum, then a Read*Request to one replica answered with
+# a ReadReplyBatch. These carry every benchmarked read, so they get
+# fixed layouts like the write path; the read-BATCHER shapes
+# (ReadRequestBatch et al.) stay pickled until a deployment exercises
+# them (grandfathered under COD301 in .paxlint-baseline.json).
+
+
+class MaxSlotRequestCodec(MessageCodec):
+    message_type = MaxSlotRequest
+    tag = 119
+
+    def encode(self, out, message):
+        _put_cid(out, message.command_id)
+
+    def decode(self, buf, at):
+        cid, at = _take_cid(buf, at)
+        return MaxSlotRequest(command_id=cid), at
+
+
+_IIQ = struct.Struct("<iiq")
+
+
+class MaxSlotReplyCodec(MessageCodec):
+    message_type = MaxSlotReply
+    tag = 120
+
+    def encode(self, out, message):
+        _put_cid(out, message.command_id)
+        out += _IIQ.pack(message.group_index, message.acceptor_index,
+                         message.slot)
+
+    def decode(self, buf, at):
+        cid, at = _take_cid(buf, at)
+        group, acceptor, slot = _IIQ.unpack_from(buf, at)
+        return MaxSlotReply(command_id=cid, group_index=group,
+                            acceptor_index=acceptor,
+                            slot=slot), at + _IIQ.size
+
+
+class _SlotCommandCodec(MessageCodec):
+    """Shared layout for the (slot, command) read requests."""
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        command, at = _take_command(buf, at + 8)
+        return self.message_type(slot=slot, command=command), at
+
+
+class ReadRequestCodec(_SlotCommandCodec):
+    message_type = ReadRequest
+    tag = 121
+
+
+class SequentialReadRequestCodec(_SlotCommandCodec):
+    message_type = SequentialReadRequest
+    tag = 122
+
+
+class EventualReadRequestCodec(MessageCodec):
+    message_type = EventualReadRequest
+    tag = 123
+
+    def encode(self, out, message):
+        _put_command(out, message.command)
+
+    def decode(self, buf, at):
+        command, at = _take_command(buf, at)
+        return EventualReadRequest(command=command), at
+
+
+def _put_reply(out: bytearray, reply) -> None:
+    """ReadReply and ClientReply share the (command_id, slot, result)
+    shape."""
+    _put_cid(out, reply.command_id)
+    out += _I64.pack(reply.slot)
+    _put_bytes(out, reply.result)
+
+
+def _take_reply(buf: bytes, at: int, cls) -> tuple:
+    cid, at = _take_cid(buf, at)
+    (slot,) = _I64.unpack_from(buf, at)
+    result, at = _take_bytes(buf, at + 8)
+    return cls(command_id=cid, slot=slot, result=result), at
+
+
+class _ReplyBatchCodec(MessageCodec):
+    """Shared layout for the (count + replies) batch messages."""
+
+    reply_type: type
+
+    def encode(self, out, message):
+        out += _I32.pack(len(message.batch))
+        for reply in message.batch:
+            _put_reply(out, reply)
+
+    def decode(self, buf, at):
+        (n,) = _I32.unpack_from(buf, at)
+        at += 4
+        batch = []
+        for _ in range(n):
+            reply, at = _take_reply(buf, at, self.reply_type)
+            batch.append(reply)
+        return self.message_type(batch=tuple(batch)), at
+
+
+class ReadReplyBatchCodec(_ReplyBatchCodec):
+    message_type = ReadReplyBatch
+    reply_type = ReadReply
+    tag = 124
+
+
+class ClientReplyBatchCodec(_ReplyBatchCodec):
+    message_type = ClientReplyBatch
+    reply_type = ClientReply
+    tag = 125
+
+
 for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                ClientRequestCodec(), ClientRequestBatchCodec(),
                ClientReplyCodec(), ChosenWatermarkCodec(),
                Phase2bRangeCodec(), Phase2bVotesCodec(),
                ClientRequestArrayCodec(), Phase2aRunCodec(),
-               ChosenRunCodec(), ClientReplyArrayCodec()):
+               ChosenRunCodec(), ClientReplyArrayCodec(),
+               MaxSlotRequestCodec(), MaxSlotReplyCodec(),
+               ReadRequestCodec(), SequentialReadRequestCodec(),
+               EventualReadRequestCodec(), ReadReplyBatchCodec(),
+               ClientReplyBatchCodec()):
     register_codec(_codec)

@@ -9,7 +9,7 @@ i, i+n, i+2n, ... Acceptors keep (round, vote_round, vote_value).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional
 
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport

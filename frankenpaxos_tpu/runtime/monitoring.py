@@ -12,7 +12,7 @@ from __future__ import annotations
 import abc
 import dataclasses
 import time
-from typing import Optional, Sequence
+from typing import Sequence
 
 
 class Counter(abc.ABC):

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import abc
 import random
-from typing import Mapping, Sequence
+from typing import Mapping
 
 from frankenpaxos_tpu.runtime.transport import Address
 

@@ -12,7 +12,11 @@ import dataclasses
 from typing import Callable, Generic, TypeVar
 
 import numpy as np
-from sortedcontainers import SortedSet  # type: ignore[import-untyped]
+
+try:
+    from sortedcontainers import SortedSet  # type: ignore[import-untyped]
+except ImportError:  # stripped environments: pure-Python fallback
+    from frankenpaxos_tpu.utils.sorted_compat import SortedSet
 
 V = TypeVar("V")
 

@@ -1,7 +1,6 @@
 """FastMultiPaxos: fast path via direct acceptor proposals, stuck-round
 recovery, and raft election."""
 
-import random
 
 from frankenpaxos_tpu.roundsystem import RoundZeroFast
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
@@ -12,12 +11,6 @@ from frankenpaxos_tpu.protocols.fastmultipaxos import (
     FastMultiPaxosConfig,
     FastMultiPaxosLeader,
 )
-from frankenpaxos_tpu.election.raft import (
-    RaftElectionOptions,
-    RaftElectionParticipant,
-)
-
-
 def make_fmp(f=1, num_clients=2, seed=0):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)

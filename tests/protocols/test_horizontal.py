@@ -4,7 +4,6 @@ from frankenpaxos_tpu.quorums import SimpleMajority
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.horizontal import (
-    Configuration,
     HorizontalAcceptor,
     HorizontalClient,
     HorizontalConfig,

@@ -3,7 +3,6 @@
 import random
 from typing import Optional
 
-import pytest
 
 from frankenpaxos_tpu.runtime import (
     FakeLogger,

@@ -5,10 +5,9 @@ shared/src/test/scala)."""
 import random
 from typing import Optional
 
-import pytest
 
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.sim import Simulator
 from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.paxos import (
     PaxosAcceptor,

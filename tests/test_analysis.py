@@ -1,0 +1,618 @@
+"""paxlint self-tests: every rule family catches its seeded violation
+class (and stays quiet on the clean twin), pragmas suppress, the
+baseline round-trips, and the repo itself gates green.
+
+Fixtures are tiny synthetic packages written to a tmp dir -- paxlint is
+purely AST-based, so nothing is imported or executed.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+from frankenpaxos_tpu.analysis import baseline as baseline_mod
+from frankenpaxos_tpu.analysis.core import Project, run_rules
+
+
+def project(tmp_path, files: dict) -> Project:
+    """A throwaway project: {relative path under pkg/: source}."""
+    for rel, source in files.items():
+        path = tmp_path / "pkg" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return Project(str(tmp_path), package="pkg")
+
+
+def rules_of(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# --- PAX1xx: actor contract -------------------------------------------------
+
+ACTOR_PREAMBLE = """\
+    import threading
+    import time
+
+    class Actor:
+        def receive(self, src, message): ...
+        def on_drain(self): ...
+        def timer(self, name, delay_s, f): ...
+        def send(self, dst, message): ...
+        def broadcast(self, dsts, message): ...
+"""
+
+
+def test_pax101_threading_in_handler(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def receive(self, src, message):
+            threading.Thread(target=self.work).start()
+    """}))
+    assert "PAX101" in rules_of(findings)
+    f = next(f for f in findings if f.rule == "PAX101")
+    assert f.scope == "Bad.receive"
+
+
+def test_pax101_reaches_self_call_closure(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def receive(self, src, message):
+            self._helper()
+
+        def _helper(self):
+            threading.Event().wait()
+    """}))
+    assert any(f.rule == "PAX101" and f.scope == "Bad._helper"
+               for f in findings)
+
+
+def test_pax101_allows_construction_time_threads(tmp_path):
+    """__init__ is not a handler: the ProxyLeader collector-thread
+    pattern stays legal."""
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Fine(Actor):
+        def __init__(self):
+            threading.Thread(target=lambda: None, daemon=True).start()
+
+        def receive(self, src, message):
+            pass
+    """}))
+    assert "PAX101" not in rules_of(findings)
+
+
+def test_pax102_lock_in_handler(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def receive(self, src, message):
+            self.lock.acquire()
+    """}))
+    assert "PAX102" in rules_of(findings)
+
+
+def test_pax103_sleep_in_handler(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            time.sleep(0.1)
+    """}))
+    assert any(f.rule == "PAX103" and f.scope == "Bad.on_drain"
+               for f in findings)
+
+
+def test_pax103_sleep_in_timer_callback(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def receive(self, src, message):
+            self.timer("t", 1.0, self._fire)
+
+        def _fire(self):
+            time.sleep(1)
+    """}))
+    assert any(f.rule == "PAX103" and f.scope == "Bad._fire"
+               for f in findings)
+
+
+def test_pax104_non_transport_timer(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def __init__(self, loop):
+            threading.Timer(1.0, self._fire).start()
+            loop.call_later(1.0, self._fire)
+
+        def receive(self, src, message):
+            pass
+
+        def _fire(self):
+            pass
+    """}))
+    assert sum(f.rule == "PAX104" for f in findings) == 2
+
+
+def test_pax105_shared_module_state(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    SHARED = {}
+
+    class A(Actor):
+        def receive(self, src, message):
+            SHARED[src] = message
+
+    class B(Actor):
+        def receive(self, src, message):
+            return SHARED.get(src)
+    """}))
+    assert any(f.rule == "PAX105" and f.detail == "SHARED"
+               for f in findings)
+
+
+def test_pax105_single_class_use_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    CACHE = {}
+
+    class A(Actor):
+        def receive(self, src, message):
+            CACHE[src] = message
+
+    class B(Actor):
+        def receive(self, src, message):
+            pass
+    """}))
+    assert "PAX105" not in rules_of(findings)
+
+
+def test_pax106_send_from_thread_target(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def __init__(self):
+            threading.Thread(target=self._worker, daemon=True).start()
+
+        def receive(self, src, message):
+            pass
+
+        def _worker(self):
+            self.send(("h", 1), "result")
+    """}))
+    assert any(f.rule == "PAX106" and f.scope == "Bad._worker"
+               for f in findings)
+
+
+def test_pax106_call_soon_threadsafe_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Fine(Actor):
+        def __init__(self, loop):
+            self.loop = loop
+            threading.Thread(target=self._worker, daemon=True).start()
+
+        def receive(self, src, message):
+            pass
+
+        def _worker(self):
+            self.loop.call_soon_threadsafe(self._emit, [1, 2])
+
+        def _emit(self, results):
+            self.send(("h", 1), results)
+    """}))
+    assert "PAX106" not in rules_of(findings)
+
+
+# --- TPU2xx: hot-path rules -------------------------------------------------
+
+
+def test_tpu201_block_until_ready_in_on_drain(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": """
+    import jax
+
+    class Tracker:
+        def drain(self):
+            jax.block_until_ready(self.board)
+
+    class Role:
+        def on_drain(self):
+            self.tracker.drain()
+    """}))
+    assert any(f.rule == "TPU201" and f.scope == "Tracker.drain"
+               for f in findings)
+
+
+def test_tpu202_device_get_in_run_pipeline_handler(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": """
+    import jax
+
+    class Phase2aRun: ...
+
+    class Role:
+        def receive(self, src, message):
+            if isinstance(message, Phase2aRun):
+                self._handle_run(message)
+
+        def _handle_run(self, run):
+            return jax.device_get(run)
+    """}))
+    assert any(f.rule == "TPU202" and f.scope == "Role._handle_run"
+               for f in findings)
+
+
+def test_tpu203_blocking_fetch_of_async_dispatch(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import numpy as np
+
+    def fetch(checker, block):
+        mask = checker.check_block_async(block)
+        return np.asarray(mask)
+    """}))
+    assert any(f.rule == "TPU203" for f in findings)
+
+
+def test_tpu203_host_asarray_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import numpy as np
+
+    def pack(slots):
+        return np.asarray(slots, dtype=np.int64)
+    """}))
+    assert "TPU203" not in rules_of(findings)
+
+
+def test_tpu204_coercion_of_traced_value(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import jax
+
+    @jax.jit
+    def bad(x):
+        return float(x)
+    """}))
+    assert any(f.rule == "TPU204" for f in findings)
+
+
+def test_tpu205_python_if_on_traced_value(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def bad(x):
+        if x > 0:
+            return x
+        return -x
+    """}))
+    assert any(f.rule == "TPU205" for f in findings)
+
+
+def test_tpu205_static_arg_if_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import functools
+
+    import jax
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def fine(x, flag):
+        if flag:
+            return x
+        return -x
+    """}))
+    assert "TPU205" not in rules_of(findings)
+
+
+def test_tpu206_nested_jit(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import jax
+
+    def hot(x):
+        return jax.jit(lambda y: y + 1)(x)
+    """}))
+    assert any(f.rule == "TPU206" for f in findings)
+
+
+def test_tpu207_loop_over_traced_shape(tmp_path):
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import jax
+
+    @jax.jit
+    def bad(x):
+        total = 0
+        for i in range(x.shape[0]):
+            total = total + x[i]
+        return total
+    """}))
+    assert any(f.rule == "TPU207" for f in findings)
+
+
+# --- COD3xx: codec rules ----------------------------------------------------
+
+CODEC_PREAMBLE = """\
+    import dataclasses
+    import struct
+
+    class MessageCodec: ...
+
+    def register_codec(codec): ...
+
+    _I64 = struct.Struct("<q")
+"""
+
+
+def test_cod301_sent_message_without_codec(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "proto/messages.py": """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Hot:
+        slot: int
+
+    @dataclasses.dataclass(frozen=True)
+    class Cold:
+        round: int
+    """,
+        "proto/wire.py": CODEC_PREAMBLE + """
+    from pkg.proto.messages import Hot
+
+    class HotCodec(MessageCodec):
+        message_type = Hot
+        tag = 1
+
+        def encode(self, out, message):
+            out += _I64.pack(message.slot)
+
+        def decode(self, buf, at):
+            (slot,) = _I64.unpack_from(buf, at)
+            return Hot(slot=slot), at + 8
+
+    register_codec(HotCodec())
+    """,
+        "proto/role.py": """
+    from pkg.proto.messages import Cold, Hot
+
+    class Role:
+        def receive(self, src, message):
+            self.send(src, Hot(slot=1))
+            self.send(src, Cold(round=2))
+    """}))
+    assert any(f.rule == "COD301" and f.detail == "Cold"
+               for f in findings)
+    assert not any(f.rule == "COD301" and f.detail == "Hot"
+                   for f in findings)
+
+
+def test_cod302_encode_decode_asymmetry(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "proto/messages.py": """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Msg:
+        slot: int
+        round: int
+    """,
+        "proto/wire.py": CODEC_PREAMBLE + """
+    from pkg.proto.messages import Msg
+
+    class MsgCodec(MessageCodec):
+        message_type = Msg
+        tag = 1
+
+        def encode(self, out, message):
+            out += _I64.pack(message.slot)  # forgets round
+
+        def decode(self, buf, at):
+            (slot,) = _I64.unpack_from(buf, at)
+            return Msg(slot=slot, round=0), at + 8
+    """}))
+    assert any(f.rule == "COD302" and "round" in f.message
+               for f in findings)
+
+
+def test_cod302_symmetric_codec_is_clean(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "proto/messages.py": """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Msg:
+        slot: int
+    """,
+        "proto/wire.py": CODEC_PREAMBLE + """
+    from pkg.proto.messages import Msg
+
+    class MsgCodec(MessageCodec):
+        message_type = Msg
+        tag = 1
+
+        def encode(self, out, message):
+            out += _I64.pack(message.slot)
+
+        def decode(self, buf, at):
+            (slot,) = _I64.unpack_from(buf, at)
+            return Msg(slot=slot), at + 8
+    """}))
+    assert "COD302" not in rules_of(findings)
+
+
+def test_cod302_same_named_messages_resolve_per_protocol(tmp_path):
+    """Two protocols with same-named messages: each codec is checked
+    against ITS protocol's dataclass, not a global name match."""
+    findings = run_rules(project(tmp_path, {
+        "p1/messages.py": """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Reply:
+        a: int
+    """,
+        "p2/messages.py": """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Reply:
+        b: int
+    """,
+        "p2/wire.py": CODEC_PREAMBLE + """
+    from pkg.p2.messages import Reply
+
+    class ReplyCodec(MessageCodec):
+        message_type = Reply
+        tag = 1
+
+        def encode(self, out, message):
+            out += _I64.pack(message.b)
+
+        def decode(self, buf, at):
+            (b,) = _I64.unpack_from(buf, at)
+            return Reply(b=b), at + 8
+    """}))
+    assert "COD302" not in rules_of(findings)
+
+
+# --- pragmas ----------------------------------------------------------------
+
+
+def test_pragma_suppresses_on_same_line(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Curated(Actor):
+        def on_drain(self):
+            time.sleep(0.1)  # paxlint: disable=PAX103
+    """}))
+    assert "PAX103" not in rules_of(findings)
+
+
+def test_pragma_on_preceding_comment_block(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Curated(Actor):
+        def on_drain(self):
+            # paxlint: disable=PAX103 -- justified: measured backoff
+            # that the sim transport never executes.
+            time.sleep(0.1)
+    """}))
+    assert "PAX103" not in rules_of(findings)
+
+
+def test_pragma_on_def_line_scopes_whole_function(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Curated(Actor):
+        def on_drain(self):  # paxlint: disable=PAX103
+            time.sleep(0.1)
+            time.sleep(0.2)
+    """}))
+    assert "PAX103" not in rules_of(findings)
+
+
+def test_pragma_only_disables_named_rule(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Curated(Actor):
+        def on_drain(self):
+            time.sleep(0.1)  # paxlint: disable=PAX101
+    """}))
+    assert "PAX103" in rules_of(findings)
+
+
+# --- baseline ---------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    proj = project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            time.sleep(0.1)
+    """})
+    findings = run_rules(proj)
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.write(path, findings)
+    entries = baseline_mod.load(path)
+    new, old, stale = baseline_mod.split(findings, entries)
+    assert not new and not stale
+    assert [f.key for f in old] == [f.key for f in findings]
+
+
+def test_baseline_is_line_number_independent(tmp_path):
+    proj = project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            time.sleep(0.1)
+    """})
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.write(path, run_rules(proj))
+    # Shift every line down: the finding must still match the baseline.
+    src = (tmp_path / "pkg" / "a.py").read_text()
+    (tmp_path / "pkg" / "a.py").write_text("# shifted\n# shifted\n" + src)
+    shifted = run_rules(Project(str(tmp_path), package="pkg"))
+    new, old, stale = baseline_mod.split(shifted,
+                                         baseline_mod.load(path))
+    assert not new and not stale and old
+
+
+def test_new_finding_not_masked_by_baseline(tmp_path):
+    proj = project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            time.sleep(0.1)
+    """})
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.write(path, run_rules(proj))
+    src = (tmp_path / "pkg" / "a.py").read_text()
+    (tmp_path / "pkg" / "a.py").write_text(src + textwrap.dedent("""
+    class Worse(Actor):
+        def receive(self, src, message):
+            time.sleep(1)
+    """))
+    findings = run_rules(Project(str(tmp_path), package="pkg"))
+    new, old, stale = baseline_mod.split(findings,
+                                         baseline_mod.load(path))
+    assert any(f.scope == "Worse.receive" for f in new)
+    assert all(f.scope != "Worse.receive" for f in old)
+
+
+def test_stale_baseline_entries_reported(tmp_path):
+    proj = project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            time.sleep(0.1)
+    """})
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.write(path, run_rules(proj))
+    (tmp_path / "pkg" / "a.py").write_text(
+        textwrap.dedent(ACTOR_PREAMBLE))
+    new, old, stale = baseline_mod.split(
+        run_rules(Project(str(tmp_path), package="pkg")),
+        baseline_mod.load(path))
+    assert not new and not old and len(stale) == 1
+
+
+# --- the repo itself gates green --------------------------------------------
+
+
+def test_repo_passes_paxlint():
+    """The acceptance gate: `python -m frankenpaxos_tpu.analysis` exits
+    0 on this repository (everything fixed, pragma'd, or baselined)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "frankenpaxos_tpu.analysis"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new findings" in proc.stdout
+
+
+def test_exit_code_gates_on_seeded_violation(tmp_path):
+    """CLI exit 1 on a repo with a fresh (unbaselined) violation."""
+    (tmp_path / "frankenpaxos_tpu").mkdir()
+    (tmp_path / "frankenpaxos_tpu" / "bad.py").write_text(
+        textwrap.dedent(ACTOR_PREAMBLE) + textwrap.dedent("""
+    class Bad(Actor):
+        def on_drain(self):
+            time.sleep(0.5)
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "frankenpaxos_tpu.analysis",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "PAX103" in proc.stdout
+
+
+def test_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "frankenpaxos_tpu.analysis",
+         "--list-rules"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0
+    for rule in ("PAX101", "TPU201", "COD301", "COD302"):
+        assert rule in proc.stdout

@@ -2,7 +2,6 @@
 rate() with counter resets, aggregation, persistence -- the
 prometheus.py:10-132 query surface without a prometheus binary."""
 
-import math
 
 import pytest
 

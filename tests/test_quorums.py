@@ -12,7 +12,6 @@ import pytest
 
 from frankenpaxos_tpu.quorums import (
     Grid,
-    QuorumSpec,
     SimpleMajority,
     UnanimousWrites,
     quorum_system_from_dict,

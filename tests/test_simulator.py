@@ -7,7 +7,7 @@ import random
 from typing import Optional
 
 from frankenpaxos_tpu.runtime import Actor, FakeLogger, SimTransport
-from frankenpaxos_tpu.sim import BadHistory, SimulatedSystem, Simulator
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
 
 
 # --- Die Hard water jugs: find a state with exactly 4 gallons --------------

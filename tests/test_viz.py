@@ -89,5 +89,5 @@ def test_record_scenario_all_registry_protocols(tmp_path):
         path = dump_html(trace, str(tmp_path / f"{protocol}.html"))
         html = open(path).read()
         assert "/*__TRACE_JSON__*/null" not in html
-        assert f'"protocol": null' not in html
+        assert '"protocol": null' not in html
         assert protocol in html

@@ -48,6 +48,41 @@ HOT_MESSAGES = [
 ]
 
 
+def test_read_path_codecs_round_trip():
+    """The read hot path (MaxSlot quorum -> Read*Request -> ReadReplyBatch)
+    and the proxied ClientReplyBatch ride fixed layouts, not pickle."""
+    from frankenpaxos_tpu.protocols.multipaxos.messages import (
+        ClientReplyBatch,
+        EventualReadRequest,
+        MaxSlotReply,
+        MaxSlotRequest,
+        ReadReply,
+        ReadReplyBatch,
+        ReadRequest,
+        SequentialReadRequest,
+    )
+
+    cid = CommandId(("10.0.0.1", 9000), 3, 44)
+    sim_cid = CommandId("Client 1", 0, 7)
+    command = Command(cid, b"get-k")
+    for message in [
+        MaxSlotRequest(command_id=cid),
+        MaxSlotRequest(command_id=sim_cid),
+        MaxSlotReply(command_id=cid, group_index=1, acceptor_index=2,
+                     slot=1 << 40),
+        ReadRequest(slot=5, command=command),
+        SequentialReadRequest(slot=-1, command=command),
+        EventualReadRequest(command=command),
+        ReadReplyBatch(batch=(ReadReply(cid, 9, b"r1"),
+                              ReadReply(sim_cid, 10, b""))),
+        ReadReplyBatch(batch=()),
+        ClientReplyBatch(batch=(ClientReply(cid, 11, b"x" * 100),)),
+    ]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] < 128, type(message).__name__
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
 @pytest.mark.parametrize("message", HOT_MESSAGES,
                          ids=lambda m: type(m).__name__)
 def test_binary_round_trip(message):
