@@ -156,11 +156,16 @@ def run_skewed(protocol_name: str, config_raw: dict, *,
 
 def run_drive(protocol_name: str, config_raw: dict, *,
               num_clients: int, duration_s: float, seed: int = 0,
-              warmup_s: float = 0.25) -> list:
+              warmup_s: float = 0.25,
+              client_overrides: dict | None = None) -> list:
     """Protocol-agnostic closed loops: one client actor per loop (each
     on its own port via the transport's multi-bind), driven through the
     registry's ``drive`` entry -- works for every protocol the smoke
-    deploys. Returns [("write", start_unix_s, latency_s)]."""
+    deploys. Returns [("write", start_unix_s, latency_s)].
+
+    ``client_overrides`` adds ``--options.*``-style client constructor
+    overrides (e.g. ``{"coalesce_writes": "true"}`` for run-pipeline
+    clients)."""
     protocol = get_protocol(protocol_name)
     config = protocol.load_config(config_raw)
     logger = FakeLogger(LogLevel.FATAL)
@@ -170,7 +175,8 @@ def run_drive(protocol_name: str, config_raw: dict, *,
     for i in range(num_clients):
         ctx = DeployCtx(config=config, transport=transport, logger=logger,
                         overrides={"resend_period_s": "1.0",
-                                   "repropose_period_s": "1.0"},
+                                   "repropose_period_s": "1.0",
+                                   **(client_overrides or {})},
                         seed=(seed << 8) + i)
         address = (transport.listen_address if i == 0
                    else ("127.0.0.1", free_port()))
@@ -219,7 +225,10 @@ def main(argv=None) -> None:
         # protocol the smoke can deploy can be benchmarked.
         rows = run_drive(args.protocol, config_raw,
                          num_clients=args.num_clients,
-                         duration_s=args.duration, seed=args.seed)
+                         duration_s=args.duration, seed=args.seed,
+                         client_overrides=(json.loads(args.client_options)
+                                           if args.client_options
+                                           else None))
     else:
         workload = (workload_from_dict(json.loads(args.workload))
                     if args.workload

@@ -4,9 +4,10 @@ The axon device link has been observed to wedge such that
 ``jax.devices()`` itself hangs indefinitely; any artifact script that
 touches the device in-process must probe FIRST, in a throwaway
 subprocess, and degrade when the link is dead instead of hanging. The
-probe uses Popen + poll + abandon: after a timeout, ``subprocess.run``'s
-own cleanup blocks in an unbounded wait on a child stuck in the wedged
-syscall, so the child must be killed and abandoned, never waited on.
+probe uses Popen + poll: after a timeout, ``subprocess.run``'s own
+cleanup blocks in an UNBOUNDED wait on a child stuck in the wedged
+syscall, so the child is killed, given one bounded wait to reap (no
+zombie in the common case), and only then abandoned.
 """
 
 from __future__ import annotations
@@ -33,7 +34,17 @@ def device_probe(timeout_s: float = 90.0) -> tuple[bool, str]:
     while probe.poll() is None and time.time() < deadline:
         time.sleep(1)
     if probe.poll() is None:
-        probe.kill()  # abandoned; do NOT wait on it
+        probe.kill()
+        # A killed child usually reaps promptly even when its syscall
+        # was wedged; try a BOUNDED wait so it doesn't linger as a
+        # zombie for the parent's lifetime. Only if the kill itself
+        # can't take effect within the bound is the child abandoned
+        # (never an unbounded wait -- that hang is the very failure
+        # mode this probe exists to contain).
+        try:
+            probe.wait(timeout=1)
+        except subprocess.TimeoutExpired:
+            pass  # truly wedged: abandon it
         return False, (f"device probe timed out after {timeout_s:.0f}s "
                        f"(wedged link)")
     out, err = probe.communicate()

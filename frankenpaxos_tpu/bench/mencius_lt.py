@@ -1,0 +1,335 @@
+"""Mencius latency-throughput A/B: per-message vs the coalesced run
+pipeline.
+
+The multipaxos_lt methodology applied to the partitioned log: the SAME
+actor code runs in two arms --
+
+  * ``per-message`` -- the reference design: one ClientRequest ->
+    Phase2a -> Phase2b -> Chosen per command (mencius/Leader.scala:
+    331-408's per-slot processClientRequestBatch).
+  * ``coalesced``   -- the drain-granular run pipeline: one
+    ClientRequestArray per event-loop pass, one strided Phase2aRun per
+    drain (carrying the owner's slot stride), one Phase2bRun ack per
+    acceptor, one ChosenRun per replica, one ClientReplyArray per
+    client. Per-message Python scales with drains, not commands.
+
+Two measurements:
+
+  * deployed TCP points (every role its own OS process, closed loops
+    from client processes through the registry's drive entry) at small
+    in-flight widths -- the multipaxos_lt "deployed_points" shape.
+  * the interleaved paired SimTransport A/B at batch widths up to 4096
+    in-flight (the multipaxos_lt ``sim_ab_pipeline`` shape): per width,
+    ``reps`` pairs of runs with rotating order, the MEDIAN of paired
+    ratios -- robust to process variance and in-process drift on a
+    1-CPU host. This is where the "coalesced >= 1.5x per-message at
+    batch >= 1024" acceptance figure comes from.
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.mencius_lt \
+        --out bench_results/mencius_lt.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _drive_waves(sim, inflight: int, waves: int, tag: bytes,
+                 results: list) -> None:
+    """Issue ``waves`` closed-loop waves of ``inflight`` writes each and
+    deliver them in coalesced waves (the real event loop's drain
+    granularity); pump recover timers between waves so noop-skip holes
+    (slots owned by idle leader groups) never stall a wave."""
+    for b in range(waves):
+        for p in range(inflight):
+            sim.clients[0].write(p, b"%s%d.%d" % (tag, b, p),
+                                 results.append)
+        sim.clients[0].flush_writes()
+        sim.transport.deliver_all_coalesced()
+        for _ in range(60):
+            if not sim.clients[0].states:  # every pseudonym resolved
+                break
+            for timer in sim.transport.running_timers():
+                if timer.name == "recover" \
+                        or timer.name.startswith("resendWrite"):
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all_coalesced()
+
+
+def sim_ab_pipeline(inflights, reps: int = 6, waves: int = 0,
+                    warm: int = 2) -> dict:
+    """Interleaved paired A/B of the full Mencius actor pipeline over
+    SimTransport in ONE process (multipaxos_lt.sim_ab_pipeline's
+    methodology): per in-flight width, ``reps`` pairs with rotating
+    order; the per-width ratio is the median of paired ratios."""
+    import gc
+    import statistics
+
+    from tests.protocols.mencius_harness import make_mencius
+
+    ARMS = {
+        "per-message": dict(coalesced=False),
+        "coalesced": dict(coalesced=True),
+    }
+
+    def measure(arm: str, inflight: int, w: int) -> float:
+        gc.collect()
+        sim = make_mencius(f=1, num_leader_groups=2, lag_threshold=1,
+                           **ARMS[arm])
+        results: list = []
+        sim.clients[0].write(0, b"warmup", results.append)
+        sim.clients[0].flush_writes()
+        sim.transport.deliver_all_coalesced()
+        for _ in range(50):
+            if results:
+                break
+            for timer in sim.transport.running_timers():
+                if timer.name == "recover":
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all_coalesced()
+        assert results, "warmup write never committed"
+        _drive_waves(sim, inflight, warm, b"w", results)
+        t0 = time.perf_counter()
+        _drive_waves(sim, inflight, w, b"x", results)
+        elapsed = time.perf_counter() - t0
+        assert len(results) == 1 + (warm + w) * inflight, (
+            arm, inflight, len(results))
+        return w * inflight / elapsed
+
+    order = ["per-message", "coalesced"]
+    table = {}
+    for inflight in inflights:
+        w = waves or max(8 if inflight >= 2048 else 16, 512 // inflight)
+        runs: dict[str, list] = {arm: [] for arm in ARMS}
+        ratios: list = []
+        for rep in range(reps):
+            rot = order[rep % 2:] + order[:rep % 2]
+            got = {arm: measure(arm, inflight, w) for arm in rot}
+            for arm in ARMS:
+                runs[arm].append(got[arm])
+            ratios.append(got["coalesced"] / got["per-message"])
+        table[str(inflight)] = {
+            "per_message_cmds_per_sec": round(
+                statistics.median(runs["per-message"]), 1),
+            "coalesced_cmds_per_sec": round(
+                statistics.median(runs["coalesced"]), 1),
+            "coalesced_over_per_message_ratio": round(
+                statistics.median(ratios), 3),
+            "ratio_range": [round(min(ratios), 3), round(max(ratios), 3)],
+        }
+    return table
+
+
+def deployed_points(suite, arms, scales, duration_s: float) -> list:
+    """Deployed TCP A/B: launch the mencius cluster (one OS process per
+    role), drive closed loops from client processes through the
+    registry drive entry, per-message vs coalesced clients."""
+    from frankenpaxos_tpu.bench.deploy_suite import (
+        launch_roles,
+        role_process_env,
+    )
+    from frankenpaxos_tpu.bench.harness import (
+        LocalHost,
+        free_port,
+        latency_throughput_stats,
+    )
+    from frankenpaxos_tpu.deploy import get_protocol
+
+    points = []
+    for arm, client_options in arms:
+        for procs, loops in scales:
+            bench = suite.benchmark_directory()
+            try:
+                protocol = get_protocol("mencius")
+                raw = protocol.cluster(1, lambda: ["127.0.0.1",
+                                                   free_port()])
+                config_path = bench.write_json("config.json", raw)
+                config = protocol.load_config(raw)
+                launch_roles(
+                    bench, "mencius", config_path, config,
+                    state_machine="AppendLog",
+                    overrides={"resend_phase1as_period_s": "0.5",
+                               # Idle groups must skip promptly (the
+                               # protocol_suite LT settings).
+                               "send_high_watermark_every_n": "1",
+                               "send_noop_range_if_lagging_by": "1"})
+                host = LocalHost()
+                env = role_process_env()
+                client_procs = []
+                for i in range(procs):
+                    out_csv = bench.abspath(f"client_{i}_data.csv")
+                    client_procs.append((out_csv, bench.popen(
+                        host, f"client_{i}",
+                        [sys.executable, "-m",
+                         "frankenpaxos_tpu.bench.client_main",
+                         "--protocol", "mencius",
+                         "--config", config_path,
+                         "--num_clients", str(loops),
+                         "--duration", str(duration_s),
+                         "--seed", str(i + 1), "--out", out_csv]
+                        + (["--client_options",
+                            json.dumps(client_options)]
+                           if client_options else []), env=env)))
+                latencies, starts = [], []
+                for out_csv, proc in client_procs:
+                    code = proc.wait(timeout=duration_s + 90)
+                    if code != 0:
+                        raise RuntimeError(
+                            f"client process exited {code}; see "
+                            f"{bench.path}")
+                    with open(out_csv) as f_csv:
+                        next(f_csv)
+                        for line in f_csv:
+                            _, start, latency = line.strip().split(",")
+                            latencies.append(float(latency))
+                            starts.append(float(start))
+            except (RuntimeError, subprocess.TimeoutExpired) as e:
+                # A wedged client process (TimeoutExpired from
+                # proc.wait) is one bad point, not a reason to abort
+                # every remaining arm and the sim sweep.
+                points.append({"arm": arm, "client_procs": procs,
+                               "loops_per_proc": loops,
+                               "error": str(e)[-300:]})
+                continue
+            finally:
+                bench.cleanup()
+            stats = latency_throughput_stats(latencies, duration_s,
+                                             starts_s=starts)
+            point = {
+                "arm": arm,
+                "coalesced": bool(client_options),
+                "client_procs": procs,
+                "loops_per_proc": loops,
+                "duration_s": duration_s,
+                "throughput_p90_1s": stats.get("start_throughput_1s.p90"),
+                "latency_median_ms": stats.get("latency.median_ms"),
+                "latency_p99_ms": stats.get("latency.p99_ms"),
+                "num_requests": stats["num_requests"],
+            }
+            points.append(point)
+            print(json.dumps(point))
+    return points
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--scales", type=str, default="1x5,2x10",
+                        help="deployed client_procs x loops points")
+    parser.add_argument("--sim_inflight", type=str,
+                        default="1,256,1024,4096",
+                        help="in-flight widths for the paired sim A/B")
+    parser.add_argument("--sim_repeats", type=int, default=4,
+                        help="A/B pairs per width per batch")
+    parser.add_argument("--sim_ab_batches", type=int, default=3,
+                        help="independent subprocess batches pooled "
+                             "(process-scoped bias)")
+    parser.add_argument("--skip_deployed", action="store_true")
+    parser.add_argument("--suite_dir", default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    from frankenpaxos_tpu.bench.deploy_suite import role_process_env
+    from frankenpaxos_tpu.bench.harness import SuiteDirectory
+
+    root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_mlt_")
+    suite = SuiteDirectory(root, "mencius_lt")
+
+    scales = []
+    for part in args.scales.split(","):
+        procs, loops = part.lower().split("x")
+        scales.append((int(procs), int(loops)))
+
+    points = []
+    if not args.skip_deployed:
+        points = deployed_points(
+            suite,
+            [("per-message", None),
+             ("coalesced", {"coalesce_writes": "true"})],
+            scales, args.duration)
+
+    # Paired sim A/B pooled over independent subprocesses (the
+    # multipaxos_lt sim_ab methodology).
+    import statistics as _stats
+
+    inflights = [int(x) for x in args.sim_inflight.split(",")]
+    per_width: dict = {str(i): [] for i in inflights}
+    for _batch in range(args.sim_ab_batches):
+        ab = subprocess.run(
+            [sys.executable, "-c",
+             "import json; from frankenpaxos_tpu.bench.mencius_lt import "
+             "sim_ab_pipeline; "
+             f"print(json.dumps(sim_ab_pipeline({inflights!r}, "
+             f"reps={args.sim_repeats})))"],
+            capture_output=True, text=True, env=role_process_env(),
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        if ab.returncode != 0:
+            print(f"sim A/B batch failed (rc={ab.returncode}): "
+                  f"{ab.stderr[-500:]}", file=sys.stderr)
+            continue
+        out = json.loads(ab.stdout.strip().splitlines()[-1])
+        print(json.dumps({"sim_ab_batch": out}))
+        for key, row in out.items():
+            per_width[key].append(row)
+    sim_ab = {}
+    for key, rows in per_width.items():
+        if not rows:
+            continue
+        ratios = [r["coalesced_over_per_message_ratio"] for r in rows]
+        sim_ab[key] = {
+            "coalesced_over_per_message_ratio": round(
+                _stats.median(ratios), 3),
+            "ratio_range": [min(r["ratio_range"][0] for r in rows),
+                            max(r["ratio_range"][1] for r in rows)],
+            "per_message_cmds_per_sec_med": round(_stats.median(
+                r["per_message_cmds_per_sec"] for r in rows), 1),
+            "coalesced_cmds_per_sec_med": round(_stats.median(
+                r["coalesced_cmds_per_sec"] for r in rows), 1),
+            "batches": len(rows),
+        }
+    crossover = next((i for i in inflights
+                      if sim_ab.get(str(i), {})
+                      .get("coalesced_over_per_message_ratio", 0)
+                      >= 1.0), None)
+
+    result = {
+        "benchmark": "mencius_lt",
+        "host_cpus": os.cpu_count(),
+        "duration_s": args.duration,
+        "deployed_points": points,
+        "sim_ab_pipeline": sim_ab,
+        "crossover_inflight": crossover,
+        "sim_ab_methodology": (
+            "per-width ratio = median over independent subprocess "
+            "batches of each batch's paired-A/B median (the "
+            "multipaxos_lt sim_ab methodology); ranges recorded"),
+        "note": ("per-message is the reference Mencius shape (one "
+                 "ClientRequest/Phase2a/Phase2b/Chosen per command); "
+                 "coalesced is the drain-granular strided run pipeline "
+                 "(ClientRequestArray -> Phase2aRun -> Phase2bRun -> "
+                 "ChosenRun -> ClientReplyArray, runs carrying the "
+                 "owner's slot stride so idle groups' slots coalesce "
+                 "into Phase2aNoopRange skip ranges). Deployed points "
+                 "run every role as its own OS process over localhost "
+                 "TCP at small in-flight widths; the sim A/B sweeps "
+                 "batch widths to 4096 in one process with paired "
+                 "interleaved runs."),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()

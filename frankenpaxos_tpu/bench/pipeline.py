@@ -131,6 +131,19 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     start_new = (i % num_blocks) * b_local
     start_old = ((i - 1) % num_blocks) * b_local
 
+    # Grid specs take the fused col-OR/row-AND reduction instead of the
+    # mask matmul (ops/quorum.grid_layout): pure boolean ops, no int32
+    # widening, bit-identical hits. Under group sharding the fused path
+    # engages only when every shard holds WHOLE rows (row-major
+    # universe, local columns a multiple of the row length); rows that
+    # straddle shards fall back to the psum'd matmul.
+    from frankenpaxos_tpu.ops.quorum import _fused_grid_hit, grid_layout
+
+    grid = grid_layout(masks, thresholds, combine_any)
+    if grid is not None and group_axis is not None \
+            and (grid[3] is not None or n_local % grid[2] != 0):
+        grid = None
+
     # Logical coordinates: lane within the global block, global acceptor.
     # The unsharded case avoids the (traced-index) slice/offset ops so
     # XLA sees pure iota inputs and fuses everything into the matmul.
@@ -158,10 +171,35 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
         block = jax.lax.dynamic_slice(votes, (0, start),
                                       (n_local, b_local)) | arrivals
         votes = jax.lax.dynamic_update_slice(votes, block, (0, start))
-        counts = _psum(masks_local @ block.astype(jnp.int32),
-                       group_axis)                       # [G, b_local]
-        satisfied = counts >= thresholds_d[:, None]
-        hit = satisfied.any(0) if combine_any else satisfied.all(0)
+        if grid is not None and group_axis is None:
+            hit = _fused_grid_hit(block, grid)
+        elif grid is not None:
+            # Sharded: this shard holds whole rows (see the gate
+            # above; perm is None there). Per-row unrolled elementwise
+            # chains like _fused_grid_hit's, combined ACROSS shards by
+            # psum-ing missing/full row counts.
+            kind, _, g_cols, _ = grid
+            local_rows = []
+            for r in range(block.shape[0] // g_cols):
+                row = block[r * g_cols]
+                for c in range(1, g_cols):
+                    cell = block[r * g_cols + c]
+                    row = (row | cell) if kind == "write" else (row & cell)
+                local_rows.append(row)
+            if kind == "write":
+                # ALL rows present <=> zero missing rows mesh-wide.
+                missing = sum((jnp.uint8(1) - row for row in local_rows),
+                              jnp.zeros((b_local,), jnp.uint8))
+                hit = _psum(missing.astype(jnp.int32), group_axis) == 0
+            else:
+                full = sum(local_rows,
+                           jnp.zeros((b_local,), jnp.uint8))
+                hit = _psum(full.astype(jnp.int32), group_axis) > 0
+        else:
+            counts = _psum(masks_local @ block.astype(jnp.int32),
+                           group_axis)                   # [G, b_local]
+            satisfied = counts >= thresholds_d[:, None]
+            hit = satisfied.any(0) if combine_any else satisfied.all(0)
         old = jax.lax.dynamic_slice(chosen, (start,), (b_local,))
         newly = hit & ~old
         chosen = jax.lax.dynamic_update_slice(chosen, hit | old, (start,))

@@ -82,6 +82,91 @@ def _quorum_hit(votes_block: jax.Array, masks: jax.Array,
     return satisfied.any(0) if combine_any else satisfied.all(0)
 
 
+def grid_layout(masks, thresholds, combine_any: bool):
+    """Detect a Grid quorum predicate in factored (masks, thresholds)
+    form (quorums/Grid.scala:5-57 via quorums/spec.py).
+
+    Returns ``(kind, rows, cols, perm)`` when the spec is a grid:
+    ``kind`` is ``"write"`` ("one vote in every row": thresholds all 1,
+    ALL-combine) or ``"read"`` ("some row fully present": thresholds ==
+    row sizes, ANY-combine); ``perm`` is a column permutation into
+    row-major ``[rows, cols]`` order, or None when the universe is
+    already row-major. Returns None for anything else.
+
+    Grids deserve a first-class fast path (Flexible Paxos,
+    arXiv:1608.06696): the generic ``[G, N] x [N, B]`` int32 mask
+    matmul degenerates, for a grid, to a pure boolean
+    reshape-to-``[rows, cols, B]`` col-OR/row-AND (write) or
+    col-AND/row-OR (read) reduction -- no dtype widening, no MXU pass,
+    and bit-identical booleans (votes are 0/1, so ``count >= 1`` IS
+    ``any`` and ``count >= cols`` IS ``all``).
+    """
+    masks = np.asarray(masks, dtype=np.uint8)
+    thresholds = np.asarray(thresholds, dtype=np.int64)
+    if masks.ndim != 2:
+        return None
+    g, n = masks.shape
+    if g < 1 or n < 1 or n % g != 0:
+        return None
+    cols = n // g
+    # Rows must partition the universe into equal-size groups.
+    if not (masks.sum(axis=0) == 1).all():
+        return None
+    if not (masks.sum(axis=1) == cols).all():
+        return None
+    if combine_any:
+        if not (thresholds == cols).all():
+            return None
+        kind = "read"
+    else:
+        if not (thresholds == 1).all():
+            return None
+        kind = "write"
+    perm = np.concatenate([np.flatnonzero(masks[r]) for r in range(g)])
+    if (perm == np.arange(n)).all():
+        return kind, g, cols, None
+    return kind, g, cols, tuple(int(x) for x in perm)
+
+
+def _fused_grid_hit(votes_block: jax.Array, grid: tuple) -> jax.Array:
+    """``[B]`` bool from a ``[N, B]`` vote block via the fused grid
+    reduction (see :func:`grid_layout`).
+
+    The rows/cols reductions are UNROLLED at trace time into a chain of
+    elementwise uint8 ``|``/``&`` ops over the block's row vectors (a
+    grid has a handful of rows): XLA fuses the whole chain into the
+    block's producer pass, where `jnp.any`/`jnp.all` reduce ops over a
+    tiny leading axis break fusion and cost ~3x on host XLA. Votes are
+    0/1, so ``|`` IS any and ``&`` IS all -- bit-identity preserved.
+    """
+    kind, rows, cols, perm = grid
+    row_of = (lambda i: votes_block[i]) if perm is None \
+        else (lambda i: votes_block[perm[i]])
+    acc = None
+    for r in range(rows):
+        row = row_of(r * cols)
+        for c in range(1, cols):
+            cell = row_of(r * cols + c)
+            row = (row | cell) if kind == "write" else (row & cell)
+        acc = row if acc is None \
+            else ((acc & row) if kind == "write" else (acc | row))
+    return acc.astype(jnp.bool_)
+
+
+def _predicate_hit(votes_block: jax.Array, masks_t: tuple,
+                   meta: tuple) -> jax.Array:
+    """Trace-time kernel selection: the fused grid reduction when
+    ``_spec_statics`` tagged the spec as a grid, else the generic
+    factored matmul."""
+    thresholds_t, combine_any = meta[0], meta[1]
+    grid = meta[2] if len(meta) > 2 else None
+    if grid is not None:
+        return _fused_grid_hit(votes_block, grid)
+    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
+    thresholds = jnp.asarray(np.asarray(thresholds_t, dtype=np.int32))
+    return _quorum_hit(votes_block, masks, thresholds, combine_any)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6, 7))
 def _record_and_check(
     board: VoteBoard,
@@ -91,13 +176,9 @@ def _record_and_check(
     vote_rounds: jax.Array,  # [B] int32
     valid: jax.Array,      # [B] bool (padding mask for partial batches)
     masks_t: tuple,        # static: ((row, ...), ...) -> rebuilt as [G, N]
-    meta: tuple,           # static: (thresholds tuple, combine_any bool)
+    meta: tuple,           # static: (thresholds, combine_any, grid|None)
 ) -> tuple[VoteBoard, jax.Array]:
     """Sparse path: out-of-order / straggler votes. O(batch) work."""
-    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))  # [G, N]
-    thresholds, combine_any = meta
-    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
-
     # Ring self-reclaim: a newer slot claims its column (clearing stale
     # state from `slot - k*window`); votes for slots the column has moved
     # past are dropped. All per-column derived values are identical for
@@ -134,7 +215,7 @@ def _record_and_check(
 
     # Quorum predicate for exactly the touched columns (duplicates are
     # fine: they see identical post-scatter state).
-    hit = _quorum_hit(votes[:, slots], masks, thresholds, combine_any)
+    hit = _predicate_hit(votes[:, slots], masks_t, meta)
     hit = hit & mine
     newly = hit & ~chosen0[slots]
     chosen = chosen0.at[slots].max(hit)
@@ -163,9 +244,6 @@ def _record_block(
     NOT bumped, so an older-round slot mid-run keeps collecting its own
     round's votes (matching the per-(slot, round) dict semantics).
     """
-    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
-    thresholds, combine_any = meta
-    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
     n = board.votes.shape[0]
 
     touched = block.any(axis=0)                                # [B]
@@ -189,7 +267,7 @@ def _record_block(
     live = touched & (vote_round == new_rounds)                # [B]
     cols = cols | (block & live[None, :].astype(jnp.uint8))
 
-    hit = _quorum_hit(cols, masks, thresholds, combine_any)
+    hit = _predicate_hit(cols, masks_t, meta)
     old_chosen = jax.lax.dynamic_slice(board.chosen, (start,), (block_size,))
     old_chosen = jnp.where(claim, False, old_chosen)
     newly = hit & ~old_chosen & touched
@@ -222,10 +300,7 @@ def _release(board: VoteBoard, slots: jax.Array, valid: jax.Array) -> VoteBoard:
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _check_batch(present: jax.Array, masks_t: tuple, meta: tuple) -> jax.Array:
     """``[B, N]`` responder rows -> ``[B]`` bool (stateless)."""
-    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
-    thresholds, combine_any = meta
-    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
-    return _quorum_hit(present.T, masks, thresholds, combine_any)
+    return _predicate_hit(present.T, masks_t, meta)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -237,11 +312,7 @@ def _check_block(block: jax.Array, masks_t: tuple, meta: tuple) -> jax.Array:
     bookkeeping, nothing proportional to the window. Measured ~3x
     cheaper per call than the stateful ``_record_block`` on host XLA
     and flat in B up to MXU-friendly widths."""
-    masks = jnp.asarray(np.asarray(masks_t, dtype=np.int32))
-    thresholds, combine_any = meta
-    thresholds = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
-    return _quorum_hit(block.astype(jnp.int32), masks, thresholds,
-                       combine_any)
+    return _predicate_hit(block, masks_t, meta)
 
 
 @jax.jit
@@ -266,8 +337,16 @@ def _check_batch_multi(
 
 
 def _spec_statics(spec: QuorumSpec) -> tuple[tuple, tuple]:
+    """Hashable statics for the jitted kernels: ``(masks_t, meta)``
+    where ``meta = (thresholds_t, combine_any, grid_or_None)``. Grid
+    specs are detected HERE, once per checker, so every kernel built
+    from these statics selects the fused grid reduction at trace time
+    (see :func:`grid_layout`)."""
     masks_t = tuple(tuple(int(x) for x in row) for row in spec.masks)
-    meta = (tuple(int(t) for t in spec.thresholds), spec.combine == ANY)
+    combine_any = spec.combine == ANY
+    thresholds_t = tuple(int(t) for t in spec.thresholds)
+    meta = (thresholds_t, combine_any,
+            grid_layout(spec.masks, spec.thresholds, combine_any))
     return masks_t, meta
 
 

@@ -5,6 +5,13 @@ Leader groups own round-robin slot stripes; laggards skip their stripes
 with noop ranges driven by high-watermark gossip. The slot-stripe layout
 is the direct analog of sharding the slot axis across cores
 (SURVEY.md section 2.3 item 4).
+
+Hot-path structure: beside the reference's per-message shape, the
+drain-granular run pipeline (docs/RUN_PIPELINE.md) ships one STRIDED
+``Phase2aRun``/``Phase2bRun``/``ChosenRun`` per event-loop drain --
+runs carry the owner's slot stride, so the ownership gaps between
+consecutive owned slots stay implicit and idle groups' slots keep
+coalescing into the noop-range skip machinery.
 """
 
 from frankenpaxos_tpu.protocols.mencius.common import (

@@ -13,13 +13,19 @@ import dataclasses
 import enum
 from frankenpaxos_tpu.runtime.transport import Address
 
-# Re-used value/message shapes identical to MultiPaxos.
+# Re-used value/message shapes identical to MultiPaxos. The
+# transport-level coalescing envelopes (ClientRequestArray /
+# ClientReplyArray) are shared too: their SoA codecs live in
+# multipaxos/wire.py and carry no slot semantics, so the Mencius twist
+# (strided slot ownership) never reaches them.
 from frankenpaxos_tpu.protocols.multipaxos.messages import (  # noqa: F401
     NOOP,
     ChosenWatermark,
     ClientReply,
+    ClientReplyArray,
     ClientReplyBatch,
     ClientRequest,
+    ClientRequestArray,
     ClientRequestBatch,
     Command,
     CommandBatch,
@@ -72,6 +78,61 @@ class Phase2bNoopRange:
 class ChosenNoopRange:
     slot_start_inclusive: int
     slot_end_exclusive: int
+
+
+# --- drain-granular run pipeline (the MultiPaxos
+# ClientRequestArray -> Phase2aRun -> Phase2bRange -> ChosenRun redesign
+# ported to Mencius' partitioned log). A Mencius leader group owns every
+# G-th slot (G = num_leader_groups, the round-robin slot stride), so one
+# drain's worth of commands occupies a STRIDED run
+# ``start, start + stride, ..., start + (k-1) * stride`` -- the run
+# messages carry the owner's stride so the ownership gaps between
+# consecutive owned slots stay implicit (they belong to OTHER groups and
+# coalesce into Phase2aNoopRange skip ranges when those groups lag)
+# instead of materializing as per-slot noops.
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2aRun:
+    """Phase2as for a strided slot run in one round, one message.
+
+    ``values[i]`` is proposed at slot ``start_slot + i * stride``. The
+    proposing leader group owns exactly those slots; one message per
+    event-loop drain replaces one Phase2a per command
+    (mencius/Leader.scala:331-408's per-slot processClientRequestBatch).
+    """
+
+    start_slot: int
+    stride: int
+    round: int
+    values: tuple  # tuple[CommandBatchOrNoop, ...], one per owned slot
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2bRun:
+    """One acceptor's votes for a whole strided Phase2aRun, one message.
+
+    The acceptor votes a run atomically (one round check, one O(1) run
+    record), so the ack is run-granular too: ``count`` slots starting at
+    ``start_slot`` with step ``stride`` (the Mencius analog of the
+    MultiPaxos Phase2bRange)."""
+
+    acceptor_group_index: int
+    acceptor_index: int
+    start_slot: int
+    count: int
+    stride: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ChosenRun:
+    """Chosen values for a strided slot run, one message per replica per
+    drain (vs one Chosen per slot)."""
+
+    start_slot: int
+    stride: int
+    values: tuple  # tuple[CommandBatchOrNoop, ...], one per owned slot
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,10 +20,13 @@ from frankenpaxos_tpu.utils import BufferMap
 from frankenpaxos_tpu.protocols.mencius.common import (
     Chosen,
     ChosenNoopRange,
+    ChosenRun,
     ChosenWatermark,
     ClientReply,
+    ClientReplyArray,
     ClientReplyBatch,
     ClientRequest,
+    ClientRequestArray,
     Command,
     CommandBatch,
     CommandId,
@@ -132,12 +135,24 @@ class MenciusReplica(Actor):
                         for leader in group:
                             self.send(leader, watermark)
 
-    def _after_choose(self) -> None:
+    def _after_choose(self, coalesce_replies: bool = False) -> None:
         replies = self._execute_log()
         if replies:
             proxy = self._proxy_replica()
             if proxy is not None:
                 self.send(proxy, ClientReplyBatch(batch=tuple(replies)))
+            elif coalesce_replies and len(replies) > 1:
+                # Run-pipeline drains ship each client ONE reply array
+                # instead of one ClientReply per command.
+                by_client: dict = {}
+                for r in replies:
+                    cid = r.command_id
+                    by_client.setdefault(cid.client_address, []).append(
+                        (cid.client_pseudonym, cid.client_id, r.slot,
+                         r.result))
+                for address, entries in by_client.items():
+                    self.send(address,
+                              ClientReplyArray(entries=tuple(entries)))
             else:
                 for reply in replies:
                     self.send(reply.command_id.client_address, reply)
@@ -164,6 +179,8 @@ class MenciusReplica(Actor):
             self.num_chosen += 1
             self.high_watermark = max(self.high_watermark, message.slot)
             self._after_choose()
+        elif isinstance(message, ChosenRun):
+            self._handle_chosen_run(message)
         elif isinstance(message, ChosenNoopRange):
             for slot in range(message.slot_start_inclusive,
                               message.slot_end_exclusive,
@@ -174,6 +191,22 @@ class MenciusReplica(Actor):
             self._after_choose()
         else:
             self.logger.fatal(f"unexpected replica message {message!r}")
+
+    def _handle_chosen_run(self, run: ChosenRun) -> None:
+        """A strided drain of chosen values in one message: log the
+        whole run, execute once, coalesce replies per client."""
+        new = 0
+        slot = run.start_slot
+        for value in run.values:
+            if self.log.get(slot) is None:
+                self.log.put(slot, value)
+                new += 1
+                self.high_watermark = max(self.high_watermark, slot)
+            slot += run.stride
+        if new == 0:
+            return
+        self.num_chosen += new
+        self._after_choose(coalesce_replies=True)
 
 
 class MenciusProxyReplica(Actor):
@@ -216,15 +249,25 @@ class MenciusClient(Actor):
 
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: MenciusConfig,
-                 resend_period_s: float = 10.0, seed: int = 0):
+                 resend_period_s: float = 10.0,
+                 coalesce_writes: bool = False, seed: int = 0):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
         self.rng = random.Random(seed)
         self.resend_period_s = resend_period_s
+        # Coalesce this event-loop pass's writes into ONE
+        # ClientRequestArray to a random group's leader (each command
+        # still gets its own owned slot there). Flushed by on_drain /
+        # flush_writes; resends still go per-request. Bypasses
+        # batchers: the array is transport-level coalescing, not slot
+        # sharing.
+        self.coalesce_writes = coalesce_writes
         self.rounds = [0] * config.num_leader_groups
         self.ids: dict[int, int] = {}
         self.states: dict[int, _PendingWrite] = {}
+        self._staged_writes: list[Command] = []
+        self._flush_scheduled = False
 
     def _send_request(self, request: ClientRequest) -> None:
         if self.config.num_batchers > 0:
@@ -237,6 +280,28 @@ class MenciusClient(Actor):
                 rs.leader(self.rounds[group])]
         self.send(dst, request)
 
+    def _leader_of_group(self, group: int) -> Address:
+        rs = ClassicRoundRobin(len(self.config.leader_addresses[group]))
+        return self.config.leader_addresses[group][
+            rs.leader(self.rounds[group])]
+
+    def flush_writes(self) -> None:
+        """Ship writes staged by ``coalesce_writes`` as one array to a
+        random leader group (any group can sequence any command)."""
+        if not self._staged_writes:
+            return
+        staged, self._staged_writes = self._staged_writes, []
+        group = self.rng.randrange(self.config.num_leader_groups)
+        self.send(self._leader_of_group(group),
+                  ClientRequestArray(commands=tuple(staged)))
+
+    def _deferred_flush(self) -> None:
+        self._flush_scheduled = False
+        self.flush_writes()
+
+    def on_drain(self) -> None:
+        self.flush_writes()
+
     def write(self, pseudonym: int, command: bytes,
               callback: Optional[Callable[[bytes], None]] = None) -> None:
         if pseudonym in self.states:
@@ -245,7 +310,18 @@ class MenciusClient(Actor):
         id = self.ids.get(pseudonym, 0)
         request = ClientRequest(Command(
             CommandId(self.address, pseudonym, id), command))
-        self._send_request(request)
+        if self.coalesce_writes:
+            self._staged_writes.append(request.command)
+            # On a real event-loop transport, flush at the END of this
+            # loop pass so a burst of writes crosses the wire as one
+            # array; SimTransport has no loop -- there on_drain / an
+            # explicit flush_writes() ships them.
+            loop = getattr(self.transport, "loop", None)
+            if loop is not None and not self._flush_scheduled:
+                self._flush_scheduled = True
+                loop.call_soon_threadsafe(self._deferred_flush)
+        else:
+            self._send_request(request)
 
         def resend():
             self._send_request(request)
@@ -267,6 +343,16 @@ class MenciusClient(Actor):
             state.resend.stop()
             del self.states[pseudonym]
             state.callback(message.result)
+        elif isinstance(message, ClientReplyArray):
+            # A replica's whole drain of replies to this client in one
+            # message; per-entry resolution mirrors ClientReply.
+            for pseudonym, client_id, _slot, result in message.entries:
+                state = self.states.get(pseudonym)
+                if state is None or client_id != state.id:
+                    continue
+                state.resend.stop()
+                del self.states[pseudonym]
+                state.callback(result)
         elif isinstance(message, NotLeaderClient):
             for leader in self.config.leader_addresses[
                     message.leader_group_index]:

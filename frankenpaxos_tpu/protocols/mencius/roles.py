@@ -22,8 +22,10 @@ from frankenpaxos_tpu.protocols.mencius.common import (
     NOOP,
     Chosen,
     ChosenNoopRange,
+    ChosenRun,
     ChosenWatermark,
     ClientRequest,
+    ClientRequestArray,
     ClientRequestBatch,
     CommandBatch,
     DistributionScheme,
@@ -41,8 +43,10 @@ from frankenpaxos_tpu.protocols.mencius.common import (
     Phase1bSlotInfo,
     Phase2a,
     Phase2aNoopRange,
+    Phase2aRun,
     Phase2b,
     Phase2bNoopRange,
+    Phase2bRun,
     Recover,
 )
 
@@ -231,14 +235,41 @@ class MenciusLeader(Actor):
                           value=batch.batch))
         self._advance_proxy_leader()
         self.next_slot += self.config.num_leader_groups
+        self._gossip_watermark(1)
+
+    def _gossip_watermark(self, commands: int) -> None:
         # Periodically gossip our nextSlot so laggards can skip
-        # (Leader.scala:455-480).
-        self._commands_since_watermark_send += 1
+        # (Leader.scala:455-480). A k-command run counts k commands.
+        self._commands_since_watermark_send += commands
         if (self._commands_since_watermark_send
                 >= self.send_high_watermark_every_n):
             self.send(self._proxy_leader(),
                       HighWatermark(next_slot=self.next_slot))
             self._commands_since_watermark_send = 0
+
+    def _process_request_array(self, array: ClientRequestArray) -> None:
+        """A drain's worth of independent requests: assign each its own
+        OWNED slot (next_slot, next_slot + G, ...) and propose the whole
+        strided block as ONE Phase2aRun carrying the stride.
+
+        Slots within one leader group also stripe over its acceptor
+        groups ((slot // G) % num_acceptor_groups), so a strided run has
+        a single acceptor audience only with one acceptor group; with
+        more, fall back to per-slot proposals."""
+        self.logger.check_eq(self.state, ("phase2",))
+        if len(self._my_acceptor_groups) > 1:
+            for command in array.commands:
+                self._process_batch(
+                    ClientRequestBatch(CommandBatch((command,))))
+            return
+        stride = self.config.num_leader_groups
+        k = len(array.commands)
+        self.send(self._proxy_leader(), Phase2aRun(
+            start_slot=self.next_slot, stride=stride, round=self.round,
+            values=tuple(CommandBatch((c,)) for c in array.commands)))
+        self._advance_proxy_leader()
+        self.next_slot += k * stride
+        self._gossip_watermark(k)
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
@@ -248,6 +279,8 @@ class MenciusLeader(Actor):
             self._handle_client_request_batch(
                 src, ClientRequestBatch(CommandBatch((message.command,))),
                 from_client=True)
+        elif isinstance(message, ClientRequestArray):
+            self._handle_client_request_array(src, message)
         elif isinstance(message, ClientRequestBatch):
             self._handle_client_request_batch(src, message,
                                               from_client=False)
@@ -319,6 +352,22 @@ class MenciusLeader(Actor):
         else:
             self._process_batch(batch)
 
+    def _handle_client_request_array(self, src: Address,
+                                     array: ClientRequestArray) -> None:
+        """The client edge of the drain-granular run pipeline: every
+        command gets its OWN owned slot (transport-level coalescing,
+        not slot sharing -- see multipaxos ClientRequestArray)."""
+        if not array.commands:
+            return
+        if self.state == ("inactive",):
+            self.send(src, NotLeaderClient(self.group_index))
+        elif isinstance(self.state, _Phase1):
+            for command in array.commands:
+                self.state.pending_batches.append(
+                    ClientRequestBatch(CommandBatch((command,))))
+        else:
+            self._process_request_array(array)
+
     def _handle_high_watermark(self, src: Address,
                                message: HighWatermark) -> None:
         """Skip our slots if we're lagging (Leader.scala:717-770)."""
@@ -371,6 +420,13 @@ class MenciusProxyLeader(Actor):
         self.slot_system = ClassicRoundRobin(config.num_leader_groups)
         # (start, end, round) -> pending state; None once Done.
         self.states: dict[tuple, object] = {}
+        # Pending strided Phase2aRuns: start -> [round, stride, values,
+        # acks set]. One O(1) record per run; round-monotone (a
+        # same-start higher-round run evicts its predecessor).
+        self._runs: dict[int, list] = {}
+        # Retired / evicted run rounds: start -> set of rounds, for the
+        # stray-ack check.
+        self._done_runs: dict[int, set] = {}
 
     def _acceptor_group_index_by_slot(self, leader_group: int,
                                       slot: int) -> int:
@@ -387,6 +443,10 @@ class MenciusProxyLeader(Actor):
             self._handle_phase2a(src, message)
         elif isinstance(message, Phase2b):
             self._handle_phase2b(src, message)
+        elif isinstance(message, Phase2aRun):
+            self._handle_phase2a_run(src, message)
+        elif isinstance(message, Phase2bRun):
+            self._handle_phase2b_run(src, message)
         elif isinstance(message, Phase2aNoopRange):
             self._handle_phase2a_noop_range(src, message)
         elif isinstance(message, Phase2bNoopRange):
@@ -420,6 +480,62 @@ class MenciusProxyLeader(Actor):
             self.send(replica, Chosen(slot=phase2b.slot,
                                       value=state["phase2a"].value))
         self.states[key] = None  # Done
+
+    def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
+        """One write quorum for the whole strided run (one thrifty f+1
+        sample, one forwarded message per member, one O(1) record).
+        Slots of a strided leader-group run all live in ONE acceptor
+        group only when that group is alone; otherwise decompose to the
+        per-slot path (the leader already avoids sending runs then)."""
+        k = len(run.values)
+        if k == 0:
+            return
+        leader_group = self.slot_system.leader(run.start_slot)
+        if len(self.config.acceptor_addresses[leader_group]) > 1:
+            for i, value in enumerate(run.values):
+                self._handle_phase2a(src, Phase2a(
+                    slot=run.start_slot + i * run.stride,
+                    round=run.round, value=value))
+            return
+        pending = self._runs.get(run.start_slot)
+        if pending is not None:
+            if run.round <= pending[0]:
+                return  # duplicate (same or stale round)
+            # Round-monotone eviction, mirroring the acceptor: the
+            # higher-round re-proposal wins; remember the evicted round
+            # so its straggler acks are recognized.
+            self._done_runs.setdefault(run.start_slot,
+                                       set()).add(pending[0])
+        group = self.config.acceptor_addresses[leader_group][0]
+        for acceptor in self.rng.sample(list(group),
+                                        self.config.quorum_size):
+            self.send(acceptor, run)  # encode the values ONCE
+        self._runs[run.start_slot] = [run.round, run.stride,
+                                      run.values, set()]
+
+    def _handle_phase2b_run(self, src: Address,
+                            phase2b: Phase2bRun) -> None:
+        """Acceptors vote runs atomically, so quorum tracking is
+        run-granular: count distinct acceptors, emit ONE ChosenRun per
+        replica when f+1 acked."""
+        run = self._runs.get(phase2b.start_slot)
+        if run is None or run[0] != phase2b.round:
+            if phase2b.round in self._done_runs.get(phase2b.start_slot,
+                                                    ()):
+                return  # straggler ack of a retired/evicted run
+            if run is None:
+                self.logger.fatal(
+                    f"Phase2bRun for unknown run at {phase2b.start_slot}")
+            return  # stale-round ack of a live re-proposed run
+        round, stride, values, acks = run
+        acks.add(phase2b.acceptor_index)
+        if len(acks) < self.config.quorum_size:
+            return
+        for replica in self.config.replica_addresses:
+            self.send(replica, ChosenRun(start_slot=phase2b.start_slot,
+                                         stride=stride, values=values))
+        del self._runs[phase2b.start_slot]
+        self._done_runs.setdefault(phase2b.start_slot, set()).add(round)
 
     def _handle_phase2a_noop_range(self, src: Address,
                                    phase2a: Phase2aNoopRange) -> None:
@@ -484,6 +600,12 @@ class MenciusAcceptor(Actor):
         self.slot_system = ClassicRoundRobin(config.num_leader_groups)
         self.round = -1
         self.states: SortedDict = SortedDict()
+        # Run-voted state (Phase2aRun): start -> (count, stride, round,
+        # values) -- one O(1) record per strided run. A slot's
+        # authoritative vote is the HIGHEST round across both stores
+        # (see _voted_info); the acceptor's monotone ``round`` makes
+        # max-round resolution exact.
+        self._voted_runs: SortedDict = SortedDict()
         self.max_voted_slot = -1
 
     def _nack_leader(self, round: int, slot: int) -> Address:
@@ -495,6 +617,8 @@ class MenciusAcceptor(Actor):
             self._handle_phase1a(src, message)
         elif isinstance(message, Phase2a):
             self._handle_phase2a(src, message)
+        elif isinstance(message, Phase2aRun):
+            self._handle_phase2a_run(src, message)
         elif isinstance(message, Phase2aNoopRange):
             self._handle_phase2a_noop_range(src, message)
         else:
@@ -505,14 +629,36 @@ class MenciusAcceptor(Actor):
             self.send(src, Nack(round=self.round))
             return
         self.round = phase1a.round
-        info = tuple(
-            Phase1bSlotInfo(slot=slot,
-                            vote_round=self.states[slot].vote_round,
-                            vote_value=self.states[slot].vote_value)
-            for slot in self.states.irange(minimum=phase1a.chosen_watermark))
         self.send(src, Phase1b(group_index=self.acceptor_group_index,
                                acceptor_index=self.index,
-                               round=self.round, info=info))
+                               round=self.round,
+                               info=self._voted_info(
+                                   phase1a.chosen_watermark)))
+
+    def _voted_info(self, minimum: int) -> tuple:
+        """Every voted slot >= ``minimum`` with its HIGHEST-round vote,
+        merging the per-slot store and the strided run store (a
+        failover that ignored run votes would recover Noop over
+        accepted values -- data loss). Recovery-only cold path: runs
+        expand per slot here and nowhere else."""
+        best: dict[int, tuple] = {
+            slot: (self.states[slot].vote_round,
+                   self.states[slot].vote_value)
+            for slot in self.states.irange(minimum=minimum)}
+        for start, (count, stride, rnd, values) in \
+                self._voted_runs.items():
+            if start + (count - 1) * stride < minimum:
+                continue
+            for i in range(count):
+                slot = start + i * stride
+                if slot < minimum:
+                    continue
+                cur = best.get(slot)
+                if cur is None or rnd > cur[0]:
+                    best[slot] = (rnd, values[i])
+        return tuple(
+            Phase1bSlotInfo(slot=slot, vote_round=rnd, vote_value=value)
+            for slot, (rnd, value) in sorted(best.items()))
 
     def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
         if phase2a.round < self.round:
@@ -525,6 +671,44 @@ class MenciusAcceptor(Actor):
         self.send(src, Phase2b(group_index=self.acceptor_group_index,
                                acceptor_index=self.index,
                                slot=phase2a.slot, round=self.round))
+
+    def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
+        """A whole strided proposal run in one O(1) update: one round
+        check, one run record, one Phase2bRun ack -- the per-drain
+        shape of the per-slot _handle_phase2a."""
+        if run.round < self.round:
+            self.send(self._nack_leader(run.round, run.start_slot),
+                      Nack(round=self.round))
+            return
+        self.round = run.round
+        count = len(run.values)
+        old = self._voted_runs.get(run.start_slot)
+        self._voted_runs[run.start_slot] = (count, run.stride, run.round,
+                                            run.values)
+        if old is not None and old[1] == run.stride and old[0] > count:
+            # Same-start truncation (the multipaxos acceptor's tail
+            # fix, strided): reinsert the longer predecessor's
+            # non-overlapped voted tail so Phase1 recovery keeps it.
+            old_count, stride, old_round, old_values = old
+            tail_start = run.start_slot + count * stride
+            if self._voted_runs.get(tail_start) is None:
+                self._voted_runs[tail_start] = (
+                    old_count - count, stride, old_round,
+                    old_values[count:])
+            else:
+                for i in range(count, old_count):
+                    slot = run.start_slot + i * stride
+                    cur = self.states.get(slot)
+                    if cur is None or cur.vote_round < old_round:
+                        self.states[slot] = _VoteState(old_round,
+                                                       old_values[i])
+        self.max_voted_slot = max(
+            self.max_voted_slot,
+            run.start_slot + (count - 1) * run.stride)
+        self.send(src, Phase2bRun(
+            acceptor_group_index=self.acceptor_group_index,
+            acceptor_index=self.index, start_slot=run.start_slot,
+            count=count, stride=run.stride, round=run.round))
 
     def _handle_phase2a_noop_range(self, src: Address,
                                    phase2a: Phase2aNoopRange) -> None:

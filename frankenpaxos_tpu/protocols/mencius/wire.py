@@ -15,13 +15,18 @@ import struct
 from frankenpaxos_tpu.protocols.mencius.common import (
     Chosen,
     ChosenNoopRange,
+    ChosenRun,
     HighWatermark,
     Phase2aNoopRange,
+    Phase2aRun,
     Phase2bNoopRange,
+    Phase2bRun,
 )
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
     _put_value,
+    _put_value_array,
     _take_value,
+    _take_value_array,
 )
 from frankenpaxos_tpu.runtime.serializer import (
     MessageCodec,
@@ -108,7 +113,71 @@ class ChosenNoopRangeCodec(MessageCodec):
                                slot_end_exclusive=end), at + 16
 
 
+# --- strided run-pipeline codecs --------------------------------------------
+# Fixed-layout SoA forms mirroring multipaxos/wire.py's run codecs: the
+# value payload rides _put_value_array's address-table layout (decoding
+# yields a LazyValueArray, so forwarding roles never materialize
+# Command objects), prefixed by the run header carrying the owner's
+# slot stride.
+
+_QQI64 = struct.Struct("<qqq")  # start, stride, round
+
+
+class MenciusPhase2aRunCodec(MessageCodec):
+    message_type = Phase2aRun
+    tag = 113
+
+    def encode(self, out, message):
+        out += _QQI64.pack(message.start_slot, message.stride,
+                           message.round)
+        _put_value_array(out, message.values)
+
+    def decode(self, buf, at):
+        start, stride, round = _QQI64.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 24)
+        return Phase2aRun(start_slot=start, stride=stride, round=round,
+                          values=values), at
+
+
+_P2BRUN = struct.Struct("<qqqqii")  # start, count, stride, round, grp, acc
+
+
+class MenciusPhase2bRunCodec(MessageCodec):
+    message_type = Phase2bRun
+    tag = 126
+
+    def encode(self, out, message):
+        out += _P2BRUN.pack(message.start_slot, message.count,
+                            message.stride, message.round,
+                            message.acceptor_group_index,
+                            message.acceptor_index)
+
+    def decode(self, buf, at):
+        start, count, stride, round, group, acceptor = \
+            _P2BRUN.unpack_from(buf, at)
+        return Phase2bRun(acceptor_group_index=group,
+                          acceptor_index=acceptor, start_slot=start,
+                          count=count, stride=stride,
+                          round=round), at + _P2BRUN.size
+
+
+class MenciusChosenRunCodec(MessageCodec):
+    message_type = ChosenRun
+    tag = 127
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.start_slot, message.stride)
+        _put_value_array(out, message.values)
+
+    def decode(self, buf, at):
+        start, stride = _I64I64.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 16)
+        return ChosenRun(start_slot=start, stride=stride,
+                         values=values), at
+
+
 for _codec in (MenciusChosenCodec(), HighWatermarkCodec(),
                Phase2aNoopRangeCodec(), Phase2bNoopRangeCodec(),
-               ChosenNoopRangeCodec()):
+               ChosenNoopRangeCodec(), MenciusPhase2aRunCodec(),
+               MenciusPhase2bRunCodec(), MenciusChosenRunCodec()):
     register_codec(_codec)

@@ -185,7 +185,29 @@ class Acceptor(Actor):
             return
         self.round = run.round
         end = run.start_slot + len(run.values)
+        old = self._voted_runs.get(run.start_slot)
         self._voted_runs[run.start_slot] = (end, run.round, run.values)
+        if old is not None and old[0] > end:
+            # A shorter same-start run replaces a longer record (a
+            # re-proposed prefix after leader change): the non-overlapped
+            # voted tail [end, old_end) must survive as its own record,
+            # or Phase1 recovery would lose those votes (choosing Noop
+            # over accepted values). ``end`` cannot equal an existing
+            # start (same-start keys collide only at run.start_slot), so
+            # this insert never clobbers a longer record.
+            old_end, old_round, old_values = old
+            tail = old_values[end - run.start_slot:]
+            if self._voted_runs.get(end) is None:
+                self._voted_runs[end] = (old_end, old_round, tail)
+            else:
+                # A record already starts at ``end``: spill the tail
+                # into the per-slot store instead of clobbering it
+                # (_voted_info max-round-merges both stores).
+                for off, slot in enumerate(range(end, old_end)):
+                    cur = self.states.get(slot)
+                    if cur is None or cur.vote_round < old_round:
+                        self.states[slot] = _VoteState(old_round,
+                                                       tail[off])
         self.max_voted_slot = max(self.max_voted_slot, end - 1)
         # Ack immediately as one range: the run is already a contiguous
         # same-round block, so drain-end staging (whose merge loop is

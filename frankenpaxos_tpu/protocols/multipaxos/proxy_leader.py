@@ -216,8 +216,25 @@ class ProxyLeader(Actor):
         sample per run keeps acceptor-side runs whole), one forwarded
         message per quorum member, one O(1) pending record."""
         k = len(run.values)
-        if k == 0 or run.start_slot in self._runs:
-            return  # empty or duplicate
+        if k == 0:
+            return
+        pending = self._runs.get(run.start_slot)
+        if pending is not None:
+            if run.round <= pending[1]:
+                return  # duplicate (same or stale round)
+            # A same-start HIGHER-round run (a new leader re-proposing
+            # the window) evicts the stale pending record -- mirroring
+            # the acceptor's round-monotone vote store; keeping the old
+            # record would swallow the new proposal and strand its
+            # slots until recovery.
+            del self._runs[run.start_slot]
+            i = bisect.bisect_left(self._run_starts, run.start_slot)
+            self._run_starts.pop(i)
+            # Remember the evicted (start, end, round) so straggler
+            # old-round acks are recognized instead of tripping the
+            # stray-ack fatal check.
+            bisect.insort(self._done_runs,
+                          (run.start_slot, pending[0], pending[1]))
         if not self.config.flexible:
             group = list(self.config.acceptor_addresses[0])
             quorum = self.rng.sample(group, self.config.f + 1)
@@ -247,8 +264,16 @@ class ProxyLeader(Actor):
                                                   float("inf"))) - 1
         if i < 0:
             return False
-        start, end, rnd = self._done_runs[i]
-        return slot < end and rnd == round
+        # Same-start records can coexist (a retired run plus an evicted
+        # lower-round predecessor); check every record sharing the
+        # covering start (distinct starts never overlap).
+        anchor = self._done_runs[i][0]
+        while i >= 0 and self._done_runs[i][0] == anchor:
+            _, end, rnd = self._done_runs[i]
+            if slot < end and rnd == round:
+                return True
+            i -= 1
+        return False
 
     def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
         key = (phase2b.slot, phase2b.round)

@@ -1,0 +1,105 @@
+"""Wire a whole Mencius deployment over one SimTransport.
+
+The analog of tests/protocols/multipaxos_harness.py for the
+partitioned-log protocol: every role in one process, driven by explicit
+message deliveries / timer firings. Shared by the Mencius tests and the
+mencius_lt bench suite (per-message vs coalesced A/B), so the driving
+harness cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
+from frankenpaxos_tpu.protocols.mencius import (
+    MenciusAcceptor,
+    MenciusBatcher,
+    MenciusClient,
+    MenciusConfig,
+    MenciusLeader,
+    MenciusProxyLeader,
+    MenciusProxyReplica,
+    MenciusReplica,
+)
+
+
+@dataclasses.dataclass
+class MenciusSim:
+    transport: SimTransport
+    config: MenciusConfig
+    batchers: list
+    leaders: list
+    proxy_leaders: list
+    acceptors: list
+    replicas: list
+    proxy_replicas: list
+    clients: list
+
+
+def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
+                 num_batchers=0, num_proxy_replicas=0, num_clients=1,
+                 batch_size=1, lag_threshold=100, coalesced=False,
+                 state_machine_factory=AppendLog, seed=0) -> MenciusSim:
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = SimTransport(logger)
+    config = MenciusConfig(
+        f=f,
+        batcher_addresses=tuple(f"batcher-{i}" for i in range(num_batchers)),
+        leader_addresses=tuple(
+            tuple(f"leader-{g}-{i}" for i in range(f + 1))
+            for g in range(num_leader_groups)),
+        leader_election_addresses=tuple(
+            tuple(f"election-{g}-{i}" for i in range(f + 1))
+            for g in range(num_leader_groups)),
+        proxy_leader_addresses=tuple(
+            f"proxy-leader-{i}" for i in range(f + 1)),
+        acceptor_addresses=tuple(
+            tuple(tuple(f"acceptor-{g}-{ag}-{i}" for i in range(2 * f + 1))
+                  for ag in range(num_acceptor_groups))
+            for g in range(num_leader_groups)),
+        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)),
+        proxy_replica_addresses=tuple(
+            f"proxy-replica-{i}" for i in range(num_proxy_replicas)),
+    )
+    config.check_valid()
+    batchers = [MenciusBatcher(a, transport, logger, config,
+                               batch_size=batch_size, seed=seed + i)
+                for i, a in enumerate(config.batcher_addresses)]
+    leaders = [MenciusLeader(a, transport, logger, config,
+                             send_high_watermark_every_n=3,
+                             send_noop_range_if_lagging_by=lag_threshold,
+                             seed=seed + 10 + g * 10 + i)
+               for g, group in enumerate(config.leader_addresses)
+               for i, a in enumerate(group)]
+    proxy_leaders = [MenciusProxyLeader(a, transport, logger, config,
+                                        seed=seed + 50 + i)
+                     for i, a in enumerate(config.proxy_leader_addresses)]
+    acceptors = [MenciusAcceptor(a, transport, logger, config)
+                 for groups in config.acceptor_addresses
+                 for group in groups for a in group]
+    replicas = [MenciusReplica(a, transport, logger,
+                               state_machine_factory(), config,
+                               send_chosen_watermark_every_n=5,
+                               seed=seed + 70 + i)
+                for i, a in enumerate(config.replica_addresses)]
+    proxy_replicas = [MenciusProxyReplica(a, transport, logger, config)
+                      for a in config.proxy_replica_addresses]
+    # coalesced=True: every client stages writes into request arrays
+    # (the drain-granular run pipeline); "mixed": even-indexed clients
+    # coalesce while odd ones send per-message ClientRequests, so
+    # strided runs and per-slot proposals interleave in one cluster.
+    assert coalesced in (False, True, "mixed"), coalesced
+    clients = [MenciusClient(f"client-{i}", transport, logger, config,
+                             coalesce_writes=(
+                                 coalesced is True
+                                 or (coalesced == "mixed" and i % 2 == 0)),
+                             seed=seed + 90 + i)
+               for i in range(num_clients)]
+    return MenciusSim(transport, config, batchers, leaders, proxy_leaders,
+                      acceptors, replicas, proxy_replicas, clients)
+
+
+def executed_prefix(replica) -> list:
+    return [replica.log.get(s) for s in range(replica.executed_watermark)]

@@ -6,75 +6,18 @@ from typing import Optional
 
 import pytest
 
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-from frankenpaxos_tpu.statemachine import AppendLog
-from frankenpaxos_tpu.protocols.mencius import (
-    MenciusAcceptor,
-    MenciusBatcher,
-    MenciusClient,
-    MenciusConfig,
-    MenciusLeader,
-    MenciusProxyLeader,
-    MenciusProxyReplica,
-    MenciusReplica,
+
+from tests.protocols.mencius_harness import (
+    executed_prefix,
+    make_mencius as _make_mencius_sim,
 )
 
 
-def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
-                 num_batchers=0, num_proxy_replicas=0, num_clients=1,
-                 batch_size=1, lag_threshold=100, seed=0):
-    logger = FakeLogger(LogLevel.FATAL)
-    transport = SimTransport(logger)
-    config = MenciusConfig(
-        f=f,
-        batcher_addresses=tuple(f"batcher-{i}" for i in range(num_batchers)),
-        leader_addresses=tuple(
-            tuple(f"leader-{g}-{i}" for i in range(f + 1))
-            for g in range(num_leader_groups)),
-        leader_election_addresses=tuple(
-            tuple(f"election-{g}-{i}" for i in range(f + 1))
-            for g in range(num_leader_groups)),
-        proxy_leader_addresses=tuple(
-            f"proxy-leader-{i}" for i in range(f + 1)),
-        acceptor_addresses=tuple(
-            tuple(tuple(f"acceptor-{g}-{ag}-{i}" for i in range(2 * f + 1))
-                  for ag in range(num_acceptor_groups))
-            for g in range(num_leader_groups)),
-        replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)),
-        proxy_replica_addresses=tuple(
-            f"proxy-replica-{i}" for i in range(num_proxy_replicas)),
-    )
-    config.check_valid()
-    batchers = [MenciusBatcher(a, transport, logger, config,
-                               batch_size=batch_size, seed=seed + i)
-                for i, a in enumerate(config.batcher_addresses)]
-    leaders = [MenciusLeader(a, transport, logger, config,
-                             send_high_watermark_every_n=3,
-                             send_noop_range_if_lagging_by=lag_threshold,
-                             seed=seed + 10 + g * 10 + i)
-               for g, group in enumerate(config.leader_addresses)
-               for i, a in enumerate(group)]
-    proxy_leaders = [MenciusProxyLeader(a, transport, logger, config,
-                                        seed=seed + 50 + i)
-                     for i, a in enumerate(config.proxy_leader_addresses)]
-    acceptors = [MenciusAcceptor(a, transport, logger, config)
-                 for groups in config.acceptor_addresses
-                 for group in groups for a in group]
-    replicas = [MenciusReplica(a, transport, logger, AppendLog(), config,
-                               send_chosen_watermark_every_n=5,
-                               seed=seed + 70 + i)
-                for i, a in enumerate(config.replica_addresses)]
-    proxy_replicas = [MenciusProxyReplica(a, transport, logger, config)
-                      for a in config.proxy_replica_addresses]
-    clients = [MenciusClient(f"client-{i}", transport, logger, config,
-                             seed=seed + 90 + i)
-               for i in range(num_clients)]
-    return transport, config, leaders, replicas, clients
-
-
-def executed_prefix(replica):
-    return [replica.log.get(s) for s in range(replica.executed_watermark)]
+def make_mencius(**kwargs):
+    """Legacy tuple shape over the shared harness."""
+    sim = _make_mencius_sim(**kwargs)
+    return sim.transport, sim.config, sim.leaders, sim.replicas, sim.clients
 
 
 class TestMenciusIntegration:
@@ -160,6 +103,179 @@ class TestMenciusIntegration:
         assert any(isinstance(v, Noop) for v in log), log
 
 
+class TestMenciusRunPipeline:
+    """The drain-granular strided run pipeline (ClientRequestArray ->
+    Phase2aRun -> Phase2bRun -> ChosenRun -> ClientReplyArray) against
+    the per-message reference shape."""
+
+    def drive(self, sim, lo, hi, got, rounds=60):
+        for p in range(lo, hi):
+            sim.clients[0].write(p, b"v%d" % p, got.append)
+        sim.clients[0].flush_writes()
+        sim.transport.deliver_all_coalesced()
+        for _ in range(rounds):
+            if len(got) == hi:
+                return
+            for timer in sim.transport.running_timers():
+                if timer.name == "recover":
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all_coalesced()
+
+    def test_matches_per_message_pipeline(self):
+        """Same writes through the coalesced and per-message pipelines
+        produce identical replica logs (commands AND noop skips)."""
+        from tests.protocols.mencius_harness import (
+            executed_prefix as prefix,
+            make_mencius as make,
+        )
+
+        logs = {}
+        for coalesced in (False, True):
+            sim = make(coalesced=coalesced, lag_threshold=1)
+            got = []
+            for wave in range(4):
+                self.drive(sim, wave * 32, wave * 32 + 32, got)
+            assert sorted(got, key=int) == [b"%d" % p for p in range(128)]
+            l0, l1 = prefix(sim.replicas[0]), prefix(sim.replicas[1])
+            n = min(len(l0), len(l1))
+            assert l0[:n] == l1[:n]
+            logs[coalesced] = l0
+        from frankenpaxos_tpu.protocols.mencius.common import Noop
+
+        def payloads(log):
+            return [v.commands[0].command for v in log
+                    if not isinstance(v, Noop) and v.commands]
+
+        # Slot ORDER differs between arms (clients pick random leader
+        # groups per request vs per flush); the committed command SET
+        # and exactly-once execution are the equivalence contract.
+        assert sorted(payloads(logs[False])) == sorted(payloads(logs[True]))
+        assert len(payloads(logs[True])) == 128
+
+    def test_run_votes_survive_leader_failover(self):
+        """Strided run-voted acceptor state must feed the new leader's
+        Phase1 (the run store merges into Phase1b): values accepted via
+        Phase2aRuns survive failover, and the new leader keeps serving
+        coalesced writes."""
+        from tests.protocols.mencius_harness import (
+            executed_prefix as prefix,
+            make_mencius as make,
+        )
+
+        sim = make(coalesced=True, lag_threshold=1)
+        got = []
+        self.drive(sim, 0, 16, got)
+        assert len(got) == 16
+        before = prefix(sim.replicas[0])
+        g0 = [ld for ld in sim.leaders if ld.group_index == 0]
+        g0[1].leader_change(is_new_leader=True, recover_slot=-1)
+        g0[0].leader_change(is_new_leader=False, recover_slot=-1)
+        sim.transport.deliver_all_coalesced()
+        after = prefix(sim.replicas[0])
+        assert after[:len(before)] == before  # nothing lost or rewritten
+        self.drive(sim, 16, 24, got)
+        for _ in range(40):
+            if len(got) == 24:
+                break
+            for timer in sim.transport.running_timers():
+                if timer.name == "recover" \
+                        or timer.name.startswith("resendWrite"):
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all_coalesced()
+        assert len(got) == 24
+        l0, l1 = prefix(sim.replicas[0]), prefix(sim.replicas[1])
+        n = min(len(l0), len(l1))
+        assert l0[:n] == l1[:n]
+
+    def test_acceptor_phase1b_merges_strided_run_votes(self):
+        """An acceptor reports strided run-voted slots in Phase1b with
+        the highest round winning over per-slot votes, and a shorter
+        same-start replacement preserves the longer run's tail."""
+        from frankenpaxos_tpu.protocols.mencius.common import (
+            CommandBatch,
+            Phase1a,
+            Phase2a,
+            Phase2aRun,
+        )
+        from tests.protocols.mencius_harness import make_mencius as make
+
+        sim = make()
+        acceptor = sim.acceptors[0]
+        v = lambda tag: CommandBatch((tag,))  # noqa: E731
+        # Run at slots 10, 12, 14 (stride 2).
+        acceptor.receive("proxy-leader-0", Phase2aRun(
+            start_slot=10, stride=2, round=0,
+            values=(v("a"), v("b"), v("c"))))
+        # Per-slot re-vote of slot 12 at a higher round shadows the run.
+        acceptor.receive("proxy-leader-0",
+                         Phase2a(slot=12, round=1, value=v("b2")))
+        # Same-start SHORTER run at a higher round truncates: the tail
+        # (slot 14) must survive recovery with its round-0 vote.
+        acceptor.receive("proxy-leader-0", Phase2aRun(
+            start_slot=10, stride=2, round=2, values=(v("a2"),)))
+        acceptor.receive("leader-0-1", Phase1a(round=3,
+                                               chosen_watermark=10))
+        sent = [m for m in sim.transport.messages if m.dst == "leader-0-1"]
+        assert sent, "acceptor must answer Phase1a"
+        phase1b = acceptor.serializer.from_bytes(sent[-1].data)
+        info = {i.slot: (i.vote_round, i.vote_value) for i in phase1b.info}
+        assert info[10] == (2, v("a2"))
+        assert info[12] == (1, v("b2"))  # higher round wins
+        assert info[14] == (0, v("c"))   # truncated tail preserved
+
+    def test_proxy_leader_round_monotone_run_eviction(self):
+        """A same-start higher-round Phase2aRun evicts the stale pending
+        record and is proposed; duplicates and stale rounds are ignored;
+        straggler acks of the evicted round don't fatal or emit."""
+        from frankenpaxos_tpu.protocols.mencius.common import (
+            Command,
+            CommandBatch,
+            CommandId,
+            Phase2aRun,
+            Phase2bRun,
+        )
+        from tests.protocols.mencius_harness import make_mencius as make
+
+        sim = make()
+        proxy = sim.proxy_leaders[0]
+        v = lambda i: CommandBatch((Command(  # noqa: E731
+            CommandId("client-0", 0, 0), i.encode()),))
+        run0 = Phase2aRun(start_slot=0, stride=2, round=0,
+                          values=(v("a"), v("b")))
+        sim.transport.messages.clear()
+        proxy.receive("leader-0-0", run0)
+        forwards = len(sim.transport.messages)
+        assert forwards == sim.config.f + 1
+        proxy.receive("leader-0-0", run0)  # duplicate: ignored
+        assert len(sim.transport.messages) == forwards
+        run1 = Phase2aRun(start_slot=0, stride=2, round=1,
+                          values=(v("a"), v("b")))
+        proxy.receive("leader-0-1", run1)  # higher round: proposed
+        assert len(sim.transport.messages) == 2 * forwards
+        assert proxy._runs[0][0] == 1
+        sim.transport.messages.clear()
+        # Straggler acks of the evicted round 0: swallowed quietly.
+        proxy.receive("acceptor-0-0-0", Phase2bRun(
+            acceptor_group_index=0, acceptor_index=0, start_slot=0,
+            count=2, stride=2, round=0))
+        assert [m for m in sim.transport.messages
+                if m.dst.startswith("replica")] == []
+        # Round-1 quorum completes: one ChosenRun per replica.
+        for acc in (0, 1):
+            proxy.receive(f"acceptor-0-0-{acc}", Phase2bRun(
+                acceptor_group_index=0, acceptor_index=acc, start_slot=0,
+                count=2, stride=2, round=1))
+        chosen = [proxy.serializer.from_bytes(m.data)
+                  for m in sim.transport.messages if m.dst == "replica-0"]
+        assert [(c.start_slot, c.stride, len(c.values))
+                for c in chosen] == [(0, 2, 2)]
+        assert 0 not in proxy._runs
+        # A re-ack of the RETIRED round is recognized (no fatal).
+        proxy.receive("acceptor-0-0-2", Phase2bRun(
+            acceptor_group_index=0, acceptor_index=2, start_slot=0,
+            count=2, stride=2, round=1))
+
+
 class WriteCmd:
     def __init__(self, client, pseudonym, payload):
         self.client = client
@@ -178,6 +294,21 @@ class TransportCmd:
         return f"Transport({self.command!r})"
 
 
+class FlushCmd:
+    """Ship one coalescing client's staged writes (flush_writes) as its
+    OWN random command -- several writes stage before a flush, so
+    request arrays (and the strided Phase2aRuns they become) carry
+    k > 1 commands into the adversarial interleaving of drops,
+    partitions, and leader changes (same pattern as the MultiPaxos
+    adversarial sim)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def __repr__(self):
+        return f"Flush({self.client})"
+
+
 class MenciusSimulated(SimulatedSystem):
     def __init__(self, **kwargs):
         self.kwargs = kwargs
@@ -191,18 +322,25 @@ class MenciusSimulated(SimulatedSystem):
     def generate_command(self, system, rng: random.Random):
         choices = []
         idle = [(c, p) for c, client in enumerate(system["clients"])
-                for p in (0, 1) if p not in client.states]
+                for p in range(4) if p not in client.states]
         if idle:
-            choices.append("write")
+            choices.extend(["write"] * 2)
+        staged = [c for c, client in enumerate(system["clients"])
+                  if getattr(client, "_staged_writes", None)]
+        if staged:
+            choices.append("flush")
         transport_cmd = system["transport"].generate_command(rng)
         if transport_cmd is not None:
             choices.extend(["transport"] * 6)
         if not choices:
             return None
-        if rng.choice(choices) == "write":
+        kind = rng.choice(choices)
+        if kind == "write":
             client, pseudonym = rng.choice(idle)
             system["counter"] += 1
             return WriteCmd(client, pseudonym, b"w%d" % system["counter"])
+        if kind == "flush":
+            return FlushCmd(rng.choice(staged))
         return TransportCmd(transport_cmd)
 
     def run_command(self, system, command):
@@ -210,6 +348,8 @@ class MenciusSimulated(SimulatedSystem):
             client = system["clients"][command.client]
             if command.pseudonym not in client.states:
                 client.write(command.pseudonym, command.payload)
+        elif isinstance(command, FlushCmd):
+            system["clients"][command.client].flush_writes()
         else:
             system["transport"].run_command(command.command)
         return system
@@ -229,7 +369,15 @@ class MenciusSimulated(SimulatedSystem):
     dict(num_leader_groups=1),
     dict(num_leader_groups=2, lag_threshold=2),
     dict(num_leader_groups=3, num_acceptor_groups=2, lag_threshold=3),
-], ids=["groups1", "groups2", "groups3x2"])
+    dict(num_leader_groups=2, lag_threshold=2, coalesced=True),
+    dict(num_leader_groups=1, coalesced=True),
+    dict(num_leader_groups=2, lag_threshold=2, coalesced="mixed"),
+    # Multiple acceptor groups + coalesced clients: the leader's
+    # per-slot fallback path under the same adversarial schedule.
+    dict(num_leader_groups=2, num_acceptor_groups=2, lag_threshold=2,
+         coalesced=True),
+], ids=["groups1", "groups2", "groups3x2", "coalesced", "coalesced-g1",
+        "coalesced-mixed", "coalesced-groups2x2"])
 def test_simulation_no_divergence(kwargs):
     failure = Simulator(MenciusSimulated(**kwargs), run_length=150,
                         num_runs=15).run(seed=0)

@@ -307,6 +307,22 @@ class TransportCmd:
         return f"Transport({self.command!r})"
 
 
+class FlushCmd:
+    """Ship one coalescing client's staged writes (flush_writes).
+
+    Flushing is its OWN random command -- several writes stage before a
+    flush, so request arrays (and the Phase2aRuns they become) carry
+    k > 1 commands INTO the adversarial interleaving of drops,
+    partitions, and leader changes, instead of degenerating to k=1
+    arrays that never exercise run-store edge paths."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def __repr__(self):
+        return f"Flush({self.client})"
+
+
 def prefixes_compatible(a: list, b: list) -> bool:
     n = min(len(a), len(b))
     return a[:n] == b[:n]
@@ -328,11 +344,17 @@ class MultiPaxosSimulated(SimulatedSystem):
 
     def generate_command(self, sim, rng: random.Random):
         choices = []
-        # Writes are only possible for idle pseudonyms.
+        # Writes are only possible for idle pseudonyms. More pseudonyms
+        # than a coalescing client can flush at once, so k > 1 writes
+        # stage between flushes.
         idle = [(c, p) for c, client in enumerate(sim.clients)
-                for p in (0, 1) if p not in client.states]
+                for p in range(4) if p not in client.states]
         if idle:
-            choices.append("write")
+            choices.extend(["write"] * 2)
+        staged = [c for c, client in enumerate(sim.clients)
+                  if getattr(client, "_staged_writes", None)]
+        if staged:
+            choices.append("flush")
         transport_cmd = sim.transport.generate_command(rng)
         if transport_cmd is not None:
             # Weight transport activity higher: most steps move messages.
@@ -345,6 +367,8 @@ class MultiPaxosSimulated(SimulatedSystem):
             sim._counter += 1
             return WriteCmd(client, pseudonym,
                             b"w%d" % sim._counter)
+        if kind == "flush":
+            return FlushCmd(rng.choice(staged))
         return TransportCmd(transport_cmd)
 
     def run_command(self, sim, command):
@@ -352,11 +376,8 @@ class MultiPaxosSimulated(SimulatedSystem):
             client = sim.clients[command.client]
             if command.pseudonym not in client.states:
                 client.write(command.pseudonym, command.payload)
-                # Coalesced clients stage writes for the next drain;
-                # flush so the adversarial interleaving sees them (the
-                # real event loop flushes on its next pass). No-op
-                # without coalesce_writes.
-                client.flush_writes()
+        elif isinstance(command, FlushCmd):
+            sim.clients[command.client].flush_writes()
         else:
             sim.transport.run_command(command.command)
         return sim
@@ -542,6 +563,58 @@ class TestCoalescedRunPipeline:
         assert len(sim.transport.messages) == forwards
         assert len(proxy._run_starts) == 1
 
+    def test_proxy_leader_higher_round_run_evicts_stale_pending(self):
+        """A same-start HIGHER-round Phase2aRun must evict the stale
+        pending record and be proposed (round-monotone, mirroring the
+        acceptor); same-round duplicates stay ignored, and straggler
+        acks of the evicted round are recognized (no fatal)."""
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            Command,
+            CommandBatch,
+            CommandId,
+            Phase2aRun,
+            Phase2b,
+            Phase2bRange,
+        )
+
+        sim = make_multipaxos(f=1)
+        proxy = sim.proxy_leaders[0]
+        v = lambda i: CommandBatch((Command(  # noqa: E731
+            CommandId("client-0", i, 0), b"v%d" % i),))
+        run0 = Phase2aRun(start_slot=0, round=0,
+                          values=(v(0), v(1), v(2)))
+        sim.transport.messages.clear()
+        proxy.receive("leader-0", run0)
+        forwards = len(sim.transport.messages)
+        assert forwards == sim.config.f + 1
+        proxy.receive("leader-0", run0)  # same round: ignored
+        assert len(sim.transport.messages) == forwards
+        run1 = Phase2aRun(start_slot=0, round=1,
+                          values=(v(0), v(1), v(2)))
+        proxy.receive("leader-1", run1)  # higher round: proposed
+        assert len(sim.transport.messages) == 2 * forwards
+        assert proxy._runs[0][1] == 1 and len(proxy._run_starts) == 1
+        sim.transport.messages.clear()
+        # Straggler acks of the evicted round 0 (ranged AND single-slot,
+        # the latter running the stray-ack fatal check): swallowed.
+        proxy.receive("acceptor-0-0", Phase2bRange(
+            group_index=0, acceptor_index=0, slot_start_inclusive=0,
+            slot_end_exclusive=3, round=0))
+        proxy.receive("acceptor-0-0", Phase2b(
+            group_index=0, acceptor_index=0, slot=1, round=0))
+        proxy.on_drain()
+        assert [m for m in sim.transport.messages
+                if m.dst.startswith("replica")] == []
+        # The round-1 quorum completes and emits ChosenRuns normally.
+        for acc in (0, 1):
+            proxy.receive(f"acceptor-0-{acc}", Phase2bRange(
+                group_index=0, acceptor_index=acc,
+                slot_start_inclusive=0, slot_end_exclusive=3, round=1))
+        proxy.on_drain()
+        chosen = [proxy.serializer.from_bytes(m.data)
+                  for m in sim.transport.messages if m.dst == "replica-0"]
+        assert [(c.start_slot, len(c.values)) for c in chosen] == [(0, 3)]
+
     def test_failover_with_proposals_stuck_at_proxies(self):
         """Proposals die at PARTITIONED proxy leaders mid-run; a
         failover plus client resends must still commit every write
@@ -604,6 +677,102 @@ class TestCoalescedRunPipeline:
         assert info[10] == (0, v("a"))
         assert info[11] == (1, v("b2"))  # higher round wins
         assert info[12] == (0, v("c"))
+
+
+class TestAcceptorSameStartTruncation:
+    """Round-5 advisor fix: a shorter same-start Phase2aRun replacing a
+    longer record must reinsert the non-overlapped voted tail
+    [new_end, old_end) -- a truncation that dropped it would erase
+    quorum evidence for tail slots, and a later leader change could
+    recover Noop over a CHOSEN value."""
+
+    def _v(self, tag):
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            Command,
+            CommandBatch,
+            CommandId,
+        )
+
+        return CommandBatch((Command(CommandId("client-0", 0, 0),
+                                     tag.encode()),))
+
+    def _info(self, acceptor, round, watermark):
+        from frankenpaxos_tpu.protocols.multipaxos.messages import Phase1a
+
+        acceptor.receive("leader-1", Phase1a(round=round,
+                                             chosen_watermark=watermark))
+
+    def test_truncation_across_leader_change_preserves_tail(self):
+        """The leader-change scenario: leader A's run [10, 18) is voted;
+        a delayed shorter same-start re-proposal [10, 13) from leader B
+        (round 1) lands after it; leader C's Phase1 (round 2) must still
+        see the round-0 tail [13, 18) -- and a real Leader fed those
+        Phase1bs must re-propose the tail VALUES, not Noop."""
+        from frankenpaxos_tpu.protocols.multipaxos.leader import _Phase1
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            NOOP,
+            Phase2aRun,
+        )
+
+        sim = make_multipaxos(f=1, coalesced=True)
+        acceptor = sim.acceptors[0]
+        long_run = Phase2aRun(start_slot=10, round=0, values=tuple(
+            self._v("a%d" % i) for i in range(8)))
+        short_run = Phase2aRun(start_slot=10, round=1, values=tuple(
+            self._v("b%d" % i) for i in range(3)))
+        acceptor.receive("proxy-leader-0", long_run)
+        acceptor.receive("proxy-leader-0", short_run)
+        self._info(acceptor, 2, 10)
+        sent = [m for m in sim.transport.messages if m.dst == "leader-1"]
+        phase1b = acceptor.serializer.from_bytes(sent[-1].data)
+        info = {i.slot: (i.vote_round, i.vote_value) for i in phase1b.info}
+        for i in range(3):
+            assert info[10 + i] == (1, self._v("b%d" % i))
+        for i in range(3, 8):
+            assert info[10 + i] == (0, self._v("a%d" % i)), i
+
+        # Leader C recovers from a quorum containing this acceptor: the
+        # tail values must be re-proposed, not Noop'd.
+        leader = sim.leaders[1]
+        leader.chosen_watermark = 10
+        phase1 = _Phase1(phase1bs=[{0: phase1b}], phase1b_acceptors=set(),
+                         pending_batches=[], resend_phase1as=None)
+        values = leader._recover_values(phase1, 17)
+        assert values == [self._v("b%d" % i) for i in range(3)] \
+            + [self._v("a%d" % i) for i in range(3, 8)]
+        assert NOOP not in values
+
+    def test_truncation_tail_collides_with_existing_run(self):
+        """When the tail's start already holds a run record, the tail
+        spills into the per-slot store instead of clobbering it; Phase1b
+        still reports the max-round vote for every slot."""
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            Phase2aRun,
+        )
+
+        sim = make_multipaxos(f=1, coalesced=True)
+        acceptor = sim.acceptors[0]
+        acceptor.receive("proxy-leader-0", Phase2aRun(
+            start_slot=14, round=1,
+            values=tuple(self._v("x%d" % i) for i in range(6))))
+        acceptor.receive("proxy-leader-0", Phase2aRun(
+            start_slot=10, round=2,
+            values=tuple(self._v("y%d" % i) for i in range(8))))
+        # Shorter same-start replacement: tail [14, 18) collides with
+        # the run record starting at 14.
+        acceptor.receive("proxy-leader-0", Phase2aRun(
+            start_slot=10, round=3,
+            values=tuple(self._v("z%d" % i) for i in range(4))))
+        self._info(acceptor, 4, 10)
+        sent = [m for m in sim.transport.messages if m.dst == "leader-1"]
+        phase1b = acceptor.serializer.from_bytes(sent[-1].data)
+        info = {i.slot: (i.vote_round, i.vote_value) for i in phase1b.info}
+        for i in range(4):
+            assert info[10 + i] == (3, self._v("z%d" % i))
+        for i in range(4, 8):  # spilled tail beats the round-1 run
+            assert info[10 + i] == (2, self._v("y%d" % i)), i
+        for slot in (18, 19):  # the round-1 run's own tail survives
+            assert info[slot] == (1, self._v("x%d" % (slot - 14)))
 
 
 def test_simulation_with_tpu_backend():

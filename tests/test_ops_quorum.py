@@ -232,3 +232,122 @@ def test_window_violation_intra_batch_and_rejected_block():
         checker2.record_block(1000, np.ones((3, 10), dtype=np.uint8))
     checker2.record_and_check([990], [0], [0])
     assert checker2.window_violations == 0
+
+
+# --- fused grid kernel ------------------------------------------------------
+# _spec_statics tags grid specs so every kernel (check_block,
+# record_block, record_and_check, check_batch) swaps the generic mask
+# matmul for the boolean reshape col-OR/row-AND (write) / col-AND/row-OR
+# (read) reduction. Bit-identity to the quorums/systems.py host oracle
+# is the contract.
+
+
+GRIDS = [
+    Grid([[0, 1, 2], [3, 4, 5]]),        # non-square 2x3
+    Grid([[0, 1], [2, 3], [4, 5]]),      # non-square 3x2
+    Grid([[0, 2, 4], [1, 3, 5]]),        # interleaved universe (perm)
+    Grid([[7, 8], [9, 10]]),             # square, offset ids
+]
+
+
+def test_spec_statics_detects_grids():
+    from frankenpaxos_tpu.ops.quorum import _spec_statics
+
+    for qs in GRIDS:
+        for spec in (qs.write_spec(), qs.read_spec()):
+            _, meta = _spec_statics(spec)
+            assert meta[2] is not None, (qs, spec.combine)
+    # Non-grid predicates keep the generic matmul...
+    for spec in (SimpleMajority(range(5)).write_spec(),
+                 UnanimousWrites(range(3)).read_spec()):
+        _, meta = _spec_statics(spec)
+        assert meta[2] is None
+    # ...except degenerate grids: UnanimousWrites' write spec (all n of
+    # one group, ANY) IS a 1xN grid-read predicate; detection keeps it
+    # bit-identical, so taking the fused path is correct.
+    spec = UnanimousWrites(range(3)).write_spec()
+    _, meta = _spec_statics(spec)
+    assert meta[2] == ("read", 1, 3, None)
+    checker = TpuQuorumChecker(spec, window=64)
+    blocks = np.array([[1, 1, 0], [1, 1, 1], [0, 0, 0], [1, 0, 1]],
+                      dtype=np.uint8)
+    np.testing.assert_array_equal(checker.check_batch(blocks),
+                                  spec.evaluate(blocks))
+
+
+@pytest.mark.parametrize("qs", GRIDS, ids=["2x3", "3x2", "perm", "2x2"])
+def test_fused_grid_check_block_matches_oracle(qs):
+    rng = np.random.default_rng(3)
+    for spec in (qs.write_spec(), qs.read_spec()):
+        checker = TpuQuorumChecker(spec, window=1 << 9)
+        for width in (1, 7, 64, 100):
+            block = (rng.random((spec.num_nodes, width)) < 0.5
+                     ).astype(np.uint8)
+            got = checker.check_block(block)
+            np.testing.assert_array_equal(got, spec.evaluate(block.T),
+                                          err_msg=f"{qs} {spec.combine}")
+
+
+@pytest.mark.parametrize("qs", GRIDS, ids=["2x3", "3x2", "perm", "2x2"])
+def test_fused_grid_record_paths_match_oracle(qs):
+    """The stateful dense + sparse paths under the fused predicate:
+    accumulated votes across drains report exactly what the host oracle
+    reports."""
+    rng = np.random.default_rng(7)
+    spec = qs.write_spec()
+    checker = TpuQuorumChecker(spec, window=1 << 9)
+    n = spec.num_nodes
+    host = np.zeros((n, 64), dtype=np.uint8)
+    chosen = np.zeros(64, dtype=bool)
+    for _ in range(6):
+        arrivals = (rng.random((n, 64)) < 0.3).astype(np.uint8)
+        newly = checker.record_block(0, arrivals)
+        host |= arrivals
+        hit = spec.evaluate(host.T)
+        expected_newly = hit & ~chosen
+        np.testing.assert_array_equal(newly, expected_newly)
+        chosen |= hit
+    # Sparse stragglers on top of the same board.
+    slots = rng.integers(0, 64, size=20)
+    nodes = rng.integers(0, n, size=20)
+    newly = checker.record_and_check(slots, nodes)
+    for s, node in zip(slots, nodes):
+        host[node, s] = 1
+    hit = spec.evaluate(host.T)
+    for i, s in enumerate(slots):
+        if newly[i]:
+            assert hit[s] and not chosen[s]
+
+
+def test_fused_grid_pipeline_step_matches_generic():
+    """bench/pipeline.steady_state_step commits identically with the
+    fused grid reduction and with the generic mask matmul (the fused
+    path forced off by patching detection)."""
+    import jax.numpy as jnp
+
+    import frankenpaxos_tpu.ops.quorum as quorum_ops
+    from frankenpaxos_tpu.bench.pipeline import make_state, steady_state_step
+
+    spec = Grid([[0, 1, 2], [3, 4, 5]]).write_spec()
+    masks, thresholds, combine_any = spec.as_arrays()
+
+    def run(patched):
+        orig = quorum_ops.grid_layout
+        if patched:
+            quorum_ops.grid_layout = lambda *a, **k: None
+        try:
+            state = make_state(1 << 9, 6)
+            for t in range(6):
+                state = steady_state_step(
+                    state, jnp.int32(t), block_size=1 << 7, masks=masks,
+                    thresholds=thresholds, combine_any=combine_any)
+        finally:
+            quorum_ops.grid_layout = orig
+        return state
+
+    fused, generic = run(False), run(True)
+    assert int(fused.committed) == int(generic.committed) > 0
+    np.testing.assert_array_equal(np.asarray(fused.chosen),
+                                  np.asarray(generic.chosen))
+    np.testing.assert_array_equal(np.asarray(fused.sm_state),
+                                  np.asarray(generic.sm_state))
