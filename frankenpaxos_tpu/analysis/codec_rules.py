@@ -36,7 +36,11 @@ RULES = {
     "COD302": "codec encode/decode field sets disagree with the message",
 }
 
-_SEND_NAMES = frozenset({"send", "send_no_flush", "broadcast"})
+#: ``_wal_send`` is the durable roles' deferred-send alias (held for
+#: the drain's group commit, then sent): messages routed through it
+#: still cross the wire, so COD301 exhaustiveness must see them.
+_SEND_NAMES = frozenset({"send", "send_no_flush", "broadcast",
+                         "_wal_send"})
 
 
 def _is_dataclass(cls: ast.ClassDef) -> bool:

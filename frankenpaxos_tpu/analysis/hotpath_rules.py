@@ -56,7 +56,16 @@ RULES = {
     "TPU205": "Python `if` on a traced value in a jitted fn",
     "TPU206": "jit retrace hazard (nested jit / non-hashable static)",
     "TPU207": "Python loop over a traced shape in a jitted fn",
+    "TPU208": "blocking fsync/file I/O reachable from ops/ kernel code",
 }
+
+#: Call leaves that mean blocking file I/O (the WAL's group-commit
+#: surface): kernels must never reach them -- durability belongs to
+#: the actor loop's drain boundary (wal/log.py), never inside a device
+#: kernel where it would serialize the pipeline on disk latency.
+_FILE_IO_LEAVES = frozenset({
+    "open", "fsync", "fdatasync", "write_bytes", "write_text",
+})
 
 RUN_PIPELINE_MESSAGES = frozenset({
     "Phase2aRun", "Phase2bRange", "Phase2bVotes", "ChosenRun",
@@ -245,6 +254,29 @@ def check(project: Project):
                          f"{d} of the {src} dispatch blocks on the "
                          f"device in code {how}; fetch outside the "
                          f"drain (collector thread / flush timer)")
+
+    # TPU208: blocking file I/O reachable from ops/ KERNEL roots
+    # specifically (not from on_drain -- the WAL's one fsync per drain
+    # lives exactly there by design; the rule guards the kernels).
+    ops_roots = [ref for ref, reason in roots.items()
+                 if reason == "ops kernel"]
+    for ref, root in graph.reachable(ops_roots).items():
+        info = graph.funcs[ref]
+        mod = info.module
+        root_name = graph.funcs[root].qualname
+        how = (f"reachable from ops kernel {root_name}"
+               if ref != root else "an ops kernel")
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            if leaf in _FILE_IO_LEAVES:
+                flag("TPU208", mod, node, info.qualname, d,
+                     f"{d} is blocking file I/O in code {how}; WAL "
+                     f"I/O must stay on the actor loop's drain "
+                     f"boundary (wal/log.py group commit), never "
+                     f"inside kernel code")
 
     # Retrace / trace-coercion hazards in jitted functions, plus nested
     # jit in hot code (project-wide: kernels are hot by definition).

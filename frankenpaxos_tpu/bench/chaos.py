@@ -1,0 +1,54 @@
+"""Process-failure chaos for deployed clusters: SIGKILL + relaunch.
+
+The deployment twin of the sim's ``crash_restart`` command
+(SimTransport.crash + the harness restart): ``kill -9`` a role process
+mid-benchmark -- no SIGTERM grace, no flush, the real crash -- then
+relaunch it VERBATIM from the command ``deploy_suite.launch_roles``
+recorded (same ports, same ``--wal_dir``), so the role recovers from
+its WAL and rejoins the live cluster. With no wal_dir this demonstrates
+the pre-WAL failure mode instead: the role comes back amnesiac.
+
+Used by the deployed crash-restart test (tests/test_deployment.py) and
+the vldb20_reconfig sweep's kill-mid-reconfig event
+(bench/sweeps.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from frankenpaxos_tpu.bench.harness import BenchmarkDirectory, LocalHost
+
+
+def sigkill_role(bench: BenchmarkDirectory, label: str) -> None:
+    """``kill -9`` the role process for ``label`` and reap it."""
+    proc = bench.labeled_procs[label]
+    os.kill(proc.pid(), signal.SIGKILL)
+    proc.wait(timeout=10)
+
+
+def relaunch_role(bench: BenchmarkDirectory, label: str,
+                  host: "LocalHost | None" = None):
+    """Restart ``label`` with its recorded command. The old log moves
+    aside (``<label>.log.killed<N>``) so the relaunch does not destroy
+    the pre-kill evidence."""
+    cmd, env = bench.role_commands[label]
+    log = bench.abspath(f"{label}.log")
+    if os.path.exists(log):
+        n = 1
+        while os.path.exists(f"{log}.killed{n}"):
+            n += 1
+        os.replace(log, f"{log}.killed{n}")
+    return bench.popen(host or LocalHost(), label, cmd, env=env)
+
+
+def kill_restart_role(bench: BenchmarkDirectory, label: str,
+                      down_s: float = 0.5,
+                      host: "LocalHost | None" = None):
+    """SIGKILL ``label``, leave it dead for ``down_s`` (requests that
+    depended on it must ride resends), then relaunch it."""
+    sigkill_role(bench, label)
+    time.sleep(down_s)
+    return relaunch_role(bench, label, host=host)

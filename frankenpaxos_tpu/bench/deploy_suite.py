@@ -46,6 +46,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  prometheus: bool = False, supernode: bool = False,
                  profiled: bool = False,
                  ready_timeout_s: float = 120.0,
+                 wal_dir: "str | None" = None,
                  host=None) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
     until each reports it is listening.
@@ -70,6 +71,15 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     unchanged on shared filesystems; a RemoteHost with
     ``staging_dir``/``local_root`` set ships them for disjoint
     filesystems (see bench/remote.py).
+
+    ``wal_dir`` turns on per-role durability (``--wal_dir``, wal/):
+    WAL-capable roles log to <wal_dir>/<label> and recover on
+    relaunch -- the seam the chaos driver (bench/chaos.py) uses to
+    SIGKILL and resurrect roles mid-benchmark.
+
+    Every launched command is recorded in ``bench.role_commands`` so a
+    role can be relaunched verbatim (same ports, same wal_dir) after a
+    kill.
     """
     protocol = get_protocol(protocol_name)
     host = host or LocalHost()
@@ -103,6 +113,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
         launch_plan = [(role_name, index)
                        for role_name, role in protocol.roles.items()
                        for index in range(len(role.addresses(config)))]
+    bench.role_commands = {}
     for role_name, index in launch_plan:
         label = f"{role_name}_{index}"
         labels.append(label)
@@ -118,8 +129,11 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
             prometheus_ports[label] = free_port()
             cmd += ["--prometheus_port",
                     str(prometheus_ports[label])]
+        if wal_dir:
+            cmd += ["--wal_dir", wal_dir]
         for key, value in (overrides or {}).items():
             cmd.append(f"--options.{key}={value}")
+        bench.role_commands[label] = (cmd, env)
         bench.popen(host, label, cmd, env=env)
     bench.prometheus_ports = prometheus_ports
     if prometheus:
@@ -131,6 +145,25 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     try:
         pending = _wait_ready(bench, host, labels, ready_server,
                               ready_timeout_s)
+        if pending and type(host) is LocalHost:
+            # THE unified readiness retry (every deployment entry point
+            # -- smoke, benchmarks, LT suites, sweeps -- comes through
+            # here): a role that lost the startup scheduling lottery on
+            # a loaded host gets killed and relaunched VERBATIM (same
+            # ports, same wal_dir) once, with a fresh full deadline.
+            # Callers that want fresh ports on top of this (a stolen
+            # free_port) keep their own whole-placement retry.
+            for label in sorted(pending):
+                print(f"role {label} not ready after "
+                      f"{ready_timeout_s:.0f}s; relaunching it")
+                bench.labeled_procs[label].kill()
+                log = bench.abspath(f"{label}.log")
+                if os.path.exists(log):
+                    os.replace(log, f"{log}.attempt1")
+                cmd, cmd_env = bench.role_commands[label]
+                bench.popen(host, label, cmd, env=cmd_env)
+            pending = _wait_ready(bench, host, sorted(pending),
+                                  ready_server, ready_timeout_s)
     finally:
         if ready_server is not None:
             ready_server.close()

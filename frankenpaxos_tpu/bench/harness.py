@@ -100,6 +100,10 @@ class BenchmarkDirectory:
         # label -> /metrics port, filled by deploy_suite.launch_roles
         # when prometheus=True.
         self.prometheus_ports: dict[str, int] = {}
+        # label -> (cmd, env), filled by deploy_suite.launch_roles so a
+        # role can be relaunched verbatim (readiness retry, chaos
+        # driver).
+        self.role_commands: dict[str, tuple] = {}
 
     def abspath(self, name: str) -> str:
         return os.path.join(self.path, name)

@@ -69,6 +69,10 @@ class MultiPaxosInput:
     # Run every role under cProfile (bench/role_cost.py consumes the
     # dumps; the perf_util.py flamegraph-wrap analog).
     profiled: bool = False
+    # Durability root (wal/): acceptors/replicas log to
+    # <wal_dir>/<label> with one group-commit fsync per drain and
+    # recover on relaunch. None = the reference's in-memory behavior.
+    wal_dir: "str | None" = None
 
 
 def placement(input: MultiPaxosInput) -> dict:
@@ -146,7 +150,7 @@ def _launch_and_warm(bench: BenchmarkDirectory,
                  state_machine=input.state_machine,
                  overrides=overrides,
                  prometheus=input.prometheus, supernode=input.supernode,
-                 profiled=input.profiled,
+                 profiled=input.profiled, wal_dir=input.wal_dir,
                  # tpu role startup pre-compiles kernels over the
                  # device link, which takes minutes under contention.
                  ready_timeout_s=(120.0 if input.quorum_backend == "dict"

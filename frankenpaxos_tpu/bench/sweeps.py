@@ -49,6 +49,18 @@ from frankenpaxos_tpu.bench.harness import SuiteDirectory
 DEFAULT_POINTS = ((1, 2), (2, 5), (4, 5))
 
 
+def _add_stage_projection(row: dict, stats: dict) -> dict:
+    """Attach role_cpu_s/bottleneck_stage/projected_stage_speedup to a
+    sweep row (the ONE wiring of the shared projection helper; on this
+    1-CPU host wall-clock cannot show decoupling wins, so every family
+    carries the real-core projection instead)."""
+    from frankenpaxos_tpu.bench.harness import BenchmarkDirectory
+
+    row.update(BenchmarkDirectory.stage_projection(
+        stats.get("role_cpu_seconds") or {}))
+    return row
+
+
 def _lt_row(series: str, procs: int, loops: int, stats: dict) -> dict:
     row = {
         "series": series,
@@ -59,15 +71,7 @@ def _lt_row(series: str, procs: int, loops: int, stats: dict) -> dict:
         "latency_median_ms": stats.get("latency.median_ms"),
         "num_requests": stats.get("num_requests"),
     }
-    # Per-role CPU + the decoupling projection: on this one-core host
-    # decoupled and coupled modes timeshare one CPU, so the ablation
-    # figures cannot show wall-clock separation -- the parallelizable
-    # fraction is what the row can honestly assert.
-    from frankenpaxos_tpu.bench.harness import BenchmarkDirectory
-
-    row.update(BenchmarkDirectory.stage_projection(
-        stats.get("role_cpu_seconds") or {}))
-    return row
+    return _add_stage_projection(row, stats)
 
 
 def _protocol_series(suite, series: str, protocol: str, points,
@@ -215,7 +219,7 @@ def eurosys_fig4(suite: SuiteDirectory, points,
                     print(f"fig4 ({series}, {batch_size}) attempt "
                           f"{attempt} failed: {e}")
                     stats = {}
-            rows.append({
+            row = {
                 "series": series,
                 "batch_size": batch_size,
                 "num_clients": procs * loops,
@@ -223,7 +227,8 @@ def eurosys_fig4(suite: SuiteDirectory, points,
                     "start_throughput_1s.p90"),
                 "latency_median_ms": stats.get("latency.median_ms"),
                 "num_requests": stats.get("num_requests"),
-            })
+            }
+            rows.append(_add_stage_projection(row, stats))
             print(json.dumps(rows[-1]))
     return rows
 
@@ -266,7 +271,7 @@ def evelyn(suite: SuiteDirectory, points, duration_s: float) -> list:
                     print(f"evelyn ({num_replicas}, {read_fraction}) "
                           f"attempt {attempt} failed: {e}")
                     stats = {}
-            rows.append({
+            row = {
                 "series": f"replicas_{num_replicas}",
                 "num_replicas": num_replicas,
                 "read_fraction": read_fraction,
@@ -277,8 +282,22 @@ def evelyn(suite: SuiteDirectory, points, duration_s: float) -> list:
                 "throughput_p90_1s": stats.get(
                     "start_throughput_1s.p90"),
                 "latency_median_ms": stats.get("latency.median_ms"),
-            })
+            }
+            rows.append(_add_stage_projection(row, stats))
             print(json.dumps(rows[-1]))
+    # Shape caveat IN the artifact: on this 1-CPU host more replica
+    # processes timeshare one core, so replicas_4 can read SLOWER than
+    # replicas_2 -- the opposite of the paper's scaling claim. The
+    # stage_projection columns carry what real cores would do
+    # (projected_stage_speedup once each stage owns a core).
+    rows.append({
+        "series": "note",
+        "note": ("replicas_4 < replicas_2 inversions are 1-CPU "
+                 "contention (all role processes share one core); "
+                 "see role_cpu_s/bottleneck_stage/"
+                 "projected_stage_speedup for the real-core "
+                 "projection"),
+    })
     return rows
 
 
@@ -309,7 +328,7 @@ def skew(suite: SuiteDirectory, points, duration_s: float) -> list:
                     print(f"skew ({protocol}, {point_fraction}) attempt "
                           f"{attempt} failed: {e}")
                     stats = {}
-            rows.append({
+            row = {
                 "series": protocol,
                 "point_skew": point_fraction,
                 "num_clients": procs * loops,
@@ -317,7 +336,8 @@ def skew(suite: SuiteDirectory, points, duration_s: float) -> list:
                     "start_throughput_1s.p90"),
                 "latency_median_ms": stats.get("latency.median_ms"),
                 "num_requests": stats.get("num_requests"),
-            })
+            }
+            rows.append(_add_stage_projection(row, stats))
             print(json.dumps(rows[-1]))
     return rows
 
@@ -335,6 +355,7 @@ def plot_param_sweep(rows: list, path: str, x_key: str, title: str,
     fig, ax = plt.subplots(1, 1, figsize=(6.4, 4.8))
     markers = ("o-", "^-", "s-", "d-", "v-", "x-")
     i = 0
+    rows = [r for r in rows if r["series"] != "note"]  # metadata rows
     for series in dict.fromkeys(row["series"] for row in rows):
         pts = sorted((r for r in rows if r["series"] == series),
                      key=lambda r: r.get(x_key, 0))
@@ -368,11 +389,21 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
         core experiment.
       * horizontal: a chunk reconfiguration (Reconfigure chosen INTO
         the log, starting a new active chunk).
+      * PLUS one process-failure event per protocol: the chaos driver
+        SIGKILLs an acceptor mid-run (no relaunch -- these protocols
+        carry no WAL, so an amnesiac restart would be unsound; f=1
+        tolerates the dead acceptor and throughput recovers once
+        resends route around it).
+
+    Every event gets a generous post-event window so its
+    ``recovery_seconds`` is measured, not truncated by the end of the
+    run (VERDICT r5 item 6).
     """
     import sys
     import threading
     import time as _time
 
+    from frankenpaxos_tpu.bench.chaos import sigkill_role
     from frankenpaxos_tpu.bench.deploy_suite import (
         launch_roles,
         role_process_env,
@@ -384,8 +415,12 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
     from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
     from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
 
-    total_s = max(18.0, duration_s)
-    reconfig_at = [total_s * 0.35, total_s * 0.55, total_s * 0.75]
+    total_s = max(32.0, duration_s)
+    # 4 events, ~6s of recovery window each (the last before a 7s
+    # tail): 3 reconfigurations + the kill.
+    reconfig_at = [total_s * 0.25, total_s * 0.42, total_s * 0.60,
+                   total_s * 0.78]
+    KILL_EVENT = len(reconfig_at) - 1  # the 4th event is the SIGKILL
 
     def trigger_messages(protocol_name, config, k):
         if protocol_name == "matchmakermultipaxos":
@@ -456,11 +491,20 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
             try:
                 for k, at in enumerate(reconfig_at):
                     _time.sleep(max(0.0, t_start + at - _time.time()))
-                    for dst, message in trigger_messages(
-                            protocol_name, config, k):
-                        transport.send(transport.listen_address, dst,
-                                       DEFAULT_SERIALIZER.to_bytes(
-                                           message))
+                    if k == KILL_EVENT:
+                        # The chaos event: kill -9 the last acceptor
+                        # mid-run (the WAL chaos driver's kill
+                        # schedule applied to the reconfig bench).
+                        acceptors = sorted(
+                            label for label in bench.labeled_procs
+                            if label.startswith("acceptor_"))
+                        sigkill_role(bench, acceptors[-1])
+                    else:
+                        for dst, message in trigger_messages(
+                                protocol_name, config, k):
+                            transport.send(
+                                transport.listen_address, dst,
+                                DEFAULT_SERIALIZER.to_bytes(message))
                     fired.append(_time.time())
                 _time.sleep(0.5)  # let the last frame flush
             finally:
@@ -508,19 +552,31 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
             if reconfig_seconds else []
         steady = _st.median(pre) if pre else 0
         for k, rs in enumerate(reconfig_seconds):
+            window_end = (reconfig_seconds[k + 1]
+                          if k + 1 < len(reconfig_seconds)
+                          else int(total_s))
             window = [buckets.get(s, 0)
                       for s in range(rs, min(rs + 3, int(total_s)))]
             dip = min(window) if window else 0
+            # Recovery bounded by the event's own window (the next
+            # event or end of run): every event gets a measured value
+            # -- if throughput never returns to 80% of steady within
+            # its window, report the window length as the honest
+            # lower bound instead of an empty cell.
             recovery = next(
-                (s - rs for s in range(rs, int(total_s))
+                (s - rs for s in range(rs, window_end)
                  if buckets.get(s, 0) >= 0.8 * steady), None)
             rows.append({
                 "series": f"{protocol_name}_summary",
                 "second": rs,
                 "reconfig_index": k,
+                "event": ("kill_acceptor" if k == KILL_EVENT
+                          else "reconfigure"),
                 "steady_cmds_per_sec": steady,
                 "dip_cmds_per_sec": dip,
-                "recovery_seconds": recovery,
+                "recovery_seconds": (recovery if recovery is not None
+                                     else window_end - rs),
+                "recovery_is_lower_bound": recovery is None,
             })
         print(json.dumps([r for r in rows
                           if r["series"] == f"{protocol_name}_summary"]))

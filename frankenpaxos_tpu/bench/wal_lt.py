@@ -1,0 +1,297 @@
+"""WAL latency-throughput A/B: durability on vs off per drain width.
+
+The question the "Paxos in the Cloud" experience report raises
+(PAPERS.md): durable logging dominates Paxos unless writes are
+batched. The paxlog WAL batches by construction -- ONE fsync per
+event-loop drain (group commit at the on_drain boundary) -- so the
+per-message durability overhead should SHRINK as drain width grows.
+This bench measures exactly that, with the multipaxos_lt methodology:
+
+  * the interleaved paired SimTransport A/B of the full coalesced
+    actor pipeline (ClientRequestArray -> Phase2aRun -> Phase2bRange
+    -> ChosenRun -> ClientReplyArray) per in-flight width, arms
+    ``wal-off`` vs ``wal-on`` (FileStorage WALs, REAL fsyncs, a fresh
+    directory per run); per width, ``reps`` pairs with rotating order,
+    the MEDIAN of paired ratios, pooled over independent subprocess
+    batches;
+  * per-width WAL accounting from a dedicated instrumented run:
+    fsync count, fsyncs per command, bytes and records per drain
+    group commit, summed across every acceptor and replica;
+  * deployed TCP points (every role its own OS process, --wal_dir on
+    vs off) at small scales -- the multipaxos_lt deployed_points
+    shape.
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.wal_lt \
+        --out bench_results/wal_lt.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _drive_waves(sim, inflight: int, waves: int, tag: bytes,
+                 results: list) -> None:
+    """Closed-loop waves of ``inflight`` coalesced writes delivered at
+    event-loop drain granularity; pump recover/resend timers so holes
+    never stall a wave (the mencius_lt driver shape)."""
+    for b in range(waves):
+        for p in range(inflight):
+            sim.clients[0].write(p, b"%s%d.%d" % (tag, b, p),
+                                 results.append)
+        sim.clients[0].flush_writes()
+        sim.transport.deliver_all_coalesced()
+        for _ in range(60):
+            if not sim.clients[0].states:
+                break
+            for timer in sim.transport.running_timers():
+                if timer.name == "recover" \
+                        or timer.name.startswith("resendWrite"):
+                    sim.transport.trigger_timer(timer.id)
+            sim.transport.deliver_all_coalesced()
+
+
+def _make(arm: str, tmp_root: str):
+    from tests.protocols.multipaxos_harness import make_multipaxos
+
+    if arm == "wal-off":
+        return make_multipaxos(f=1, coalesced=True), None
+    wal_dir = tempfile.mkdtemp(dir=tmp_root, prefix="walarm_")
+    return make_multipaxos(f=1, coalesced=True, wal=wal_dir), wal_dir
+
+
+def wal_accounting(sim) -> dict:
+    """Summed WAL metrics across every durable role."""
+    roles = [a for a in sim.acceptors if a.wal is not None] \
+        + [r for r in sim.replicas if r.wal is not None]
+    total = {
+        "fsyncs": sum(r.wal.metrics.syncs for r in roles),
+        "bytes_synced": sum(r.wal.metrics.bytes_synced for r in roles),
+        "records_synced": sum(r.wal.metrics.records_synced
+                              for r in roles),
+        "compactions": sum(r.wal.metrics.compactions for r in roles),
+    }
+    if total["fsyncs"]:
+        total["bytes_per_drain_sync"] = round(
+            total["bytes_synced"] / total["fsyncs"], 1)
+        total["records_per_drain_sync"] = round(
+            total["records_synced"] / total["fsyncs"], 2)
+    return total
+
+
+def sim_ab_pipeline(inflights, reps: int = 6, waves: int = 0,
+                    warm: int = 2) -> dict:
+    """Interleaved paired A/B (multipaxos_lt.sim_ab_pipeline
+    methodology) of wal-on (real fsyncs) vs wal-off."""
+    import gc
+    import statistics
+
+    tmp_root = tempfile.mkdtemp(prefix="fpx_wal_lt_")
+    ARMS = ("wal-off", "wal-on")
+
+    def measure(arm: str, inflight: int, w: int) -> float:
+        gc.collect()
+        sim, wal_dir = _make(arm, tmp_root)
+        results: list = []
+        _drive_waves(sim, inflight, warm, b"w", results)
+        t0 = time.perf_counter()
+        _drive_waves(sim, inflight, w, b"x", results)
+        elapsed = time.perf_counter() - t0
+        assert len(results) == (warm + w) * inflight, (
+            arm, inflight, len(results))
+        for role in sim.acceptors + sim.replicas:
+            if role.wal is not None:
+                role.wal.close()
+        if wal_dir is not None:
+            shutil.rmtree(wal_dir, ignore_errors=True)
+        return w * inflight / elapsed
+
+    table = {}
+    for inflight in inflights:
+        w = waves or max(8 if inflight >= 1024 else 16, 256 // inflight)
+        runs: dict[str, list] = {arm: [] for arm in ARMS}
+        ratios: list = []
+        for rep in range(reps):
+            rot = list(ARMS[rep % 2:]) + list(ARMS[:rep % 2])
+            got = {arm: measure(arm, inflight, w) for arm in rot}
+            for arm in ARMS:
+                runs[arm].append(got[arm])
+            ratios.append(got["wal-on"] / got["wal-off"])
+        # One instrumented wal-on run for the fsync accounting (not
+        # timed against the A/B).
+        sim, wal_dir = _make("wal-on", tmp_root)
+        results: list = []
+        _drive_waves(sim, inflight, w, b"a", results)
+        acct = wal_accounting(sim)
+        acct["commands"] = len(results)
+        if acct["fsyncs"]:
+            acct["fsyncs_per_command"] = round(
+                acct["fsyncs"] / len(results), 4)
+        for role in sim.acceptors + sim.replicas:
+            if role.wal is not None:
+                role.wal.close()
+        if wal_dir is not None:
+            shutil.rmtree(wal_dir, ignore_errors=True)
+        table[str(inflight)] = {
+            "wal_off_cmds_per_sec": round(
+                statistics.median(runs["wal-off"]), 1),
+            "wal_on_cmds_per_sec": round(
+                statistics.median(runs["wal-on"]), 1),
+            "wal_on_over_off_ratio": round(statistics.median(ratios), 3),
+            "ratio_range": [round(min(ratios), 3), round(max(ratios), 3)],
+            "wal_accounting": acct,
+        }
+    shutil.rmtree(tmp_root, ignore_errors=True)
+    return table
+
+
+def deployed_points(suite, scales, duration_s: float) -> list:
+    """Deployed TCP A/B (--wal_dir on vs off), the multipaxos_lt
+    deployed_points shape."""
+    from frankenpaxos_tpu.bench.multipaxos_suite import (
+        MultiPaxosInput,
+        run_benchmark,
+    )
+
+    points = []
+    for arm in ("wal-off", "wal-on"):
+        for procs, loops in scales:
+            bench = suite.benchmark_directory()
+            wal_root = (tempfile.mkdtemp(prefix="fpx_wal_dep_")
+                        if arm == "wal-on" else None)
+            try:
+                stats = run_benchmark(bench, MultiPaxosInput(
+                    duration_s=duration_s, num_clients=loops,
+                    client_procs=procs, coalesced=True,
+                    wal_dir=wal_root))
+            except (RuntimeError, subprocess.TimeoutExpired) as e:
+                points.append({"arm": arm, "client_procs": procs,
+                               "loops_per_proc": loops,
+                               "error": str(e)[-300:]})
+                continue
+            finally:
+                if wal_root:
+                    shutil.rmtree(wal_root, ignore_errors=True)
+            point = {
+                "arm": arm,
+                "client_procs": procs,
+                "loops_per_proc": loops,
+                "duration_s": duration_s,
+                "throughput_p90_1s": stats.get("start_throughput_1s.p90"),
+                "latency_median_ms": stats.get("latency.median_ms"),
+                "latency_p99_ms": stats.get("latency.p99_ms"),
+                "num_requests": stats.get("num_requests"),
+            }
+            points.append(point)
+            print(json.dumps(point))
+    return points
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--scales", type=str, default="1x5,2x10")
+    parser.add_argument("--sim_inflight", type=str,
+                        default="1,16,256,1024")
+    parser.add_argument("--sim_repeats", type=int, default=4)
+    parser.add_argument("--sim_ab_batches", type=int, default=3)
+    parser.add_argument("--skip_deployed", action="store_true")
+    parser.add_argument("--suite_dir", default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    from frankenpaxos_tpu.bench.deploy_suite import role_process_env
+    from frankenpaxos_tpu.bench.harness import SuiteDirectory
+
+    root = args.suite_dir or tempfile.mkdtemp(prefix="fpx_wlt_")
+    suite = SuiteDirectory(root, "wal_lt")
+    scales = []
+    for part in args.scales.split(","):
+        procs, loops = part.lower().split("x")
+        scales.append((int(procs), int(loops)))
+
+    points = []
+    if not args.skip_deployed:
+        points = deployed_points(suite, scales, args.duration)
+
+    import statistics as _stats
+
+    inflights = [int(x) for x in args.sim_inflight.split(",")]
+    per_width: dict = {str(i): [] for i in inflights}
+    for _batch in range(args.sim_ab_batches):
+        ab = subprocess.run(
+            [sys.executable, "-c",
+             "import json; from frankenpaxos_tpu.bench.wal_lt import "
+             "sim_ab_pipeline; "
+             f"print(json.dumps(sim_ab_pipeline({inflights!r}, "
+             f"reps={args.sim_repeats})))"],
+            capture_output=True, text=True, env=role_process_env(),
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        if ab.returncode != 0:
+            print(f"sim A/B batch failed (rc={ab.returncode}): "
+                  f"{ab.stderr[-500:]}", file=sys.stderr)
+            continue
+        out = json.loads(ab.stdout.strip().splitlines()[-1])
+        print(json.dumps({"sim_ab_batch": out}))
+        for key, row in out.items():
+            per_width[key].append(row)
+    sim_ab = {}
+    for key, rows in per_width.items():
+        if not rows:
+            continue
+        ratios = [r["wal_on_over_off_ratio"] for r in rows]
+        sim_ab[key] = {
+            "wal_on_over_off_ratio": round(_stats.median(ratios), 3),
+            "ratio_range": [min(r["ratio_range"][0] for r in rows),
+                            max(r["ratio_range"][1] for r in rows)],
+            "wal_off_cmds_per_sec_med": round(_stats.median(
+                r["wal_off_cmds_per_sec"] for r in rows), 1),
+            "wal_on_cmds_per_sec_med": round(_stats.median(
+                r["wal_on_cmds_per_sec"] for r in rows), 1),
+            "wal_accounting": rows[0]["wal_accounting"],
+            "batches": len(rows),
+        }
+
+    result = {
+        "benchmark": "wal_lt",
+        "host_cpus": os.cpu_count(),
+        "duration_s": args.duration,
+        "deployed_points": points,
+        "sim_ab_pipeline": sim_ab,
+        "sim_ab_methodology": (
+            "per-width ratio = median over independent subprocess "
+            "batches of each batch's paired-A/B median (the "
+            "multipaxos_lt/mencius_lt sim_ab methodology); arms are "
+            "wal-off (reference in-memory) vs wal-on (FileStorage "
+            "WALs on every acceptor+replica, ONE group-commit fsync "
+            "per event-loop drain, fresh directories per run); "
+            "wal_accounting comes from a separate instrumented wal-on "
+            "run per width"),
+        "note": (
+            "Group-commit amortization: per-message durability "
+            "overhead (1 - ratio) should SHRINK as drain width grows "
+            "because a drain of k messages shares one fsync -- "
+            "fsyncs_per_command falls with width while "
+            "records_per_drain_sync rises. Deployed points run every "
+            "role as its own OS process over localhost TCP with "
+            "--wal_dir on vs off."),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()

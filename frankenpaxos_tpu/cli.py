@@ -69,6 +69,13 @@ def main(argv=None) -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--prometheus_port", type=int, default=0,
                         help="0 disables the metrics endpoint")
+    parser.add_argument("--wal_dir", default=None,
+                        help="durability root (wal/): WAL-capable roles "
+                             "write a per-role write-ahead log under "
+                             "<wal_dir>/<role>_<index> and recover from "
+                             "it on startup, so a SIGKILL'd role "
+                             "relaunched with the same wal_dir rejoins "
+                             "with its state intact")
     parser.add_argument("--ready_addr", default=None,
                         help="host:port the launcher listens on for the "
                              "wait-for-listen handshake: once this role "
@@ -136,7 +143,7 @@ def main(argv=None) -> None:
     ctx = DeployCtx(config=config, transport=transport, logger=logger,
                     overrides=overrides, seed=args.seed,
                     state_machine=args.state_machine,
-                    collectors=collectors)
+                    collectors=collectors, wal_dir=args.wal_dir)
 
     def make_instrumented(role, role_name, role_address, index):
         """Construct the role actor and, when metrics are on, wrap its

@@ -81,12 +81,27 @@ class DeployCtx:
     seed: int = 0
     state_machine: str = "AppendLog"
     collectors: Any = None  # monitoring.Collectors; None -> fakes
+    # Durability root (--wal_dir): when set, WAL-capable roles get a
+    # Wal over FileStorage at <wal_dir>/<label> and recover from it on
+    # construction -- a SIGKILL'd role relaunched with the same
+    # wal_dir rejoins with its promises/votes/SM state intact.
+    wal_dir: Any = None
     consumed: set = dataclasses.field(default_factory=set)
 
     def sm(self):
         from frankenpaxos_tpu.statemachine import state_machine_by_name
 
         return state_machine_by_name(self.state_machine)
+
+    def wal(self, label: str):
+        """A per-role Wal (or None when durability is off)."""
+        if not self.wal_dir:
+            return None
+        import os
+
+        from frankenpaxos_tpu.wal import FileStorage, Wal
+
+        return Wal(FileStorage(os.path.join(self.wal_dir, label)))
 
     def kw(self, fn) -> dict:
         out = ctor_kwargs(fn, self.overrides)
@@ -366,13 +381,15 @@ def _multipaxos() -> Protocol:
                 lambda ctx, a, i: mp.Acceptor(
                     a, ctx.transport, ctx.logger, ctx.config,
                     ctx.opts(mp.AcceptorOptions),
-                    collectors=ctx.collectors)),
+                    collectors=ctx.collectors,
+                    wal=ctx.wal(f"acceptor_{i}"))),
             "replica": Role(
                 lambda c: list(c.replica_addresses),
                 lambda ctx, a, i: mp.Replica(
                     a, ctx.transport, ctx.logger, ctx.sm(), ctx.config,
                     ctx.opts(mp.ReplicaOptions), seed=ctx.seed,
-                    collectors=ctx.collectors)),
+                    collectors=ctx.collectors,
+                    wal=ctx.wal(f"replica_{i}"))),
             "proxy_replica": Role(
                 lambda c: list(c.proxy_replica_addresses),
                 lambda ctx, a, i: mp.ProxyReplica(
@@ -455,12 +472,14 @@ def _mencius() -> Protocol:
             "acceptor": Role(
                 flat_acceptors,
                 lambda ctx, a, i: m.MenciusAcceptor(
-                    a, ctx.transport, ctx.logger, ctx.config)),
+                    a, ctx.transport, ctx.logger, ctx.config,
+                    wal=ctx.wal(f"acceptor_{i}"))),
             "replica": Role(
                 lambda c: list(c.replica_addresses),
                 lambda ctx, a, i: m.MenciusReplica(
                     a, ctx.transport, ctx.logger, ctx.sm(), ctx.config,
-                    seed=ctx.seed, **ctx.kw(m.MenciusReplica))),
+                    seed=ctx.seed, wal=ctx.wal(f"replica_{i}"),
+                    **ctx.kw(m.MenciusReplica))),
             "proxy_replica": Role(
                 lambda c: list(c.proxy_replica_addresses),
                 lambda ctx, a, i: m.MenciusProxyReplica(

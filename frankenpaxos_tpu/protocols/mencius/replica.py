@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import struct
 from typing import Callable, Optional
 
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
@@ -17,6 +18,20 @@ from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
 from frankenpaxos_tpu.utils import BufferMap
+from frankenpaxos_tpu.wal import (
+    DurableRole,
+    WalChosenRun,
+    WalNoopRange,
+    WalSnapshot,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+    decode_value_array,
+    encode_value_array,
+)
 from frankenpaxos_tpu.protocols.mencius.common import (
     Chosen,
     ChosenNoopRange,
@@ -40,14 +55,15 @@ from frankenpaxos_tpu.protocols.mencius.common import (
 )
 
 
-class MenciusReplica(Actor):
+class MenciusReplica(Actor, DurableRole):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, state_machine: StateMachine,
                  config: MenciusConfig, log_grow_size: int = 5000,
                  send_chosen_watermark_every_n: int = 100,
                  recover_min_period_s: float = 5.0,
                  recover_max_period_s: float = 10.0,
-                 unsafe_dont_recover: bool = False, seed: int = 0):
+                 unsafe_dont_recover: bool = False, seed: int = 0,
+                 wal=None):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
@@ -56,18 +72,122 @@ class MenciusReplica(Actor):
         self.send_chosen_watermark_every_n = send_chosen_watermark_every_n
         self.index = list(config.replica_addresses).index(address)
         self.slot_system = ClassicRoundRobin(config.num_leader_groups)
+        self.log_grow_size = log_grow_size
         self.log: BufferMap = BufferMap(log_grow_size)
         self.executed_watermark = 0
         self.num_chosen = 0
         self.high_watermark = -1
         self.client_table: dict[tuple, tuple[int, bytes]] = {}
         self.recovering_slot: Optional[int] = None
+        # Durability (wal/): the multipaxos replica's group-commit
+        # contract, strided (see protocols/multipaxos/replica.py).
+        self._wal_init(wal)
         self.recover_timer = None
+        if wal is not None:
+            self._recover_from_wal()
         if not unsafe_dont_recover:
             self.recover_timer = self.timer(
                 "recover",
                 self.rng.uniform(recover_min_period_s, recover_max_period_s),
                 self._recover)
+            if wal is not None and self.executed_watermark < self.num_chosen:
+                self.recovering_slot = self.executed_watermark
+                self.recover_timer.start()
+
+    # --- durability -------------------------------------------------------
+    def _snapshot_payload(self) -> bytes:
+        out = bytearray()
+        out += struct.pack("<qq", self.executed_watermark,
+                           self.high_watermark)
+        _put_bytes(out, self.state_machine.to_bytes())
+        out += struct.pack("<i", len(self.client_table))
+        for (address, pseudonym), (client_id, result) in \
+                self.client_table.items():
+            _put_address(out, address)
+            out += struct.pack("<qq", pseudonym, client_id)
+            _put_bytes(out, result)
+        return bytes(out)
+
+    def _restore_snapshot(self, payload: bytes) -> None:
+        watermark, high = struct.unpack_from("<qq", payload, 0)
+        sm_bytes, at = _take_bytes(payload, 16)
+        (n,) = struct.unpack_from("<i", payload, at)
+        at += 4
+        table: dict = {}
+        for _ in range(n):
+            address, at = _take_address(payload, at)
+            pseudonym, client_id = struct.unpack_from("<qq", payload, at)
+            result, at = _take_bytes(payload, at + 16)
+            table[(address, pseudonym)] = (client_id, result)
+        self.state_machine.from_bytes(sm_bytes)
+        self.executed_watermark = watermark
+        self.num_chosen = watermark
+        self.high_watermark = high
+        self.client_table = table
+        self.log.garbage_collect(watermark)
+
+    def _recover_from_wal(self) -> None:
+        for record in self.wal.recover(self.logger):
+            if isinstance(record, WalSnapshot):
+                self.log = BufferMap(self.log_grow_size)
+                self.executed_watermark = 0
+                self.num_chosen = 0
+                self.high_watermark = -1
+                self.client_table = {}
+                self._restore_snapshot(record.payload)
+            elif isinstance(record, WalChosenRun):
+                self._log_chosen(
+                    record.start_slot, record.stride,
+                    decode_value_array(record.values))
+            elif isinstance(record, WalNoopRange):
+                self._log_noop_range(record.slot_start_inclusive,
+                                     record.slot_end_exclusive)
+            else:
+                self.logger.fatal(
+                    f"unexpected replica WAL record {record!r}")
+        self._execute_log()  # replies discarded; clients resend
+
+    def _log_chosen(self, start_slot: int, stride: int, values) -> int:
+        """Put a strided run of chosen values into the log (slots below
+        the executed watermark are duplicates by definition); returns
+        how many were new. Shared by the live handlers and WAL
+        replay."""
+        new = 0
+        slot = start_slot
+        for value in values:
+            if slot >= self.executed_watermark \
+                    and self.log.get(slot) is None:
+                self.log.put(slot, value)
+                new += 1
+                self.high_watermark = max(self.high_watermark, slot)
+            slot += stride
+        self.num_chosen += new
+        return new
+
+    def _log_noop_range(self, start_inclusive: int,
+                        end_exclusive: int) -> int:
+        new = 0
+        for slot in range(start_inclusive, end_exclusive,
+                          self.config.num_leader_groups):
+            if slot >= self.executed_watermark \
+                    and self.log.get(slot) is None:
+                self.log.put(slot, Noop())
+                new += 1
+        self.num_chosen += new
+        return new
+
+    def _wal_compact(self) -> None:
+        records = []
+        for slot, value in self.log.items(start=self.executed_watermark):
+            records.append(WalChosenRun(
+                start_slot=slot, stride=1,
+                values=encode_value_array((value,))))
+        self.wal.compact(WalSnapshot(payload=self._snapshot_payload()),
+                         records)
+        self.log.garbage_collect(self.executed_watermark)
+
+    def on_drain(self) -> None:
+        self._wal_drain()  # group commit, then release the held replies
 
     def _proxy_replica(self) -> Optional[Address]:
         if not self.config.proxy_replica_addresses:
@@ -129,18 +249,19 @@ class MenciusReplica(Actor):
                 watermark = ChosenWatermark(slot=self.executed_watermark)
                 proxy = self._proxy_replica()
                 if proxy is not None:
-                    self.send(proxy, watermark)
+                    self._wal_send(proxy, watermark)
                 else:
                     for group in self.config.leader_addresses:
                         for leader in group:
-                            self.send(leader, watermark)
+                            self._wal_send(leader, watermark)
 
     def _after_choose(self, coalesce_replies: bool = False) -> None:
         replies = self._execute_log()
         if replies:
             proxy = self._proxy_replica()
             if proxy is not None:
-                self.send(proxy, ClientReplyBatch(batch=tuple(replies)))
+                self._wal_send(proxy,
+                               ClientReplyBatch(batch=tuple(replies)))
             elif coalesce_replies and len(replies) > 1:
                 # Run-pipeline drains ship each client ONE reply array
                 # instead of one ClientReply per command.
@@ -151,11 +272,11 @@ class MenciusReplica(Actor):
                         (cid.client_pseudonym, cid.client_id, r.slot,
                          r.result))
                 for address, entries in by_client.items():
-                    self.send(address,
-                              ClientReplyArray(entries=tuple(entries)))
+                    self._wal_send(address,
+                                   ClientReplyArray(entries=tuple(entries)))
             else:
                 for reply in replies:
-                    self.send(reply.command_id.client_address, reply)
+                    self._wal_send(reply.command_id.client_address, reply)
         # Hole-recovery timer management (Replica.scala:432-462).
         if self.recover_timer is None:
             return
@@ -173,21 +294,23 @@ class MenciusReplica(Actor):
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, Chosen):
-            if self.log.get(message.slot) is not None:
+            if self._log_chosen(message.slot, 1, (message.value,)) == 0:
                 return
-            self.log.put(message.slot, message.value)
-            self.num_chosen += 1
-            self.high_watermark = max(self.high_watermark, message.slot)
+            if self.wal is not None:
+                self.wal.append(WalChosenRun(
+                    start_slot=message.slot, stride=1,
+                    values=encode_value_array((message.value,))))
             self._after_choose()
         elif isinstance(message, ChosenRun):
             self._handle_chosen_run(message)
         elif isinstance(message, ChosenNoopRange):
-            for slot in range(message.slot_start_inclusive,
-                              message.slot_end_exclusive,
-                              self.config.num_leader_groups):
-                if self.log.get(slot) is None:
-                    self.log.put(slot, Noop())
-                    self.num_chosen += 1
+            new = self._log_noop_range(message.slot_start_inclusive,
+                                       message.slot_end_exclusive)
+            if new and self.wal is not None:
+                self.wal.append(WalNoopRange(
+                    slot_start_inclusive=message.slot_start_inclusive,
+                    slot_end_exclusive=message.slot_end_exclusive,
+                    round=0))
             self._after_choose()
         else:
             self.logger.fatal(f"unexpected replica message {message!r}")
@@ -195,17 +318,23 @@ class MenciusReplica(Actor):
     def _handle_chosen_run(self, run: ChosenRun) -> None:
         """A strided drain of chosen values in one message: log the
         whole run, execute once, coalesce replies per client."""
-        new = 0
-        slot = run.start_slot
-        for value in run.values:
-            if self.log.get(slot) is None:
-                self.log.put(slot, value)
-                new += 1
-                self.high_watermark = max(self.high_watermark, slot)
-            slot += run.stride
+        new = self._log_chosen(run.start_slot, run.stride, run.values)
         if new == 0:
             return
-        self.num_chosen += new
+        if self.wal is not None:
+            if new == len(run.values):
+                # The common case logs the inbound lazy value segment
+                # as a raw copy.
+                self.wal.append(WalChosenRun(
+                    start_slot=run.start_slot, stride=run.stride,
+                    values=encode_value_array(run.values)))
+            else:
+                for i, value in enumerate(run.values):
+                    slot = run.start_slot + i * run.stride
+                    if self.log.get(slot) is value:
+                        self.wal.append(WalChosenRun(
+                            start_slot=slot, stride=1,
+                            values=encode_value_array((value,))))
         self._after_choose(coalesce_replies=True)
 
 

@@ -18,6 +18,20 @@ from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.wal import (
+    DurableRole,
+    WalNoopRange,
+    WalPromise,
+    WalSnapshot,
+    WalVote,
+    WalVoteRun,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    decode_value,
+    decode_value_array,
+    encode_value,
+    encode_value_array,
+)
 from frankenpaxos_tpu.protocols.mencius.common import (
     NOOP,
     Chosen,
@@ -332,8 +346,16 @@ class MenciusLeader(Actor):
             self.send(self._proxy_leader(),
                       Phase2a(slot=slot, round=self.round,
                               value=self._safe_value(group.values(), slot)))
+        # next_slot must clear the chosen watermark as well as the
+        # voted max: Phase1bs report nothing below the watermark (all
+        # chosen -- e.g. a predecessor's ChosenNoopRange), so with no
+        # votes above it this would re-propose a pending command into
+        # an already-Noop-chosen slot -- a second chosen value (found
+        # by the WAL chaos soak's partition + leader-churn schedules).
+        # Chosen slots >= the watermark are covered by quorum
+        # intersection: some Phase1b carries their vote.
         self.next_slot = self.slot_system.next_classic_round(
-            self.group_index, max_slot)
+            self.group_index, max(max_slot, self.chosen_watermark - 1))
         phase1.resend_phase1as.stop()
         self.state = ("phase2",)
         for batch in phase1.pending_batches:
@@ -581,11 +603,11 @@ class _VoteState:
     vote_value: object
 
 
-class MenciusAcceptor(Actor):
+class MenciusAcceptor(Actor, DurableRole):
     """(mencius/Acceptor.scala:103-300)."""
 
     def __init__(self, address: Address, transport: Transport,
-                 logger: Logger, config: MenciusConfig):
+                 logger: Logger, config: MenciusConfig, wal=None):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
@@ -607,6 +629,59 @@ class MenciusAcceptor(Actor):
         # max-round resolution exact.
         self._voted_runs: SortedDict = SortedDict()
         self.max_voted_slot = -1
+        # Durability (wal/): the multipaxos acceptor's group-commit
+        # contract, strided -- promises/votes/runs/noop-ranges append
+        # to the WAL and every dependent ack holds back until
+        # on_drain's single fsync releases it (DurableRole).
+        self._wal_init(wal)
+        if wal is not None:
+            self._recover_from_wal()
+
+    # --- durability -------------------------------------------------------
+    def _recover_from_wal(self) -> None:
+        for record in self.wal.recover(self.logger):
+            if isinstance(record, WalSnapshot):
+                self.round = -1
+                self.states.clear()
+                self._voted_runs.clear()
+                self.max_voted_slot = -1
+            elif isinstance(record, WalPromise):
+                self.round = max(self.round, record.round)
+            elif isinstance(record, WalVote):
+                self.round = max(self.round, record.round)
+                self.states[record.slot] = _VoteState(
+                    record.round, decode_value(record.value))
+                self.max_voted_slot = max(self.max_voted_slot,
+                                          record.slot)
+            elif isinstance(record, WalVoteRun):
+                self.round = max(self.round, record.round)
+                self._store_run(record.start_slot, record.stride,
+                                record.round,
+                                decode_value_array(record.values))
+            elif isinstance(record, WalNoopRange):
+                self.round = max(self.round, record.round)
+                self._store_noop_range(record.slot_start_inclusive,
+                                       record.slot_end_exclusive,
+                                       record.round)
+            else:
+                self.logger.fatal(
+                    f"unexpected acceptor WAL record {record!r}")
+
+    def _wal_compact(self) -> None:
+        records = [WalPromise(round=self.round)]
+        for start, (count, stride, rnd, values) in \
+                self._voted_runs.items():
+            records.append(WalVoteRun(
+                start_slot=start, stride=stride, round=rnd,
+                values=encode_value_array(values)))
+        for slot, vs in self.states.items():
+            records.append(WalVote(
+                slot=slot, round=vs.vote_round,
+                value=encode_value(vs.vote_value)))
+        self.wal.compact(WalSnapshot(payload=b""), records)
+
+    def on_drain(self) -> None:
+        self._wal_drain()  # group commit, then release the held acks
 
     def _nack_leader(self, round: int, slot: int) -> Address:
         return self.config.leader_addresses[self.slot_system.leader(slot)][
@@ -628,12 +703,14 @@ class MenciusAcceptor(Actor):
         if phase1a.round < self.round:
             self.send(src, Nack(round=self.round))
             return
+        if self.wal is not None and phase1a.round > self.round:
+            self.wal.append(WalPromise(round=phase1a.round))
         self.round = phase1a.round
-        self.send(src, Phase1b(group_index=self.acceptor_group_index,
-                               acceptor_index=self.index,
-                               round=self.round,
-                               info=self._voted_info(
-                                   phase1a.chosen_watermark)))
+        self._wal_send(src, Phase1b(group_index=self.acceptor_group_index,
+                                    acceptor_index=self.index,
+                                    round=self.round,
+                                    info=self._voted_info(
+                                        phase1a.chosen_watermark)))
 
     def _voted_info(self, minimum: int) -> tuple:
         """Every voted slot >= ``minimum`` with its HIGHEST-round vote,
@@ -668,9 +745,13 @@ class MenciusAcceptor(Actor):
         self.round = phase2a.round
         self.states[phase2a.slot] = _VoteState(self.round, phase2a.value)
         self.max_voted_slot = max(self.max_voted_slot, phase2a.slot)
-        self.send(src, Phase2b(group_index=self.acceptor_group_index,
-                               acceptor_index=self.index,
-                               slot=phase2a.slot, round=self.round))
+        if self.wal is not None:
+            self.wal.append(WalVote(
+                slot=phase2a.slot, round=self.round,
+                value=encode_value(phase2a.value)))
+        self._wal_send(src, Phase2b(group_index=self.acceptor_group_index,
+                                    acceptor_index=self.index,
+                                    slot=phase2a.slot, round=self.round))
 
     def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
         """A whole strided proposal run in one O(1) update: one round
@@ -681,34 +762,49 @@ class MenciusAcceptor(Actor):
                       Nack(round=self.round))
             return
         self.round = run.round
-        count = len(run.values)
-        old = self._voted_runs.get(run.start_slot)
-        self._voted_runs[run.start_slot] = (count, run.stride, run.round,
-                                            run.values)
-        if old is not None and old[1] == run.stride and old[0] > count:
+        count = self._store_run(run.start_slot, run.stride, run.round,
+                                run.values)
+        if self.wal is not None:
+            # A raw copy of the inbound lazy value segment, never a
+            # re-materialization.
+            self.wal.append(WalVoteRun(
+                start_slot=run.start_slot, stride=run.stride,
+                round=run.round,
+                values=encode_value_array(run.values)))
+        self._wal_send(src, Phase2bRun(
+            acceptor_group_index=self.acceptor_group_index,
+            acceptor_index=self.index, start_slot=run.start_slot,
+            count=count, stride=run.stride, round=run.round))
+
+    def _store_run(self, start_slot: int, stride: int, round: int,
+                   values) -> int:
+        """Merge one strided voted run into the run store; returns the
+        run's count. Shared by the live Phase2aRun handler and WAL
+        replay so truncation-tail semantics cannot drift."""
+        count = len(values)
+        old = self._voted_runs.get(start_slot)
+        self._voted_runs[start_slot] = (count, stride, round, values)
+        if old is not None and old[1] == stride and old[0] > count:
             # Same-start truncation (the multipaxos acceptor's tail
             # fix, strided): reinsert the longer predecessor's
             # non-overlapped voted tail so Phase1 recovery keeps it.
-            old_count, stride, old_round, old_values = old
-            tail_start = run.start_slot + count * stride
+            old_count, old_stride, old_round, old_values = old
+            tail_start = start_slot + count * stride
             if self._voted_runs.get(tail_start) is None:
                 self._voted_runs[tail_start] = (
                     old_count - count, stride, old_round,
                     old_values[count:])
             else:
                 for i in range(count, old_count):
-                    slot = run.start_slot + i * stride
+                    slot = start_slot + i * stride
                     cur = self.states.get(slot)
                     if cur is None or cur.vote_round < old_round:
                         self.states[slot] = _VoteState(old_round,
                                                        old_values[i])
         self.max_voted_slot = max(
             self.max_voted_slot,
-            run.start_slot + (count - 1) * run.stride)
-        self.send(src, Phase2bRun(
-            acceptor_group_index=self.acceptor_group_index,
-            acceptor_index=self.index, start_slot=run.start_slot,
-            count=count, stride=run.stride, round=run.round))
+            start_slot + (count - 1) * stride)
+        return count
 
     def _handle_phase2a_noop_range(self, src: Address,
                                    phase2a: Phase2aNoopRange) -> None:
@@ -720,19 +816,33 @@ class MenciusAcceptor(Actor):
                       Nack(round=self.round))
             return
         self.round = phase2a.round
-        num_groups = len(
-            self.config.acceptor_addresses[self.leader_group_index])
-        stride = self.config.num_leader_groups * num_groups
-        start = phase2a.slot_start_inclusive
-        while (start < phase2a.slot_end_exclusive
-               and ((start // self.config.num_leader_groups) % num_groups)
-               != self.acceptor_group_index):
-            start += self.config.num_leader_groups
-        for slot in range(start, phase2a.slot_end_exclusive, stride):
-            self.states[slot] = _VoteState(self.round, NOOP)
-        self.send(src, Phase2bNoopRange(
+        self._store_noop_range(phase2a.slot_start_inclusive,
+                               phase2a.slot_end_exclusive, self.round)
+        if self.wal is not None:
+            # One O(1) record for the whole range; replay re-derives
+            # the owned slots from the (restart-stable) config.
+            self.wal.append(WalNoopRange(
+                slot_start_inclusive=phase2a.slot_start_inclusive,
+                slot_end_exclusive=phase2a.slot_end_exclusive,
+                round=self.round))
+        self._wal_send(src, Phase2bNoopRange(
             acceptor_group_index=self.acceptor_group_index,
             acceptor_index=self.index,
             slot_start_inclusive=phase2a.slot_start_inclusive,
             slot_end_exclusive=phase2a.slot_end_exclusive,
             round=self.round))
+
+    def _store_noop_range(self, start_inclusive: int, end_exclusive: int,
+                          round: int) -> None:
+        """Vote noop for every slot this acceptor group owns in the
+        range. Shared by the live handler and WAL replay."""
+        num_groups = len(
+            self.config.acceptor_addresses[self.leader_group_index])
+        stride = self.config.num_leader_groups * num_groups
+        start = start_inclusive
+        while (start < end_exclusive
+               and ((start // self.config.num_leader_groups) % num_groups)
+               != self.acceptor_group_index):
+            start += self.config.num_leader_groups
+        for slot in range(start, end_exclusive, stride):
+            self.states[slot] = _VoteState(round, NOOP)

@@ -17,7 +17,20 @@ except ImportError:  # stripped environments: pure-Python fallback
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.wal import (
+    DurableRole,
+    WalPromise,
+    WalSnapshot,
+    WalVote,
+    WalVoteRun,
+)
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    decode_value,
+    decode_value_array,
+    encode_value,
+    encode_value_array,
+)
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     BatchMaxSlotReply,
     BatchMaxSlotRequest,
@@ -53,11 +66,11 @@ class _VoteState:
     vote_value: CommandBatchOrNoop
 
 
-class Acceptor(Actor):
+class Acceptor(Actor, DurableRole):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: MultiPaxosConfig,
                  options: AcceptorOptions = AcceptorOptions(),
-                 collectors: Collectors | None = None):
+                 collectors: Collectors | None = None, wal=None):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
@@ -86,6 +99,55 @@ class Acceptor(Actor):
         self.max_voted_slot = -1
         # Phase2b acks staged during this drain: dst -> [(slot, round)].
         self._pending_phase2bs: dict[Address, list] = {}
+        # Durability (wal/): promises and votes append to the WAL as
+        # they are handled, and every ack that DEPENDS on one is held
+        # back until on_drain's single group-commit fsync releases it
+        # (DurableRole) -- a crashed acceptor can therefore never have
+        # acked state it will not recover. wal=None (the default) is
+        # the reference's in-memory behavior.
+        self._wal_init(wal)
+        if wal is not None:
+            self._recover_from_wal()
+
+    # --- durability -------------------------------------------------------
+    def _recover_from_wal(self) -> None:
+        for record in self.wal.recover(self.logger):
+            if isinstance(record, WalSnapshot):
+                # A compaction base: everything replayed so far is
+                # superseded state re-logged after this marker.
+                self.round = -1
+                self.states.clear()
+                self._voted_runs.clear()
+                self.max_voted_slot = -1
+            elif isinstance(record, WalPromise):
+                self.round = max(self.round, record.round)
+            elif isinstance(record, WalVote):
+                self.round = max(self.round, record.round)
+                self.states[record.slot] = _VoteState(
+                    record.round, decode_value(record.value))
+                self.max_voted_slot = max(self.max_voted_slot,
+                                          record.slot)
+            elif isinstance(record, WalVoteRun):
+                self.round = max(self.round, record.round)
+                self._store_run(record.start_slot, record.round,
+                                decode_value_array(record.values))
+            else:
+                self.logger.fatal(
+                    f"unexpected acceptor WAL record {record!r}")
+
+    def _wal_compact(self) -> None:
+        """Rewrite the log as one snapshot marker + the live voted
+        state (one fsync), reclaiming every older segment."""
+        records = [WalPromise(round=self.round)]
+        for start, (end, rnd, values) in self._voted_runs.items():
+            records.append(WalVoteRun(
+                start_slot=start, stride=1, round=rnd,
+                values=encode_value_array(values)))
+        for slot, vs in self.states.items():
+            records.append(WalVote(
+                slot=slot, round=vs.vote_round,
+                value=encode_value(vs.vote_value)))
+        self.wal.compact(WalSnapshot(payload=b""), records)
 
     def receive(self, src: Address, message) -> None:
         # timed(label) handler latency summaries (Leader.scala:281-293).
@@ -122,8 +184,14 @@ class Acceptor(Actor):
                 f"round {self.round}")
             self.send(src, Nack(round=self.round))
             return
+        if self.wal is not None and phase1a.round > self.round:
+            self.wal.append(WalPromise(round=phase1a.round))
         self.round = phase1a.round
-        self.send(src, Phase1b(
+        # The promise must be durable before the leader may trust it
+        # (a crashed acceptor re-promising a lower round would let two
+        # leaders both believe they own a round): held for group
+        # commit.
+        self._wal_send(src, Phase1b(
             group_index=self.group_index, acceptor_index=self.index,
             round=self.round,
             info=self._voted_info(phase1a.chosen_watermark)))
@@ -164,15 +232,21 @@ class Acceptor(Actor):
         self.states[phase2a.slot] = _VoteState(vote_round=self.round,
                                                vote_value=phase2a.value)
         self.max_voted_slot = max(self.max_voted_slot, phase2a.slot)
+        if self.wal is not None:
+            self.wal.append(WalVote(
+                slot=phase2a.slot, round=self.round,
+                value=encode_value(phase2a.value)))
         if self.options.range_phase2bs:
             # Stage the ack; on_drain coalesces contiguous runs per
-            # destination into Phase2bRanges.
+            # destination into Phase2bRanges (and, durable, releases
+            # them only after the drain's group commit).
             self._pending_phase2bs.setdefault(src, []).append(
                 (phase2a.slot, self.round))
         else:
-            self.send(src, Phase2b(group_index=self.group_index,
-                                   acceptor_index=self.index,
-                                   slot=phase2a.slot, round=self.round))
+            self._wal_send(src, Phase2b(group_index=self.group_index,
+                                        acceptor_index=self.index,
+                                        slot=phase2a.slot,
+                                        round=self.round))
 
     def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
         """A whole contiguous proposal run in one O(1) update: one round
@@ -184,9 +258,30 @@ class Acceptor(Actor):
             self.send(leader, Nack(round=self.round))
             return
         self.round = run.round
-        end = run.start_slot + len(run.values)
-        old = self._voted_runs.get(run.start_slot)
-        self._voted_runs[run.start_slot] = (end, run.round, run.values)
+        end = self._store_run(run.start_slot, run.round, run.values)
+        if self.wal is not None:
+            # Logging the run re-encodes its value array -- a RAW COPY
+            # of the inbound lazy segment, never a re-materialization.
+            self.wal.append(WalVoteRun(
+                start_slot=run.start_slot, stride=1, round=run.round,
+                values=encode_value_array(run.values)))
+        # Ack immediately as one range: the run is already a contiguous
+        # same-round block, so drain-end staging (whose merge loop is
+        # per-slot) would cost Python without saving messages. Durable
+        # mode holds it for the drain's group commit instead.
+        self._wal_send(src, Phase2bRange(group_index=self.group_index,
+                                         acceptor_index=self.index,
+                                         slot_start_inclusive=run.start_slot,
+                                         slot_end_exclusive=end,
+                                         round=run.round))
+
+    def _store_run(self, start_slot: int, round: int, values) -> int:
+        """Merge one contiguous voted run into the run store; returns
+        the run's exclusive end. Shared by the live Phase2aRun handler
+        and WAL replay so truncation-tail semantics cannot drift."""
+        end = start_slot + len(values)
+        old = self._voted_runs.get(start_slot)
+        self._voted_runs[start_slot] = (end, round, values)
         if old is not None and old[0] > end:
             # A shorter same-start run replaces a longer record (a
             # re-proposed prefix after leader change): the non-overlapped
@@ -196,7 +291,7 @@ class Acceptor(Actor):
             # start (same-start keys collide only at run.start_slot), so
             # this insert never clobbers a longer record.
             old_end, old_round, old_values = old
-            tail = old_values[end - run.start_slot:]
+            tail = old_values[end - start_slot:]
             if self._voted_runs.get(end) is None:
                 self._voted_runs[end] = (old_end, old_round, tail)
             else:
@@ -209,18 +304,9 @@ class Acceptor(Actor):
                         self.states[slot] = _VoteState(old_round,
                                                        tail[off])
         self.max_voted_slot = max(self.max_voted_slot, end - 1)
-        # Ack immediately as one range: the run is already a contiguous
-        # same-round block, so drain-end staging (whose merge loop is
-        # per-slot) would cost Python without saving messages.
-        self.send(src, Phase2bRange(group_index=self.group_index,
-                                    acceptor_index=self.index,
-                                    slot_start_inclusive=run.start_slot,
-                                    slot_end_exclusive=end,
-                                    round=run.round))
+        return end
 
     def on_drain(self) -> None:
-        if not self._pending_phase2bs:
-            return
         pending, self._pending_phase2bs = self._pending_phase2bs, {}
         for dst, acks in pending.items():
             acks.sort()
@@ -240,24 +326,28 @@ class Acceptor(Actor):
                                     count=len(acks))
                 rounds = np.fromiter((r for _, r in acks), dtype=np.int32,
                                      count=len(acks))
-                self.send(dst, Phase2bVotes(
+                self._wal_send(dst, Phase2bVotes(
                     group_index=self.group_index,
                     acceptor_index=self.index,
                     packed=native.pack_votes2(slots, rounds)))
                 continue
             for run in runs:
                 if len(run) == 1:
-                    self.send(dst, Phase2b(
+                    self._wal_send(dst, Phase2b(
                         group_index=self.group_index,
                         acceptor_index=self.index,
                         slot=run[0][0], round=run[0][1]))
                 else:
-                    self.send(dst, Phase2bRange(
+                    self._wal_send(dst, Phase2bRange(
                         group_index=self.group_index,
                         acceptor_index=self.index,
                         slot_start_inclusive=run[0][0],
                         slot_end_exclusive=run[-1][0] + 1,
                         round=run[0][1]))
+        # GROUP COMMIT (DurableRole): one fsync covers every record
+        # this drain appended, then -- and only then -- the acks it
+        # produced go out.
+        self._wal_drain()
 
     @staticmethod
     def _runs_of(acks: list) -> list:

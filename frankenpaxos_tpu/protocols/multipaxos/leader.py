@@ -408,7 +408,16 @@ class Leader(Actor):
                                values):
             self._send_phase2a(Phase2a(slot=slot, round=self.round,
                                        value=value))
-        self.next_slot = max_slot + 1
+        # next_slot must clear the chosen watermark, not just the voted
+        # max: Phase1bs report nothing below the watermark (every slot
+        # there is already chosen), so with no votes ABOVE it,
+        # ``max_slot + 1`` alone would re-propose fresh commands into
+        # already-chosen slots -- choosing a second value for a slot
+        # (found by the WAL chaos soak's partition + leader-churn
+        # schedules). Any CHOSEN slot >= the watermark is covered by
+        # quorum intersection: some Phase1b carries its vote, so
+        # max_slot clears it.
+        self.next_slot = max(max_slot + 1, self.chosen_watermark)
 
         phase1.resend_phase1as.stop()
         self.state = _Phase2(self._make_noop_flush_timer())

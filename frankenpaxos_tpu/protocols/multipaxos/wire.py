@@ -152,6 +152,34 @@ def _take_value(buf: bytes, at: int):
     return CommandBatch(tuple(commands)), at
 
 
+def encode_value(value) -> bytes:
+    """One CommandBatchOrNoop as a standalone byte segment (the WAL's
+    WalVote payload; same layout Phase2a carries on the wire)."""
+    out = bytearray()
+    _put_value(out, value)
+    return bytes(out)
+
+
+def decode_value(data: bytes):
+    value, _ = _take_value(data, 0)
+    return value
+
+
+def encode_value_array(values) -> bytes:
+    """A value array as a standalone byte segment (the WAL's
+    WalVoteRun/WalChosenRun payload). Encoding a LazyValueArray -- the
+    form runs arrive in -- is a raw copy: logging a drain's Phase2aRun
+    never re-materializes its values."""
+    out = bytearray()
+    _put_value_array(out, values)
+    return bytes(out)
+
+
+def decode_value_array(data: bytes) -> LazyValueArray:
+    values, _ = _take_value_array(data, 0)
+    return values
+
+
 class Phase2bCodec(MessageCodec):
     """The single hottest message (2f+1 per slot)."""
 
@@ -324,7 +352,11 @@ class LazyValueArray:
         if self._values is None:
             try:
                 self._values = _parse_value_array(self.raw, 0, self.n)[0]
-            except (struct.error, IndexError) as e:
+            except (struct.error, IndexError, KeyError,
+                    UnicodeDecodeError, OverflowError, MemoryError) as e:
+                # The lazy twin of HybridSerializer.from_bytes'
+                # containment normalization: corruption surfacing at
+                # first ACCESS still comes out as ValueError.
                 raise ValueError(
                     f"corrupt value array (n={self.n}): {e}") from e
         return self._values

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import abc
 import pickle
+import struct
 from typing import Generic, TypeVar
 
 M = TypeVar("M")
@@ -103,7 +104,15 @@ def guarded_pickle_loads(raw: bytes, what: str):
         raise ValueError(
             f"pickle fallback disabled: refusing pickled {what} inside "
             f"binary frame")
-    return pickle.loads(raw)
+    try:
+        return pickle.loads(raw)
+    except Exception as e:
+        # A corrupt frame can route arbitrary bytes into this hatch (a
+        # flipped address-kind byte -- found by the registry-wide
+        # containment fuzz), and pickle.loads raises open-ended
+        # exception types on garbage. Normalize to the ValueError
+        # containment channel like every other decode failure.
+        raise ValueError(f"corrupt pickled {what}: {e!r}") from e
 
 
 def guarded_pickle_dumps(obj, what: str) -> bytes:
@@ -162,7 +171,21 @@ class HybridSerializer(Serializer[M]):
         codec = _CODECS_BY_TAG.get(tag)
         if codec is None:
             raise ValueError(f"no codec registered for wire tag {tag}")
-        message, _ = codec.decode(data, 1)
+        try:
+            message, _ = codec.decode(data, 1)
+        except ValueError:
+            raise
+        except (struct.error, IndexError, KeyError, UnicodeDecodeError,
+                OverflowError, MemoryError) as e:
+            # THE CONTAINMENT CONTRACT (fuzzed over the whole codec
+            # registry in tests/test_wire_codecs.py): a corrupt binary
+            # frame decodes to garbage or raises ValueError -- never an
+            # uncontrolled exception type. The transport's
+            # corrupt-frame guard logs-and-drops on any Exception, but
+            # OTHER decode sites (WAL replay, tests, tools) rely on
+            # ValueError being the one failure channel.
+            raise ValueError(
+                f"corrupt frame for wire tag {tag}: {e!r}") from e
         return message
 
 

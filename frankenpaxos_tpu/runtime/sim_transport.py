@@ -228,3 +228,19 @@ class SimTransport(Transport):
 
     def heal(self, address: Address) -> None:
         self.partitioned.discard(address)
+
+    def crash(self, address: Address) -> None:
+        """Process crash (``kill -9``) for the actor at ``address``:
+        deregister it and destroy its timers -- every piece of volatile
+        state dies with the object, including anything it staged for a
+        group commit that never happened. In-flight messages to the
+        address stay buffered (the network does not know about the
+        crash): they deliver to whatever re-registers there -- the
+        restarted actor, whose durable state must make that safe -- or
+        drop as 'no actor registered' if nothing does. The restart is
+        the harness's job: construct a fresh actor at the same address
+        over the surviving WAL storage."""
+        self.actors.pop(address, None)
+        for timer_id in [tid for tid, t in self.timers.items()
+                         if t.address == address]:
+            del self.timers[timer_id]

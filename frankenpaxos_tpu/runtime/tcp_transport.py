@@ -318,6 +318,17 @@ class TcpTransport(Transport):
                flush: bool) -> None:
         assert self.loop is not None, "transport not started"
         conn = self._conn_for(src, dst)
+        if conn.writer is not None and conn.writer.is_closing():
+            # The peer died (process crash / kill -9) or reset the
+            # connection: drop the dead writer so this send triggers a
+            # fresh lazy connect. Without this, every later message to
+            # a RESTARTED role would pour into a closed socket forever
+            # -- the failure mode the WAL chaos harness exists to
+            # catch. Messages written into the dead socket before the
+            # loss was detected are gone, which is within the
+            # at-most-once transport contract; protocol resends cover
+            # them.
+            conn.writer = None
         conn.pending.append(_encode_frame(src, data))
         if conn.writer is not None:
             if flush:
@@ -343,7 +354,14 @@ class TcpTransport(Transport):
     def _flush_conn(self, conn: _Conn) -> None:
         if conn.writer is None or not conn.pending:
             return
-        conn.writer.write(b"".join(conn.pending))
+        try:
+            conn.writer.write(b"".join(conn.pending))
+        except (OSError, RuntimeError) as e:
+            # Connection torn down mid-write: drop the writer; the
+            # next send reconnects (see _write) and resends cover the
+            # loss.
+            self.logger.warn(f"write failed ({e}); dropping connection")
+            conn.writer = None
         conn.pending.clear()
 
     def send(self, src: Address, dst: Address, data: bytes) -> None:

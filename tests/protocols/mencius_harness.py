@@ -36,14 +36,54 @@ class MenciusSim:
     replicas: list
     proxy_replicas: list
     clients: list
+    # wal=True extras (see multipaxos_harness).
+    wal_storages: dict = dataclasses.field(default_factory=dict)
+    state_machine_factory: object = None
+    seed: int = 0
+
+
+def _sim_wal(storages: dict, address):
+    from tests.protocols.multipaxos_harness import (
+        _SIM_WAL_COMPACT_BYTES,
+        _SIM_WAL_SEGMENT_BYTES,
+    )
+
+    from frankenpaxos_tpu.wal import MemStorage, Wal
+
+    storage = storages.setdefault(address, MemStorage())
+    return Wal(storage, segment_bytes=_SIM_WAL_SEGMENT_BYTES,
+               compact_every_bytes=_SIM_WAL_COMPACT_BYTES)
+
+
+def crash_restart_acceptor(sim: "MenciusSim", i: int) -> None:
+    old = sim.acceptors[i]
+    sim.transport.crash(old.address)
+    sim.acceptors[i] = MenciusAcceptor(
+        old.address, sim.transport, sim.transport.logger, sim.config,
+        wal=_sim_wal(sim.wal_storages, old.address))
+
+
+def crash_restart_replica(sim: "MenciusSim", i: int) -> None:
+    old = sim.replicas[i]
+    sim.transport.crash(old.address)
+    sim.replicas[i] = MenciusReplica(
+        old.address, sim.transport, sim.transport.logger,
+        sim.state_machine_factory(), sim.config,
+        send_chosen_watermark_every_n=old.send_chosen_watermark_every_n,
+        seed=sim.seed + 70 + i,
+        wal=_sim_wal(sim.wal_storages, old.address))
 
 
 def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
                  num_batchers=0, num_proxy_replicas=0, num_clients=1,
                  batch_size=1, lag_threshold=100, coalesced=False,
-                 state_machine_factory=AppendLog, seed=0) -> MenciusSim:
+                 state_machine_factory=AppendLog, seed=0,
+                 wal=False) -> MenciusSim:
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
+    wal_storages: dict = {}
+    wal_for = (lambda a: _sim_wal(wal_storages, a)) if wal \
+        else (lambda a: None)
     config = MenciusConfig(
         f=f,
         batcher_addresses=tuple(f"batcher-{i}" for i in range(num_batchers)),
@@ -76,13 +116,14 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
     proxy_leaders = [MenciusProxyLeader(a, transport, logger, config,
                                         seed=seed + 50 + i)
                      for i, a in enumerate(config.proxy_leader_addresses)]
-    acceptors = [MenciusAcceptor(a, transport, logger, config)
+    acceptors = [MenciusAcceptor(a, transport, logger, config,
+                                 wal=wal_for(a))
                  for groups in config.acceptor_addresses
                  for group in groups for a in group]
     replicas = [MenciusReplica(a, transport, logger,
                                state_machine_factory(), config,
                                send_chosen_watermark_every_n=5,
-                               seed=seed + 70 + i)
+                               seed=seed + 70 + i, wal=wal_for(a))
                 for i, a in enumerate(config.replica_addresses)]
     proxy_replicas = [MenciusProxyReplica(a, transport, logger, config)
                       for a in config.proxy_replica_addresses]
@@ -98,7 +139,10 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
                              seed=seed + 90 + i)
                for i in range(num_clients)]
     return MenciusSim(transport, config, batchers, leaders, proxy_leaders,
-                      acceptors, replicas, proxy_replicas, clients)
+                      acceptors, replicas, proxy_replicas, clients,
+                      wal_storages=wal_storages,
+                      state_machine_factory=state_machine_factory,
+                      seed=seed)
 
 
 def executed_prefix(replica) -> list:

@@ -42,6 +42,58 @@ class MultiPaxosSim:
     replicas: list
     proxy_replicas: list
     clients: list
+    # wal=True extras: address -> MemStorage (survives crash_restart),
+    # plus what a restart needs to rebuild the actor.
+    wal_storages: dict = dataclasses.field(default_factory=dict)
+    state_machine_factory: object = None
+    seed: int = 0
+
+
+#: Small segment/compaction thresholds so sim runs exercise rotation
+#: and snapshot GC, not just appends.
+_SIM_WAL_SEGMENT_BYTES = 2048
+_SIM_WAL_COMPACT_BYTES = 8192
+
+
+def _sim_wal(sim_or_storages, address, root=None):
+    """A Wal over the (surviving) MemStorage for ``address`` -- or,
+    with ``root`` set (the wal_lt bench's real-fsync arm), over
+    FileStorage at <root>/<address>."""
+    from frankenpaxos_tpu.wal import FileStorage, MemStorage, Wal
+
+    storages = getattr(sim_or_storages, "wal_storages", sim_or_storages)
+    if root is not None:
+        import os
+
+        storage = storages.setdefault(
+            address, FileStorage(os.path.join(root, str(address))))
+        return Wal(storage)
+    storage = storages.setdefault(address, MemStorage())
+    return Wal(storage, segment_bytes=_SIM_WAL_SEGMENT_BYTES,
+               compact_every_bytes=_SIM_WAL_COMPACT_BYTES)
+
+
+def crash_restart_acceptor(sim: "MultiPaxosSim", i: int) -> None:
+    """kill -9 acceptor ``i`` and restart it from its WAL: volatile
+    state (staged acks, the unsynced group-commit buffer) dies; synced
+    promises/votes/runs recover."""
+    old = sim.acceptors[i]
+    sim.transport.crash(old.address)
+    sim.acceptors[i] = Acceptor(
+        old.address, sim.transport, sim.transport.logger, sim.config,
+        old.options, wal=_sim_wal(sim, old.address))
+
+
+def crash_restart_replica(sim: "MultiPaxosSim", i: int) -> None:
+    """kill -9 replica ``i`` and restart it: the SM rebuilds from the
+    WAL snapshot + chosen-record replay; unsynced executions (never
+    acked, by the group-commit rule) are re-learned or re-requested."""
+    old = sim.replicas[i]
+    sim.transport.crash(old.address)
+    sim.replicas[i] = Replica(
+        old.address, sim.transport, sim.transport.logger,
+        sim.state_machine_factory(), sim.config, old.options,
+        seed=sim.seed + 20 + i, wal=_sim_wal(sim, old.address))
 
 
 def make_multipaxos(
@@ -64,9 +116,21 @@ def make_multipaxos(
     state_machine_factory=AppendLog,
     seed: int = 0,
     log_level: LogLevel = LogLevel.FATAL,
+    wal: "bool | str" = False,
 ) -> MultiPaxosSim:
+    """``wal``: False (reference in-memory behavior), True (MemStorage
+    WALs, the crash-restart sims), or a directory path (FileStorage
+    WALs with REAL fsyncs -- the wal_lt bench's measured arm)."""
     logger = FakeLogger(log_level)
     transport = SimTransport(logger)
+    wal_storages: dict = {}
+    if wal is False:
+        wal_for = lambda a: None  # noqa: E731
+    elif wal is True:
+        wal_for = lambda a: _sim_wal(wal_storages, a)  # noqa: E731
+    else:
+        wal_for = lambda a: _sim_wal(wal_storages, a,  # noqa: E731
+                                     root=wal)
 
     if flexible:
         rows, cols = grid_shape or (f + 1, f + 1)
@@ -118,12 +182,12 @@ def make_multipaxos(
                     seed=seed + 10 + i)
         for i, a in enumerate(config.proxy_leader_addresses)]
     acceptors = [
-        Acceptor(a, transport, logger, config)
+        Acceptor(a, transport, logger, config, wal=wal_for(a))
         for group in config.acceptor_addresses for a in group]
     replicas = [
         Replica(a, transport, logger, state_machine_factory(), config,
                 ReplicaOptions(send_chosen_watermark_every_n_entries=10),
-                seed=seed + 20 + i)
+                seed=seed + 20 + i, wal=wal_for(a))
         for i, a in enumerate(config.replica_addresses)]
     proxy_replicas = [
         ProxyReplica(a, transport, logger, config)
@@ -145,7 +209,10 @@ def make_multipaxos(
         for i in range(num_clients)]
 
     return MultiPaxosSim(transport, config, batchers, leaders, proxy_leaders,
-                         acceptors, replicas, proxy_replicas, clients)
+                         acceptors, replicas, proxy_replicas, clients,
+                         wal_storages=wal_storages,
+                         state_machine_factory=state_machine_factory,
+                         seed=seed)
 
 
 def executed_prefix(replica: Replica) -> list:

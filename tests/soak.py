@@ -247,6 +247,37 @@ CONFIGS: list[tuple] = [
      lambda: MultiPaxosSimulated(f=1, coalesced="mixed")),
 ]
 
+# The paxlog crash-restart chaos arms (wal/): randomized kill -9 +
+# restart-from-WAL of acceptors/replicas interleaved with drops,
+# partitions, and leader changes. Kept in their own list so
+# ``--only wal`` (and the wal_chaos_soak artifact) can run exactly
+# this family; run_soak covers CONFIGS + WAL_CHAOS_CONFIGS.
+from tests.protocols.test_mencius_wal import (  # noqa: E402
+    MenciusWalSimulated,
+)
+from tests.protocols.test_multipaxos_wal import (  # noqa: E402
+    MultiPaxosWalSimulated,
+)
+
+WAL_CHAOS_CONFIGS: list[tuple] = [
+    ("wal-chaos/multipaxos-f1",
+     lambda: MultiPaxosWalSimulated(f=1)),
+    ("wal-chaos/multipaxos-f1-coalesced",
+     lambda: MultiPaxosWalSimulated(f=1, coalesced=True)),
+    ("wal-chaos/multipaxos-f2-mixed",
+     lambda: MultiPaxosWalSimulated(f=2, coalesced="mixed")),
+    ("wal-chaos/mencius-groups2",
+     lambda: MenciusWalSimulated(num_leader_groups=2, lag_threshold=2)),
+    ("wal-chaos/mencius-coalesced",
+     lambda: MenciusWalSimulated(num_leader_groups=2, lag_threshold=2,
+                                 coalesced=True)),
+    ("wal-chaos/mencius-coalesced-groups2x2",
+     lambda: MenciusWalSimulated(num_leader_groups=2,
+                                 num_acceptor_groups=2, lag_threshold=2,
+                                 coalesced=True)),
+]
+CONFIGS.extend(WAL_CHAOS_CONFIGS)
+
 
 def _expand(entry, num_runs: int):
     """(name, factory[, runs_scale]) -> (name, factory, scaled runs) --

@@ -254,6 +254,53 @@ def test_tpu203_host_asarray_is_fine(tmp_path):
     assert "TPU203" not in rules_of(findings)
 
 
+def test_tpu208_file_io_reachable_from_ops_kernel(tmp_path):
+    """fsync / open reachable from ops/ kernel code is flagged -- WAL
+    I/O must stay on the drain boundary, never inside a kernel."""
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import os
+
+    def persist(path, board):
+        f = open(path, "ab")
+        f.write(board.tobytes())
+        os.fsync(f.fileno())
+    """}))
+    tpu208 = [f for f in findings if f.rule == "TPU208"]
+    assert {f.detail for f in tpu208} >= {"open", "os.fsync"}
+
+
+def test_tpu208_transitive_through_helper(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "ops/kernel.py": """
+    from pkg.wal import sync_log
+
+    def drain_kernel(block):
+        sync_log()
+    """,
+        "wal.py": """
+    import os
+
+    def sync_log():
+        os.fsync(3)
+    """}))
+    assert any(f.rule == "TPU208" and f.scope == "sync_log"
+               for f in findings)
+
+
+def test_tpu208_fsync_in_on_drain_is_fine(tmp_path):
+    """The drain boundary is exactly where WAL I/O belongs: fsync in
+    an actor's on_drain (outside ops/) is NOT flagged."""
+    findings = run_rules(project(tmp_path, {"roles.py": """
+    import os
+
+    class Role:
+        def on_drain(self):
+            self.wal_file.flush()
+            os.fsync(self.wal_file.fileno())
+    """}))
+    assert "TPU208" not in rules_of(findings)
+
+
 def test_tpu204_coercion_of_traced_value(tmp_path):
     findings = run_rules(project(tmp_path, {"ops/kernel.py": """
     import jax

@@ -106,6 +106,104 @@ def test_multipaxos_supernode_benchmark():
     assert stats["num_requests"] > 0
 
 
+def test_multipaxos_wal_survives_acceptor_sigkill(tmp_path):
+    """Process-failure chaos on a REAL deployment: SIGKILL an acceptor
+    mid-run, relaunch it with the same --wal_dir, then SIGKILL a
+    *different* acceptor -- further commits now require the restarted
+    one to participate with its recovered votes. The client must
+    observe every write acknowledged exactly once and read all of them
+    back (no lost acknowledged writes)."""
+    import threading
+
+    from frankenpaxos_tpu.bench.chaos import (
+        kill_restart_role,
+        sigkill_role,
+    )
+    from frankenpaxos_tpu.bench.deploy_suite import launch_roles
+    from frankenpaxos_tpu.bench.harness import free_port
+    from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.serializer import PickleSerializer
+    from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+    from frankenpaxos_tpu.statemachine import GetRequest, SetRequest
+
+    serializer = PickleSerializer()
+    bench = BenchmarkDirectory(str(tmp_path / "wal_chaos"))
+    protocol = get_protocol("multipaxos")
+    raw = protocol.cluster(1, lambda: ["127.0.0.1", free_port()])
+    config_path = bench.write_json("config.json", raw)
+    config = protocol.load_config(raw)
+    launch_roles(bench, "multipaxos", config_path, config,
+                 state_machine="KeyValueStore",
+                 overrides={"resend_phase1as_period_s": "0.5",
+                            # Slots proposed to a just-killed acceptor
+                            # leave holes; the replicas' hole-recovery
+                            # timer (default 10-20s) is what repairs
+                            # them, so run it fast.
+                            "recover_log_entry_min_period_s": "0.5",
+                            "recover_log_entry_max_period_s": "1.0"},
+                 wal_dir=str(tmp_path / "wal"))
+    transport = None
+    try:
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = TcpTransport(("127.0.0.1", free_port()), logger)
+        transport.start()
+        ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                        overrides={"resend_client_request_period_s": "0.5",
+                                   "resend_read_request_period_s": "0.5"},
+                        seed=0xFEED, state_machine="KeyValueStore")
+        client = protocol.make_client(ctx, transport.listen_address)
+
+        def write(k: int) -> None:
+            done = threading.Event()
+            transport.loop.call_soon_threadsafe(
+                client.write, 0,
+                serializer.to_bytes(SetRequest(((f"k{k}", str(k)),))),
+                lambda _: done.set())
+            assert done.wait(timeout=30), f"write k{k} never acked"
+
+        for k in range(5):
+            write(k)
+        # kill -9 acceptor_1 (no grace, no flush), relaunch from WAL.
+        kill_restart_role(bench, "acceptor_1", down_s=0.2)
+        for k in range(5, 10):
+            write(k)
+        # Now kill acceptor_2 WITHOUT relaunch: the f+1 write quorum
+        # must go through the RESTARTED acceptor_1 -- progress from
+        # here proves its recovery made it a functioning participant.
+        sigkill_role(bench, "acceptor_2")
+        for k in range(10, 15):
+            write(k)
+
+        # No lost acknowledged writes: read every key back.
+        results: list = []
+        read_done = threading.Event()
+
+        def read_all() -> None:
+            def next_read(i: int):
+                def on_reply(raw_reply):
+                    results.append(serializer.from_bytes(raw_reply))
+                    if i + 1 < 15:
+                        next_read(i + 1)
+                    else:
+                        read_done.set()
+                client.eventual_read(
+                    1, serializer.to_bytes(GetRequest((f"k{i}",))),
+                    on_reply)
+            next_read(0)
+
+        transport.loop.call_soon_threadsafe(read_all)
+        assert read_done.wait(timeout=60), (
+            f"reads stalled after {len(results)}")
+        got = {k: dict(r.key_values).get(f"k{k}")
+               for k, r in enumerate(results)}
+        assert got == {k: str(k) for k in range(15)}, got
+    finally:
+        if transport is not None:
+            transport.stop()
+        bench.cleanup()
+
+
 def test_lt_suite_sim_transport_dict():
     """The LT suite's in-process pipeline measure runs and is sane."""
     from frankenpaxos_tpu.bench.lt_suite import sim_transport_cmds_per_sec
