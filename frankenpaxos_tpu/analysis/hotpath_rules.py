@@ -57,7 +57,24 @@ RULES = {
     "TPU206": "jit retrace hazard (nested jit / non-hashable static)",
     "TPU207": "Python loop over a traced shape in a jitted fn",
     "TPU208": "blocking fsync/file I/O reachable from ops/ kernel code",
+    "TPU209": "trace span/clock hook in ops/ kernel or jit-reachable "
+              "code",
 }
+
+#: Span-emitting / clock-reading trace hooks (paxtrace, obs/): host
+#: observability must stay on the actor loop -- a clock read or span
+#: record inside a kernel (or anything a jitted function calls)
+#: either breaks tracing under jit (traced once, never at runtime) or
+#: serializes the dispatch on host work. The drain/receive spans live
+#: in the transports for exactly this reason.
+_TRACE_HOOK_LEAVES = frozenset({
+    "trace_stage", "stage_scope", "receive_span", "timer_span",
+    "drain_span", "record_stage",
+})
+_CLOCK_LEAVES = frozenset({
+    "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+    "process_time", "time_ns",
+})
 
 #: Call leaves that mean blocking file I/O (the WAL's group-commit
 #: surface): kernels must never reach them -- durability belongs to
@@ -277,6 +294,13 @@ def check(project: Project):
                      f"I/O must stay on the actor loop's drain "
                      f"boundary (wal/log.py group commit), never "
                      f"inside kernel code")
+            elif leaf in _TRACE_HOOK_LEAVES or _is_clock_read(d):
+                flag("TPU209", mod, node, info.qualname, d,
+                     f"{d} is a trace span/clock hook in code {how}; "
+                     f"paxtrace spans belong to the transports and "
+                     f"the actor drain (obs/), never inside kernel "
+                     f"code where they serialize the dispatch on "
+                     f"host work")
 
     # Retrace / trace-coercion hazards in jitted functions, plus nested
     # jit in hot code (project-wide: kernels are hot by definition).
@@ -305,6 +329,15 @@ def check(project: Project):
             for node in _own_nodes(func):
                 if isinstance(node, ast.Call):
                     d = dotted(node.func)
+                    leaf209 = d.split(".")[-1]
+                    if leaf209 in _TRACE_HOOK_LEAVES \
+                            or _is_clock_read(d):
+                        flag("TPU209", mod, node, qual, d,
+                             f"{d} inside a jitted function: the "
+                             f"hook runs once at trace time, never "
+                             f"per call -- spans/clock reads are "
+                             f"silently wrong under jit; emit them "
+                             f"from the drain path instead")
                     if d in ("float", "int", "bool") and node.args:
                         used = _root_names(node.args[0]) & traced
                         if used:
@@ -360,6 +393,13 @@ def check(project: Project):
                              f"argument {kw.arg!r}: every call "
                              f"retraces (statics must be hashable)")
     return findings
+
+
+def _is_clock_read(name: str) -> bool:
+    """``time.perf_counter``-style host clock reads. Bare ``time()``
+    and ``<obj>.time()`` (the Summary timer) are NOT clock reads; the
+    exact dotted ``time.time`` is."""
+    return name.split(".")[-1] in _CLOCK_LEAVES or name == "time.time"
 
 
 def _is_numpy(name: str, aliases: dict) -> bool:

@@ -23,10 +23,37 @@ from frankenpaxos_tpu.bench.harness import BenchmarkDirectory, LocalHost
 
 
 def sigkill_role(bench: BenchmarkDirectory, label: str) -> None:
-    """``kill -9`` the role process for ``label`` and reap it."""
+    """``kill -9`` the role process for ``label`` and reap it. When the
+    deployment ran with ``trace_dir`` (paxtrace), the killed role's
+    flight-recorder ring is snapshotted to the post-mortem JSON
+    immediately -- BEFORE any relaunch can reuse the ring file."""
     proc = bench.labeled_procs[label]
     os.kill(proc.pid(), signal.SIGKILL)
     proc.wait(timeout=10)
+    collect_flight_record(bench, label)
+
+
+def collect_flight_record(bench: BenchmarkDirectory,
+                          label: str) -> "str | None":
+    """Dump ``label``'s flight-recorder ring (the mmap'd file survives
+    SIGKILL) to ``<bench>/<label>.flight.json``; numbered like the
+    killed logs so repeated kills of one label keep every post-mortem.
+    Returns the dump path, or None when tracing was off."""
+    trace_dir = getattr(bench, "trace_dir", None)
+    if not trace_dir:
+        return None
+    ring = os.path.join(trace_dir, f"{label}.flight")
+    if not os.path.exists(ring):
+        return None
+    from frankenpaxos_tpu.obs import FlightRecorder
+
+    out = bench.abspath(f"{label}.flight.json")
+    n = 1
+    while os.path.exists(out):
+        out = bench.abspath(f"{label}.flight.json.killed{n}")
+        n += 1
+    FlightRecorder.dump_file(ring, out)
+    return out
 
 
 def relaunch_role(bench: BenchmarkDirectory, label: str,

@@ -47,6 +47,8 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  profiled: bool = False,
                  ready_timeout_s: float = 120.0,
                  wal_dir: "str | None" = None,
+                 trace_dir: "str | None" = None,
+                 trace_sample: float = 1.0,
                  host=None) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
     until each reports it is listening.
@@ -76,6 +78,12 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     WAL-capable roles log to <wal_dir>/<label> and recover on
     relaunch -- the seam the chaos driver (bench/chaos.py) uses to
     SIGKILL and resurrect roles mid-benchmark.
+
+    ``trace_dir`` turns on paxtrace (``--trace``, obs/): every role
+    emits spans to <trace_dir>/<label>.trace.jsonl and keeps its
+    crash flight-recorder ring in <trace_dir>/<label>.flight --
+    ``bench/chaos.py`` snapshots the ring of a SIGKILL'd role for the
+    post-mortem. ``trace_sample`` is the root sampling rate.
 
     Every launched command is recorded in ``bench.role_commands`` so a
     role can be relaunched verbatim (same ports, same wal_dir) after a
@@ -131,11 +139,15 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                     str(prometheus_ports[label])]
         if wal_dir:
             cmd += ["--wal_dir", wal_dir]
+        if trace_dir:
+            cmd += ["--trace", trace_dir,
+                    "--trace_sample", str(trace_sample)]
         for key, value in (overrides or {}).items():
             cmd.append(f"--options.{key}={value}")
         bench.role_commands[label] = (cmd, env)
         bench.popen(host, label, cmd, env=env)
     bench.prometheus_ports = prometheus_ports
+    bench.trace_dir = trace_dir
     if prometheus:
         from frankenpaxos_tpu.bench.metrics import scrape_config
 
@@ -224,7 +236,8 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
                        state_machine: str = "AppendLog",
                        overrides: "dict[str, str] | None" = None,
                        command_timeout_s: float = 30.0,
-                       host=None, prometheus: bool = False) -> dict:
+                       host=None, prometheus: bool = False,
+                       trace_dir: "str | None" = None) -> dict:
     """Deploy ``protocol_name`` over localhost TCP and commit
     ``num_commands`` commands through it. ``host`` launches the roles
     on another machine (see ``launch_roles``)."""
@@ -241,7 +254,7 @@ def run_protocol_smoke(bench: BenchmarkDirectory, protocol_name: str, *,
     labels = launch_roles(bench, protocol_name, config_path, config,
                           state_machine=state_machine,
                           overrides=overrides, host=host,
-                          prometheus=prometheus)
+                          prometheus=prometheus, trace_dir=trace_dir)
     ready_s = time.time() - t0
 
     # In-process client over real TCP. A short resend period rides out

@@ -13,6 +13,74 @@ from __future__ import annotations
 
 import urllib.request
 
+_NAME_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def parse_sample_line(line: str) -> "tuple[str, float] | None":
+    """One exposition sample: ``name{labels} value [timestamp]`` ->
+    ``(name{labels}, value)``, or None for comments/garbage.
+
+    The old ``line.rpartition(" ")`` shortcut mis-keyed any sample
+    whose label VALUES contain spaces (``{msg="hello world"}`` split
+    inside the label) and any line carrying a trailing timestamp (the
+    timestamp became the value and the real value joined the key). The
+    label block is scanned with quote/escape awareness -- ``\\"`` and
+    ``\\\\`` inside a quoted value never terminate it -- and the
+    remainder splits into value + optional dropped timestamp.
+    Histogram/summary series keep their suffixed names
+    (``*_bucket{le=...}``, ``*_sum``, ``*_count``) so they stay
+    queryable downstream (promdb)."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    i, n = 0, len(line)
+    while i < n and line[i] in _NAME_CHARS:
+        i += 1
+    if i == 0:
+        return None
+    key_end = i
+    if i < n and line[i] == "{":
+        j = i + 1
+        in_quotes = False
+        while j < n:
+            c = line[j]
+            if in_quotes:
+                if c == "\\":
+                    j += 1  # escaped char: skip it
+                elif c == '"':
+                    in_quotes = False
+            elif c == '"':
+                in_quotes = True
+            elif c == "}":
+                break
+            j += 1
+        if j >= n:
+            return None  # unterminated label block
+        key_end = j + 1
+    key = line[:key_end]
+    rest = line[key_end:].split()
+    if not rest:
+        return None
+    try:
+        # float() accepts the exposition specials +Inf/-Inf/NaN.
+        value = float(rest[0])
+    except ValueError:
+        return None
+    # rest[1:], if present, is the millisecond timestamp: dropped (the
+    # scraper stamps its own sample time).
+    return key, value
+
+
+def parse_exposition(text: str) -> dict:
+    """A whole /metrics payload -> ``{name{labels}: value}``."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        parsed = parse_sample_line(line)
+        if parsed is not None:
+            out[parsed[0]] = parsed[1]
+    return out
+
 
 def scrape(port: int, host: str = "127.0.0.1",
            timeout_s: float = 5.0) -> dict:
@@ -20,16 +88,7 @@ def scrape(port: int, host: str = "127.0.0.1",
     with urllib.request.urlopen(
             f"http://{host}:{port}/metrics", timeout=timeout_s) as resp:
         text = resp.read().decode()
-    out: dict[str, float] = {}
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        name, _, value = line.rpartition(" ")
-        try:
-            out[name] = float(value)
-        except ValueError:
-            continue
-    return out
+    return parse_exposition(text)
 
 
 def scrape_config(targets: "dict[str, int]", host: str = "127.0.0.1",

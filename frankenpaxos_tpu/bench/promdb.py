@@ -49,11 +49,25 @@ _AGG = re.compile(
     r"(?:by\s*\((?P<by>[^)]*)\)\s*)?"
     r"\((?P<inner>.*)\)\s*$", re.DOTALL)
 _RATE = re.compile(r"^\s*rate\s*\((?P<inner>.*)\)\s*$", re.DOTALL)
-_MATCHER = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"([^"]*)"')
+# Label values are quoted strings WITH escapes (the exposition format
+# escapes backslash, double-quote, and newline): ``[^"]*`` would end a
+# value at the first escaped quote.
+_MATCHER = re.compile(
+    r'([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"')
 _SCRAPED_KEY = re.compile(
     r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)(?:\{(?P<labels>.*)\})?$")
 
 _UNIT_S = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+_ESCAPE = re.compile(r"\\(.)")
+
+
+def _unescape(value: str) -> str:
+    # Left-to-right (chained str.replace mangles ``\\n`` -- an escaped
+    # backslash followed by a literal n -- into a newline).
+    return _ESCAPE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), value)
 
 
 def _parse_scraped_key(key: str, job: str) -> Optional[Labels]:
@@ -62,7 +76,8 @@ def _parse_scraped_key(key: str, job: str) -> Optional[Labels]:
         return None
     labels = [("__name__", m.group("name")), ("job", job)]
     if m.group("labels"):
-        labels.extend(_MATCHER.findall(m.group("labels")))
+        labels.extend((k, _unescape(v))
+                      for k, v in _MATCHER.findall(m.group("labels")))
     return frozenset(labels)
 
 
@@ -234,7 +249,7 @@ class MetricsDB:
             raise ValueError(
                 f"unsupported label matchers {raw!r} (only "
                 f'`name="value"` equality is implemented)')
-        matchers = dict(_MATCHER.findall(raw))
+        matchers = {k: _unescape(v) for k, v in _MATCHER.findall(raw)}
         hits = []
         with self._lock:
             items = [(labels, list(samples))

@@ -1,0 +1,256 @@
+"""paxtrace overhead A/B: no-hooks vs off vs sampled vs full.
+
+THE GATE (ISSUE 4): the tracing hooks must cost <3% per message when
+tracing is OFF -- every role ships with them compiled in, so the
+disabled path (one attribute load + an ``is None`` test per hook
+site) is the price everyone pays. The bench proves it with the
+multipaxos_lt methodology over the full coalesced actor pipeline:
+
+  * arm ``no-hooks``: SimTransport's deliver/drain/send monkeypatched
+    with verbatim copies of the PRE-paxtrace bodies (no tracer checks
+    at all) -- the true baseline a committed repo can no longer run;
+  * arm ``off``: the shipped code, no tracer attached;
+  * arm ``sampled``: a Tracer at 1/64 root sampling;
+  * arm ``full``: a Tracer at 1.0 (every command traced).
+
+Per in-flight width: interleaved paired reps with rotating arm order,
+the MEDIAN of paired ratios, pooled over independent subprocess
+batches (the multipaxos_lt/mencius_lt/wal_lt sim A/B shape).
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.trace_overhead \
+        --out bench_results/trace_overhead.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ARMS = ("no-hooks", "off", "sampled", "full")
+
+
+def _nohooks_patch():
+    """(enter, exit) functions swapping SimTransport's traced
+    deliver/drain/send for verbatim PRE-paxtrace bodies."""
+    from frankenpaxos_tpu.runtime.sim_transport import (
+        SimMessage,
+        SimTransport,
+    )
+
+    def send(self, src, dst, data):
+        self.messages.append(
+            SimMessage(next(self._ids), src, dst, data))
+
+    def _deliver(self, message):
+        try:
+            self.messages.remove(message)
+        except ValueError:
+            self.logger.warn(
+                f"delivering unbuffered message {message}")
+            return None
+        if (message.dst in self.partitioned
+                or message.src in self.partitioned):
+            return None
+        from frankenpaxos_tpu.runtime.sim_transport import (
+            DeliverMessage,
+        )
+
+        self.history.append(DeliverMessage(message))
+        actor = self.actors.get(message.dst)
+        if actor is None:
+            self.logger.warn(f"no actor registered at {message.dst}")
+            return None
+        actor.receive(message.src,
+                      actor.serializer.from_bytes(message.data))
+        return actor
+
+    def _drain(self, actor):
+        actor.on_drain()
+
+    originals = (SimTransport.send, SimTransport._deliver,
+                 SimTransport._drain)
+
+    def enter():
+        SimTransport.send = send
+        SimTransport._deliver = _deliver
+        SimTransport._drain = _drain
+
+    def exit():
+        (SimTransport.send, SimTransport._deliver,
+         SimTransport._drain) = originals
+
+    return enter, exit
+
+
+def measure(arm: str, inflight: int, waves: int, warm: int = 2,
+            sample_rate: float = 1.0 / 64) -> dict:
+    """One timed run of the coalesced multipaxos pipeline under
+    ``arm``; returns {"cmds_per_sec": ..., "spans": ...}."""
+    import gc
+
+    from frankenpaxos_tpu.bench.wal_lt import _drive_waves
+    from tests.protocols.multipaxos_harness import make_multipaxos
+
+    gc.collect()
+    enter = exit = None
+    if arm == "no-hooks":
+        enter, exit = _nohooks_patch()
+        enter()
+    try:
+        sim = make_multipaxos(f=1, coalesced=True)
+        tracer = None
+        if arm in ("sampled", "full"):
+            from frankenpaxos_tpu.obs import Tracer
+
+            tracer = Tracer(
+                role="bench",
+                sample_rate=1.0 if arm == "full" else sample_rate)
+            sim.transport.tracer = tracer
+        results: list = []
+        _drive_waves(sim, inflight, warm, b"w", results)
+        t0 = time.perf_counter()
+        _drive_waves(sim, inflight, waves, b"x", results)
+        elapsed = time.perf_counter() - t0
+        assert len(results) == (warm + waves) * inflight, (
+            arm, inflight, len(results))
+        return {"cmds_per_sec": waves * inflight / elapsed,
+                "spans": len(tracer.spans) if tracer else 0}
+    finally:
+        if exit is not None:
+            exit()
+
+
+def sim_ab(inflights, reps: int = 6, waves: int = 0) -> dict:
+    """Interleaved paired A/B across the four arms (multipaxos_lt
+    sim_ab_pipeline methodology; ratios are per-rep pairs, the table
+    rows their medians)."""
+    import statistics
+
+    table = {}
+    for inflight in inflights:
+        # Enough waves that each timed segment runs long enough to
+        # swamp scheduler noise (~8k commands per measurement): a
+        # 20ms segment cannot resolve a 3% gate.
+        w = waves or max(8, 8192 // inflight)
+        runs: dict = {arm: [] for arm in ARMS}
+        ratios: dict = {key: [] for key in
+                        ("off/no-hooks", "sampled/off", "full/off")}
+        spans = {}
+        for rep in range(reps):
+            order = list(ARMS[rep % len(ARMS):]) \
+                + list(ARMS[:rep % len(ARMS)])
+            got = {}
+            for arm in order:
+                result = measure(arm, inflight, w)
+                got[arm] = result["cmds_per_sec"]
+                if result["spans"]:
+                    spans[arm] = result["spans"]
+            for arm in ARMS:
+                runs[arm].append(got[arm])
+            ratios["off/no-hooks"].append(got["off"] / got["no-hooks"])
+            ratios["sampled/off"].append(got["sampled"] / got["off"])
+            ratios["full/off"].append(got["full"] / got["off"])
+        row = {f"{arm.replace('-', '_')}_cmds_per_sec":
+               round(statistics.median(runs[arm]), 1) for arm in ARMS}
+        for key, values in ratios.items():
+            row[f"ratio_{key.replace('/', '_over_').replace('-', '_')}"] \
+                = round(statistics.median(values), 4)
+            row[f"ratio_{key.replace('/', '_over_').replace('-', '_')}"
+                + "_range"] = [round(min(values), 4),
+                               round(max(values), 4)]
+        row["spans_per_arm"] = spans
+        row["commands_timed"] = w * inflight
+        table[str(inflight)] = row
+    return table
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sim_inflight", type=str, default="16,256,1024")
+    parser.add_argument("--sim_repeats", type=int, default=6)
+    parser.add_argument("--sim_ab_batches", type=int, default=3)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import statistics
+
+    from frankenpaxos_tpu.bench.deploy_suite import role_process_env
+
+    inflights = [int(x) for x in args.sim_inflight.split(",")]
+    per_width: dict = {str(i): [] for i in inflights}
+    for _batch in range(args.sim_ab_batches):
+        ab = subprocess.run(
+            [sys.executable, "-c",
+             "import json; from frankenpaxos_tpu.bench.trace_overhead "
+             f"import sim_ab; print(json.dumps(sim_ab({inflights!r}, "
+             f"reps={args.sim_repeats})))"],
+            capture_output=True, text=True, env=role_process_env(),
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        if ab.returncode != 0:
+            print(f"sim A/B batch failed (rc={ab.returncode}): "
+                  f"{ab.stderr[-500:]}", file=sys.stderr)
+            continue
+        out = json.loads(ab.stdout.strip().splitlines()[-1])
+        print(json.dumps({"sim_ab_batch": out}))
+        for key, row in out.items():
+            per_width[key].append(row)
+
+    merged = {}
+    worst_off_overhead = 0.0
+    for key, rows in per_width.items():
+        if not rows:
+            continue
+        row = {}
+        for field in rows[0]:
+            if field.endswith("_range"):
+                row[field] = [min(r[field][0] for r in rows),
+                              max(r[field][1] for r in rows)]
+            elif field == "spans_per_arm":
+                row[field] = rows[0][field]
+            elif field == "commands_timed":
+                row[field] = rows[0][field]
+            else:
+                row[field] = round(statistics.median(
+                    r[field] for r in rows), 4)
+        row["batches"] = len(rows)
+        overhead_pct = round(
+            (1.0 - row["ratio_off_over_no_hooks"]) * 100, 2)
+        row["off_overhead_pct"] = overhead_pct
+        worst_off_overhead = max(worst_off_overhead, overhead_pct)
+        merged[key] = row
+
+    result = {
+        "benchmark": "trace_overhead",
+        "host_cpus": os.cpu_count(),
+        "sim_ab": merged,
+        "off_overhead_pct_worst_width": round(worst_off_overhead, 2),
+        "gate": "tracing-off per-message overhead must be < 3%",
+        "gate_passed": worst_off_overhead < 3.0,
+        "methodology": (
+            "per-width ratio = median over independent subprocess "
+            "batches of each batch's paired-A/B median (the "
+            "multipaxos_lt sim_ab methodology) over the coalesced "
+            "multipaxos SimTransport pipeline; arms are no-hooks "
+            "(SimTransport deliver/drain/send monkeypatched with "
+            "verbatim pre-paxtrace bodies), off (shipped hooks, no "
+            "tracer), sampled (Tracer at 1/64 root sampling), full "
+            "(Tracer at 1.0). off/no-hooks isolates the disabled-"
+            "hook cost every deployment pays; sampled/off and "
+            "full/off price the tracing itself."),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()

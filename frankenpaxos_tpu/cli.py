@@ -81,6 +81,19 @@ def main(argv=None) -> None:
                              "wait-for-listen handshake: once this role "
                              "is fully constructed and listening, it "
                              "connects there and reports its label")
+    parser.add_argument("--trace", default=None, metavar="DIR",
+                        help="paxtrace root (obs/): emit receive/timer/"
+                             "drain spans with drain-stage sub-spans to "
+                             "DIR/<role>_<index>.trace.jsonl, keep the "
+                             "crash flight recorder ring in "
+                             "DIR/<role>_<index>.flight (mmap'd: "
+                             "survives kill -9), and propagate trace "
+                             "contexts on outbound frames")
+    parser.add_argument("--trace_sample", type=float, default=1.0,
+                        help="trace sampling rate at trace roots "
+                             "(1.0 = every command, 0.01 = 1 in 100); "
+                             "propagated contexts keep the root's "
+                             "decision")
     # Back-compat shorthands (now spelled --options.*):
     parser.add_argument("--quorum_backend", default=None,
                         choices=[None, "dict", "tpu"])
@@ -139,6 +152,32 @@ def main(argv=None) -> None:
         listen_address = addresses[args.index]
 
     transport = TcpTransport(listen_address, logger)
+    label = f"{args.role}_{args.index}"
+    if collectors is not None:
+        from frankenpaxos_tpu.obs import RuntimeMetrics
+
+        transport.runtime_metrics = RuntimeMetrics(collectors, label)
+    if args.trace:
+        import atexit
+        import os
+
+        from frankenpaxos_tpu.obs import FlightRecorder, Tracer
+
+        os.makedirs(args.trace, exist_ok=True)
+        tracer = Tracer(
+            role=label, sample_rate=args.trace_sample,
+            flight=FlightRecorder(
+                os.path.join(args.trace, f"{label}.flight")),
+            runtime_metrics=transport.runtime_metrics,
+            sink_path=os.path.join(args.trace,
+                                   f"{label}.trace.jsonl"),
+            # Incarnation salt: a crash-relaunched role appends to the
+            # same trace.jsonl and must not reuse the dead life's ids.
+            instance=os.getpid())
+        transport.tracer = tracer
+        # SIGTERM exits via sys.exit (below), so a clean kill flushes
+        # the span sink; a SIGKILL leaves the mmap'd flight ring.
+        atexit.register(tracer.flush)
     transport.start()
     ctx = DeployCtx(config=config, transport=transport, logger=logger,
                     overrides=overrides, seed=args.seed,

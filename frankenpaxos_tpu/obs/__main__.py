@@ -1,0 +1,74 @@
+"""paxtrace CLI: role trace dumps -> one Perfetto file + breakdown.
+
+Usage::
+
+    python -m frankenpaxos_tpu.obs <dir-or-trace.jsonl>... \
+        --out trace.json [--breakdown] [--flight <ring.flight>]
+
+Globs ``*.trace.jsonl`` under directories, merges every role's spans
+into one Chrome-trace-event JSON (load it at ui.perfetto.dev or
+chrome://tracing), prints the drain-stage latency-breakdown table,
+and renders flight-recorder rings to their post-mortem JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from frankenpaxos_tpu.obs.flight import FlightRecorder
+from frankenpaxos_tpu.obs.perfetto import (
+    format_breakdown,
+    latency_breakdown,
+    load_jsonl,
+    to_chrome_trace,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="frankenpaxos_tpu.obs")
+    parser.add_argument("inputs", nargs="*",
+                        help="trace.jsonl files or directories of them")
+    parser.add_argument("--out", default=None,
+                        help="write merged Chrome-trace JSON here")
+    parser.add_argument("--breakdown", action="store_true",
+                        help="print the per-stage latency table")
+    parser.add_argument("--flight", action="append", default=[],
+                        help="flight-recorder ring file to render "
+                             "(repeatable); writes <file>.json")
+    args = parser.parse_args(argv)
+
+    paths = []
+    for item in args.inputs:
+        if os.path.isdir(item):
+            paths.extend(sorted(glob.glob(
+                os.path.join(item, "*.trace.jsonl"))))
+        else:
+            paths.append(item)
+    records = []
+    for path in paths:
+        records.extend(load_jsonl(path))
+    records.sort(key=lambda r: (r.t0, r.role, r.span_id))
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(to_chrome_trace(records), f)
+        print(f"wrote {args.out} ({len(records)} spans from "
+              f"{len(paths)} role dumps)")
+    if args.breakdown:
+        print(format_breakdown(latency_breakdown(records)))
+    for ring in args.flight:
+        out = ring + ".json"
+        dump = FlightRecorder.dump_file(ring, out)
+        print(f"wrote {out} ({len(dump['records'])} records)")
+    if not (args.out or args.breakdown or args.flight):
+        parser.print_usage()
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,434 @@
+"""Trace contexts, spans, and the per-role Tracer.
+
+THE PROPAGATION LAYER: a ``TraceContext`` (trace_id, parent span_id,
+sampling bit) rides the transport FRAME header -- ``host:port|<ctx>``
+on TCP frames, a ``trace`` field on ``SimMessage`` -- never the
+message codecs: the wire tag space 1..127 is fully allocated, and the
+frame layer reaches every protocol uniformly without touching a single
+codec. Roles that never heard of tracing still propagate it, because
+propagation lives in the two transports.
+
+SPAN MODEL (docs/OBSERVABILITY.md): the transports emit one span per
+``receive`` (parented by the frame's context, or a fresh sampled root
+when the frame carries none -- the client edge), one per timer fire,
+and one per ``on_drain``. The drain span adopts the context of the
+LAST sampled message delivered in its batch (group commit serves a
+batch; the adopted command's critical path runs through its batch's
+drain). Inside handlers and drains, ``Actor.trace_stage`` opens
+drain-stage sub-spans -- decode, handler, quorum-kernel, wal-fsync,
+send-release -- the stages the latency-breakdown table attributes
+per-command time to.
+
+DETERMINISM: ids come from a per-role counter (salted with a CRC of
+the role name so roles never collide), and the clock is injectable --
+``VirtualClock`` advances a fixed tick per reading, so a SimTransport
+trace is a pure function of the command sequence and golden-testable.
+
+OVERHEAD: with no tracer attached every hook is one attribute load +
+``is None`` test (measured in bench_results/trace_overhead.json).
+Unsampled traces propagate their context (so the sampling decision is
+made ONCE, at the root) but never read the clock or allocate records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import zlib
+from typing import Callable, Optional
+
+_MASK64 = (1 << 64) - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """What propagates: which trace, which parent span, sampled or not."""
+
+    trace_id: int
+    span_id: int
+    sampled: bool
+
+    def encode(self) -> str:
+        """Frame-header form. No ``:`` or ``|`` (both are taken by the
+        ``host:port|ctx`` header grammar)."""
+        return (f"{self.trace_id:016x}.{self.span_id:016x}."
+                f"{1 if self.sampled else 0}")
+
+    @classmethod
+    def decode(cls, text: str) -> "Optional[TraceContext]":
+        parts = text.split(".")
+        if len(parts) != 3:
+            return None
+        try:
+            return cls(trace_id=int(parts[0], 16),
+                       span_id=int(parts[1], 16),
+                       sampled=parts[2] == "1")
+        except ValueError:
+            return None
+
+
+@dataclasses.dataclass
+class SpanRecord:
+    """One finished span (the unit perfetto.py exports)."""
+
+    name: str       # e.g. "receive:Phase2a", "drain", "stage:wal-fsync"
+    cat: str        # receive | timer | drain | stage | event
+    role: str       # the tracer's role label ("acceptor_1")
+    t0: float       # seconds (shared wall clock; virtual in sim)
+    dur: float      # seconds
+    trace_id: int
+    span_id: int
+    parent_id: int  # 0 = root
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "cat": self.cat, "role": self.role,
+                "t0": round(self.t0, 9), "dur": round(self.dur, 9),
+                "trace_id": f"{self.trace_id:016x}",
+                "span_id": f"{self.span_id:016x}",
+                "parent_id": f"{self.parent_id:016x}"}
+
+    @classmethod
+    def from_json(cls, row: dict) -> "SpanRecord":
+        return cls(name=row["name"], cat=row["cat"], role=row["role"],
+                   t0=row["t0"], dur=row["dur"],
+                   trace_id=int(row["trace_id"], 16),
+                   span_id=int(row["span_id"], 16),
+                   parent_id=int(row["parent_id"], 16))
+
+
+class VirtualClock:
+    """A deterministic clock: every reading advances a fixed tick.
+    SimTransport traces under it are pure functions of the command
+    sequence (the golden-trace tests rely on this)."""
+
+    def __init__(self, start: float = 0.0, tick_s: float = 1e-6):
+        self.now = start
+        self.tick_s = tick_s
+
+    def __call__(self) -> float:
+        self.now += self.tick_s
+        return self.now
+
+
+class RuntimeMetrics:
+    """The drain-granular runtime metrics every role exports when the
+    metrics endpoint is on (with or without tracing): drain-stage
+    latency histograms, inbound queue depth (messages per drain
+    batch), and WAL group-commit fsync latency. These feed the shared
+    "runtime" Grafana row and the promdb scrapes."""
+
+    def __init__(self, collectors, role: str):
+        self.role = role
+        self._stage_hist = collectors.histogram(
+            "fpx_runtime_drain_stage_seconds",
+            help="Per-drain-stage latency (decode/handler/quorum-kernel/"
+                 "wal-fsync/send-release)",
+            labels=("role", "stage"))
+        self._depth_gauge = collectors.gauge(
+            "fpx_runtime_inbound_queue_depth",
+            help="Messages delivered in the current drain batch",
+            labels=("role",)).labels(role)
+        self._fsync_hist = collectors.histogram(
+            "fpx_runtime_wal_fsync_seconds",
+            help="WAL group-commit fsync latency (one per drain)",
+            labels=("role",)).labels(role)
+        self._stage_children: dict = {}
+
+    def observe_stage(self, stage: str, dur_s: float) -> None:
+        child = self._stage_children.get(stage)
+        if child is None:
+            child = self._stage_hist.labels(self.role, stage)
+            self._stage_children[stage] = child
+        child.observe(dur_s)
+        if stage == "wal-fsync":
+            self._fsync_hist.observe(dur_s)
+
+    def observe_batch(self, depth: int) -> None:
+        self._depth_gauge.set(depth)
+
+
+class _Scope:
+    """An active span: sets ``tracer.current`` for its dynamic extent
+    so sends made inside it propagate its context."""
+
+    __slots__ = ("tracer", "name", "cat", "ctx", "parent_id", "prev",
+                 "t0", "m0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 ctx: TraceContext, parent_id: int):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.ctx = ctx
+        self.parent_id = parent_id
+
+    def __enter__(self) -> "_Scope":
+        tracer = self.tracer
+        self.prev = tracer.current
+        tracer.current = self.ctx
+        if self.ctx.sampled:
+            self.t0 = tracer.clock()
+            # Durations come from the MONOTONIC clock (an NTP step
+            # between enter and exit would otherwise record a
+            # negative duration and corrupt the latency histograms);
+            # t0 stays on the shared wall clock so role tracks align.
+            self.m0 = (self.t0 if tracer.mono is tracer.clock
+                       else tracer.mono())
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        tracer = self.tracer
+        tracer.current = self.prev
+        if self.ctx.sampled:
+            m1 = (tracer.clock() if tracer.mono is tracer.clock
+                  else tracer.mono())
+            tracer._record(SpanRecord(
+                name=self.name, cat=self.cat, role=tracer.role,
+                t0=self.t0, dur=m1 - self.m0,
+                trace_id=self.ctx.trace_id, span_id=self.ctx.span_id,
+                parent_id=self.parent_id))
+            if self.cat == "stage" and tracer.runtime_metrics is not None:
+                tracer.runtime_metrics.observe_stage(
+                    self.name[len("stage:"):], m1 - self.m0)
+        return False
+
+
+class _MetricStage:
+    """Stage timing with metrics only (tracing off but /metrics on):
+    feeds the drain-stage histogram without emitting spans."""
+
+    __slots__ = ("metrics", "stage", "t0")
+
+    def __init__(self, metrics: RuntimeMetrics, stage: str):
+        self.metrics = metrics
+        self.stage = stage
+
+    def __enter__(self) -> "_MetricStage":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.metrics.observe_stage(self.stage,
+                                   time.perf_counter() - self.t0)
+        return False
+
+
+class _Noop:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SCOPE = _Noop()
+
+
+def stage_scope(tracer: "Optional[Tracer]",
+                metrics: Optional[RuntimeMetrics], name: str):
+    """The one stage-timing entry point (Actor.trace_stage): a traced
+    sub-span, a metrics-only timer, or a shared no-op."""
+    if tracer is not None:
+        return tracer.stage(name)
+    if metrics is not None:
+        return _MetricStage(metrics, name)
+    return NOOP_SCOPE
+
+
+class Tracer:
+    """Per-role span emitter. One per process (deployed) or one per
+    harness (sim, shared across roles via per-call role labels is NOT
+    done -- each simulated role can share one tracer because the role
+    label rides each span via the transport's actor address)."""
+
+    def __init__(self, role: str = "",
+                 clock: Optional[Callable[[], float]] = None,
+                 sample_rate: float = 1.0,
+                 flight=None,
+                 runtime_metrics: Optional[RuntimeMetrics] = None,
+                 sink_path: Optional[str] = None,
+                 max_spans: int = 1 << 20,
+                 instance: int = 0):
+        self.role = role
+        self.clock = clock if clock is not None else time.time
+        # Durations are measured on a monotonic clock; a CUSTOM clock
+        # (VirtualClock) serves both roles so sim traces stay pure
+        # functions of the command sequence.
+        self.mono: Callable[[], float] = (
+            clock if clock is not None else time.perf_counter)
+        # Sampling is 1-in-N at trace ROOTS (deterministic, counter
+        # based); propagated contexts keep their bit unchanged.
+        self.sample_every = (1 if sample_rate >= 1.0
+                             else 0 if sample_rate <= 0.0
+                             else max(1, round(1.0 / sample_rate)))
+        self.flight = flight
+        self.runtime_metrics = runtime_metrics
+        self.current: Optional[TraceContext] = None
+        self.spans: list[SpanRecord] = []
+        self.max_spans = max_spans
+        # ``instance`` distinguishes INCARNATIONS of one role: a
+        # crash-relaunched role restarts its counter at 0, and with
+        # the same role salt its ids would collide with the killed
+        # life's in the appended trace.jsonl (the CLI passes the pid;
+        # sims keep the default 0 so traces stay deterministic).
+        self._salt = ((zlib.crc32(role.encode())
+                       ^ ((instance * 0x9E3779B1) & 0xFFFFFFFF))
+                      & 0xFFFFFFFF) << 32
+        self._next = 0
+        self._roots = 0
+        # Per-actor: colocated actors (supernode, every sim harness)
+        # share one tracer, and actor A's drain must never adopt the
+        # context of a receive that went to actor B.
+        self._drain_parent: dict[str, TraceContext] = {}
+        self._sink = open(sink_path, "a") if sink_path else None
+        self._sink_pending = 0
+
+    # --- ids / sampling ---------------------------------------------------
+    def _new_id(self) -> int:
+        self._next += 1
+        return (self._salt | (self._next & 0xFFFFFFFF)) & _MASK64
+
+    def _sample_root(self) -> bool:
+        if self.sample_every == 0:
+            return False
+        self._roots += 1
+        return (self._roots - 1) % self.sample_every == 0
+
+    # --- span factories (called by the transports) ------------------------
+    def receive_span(self, actor: str, msg_type: str,
+                     ctx: Optional[TraceContext]) -> _Scope:
+        """The per-message receive span. ``ctx`` is the frame's
+        context; a missing context makes this receive a trace ROOT
+        (the client-facing edge) under the sampling policy."""
+        if ctx is None:
+            ctx = TraceContext(trace_id=self._new_id(), span_id=0,
+                               sampled=self._sample_root())
+        child = TraceContext(trace_id=ctx.trace_id,
+                             span_id=self._new_id(),
+                             sampled=ctx.sampled)
+        if ctx.sampled:
+            self._drain_parent[actor] = child
+        return _Scope(self, f"receive:{msg_type}@{actor}", "receive",
+                      child, ctx.span_id)
+
+    def timer_span(self, actor: str, timer_name: str) -> _Scope:
+        ctx = TraceContext(trace_id=self._new_id(),
+                           span_id=self._new_id(),
+                           sampled=self._sample_root())
+        if ctx.sampled:
+            self._drain_parent[actor] = ctx
+        return _Scope(self, f"timer:{timer_name}@{actor}", "timer",
+                      ctx, 0)
+
+    def drain_span(self, actor: str) -> _Scope:
+        """The on_drain span: adopts THIS actor's last sampled receive
+        of the batch (group commit serves the batch; the adopted
+        command's critical path runs through its batch's drain)."""
+        parent = self._drain_parent.pop(actor, None)
+        if parent is None:
+            ctx = TraceContext(trace_id=self._new_id(), span_id=0,
+                               sampled=False)
+            parent_id = 0
+        else:
+            ctx = TraceContext(trace_id=parent.trace_id,
+                               span_id=self._new_id(),
+                               sampled=parent.sampled)
+            parent_id = parent.span_id
+        return _Scope(self, f"drain@{actor}", "drain", ctx, parent_id)
+
+    def stage(self, name: str):
+        """A drain-stage sub-span under the current context (decode,
+        handler, quorum-kernel, wal-fsync, send-release)."""
+        parent = self.current
+        if parent is None or not parent.sampled:
+            # No span for unsampled work -- but the RUNTIME METRICS
+            # must not be starved by the sampling rate (the Grafana
+            # row charts every fsync, not 1-in-N), so fall back to the
+            # metrics-only timer when one is attached. It leaves
+            # ``current`` untouched, which matches the unsampled span
+            # behavior exactly: an unsampled stage reuses the parent
+            # context anyway.
+            if self.runtime_metrics is not None:
+                return _MetricStage(self.runtime_metrics, name)
+            ctx = parent if parent is not None else TraceContext(
+                trace_id=0, span_id=0, sampled=False)
+            return _Scope(self, f"stage:{name}", "stage", ctx, 0)
+        ctx = TraceContext(trace_id=parent.trace_id,
+                           span_id=self._new_id(), sampled=True)
+        return _Scope(self, f"stage:{name}", "stage", ctx,
+                      parent.span_id)
+
+    def record_stage(self, name: str, m0: float,
+                     ctx: Optional[TraceContext]) -> None:
+        """A stage span recorded after the fact (ends now; ``m0`` is a
+        ``tracer.mono()`` reading from its start): the TCP receive
+        path times message decode before any span scope can be open,
+        because decode errors must stay inside the transport's
+        corrupt-frame guard."""
+        if ctx is None or not ctx.sampled:
+            return
+        if self.mono is self.clock:
+            dur = self.clock() - m0
+            t0 = m0
+        else:
+            dur = self.mono() - m0
+            t0 = self.clock() - dur
+        self._record(SpanRecord(
+            name=f"stage:{name}", cat="stage", role=self.role,
+            t0=t0, dur=dur, trace_id=ctx.trace_id,
+            span_id=self._new_id(), parent_id=ctx.span_id))
+        if self.runtime_metrics is not None:
+            self.runtime_metrics.observe_stage(name, dur)
+
+    def event(self, text: str) -> None:
+        """An instantaneous flight-recorder note (crash post-mortems:
+        'recovering 8124 records', 'phase1 restarted @ round 3')."""
+        t = self.clock()
+        if self.flight is not None:
+            self.flight.record(t, f"event {text}")
+        self._record(SpanRecord(
+            name=f"event:{text}", cat="event", role=self.role,
+            t0=t, dur=0.0, trace_id=0, span_id=self._new_id(),
+            parent_id=0))
+
+    # --- record sinks -----------------------------------------------------
+    def _record(self, record: SpanRecord) -> None:
+        # With a jsonl sink the file IS the record of truth; keeping a
+        # second in-memory copy would grow a long-running role by
+        # hundreds of MB at full sampling for data nothing reads.
+        if self._sink is None and len(self.spans) < self.max_spans:
+            self.spans.append(record)
+        if self.flight is not None and record.cat != "event":
+            self.flight.record(
+                record.t0 + record.dur,
+                f"{record.name} trace={record.trace_id:016x} "
+                f"dur_us={record.dur * 1e6:.1f}")
+        if self._sink is not None:
+            self._sink.write(json.dumps(record.to_json(),
+                                        separators=(",", ":")) + "\n")
+            self._sink_pending += 1
+            if self._sink_pending >= 64:
+                self._sink.flush()
+                self._sink_pending = 0
+
+    def flush(self) -> None:
+        if self._sink is not None:
+            self._sink.flush()
+            self._sink_pending = 0
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.flush()
+            self._sink.close()
+            self._sink = None
+        if self.flight is not None:
+            self.flight.close()
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for record in self.spans:
+                f.write(json.dumps(record.to_json(),
+                                   separators=(",", ":")) + "\n")

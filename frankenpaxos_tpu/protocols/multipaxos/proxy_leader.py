@@ -312,7 +312,10 @@ class ProxyLeader(Actor):
                                   m.acceptor_index)
 
     def on_drain(self) -> None:
-        self._emit_chosen(self.tracker.drain())
+        # paxtrace drain stage: the batched quorum check (dict tracker
+        # or TPU kernel dispatch) plus the Chosen emission it unlocks.
+        with self.trace_stage("quorum-kernel"):
+            self._emit_chosen(self.tracker.drain())
         if self._collector is not None:
             while True:
                 dispatch = self.tracker.take_dispatch()

@@ -22,7 +22,9 @@ from frankenpaxos_tpu.runtime.monitoring import (
     Collectors,
     Counter,
     FakeCollectors,
+    FakeHistogram,
     Gauge,
+    Histogram,
     PrometheusCollectors,
     Summary,
 )
@@ -39,9 +41,11 @@ __all__ = [
     "Collectors",
     "Counter",
     "FakeCollectors",
+    "FakeHistogram",
     "FakeLogger",
     "FileLogger",
     "Gauge",
+    "Histogram",
     "LogLevel",
     "Logger",
     "PickleSerializer",

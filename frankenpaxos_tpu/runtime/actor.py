@@ -11,6 +11,7 @@ from __future__ import annotations
 import abc
 from typing import Any, Callable, Generic, TypeVar
 
+from frankenpaxos_tpu.obs.trace import stage_scope
 from frankenpaxos_tpu.runtime.logger import Logger
 from frankenpaxos_tpu.runtime.serializer import (
     DEFAULT_SERIALIZER,
@@ -102,6 +103,16 @@ class Actor(abc.ABC):
 
     def flush(self, dst: Address) -> None:
         self.transport.flush(self.address, dst)
+
+    def trace_stage(self, name: str):
+        """A drain-stage scope (paxtrace, obs/): times ``name`` as a
+        sub-span of the current trace and/or an observation into the
+        runtime drain-stage histogram, whichever sinks are attached to
+        the transport; a shared no-op otherwise. The canonical stages
+        are decode, handler, quorum-kernel, wal-fsync, send-release."""
+        transport = self.transport
+        return stage_scope(transport.tracer, transport.runtime_metrics,
+                           name)
 
     def timer(self, name: str, delay_s: float,
               f: Callable[[], None]) -> Timer:

@@ -87,6 +87,37 @@ class _SummaryTimer:
         return False
 
 
+class Histogram(abc.ABC):
+    """A bucketed distribution (drain-stage latencies, WAL fsyncs):
+    the Prometheus exposition carries ``_bucket{le=...}``/``_sum``/
+    ``_count`` samples, which promdb keeps queryable by those suffixed
+    names."""
+
+    @abc.abstractmethod
+    def labels(self, *values: str) -> "Histogram":
+        ...
+
+    @abc.abstractmethod
+    def observe(self, value: float) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_count(self) -> float:
+        ...
+
+    @abc.abstractmethod
+    def get_sum(self) -> float:
+        ...
+
+
+#: Event-loop-scale latency buckets (seconds): the prometheus_client
+#: defaults start at 5ms -- useless for µs drain stages; these cover
+#: 1µs..1s, the span between a fused kernel pass and a stalled fsync.
+LATENCY_BUCKETS = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0)
+
+
 class Collectors(abc.ABC):
     """Metric builders (Collectors.scala:6-14)."""
 
@@ -103,6 +134,13 @@ class Collectors(abc.ABC):
     @abc.abstractmethod
     def summary(self, name: str, help: str = "",
                 labels: Sequence[str] = ()) -> Summary:
+        ...
+
+    @abc.abstractmethod
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = LATENCY_BUCKETS
+                  ) -> Histogram:
         ...
 
 
@@ -176,6 +214,38 @@ class FakeSummary(Summary):
         return self._root.value
 
 
+class FakeHistogram(Histogram):
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS):
+        self.buckets = tuple(buckets)
+        self._children: dict[tuple, "FakeHistogram"] = {}
+        self._root = _FakeChild()
+        self.bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf last
+
+    def labels(self, *values: str) -> "FakeHistogram":
+        # Label aliasing contract (same as the other fakes): repeated
+        # labels() calls with equal values share ONE child's state.
+        child = self._children.get(values)
+        if child is None:
+            child = FakeHistogram(self.buckets)
+            self._children[values] = child
+        return child
+
+    def observe(self, value: float) -> None:
+        self._root.value += value
+        self._root.count += 1
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                self.bucket_counts[i] += 1
+                return
+        self.bucket_counts[-1] += 1
+
+    def get_count(self) -> float:
+        return self._root.count
+
+    def get_sum(self) -> float:
+        return self._root.value
+
+
 class FakeCollectors(Collectors):
     def __init__(self):
         self.metrics: dict[str, object] = {}
@@ -188,6 +258,10 @@ class FakeCollectors(Collectors):
 
     def summary(self, name, help="", labels=()):
         return self.metrics.setdefault(name, FakeSummary())
+
+    def histogram(self, name, help="", labels=(),
+                  buckets=LATENCY_BUCKETS):
+        return self.metrics.setdefault(name, FakeHistogram(buckets))
 
 
 # --- Prometheus backend (PrometheusCollectors.scala) -----------------------
@@ -218,6 +292,14 @@ class PrometheusCollectors(Collectors):
 
     def summary(self, name, help="", labels=()):
         return _PromSummary(self._make(self._pc.Summary, name, help, labels))
+
+    def histogram(self, name, help="", labels=(),
+                  buckets=LATENCY_BUCKETS):
+        if name not in self._cache:
+            self._cache[name] = self._pc.Histogram(
+                name, help or name, list(labels),
+                buckets=list(buckets), registry=self._registry)
+        return _PromHistogram(self._cache[name])
 
 
 class _PromCounter(Counter):
@@ -266,6 +348,24 @@ class _PromSummary(Summary):
 
     def get_count(self) -> float:
         return self._m._count.get()
+
+    def get_sum(self) -> float:
+        return self._m._sum.get()
+
+
+class _PromHistogram(Histogram):
+    def __init__(self, metric):
+        self._m = metric
+
+    def labels(self, *values):
+        return _PromHistogram(self._m.labels(*values))
+
+    def observe(self, value: float) -> None:
+        self._m.observe(value)
+
+    def get_count(self) -> float:
+        return sum(b.get() for b in self._m._buckets) \
+            if hasattr(self._m, "_buckets") else 0.0
 
     def get_sum(self) -> float:
         return self._m._sum.get()

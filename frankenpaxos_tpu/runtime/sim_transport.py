@@ -29,6 +29,10 @@ class SimMessage:
     src: Address
     dst: Address
     data: bytes
+    # paxtrace: the frame-layer trace context (obs.TraceContext) --
+    # the sim analog of the TCP frame header's ``|ctx`` suffix. None
+    # whenever no tracer is attached or no context was active at send.
+    trace: object = None
 
 
 class SimTimer(Timer):
@@ -104,7 +108,10 @@ class SimTransport(Transport):
         self.actors[address] = actor
 
     def send(self, src: Address, dst: Address, data: bytes) -> None:
-        self.messages.append(SimMessage(next(self._ids), src, dst, data))
+        tracer = self.tracer
+        trace = tracer.current if tracer is not None else None
+        self.messages.append(
+            SimMessage(next(self._ids), src, dst, data, trace))
 
     def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
         self.send(src, dst, data)
@@ -127,6 +134,14 @@ class SimTransport(Transport):
         ``receive`` inline. Unknown/partitioned destinations drop."""
         actor = self._deliver(message)
         if actor is not None:
+            self._drain(actor)
+
+    def _drain(self, actor: Actor) -> None:
+        tracer = self.tracer
+        if tracer is None:
+            actor.on_drain()
+            return
+        with tracer.drain_span(str(actor.address)):
             actor.on_drain()
 
     def _deliver(self, message: SimMessage) -> Optional[Actor]:
@@ -147,7 +162,22 @@ class SimTransport(Transport):
         if actor is None:
             self.logger.warn(f"no actor registered at {message.dst}")
             return None
-        actor.receive(message.src, actor.serializer.from_bytes(message.data))
+        tracer = self.tracer
+        if tracer is None:
+            actor.receive(message.src,
+                          actor.serializer.from_bytes(message.data))
+            return actor
+        # Traced delivery: decode and handler run as drain-stage
+        # sub-spans of the per-message receive span, which is parented
+        # by the frame's propagated context (message.trace).
+        span = tracer.receive_span(str(message.dst), "?", message.trace)
+        with span:
+            with tracer.stage("decode"):
+                decoded = actor.serializer.from_bytes(message.data)
+            span.name = (f"receive:{type(decoded).__name__}"
+                         f"@{message.dst}")
+            with tracer.stage("handler"):
+                actor.receive(message.src, decoded)
         return actor
 
     def trigger_timer(self, timer_id: int) -> None:
@@ -159,7 +189,12 @@ class SimTransport(Transport):
             return
         self.history.append(
             TriggerTimer(timer.address, timer.name, timer_id))
-        timer.run()
+        tracer = self.tracer
+        if tracer is None:
+            timer.run()
+            return
+        with tracer.timer_span(str(timer.address), timer.name):
+            timer.run()
 
     def run_command(self, command: SimCommand) -> None:
         if isinstance(command, DeliverMessage):
@@ -220,7 +255,7 @@ class SimTransport(Transport):
                     seen.add(id(actor))
                     touched.append(actor)
             for actor in touched:
-                actor.on_drain()
+                self._drain(actor)
         return steps
 
     def partition(self, address: Address) -> None:
@@ -240,6 +275,8 @@ class SimTransport(Transport):
         drop as 'no actor registered' if nothing does. The restart is
         the harness's job: construct a fresh actor at the same address
         over the surviving WAL storage."""
+        if self.tracer is not None:
+            self.tracer.event(f"crash {address}")
         self.actors.pop(address, None)
         for timer_id in [tid for tid, t in self.timers.items()
                          if t.address == address]:

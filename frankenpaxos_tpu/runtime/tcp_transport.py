@@ -28,8 +28,10 @@ from __future__ import annotations
 import asyncio
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
+from frankenpaxos_tpu.obs.trace import TraceContext
 from frankenpaxos_tpu.runtime.actor import Actor
 from frankenpaxos_tpu.runtime.logger import Logger, PrintLogger
 from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
@@ -38,23 +40,36 @@ MAX_FRAME = 10 * 1024 * 1024  # 10 MiB, like the reference's frame decoder
 _LEN = struct.Struct(">I")
 
 
-def _encode_frame(src: Address, data: bytes) -> bytes:
+def _encode_frame(src: Address, data: bytes,
+                  ctx: "Optional[TraceContext]" = None) -> bytes:
     # The framing hot path runs through the native C++ codec when built
     # (frankenpaxos_tpu/native/codec.cpp), with an identical pure-Python
     # fallback inside `native.encode_frame`.
     from frankenpaxos_tpu import native
 
     host, port = src
-    return native.encode_frame(f"{host}:{port}".encode(), data)
+    # paxtrace: the trace context rides the FRAME HEADER
+    # (``host:port|<ctx>``), never the message codecs -- the wire tag
+    # space 1..127 is fully allocated, and the header reaches every
+    # protocol uniformly. Receivers without a "|" parse unchanged.
+    if ctx is None:
+        header = f"{host}:{port}".encode()
+    else:
+        header = f"{host}:{port}|{ctx.encode()}".encode()
+    return native.encode_frame(header, data)
 
 
 class TcpTimer(Timer):
     def __init__(self, loop: asyncio.AbstractEventLoop, name: str,
-                 delay_s: float, f: Callable[[], None]):
+                 delay_s: float, f: Callable[[], None],
+                 transport: "Optional[TcpTransport]" = None,
+                 address: Optional[Address] = None):
         self._loop = loop
         self._name = name
         self._delay_s = delay_s
         self._f = f
+        self._transport = transport
+        self._address = address
         self._handle: Optional[asyncio.TimerHandle] = None
 
     @property
@@ -78,7 +93,13 @@ class TcpTimer(Timer):
 
     def _fire(self) -> None:
         self._handle = None
-        self._f()
+        tracer = (self._transport.tracer
+                  if self._transport is not None else None)
+        if tracer is None:
+            self._f()
+            return
+        with tracer.timer_span(str(self._address), self._name):
+            self._f()
 
 
 class _Conn:
@@ -106,6 +127,7 @@ class TcpTransport(Transport):
         self._conns: dict[tuple[Address, Address], _Conn] = {}
         self._servers: dict[Address, asyncio.AbstractServer] = {}
         self._drain_scheduled: set = set()
+        self._batch_depth: dict = {}  # messages in the current drain
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
 
@@ -223,17 +245,45 @@ class TcpTransport(Transport):
                                     f"payload {end - start - 4}")
                             header = bytes(
                                 buf[start + 4:start + 4 + hlen]).decode()
-                            host, _, port = header.rpartition(":")
+                            # paxtrace: ``host:port|<ctx>`` -- the
+                            # address part first, then the optional
+                            # frame-layer trace context.
+                            addr_part, _, trace_part = header.partition(
+                                "|")
+                            host, _, port = addr_part.rpartition(":")
                             src: Address = (host, int(port))
+                            ctx = (TraceContext.decode(trace_part)
+                                   if trace_part else None)
                             data = bytes(buf[start + 4 + hlen:end])
-                            delivery = self._decode(local, src, data)
+                            tracer = self.tracer
+                            metrics = self.runtime_metrics
+                            if tracer is not None and ctx is not None \
+                                    and ctx.sampled:
+                                m0 = tracer.mono()
+                                delivery = self._decode(local, src, data)
+                                if delivery is not None:
+                                    tracer.record_stage("decode", m0,
+                                                        ctx)
+                            elif metrics is not None:
+                                # Unsampled (or context-less) frame
+                                # with /metrics on: the drain-stage
+                                # histogram still sees EVERY decode --
+                                # sampling must not starve it.
+                                p0 = time.perf_counter()
+                                delivery = self._decode(local, src, data)
+                                if delivery is not None:
+                                    metrics.observe_stage(
+                                        "decode",
+                                        time.perf_counter() - p0)
+                            else:
+                                delivery = self._decode(local, src, data)
                         except Exception as e:
                             self.logger.error(
                                 f"dropping connection on corrupt frame: "
                                 f"{e!r}")
                             return
                         if delivery is not None:
-                            self._deliver(*delivery)
+                            self._deliver(*delivery, ctx)
                     del buf[:consumed]
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -256,8 +306,30 @@ class TcpTransport(Transport):
             return None
         return actor, src, actor.serializer.from_bytes(data)
 
-    def _deliver(self, actor: Actor, src: Address, message) -> None:
-        actor.receive(src, message)
+    def _deliver(self, actor: Actor, src: Address, message,
+                 ctx: "Optional[TraceContext]" = None) -> None:
+        tracer = self.tracer
+        if tracer is None:
+            metrics = self.runtime_metrics
+            if metrics is not None:
+                # Metrics-only mode: the handler stage (usually the
+                # largest) must reach the drain-stage histogram like
+                # every other canonical stage does.
+                p0 = time.perf_counter()
+                actor.receive(src, message)
+                metrics.observe_stage("handler",
+                                      time.perf_counter() - p0)
+            else:
+                actor.receive(src, message)
+        else:
+            span = tracer.receive_span(
+                str(actor.address), type(message).__name__, ctx)
+            with span:
+                with tracer.stage("handler"):
+                    actor.receive(src, message)
+        if self.runtime_metrics is not None:
+            self._batch_depth[actor] = \
+                self._batch_depth.get(actor, 0) + 1
         # Defer on_drain to the end of this event-loop pass so every
         # frame already buffered (a burst of Phase2bs) lands in ONE
         # drain -- the batching the device kernels amortize over
@@ -269,7 +341,15 @@ class TcpTransport(Transport):
 
     def _drain_actor(self, actor: Actor) -> None:
         self._drain_scheduled.discard(actor)
-        actor.on_drain()
+        if self.runtime_metrics is not None:
+            self.runtime_metrics.observe_batch(
+                self._batch_depth.pop(actor, 0))
+        tracer = self.tracer
+        if tracer is None:
+            actor.on_drain()
+            return
+        with tracer.drain_span(str(actor.address)):
+            actor.on_drain()
 
     def listen_on(self, address: Address) -> None:
         """Bind a listener for ``address`` ahead of actor registration
@@ -315,7 +395,8 @@ class TcpTransport(Transport):
         return conn
 
     def _write(self, src: Address, dst: Address, data: bytes,
-               flush: bool) -> None:
+               flush: bool,
+               ctx: "Optional[TraceContext]" = None) -> None:
         assert self.loop is not None, "transport not started"
         conn = self._conn_for(src, dst)
         if conn.writer is not None and conn.writer.is_closing():
@@ -329,7 +410,7 @@ class TcpTransport(Transport):
             # at-most-once transport contract; protocol resends cover
             # them.
             conn.writer = None
-        conn.pending.append(_encode_frame(src, data))
+        conn.pending.append(_encode_frame(src, data, ctx))
         if conn.writer is not None:
             if flush:
                 self._flush_conn(conn)
@@ -364,11 +445,22 @@ class TcpTransport(Transport):
             conn.writer = None
         conn.pending.clear()
 
+    def _send_ctx(self) -> "Optional[TraceContext]":
+        """The trace context to stamp on an outbound frame: captured at
+        the SEND CALL (the caller's active span), not when the deferred
+        write runs on the loop."""
+        tracer = self.tracer
+        return tracer.current if tracer is not None else None
+
     def send(self, src: Address, dst: Address, data: bytes) -> None:
-        self._call_on_loop(lambda: self._write(src, dst, data, flush=True))
+        ctx = self._send_ctx()
+        self._call_on_loop(
+            lambda: self._write(src, dst, data, flush=True, ctx=ctx))
 
     def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
-        self._call_on_loop(lambda: self._write(src, dst, data, flush=False))
+        ctx = self._send_ctx()
+        self._call_on_loop(
+            lambda: self._write(src, dst, data, flush=False, ctx=ctx))
 
     def flush(self, src: Address, dst: Address) -> None:
         self._call_on_loop(
@@ -396,4 +488,5 @@ class TcpTransport(Transport):
     def timer(self, address: Address, name: str, delay_s: float,
               f: Callable[[], None]) -> TcpTimer:
         assert self.loop is not None, "transport not started"
-        return TcpTimer(self.loop, name, delay_s, f)
+        return TcpTimer(self.loop, name, delay_s, f, transport=self,
+                        address=address)

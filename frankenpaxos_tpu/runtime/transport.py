@@ -53,6 +53,16 @@ class Transport(abc.ABC):
     # the caller's thread, so everything must stay synchronous.
     threaded: bool = False
 
+    # paxtrace (obs/): an attached obs.Tracer makes the transport emit
+    # receive/timer/drain spans and propagate trace contexts at the
+    # frame layer; an attached obs.RuntimeMetrics feeds the
+    # drain-granular runtime metrics (stage histograms, queue depth).
+    # None (the default) keeps every hook to one attribute load + an
+    # ``is None`` test -- the <3% tracing-off budget
+    # (bench_results/trace_overhead.json).
+    tracer = None
+    runtime_metrics = None
+
     @abc.abstractmethod
     def register(self, address: Address, actor: "Actor") -> None:
         """Register ``actor`` to receive messages addressed to ``address``.

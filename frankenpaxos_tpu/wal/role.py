@@ -32,16 +32,22 @@ class DurableRole:
     def _wal_drain(self) -> None:
         """The on_drain tail for durable roles: ONE fsync covers every
         record this drain appended, compaction runs on the same
-        boundary, and only then do the held acks go out."""
+        boundary, and only then do the held acks go out. The two
+        paxtrace drain stages here -- wal-fsync and send-release --
+        are exactly the latency a command spends waiting on the group
+        commit (the dominant cloud-Paxos cost PAPERS.md's experience
+        report attributes poorly without tracing)."""
         if self.wal is None:
             return
-        self.wal.sync()
+        with self.trace_stage("wal-fsync"):
+            self.wal.sync()
         if self.wal.wants_compaction():
             self._wal_compact()
         if self._wal_sends:
             sends, self._wal_sends = self._wal_sends, []
-            for dst, message in sends:
-                self.send(dst, message)
+            with self.trace_stage("send-release"):
+                for dst, message in sends:
+                    self.send(dst, message)
 
     def _wal_compact(self) -> None:  # pragma: no cover - roles override
         raise NotImplementedError

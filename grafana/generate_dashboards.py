@@ -32,34 +32,83 @@ _DATASOURCE = {"type": "prometheus", "uid": "${DS_PROMETHEUS}"}
 
 
 def _panel(panel_id: int, title: str, expr: str, legend: str, unit: str,
-           x: int, y: int) -> dict:
+           x: int, y: int, w: int = 12) -> dict:
     return {
         "id": panel_id,
         "type": "timeseries",
         "title": title,
-        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "gridPos": {"h": 8, "w": w, "x": x, "y": y},
         "datasource": _DATASOURCE,
         "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
         "targets": [{"expr": expr, "legendFormat": legend, "refId": "A"}],
     }
 
 
+# The SHARED runtime row (paxtrace, obs/): the same three panels on
+# every protocol dashboard, over the uniform fpx_runtime_* metrics the
+# transports/WAL export for every role (see obs.RuntimeMetrics) --
+# drain-stage time share, inbound queue depth, and WAL group-commit
+# fsync latency. Panel ids 9000+ so they never collide with the
+# per-role panels (generated) or the hand-written multipaxos ones.
+RUNTIME_ROW_TITLE = "Runtime (drain stages / queue depth / WAL fsync)"
+
+
+def runtime_row_panels(y: int = 0) -> list:
+    return [
+        {
+            "id": 9000,
+            "type": "row",
+            "title": RUNTIME_ROW_TITLE,
+            "collapsed": False,
+            "gridPos": {"h": 1, "w": 24, "x": 0, "y": y},
+            "panels": [],
+        },
+        _panel(
+            9001, "Drain-stage time share",
+            "sum by (stage) "
+            "(rate(fpx_runtime_drain_stage_seconds_sum[5s]))",
+            "{{stage}}", "s", x=0, y=y + 1, w=8),
+        _panel(
+            9002, "Inbound queue depth (msgs/drain)",
+            "fpx_runtime_inbound_queue_depth",
+            "{{role}}", "short", x=8, y=y + 1, w=8),
+        _panel(
+            9003, "WAL fsync latency p99 / mean",
+            "histogram_quantile(0.99, sum by (le) "
+            "(rate(fpx_runtime_wal_fsync_seconds_bucket[5s])))",
+            "p99", "s", x=16, y=y + 1, w=8),
+    ]
+
+
+# The fsync panel charts the p99 AND the mean on one graph.
+_FSYNC_MEAN_TARGET = {
+    "expr": ("sum(rate(fpx_runtime_wal_fsync_seconds_sum[5s])) / "
+             "sum(rate(fpx_runtime_wal_fsync_seconds_count[5s]))"),
+    "legendFormat": "mean",
+    "refId": "B",
+}
+
+
 def dashboard(protocol: str, roles: list) -> dict:
-    panels = []
+    panels = runtime_row_panels(y=0)
+    panels[-1]["targets"].append(dict(_FSYNC_MEAN_TARGET))
+    # Role panels start right under the runtime row (header h=1 +
+    # panels h=8 -> y=9); Grafana renders stored gridPos verbatim, so
+    # a gap here would show as a blank band on every dashboard.
     for row, role in enumerate(roles):
         pretty = role.replace("_", " ").capitalize()
         metric = f"{protocol}_{role}"
         panels.append(_panel(
             2 * row, f"{pretty} request throughput",
             f"sum(rate({metric}_requests_total[1s])) by (type)",
-            "{{type}}", "ops", x=0, y=8 * row))
+            "{{type}}", "ops", x=0, y=9 + 8 * row))
         panels.append(_panel(
             2 * row + 1, f"{pretty} handler latency (mean)",
             f"sum(rate({metric}_requests_latency_seconds_sum[1s])) "
             f"by (type) / "
             f"sum(rate({metric}_requests_latency_seconds_count[1s])) "
             f"by (type)",
-            "{{type}}", "s", x=12, y=8 * row))
+            "{{type}}", "s", x=12, y=9 + 8 * row))
     return {
         "uid": f"fpx-{protocol}",
         "title": f"FrankenPaxos TPU / {protocol}",
@@ -79,6 +128,29 @@ def dashboard(protocol: str, roles: list) -> dict:
     }
 
 
+def inject_runtime_row(path: str) -> None:
+    """Prepend the shared runtime row to a HAND-WRITTEN dashboard
+    (multipaxos, batching) without touching its own panels: existing
+    9000-series panels are replaced (re-running is idempotent), and
+    everything else shifts below the row."""
+    with open(path) as f:
+        board = json.load(f)
+    own = [p for p in board["panels"] if p["id"] < 9000]
+    row = runtime_row_panels(y=0)
+    row[-1]["targets"].append(dict(_FSYNC_MEAN_TARGET))
+    row_height = 1 + max(p["gridPos"]["h"] for p in row[1:])
+    shifted_ids = {p["id"] for p in board["panels"]} != {
+        p["id"] for p in own}
+    for panel in own:
+        if not shifted_ids:  # first injection: move them down once
+            panel["gridPos"]["y"] += row_height
+    board["panels"] = row + own
+    with open(path, "w") as f:
+        json.dump(board, f, indent=2)
+        f.write("\n")
+    print(f"injected runtime row into {path}")
+
+
 def main() -> None:
     for protocol in PROTOCOL_NAMES:
         if protocol in HAND_WRITTEN:
@@ -89,6 +161,8 @@ def main() -> None:
             json.dump(dashboard(protocol, roles), f, indent=2)
             f.write("\n")
         print(f"wrote {path} ({len(roles)} roles)")
+    for name in sorted(HAND_WRITTEN | {"batching"}):
+        inject_runtime_row(os.path.join(OUT_DIR, f"{name}.json"))
 
 
 if __name__ == "__main__":

@@ -301,6 +301,85 @@ def test_tpu208_fsync_in_on_drain_is_fine(tmp_path):
     assert "TPU208" not in rules_of(findings)
 
 
+def test_tpu209_clock_read_in_ops_kernel(tmp_path):
+    """A host clock read inside ops/ kernel code is flagged -- span
+    timing belongs to the transports/drain (obs/), never kernels."""
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    import time
+
+    def check_block(board):
+        t0 = time.perf_counter()
+        result = board.sum()
+        return result, time.perf_counter() - t0
+    """}))
+    tpu209 = [f for f in findings if f.rule == "TPU209"]
+    assert {f.detail for f in tpu209} == {"time.perf_counter"}
+
+
+def test_tpu209_trace_hook_reachable_from_ops_kernel(tmp_path):
+    """Span-emitting hooks (trace_stage & friends) transitively
+    reachable from a kernel are flagged at the reached site."""
+    findings = run_rules(project(tmp_path, {
+        "ops/kernel.py": """
+    from pkg.helper import timed_step
+
+    def record_and_check(board):
+        return timed_step(board)
+    """,
+        "helper.py": """
+    def timed_step(board):
+        with board.owner.trace_stage("quorum-kernel"):
+            return board.sum()
+    """}))
+    assert any(f.rule == "TPU209" and f.scope == "timed_step"
+               and f.detail.endswith("trace_stage")
+               for f in findings)
+
+
+def test_tpu209_trace_hook_in_jitted_function(tmp_path):
+    """Inside a jitted body the hook would run once at trace time and
+    never again -- silently wrong, so it is flagged project-wide."""
+    findings = run_rules(project(tmp_path, {"fast.py": """
+    import time
+
+    import jax
+
+    @jax.jit
+    def step(x):
+        t0 = time.monotonic()
+        return x + t0
+    """}))
+    assert any(f.rule == "TPU209" and f.scope == "step"
+               for f in findings)
+
+
+def test_tpu209_spans_in_on_drain_are_fine(tmp_path):
+    """The drain path OUTSIDE kernels is exactly where stage spans
+    belong: trace_stage/perf_counter in an actor's on_drain (not under
+    ops/, not jitted) stays quiet."""
+    findings = run_rules(project(tmp_path, {"roles.py": """
+    import time
+
+    class Role:
+        def on_drain(self):
+            with self.trace_stage("wal-fsync"):
+                self.wal.sync()
+            self.latency = time.perf_counter()
+    """}))
+    assert "TPU209" not in rules_of(findings)
+
+
+def test_tpu209_summary_timer_not_a_clock_read(tmp_path):
+    """``metrics.time()`` (the Summary timer) and bare ``time()`` are
+    not clock reads; only the time-module entry points are."""
+    findings = run_rules(project(tmp_path, {"ops/kernel.py": """
+    def check(board, metrics):
+        with metrics.time():
+            return board.sum()
+    """}))
+    assert "TPU209" not in rules_of(findings)
+
+
 def test_tpu204_coercion_of_traced_value(tmp_path):
     findings = run_rules(project(tmp_path, {"ops/kernel.py": """
     import jax

@@ -1,0 +1,144 @@
+"""The monitoring facade's fakes under label aliasing, the Histogram
+backend pair, and a promdb scrape -> query round-trip against a REAL
+prometheus_client endpoint (the production exposition path end to
+end: register -> observe -> HTTP scrape -> parse -> PromQL subset)."""
+
+from __future__ import annotations
+
+import pytest
+
+from frankenpaxos_tpu.runtime.monitoring import (
+    FakeCollectors,
+    LATENCY_BUCKETS,
+    PrometheusCollectors,
+)
+
+
+class TestFakeLabelAliasing:
+    def test_summary_observations_alias_across_labels_handles(self):
+        """Two labels() handles with EQUAL values share one child:
+        observations through either are visible through both (the
+        aliasing contract protocol code relies on when it re-derives a
+        labeled child per call)."""
+        collectors = FakeCollectors()
+        s = collectors.summary("lat", labels=("type",))
+        a1 = s.labels("Phase2a")
+        a2 = s.labels("Phase2a")
+        other = s.labels("Phase2b")
+        a1.observe(0.25)
+        a2.observe(0.75)
+        assert a1.get_count() == 2
+        assert a2.get_count() == 2
+        assert a1.get_sum() == pytest.approx(1.0)
+        assert other.get_count() == 0
+        # The aliased children never leak into the parent's root.
+        assert s.get_count() == 0
+
+    def test_gauge_inc_dec_round_trip(self):
+        collectors = FakeCollectors()
+        g = collectors.gauge("depth", labels=("role",))
+        child = g.labels("acceptor_0")
+        child.inc(5)
+        child.dec(2)
+        assert g.labels("acceptor_0").get() == 3
+        g.labels("acceptor_0").dec(3)
+        assert child.get() == 0
+        # set() through one handle, read through another.
+        child.set(41)
+        g.labels("acceptor_0").inc()
+        assert child.get() == 42
+        assert g.labels("acceptor_1").get() == 0
+
+    def test_counter_aliasing(self):
+        collectors = FakeCollectors()
+        c = collectors.counter("reqs", labels=("type",))
+        c.labels("A").inc()
+        c.labels("A").inc(2)
+        assert c.labels("A").get() == 3
+        assert c.labels("B").get() == 0
+
+    def test_histogram_aliasing_and_buckets(self):
+        collectors = FakeCollectors()
+        h = collectors.histogram("stage_seconds",
+                                 labels=("role", "stage"))
+        h.labels("r0", "decode").observe(2e-6)
+        h.labels("r0", "decode").observe(0.2)
+        child = h.labels("r0", "decode")
+        assert child.get_count() == 2
+        assert child.get_sum() == pytest.approx(0.200002)
+        # 2e-6 lands in the 2.5e-6 bucket, 0.2 in the 0.25 bucket.
+        assert child.bucket_counts[LATENCY_BUCKETS.index(2.5e-6)] == 1
+        assert child.bucket_counts[LATENCY_BUCKETS.index(0.25)] == 1
+        assert h.labels("r1", "decode").get_count() == 0
+
+    def test_histogram_overflow_bucket(self):
+        collectors = FakeCollectors()
+        h = collectors.histogram("x")
+        h.observe(1e9)
+        assert h.bucket_counts[-1] == 1
+        assert h.get_count() == 1
+
+
+class TestPrometheusHistogram:
+    def test_observe_and_read_back(self):
+        pc = pytest.importorskip("prometheus_client")
+        collectors = PrometheusCollectors(
+            registry=pc.CollectorRegistry())
+        h = collectors.histogram("fpx_test_stage_seconds",
+                                 labels=("stage",))
+        child = h.labels("wal-fsync")
+        child.observe(1e-4)
+        child.observe(2e-3)
+        assert child.get_count() == 2
+        assert child.get_sum() == pytest.approx(2.1e-3)
+
+    def test_same_name_same_metric(self):
+        pc = pytest.importorskip("prometheus_client")
+        collectors = PrometheusCollectors(
+            registry=pc.CollectorRegistry())
+        a = collectors.histogram("fpx_dup_seconds")
+        b = collectors.histogram("fpx_dup_seconds")
+        a.observe(0.5)
+        assert b.get_count() == 1
+
+
+def test_promdb_round_trip_against_real_prometheus_endpoint():
+    """register -> observe -> HTTP /metrics -> bench.metrics.scrape ->
+    MetricsDB -> PromQL subset, with label values that defeat naive
+    space-splitting and histogram suffix series included."""
+    pc = pytest.importorskip("prometheus_client")
+
+    from frankenpaxos_tpu.bench.harness import free_port
+    from frankenpaxos_tpu.bench.promdb import MetricsDB
+
+    registry = pc.CollectorRegistry()
+    counter = pc.Counter("rt_cmds_total", "commands", ["kind"],
+                         registry=registry)
+    counter.labels('write "hello world"').inc(7)
+    hist = pc.Histogram("rt_stage_seconds", "stages", ["stage"],
+                        buckets=[0.001, 0.1], registry=registry)
+    hist.labels("wal fsync").observe(0.05)
+    hist.labels("wal fsync").observe(0.0005)
+
+    port = free_port()
+    server, thread = pc.start_http_server(port, registry=registry)
+    try:
+        db = MetricsDB()
+        db.scrape_once({"role_0": port})
+
+        df = db.query('rt_cmds_total{kind="write \\"hello world\\""}')
+        assert not df.empty
+        assert df.iloc[-1].max() == 7.0
+
+        # Histogram suffix series survive the scrape and stay
+        # queryable by their suffixed names + le label.
+        assert db.query("rt_stage_seconds_count").iloc[-1].max() == 2.0
+        assert db.query("rt_stage_seconds_sum").iloc[-1].max() == \
+            pytest.approx(0.0505)
+        buckets = db.query('rt_stage_seconds_bucket{le="0.001"}')
+        assert buckets.iloc[-1].max() == 1.0
+        inf = db.query('rt_stage_seconds_bucket{le="+Inf"}')
+        assert inf.iloc[-1].max() == 2.0
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)

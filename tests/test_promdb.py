@@ -36,6 +36,63 @@ def test_scraped_key_parsing():
         "__name__": "bar", "job": "j"}
 
 
+def test_scraped_key_label_values_with_spaces_and_escapes():
+    labels = _parse_scraped_key(
+        'foo_total{msg="hello world",quoted="a \\"b\\" c",'
+        'back="x\\\\y"}', "j")
+    assert dict(labels) == {"__name__": "foo_total", "job": "j",
+                            "msg": "hello world",
+                            "quoted": 'a "b" c', "back": "x\\y"}
+
+
+def test_exposition_line_grammar():
+    """The satellite fix: ``name{labels} value [timestamp]`` parsed by
+    grammar, not rpartition(" ") -- label values with spaces keep
+    their key intact, timestamps are dropped from the value, and
+    histogram suffix samples keep their suffixed names."""
+    from frankenpaxos_tpu.bench.metrics import (
+        parse_exposition,
+        parse_sample_line,
+    )
+
+    assert parse_sample_line("foo_total 3") == ("foo_total", 3.0)
+    # Trailing timestamp: dropped (the OLD parser returned
+    # ("foo_total 3", 1700000000.0) here -- key and value both wrong).
+    assert parse_sample_line("foo_total 3 1700000000123") == \
+        ("foo_total", 3.0)
+    # Label value containing spaces AND a closing-brace lookalike.
+    line = 'foo_total{msg="hello } world",k="v"} 2.5'
+    assert parse_sample_line(line) == \
+        ('foo_total{msg="hello } world",k="v"}', 2.5)
+    # Escaped quote inside a label value never terminates the block.
+    line = 'foo_total{msg="say \\"hi\\" now"} 1 1700000000123'
+    assert parse_sample_line(line) == \
+        ('foo_total{msg="say \\"hi\\" now"}', 1.0)
+    # Exposition specials parse as floats.
+    assert parse_sample_line('b_bucket{le="+Inf"} 4') == \
+        ('b_bucket{le="+Inf"}', 4.0)
+    assert parse_sample_line("x NaN")[0] == "x"
+    # Comments, blanks, and garbage are skipped.
+    assert parse_sample_line("# HELP foo_total help text") is None
+    assert parse_sample_line("") is None
+    assert parse_sample_line("foo_total notanumber") is None
+    assert parse_sample_line('foo{unterminated="v 1') is None
+
+    text = ("# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 1\n'
+            'h_bucket{le="+Inf"} 2 1700000000123\n'
+            "h_sum 0.15\n"
+            "h_count 2\n")
+    parsed = parse_exposition(text)
+    assert parsed == {'h_bucket{le="0.1"}': 1.0,
+                      'h_bucket{le="+Inf"}': 2.0,
+                      "h_sum": 0.15, "h_count": 2.0}
+    # ...and the parsed keys feed straight into the promdb label
+    # parser: the suffixed names + le labels survive end to end.
+    assert dict(_parse_scraped_key('h_bucket{le="+Inf"}', "r0")) == {
+        "__name__": "h_bucket", "job": "r0", "le": "+Inf"}
+
+
 def test_selector_and_label_matching():
     db = make_db([
         {"r0": {"cmds_total": 1.0, "other": 9.0},
