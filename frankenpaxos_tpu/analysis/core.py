@@ -132,6 +132,7 @@ def _ensure_loaded() -> None:
     from frankenpaxos_tpu.analysis import (  # noqa: F401
         actor_rules,
         codec_rules,
+        epoch_rules,
         hotpath_rules,
     )
 

@@ -1,0 +1,98 @@
+"""PAX110: acceptor-set reads must flow through the epoch store.
+
+Reconfig-wired roles (reconfig/, docs/RECONFIG.md) resolve acceptor
+membership per SLOT through their ``EpochStore``; a handler that reads
+the static config's acceptor lists (``config.acceptor_addresses``, the
+``quorum_grid()`` factory) bypasses the store and silently pins the
+pre-reconfiguration membership -- fanning proposals to dead members,
+counting votes under the wrong spec, or recovering with the wrong
+quorum after a handover.
+
+The rule is SELF-SCOPING: it applies exactly to Actor subclasses that
+assign ``self.epochs`` in ``__init__`` (the epoch-store-backed roles).
+Roles of epoch-frozen protocols never assign the attribute and are
+untouched. Flagged reads inside handlers (``receive``/``on_drain`` and
+everything reachable from them, per the PAX1xx closure) must either
+route through the store or carry a justifying
+``# paxlint: disable=PAX110`` (e.g. the flexible-grid branch, the
+one-shot dict-tracker migration).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.actor_rules import (
+    _actor_classes,
+    _handler_closure,
+)
+from frankenpaxos_tpu.analysis.core import (
+    Finding,
+    Project,
+    dotted,
+    register_rules,
+)
+
+RULES = {
+    "PAX110": "acceptor-set/QuorumSpec read bypassing the epoch store "
+              "in a protocol handler",
+}
+
+#: Attribute reads / calls that resolve acceptor membership outside
+#: the store.
+_BYPASS_ATTRS = ("acceptor_addresses",)
+_BYPASS_CALLS = ("quorum_grid",)
+
+
+def _assigns_epoch_store(cls: ast.ClassDef) -> bool:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "__init__":
+            for sub in ast.walk(node):
+                targets = []
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, ast.AnnAssign):
+                    targets = [sub.target]
+                for target in targets:
+                    if isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id == "self" \
+                            and target.attr == "epochs":
+                        return True
+    return False
+
+
+def check(project: Project):
+    findings: list = []
+    for mod, cls in _actor_classes(project):
+        if not _assigns_epoch_store(cls):
+            continue
+        for name, func in _handler_closure(cls).items():
+            scope = f"{cls.name}.{name}"
+            for node in ast.walk(func):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in _BYPASS_ATTRS:
+                    d = dotted(node)
+                    findings.append(Finding(
+                        rule="PAX110", file=mod.path,
+                        line=node.lineno, scope=scope, detail=d,
+                        message=f"handler reads {d}: acceptor "
+                                f"membership must resolve through the "
+                                f"epoch store (self.epochs) so "
+                                f"committed reconfigurations reach "
+                                f"every path"))
+                elif isinstance(node, ast.Call) \
+                        and dotted(node.func).split(".")[-1] \
+                        in _BYPASS_CALLS:
+                    d = dotted(node.func)
+                    findings.append(Finding(
+                        rule="PAX110", file=mod.path,
+                        line=node.lineno, scope=scope, detail=d,
+                        message=f"handler calls {d}(): quorum "
+                                f"construction must resolve through "
+                                f"the epoch store (self.epochs)"))
+    return findings
+
+
+register_rules(RULES, check)

@@ -1,4 +1,5 @@
-"""Process-failure chaos for deployed clusters: SIGKILL + relaunch.
+"""Process-failure chaos for deployed clusters: SIGKILL + relaunch,
+and the paxepoch repair path: kill -> reconfigure-out -> replace.
 
 The deployment twin of the sim's ``crash_restart`` command
 (SimTransport.crash + the harness restart): ``kill -9`` a role process
@@ -8,15 +9,24 @@ recorded (same ports, same ``--wal_dir``), so the role recovers from
 its WAL and rejoins the live cluster. With no wal_dir this demonstrates
 the pre-WAL failure mode instead: the role comes back amnesiac.
 
-Used by the deployed crash-restart test (tests/test_deployment.py) and
-the vldb20_reconfig sweep's kill-mid-reconfig event
-(bench/sweeps.py).
+The RECONFIGURATION driver (reconfig/, docs/RECONFIG.md) goes further
+than resurrection: ``launch_replacement_acceptor`` starts a brand-new
+acceptor process at a FRESH address (a rewritten config file puts it in
+the dead member's group slot) and ``reconfigure_acceptors`` drives the
+leader's epoch-change flow to swap the membership live -- the repair
+the PR 3 vldb20_reconfig study showed a frozen acceptor set lacks.
+
+Used by the deployed crash-restart and reconfigure-under-kill tests
+(tests/test_deployment.py) and the vldb20_reconfig sweep's
+kill-then-repair events (bench/sweeps.py).
 """
 
 from __future__ import annotations
 
+import copy
 import os
 import signal
+import sys
 import time
 
 from frankenpaxos_tpu.bench.harness import BenchmarkDirectory, LocalHost
@@ -79,3 +89,76 @@ def kill_restart_role(bench: BenchmarkDirectory, label: str,
     sigkill_role(bench, label)
     time.sleep(down_s)
     return relaunch_role(bench, label, host=host)
+
+
+# --- paxepoch repair: reconfigure-out + replacement -------------------------
+
+
+def launch_replacement_acceptor(bench: BenchmarkDirectory, raw_config,
+                                group: int, member: int,
+                                protocol_name: str = "multipaxos",
+                                state_machine: str = "AppendLog",
+                                wal_dir: "str | None" = None,
+                                trace_dir: "str | None" = None,
+                                overrides: "dict | None" = None,
+                                host: "LocalHost | None" = None):
+    """Start a BRAND-NEW acceptor process at a fresh port to replace
+    ``raw_config['acceptors'][group][member]``.
+
+    The replacement gets its own rewritten config file (the original
+    with its address in the dead member's slot) -- the group/index
+    lookups in the acceptor's constructor then resolve, while every
+    OTHER role keeps the original config: membership authority lives
+    in the epoch store, which the subsequent ``Reconfigure`` updates.
+    Returns ``(new_members, label)`` where ``new_members`` is the full
+    address tuple to pass to :func:`reconfigure_acceptors`.
+    """
+    from frankenpaxos_tpu.bench.deploy_suite import role_process_env
+    from frankenpaxos_tpu.bench.harness import free_port
+
+    new_raw = copy.deepcopy(raw_config)
+    new_address = ["127.0.0.1", free_port()]
+    new_raw["acceptors"][group][member] = new_address
+    index = sum(len(g) for g in new_raw["acceptors"][:group]) + member
+    label = f"acceptor_{index}_replacement"
+    n = 1
+    while label in bench.labeled_procs:
+        label = f"acceptor_{index}_replacement{n}"
+        n += 1
+    config_path = bench.write_json(f"{label}_config.json", new_raw)
+    cmd = [sys.executable, "-m", "frankenpaxos_tpu.cli",
+           "--protocol", protocol_name, "--role", "acceptor",
+           "--index", str(index), "--config", config_path,
+           "--state_machine", state_machine, "--seed", str(100 + index)]
+    if wal_dir:
+        # The cli derives the WAL path from the role LABEL
+        # (acceptor_<index>) -- which the replacement shares with the
+        # member it replaces. A private subdirectory keeps the new
+        # member's log genuinely FRESH (it must join via the epoch
+        # handover, not inherit the dead acceptor's votes) and rules
+        # out two live processes appending to one WAL on a non-kill
+        # swap.
+        cmd += ["--wal_dir", os.path.join(wal_dir, label)]
+    if trace_dir:
+        cmd += ["--trace", trace_dir]
+    for key, value in (overrides or {}).items():
+        cmd.append(f"--options.{key}={value}")
+    env = role_process_env()
+    bench.role_commands[label] = (cmd, env)
+    bench.popen(host or LocalHost(), label, cmd, env=env)
+    members = tuple(tuple(a) for a in new_raw["acceptors"][group])
+    return members, label
+
+
+def reconfigure_acceptors(transport, leader_addresses,
+                          members: tuple) -> None:
+    """Fire the paxepoch config-change request at every leader (only
+    the active one acts; the leader-driven flow -- EpochCommit,
+    durable old-quorum acks, watermark-bounded handover -- takes it
+    from there). Call from off the transport's loop thread."""
+    from frankenpaxos_tpu.reconfig import Reconfigure
+    from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+    data = DEFAULT_SERIALIZER.to_bytes(Reconfigure(members=members))
+    for leader in leader_addresses:
+        transport.send(transport.listen_address, tuple(leader), data)

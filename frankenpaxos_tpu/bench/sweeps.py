@@ -389,11 +389,18 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
         core experiment.
       * horizontal: a chunk reconfiguration (Reconfigure chosen INTO
         the log, starting a new active chunk).
+      * multipaxos (the paxepoch arm, reconfig/): LIVE member swaps --
+        each non-kill event launches a fresh replacement acceptor
+        process and drives the leader's epoch-change flow
+        (EpochCommit -> durable old-quorum acks -> watermark-bounded
+        handover).
       * PLUS one process-failure event per protocol: the chaos driver
-        SIGKILLs an acceptor mid-run (no relaunch -- these protocols
-        carry no WAL, so an amnesiac restart would be unsound; f=1
-        tolerates the dead acceptor and throughput recovers once
-        resends route around it).
+        SIGKILLs an acceptor mid-run (no relaunch), THEN the
+        protocol's repair path runs: the matchmaker reconfigures to a
+        quorum system over the survivors, and the paxepoch arm
+        reconfigures the dead member out for a replacement -- so the
+        kill rows carry MEASURED recovery_seconds where PR 3's study
+        could only report a does-not-recover lower bound.
 
     Every event gets a generous post-event window so its
     ``recovery_seconds`` is measured, not truncated by the end of the
@@ -423,6 +430,12 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
     KILL_EVENT = len(reconfig_at) - 1  # the 4th event is the SIGKILL
 
     def trigger_messages(protocol_name, config, k):
+        if protocol_name == "multipaxos":
+            # paxepoch (reconfig/): non-kill events swap one acceptor
+            # for a fresh replacement process through the epoch-change
+            # flow -- handled inline in fire_reconfigs (they must
+            # launch processes, not just send a message).
+            return []
         if protocol_name == "matchmakermultipaxos":
             from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
                 Reconfigure,
@@ -457,15 +470,28 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
 
     rows = []
     procs_n, loops = max(points, key=lambda p: p[0] * p[1])
-    for protocol_name in ("matchmakermultipaxos", "horizontal"):
+    # "multipaxos" is the paxepoch arm (reconfig/): the same
+    # kill_acceptor chaos event, REPAIRED live -- reconfigure the dead
+    # member out and a fresh replacement process in -- so its
+    # recovery_seconds is a measured number where the epoch-frozen
+    # stack could only report a lower bound (PR 3's finding).
+    for protocol_name in ("matchmakermultipaxos", "horizontal",
+                          "multipaxos"):
         bench = suite.benchmark_directory()
         protocol = get_protocol(protocol_name)
         raw = protocol.cluster(1, lambda: ["127.0.0.1", free_port()])
         config_path = bench.write_json("config.json", raw)
         config = protocol.load_config(raw)
+        overrides = {"resend_phase1as_period_s": "0.5"}
+        if protocol_name == "multipaxos":
+            # Prompt watermark gossip + hole recovery keep the
+            # handover windows tight (docs/RECONFIG.md).
+            overrides.update({
+                "send_chosen_watermark_every_n_entries": "1",
+                "recover_log_entry_min_period_s": "0.5",
+                "recover_log_entry_max_period_s": "1.0"})
         launch_roles(bench, protocol_name, config_path, config,
-                     state_machine="AppendLog",
-                     overrides={"resend_phase1as_period_s": "0.5"})
+                     state_machine="AppendLog", overrides=overrides)
         host = LocalHost()
         env = role_process_env()
         client_procs = []
@@ -483,6 +509,28 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
                     "--seed", str(i + 1), "--out", out_csv], env=env)))
 
         fired: list[float] = []
+        # paxepoch arm state: the live member labels + rewritten raw.
+        epoch_state = {"raw": raw,
+                       "labels": ["acceptor_0", "acceptor_1",
+                                  "acceptor_2"]}
+
+        def fire_epoch_swap(transport, member: int) -> None:
+            """One paxepoch event: a fresh replacement process for
+            group-0 member ``member`` + the leader-driven change."""
+            from frankenpaxos_tpu.bench.chaos import (
+                launch_replacement_acceptor,
+                reconfigure_acceptors,
+            )
+
+            members, label = launch_replacement_acceptor(
+                bench, epoch_state["raw"], group=0, member=member,
+                state_machine="AppendLog", overrides=overrides)
+            new_raw = dict(epoch_state["raw"])
+            new_raw["acceptors"] = [[list(a) for a in members]]
+            epoch_state["raw"] = new_raw
+            epoch_state["labels"][member] = label
+            reconfigure_acceptors(transport,
+                                  config.leader_addresses, members)
 
         def fire_reconfigs():
             logger = FakeLogger(LogLevel.FATAL)
@@ -492,13 +540,51 @@ def vldb20_reconfig(suite: SuiteDirectory, points,
                 for k, at in enumerate(reconfig_at):
                     _time.sleep(max(0.0, t_start + at - _time.time()))
                     if k == KILL_EVENT:
-                        # The chaos event: kill -9 the last acceptor
-                        # mid-run (the WAL chaos driver's kill
-                        # schedule applied to the reconfig bench).
-                        acceptors = sorted(
-                            label for label in bench.labeled_procs
-                            if label.startswith("acceptor_"))
-                        sigkill_role(bench, acceptors[-1])
+                        if protocol_name == "multipaxos":
+                            # Kill a CURRENT member, then repair live:
+                            # reconfigure it out, replacement in.
+                            sigkill_role(bench,
+                                         epoch_state["labels"][2])
+                            fire_epoch_swap(transport, member=2)
+                        else:
+                            # The chaos event: kill -9 the last
+                            # acceptor mid-run (the WAL chaos driver's
+                            # kill schedule applied to this bench) --
+                            # then the protocol's own repair: the
+                            # matchmaker reconfigures to a quorum
+                            # system over the SURVIVORS (the paper's
+                            # acceptor-replacement flow), turning PR
+                            # 3's does-not-recover lower bound into a
+                            # measured recovery.
+                            acceptors = sorted(
+                                label for label in bench.labeled_procs
+                                if label.startswith("acceptor_"))
+                            sigkill_role(bench, acceptors[-1])
+                            if protocol_name == "matchmakermultipaxos":
+                                from frankenpaxos_tpu.protocols \
+                                    .matchmakermultipaxos import (
+                                        Reconfigure as MMPReconfigure,
+                                    )
+                                from frankenpaxos_tpu.quorums import (
+                                    quorum_system_to_dict,
+                                )
+
+                                survivors = range(
+                                    len(config.acceptor_addresses) - 1)
+                                transport.send(
+                                    transport.listen_address,
+                                    tuple(config
+                                          .reconfigurer_addresses[0]),
+                                    DEFAULT_SERIALIZER.to_bytes(
+                                        MMPReconfigure(
+                                            quorum_system_to_dict(
+                                                SimpleMajority(
+                                                    survivors)))))
+                    elif protocol_name == "multipaxos":
+                        # Non-kill paxepoch events: live member swaps
+                        # under load (alternate members 0 and 1; 2
+                        # stays for the kill event).
+                        fire_epoch_swap(transport, member=k % 2)
                     else:
                         for dst, message in trigger_messages(
                                 protocol_name, config, k):

@@ -167,18 +167,13 @@ def _predicate_hit(votes_block: jax.Array, masks_t: tuple,
     return _quorum_hit(votes_block, masks, thresholds, combine_any)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6, 7))
-def _record_and_check(
-    board: VoteBoard,
-    slots: jax.Array,      # [B] int32, already reduced mod window
-    true_slots: jax.Array,  # [B] int32 un-modded slot numbers (owner ids)
-    nodes: jax.Array,      # [B] int32 acceptor rows
-    vote_rounds: jax.Array,  # [B] int32
-    valid: jax.Array,      # [B] bool (padding mask for partial batches)
-    masks_t: tuple,        # static: ((row, ...), ...) -> rebuilt as [G, N]
-    meta: tuple,           # static: (thresholds, combine_any, grid|None)
-) -> tuple[VoteBoard, jax.Array]:
-    """Sparse path: out-of-order / straggler votes. O(batch) work."""
+def _apply_sparse_votes(board: VoteBoard, slots, true_slots, nodes,
+                        vote_rounds, valid):
+    """Shared traced body of the sparse scatter kernels: ring
+    self-reclaim + round preemption + vote recording, WITHOUT the
+    quorum predicate (the single-spec and epoch-segmented kernels each
+    attach their own). Returns ``(votes, new_rounds, chosen0, owner,
+    mine)``."""
     # Ring self-reclaim: a newer slot claims its column (clearing stale
     # state from `slot - k*window`); votes for slots the column has moved
     # past are dropped. All per-column derived values are identical for
@@ -212,10 +207,56 @@ def _record_and_check(
     # Record votes that are for the slot's (possibly new) current round.
     live = mine & (vote_rounds == cur)
     votes = votes.at[nodes, slots].max(live.astype(jnp.uint8))
+    return votes, new_rounds, chosen0, owner, mine
 
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6, 7))
+def _record_and_check(
+    board: VoteBoard,
+    slots: jax.Array,      # [B] int32, already reduced mod window
+    true_slots: jax.Array,  # [B] int32 un-modded slot numbers (owner ids)
+    nodes: jax.Array,      # [B] int32 acceptor rows
+    vote_rounds: jax.Array,  # [B] int32
+    valid: jax.Array,      # [B] bool (padding mask for partial batches)
+    masks_t: tuple,        # static: ((row, ...), ...) -> rebuilt as [G, N]
+    meta: tuple,           # static: (thresholds, combine_any, grid|None)
+) -> tuple[VoteBoard, jax.Array]:
+    """Sparse path: out-of-order / straggler votes. O(batch) work."""
+    votes, new_rounds, chosen0, owner, mine = _apply_sparse_votes(
+        board, slots, true_slots, nodes, vote_rounds, valid)
     # Quorum predicate for exactly the touched columns (duplicates are
     # fine: they see identical post-scatter state).
     hit = _predicate_hit(votes[:, slots], masks_t, meta)
+    hit = hit & mine
+    newly = hit & ~chosen0[slots]
+    chosen = chosen0.at[slots].max(hit)
+    return VoteBoard(votes, new_rounds, chosen, owner), newly
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _record_and_check_epochs(
+    board: VoteBoard,
+    slots: jax.Array,        # [B] int32, reduced mod window
+    true_slots: jax.Array,   # [B] int32 un-modded slot numbers
+    nodes: jax.Array,        # [B] int32 acceptor rows (union universe)
+    vote_rounds: jax.Array,  # [B] int32
+    valid: jax.Array,        # [B] bool
+    boundaries: jax.Array,   # [K-1] int64: start slots of epochs 1..K-1
+    masks: jax.Array,        # [K, G, N] padded per-epoch masks
+    thresholds: jax.Array,   # [K, G]
+    combine_any: jax.Array,  # [K] bool
+) -> tuple[VoteBoard, jax.Array]:
+    """The epoch-segmented sparse kernel: identical board update to
+    :func:`_record_and_check`, but each vote's quorum predicate is
+    selected by its SLOT's epoch (``searchsorted`` over the epoch
+    activation boundaries), so one fused drain can span a handover
+    boundary -- old-epoch columns keep counting under the old spec
+    while new-epoch columns count under the new one."""
+    votes, new_rounds, chosen0, owner, mine = _apply_sparse_votes(
+        board, slots, true_slots, nodes, vote_rounds, valid)
+    config_idx = jnp.searchsorted(boundaries, true_slots, side="right")
+    hit = _check_batch_multi(votes[:, slots].T, config_idx, masks,
+                             thresholds, combine_any)
     hit = hit & mine
     newly = hit & ~chosen0[slots]
     chosen = chosen0.at[slots].max(hit)
@@ -348,6 +389,38 @@ def _spec_statics(spec: QuorumSpec) -> tuple[tuple, tuple]:
     meta = (thresholds_t, combine_any,
             grid_layout(spec.masks, spec.thresholds, combine_any))
     return masks_t, meta
+
+
+def epoch_column_map(old_universe, new_universe) -> np.ndarray:
+    """``[N_new]`` int32 gather map for an epoch reshape: new column
+    ``i`` draws its votes from old column ``map[i]``, or ``-1`` when
+    universe node ``new_universe[i]`` is new to the board (its column
+    starts empty). Node ids removed by the new universe simply have no
+    image -- their columns are dropped (the shrink half of
+    pad/shrink)."""
+    old_col = {node: i for i, node in enumerate(old_universe)}
+    return np.asarray([old_col.get(node, -1) for node in new_universe],
+                      dtype=np.int32)
+
+
+@jax.jit
+def _reshape_columns(block: jax.Array, cmap: jax.Array) -> jax.Array:
+    """``[N_old, B] x [N_new] -> [N_new, B]``: the epoch reshape gather
+    (column permutation + pad with zero columns + shrink). One fused
+    gather+select -- no host round trip for the board's vote matrix."""
+    src = jnp.clip(cmap, 0, block.shape[0] - 1)
+    return jnp.where((cmap >= 0)[:, None], block[src],
+                     jnp.zeros((), dtype=block.dtype))
+
+
+def reshape_block(block: np.ndarray, old_universe,
+                  new_universe) -> np.ndarray:
+    """Host wrapper over :func:`_reshape_columns` for a standalone
+    ``[N_old, B]`` vote block (drain blocks crossing an epoch
+    boundary)."""
+    cmap = epoch_column_map(old_universe, new_universe)
+    return np.asarray(_reshape_columns(
+        jnp.asarray(block), jnp.asarray(cmap)))
 
 
 class TpuQuorumChecker:
@@ -574,6 +647,32 @@ class TpuQuorumChecker:
         if highest > self._max_slot_seen:
             self._max_slot_seen = highest
 
+    def reshape(self, new_spec: QuorumSpec) -> None:
+        """Epoch reshape: remap the live board's ACCEPTOR axis onto
+        ``new_spec``'s universe and swap the predicate, in place.
+
+        The ``[acceptors, window]`` vote matrix is re-laid-out by ONE
+        on-device gather (:func:`_reshape_columns`): columns permute to
+        the new universe order, members new to the universe get empty
+        columns (pad), members the new universe drops lose theirs
+        (shrink). Slot-axis state (rounds/chosen/owner) is untouched --
+        an epoch changes who votes, not which slots exist -- so a board
+        mid-collection survives the handover: votes already recorded
+        for surviving acceptors keep counting, bit-identical to
+        replaying them onto a fresh new-universe board (asserted
+        against the two-config ``quorums/systems.py`` oracle in
+        tests/test_reconfig.py)."""
+        cmap = epoch_column_map(self.spec.universe, new_spec.universe)
+        self.board = VoteBoard(
+            votes=_reshape_columns(self.board.votes, jnp.asarray(cmap)),
+            rounds=self.board.rounds,
+            chosen=self.board.chosen,
+            owner=self.board.owner,
+        )
+        self.spec = new_spec
+        self.num_nodes = new_spec.num_nodes
+        self._masks_t, self._meta = _spec_statics(new_spec)
+
     def release(self, slots: Sequence[int] | np.ndarray) -> None:
         """GC slot columns below the chosen watermark so the ring can wrap."""
         slots = np.asarray(slots, dtype=np.int32) % self.window
@@ -585,6 +684,161 @@ class TpuQuorumChecker:
         """Stateless: evaluate the predicate for ``[B, N]`` responder rows."""
         return np.asarray(_check_batch(jnp.asarray(present), self._masks_t,
                                        self._meta))
+
+
+class EpochSegmentedChecker:
+    """Quorum checking where each SLOT selects its epoch's predicate.
+
+    The reconfiguration (paxepoch) shape: epochs partition slot space
+    at activation watermarks (epoch ``k`` governs ``[start_k,
+    start_{k+1})``), each with its own acceptor set and QuorumSpec.
+    Specs are padded into one ``[K, G, N]`` plane stack over the UNION
+    universe (``quorums.spec.pad_specs``), and every kernel selects a
+    slot's plane by ``searchsorted`` over the activation boundaries --
+    so ONE fused call (stateless ``check_batch`` or the stateful
+    scatter ``record_and_check``) spans the handover boundary instead
+    of splitting the drain at it.
+
+    ``add_epoch`` grows the stack in place: specs reindex onto the
+    widened union universe and the live vote board reshapes by the
+    same on-device gather as :meth:`TpuQuorumChecker.reshape` --
+    mid-flight votes for surviving acceptors keep counting across the
+    handover.
+    """
+
+    def __init__(self, specs: Sequence[QuorumSpec],
+                 boundaries: Sequence[int], window: int = 4096):
+        if len(specs) != len(boundaries):
+            raise ValueError(
+                f"{len(specs)} specs vs {len(boundaries)} boundaries")
+        if list(boundaries) != sorted(boundaries):
+            raise ValueError(
+                f"epoch boundaries must be nondecreasing: {boundaries}")
+        self.window = window
+        # Per-epoch specs in their OWN universes; the union universe is
+        # first-seen order so adding an epoch only APPENDS columns
+        # (existing columns keep their indices -- the board gather for
+        # a pure-growth reshape is the identity prefix).
+        self._own_specs = list(specs)
+        self._starts = [int(b) for b in boundaries]
+        self.universe: tuple = ()
+        self._rebuild_universe()
+        self.board = make_vote_board(window, len(self.universe))
+
+    def _rebuild_universe(self) -> None:
+        seen: dict = {}
+        for spec in self._own_specs:
+            for node in spec.universe:
+                seen.setdefault(node, len(seen))
+        self.universe = tuple(seen)
+        specs = [s.reindexed(self.universe) for s in self._own_specs]
+        from frankenpaxos_tpu.quorums.spec import pad_specs
+
+        masks, thresholds, combine_any = pad_specs(specs)
+        self._masks = jnp.asarray(masks)
+        self._thresholds = jnp.asarray(thresholds)
+        self._combine_any = jnp.asarray(combine_any)
+        # boundaries[k-1] = first slot of epoch k (epoch 0 governs
+        # everything below boundaries[0]). int32 like the board's slot
+        # state: x64 is off in jitted kernels, and no ring outlives
+        # 2^31 slots between GCs.
+        self._boundaries = jnp.asarray(
+            np.asarray(self._starts[1:], dtype=np.int32))
+        self._boundaries_np = np.asarray(self._starts[1:],
+                                         dtype=np.int64)
+
+    def column_of(self, node_id: int) -> int:
+        return self.universe.index(node_id)
+
+    def add_epoch(self, spec: QuorumSpec, start_slot: int) -> None:
+        """Append an epoch: slots >= ``start_slot`` check under
+        ``spec``. Reshapes the live board onto the widened union
+        universe (the epoch reshape gather)."""
+        if start_slot < self._starts[-1]:
+            raise ValueError(
+                f"epoch start {start_slot} below the newest epoch's "
+                f"{self._starts[-1]}")
+        self._own_specs.append(spec)
+        self._starts.append(int(start_slot))
+        old_universe = self.universe
+        self._rebuild_universe()
+        if self.universe != old_universe:
+            cmap = epoch_column_map(old_universe, self.universe)
+            self.board = VoteBoard(
+                votes=_reshape_columns(self.board.votes,
+                                       jnp.asarray(cmap)),
+                rounds=self.board.rounds,
+                chosen=self.board.chosen,
+                owner=self.board.owner,
+            )
+
+    def config_indices(self, slots: np.ndarray) -> np.ndarray:
+        """Which epoch plane governs each slot."""
+        return np.searchsorted(self._boundaries_np,
+                               np.asarray(slots, dtype=np.int64),
+                               side="right")
+
+    def check_batch(self, present: np.ndarray,
+                    slots: np.ndarray) -> np.ndarray:
+        """Stateless: ``[B, N]`` union-universe responder rows checked
+        under each row's slot's epoch -- one fused kernel across the
+        handover boundary."""
+        config_idx = self.config_indices(slots)
+        return np.asarray(_check_batch_multi(
+            jnp.asarray(present, dtype=jnp.uint8),
+            jnp.asarray(config_idx, dtype=jnp.int32),
+            self._masks, self._thresholds, self._combine_any))
+
+    def check_block(self, start_slot: int,
+                    block: np.ndarray) -> np.ndarray:
+        """Stateless dense form: ``block[N, B]`` covers contiguous
+        slots ``[start_slot, start_slot + B)`` (which may straddle any
+        number of epoch boundaries)."""
+        b = block.shape[1]
+        slots = start_slot + np.arange(b, dtype=np.int64)
+        return self.check_batch(np.asarray(block, dtype=np.uint8).T,
+                                slots)
+
+    def record_and_check(
+        self,
+        slots: Sequence[int] | np.ndarray,
+        node_cols: Sequence[int] | np.ndarray,
+        rounds: Sequence[int] | np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Stateful sparse path (the TpuQuorumChecker scatter shape):
+        record votes on the union-universe board and return the
+        per-vote "slot newly has quorum" mask, each slot judged under
+        its epoch's spec."""
+        slots = np.asarray(slots, dtype=np.int64)
+        b = slots.shape[0]
+        if rounds is None:
+            rounds = np.zeros(b, dtype=np.int32)
+        pad = 64
+        while pad < b:
+            pad *= 2
+        slots_p = np.zeros(pad, dtype=np.int32)
+        true_p = np.zeros(pad, dtype=np.int32)
+        nodes_p = np.zeros(pad, dtype=np.int32)
+        rounds_p = np.zeros(pad, dtype=np.int32)
+        valid = np.zeros(pad, dtype=bool)
+        slots_p[:b] = slots % self.window
+        true_p[:b] = slots
+        nodes_p[:b] = np.asarray(node_cols, dtype=np.int32)
+        rounds_p[:b] = np.asarray(rounds, dtype=np.int32)
+        valid[:b] = True
+        self.board, newly = _record_and_check_epochs(
+            self.board, jnp.asarray(slots_p), jnp.asarray(true_p),
+            jnp.asarray(nodes_p), jnp.asarray(rounds_p),
+            jnp.asarray(valid), self._boundaries, self._masks,
+            self._thresholds, self._combine_any)
+        return np.asarray(newly)[:b]
+
+    def release(self, slots: Sequence[int] | np.ndarray) -> None:
+        """GC chosen columns below the watermark (ring wrap)."""
+        slots = np.asarray(slots, dtype=np.int32) % self.window
+        valid = np.ones(slots.shape[0], dtype=bool)
+        self.board = _release(self.board, jnp.asarray(slots),
+                              jnp.asarray(valid))
 
 
 class MultiConfigQuorumChecker:

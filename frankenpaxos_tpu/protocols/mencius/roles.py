@@ -15,11 +15,21 @@ except ImportError:  # stripped environments: pure-Python fallback
     from frankenpaxos_tpu.utils.sorted_compat import SortedDict
 
 from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
+from frankenpaxos_tpu.reconfig import (
+    EpochAck,
+    EpochCommit,
+    EpochConfig,
+    EpochStore,
+    Reconfigure,
+    decode_epoch_config,
+    encode_epoch_config,
+)
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.wal import (
     DurableRole,
+    WalEpoch,
     WalNoopRange,
     WalPromise,
     WalSnapshot,
@@ -130,6 +140,39 @@ class _Phase1:
     # Slot to force-recover through phase 1, or -1 (Leader.scala:160-172).
     recover_slot: int
     resend_phase1as: object
+    # Address-keyed Phase1bs + the in-flight Phase1a (reconfig: across
+    # epochs, (group, index) coordinates can collide; addresses cannot).
+    by_addr: dict = dataclasses.field(default_factory=dict)
+    phase1a: object = None
+
+
+@dataclasses.dataclass
+class _EpochChange:
+    """A Mencius epoch change in flight. Unlike MultiPaxos (whose
+    proposals carry epoch tags and stash at a lagging proxy), Mencius
+    runs stay untagged, so activation additionally gates on EVERY
+    proxy leader's ack -- a proxy can then never mis-route a new-epoch
+    run to the old set. The trade-off: a dead proxy blocks
+    reconfiguration here, where MultiPaxos rides through
+    (docs/RECONFIG.md)."""
+
+    config: EpochConfig
+    commit: EpochCommit
+    targets: set
+    acks: set
+    resend: object
+    pending: list  # buffered ClientRequestBatch
+    activated: bool = False
+    # True when re-driving an adopted epoch (post-failover / peer
+    # broadcast); targets and gating then depend on whether the
+    # predecessor-quorum durability was already PROVEN by Phase1bs.
+    recommit: bool = False
+    # Activation must (re-)establish f+1 predecessor-epoch durable
+    # acks unless Phase1 already proved them (chaos-found: proposing
+    # into an adopted-but-undurable epoch lets a later leader that
+    # misses it re-propose its slots under the old quorums -- a second
+    # chosen value).
+    need_old_quorum: bool = True
 
 
 class MenciusLeader(Actor):
@@ -175,6 +218,16 @@ class MenciusLeader(Actor):
             lambda leader_index: self.leader_change(
                 leader_index == self.index, recover_slot=-1))
 
+        # Live reconfiguration (reconfig/): one epoch store per leader
+        # group, over ITS owned slots -- supported when the group has
+        # exactly one 2f+1 acceptor group (the run-pipeline shape).
+        self.epochs: object = None
+        if len(config.acceptor_addresses[self.group_index]) == 1:
+            self.epochs = EpochStore.from_members(
+                tuple(config.acceptor_addresses[self.group_index][0]),
+                config.f)
+        self._epoch_change: object = None
+
         self.state: object = ("inactive",)
         if self.index == 0:
             self.state = self._start_phase1(self.round,
@@ -209,18 +262,39 @@ class MenciusLeader(Actor):
                     best_round, best_value = info.vote_round, info.vote_value
         return NOOP if best_value is None else best_value
 
+    def _phase1_epochs(self) -> list:
+        return self.epochs.epochs_covering(self.chosen_watermark)
+
     def _start_phase1(self, round: int, chosen_watermark: int,
                       recover_slot: int) -> _Phase1:
         phase1a = Phase1a(round=round, chosen_watermark=chosen_watermark)
-        for group in self._my_acceptor_groups:
-            for acceptor in self.rng.sample(list(group),
-                                            self.config.quorum_size):
+        if self.epochs is not None:
+            # Per covered epoch, a thrifty read-quorum sample; resend
+            # widens to every member (dict.fromkeys: deterministic
+            # iteration under hash randomization).
+            targets: dict = {}
+            for config in self._phase1_epochs():
+                targets.update(dict.fromkeys(self.rng.sample(
+                    list(config.members), config.quorum_size)))
+            for acceptor in targets:
                 self.send(acceptor, phase1a)
+        else:
+            for group in self._my_acceptor_groups:
+                for acceptor in self.rng.sample(list(group),
+                                                self.config.quorum_size):
+                    self.send(acceptor, phase1a)
 
         def resend():
-            for group in self._my_acceptor_groups:
-                for acceptor in group:
+            if self.epochs is not None:
+                targets: dict = {}
+                for config in self._phase1_epochs():
+                    targets.update(dict.fromkeys(config.members))
+                for acceptor in targets:
                     self.send(acceptor, phase1a)
+            else:
+                for group in self._my_acceptor_groups:
+                    for acceptor in group:
+                        self.send(acceptor, phase1a)
             timer.start()
 
         timer = self.timer("resendPhase1as", self.resend_phase1as_period_s,
@@ -229,11 +303,23 @@ class MenciusLeader(Actor):
         return _Phase1(
             phase1bs=[{} for _ in self._my_acceptor_groups],
             pending_batches=[], recover_slot=recover_slot,
-            resend_phase1as=timer)
+            resend_phase1as=timer, phase1a=phase1a)
+
+    def _abort_epoch_change(self) -> None:
+        change = self._epoch_change
+        if change is None:
+            return
+        change.resend.stop()
+        if change.pending:
+            self.logger.debug(
+                f"epoch change aborted with {len(change.pending)} "
+                f"buffered batches (clients will resend)")
+        self._epoch_change = None
 
     def leader_change(self, is_new_leader: bool, recover_slot: int) -> None:
         if isinstance(self.state, _Phase1):
             self.state.resend_phase1as.stop()
+        self._abort_epoch_change()
         if not is_new_leader:
             self.state = ("inactive",)
             return
@@ -244,6 +330,10 @@ class MenciusLeader(Actor):
 
     def _process_batch(self, batch: ClientRequestBatch) -> None:
         self.logger.check_eq(self.state, ("phase2",))
+        change = self._epoch_change
+        if change is not None and not change.activated:
+            change.pending.append(batch)
+            return
         self.send(self._proxy_leader(),
                   Phase2a(slot=self.next_slot, round=self.round,
                           value=batch.batch))
@@ -275,6 +365,14 @@ class MenciusLeader(Actor):
             for command in array.commands:
                 self._process_batch(
                     ClientRequestBatch(CommandBatch((command,))))
+            return
+        change = self._epoch_change
+        if change is not None and not change.activated:
+            # Handover window: buffer until the commit's activation
+            # quorum (old-epoch write quorum + every proxy) is in.
+            change.pending.extend(
+                ClientRequestBatch(CommandBatch((c,)))
+                for c in array.commands)
             return
         stride = self.config.num_leader_groups
         k = len(array.commands)
@@ -314,8 +412,31 @@ class MenciusLeader(Actor):
             self.chosen_watermark = max(self.chosen_watermark, message.slot)
         elif isinstance(message, Recover):
             self._handle_recover(src, message)
+        elif isinstance(message, Reconfigure):
+            self._handle_reconfigure(src, message)
+        elif isinstance(message, EpochAck):
+            self._handle_epoch_ack(src, message)
+        elif isinstance(message, EpochCommit):
+            self._handle_epoch_commit(src, message)
         else:
             self.logger.fatal(f"unexpected leader message {message!r}")
+
+    def _adopt_epochs(self, commits) -> bool:
+        """Merge Phase1b-discovered epoch entries (highest round per
+        id); True when coverage changed."""
+        changed = False
+        for commit in sorted(commits, key=lambda c: (c.epoch, c.round)):
+            try:
+                outcome = self.epochs.offer(
+                    EpochConfig(epoch=commit.epoch,
+                                start_slot=commit.start_slot,
+                                f=commit.f, members=commit.members),
+                    commit.round)
+            except ValueError as e:
+                self.logger.warn(f"discovered epoch rejected: {e}")
+                continue
+            changed = changed or outcome in ("new", "replaced")
+        return changed
 
     def _handle_phase1b(self, src: Address, phase1b: Phase1b) -> None:
         if not isinstance(self.state, _Phase1):
@@ -324,13 +445,31 @@ class MenciusLeader(Actor):
         if phase1b.round != self.round:
             self.logger.check_lt(phase1b.round, self.round)
             return
-        phase1.phase1bs[phase1b.group_index][phase1b.acceptor_index] = phase1b
-        if any(len(g) < self.config.quorum_size for g in phase1.phase1bs):
-            return
+        phase1.by_addr[src] = phase1b
+        if self.epochs is not None and phase1b.epochs \
+                and self._adopt_epochs(phase1b.epochs):
+            members: dict = {}
+            for config in self._phase1_epochs():
+                members.update(dict.fromkeys(config.members))
+            for acceptor in members:
+                if acceptor not in phase1.by_addr:
+                    self.send(acceptor, phase1.phase1a)
+        if self.epochs is not None and self.epochs.multi_epoch:
+            # Phase1-with-both-configs over this group's epochs.
+            answered = set(phase1.by_addr)
+            for config in self._phase1_epochs():
+                if not config.has_read_quorum(answered):
+                    return
+        else:
+            phase1.phase1bs[phase1b.group_index][phase1b.acceptor_index] \
+                = phase1b
+            if any(len(g) < self.config.quorum_size
+                   for g in phase1.phase1bs):
+                return
 
         max_slot = max(
-            (info.slot for group in phase1.phase1bs
-             for p1b in group.values() for info in p1b.info),
+            (info.slot for p1b in phase1.by_addr.values()
+             for info in p1b.info),
             default=-1)
         max_slot = max(max_slot, phase1.recover_slot)
         self.logger.check(
@@ -340,12 +479,20 @@ class MenciusLeader(Actor):
         # Fill only the slots this group owns (Leader.scala:624-647).
         start = self.slot_system.next_classic_round(
             self.group_index, self.chosen_watermark - 1)
+        multi = self.epochs is not None and self.epochs.multi_epoch
         for slot in range(start, max_slot + 1,
                           self.config.num_leader_groups):
-            group = phase1.phase1bs[self._acceptor_group_index_by_slot(slot)]
+            if multi:
+                # Scan every answering acceptor: non-members of the
+                # slot's epoch hold no votes for it, so this is a
+                # superset of the right epoch's read quorum.
+                voters = phase1.by_addr.values()
+            else:
+                voters = phase1.phase1bs[
+                    self._acceptor_group_index_by_slot(slot)].values()
             self.send(self._proxy_leader(),
                       Phase2a(slot=slot, round=self.round,
-                              value=self._safe_value(group.values(), slot)))
+                              value=self._safe_value(voters, slot)))
         # next_slot must clear the chosen watermark as well as the
         # voted max: Phase1bs report nothing below the watermark (all
         # chosen -- e.g. a predecessor's ChosenNoopRange), so with no
@@ -358,6 +505,28 @@ class MenciusLeader(Actor):
             self.group_index, max(max_slot, self.chosen_watermark - 1))
         phase1.resend_phase1as.stop()
         self.state = ("phase2",)
+        if multi:
+            # Re-drive the newest epoch's commit before proposing into
+            # it: untagged runs may only flow once every proxy provably
+            # routes by the current epoch map, and the epoch's durable
+            # predecessor-quorum must exist (proven by Phase1bs, or
+            # re-established by the gated acks below). Pending batches
+            # buffer through the activation window.
+            newest = self.epochs.current()
+            pred = self.epochs.config(newest.epoch - 1)
+            reporters = {
+                addr for addr, p1b in phase1.by_addr.items()
+                if any(c.epoch == newest.epoch for c in p1b.epochs)}
+            # Proof of durable commitment: a predecessor write quorum
+            # among the reporters, or a slot chosen STRICTLY past the
+            # activation watermark (chosen under the epoch => some
+            # gate-compliant leader activated it; WALs outlive
+            # crashes).
+            proven = (pred is None
+                      or pred.has_write_quorum(reporters)
+                      or self.chosen_watermark > newest.start_slot)
+            self._start_epoch_commit(newest, recommit=True,
+                                     need_old_quorum=not proven)
         for batch in phase1.pending_batches:
             self._process_batch(batch)
 
@@ -402,12 +571,28 @@ class MenciusLeader(Actor):
         if self.high_watermark - self.next_slot \
                 < self.send_noop_range_if_lagging_by:
             return
+        change = self._epoch_change
+        if change is not None and not change.activated:
+            # Mid-handover: don't skip slots whose epoch is still
+            # committing; a later HighWatermark re-triggers.
+            return
         end = self.slot_system.next_classic_round(self.group_index,
                                                   self.high_watermark)
-        self.send(self._proxy_leader(),
-                  Phase2aNoopRange(slot_start_inclusive=self.next_slot,
-                                   slot_end_exclusive=end,
-                                   round=self.round))
+        at = self.next_slot
+        while at < end:
+            seg_end = end
+            if self.epochs is not None:
+                # Split the skip range at epoch activation boundaries:
+                # each segment's noop quorum is one epoch's.
+                config = self.epochs.epoch_of_slot(at)
+                nxt = self.epochs.config(config.epoch + 1)
+                if nxt is not None:
+                    seg_end = min(end, nxt.start_slot)
+            self.send(self._proxy_leader(),
+                      Phase2aNoopRange(slot_start_inclusive=at,
+                                       slot_end_exclusive=seg_end,
+                                       round=self.round))
+            at = seg_end
         self.next_slot = end
 
     def _handle_nack(self, src: Address, nack: Nack) -> None:
@@ -429,6 +614,151 @@ class MenciusLeader(Actor):
             self.leader_change(is_new_leader=True,
                                recover_slot=recover.slot)
 
+    # --- reconfiguration (reconfig/, docs/RECONFIG.md) --------------------
+    def _start_epoch_commit(self, config: EpochConfig, recommit: bool,
+                            need_old_quorum: bool = True) -> None:
+        """Drive one EpochCommit to quorum: broadcast + resend until
+        the activation set (f+1 PREDECESSOR-epoch members -- unless
+        Phase1 already proved that durability -- and, because Mencius
+        runs are untagged, EVERY proxy leader) has acked. ``recommit``
+        re-drives an adopted epoch after failover: the store already
+        holds it, but this leader must not propose into it before the
+        proxies provably route by it and the durable discovery quorum
+        provably exists."""
+        commit = EpochCommit(epoch=config.epoch,
+                             start_slot=config.start_slot,
+                             f=config.f, round=self.round,
+                             members=config.members)
+        old = (self.epochs.config(config.epoch - 1)
+               if need_old_quorum else None)
+        targets: dict = dict.fromkeys(old.members if old else ())
+        targets.update(dict.fromkeys(config.members))
+        targets.update(dict.fromkeys(self.config.proxy_leader_addresses))
+        targets.update(dict.fromkeys(
+            a for a in self.config.leader_addresses[self.group_index]
+            if a != self.address))
+
+        def resend():
+            change = self._epoch_change
+            if change is None or change.config is not config:
+                return
+            for dst in change.targets:
+                if dst not in change.acks:
+                    self.send(dst, change.commit)
+            timer.start()
+
+        timer = self.timer("resendEpochCommit", 1.0, resend)
+        timer.start()
+        self._epoch_change = _EpochChange(
+            config=config, commit=commit, targets=set(targets),
+            acks=set(), resend=timer, pending=[], recommit=recommit,
+            need_old_quorum=need_old_quorum)
+        if recommit:
+            self.epochs.offer(config, self.round)
+        for dst in targets:
+            self.send(dst, commit)
+
+    def _handle_reconfigure(self, src: Address,
+                            msg: Reconfigure) -> None:
+        if self.epochs is None:
+            self.logger.warn(
+                "Reconfigure ignored: this leader group has multiple "
+                "acceptor groups (epoch-frozen)")
+            return
+        if self.state != ("phase2",):
+            self.logger.debug("Reconfigure ignored outside phase2")
+            return
+        if self._epoch_change is not None:
+            if not self._epoch_change.activated:
+                self.logger.debug(
+                    "Reconfigure ignored: a change is mid-activation")
+                return
+            # The previous change is ACTIVE and only chasing straggler
+            # acks (possibly of dead members); the new change's commit
+            # flow supersedes those resends.
+            self._abort_epoch_change()
+        current = self.epochs.current()
+        members = tuple(msg.members)
+        if members == current.members:
+            return
+        if self.next_slot < current.start_slot:
+            self.logger.debug("Reconfigure ignored: next_slot below "
+                              "the current epoch's start")
+            return
+        try:
+            config = EpochConfig(epoch=current.epoch + 1,
+                                 start_slot=self.next_slot,
+                                 f=self.config.f, members=members)
+        except ValueError as e:
+            self.logger.warn(f"Reconfigure rejected: {e}")
+            return
+        self._start_epoch_commit(config, recommit=False)
+
+    def _epoch_activation_ready(self, change) -> bool:
+        proxies = set(self.config.proxy_leader_addresses)
+        if not proxies <= change.acks:
+            return False
+        if not change.need_old_quorum:
+            return True  # durability already proven via Phase1bs
+        old = self.epochs.config(change.config.epoch - 1)
+        return old is None or old.has_write_quorum(change.acks)
+
+    def _handle_epoch_ack(self, src: Address, ack: EpochAck) -> None:
+        change = self._epoch_change
+        if change is None or ack.epoch != change.config.epoch \
+                or ack.round != self.round:
+            return
+        change.acks.add(src)
+        if not change.activated and self._epoch_activation_ready(change):
+            try:
+                self.epochs.offer(change.config, self.round)
+            except ValueError as e:
+                self.logger.warn(f"epoch activation aborted: {e}")
+                self._abort_epoch_change()
+                return
+            change.activated = True
+            # Stop chasing old-epoch/peer-leader stragglers once
+            # activated (the reconfigured-OUT member may be dead
+            # forever); proxies and new members still matter.
+            change.targets &= (set(self.config.proxy_leader_addresses)
+                               | set(change.config.members))
+            pending, change.pending = change.pending, []
+            for batch in pending:
+                self._process_batch(batch)
+        if change.activated and change.targets <= change.acks:
+            change.resend.stop()
+            self._epoch_change = None
+
+    def _handle_epoch_commit(self, src: Address,
+                             commit: EpochCommit) -> None:
+        """A peer leader's commit: adopt and ack."""
+        if self.epochs is None:
+            return
+        if self.slot_system.leader(commit.start_slot) != self.group_index:
+            return  # another group's epoch space
+        try:
+            outcome = self.epochs.offer(
+                EpochConfig(epoch=commit.epoch,
+                            start_slot=commit.start_slot,
+                            f=commit.f, members=commit.members),
+                commit.round)
+        except ValueError as e:
+            self.logger.warn(f"peer EpochCommit rejected: {e}")
+            return
+        if outcome in ("new", "replaced", "dup"):
+            self.send(src, EpochAck(epoch=commit.epoch,
+                                    round=commit.round))
+        if outcome in ("new", "replaced") and self.state == ("phase2",):
+            # An active leader adopting a peer's epoch mid-phase2:
+            # gate its own proposals on the durable-commit proof, as
+            # in the post-Phase1 path (no Phase1b reporters here).
+            newest = self.epochs.current()
+            self._abort_epoch_change()
+            self._start_epoch_commit(
+                newest, recommit=True,
+                need_old_quorum=(
+                    self.chosen_watermark <= newest.start_slot))
+
 
 class MenciusProxyLeader(Actor):
     """(mencius/ProxyLeader.scala:31-420)."""
@@ -449,11 +779,45 @@ class MenciusProxyLeader(Actor):
         # Retired / evicted run rounds: start -> set of rounds, for the
         # stray-ack check.
         self._done_runs: dict[int, set] = {}
+        # Reconfiguration (reconfig/): one epoch store per
+        # single-acceptor-group leader group; quorums for its slots
+        # resolve through it (PAX110) and acks count by ADDRESS
+        # membership in the slot's epoch.
+        self.epochs: dict[int, EpochStore] = {}
+        for lg, groups in enumerate(config.acceptor_addresses):
+            if len(groups) == 1:
+                self.epochs[lg] = EpochStore.from_members(
+                    tuple(groups[0]), config.f)
 
-    def _acceptor_group_index_by_slot(self, leader_group: int,
+    # A GROUP-COUNT read for the striping arithmetic, not a membership
+    # read; group counts are structural (reconfig swaps members within
+    # the single group).
+    def _acceptor_group_index_by_slot(self, leader_group: int,  # paxlint: disable=PAX110
                                       slot: int) -> int:
         return ((slot // self.config.num_leader_groups)
                 % len(self.config.acceptor_addresses[leader_group]))
+
+    def _epoch_for_slot(self, slot: int) -> "EpochConfig | None":
+        store = self.epochs.get(self.slot_system.leader(slot))
+        return store.epoch_of_slot(slot) if store is not None else None
+
+    def _handle_epoch_commit(self, src: Address,
+                             commit: EpochCommit) -> None:
+        store = self.epochs.get(self.slot_system.leader(commit.start_slot))
+        if store is None:
+            return
+        try:
+            outcome = store.offer(
+                EpochConfig(epoch=commit.epoch,
+                            start_slot=commit.start_slot,
+                            f=commit.f, members=commit.members),
+                commit.round)
+        except ValueError as e:
+            self.logger.warn(f"EpochCommit rejected: {e}")
+            return
+        if outcome == "stale":
+            return
+        self.send(src, EpochAck(epoch=commit.epoch, round=commit.round))
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, HighWatermark):
@@ -461,6 +825,8 @@ class MenciusProxyLeader(Actor):
             # (ProxyLeader.scala:207-214).
             for leader in self.config.all_leaders():
                 self.send(leader, message)
+        elif isinstance(message, EpochCommit):
+            self._handle_epoch_commit(src, message)
         elif isinstance(message, Phase2a):
             self._handle_phase2a(src, message)
         elif isinstance(message, Phase2b):
@@ -480,11 +846,20 @@ class MenciusProxyLeader(Actor):
         key = (phase2a.slot, phase2a.slot + 1, phase2a.round)
         if key in self.states:
             return
-        leader_group = self.slot_system.leader(phase2a.slot)
-        group = self.config.acceptor_addresses[leader_group][
-            self._acceptor_group_index_by_slot(leader_group, phase2a.slot)]
-        for acceptor in self.rng.sample(list(group),
-                                        self.config.quorum_size):
+        config = self._epoch_for_slot(phase2a.slot)
+        if config is not None:
+            quorum = self.rng.sample(list(config.members),
+                                     config.quorum_size)
+        else:
+            leader_group = self.slot_system.leader(phase2a.slot)
+            # Multi-acceptor-group striping is epoch-frozen.
+            # paxlint: disable=PAX110
+            group = self.config.acceptor_addresses[leader_group][
+                self._acceptor_group_index_by_slot(leader_group,
+                                                   phase2a.slot)]
+            quorum = self.rng.sample(list(group),
+                                     self.config.quorum_size)
+        for acceptor in quorum:
             self.send(acceptor, phase2a)
         self.states[key] = {"phase2a": phase2a, "phase2bs": {}}
 
@@ -495,7 +870,16 @@ class MenciusProxyLeader(Actor):
             self.logger.fatal(f"Phase2b for unknown {key}")
         if state is None or "phase2a" not in state:
             return  # Done or a noop-range entry
-        state["phase2bs"][phase2b.acceptor_index] = phase2b
+        config = self._epoch_for_slot(phase2b.slot)
+        if config is not None:
+            # Address-keyed membership counting: a replacement can
+            # reuse a dead member's (group, index) coordinates, its
+            # address it cannot.
+            if src not in config.members:
+                return
+            state["phase2bs"][src] = phase2b
+        else:
+            state["phase2bs"][phase2b.acceptor_index] = phase2b
         if len(state["phase2bs"]) < self.config.quorum_size:
             return
         for replica in self.config.replica_addresses:
@@ -513,6 +897,8 @@ class MenciusProxyLeader(Actor):
         if k == 0:
             return
         leader_group = self.slot_system.leader(run.start_slot)
+        # paxlint: disable=PAX110 -- group-COUNT read (structural):
+        # multi-group striping decomposes to the per-slot path.
         if len(self.config.acceptor_addresses[leader_group]) > 1:
             for i, value in enumerate(run.values):
                 self._handle_phase2a(src, Phase2a(
@@ -528,9 +914,18 @@ class MenciusProxyLeader(Actor):
             # so its straggler acks are recognized.
             self._done_runs.setdefault(run.start_slot,
                                        set()).add(pending[0])
-        group = self.config.acceptor_addresses[leader_group][0]
-        for acceptor in self.rng.sample(list(group),
-                                        self.config.quorum_size):
+        config = self._epoch_for_slot(run.start_slot)
+        if config is not None:
+            # A run never spans epochs (the leader buffers through the
+            # handover), so the start slot's epoch covers it all.
+            quorum = self.rng.sample(list(config.members),
+                                     config.quorum_size)
+        else:
+            # paxlint: disable=PAX110 -- multi-group striping is frozen
+            group = self.config.acceptor_addresses[leader_group][0]
+            quorum = self.rng.sample(list(group),
+                                     self.config.quorum_size)
+        for acceptor in quorum:
             self.send(acceptor, run)  # encode the values ONCE
         self._runs[run.start_slot] = [run.round, run.stride,
                                       run.values, set()]
@@ -550,7 +945,13 @@ class MenciusProxyLeader(Actor):
                     f"Phase2bRun for unknown run at {phase2b.start_slot}")
             return  # stale-round ack of a live re-proposed run
         round, stride, values, acks = run
-        acks.add(phase2b.acceptor_index)
+        config = self._epoch_for_slot(phase2b.start_slot)
+        if config is not None:
+            if src not in config.members:
+                return  # not this epoch's vote
+            acks.add(src)
+        else:
+            acks.add(phase2b.acceptor_index)
         if len(acks) < self.config.quorum_size:
             return
         for replica in self.config.replica_addresses:
@@ -566,6 +967,17 @@ class MenciusProxyLeader(Actor):
         if key in self.states:
             return
         leader_group = self.slot_system.leader(phase2a.slot_start_inclusive)
+        config = self._epoch_for_slot(phase2a.slot_start_inclusive)
+        if config is not None:
+            # The leader splits skip ranges at epoch boundaries, so the
+            # start slot's epoch covers the whole range.
+            for acceptor in self.rng.sample(list(config.members),
+                                            config.quorum_size):
+                self.send(acceptor, phase2a)
+            self.states[key] = {"noop_range": phase2a,
+                                "phase2bs_per_group": [{}]}
+            return
+        # paxlint: disable=PAX110 -- multi-group striping is frozen
         for group in self.config.acceptor_addresses[leader_group]:
             for acceptor in self.rng.sample(list(group),
                                             self.config.quorum_size):
@@ -585,8 +997,14 @@ class MenciusProxyLeader(Actor):
             self.logger.fatal(f"Phase2bNoopRange for unknown {key}")
         if state is None or "noop_range" not in state:
             return
-        state["phase2bs_per_group"][phase2b.acceptor_group_index][
-            phase2b.acceptor_index] = phase2b
+        config = self._epoch_for_slot(phase2b.slot_start_inclusive)
+        if config is not None:
+            if src not in config.members:
+                return
+            state["phase2bs_per_group"][0][src] = phase2b
+        else:
+            state["phase2bs_per_group"][phase2b.acceptor_group_index][
+                phase2b.acceptor_index] = phase2b
         if any(len(g) < self.config.quorum_size
                for g in state["phase2bs_per_group"]):
             return
@@ -629,6 +1047,11 @@ class MenciusAcceptor(Actor, DurableRole):
         # max-round resolution exact.
         self._voted_runs: SortedDict = SortedDict()
         self.max_voted_slot = -1
+        # Committed reconfiguration epochs (reconfig/): epoch id ->
+        # EpochCommit, round-monotone; WAL'd before the ack leaves and
+        # reported in every Phase1b (the matchmaker role -- see the
+        # multipaxos acceptor).
+        self._epoch_commits: dict[int, EpochCommit] = {}
         # Durability (wal/): the multipaxos acceptor's group-commit
         # contract, strided -- promises/votes/runs/noop-ranges append
         # to the WAL and every dependent ack holds back until
@@ -663,12 +1086,24 @@ class MenciusAcceptor(Actor, DurableRole):
                 self._store_noop_range(record.slot_start_inclusive,
                                        record.slot_end_exclusive,
                                        record.round)
+            elif isinstance(record, WalEpoch):
+                epoch, start, f, rnd, members = decode_epoch_config(
+                    record.payload)
+                known = self._epoch_commits.get(epoch)
+                if known is None or rnd > known.round:
+                    self._epoch_commits[epoch] = EpochCommit(
+                        epoch=epoch, start_slot=start, f=f, round=rnd,
+                        members=members)
             else:
                 self.logger.fatal(
                     f"unexpected acceptor WAL record {record!r}")
 
     def _wal_compact(self) -> None:
         records = [WalPromise(round=self.round)]
+        for epoch in sorted(self._epoch_commits):
+            c = self._epoch_commits[epoch]
+            records.append(WalEpoch(payload=encode_epoch_config(
+                c.epoch, c.start_slot, c.f, c.round, c.members)))
         for start, (count, stride, rnd, values) in \
                 self._voted_runs.items():
             records.append(WalVoteRun(
@@ -696,8 +1131,32 @@ class MenciusAcceptor(Actor, DurableRole):
             self._handle_phase2a_run(src, message)
         elif isinstance(message, Phase2aNoopRange):
             self._handle_phase2a_noop_range(src, message)
+        elif isinstance(message, EpochCommit):
+            self._handle_epoch_commit(src, message)
         else:
             self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+    def _handle_epoch_commit(self, src: Address,
+                             commit: EpochCommit) -> None:
+        """The matchmaker write (see the multipaxos acceptor): store
+        round-monotonically, WAL, ack after the group commit."""
+        if commit.round < self.round:
+            self.send(src, Nack(round=self.round))
+            return
+        known = self._epoch_commits.get(commit.epoch)
+        if known is None or commit.round > known.round:
+            self._epoch_commits[commit.epoch] = commit
+            if self.wal is not None and known != commit:
+                self.wal.append(WalEpoch(payload=encode_epoch_config(
+                    commit.epoch, commit.start_slot, commit.f,
+                    commit.round, commit.members)))
+        elif known is not None and commit.round == known.round \
+                and known != commit:
+            self.logger.fatal(
+                f"conflicting EpochCommits at one round: {known!r} "
+                f"vs {commit!r}")
+        self._wal_send(src, EpochAck(epoch=commit.epoch,
+                                     round=commit.round))
 
     def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
         if phase1a.round < self.round:
@@ -710,7 +1169,11 @@ class MenciusAcceptor(Actor, DurableRole):
                                     acceptor_index=self.index,
                                     round=self.round,
                                     info=self._voted_info(
-                                        phase1a.chosen_watermark)))
+                                        phase1a.chosen_watermark),
+                                    epochs=tuple(
+                                        self._epoch_commits[e]
+                                        for e in sorted(
+                                            self._epoch_commits))))
 
     def _voted_info(self, minimum: int) -> tuple:
         """Every voted slot >= ``minimum`` with its HIGHEST-round vote,

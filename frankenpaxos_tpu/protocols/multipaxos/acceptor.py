@@ -19,10 +19,17 @@ from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.wal import (
     DurableRole,
+    WalEpoch,
     WalPromise,
     WalSnapshot,
     WalVote,
     WalVoteRun,
+)
+from frankenpaxos_tpu.reconfig import (
+    EpochAck,
+    EpochCommit,
+    decode_epoch_config,
+    encode_epoch_config,
 )
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
@@ -88,6 +95,13 @@ class Acceptor(Actor, DurableRole):
         self.round_system = ClassicRoundRobin(config.num_leaders)
         self.round = -1
         self.states: SortedDict = SortedDict()  # slot -> _VoteState
+        # Committed reconfiguration epochs (reconfig/):
+        # epoch id -> EpochCommit, round-monotone per id. The acceptor
+        # is a MATCHMAKER for the epoch map: entries are WAL'd before
+        # the EpochAck leaves (group commit), and every Phase1b reports
+        # them so a new leader's read quorum always discovers activated
+        # epochs (the Flexible-Paxos intersection condition).
+        self._epoch_commits: dict[int, EpochCommit] = {}
         # Run-voted state (Phase2aRun): start -> (end, round, values) --
         # one O(1) record per run instead of per-slot _VoteStates. A
         # slot's authoritative vote is the HIGHEST round across both
@@ -131,6 +145,14 @@ class Acceptor(Actor, DurableRole):
                 self.round = max(self.round, record.round)
                 self._store_run(record.start_slot, record.round,
                                 decode_value_array(record.values))
+            elif isinstance(record, WalEpoch):
+                epoch, start, f, rnd, members = decode_epoch_config(
+                    record.payload)
+                known = self._epoch_commits.get(epoch)
+                if known is None or rnd > known.round:
+                    self._epoch_commits[epoch] = EpochCommit(
+                        epoch=epoch, start_slot=start, f=f, round=rnd,
+                        members=members)
             else:
                 self.logger.fatal(
                     f"unexpected acceptor WAL record {record!r}")
@@ -139,6 +161,10 @@ class Acceptor(Actor, DurableRole):
         """Rewrite the log as one snapshot marker + the live voted
         state (one fsync), reclaiming every older segment."""
         records = [WalPromise(round=self.round)]
+        for epoch in sorted(self._epoch_commits):
+            c = self._epoch_commits[epoch]
+            records.append(WalEpoch(payload=encode_epoch_config(
+                c.epoch, c.start_slot, c.f, c.round, c.members)))
         for start, (end, rnd, values) in self._voted_runs.items():
             records.append(WalVoteRun(
                 start_slot=start, stride=1, round=rnd,
@@ -174,8 +200,38 @@ class Acceptor(Actor, DurableRole):
         elif isinstance(message, BatchMaxSlotRequest):
             self.metrics_requests.labels("BatchMaxSlotRequest").inc()
             self._handle_batch_max_slot_request(src, message)
+        elif isinstance(message, EpochCommit):
+            self.metrics_requests.labels("EpochCommit").inc()
+            self._handle_epoch_commit(src, message)
         else:
             self.logger.fatal(f"unexpected acceptor message {message!r}")
+
+    def _handle_epoch_commit(self, src: Address,
+                             commit: EpochCommit) -> None:
+        """Store one epoch map entry (round-monotone per epoch id),
+        WAL it, and ack only after the drain's group commit -- the
+        matchmaker write: f+1 of these durable acks IS the epoch's
+        commit point."""
+        if commit.round < self.round:
+            # A stale leader defining epochs: nack so it re-runs Phase1
+            # (mirroring the Phase2a round check).
+            self.send(src, Nack(round=self.round))
+            return
+        known = self._epoch_commits.get(commit.epoch)
+        if known is None or commit.round > known.round:
+            self._epoch_commits[commit.epoch] = commit
+            if self.wal is not None and known != commit:
+                self.wal.append(WalEpoch(payload=encode_epoch_config(
+                    commit.epoch, commit.start_slot, commit.f,
+                    commit.round, commit.members)))
+        elif known is not None and commit.round == known.round \
+                and known != commit:
+            self.logger.fatal(
+                f"conflicting EpochCommits at one round: {known!r} "
+                f"vs {commit!r}")
+        # Duplicate commits re-ack (the leader's resend protocol).
+        self._wal_send(src, EpochAck(epoch=commit.epoch,
+                                     round=commit.round))
 
     def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
         if phase1a.round < self.round:
@@ -194,7 +250,9 @@ class Acceptor(Actor, DurableRole):
         self._wal_send(src, Phase1b(
             group_index=self.group_index, acceptor_index=self.index,
             round=self.round,
-            info=self._voted_info(phase1a.chosen_watermark)))
+            info=self._voted_info(phase1a.chosen_watermark),
+            epochs=tuple(self._epoch_commits[e]
+                         for e in sorted(self._epoch_commits))))
 
     def _voted_info(self, minimum: int) -> tuple:
         """Every voted slot >= ``minimum`` with its HIGHEST-round vote,

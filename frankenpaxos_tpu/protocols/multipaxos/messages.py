@@ -111,6 +111,13 @@ class Phase1b:
     acceptor_index: int
     round: int
     info: tuple[Phase1bSlotInfo, ...]
+    # Epoch discovery (reconfig/): every EpochCommit this acceptor has
+    # WAL-durably accepted, as a tuple of reconfig.messages.EpochCommit.
+    # The Flexible-Paxos intersection condition rides here: a new
+    # leader's old-epoch read quorum intersects any activated epoch's
+    # commit write quorum, so at least one Phase1b reports it and the
+    # leader extends Phase1 to cover the new members.
+    epochs: tuple = ()
 
 
 # --- phase 2 ----------------------------------------------------------------

@@ -20,6 +20,14 @@ import random
 
 import numpy as np
 
+from frankenpaxos_tpu.reconfig import (
+    EpochAck,
+    EpochCommit,
+    EpochConfig,
+    EpochPhase2aRun,
+    EpochQuorumTracker,
+    EpochStore,
+)
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
@@ -55,6 +63,13 @@ class ProxyLeaderOptions:
     # flush timer collects the final dispatch during quiescence.
     tpu_pipelined: bool = False
     tpu_flush_period_s: float = 0.005
+    # Reconfiguration (reconfig/): backend for the epoch-segmented
+    # tracker once epoch counting engages ("" follows quorum_backend).
+    epoch_backend: str = ""
+    # Engage the epoch tracker from construction even in a single
+    # epoch (the reconfig_lt A/B's tagged arm); otherwise it engages
+    # on the first committed epoch change / epoch-tagged run.
+    epoch_quorums: bool = False
 
 
 class ProxyLeader(Actor):
@@ -105,6 +120,22 @@ class ProxyLeader(Actor):
                 min_device_slots=options.tpu_min_device_slots)
         else:
             self.tracker = DictQuorumTracker(config)
+        # Reconfiguration (reconfig/): the epoch store resolves
+        # acceptor sets per SLOT once epochs exist; the epoch tracker
+        # counts votes by ADDRESS under each slot's epoch spec. Both
+        # stay dormant (None tracker, single-epoch store) until a
+        # reconfiguration touches this proxy, so the epoch-frozen hot
+        # path is byte-identical to the pre-reconfig one.
+        self.epochs: "EpochStore | None" = None
+        if not config.flexible and config.num_acceptor_groups == 1:
+            self.epochs = EpochStore.from_members(
+                tuple(config.acceptor_addresses[0]), config.f)
+        self._epoch_tracker: "EpochQuorumTracker | None" = None
+        # EpochPhase2aRuns for epochs this proxy has not seen the
+        # commit for yet: epoch -> [run]; replayed when it arrives.
+        self._stashed_epoch_runs: dict[int, list] = {}
+        if options.epoch_quorums and self.epochs is not None:
+            self._ensure_epoch_tracker()
         self._flush_timer = None
         self._collector = None
         if options.quorum_backend == "tpu" and options.tpu_pipelined:
@@ -169,6 +200,12 @@ class ProxyLeader(Actor):
         elif isinstance(message, Phase2aRun):
             self.metrics_requests.labels("Phase2aRun").inc()
             self._handle_phase2a_run(src, message)
+        elif isinstance(message, EpochPhase2aRun):
+            self.metrics_requests.labels("EpochPhase2aRun").inc()
+            self._handle_epoch_phase2a_run(src, message)
+        elif isinstance(message, EpochCommit):
+            self.metrics_requests.labels("EpochCommit").inc()
+            self._handle_epoch_commit(src, message)
         elif isinstance(message, Phase2b):
             self.metrics_requests.labels("Phase2b").inc()
             self._handle_phase2b(src, message)
@@ -186,13 +223,20 @@ class ProxyLeader(Actor):
         if key in self.pending:
             self.logger.debug(f"duplicate Phase2a for {key}; ignoring")
             return
-        if not self.config.flexible:
+        if self.epochs is not None:
+            config = self.epochs.epoch_of_slot(phase2a.slot)
+            quorum = self.rng.sample(list(config.members),
+                                     config.quorum_size)
+        elif not self.config.flexible:
+            # Multi-group striping is epoch-frozen (no store).
+            # paxlint: disable=PAX110
             group = list(self.config.acceptor_addresses[
                 phase2a.slot % self.config.num_acceptor_groups])
             quorum = self.rng.sample(group, self.config.f + 1)
         else:
             write_quorum = self.grid.random_write_quorum(self.rng)
             quorum = [
+                # paxlint: disable=PAX110 -- grids are epoch-frozen
                 self.config.acceptor_addresses[flat // self._row_size]
                 [flat % self._row_size] for flat in write_quorum]
 
@@ -204,50 +248,151 @@ class ProxyLeader(Actor):
                 self.send_no_flush(acceptor, phase2a)
             self._unflushed_phase2as += 1
             if self._unflushed_phase2as >= self.options.flush_phase2as_every_n:
+                # Flushing is connection upkeep, not membership: cover
+                # every address ever buffered to.
+                # paxlint: disable=PAX110
                 for group_addresses in self.config.acceptor_addresses:
                     for acceptor in group_addresses:
                         self.flush(acceptor)
+                if self.epochs is not None:
+                    for acceptor in self.epochs.all_members():
+                        self.flush(acceptor)
                 self._unflushed_phase2as = 0
         self.pending[key] = phase2a.value
+
+    def _admit_run(self, start_slot: int, round: int, values) -> bool:
+        """Install a run's O(1) pending record, evicting a same-start
+        LOWER-round predecessor (a new leader re-proposing the window;
+        mirroring the acceptor's round-monotone vote store -- keeping
+        the old record would swallow the new proposal and strand its
+        slots until recovery). False: duplicate (same or stale round)."""
+        pending = self._runs.get(start_slot)
+        if pending is not None:
+            if round <= pending[1]:
+                return False
+            del self._runs[start_slot]
+            i = bisect.bisect_left(self._run_starts, start_slot)
+            self._run_starts.pop(i)
+            # Remember the evicted (start, end, round) so straggler
+            # old-round acks are recognized instead of tripping the
+            # stray-ack fatal check.
+            bisect.insort(self._done_runs,
+                          (start_slot, pending[0], pending[1]))
+        self._runs[start_slot] = [
+            start_slot + len(values), round, values,
+            np.ones(len(values), dtype=bool), len(values)]
+        bisect.insort(self._run_starts, start_slot)
+        return True
 
     def _handle_phase2a_run(self, src: Address, run: Phase2aRun) -> None:
         """One write quorum for the whole run (drain-granular thrifty:
         the reference samples per slot, ProxyLeader.scala:67-120; one
         sample per run keeps acceptor-side runs whole), one forwarded
         message per quorum member, one O(1) pending record."""
-        k = len(run.values)
-        if k == 0:
+        if len(run.values) == 0:
             return
-        pending = self._runs.get(run.start_slot)
-        if pending is not None:
-            if run.round <= pending[1]:
-                return  # duplicate (same or stale round)
-            # A same-start HIGHER-round run (a new leader re-proposing
-            # the window) evicts the stale pending record -- mirroring
-            # the acceptor's round-monotone vote store; keeping the old
-            # record would swallow the new proposal and strand its
-            # slots until recovery.
-            del self._runs[run.start_slot]
-            i = bisect.bisect_left(self._run_starts, run.start_slot)
-            self._run_starts.pop(i)
-            # Remember the evicted (start, end, round) so straggler
-            # old-round acks are recognized instead of tripping the
-            # stray-ack fatal check.
-            bisect.insort(self._done_runs,
-                          (run.start_slot, pending[0], pending[1]))
-        if not self.config.flexible:
+        if not self._admit_run(run.start_slot, run.round, run.values):
+            return
+        if self.epochs is not None:
+            # Epoch store = the acceptor-set authority (PAX110): for a
+            # plain run the set is the start slot's epoch's (a run
+            # never spans epochs -- the leader splits at boundaries).
+            config = self.epochs.epoch_of_slot(run.start_slot)
+            quorum = self.rng.sample(list(config.members),
+                                     config.quorum_size)
+        elif not self.config.flexible:
+            # Multi-group striping is epoch-frozen (no store); the
+            # config read IS the membership authority here.
+            # paxlint: disable=PAX110
             group = list(self.config.acceptor_addresses[0])
             quorum = self.rng.sample(group, self.config.f + 1)
         else:
             write_quorum = self.grid.random_write_quorum(self.rng)
             quorum = [
+                # paxlint: disable=PAX110 -- grids are epoch-frozen
                 self.config.acceptor_addresses[flat // self._row_size]
                 [flat % self._row_size] for flat in write_quorum]
         self.broadcast(quorum, run)  # encode the values ONCE
-        self._runs[run.start_slot] = [
-            run.start_slot + k, run.round, run.values,
-            np.ones(k, dtype=bool), k]
-        bisect.insort(self._run_starts, run.start_slot)
+
+    def _handle_epoch_phase2a_run(self, src: Address,
+                                  run: EpochPhase2aRun) -> None:
+        """An epoch-tagged run: fan it to ITS epoch's acceptors (as a
+        plain Phase2aRun -- acceptors are epoch-agnostic voters) and
+        count the acks under that epoch's spec. Unknown epoch: stash
+        until the leader's EpochCommit resend lands -- never mis-route
+        a new-epoch run to the old set."""
+        if self.epochs is None:
+            self.logger.fatal(
+                "EpochPhase2aRun on a non-reconfigurable config")
+        if len(run.values) == 0:
+            return
+        config = self.epochs.config(run.epoch)
+        if config is None:
+            self._stashed_epoch_runs.setdefault(run.epoch,
+                                                []).append(run)
+            return
+        self._ensure_epoch_tracker()
+        if not self._admit_run(run.start_slot, run.round, run.values):
+            return
+        quorum = self.rng.sample(list(config.members),
+                                 config.quorum_size)
+        self.broadcast(quorum, Phase2aRun(
+            start_slot=run.start_slot, round=run.round,
+            values=run.values))
+
+    def _handle_epoch_commit(self, src: Address,
+                             commit: EpochCommit) -> None:
+        """Adopt the epoch map entry, switch vote counting onto the
+        epoch-segmented tracker, ack the committing leader, and replay
+        any runs stashed for this epoch."""
+        if self.epochs is None:
+            return
+        try:
+            outcome = self.epochs.offer(
+                EpochConfig(epoch=commit.epoch,
+                            start_slot=commit.start_slot,
+                            f=commit.f, members=commit.members),
+                commit.round)
+        except ValueError as e:
+            self.logger.warn(f"EpochCommit rejected: {e}")
+            return
+        if outcome == "stale":
+            return  # lower-round or non-contiguous: no ack
+        self._ensure_epoch_tracker()
+        self._epoch_tracker.note_epochs()
+        self.send(src, EpochAck(epoch=commit.epoch, round=commit.round))
+        for run in self._stashed_epoch_runs.pop(commit.epoch, []):
+            self._handle_epoch_phase2a_run(src, run)
+
+    def _ensure_epoch_tracker(self) -> None:
+        """Engage epoch-segmented vote counting. Pre-switch state in a
+        dict tracker migrates (its (group, index) votes map to
+        addresses through the epoch-0 config); the TPU tracker's
+        board/spill state cannot be extracted -- quorums straddling
+        that switch complete through protocol-level resends (warned)."""
+        if self._epoch_tracker is not None or self.epochs is None:
+            return
+        backend = self.options.epoch_backend or (
+            "tpu" if self.options.quorum_backend == "tpu" else "dict")
+        self._epoch_tracker = EpochQuorumTracker(
+            self.epochs, backend=backend,
+            window=min(self.options.tpu_window, 1 << 14))
+        if isinstance(self.tracker, DictQuorumTracker):
+            for (slot, rnd), votes in self.tracker.states.items():
+                if not votes:
+                    continue  # Done: the chosen report already left
+                for g, i in votes:
+                    # One-shot migration of pre-epoch vote state; the
+                    # epoch-0 members ARE the config group.
+                    # paxlint: disable=PAX110
+                    addr = self.config.acceptor_addresses[g][i]
+                    self._epoch_tracker.record(slot, rnd, addr)
+            self.tracker.states = {}
+        elif self.options.quorum_backend == "tpu" \
+                and not self.options.epoch_quorums:
+            self.logger.warn(
+                "tpu quorum tracker state not migrated to the epoch "
+                "tracker; in-flight quorums complete via resends")
 
     def _run_for(self, slot: int, round: int):
         """The pending run covering (slot, round), else None."""
@@ -286,6 +431,12 @@ class ProxyLeader(Actor):
                     f"ProxyLeader got Phase2b for {key} but never sent a "
                     f"Phase2a there")
             return
+        if self._epoch_tracker is not None:
+            # Epoch mode counts by voter ADDRESS: carried (group,
+            # index) coordinates collide across epochs when a
+            # replacement reuses a dead member's config slot.
+            self._epoch_tracker.record(phase2b.slot, phase2b.round, src)
+            return
         self.tracker.record(phase2b.slot, phase2b.round,
                             phase2b.group_index, phase2b.acceptor_index)
 
@@ -296,6 +447,11 @@ class ProxyLeader(Actor):
         pending check here -- every slot in the range was a Phase2a THIS
         proxy leader sent to that acceptor, so each is in ``pending`` or
         already ``_done``; ``_emit_chosen`` dedups either way."""
+        if self._epoch_tracker is not None:
+            self._epoch_tracker.record_range(
+                r.slot_start_inclusive, r.slot_end_exclusive, r.round,
+                src)
+            return
         self.tracker.record_range(r.slot_start_inclusive,
                                   r.slot_end_exclusive, r.round,
                                   r.group_index, r.acceptor_index)
@@ -308,6 +464,9 @@ class ProxyLeader(Actor):
         from frankenpaxos_tpu import native
 
         slots, rounds = native.unpack_votes2(m.packed)
+        if self._epoch_tracker is not None:
+            self._epoch_tracker.record_votes(slots, rounds, src)
+            return
         self.tracker.record_votes(slots, rounds, m.group_index,
                                   m.acceptor_index)
 
@@ -316,6 +475,8 @@ class ProxyLeader(Actor):
         # or TPU kernel dispatch) plus the Chosen emission it unlocks.
         with self.trace_stage("quorum-kernel"):
             self._emit_chosen(self.tracker.drain())
+            if self._epoch_tracker is not None:
+                self._emit_chosen(self._epoch_tracker.drain())
         if self._collector is not None:
             while True:
                 dispatch = self.tracker.take_dispatch()

@@ -1,0 +1,43 @@
+"""paxepoch: live reconfiguration with matchmaker-backed epochs.
+
+The BASELINE north star's "Matchmaker reconfiguration (quorum-matrix
+reshape)" capability, grown into a subsystem the workhorse protocol
+families share (docs/RECONFIG.md):
+
+  * ``reconfig.epoch`` -- ``EpochConfig`` / ``EpochStore``: epoch id ->
+    acceptor set + QuorumSpec, watermark-partitioned over slot space,
+    persisted through ``wal.records.WalEpoch`` in the closed WAL tag
+    space.
+  * ``reconfig.messages`` / ``reconfig.wire`` -- the config-change
+    command flow (Reconfigure -> EpochCommit -> EpochAck, epoch-tagged
+    EpochPhase2aRun proposals), fixed-layout codecs on the wire's
+    extended tag page (128-131), corrupt-frame-fuzz gated.
+  * ``reconfig.tracker`` -- ``EpochQuorumTracker``: address-keyed,
+    epoch-segmented vote counting (dict oracle or the TPU
+    ``EpochSegmentedChecker`` whose fused kernels span the handover
+    boundary; ``ops.quorum`` owns the reshape gather).
+
+MultiPaxos wires the full leader-driven flow (propose epoch e+1,
+Phase1-with-both-configs over the Flexible-Paxos intersection
+condition, watermark-bounded handover); Mencius reuses the store,
+messages, and tracker per leader group.
+"""
+
+from frankenpaxos_tpu.reconfig.epoch import (  # noqa: F401
+    EpochConfig,
+    EpochStore,
+)
+from frankenpaxos_tpu.reconfig.messages import (  # noqa: F401
+    EpochAck,
+    EpochCommit,
+    EpochPhase2aRun,
+    Reconfigure,
+)
+from frankenpaxos_tpu.reconfig.tracker import (  # noqa: F401
+    EpochQuorumTracker,
+)
+# Importing the wire module registers the extended-page codecs.
+from frankenpaxos_tpu.reconfig.wire import (  # noqa: F401
+    decode_epoch_config,
+    encode_epoch_config,
+)

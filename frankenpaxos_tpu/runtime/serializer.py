@@ -10,7 +10,8 @@ codec) is format-agnostic, so a fixed-layout binary codec can replace
 pickle per-message-type without touching protocol code.
 
 SECURITY: the no-code-execution-on-decode property holds ONLY for
-messages carried by a registered ``MessageCodec`` (wire tags 1..127).
+messages carried by a registered ``MessageCodec`` (wire tags 1..255;
+128+ ride the 0x00-prefixed extended page).
 Unregistered message types -- and a handful of escape hatches inside
 binary codecs, e.g. exotic sim addresses -- fall back to pickle, and
 ``pickle.loads`` on attacker-controlled bytes executes arbitrary code.
@@ -61,8 +62,13 @@ class MessageCodec(abc.ABC):
 
     #: The message class this codec handles.
     message_type: type
-    #: Wire tag, 1..127 (pickle streams start with 0x80, so one leading
-    #: byte discriminates binary-coded from pickled messages).
+    #: Wire tag. 1..127 encode as a single leading byte (pickle streams
+    #: start with 0x80, so one byte discriminates binary-coded from
+    #: pickled messages). Tags 128..255 live on the EXTENDED PAGE:
+    #: byte 0x00 -- never a primary tag, never a pickle opcode -- is the
+    #: escape prefix, and the second byte carries ``tag - 128``. The
+    #: primary page filled up at PR 4 (every protocol family carries
+    #: codecs); new subsystems allocate from the extended page.
     tag: int
 
     @abc.abstractmethod
@@ -128,8 +134,8 @@ def guarded_pickle_dumps(obj, what: str) -> bytes:
 def register_codec(codec: MessageCodec) -> None:
     """Install a binary codec for its message type (process-global: the
     codec IS the wire schema, so every actor must agree on it)."""
-    if not 1 <= codec.tag <= 127:
-        raise ValueError(f"tag {codec.tag} outside 1..127")
+    if not 1 <= codec.tag <= 255:
+        raise ValueError(f"tag {codec.tag} outside 1..255")
     existing = _CODECS_BY_TAG.get(codec.tag)
     if existing is not None and type(existing) is not type(codec):
         raise ValueError(f"tag {codec.tag} already taken by {existing}")
@@ -142,9 +148,11 @@ class HybridSerializer(Serializer[M]):
     (Phase2a/Phase2b/Chosen/ClientRequest...); pickle for the long tail.
 
     The first byte discriminates: 1..127 selects a registered codec,
-    0x80+ is a pickle stream (every pickle protocol >= 2 starts with
-    the PROTO opcode 0x80). Senders and receivers therefore
-    interoperate in any mix of registered/unregistered types.
+    0x00 escapes to the extended tag page (the second byte selects tag
+    ``128 + byte``), and 0x80+ is a pickle stream (every pickle
+    protocol >= 2 starts with the PROTO opcode 0x80). Senders and
+    receivers therefore interoperate in any mix of
+    registered/unregistered types.
     """
 
     def to_bytes(self, message: M) -> bytes:
@@ -156,7 +164,10 @@ class HybridSerializer(Serializer[M]):
                     f"for {type(message).__name__}")
             return pickle.dumps(message,
                                 protocol=pickle.HIGHEST_PROTOCOL)
-        out = bytearray((codec.tag,))
+        if codec.tag > 127:
+            out = bytearray((0, codec.tag - 128))
+        else:
+            out = bytearray((codec.tag,))
         codec.encode(out, message)
         return bytes(out)
 
@@ -168,11 +179,19 @@ class HybridSerializer(Serializer[M]):
                     "pickle fallback disabled: refusing to decode a "
                     "pickle frame (first byte >= 0x80)")
             return pickle.loads(data)
+        at = 1
+        if tag == 0:
+            # Extended page: 0x00 escape + one tag byte. A bare 0x00
+            # frame is corruption, not a message.
+            if len(data) < 2:
+                raise ValueError("truncated extended-tag frame")
+            tag = 128 + data[1]
+            at = 2
         codec = _CODECS_BY_TAG.get(tag)
         if codec is None:
             raise ValueError(f"no codec registered for wire tag {tag}")
         try:
-            message, _ = codec.decode(data, 1)
+            message, _ = codec.decode(data, at)
         except ValueError:
             raise
         except (struct.error, IndexError, KeyError, UnicodeDecodeError,

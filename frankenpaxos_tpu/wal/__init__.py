@@ -32,6 +32,7 @@ from frankenpaxos_tpu.wal.log import (  # noqa: F401
 from frankenpaxos_tpu.wal.role import DurableRole  # noqa: F401
 from frankenpaxos_tpu.wal.records import (  # noqa: F401
     WalChosenRun,
+    WalEpoch,
     WalNoopRange,
     WalPromise,
     WalSnapshot,

@@ -1,4 +1,4 @@
-"""WAL record types and their fixed-layout codecs (record tags 1-6).
+"""WAL record types and their fixed-layout codecs (record tags 1-7).
 
 Records are protocol-NEUTRAL: value payloads are opaque byte segments
 already encoded by the owning role's wire helpers
@@ -81,6 +81,18 @@ class WalChosenRun:
     start_slot: int
     stride: int
     values: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class WalEpoch:
+    """A committed reconfiguration epoch (reconfig/): ``payload`` is
+    the role-encoded EpochConfig (``reconfig.wire.encode_epoch_config``
+    -- epoch id, activation start slot, f, member addresses). Durable
+    BEFORE the EpochAck leaves the acceptor: a crashed acceptor can
+    never have acked an epoch it will not recover, which is what makes
+    the old-epoch write quorum of acks a real matchmaker commit."""
+
+    payload: bytes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +195,19 @@ class WalChosenRunCodec(MessageCodec):
                             values=values), at
 
 
+class WalEpochCodec(MessageCodec):
+    message_type = WalEpoch
+    tag = 7
+
+    def encode(self, out, message):
+        out += _I32.pack(len(message.payload))
+        out += message.payload
+
+    def decode(self, buf, at):
+        payload, at = _take_bytes(buf, at)
+        return WalEpoch(payload=payload), at
+
+
 class WalSnapshotCodec(MessageCodec):
     message_type = WalSnapshot
     tag = 6
@@ -235,6 +260,6 @@ WAL_SERIALIZER = WalRecordSerializer()
 
 for _codec in (WalPromiseCodec(), WalVoteCodec(), WalVoteRunCodec(),
                WalNoopRangeCodec(), WalChosenRunCodec(),
-               WalSnapshotCodec()):
+               WalSnapshotCodec(), WalEpochCodec()):
     _RECORD_CODECS_BY_TYPE[_codec.message_type] = _codec
     _RECORD_CODECS_BY_TAG[_codec.tag] = _codec

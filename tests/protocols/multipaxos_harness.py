@@ -76,12 +76,35 @@ def _sim_wal(sim_or_storages, address, root=None):
 def crash_restart_acceptor(sim: "MultiPaxosSim", i: int) -> None:
     """kill -9 acceptor ``i`` and restart it from its WAL: volatile
     state (staged acks, the unsynced group-commit buffer) dies; synced
-    promises/votes/runs recover."""
+    promises/votes/runs recover. Replacement acceptors (reconfig)
+    relaunch with THEIR recorded config, like the deployed relaunch
+    reuses the replacement's own config file."""
     old = sim.acceptors[i]
+    config = getattr(sim, "acceptor_configs", {}).get(old.address,
+                                                      sim.config)
     sim.transport.crash(old.address)
     sim.acceptors[i] = Acceptor(
-        old.address, sim.transport, sim.transport.logger, sim.config,
+        old.address, sim.transport, sim.transport.logger, config,
         old.options, wal=_sim_wal(sim, old.address))
+
+
+def add_replacement_acceptor(sim: "MultiPaxosSim", members: tuple,
+                             new_address) -> None:
+    """Construct a reconfiguration replacement: a NEW acceptor at
+    ``new_address`` whose config lists exactly ``members`` as the
+    acceptor group (the deployed driver's rewritten-config shape).
+    The caller then sends ``Reconfigure(members)`` to the leader."""
+    import dataclasses as _dc
+
+    assert new_address in members
+    config = _dc.replace(sim.config,
+                         acceptor_addresses=[list(members)])
+    if not hasattr(sim, "acceptor_configs"):
+        sim.acceptor_configs = {}
+    sim.acceptor_configs[new_address] = config
+    sim.acceptors.append(Acceptor(
+        new_address, sim.transport, sim.transport.logger, config,
+        wal=_sim_wal(sim, new_address)))
 
 
 def crash_restart_replica(sim: "MultiPaxosSim", i: int) -> None:
@@ -117,6 +140,8 @@ def make_multipaxos(
     seed: int = 0,
     log_level: LogLevel = LogLevel.FATAL,
     wal: "bool | str" = False,
+    epoch_tag_runs: bool = False,
+    epoch_quorums: bool = False,
 ) -> MultiPaxosSim:
     """``wal``: False (reference in-memory behavior), True (MemStorage
     WALs, the crash-restart sims), or a directory path (FileStorage
@@ -169,7 +194,8 @@ def make_multipaxos(
     leaders = [
         Leader(a, transport, logger, config,
                LeaderOptions(resend_phase1as_period_s=5.0,
-                             phase1_backend=phase1_backend),
+                             phase1_backend=phase1_backend,
+                             epoch_tag_runs=epoch_tag_runs),
                seed=seed + i)
         for i, a in enumerate(config.leader_addresses)]
     proxy_leaders = [
@@ -178,7 +204,8 @@ def make_multipaxos(
                         quorum_backend=quorum_backend,
                         tpu_window=1 << 12,
                         tpu_pipelined=tpu_pipelined,
-                        tpu_min_device_slots=tpu_min_device_slots),
+                        tpu_min_device_slots=tpu_min_device_slots,
+                        epoch_quorums=epoch_quorums),
                     seed=seed + 10 + i)
         for i, a in enumerate(config.proxy_leader_addresses)]
     acceptors = [

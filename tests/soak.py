@@ -278,6 +278,23 @@ WAL_CHAOS_CONFIGS: list[tuple] = [
 ]
 CONFIGS.extend(WAL_CHAOS_CONFIGS)
 
+# Live reconfiguration interleaved with the WAL chaos schedule
+# (reconfig/, docs/RECONFIG.md): member swaps to fresh replacement
+# acceptors mid-traffic under the same SM-prefix + chosen-uniqueness
+# + exactly-once oracle.
+from tests.protocols.test_protocol_reconfig import (  # noqa: E402
+    MultiPaxosReconfigSimulated,
+)
+
+CONFIGS.extend([
+    ("reconfig-chaos/multipaxos-f1",
+     lambda: MultiPaxosReconfigSimulated(f=1)),
+    ("reconfig-chaos/multipaxos-f1-coalesced",
+     lambda: MultiPaxosReconfigSimulated(f=1, coalesced=True)),
+    ("reconfig-chaos/multipaxos-f2-mixed",
+     lambda: MultiPaxosReconfigSimulated(f=2, coalesced="mixed")),
+])
+
 
 def _expand(entry, num_runs: int):
     """(name, factory[, runs_scale]) -> (name, factory, scaled runs) --

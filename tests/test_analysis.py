@@ -177,6 +177,78 @@ def test_pax106_send_from_thread_target(tmp_path):
                for f in findings)
 
 
+def test_pax110_acceptor_set_read_in_epoch_role(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def __init__(self, config):
+            self.config = config
+            self.epochs = object()
+
+        def receive(self, src, message):
+            group = self.config.acceptor_addresses[0]
+            self.send(group[0], message)
+    """}))
+    assert any(f.rule == "PAX110" and f.scope == "Bad.receive"
+               for f in findings)
+
+
+def test_pax110_reaches_handler_closure_and_quorum_grid(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def __init__(self, config):
+            self.config = config
+            self.epochs = None
+
+        def receive(self, src, message):
+            self._fanout(message)
+
+        def _fanout(self, message):
+            grid = self.config.quorum_grid()
+    """}))
+    assert any(f.rule == "PAX110" and f.scope == "Bad._fanout"
+               for f in findings)
+
+
+def test_pax110_ignores_roles_without_epoch_store(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Frozen(Actor):
+        def __init__(self, config):
+            self.config = config
+
+        def receive(self, src, message):
+            group = self.config.acceptor_addresses[0]
+    """}))
+    assert "PAX110" not in rules_of(findings)
+
+
+def test_pax110_init_reads_are_fine(tmp_path):
+    # Construction-time reads seed the store itself.
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Good(Actor):
+        def __init__(self, config):
+            self.config = config
+            self.epochs = list(config.acceptor_addresses[0])
+
+        def receive(self, src, message):
+            members = self.epochs
+    """}))
+    assert "PAX110" not in rules_of(findings)
+
+
+def test_pax110_pragma_suppresses(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Pragmad(Actor):
+        def __init__(self, config):
+            self.config = config
+            self.epochs = object()
+
+        def receive(self, src, message):
+            # paxlint: disable=PAX110
+            group = self.config.acceptor_addresses[0]
+    """}))
+    assert "PAX110" not in rules_of(findings)
+
+
 def test_pax106_call_soon_threadsafe_is_fine(tmp_path):
     findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
     class Fine(Actor):

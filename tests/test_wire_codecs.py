@@ -796,11 +796,23 @@ def all_codec_samples() -> dict:
         mkp.Phase2a(2, "v"), mkp.Phase2b(2, 1),
         mkp.MatchmakerNack(5), mkp.AcceptorNack(6),
     ]
+    # reconfig (paxepoch): the extended tag page (0x00-escaped).
+    from frankenpaxos_tpu import reconfig as rc
+
+    samples += [
+        rc.Reconfigure(members=(("10.0.0.1", 9000), "a1", "a2")),
+        rc.EpochCommit(epoch=1, start_slot=64, f=1, round=2,
+                       members=("a0", ("10.0.0.2", 9001), "a3")),
+        rc.EpochAck(epoch=1, round=2),
+        rc.EpochPhase2aRun(epoch=1, start_slot=64, round=2,
+                           values=(batch, mp.NOOP)),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] < 128, type(message).__name__
-        by_tag.setdefault(data[0], message)
+        tag = data[0] if data[0] else 128 + data[1]
+        by_tag.setdefault(tag, message)
     return by_tag, serializer._CODECS_BY_TAG
 
 
@@ -853,12 +865,14 @@ def test_registry_wide_corrupt_frame_containment():
     from frankenpaxos_tpu.wal.records import WAL_SERIALIZER
     from frankenpaxos_tpu.wal import (
         WalChosenRun,
+        WalEpoch,
         WalNoopRange,
         WalPromise,
         WalSnapshot,
         WalVote,
         WalVoteRun,
     )
+    from frankenpaxos_tpu.reconfig import encode_epoch_config
 
     for record in [WalPromise(round=3),
                    WalVote(slot=7, round=1, value=b"\x01ab"),
@@ -867,6 +881,8 @@ def test_registry_wide_corrupt_frame_containment():
                    WalNoopRange(slot_start_inclusive=0,
                                 slot_end_exclusive=9, round=1),
                    WalChosenRun(start_slot=3, stride=1, values=b"zz"),
+                   WalEpoch(payload=encode_epoch_config(
+                       1, 64, 1, 2, ("a0", ("10.0.0.2", 9001)))),
                    WalSnapshot(payload=b"snap")]:
         data = WAL_SERIALIZER.to_bytes(record)
         for _ in range(40):
