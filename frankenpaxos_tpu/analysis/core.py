@@ -134,6 +134,7 @@ def _ensure_loaded() -> None:
         codec_rules,
         epoch_rules,
         hotpath_rules,
+        overload_rules,
     )
 
 

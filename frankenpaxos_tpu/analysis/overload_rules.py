@@ -1,0 +1,183 @@
+"""PAX111: unbounded inbound buffers and sleep-based retry loops.
+
+The overload postmortem shape paxload (serve/, docs/SERVING.md)
+exists to prevent: a role buffers inbound work in a bare ``list`` /
+``deque`` with no capacity, so offered load past capacity turns into
+memory growth and timeout storms instead of explicit shedding; or a
+retry "discipline" blocks an event loop in ``time.sleep`` instead of
+using transport timers with jittered backoff (serve/backoff.py).
+
+Two patterns, both scoped to role/transport code:
+
+  * **Unbounded inbound buffer** -- an Actor whose ``__init__``
+    creates ``self.<X> = []``/``list()``/``deque()`` (no ``maxlen``)
+    where ``<X>`` is named like an inbound queue (inbox/inbound/
+    pending/queue/buffer/backlog) and a handler-closure method
+    appends/extends it. Bounding it (a ``deque(maxlen=...)``, any
+    ``len(self.<X>)`` guard in the class, or an
+    ``AdmissionController.inbox_full`` check) clears the finding.
+  * **Sleep-based retry loop** -- a ``time.sleep`` (or bare
+    ``sleep``) call lexically inside a loop anywhere under
+    ``runtime/`` or ``protocols/``. Retry pacing belongs on transport
+    timers with ``serve.Backoff``; a sleeping loop wedges the event
+    loop exactly when the cluster is congested.
+
+Justified exceptions carry ``# paxlint: disable=PAX111``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.actor_rules import (
+    _actor_classes,
+    _handler_closure,
+)
+from frankenpaxos_tpu.analysis.core import (
+    Finding,
+    Project,
+    dotted,
+    register_rules,
+)
+
+RULES = {
+    "PAX111": "unbounded inbound list/deque buffer or sleep-based "
+              "retry loop in role/transport code",
+}
+
+#: Attribute-name fragments that mark a buffer as INBOUND work (the
+#: shape overload grows without bound). Purpose-named state like
+#: ``_staged_writes`` or ``_wal_sends`` is drain-cleared by contract
+#: and stays out of scope.
+_BUFFER_WORDS = ("inbox", "inbound", "pending", "queue", "buffer",
+                 "backlog")
+
+_APPENDS = ("append", "appendleft", "extend", "extendleft")
+
+#: Path segments that mark role/transport code for the sleep-loop
+#: pattern (Actor classes are covered wherever they live). Matched
+#: package-relative so fixture projects scope the same way.
+_SLEEP_SCOPES = ("/runtime/", "/protocols/")
+
+
+def _unbounded_buffer_attrs(cls: ast.ClassDef) -> dict:
+    """{attr name: assign line} for __init__-created list/deque
+    buffers with an inbound-ish name and no maxlen."""
+    out: dict = {}
+    for node in cls.body:
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "__init__"):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for target in sub.targets:
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                name = target.attr.lower()
+                if not any(w in name for w in _BUFFER_WORDS):
+                    continue
+                value = sub.value
+                if isinstance(value, ast.List) and not value.elts:
+                    out[target.attr] = sub.lineno
+                elif isinstance(value, ast.Call):
+                    callee = dotted(value.func).split(".")[-1]
+                    if callee in ("list", "deque") and not any(
+                            kw.arg == "maxlen" for kw in value.keywords):
+                        out[target.attr] = sub.lineno
+    return out
+
+
+def _class_has_bound_guard(cls: ast.ClassDef, attr: str) -> bool:
+    """Any ``len(self.<attr>)`` read or ``inbox_full`` call in the
+    class counts as a capacity guard."""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func)
+            if callee.split(".")[-1] == "inbox_full":
+                return True
+            if callee == "len" and node.args \
+                    and dotted(node.args[0]) == f"self.{attr}":
+                return True
+    return False
+
+
+def _walk_same_scope(root: ast.AST):
+    """``ast.walk`` that does not descend into nested function/class
+    definitions: their bodies run in another scope that may never
+    execute inside the enclosing loop."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def check(project: Project):
+    findings: list = []
+    for mod, cls in _actor_classes(project):
+        buffers = _unbounded_buffer_attrs(cls)
+        if not buffers:
+            continue
+        flagged: set = set()
+        for name, func in _handler_closure(cls).items():
+            for node in ast.walk(func):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _APPENDS):
+                    continue
+                owner = dotted(node.func.value)
+                if not owner.startswith("self."):
+                    continue
+                attr = owner.split(".", 1)[1]
+                if attr not in buffers or attr in flagged:
+                    continue
+                if _class_has_bound_guard(cls, attr):
+                    continue
+                flagged.add(attr)
+                findings.append(Finding(
+                    rule="PAX111", file=mod.path, line=node.lineno,
+                    scope=f"{cls.name}.{name}",
+                    detail=f"self.{attr}",
+                    message=f"handler grows self.{attr} without a "
+                            f"bound: overload becomes memory growth "
+                            f"and timeout storms -- cap it "
+                            f"(deque(maxlen=...), a len() guard, or "
+                            f"serve.AdmissionController.inbox_full) "
+                            f"and shed explicitly"))
+    for mod in project:
+        if not any(seg in mod.path for seg in _SLEEP_SCOPES):
+            continue
+        # One finding per sleep CALL SITE: nested loops both walk over
+        # the same call, and sleeps in functions merely DEFINED inside
+        # a loop run in another scope (_walk_same_scope stops there).
+        seen_lines: set = set()
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            for node in _walk_same_scope(loop):
+                if isinstance(node, ast.Call):
+                    callee = dotted(node.func)
+                    if callee in ("time.sleep", "sleep") \
+                            and node.lineno not in seen_lines:
+                        seen_lines.add(node.lineno)
+                        findings.append(Finding(
+                            rule="PAX111", file=mod.path,
+                            line=node.lineno, scope="",
+                            detail=callee,
+                            message="sleep-based retry loop in "
+                                    "role/transport code: pace "
+                                    "retries on transport timers "
+                                    "with serve.Backoff (a sleeping "
+                                    "loop wedges the event loop "
+                                    "under congestion)"))
+    return findings
+
+
+register_rules(RULES, check)

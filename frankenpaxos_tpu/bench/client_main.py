@@ -37,6 +37,7 @@ from frankenpaxos_tpu.bench.workload import (
 from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
 from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
 
 
 
@@ -102,10 +103,105 @@ def run(protocol_name: str, config_raw: dict, workload, *,
         kind, command = workload.get(rngs[pseudonym])
         op = (client.write if kind == WRITE
               else getattr(client, read_method))
-        op(pseudonym, command, lambda _reply: finished(kind))
+        # Retry-budget give-ups are labeled, never counted as acks --
+        # a backoff-dominated RETRY_EXHAUSTED sample would otherwise
+        # inflate throughput and corrupt the latency percentiles.
+        op(pseudonym, command,
+           lambda reply: finished(
+               "giveup" if reply is RETRY_EXHAUSTED else kind))
 
     return _closed_loops(transport, num_clients, duration_s, warmup_s,
                          issue_op)
+
+
+def run_open_loop(protocol_name: str, config_raw: dict, workload, *,
+                  num_sessions: int, duration_s: float,
+                  read_consistency: str = "linearizable", seed: int = 0,
+                  warmup_s: float = 0.5,
+                  overrides: dict | None = None) -> list:
+    """OPEN-loop driver (paxload): ops issue on the arrival process's
+    schedule, independent of completions -- the load shape overload
+    needs (a closed loop self-throttles and can never offer more than
+    the cluster absorbs). ``workload`` is the SHARED
+    :class:`~frankenpaxos_tpu.bench.workload.OpenLoopWorkload`, the
+    same definition the sim tier draws from (serve/loadgen.py), so
+    "10x offered load" means the same arrival process, key skew, and
+    mix on both arms.
+
+    Sessions are a pseudonym pool: an arrival binds a free pseudonym;
+    when none is free the arrival is dropped-at-the-source and counted
+    (``thinned`` rows are not latencies -- the row kind says what
+    happened: write/read kinds, ``giveup`` for RETRY_EXHAUSTED
+    conclusions). Returns [(kind, start_unix_s, latency_s)] plus one
+    ``("thinned", t, count)`` tail row when any arrivals were thinned.
+    """
+    import numpy as np
+
+    protocol = get_protocol(protocol_name)
+    config = protocol.load_config(config_raw)
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = TcpTransport(("127.0.0.1", free_port()), logger)
+    transport.start()
+    ctx = DeployCtx(config=config, transport=transport, logger=logger,
+                    overrides=overrides or {}, seed=seed)
+    client = protocol.make_client(ctx, transport.listen_address)
+    read_method = READ_METHODS[read_consistency]
+    np_rng = np.random.default_rng(seed)
+    rng = random.Random(seed)
+    rows: list = []
+    done = threading.Event()
+    idle = list(range(num_sessions))
+    thinned = {"count": 0}
+    dt = 0.02
+    t_start = time.time()
+    measure_from = t_start + warmup_s
+    stop_at = t_start + warmup_s + duration_s
+
+    # Absolute fire schedule: each window draws arrivals for exactly dt
+    # of the arrival process, and a window that runs long is followed by
+    # catch-up windows back-to-back, so offered load stays rate*duration
+    # even when per-window work inflates the period (otherwise the
+    # driver would self-throttle at exactly the loads it exists for).
+    sched = {"t": t_start}
+
+    def window() -> None:
+        now = time.time()
+        if now >= stop_at:
+            done.set()
+            return
+        for _ in range(workload.arrival_count(np_rng, sched["t"] - t_start,
+                                              dt)):
+            if not idle:
+                thinned["count"] += 1
+                continue
+            pseudonym = idle.pop()
+            kind, command = workload.get(rng)
+            t0 = time.perf_counter()
+
+            def finished(result, pseudonym=pseudonym, kind=kind,
+                         t0=t0, issued=now) -> None:
+                idle.append(pseudonym)
+                label = ("giveup" if result is RETRY_EXHAUSTED
+                         else kind)
+                if issued >= measure_from:
+                    rows.append((label, issued,
+                                 time.perf_counter() - t0))
+
+            op = (client.write if kind == WRITE
+                  else getattr(client, read_method))
+            op(pseudonym, command, finished)
+        flush = getattr(client, "flush_writes", None)
+        if flush is not None:
+            flush()
+        sched["t"] += dt
+        transport.loop.call_later(max(0.0, sched["t"] - time.time()), window)
+
+    transport.loop.call_soon_threadsafe(window)
+    done.wait(timeout=warmup_s + duration_s + 30)
+    transport.stop()
+    if thinned["count"]:
+        rows.append(("thinned", time.time(), float(thinned["count"])))
+    return rows
 
 
 def run_skewed(protocol_name: str, config_raw: dict, *,
@@ -140,7 +236,8 @@ def run_skewed(protocol_name: str, config_raw: dict, *,
                else str(rng.randrange(num_keys)))
         tags["next"] += 1
         value = "v%d" % tags["next"]
-        done = lambda *_reply: finished("write")  # noqa: E731
+        done = lambda *reply: finished(  # noqa: E731
+            "giveup" if reply and reply[0] is RETRY_EXHAUSTED else "write")
         if protocol_name == "craq":
             client.write(i, key, value, done)
         elif protocol_name == "epaxos":
@@ -187,7 +284,8 @@ def run_drive(protocol_name: str, config_raw: dict, *,
     def issue_op(i: int, finished) -> None:
         tag = tags["next"]
         tags["next"] += 1
-        protocol.drive(clients[i], tag, lambda *_reply: finished("write"))
+        protocol.drive(clients[i], tag, lambda *reply: finished(
+            "giveup" if reply and reply[0] is RETRY_EXHAUSTED else "write"))
 
     return _closed_loops(transport, num_clients, duration_s, warmup_s,
                          issue_op)
@@ -209,13 +307,35 @@ def main(argv=None) -> None:
     parser.add_argument("--point_skew", type=float, default=None,
                         help="point-skewed KV write loops with this "
                              "hot-key fraction (conflict sweep)")
+    parser.add_argument("--open_loop", action="store_true",
+                        help="OPEN-loop arrivals from the shared "
+                             "OpenLoopWorkload (paxload): the "
+                             "--workload spec must be "
+                             '{"name": "open_loop", "rate": ...}')
+    parser.add_argument("--num_sessions", type=int, default=1024,
+                        help="open-loop pseudonym pool size")
     parser.add_argument("--out", required=True)
     args = parser.parse_args(argv)
 
     with open(args.config) as f:
         config_raw = json.load(f)
 
-    if args.point_skew is not None:
+    if args.open_loop:
+        from frankenpaxos_tpu.bench.workload import OpenLoopWorkload
+
+        workload = (workload_from_dict(json.loads(args.workload))
+                    if args.workload else OpenLoopWorkload())
+        assert isinstance(workload, OpenLoopWorkload), \
+            "--open_loop needs an open_loop workload spec"
+        rows = run_open_loop(args.protocol, config_raw, workload,
+                             num_sessions=args.num_sessions,
+                             duration_s=args.duration,
+                             read_consistency=args.read_consistency,
+                             seed=args.seed,
+                             overrides=(json.loads(args.client_options)
+                                        if args.client_options
+                                        else None))
+    elif args.point_skew is not None:
         rows = run_skewed(args.protocol, config_raw,
                           point_fraction=args.point_skew,
                           num_clients=args.num_clients,

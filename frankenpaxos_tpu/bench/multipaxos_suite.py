@@ -313,8 +313,11 @@ def _run_with_client_procs(bench: BenchmarkDirectory,
                 next(f)  # header
                 for line in f:
                     kind, start, latency = line.strip().split(",")
-                    samples[kind][0].append(float(latency))
-                    samples[kind][1].append(float(start))
+                    # Beyond read/write: "giveup" (RETRY_EXHAUSTED) and
+                    # "thinned" rows are kept out of the ack stats.
+                    lat, starts = samples.setdefault(kind, ([], []))
+                    lat.append(float(latency))
+                    starts.append(float(start))
         role_metrics = _scrape_role_metrics(bench, input)
         role_cpu = bench.role_cpu_seconds()
     finally:

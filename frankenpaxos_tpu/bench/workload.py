@@ -168,6 +168,118 @@ ReadWriteWorkload = Union[UniformReadWriteWorkload,
                           WriteOnlyWorkload]
 
 
+# --- the shared open-loop workload (paxload, serve/loadgen.py) -------------
+
+
+@dataclasses.dataclass(frozen=True)
+class OpenLoopWorkload:
+    """THE open-loop generator both bench arms share: the vectorized
+    sim load tier (serve/loadgen.py, bench/overload_lt.py) and the
+    deployed driver (bench/client_main.py --open_loop) draw from this
+    one definition, so "10x offered load" means the same arrival
+    process, key skew, and mix on both paths.
+
+    Open loop: arrivals fire on the ARRIVAL PROCESS's schedule,
+    independent of completions (closed loops self-throttle and can
+    never overload anything -- the pathology "Paxos in the Cloud"
+    documents needs open-loop pressure). Components:
+
+      * ``rate`` arrivals/s aggregate, as a Poisson process
+        (``process="poisson"``) or with heavy-tailed per-window burst
+        modulation (``process="pareto"``: the window's rate is scaled
+        by a Pareto(alpha) multiplier normalized to mean 1 -- bursty
+        like production edges, still open-loop).
+      * Zipf(``zipf_s``) key skew over ``num_keys`` (0 = uniform):
+        the canonical hot-key distribution.
+      * A diurnal ramp: rate * (1 + amplitude * sin(2*pi*t/period)).
+
+    Scalar ``get(rng)`` keeps the ReadWriteWorkload interface for
+    closed-loop reuse; the vectorized entry points take a
+    ``numpy.random.Generator`` and return arrays."""
+
+    rate: float = 1000.0
+    process: str = "poisson"         # "poisson" | "pareto"
+    pareto_alpha: float = 2.5        # burst-tail index (>1)
+    zipf_s: float = 0.0              # 0 = uniform keys
+    num_keys: int = 1024
+    read_fraction: float = 0.0
+    write_size_mean: int = 8
+    write_size_std: int = 0
+    diurnal_amplitude: float = 0.0
+    diurnal_period_s: float = 60.0
+
+    def offered_rate(self, t: float) -> float:
+        """The instantaneous target rate at virtual time ``t``
+        (diurnal modulation only; burstiness is sampled per window)."""
+        if not self.diurnal_amplitude:
+            return self.rate
+        import math
+
+        return self.rate * max(0.0, 1.0 + self.diurnal_amplitude
+                               * math.sin(2 * math.pi * t
+                                          / self.diurnal_period_s))
+
+    def arrival_count(self, np_rng, t: float, dt: float) -> int:
+        """Arrivals in [t, t+dt): Poisson around the modulated rate,
+        optionally Pareto-burst-scaled (mean-1 multiplier, so the
+        long-run offered rate is unchanged -- only its variance)."""
+        lam = self.offered_rate(t) * dt
+        if self.process == "pareto":
+            alpha = self.pareto_alpha
+            # numpy's pareto is the Lomax shift: mean alpha/(alpha-1)
+            # after +1; normalize to mean 1.
+            burst = (1.0 + np_rng.pareto(alpha)) * (alpha - 1.0) / alpha
+            lam *= burst
+        return int(np_rng.poisson(lam))
+
+    def _zipf_cdf(self, np_rng):
+        import numpy as np
+
+        cdf = _ZIPF_CDF_CACHE.get((self.num_keys, self.zipf_s))
+        if cdf is None:
+            ranks = np.arange(1, self.num_keys + 1, dtype=np.float64)
+            weights = ranks ** -self.zipf_s
+            cdf = np.cumsum(weights)
+            cdf /= cdf[-1]
+            _ZIPF_CDF_CACHE[(self.num_keys, self.zipf_s)] = cdf
+        return cdf
+
+    def sample_keys(self, np_rng, n: int):
+        """``n`` key indices, Zipf-skewed (vectorized searchsorted
+        over the precomputed CDF) or uniform when ``zipf_s`` is 0."""
+        import numpy as np
+
+        if not self.zipf_s:
+            return np_rng.integers(0, self.num_keys, n)
+        u = np_rng.random(n)
+        return np.searchsorted(self._zipf_cdf(np_rng), u)
+
+    def sample_kinds(self, np_rng, n: int):
+        """Boolean read mask for ``n`` ops."""
+        return np_rng.random(n) < self.read_fraction
+
+    def get(self, rng: random.Random) -> tuple[str, bytes]:
+        """Scalar ReadWriteWorkload-compatible draw (the deployed
+        closed-loop drivers and tests)."""
+        if self.zipf_s:
+            # Inverse-CDF draw through the same table as the
+            # vectorized path (one bisect).
+            import bisect
+
+            cdf = self._zipf_cdf(None)
+            key = str(bisect.bisect_left(cdf, rng.random()))
+        else:
+            key = str(rng.randrange(self.num_keys))
+        if rng.random() < self.read_fraction:
+            return READ, _SER.to_bytes(GetRequest((key,)))
+        value = _sized_value(rng, self.write_size_mean,
+                             self.write_size_std)
+        return WRITE, _SER.to_bytes(SetRequest(((key, value),)))
+
+
+_ZIPF_CDF_CACHE: dict = {}
+
+
 # Client read-consistency level -> multipaxos Client method name
 # (Client.scala:851-933, :697+, :739+).
 READ_METHODS = {
@@ -184,6 +296,7 @@ _BY_NAME = {
     "point_skewed_read_write": PointSkewedReadWriteWorkload,
     "uniform_multi_key_read_write": UniformMultiKeyReadWriteWorkload,
     "write_only": WriteOnlyWorkload,
+    "open_loop": OpenLoopWorkload,
 }
 
 

@@ -134,6 +134,52 @@ class RuntimeMetrics:
             help="WAL group-commit fsync latency (one per drain)",
             labels=("role",)).labels(role)
         self._stage_children: dict = {}
+        # paxload (serve/): the admission/backpressure families every
+        # /metrics role exports -- registered here (not lazily) so the
+        # series exist at zero on every role, admission enabled or not
+        # (the Grafana "Runtime" row charts them fleet-wide).
+        self._adm_admitted = collectors.counter(
+            "fpx_runtime_admission_admitted_total",
+            help="Client commands admitted by this role's admission "
+                 "controller",
+            labels=("role",)).labels(role)
+        self._adm_rejected = collectors.counter(
+            "fpx_runtime_admission_rejected_total",
+            help="Client commands rejected (tokens/inflight/queue/"
+                 "codel)",
+            labels=("role", "reason"))
+        self._adm_shed = collectors.counter(
+            "fpx_runtime_admission_shed_total",
+            help="Client-lane frames shed by a bounded inbox "
+                 "(drop-oldest/reject-newest)",
+            labels=("role", "policy"))
+        self._adm_inflight = collectors.gauge(
+            "fpx_runtime_admission_inflight",
+            help="Live proposed-minus-chosen in-flight span under the "
+                 "slot budget",
+            labels=("role",)).labels(role)
+        self._adm_queue = collectors.gauge(
+            "fpx_runtime_admission_queue_depth",
+            help="Client-lane bounded-inbox depth",
+            labels=("role",)).labels(role)
+        self._retry_counter = collectors.counter(
+            "fpx_runtime_client_retries_total",
+            help="Client retry-discipline events "
+                 "(backoff/failover/giveup)",
+            labels=("role", "kind"))
+        self._outbuf_hwm = collectors.gauge(
+            "fpx_runtime_outbound_buffer_bytes",
+            help="Per-role outbound-buffer high-water mark (bytes "
+                 "pending to the slowest peer)",
+            labels=("role",)).labels(role)
+        self._outbuf_stalls = collectors.counter(
+            "fpx_runtime_outbound_stalls_total",
+            help="Outbound buffer overflows (oldest frames dropped; "
+                 "protocol resends cover)",
+            labels=("role",)).labels(role)
+        self._adm_rejected_children: dict = {}
+        self._adm_shed_children: dict = {}
+        self._retry_children: dict = {}
 
     def observe_stage(self, stage: str, dur_s: float) -> None:
         child = self._stage_children.get(stage)
@@ -146,6 +192,44 @@ class RuntimeMetrics:
 
     def observe_batch(self, depth: int) -> None:
         self._depth_gauge.set(depth)
+
+    # --- paxload admission/backpressure (serve/) ------------------------
+    def admission_admitted(self, n: int = 1) -> None:
+        self._adm_admitted.inc(n)
+
+    def admission_rejected(self, reason: str, n: int = 1) -> None:
+        child = self._adm_rejected_children.get(reason)
+        if child is None:
+            child = self._adm_rejected.labels(self.role, reason)
+            self._adm_rejected_children[reason] = child
+        child.inc(n)
+
+    def admission_shed(self, policy: str, n: int = 1) -> None:
+        child = self._adm_shed_children.get(policy)
+        if child is None:
+            child = self._adm_shed.labels(self.role, policy)
+            self._adm_shed_children[policy] = child
+        child.inc(n)
+
+    def admission_inflight(self, value: int) -> None:
+        self._adm_inflight.set(value)
+
+    def admission_queue_depth(self, value: int) -> None:
+        self._adm_queue.set(value)
+
+    def client_retry(self, kind: str, n: int = 1) -> None:
+        child = self._retry_children.get(kind)
+        if child is None:
+            child = self._retry_counter.labels(self.role, kind)
+            self._retry_children[kind] = child
+        child.inc(n)
+
+    def outbound_buffer_hwm(self, size_bytes: int) -> None:
+        if size_bytes > self._outbuf_hwm.get():
+            self._outbuf_hwm.set(size_bytes)
+
+    def outbound_stall(self, n: int = 1) -> None:
+        self._outbuf_stalls.inc(n)
 
 
 class _Scope:

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.serve.messages import Rejected
 from frankenpaxos_tpu.statemachine import StateMachine
 from frankenpaxos_tpu.utils import BufferMap
 from frankenpaxos_tpu.wal import (
@@ -75,6 +76,7 @@ class MenciusReplica(Actor, DurableRole):
         self.log_grow_size = log_grow_size
         self.log: BufferMap = BufferMap(log_grow_size)
         self.executed_watermark = 0
+        self._wm_dirty = False  # executed advanced since last drain
         self.num_chosen = 0
         self.high_watermark = -1
         self.client_table: dict[tuple, tuple[int, bytes]] = {}
@@ -187,7 +189,28 @@ class MenciusReplica(Actor, DurableRole):
         self.log.garbage_collect(self.executed_watermark)
 
     def on_drain(self) -> None:
+        # Drain-granular watermark tail (paxload; see the multipaxos
+        # replica): without it, a quiet pipeline leaves the leaders'
+        # watermark view up to N-1 slots stale and a watermark-tied
+        # admission budget wedges shut.
+        if (self._wm_dirty
+                and self.executed_watermark
+                % self.send_chosen_watermark_every_n
+                and self.executed_watermark % self.config.num_replicas
+                == self.index):
+            self._send_chosen_watermark()
+        self._wm_dirty = False
         self._wal_drain()  # group commit, then release the held replies
+
+    def _send_chosen_watermark(self) -> None:
+        watermark = ChosenWatermark(slot=self.executed_watermark)
+        proxy = self._proxy_replica()
+        if proxy is not None:
+            self._wal_send(proxy, watermark)
+        else:
+            for group in self.config.leader_addresses:
+                for leader in group:
+                    self._wal_send(leader, watermark)
 
     def _proxy_replica(self) -> Optional[Address]:
         if not self.config.proxy_replica_addresses:
@@ -242,18 +265,12 @@ class MenciusReplica(Actor, DurableRole):
                 for command in value.commands:
                     self._execute_command(slot, command, replies)
             self.executed_watermark += 1
+            self._wm_dirty = True
             every_n = self.send_chosen_watermark_every_n
             if (self.executed_watermark % every_n == 0
                     and (self.executed_watermark // every_n)
                     % self.config.num_replicas == self.index):
-                watermark = ChosenWatermark(slot=self.executed_watermark)
-                proxy = self._proxy_replica()
-                if proxy is not None:
-                    self._wal_send(proxy, watermark)
-                else:
-                    for group in self.config.leader_addresses:
-                        for leader in group:
-                            self._wal_send(leader, watermark)
+                self._send_chosen_watermark()
 
     def _after_choose(self, coalesce_replies: bool = False) -> None:
         replies = self._execute_log()
@@ -370,6 +387,8 @@ class _PendingWrite:
     command: bytes
     callback: Callable[[bytes], None]
     resend: object
+    attempts: int = 0
+    backoff_pending: bool = False
 
 
 class MenciusClient(Actor):
@@ -379,12 +398,20 @@ class MenciusClient(Actor):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: MenciusConfig,
                  resend_period_s: float = 10.0,
-                 coalesce_writes: bool = False, seed: int = 0):
+                 coalesce_writes: bool = False, seed: int = 0,
+                 retry_budget: int = 0, backoff=None):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
         self.rng = random.Random(seed)
         self.resend_period_s = resend_period_s
+        # paxload retry discipline (serve/backoff.py): 0 = unlimited
+        # resends, the pre-paxload behavior; see multipaxos
+        # ClientOptions.retry_budget for the contract.
+        self.retry_budget = retry_budget
+        from frankenpaxos_tpu.serve.backoff import Backoff
+
+        self.backoff = backoff or Backoff()
         # Coalesce this event-loop pass's writes into ONE
         # ClientRequestArray to a random group's leader (each command
         # still gets its own owned slot there). Flushed by on_drain /
@@ -453,6 +480,11 @@ class MenciusClient(Actor):
             self._send_request(request)
 
         def resend():
+            state = self.states.get(pseudonym)
+            if not isinstance(state, _PendingWrite) or state.id != id \
+                    or not self._consume_retry(pseudonym, state,
+                                               "failover"):
+                return
             self._send_request(request)
             timer.start()
 
@@ -462,6 +494,72 @@ class MenciusClient(Actor):
         self.states[pseudonym] = _PendingWrite(
             id, command, callback or (lambda _: None), timer)
         self.ids[pseudonym] = id + 1
+
+    def _consume_retry(self, pseudonym: int, state, kind: str) -> bool:
+        """Retry-budget bookkeeping (see multipaxos Client)."""
+        if self.retry_budget <= 0:
+            return True
+        from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
+
+        metrics = self.transport.runtime_metrics
+        if state.attempts >= self.retry_budget:
+            state.resend.stop()
+            del self.states[pseudonym]
+            if metrics is not None:
+                metrics.client_retry("giveup")
+            state.callback(RETRY_EXHAUSTED)
+            return False
+        state.attempts += 1
+        if metrics is not None:
+            metrics.client_retry(kind)
+        return True
+
+    def _handle_rejected(self, rejected) -> None:
+        """Admission refused: jittered exponential backoff, then
+        re-issue to the SAME leader class (no failover -- the leader
+        is alive, just saturated)."""
+        for pseudonym, client_id in rejected.entries:
+            state = self.states.get(pseudonym)
+            if state is None or client_id != state.id:
+                continue
+            if state.backoff_pending:
+                # One backoff per operation (see the multipaxos
+                # client): the resend's duplicate Rejected must not
+                # double-consume the budget or double-reissue.
+                continue
+            state.resend.stop()
+            if not self._consume_retry(pseudonym, state, "backoff"):
+                continue
+            if self.retry_budget <= 0:
+                state.attempts += 1
+            delay_s = self.backoff.delay_s(
+                state.attempts - 1, self.rng,
+                floor_s=rejected.retry_after_ms / 1000.0)
+            expected = state
+            state.backoff_pending = True
+
+            def reissue(pseudonym=pseudonym, expected=expected):
+                current = self.states.get(pseudonym)
+                if current is not expected:
+                    return
+                current.backoff_pending = False
+                request = ClientRequest(Command(
+                    CommandId(self.address, pseudonym, current.id),
+                    current.command))
+                if self.coalesce_writes:
+                    # Coalesce backoff expiries back into one array
+                    # (see the multipaxos client's reissue path).
+                    self._staged_writes.append(request.command)
+                    loop = getattr(self.transport, "loop", None)
+                    if loop is not None and not self._flush_scheduled:
+                        self._flush_scheduled = True
+                        loop.call_soon_threadsafe(self._deferred_flush)
+                else:
+                    self._send_request(request)
+                current.resend.start()
+
+            timer = self.timer(f"backoff{pseudonym}", delay_s, reissue)
+            timer.start()
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, ClientReply):
@@ -486,6 +584,8 @@ class MenciusClient(Actor):
             for leader in self.config.leader_addresses[
                     message.leader_group_index]:
                 self.send(leader, LeaderInfoRequestClient())
+        elif isinstance(message, Rejected):
+            self._handle_rejected(message)
         elif isinstance(message, LeaderInfoReplyClient):
             if message.round > self.rounds[message.leader_group_index]:
                 self.rounds[message.leader_group_index] = message.round

@@ -184,10 +184,41 @@ class MenciusLeader(Actor):
                  send_high_watermark_every_n: int = 100,
                  send_noop_range_if_lagging_by: int = 100,
                  election_options: ElectionOptions = ElectionOptions(),
-                 seed: int = 0):
+                 seed: int = 0,
+                 admission_token_rate: float = 0.0,
+                 admission_token_burst: float = 0.0,
+                 admission_inflight_limit: int = 0,
+                 admission_inbox_capacity: int = 0,
+                 admission_inbox_policy: str = "reject",
+                 admission_codel_target_s: float = 0.0,
+                 admission_codel_interval_s: float = 0.1,
+                 admission_retry_after_ms: int = 0):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        # paxload admission (serve/): built only when armed; the
+        # in-flight measure is this group's owned-slot span
+        # (next_slot - chosen_watermark) / stride, refreshed on
+        # proposals and ChosenWatermark advances.
+        from frankenpaxos_tpu.serve.admission import (
+            AdmissionController,
+            AdmissionOptions,
+        )
+
+        admission_options = AdmissionOptions(
+            token_rate=admission_token_rate,
+            token_burst=admission_token_burst,
+            inflight_limit=admission_inflight_limit,
+            inbox_capacity=admission_inbox_capacity,
+            inbox_policy=admission_inbox_policy,
+            codel_target_s=admission_codel_target_s,
+            codel_interval_s=admission_codel_interval_s,
+            retry_after_ms=admission_retry_after_ms)
+        if admission_options.any_enabled():
+            self.admission = AdmissionController(
+                admission_options, role="mencius_leader",
+                metrics=transport.runtime_metrics)
+            transport.note_admission(address, self)
         self.rng = random.Random(seed)
         self.send_high_watermark_every_n = send_high_watermark_every_n
         self.send_noop_range_if_lagging_by = send_noop_range_if_lagging_by
@@ -205,6 +236,10 @@ class MenciusLeader(Actor):
         self.next_slot = self.group_index
         self.high_watermark = self.next_slot
         self.chosen_watermark = 0
+        # Commands admitted while in _Phase1 (pending_batches, no slot
+        # yet) -- counted by the in-flight resyncs (see the multipaxos
+        # leader's _sync_inflight).
+        self._admitted_backlog = 0
         self._commands_since_watermark_send = 0
         self._current_proxy_leader = self.rng.randrange(
             config.num_proxy_leaders)
@@ -300,6 +335,8 @@ class MenciusLeader(Actor):
         timer = self.timer("resendPhase1as", self.resend_phase1as_period_s,
                            resend)
         timer.start()
+        # Fresh Phase1 = fresh (empty) pending backlog.
+        self._admitted_backlog = 0
         return _Phase1(
             phase1bs=[{} for _ in self._my_acceptor_groups],
             pending_batches=[], recover_slot=recover_slot,
@@ -410,6 +447,9 @@ class MenciusLeader(Actor):
             self._handle_nack(src, message)
         elif isinstance(message, ChosenWatermark):
             self.chosen_watermark = max(self.chosen_watermark, message.slot)
+            if self.admission is not None:
+                # Drain-granular release (see the multipaxos leader).
+                self._sync_inflight()
         elif isinstance(message, Recover):
             self._handle_recover(src, message)
         elif isinstance(message, Reconfigure):
@@ -529,6 +569,36 @@ class MenciusLeader(Actor):
                                      need_old_quorum=not proven)
         for batch in phase1.pending_batches:
             self._process_batch(batch)
+        # The backlog just moved into the span; resync so it isn't
+        # double-counted.
+        self._admitted_backlog = 0
+        if self.admission is not None:
+            self._sync_inflight()
+
+    def _sync_inflight(self) -> None:
+        """Resync to the live in-flight measure: this group's
+        owned-slot span plus the Phase1 backlog (see the multipaxos
+        leader's _sync_inflight for why the backlog must count)."""
+        stride = self.config.num_leader_groups
+        self.admission.set_inflight(
+            (self.next_slot - self.chosen_watermark) // stride
+            + self._admitted_backlog)
+
+    def _admit(self, message, n: int) -> bool:
+        """paxload admission (the multipaxos leader's _admit, with
+        this group's owned-slot span as the in-flight measure)."""
+        admission = self.admission
+        if admission is None:
+            return True
+        if admission.admit(n):
+            return True
+        from frankenpaxos_tpu.serve.admission import reject_replies_for
+
+        for client, reply in reject_replies_for(
+                message, admission.retry_after_ms(),
+                admission.last_reason):
+            self.send(client, reply)
+        return False
 
     def _handle_client_request_batch(self, src: Address,
                                      batch: ClientRequestBatch,
@@ -538,7 +608,10 @@ class MenciusLeader(Actor):
                 self.send(src, NotLeaderClient(self.group_index))
             else:
                 self.send(src, NotLeaderBatcher(self.group_index, batch))
+        elif not self._admit(batch, len(batch.batch.commands)):
+            pass
         elif isinstance(self.state, _Phase1):
+            self._admitted_backlog += len(batch.batch.commands)
             self.state.pending_batches.append(batch)
         else:
             self._process_batch(batch)
@@ -552,12 +625,36 @@ class MenciusLeader(Actor):
             return
         if self.state == ("inactive",):
             self.send(src, NotLeaderClient(self.group_index))
-        elif isinstance(self.state, _Phase1):
-            for command in array.commands:
+            return
+        commands = array.commands
+        if self.admission is not None:
+            commands = self._admit_prefix(commands)
+            if not commands:
+                return
+            if len(commands) < len(array.commands):
+                array = ClientRequestArray(commands=commands)
+        if isinstance(self.state, _Phase1):
+            self._admitted_backlog += len(commands)
+            for command in commands:
                 self.state.pending_batches.append(
                     ClientRequestBatch(CommandBatch((command,))))
         else:
             self._process_request_array(array)
+
+    def _admit_prefix(self, commands: tuple) -> tuple:
+        """Partial admission for a coalesced array (see the multipaxos
+        leader's _admit_prefix)."""
+        admission = self.admission
+        k = admission.admit_up_to(len(commands))
+        if k < len(commands):
+            from frankenpaxos_tpu.serve.admission import reject_replies_for
+
+            for address, reply in reject_replies_for(
+                    ClientRequestArray(commands=commands[k:]),
+                    retry_after_ms=admission.retry_after_ms(),
+                    reason=admission.last_reason):
+                self.send(address, reply)
+        return commands[:k]
 
     def _handle_high_watermark(self, src: Address,
                                message: HighWatermark) -> None:

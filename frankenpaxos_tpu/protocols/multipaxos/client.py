@@ -25,6 +25,8 @@ from typing import Callable, Optional
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED, Backoff
+from frankenpaxos_tpu.serve.messages import Rejected
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ClientReply,
@@ -64,6 +66,14 @@ class ClientOptions:
     # resends still go per-request. Bypasses batchers: the array is
     # transport-level coalescing, not slot sharing.
     coalesce_writes: bool = False
+    # paxload retry discipline (serve/backoff.py, docs/SERVING.md).
+    # retry_budget = 0 keeps the pre-paxload behavior: unlimited
+    # resends, Rejected treated as an immediate-backoff retry with no
+    # cap. With a budget, EVERY retry (Rejected backoff or timeout
+    # failover) consumes it, and exhaustion completes the operation
+    # with serve.RETRY_EXHAUSTED -- no request ever wedges silently.
+    retry_budget: int = 0
+    backoff: Backoff = Backoff()
 
 
 @dataclasses.dataclass
@@ -72,15 +82,23 @@ class _PendingWrite:
     command: bytes
     callback: Callback
     resend: object
+    attempts: int = 0
+    backoff_pending: bool = False
 
 
 @dataclasses.dataclass
 class _MaxSlot:
+    # No backoff_pending: while the state is _MaxSlot the only
+    # outstanding requests are MaxSlotRequests to acceptors, which
+    # carry no admission controller and never draw a Rejected (the
+    # state becomes _PendingRead in the same handler that sends the
+    # rejectable ReadRequest).
     id: int
     command: bytes
     callback: Callback
     replies: dict[tuple[int, int], int]
     resend: object
+    attempts: int = 0
 
 
 @dataclasses.dataclass
@@ -89,6 +107,12 @@ class _PendingRead:
     command: bytes
     callback: Callback
     resend: object
+    attempts: int = 0
+    backoff_pending: bool = False
+    # The in-flight read request + target replica, kept so a Rejected
+    # read can be re-issued after backoff without re-deriving the slot.
+    request: object = None
+    replica: object = None
 
 
 class Client(Actor):
@@ -152,9 +176,15 @@ class Client(Actor):
         if timer is None:
             def resend():
                 # Reads the CURRENT pending write (the timer outlives
-                # individual operations).
+                # individual operations). A timeout is the FAILOVER
+                # signal (the leader may be gone) -- re-send on the
+                # normal discovery path; with a retry budget set, the
+                # failover consumes it like any other retry.
                 state = self.states.get(pseudonym)
                 if isinstance(state, _PendingWrite):
+                    if not self._consume_retry(pseudonym, state,
+                                               "failover"):
+                        return
                     self._send_client_request(ClientRequest(Command(
                         CommandId(self.address, pseudonym, state.id),
                         state.command)))
@@ -165,6 +195,26 @@ class Client(Actor):
                 self.options.resend_client_request_period_s, resend)
             self._write_timers[pseudonym] = timer
         return timer
+
+    def _consume_retry(self, pseudonym: int, state, kind: str) -> bool:
+        """Retry-budget bookkeeping (serve/backoff.py contract): True =
+        proceed with the retry; False = the budget is exhausted and the
+        operation just completed with RETRY_EXHAUSTED."""
+        budget = self.options.retry_budget
+        if budget <= 0:
+            return True
+        metrics = self.transport.runtime_metrics
+        if state.attempts >= budget:
+            state.resend.stop()
+            del self.states[pseudonym]
+            if metrics is not None:
+                metrics.client_retry("giveup")
+            state.callback(RETRY_EXHAUSTED)
+            return False
+        state.attempts += 1
+        if metrics is not None:
+            metrics.client_retry(kind)
+        return True
 
     def read(self, pseudonym: int, command: bytes,
              callback: Optional[Callback] = None) -> None:
@@ -184,6 +234,11 @@ class Client(Actor):
             self.send(batcher, read_request)
 
             def resend_batched():
+                state = self.states.get(pseudonym)
+                if not isinstance(state, _PendingRead) \
+                        or not self._consume_retry(pseudonym, state,
+                                                   "failover"):
+                    return
                 self.send(batcher, read_request)
                 timer.start()
 
@@ -192,7 +247,9 @@ class Client(Actor):
                 self.options.resend_read_request_period_s, resend_batched)
             timer.start()
             self.states[pseudonym] = _PendingRead(id, command, callback,
-                                                  timer)
+                                                  timer,
+                                                  request=read_request,
+                                                  replica=batcher)
             self.ids[pseudonym] = id + 1
             return
         request = MaxSlotRequest(CommandId(self.address, pseudonym, id))
@@ -210,6 +267,11 @@ class Client(Actor):
             self.send(acceptor, request)
 
         def resend():
+            state = self.states.get(pseudonym)
+            if not isinstance(state, _MaxSlot) \
+                    or not self._consume_retry(pseudonym, state,
+                                               "failover"):
+                return
             for acceptor in resend_to:
                 self.send(acceptor, request)
             timer.start()
@@ -233,7 +295,9 @@ class Client(Actor):
         replica = self._random_replica()
         self.send(replica, request)
         timer = self._make_read_resend_timer(pseudonym, replica, request)
-        self.states[pseudonym] = _PendingRead(id, command, callback, timer)
+        self.states[pseudonym] = _PendingRead(id, command, callback, timer,
+                                              request=request,
+                                              replica=replica)
         self.ids[pseudonym] = id + 1
 
     def eventual_read(self, pseudonym: int, command: bytes,
@@ -246,7 +310,9 @@ class Client(Actor):
         replica = self._random_replica()
         self.send(replica, request)
         timer = self._make_read_resend_timer(pseudonym, replica, request)
-        self.states[pseudonym] = _PendingRead(id, command, callback, timer)
+        self.states[pseudonym] = _PendingRead(id, command, callback, timer,
+                                              request=request,
+                                              replica=replica)
         self.ids[pseudonym] = id + 1
 
     # --- helpers ----------------------------------------------------------
@@ -292,6 +358,11 @@ class Client(Actor):
     def _make_read_resend_timer(self, pseudonym: int, replica: Address,
                                 request) -> object:
         def resend():
+            state = self.states.get(pseudonym)
+            if not isinstance(state, _PendingRead) \
+                    or not self._consume_retry(pseudonym, state,
+                                               "failover"):
+                return
             self.send(replica, request)
             timer.start()
 
@@ -314,8 +385,83 @@ class Client(Actor):
             self._handle_not_leader(src, message)
         elif isinstance(message, LeaderInfoReplyClient):
             self._handle_leader_info(src, message)
+        elif isinstance(message, Rejected):
+            self._handle_rejected(src, message)
         else:
             self.logger.fatal(f"unexpected client message {message!r}")
+
+    # --- paxload retry discipline (serve/, docs/SERVING.md) ---------------
+    def _handle_rejected(self, src: Address, rejected: Rejected) -> None:
+        """Admission refused these commands: the server is ALIVE but
+        saturated. Back off (jittered exponential, the server's
+        retry_after_ms as a floor) and re-issue to the SAME
+        destination class -- unlike a timeout, no failover. Each
+        backoff consumes the retry budget when one is set."""
+        for pseudonym, client_id in rejected.entries:
+            state = self.states.get(pseudonym)
+            if state is None or client_id != getattr(state, "id", None):
+                self.logger.debug(
+                    f"stale Rejected entry for pseudonym {pseudonym}")
+                continue
+            if getattr(state, "backoff_pending", True):
+                # Under overload the resend and the original both reach
+                # the leader and each draws a Rejected; one backoff per
+                # operation, or the budget is double-consumed and the
+                # shedding leader gets duplicate reissues. The True
+                # default drops states that cannot be rejected at all
+                # (_MaxSlot: acceptors carry no admission).
+                continue
+            state.resend.stop()
+            if not self._consume_retry(pseudonym, state, "backoff"):
+                continue
+            delay_s = self.options.backoff.delay_s(
+                state.attempts - 1 if self.options.retry_budget > 0
+                else state.attempts, self.rng,
+                floor_s=rejected.retry_after_ms / 1000.0)
+            if self.options.retry_budget <= 0:
+                # No budget: attempts still drive the backoff curve.
+                state.attempts += 1
+            self._schedule_reissue(pseudonym, state, delay_s)
+
+    def _schedule_reissue(self, pseudonym: int, state,
+                          delay_s: float) -> None:
+        """One-shot jittered-backoff timer re-issuing ``state``'s
+        operation. The closure re-validates the pending state at fire
+        time: a completion (or a newer operation) in the backoff
+        window makes it a no-op."""
+        expected_id = state.id
+        state.backoff_pending = True
+
+        def reissue():
+            current = self.states.get(pseudonym)
+            if current is not state \
+                    or getattr(current, "id", None) != expected_id:
+                return
+            current.backoff_pending = False
+            if isinstance(current, _PendingWrite):
+                request = ClientRequest(Command(
+                    CommandId(self.address, pseudonym, current.id),
+                    current.command))
+                if self.options.coalesce_writes:
+                    # Re-enter through the STAGED path: a burst of
+                    # backoff expiries coalesces back into one
+                    # ClientRequestArray instead of a retry storm of
+                    # singles (the storm would re-congest the very
+                    # leader that just shed us).
+                    self._staged_writes.append(request.command)
+                    loop = getattr(self.transport, "loop", None)
+                    if loop is not None and not self._flush_scheduled:
+                        self._flush_scheduled = True
+                        loop.call_soon_threadsafe(self._deferred_flush)
+                else:
+                    self._send_client_request(request)
+            elif isinstance(current, _PendingRead) \
+                    and current.request is not None:
+                self.send(current.replica, current.request)
+            current.resend.start()
+
+        timer = self.timer(f"backoff{pseudonym}", delay_s, reissue)
+        timer.start()
 
     def _handle_client_reply(self, src: Address, reply: ClientReply) -> None:
         pseudonym = reply.command_id.client_pseudonym
@@ -384,7 +530,10 @@ class Client(Actor):
         state.resend.stop()
         timer = self._make_read_resend_timer(pseudonym, replica, request)
         self.states[pseudonym] = _PendingRead(state.id, state.command,
-                                              state.callback, timer)
+                                              state.callback, timer,
+                                              attempts=state.attempts,
+                                              request=request,
+                                              replica=replica)
 
     def _handle_read_reply(self, src: Address, reply: ReadReply) -> None:
         pseudonym = reply.command_id.client_pseudonym

@@ -88,6 +88,25 @@ class LeaderOptions:
     # reconfiguration commits, tagging engages regardless.
     epoch_tag_runs: bool = False
     resend_epoch_commit_period_s: float = 1.0
+    # paxload admission control (serve/admission.py, docs/SERVING.md).
+    # All zeros (the default) admits everything and builds NO
+    # controller -- the admission-off hot path is one ``is None`` test.
+    # The in-flight budget is tied to the run pipeline's watermark:
+    # the live span is next_slot - chosen_watermark, refreshed on
+    # every proposal and every ChosenWatermark advance.
+    admission_token_rate: float = 0.0
+    admission_token_burst: float = 0.0
+    admission_inflight_limit: int = 0
+    admission_inbox_capacity: int = 0
+    admission_inbox_policy: str = "reject"
+    admission_codel_target_s: float = 0.0
+    admission_codel_interval_s: float = 0.1
+    admission_retry_after_ms: int = 0
+
+    def admission_options(self):
+        from frankenpaxos_tpu.serve.admission import options_from_flat
+
+        return options_from_flat(self)
 
 
 class _Inactive:
@@ -176,6 +195,23 @@ class Leader(Actor):
         self.round = self.round_system.next_classic_round(0, -1)
         self.next_slot = 0
         self.chosen_watermark = 0
+        # Commands admitted while in _Phase1 (sitting in
+        # pending_batches with no slot yet): the in-flight resyncs
+        # must count them, or a long Phase1 admits without bound.
+        self._admitted_backlog = 0
+        # paxload admission (serve/): built only when an option arms
+        # it, so admission-off deployments keep the exact pre-paxload
+        # hot path (Actor.admission stays None for the transports too).
+        admission_options = options.admission_options()
+        if admission_options is not None:
+            from frankenpaxos_tpu.serve.admission import (
+                AdmissionController,
+            )
+
+            self.admission = AdmissionController(
+                admission_options, role=f"leader_{self.index}",
+                metrics=transport.runtime_metrics)
+            transport.note_admission(address, self)
         self._current_proxy_leader = 0
         self._unflushed_phase2as = 0
         self._chunk_sent = 0
@@ -434,6 +470,8 @@ class Leader(Actor):
         timer = self.timer("resendPhase1as",
                            self.options.resend_phase1as_period_s, resend)
         timer.start()
+        # Fresh Phase1 = fresh (empty) pending backlog.
+        self._admitted_backlog = 0
         return _Phase1(
             phase1bs=[{} for _ in range(self.config.num_acceptor_groups)],
             phase1b_acceptors=set(),
@@ -640,12 +678,69 @@ class Leader(Actor):
             self._ensure_epoch_durability(reporters)
         for batch in phase1.pending_batches:
             self._process_client_request_batch(batch)
+        # The backlog just moved into the span (next_slot advanced per
+        # batch); resync so it isn't double-counted.
+        self._admitted_backlog = 0
+        if self.admission is not None:
+            self._sync_inflight()
+
+    def _sync_inflight(self) -> None:
+        """Resync the controller to the LIVE in-flight measure:
+        proposed-minus-chosen span (the run pipeline's own count of
+        outstanding work) plus the Phase1 backlog of admitted-but-
+        unslotted commands. Called only where the measure actually
+        changes (watermark advances, Phase1 exit) -- between resyncs
+        ``admit()``'s own increments accumulate, so the budget binds
+        even while next_slot is frozen in Phase1."""
+        self.admission.set_inflight(
+            self.next_slot - self.chosen_watermark
+            + self._admitted_backlog)
+
+    def _admit(self, message, n: int) -> bool:
+        """paxload admission for ``n`` client commands (serve/): on
+        refusal, answer with explicit Rejected wire replies so clients
+        back off instead of re-sending into the congestion.
+        Control-plane messages never pass through here -- only the
+        three client-request shapes do."""
+        admission = self.admission
+        if admission is None:
+            return True
+        if admission.admit(n):
+            return True
+        from frankenpaxos_tpu.serve.admission import reject_replies_for
+
+        for client, reply in reject_replies_for(
+                message, admission.retry_after_ms(),
+                admission.last_reason):
+            self.send(client, reply)
+        return False
+
+    def _admit_prefix(self, commands: tuple) -> tuple:
+        """Partial admission for a coalesced array: serve the prefix
+        the budget allows, explicitly reject the suffix (one Rejected
+        -- all commands in an array come from ONE client)."""
+        admission = self.admission
+        if admission is None:
+            return commands
+        k = admission.admit_up_to(len(commands))
+        if k < len(commands):
+            from frankenpaxos_tpu.serve.admission import reject_replies_for
+
+            for address, reply in reject_replies_for(
+                    ClientRequestArray(commands=commands[k:]),
+                    retry_after_ms=admission.retry_after_ms(),
+                    reason=admission.last_reason):
+                self.send(address, reply)
+        return commands[:k]
 
     def _handle_client_request(self, src: Address,
                                request: ClientRequest) -> None:
         if isinstance(self.state, _Inactive):
             self.send(src, NotLeaderClient())
+        elif not self._admit(request, 1):
+            pass
         elif isinstance(self.state, _Phase1):
+            self._admitted_backlog += 1
             self.state.pending_batches.append(
                 ClientRequestBatch(CommandBatch((request.command,))))
         else:
@@ -663,7 +758,13 @@ class Leader(Actor):
         if isinstance(self.state, _Inactive):
             self.send(src, NotLeaderClient())
             return
+        commands = self._admit_prefix(array.commands)
+        if not commands:
+            return
+        if len(commands) < len(array.commands):
+            array = ClientRequestArray(commands=commands)
         if isinstance(self.state, _Phase1):
+            self._admitted_backlog += len(array.commands)
             for command in array.commands:
                 self.state.pending_batches.append(
                     ClientRequestBatch(CommandBatch((command,))))
@@ -705,7 +806,10 @@ class Leader(Actor):
             # Bounce the batch back so the batcher can re-route it
             # (Leader.scala:606-634).
             self.send(src, NotLeaderBatcher(client_request_batch=batch))
+        elif not self._admit(batch, len(batch.batch.commands)):
+            pass
         elif isinstance(self.state, _Phase1):
+            self._admitted_backlog += len(batch.batch.commands)
             self.state.pending_batches.append(batch)
         else:
             self._process_client_request_batch(batch)
@@ -732,6 +836,10 @@ class Leader(Actor):
     def _handle_chosen_watermark(self, src: Address,
                                  msg: ChosenWatermark) -> None:
         self.chosen_watermark = max(self.chosen_watermark, msg.slot)
+        if self.admission is not None:
+            # Drain-granular release: the watermark advance IS the
+            # signal that in-flight slots completed their quorums.
+            self._sync_inflight()
 
     def _handle_recover(self, src: Address, recover: Recover) -> None:
         # Re-running Phase1 recovers every unchosen slot below some chosen

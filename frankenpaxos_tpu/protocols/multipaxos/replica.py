@@ -64,6 +64,19 @@ class ReplicaOptions:
     recover_log_entry_max_period_s: float = 20.0
     unsafe_dont_recover: bool = False
     measure_latencies: bool = True
+    # paxload read-path admission (serve/admission.py): a replica
+    # sheds READ traffic only -- Chosen/ChosenRun deliveries are the
+    # write pipeline's control plane and never pass the controller.
+    # The in-flight measure here is the deferred-read backlog. All
+    # zeros (default) builds no controller.
+    admission_token_rate: float = 0.0
+    admission_token_burst: float = 0.0
+    admission_inflight_limit: int = 0
+    admission_inbox_capacity: int = 0
+    admission_inbox_policy: str = "reject"
+    admission_codel_target_s: float = 0.0
+    admission_codel_interval_s: float = 0.1
+    admission_retry_after_ms: int = 0
 
 
 class Replica(Actor, DurableRole):
@@ -105,6 +118,20 @@ class Replica(Actor, DurableRole):
         # watermark GC extended to disk). wal=None is the reference's
         # in-memory behavior.
         self._wal_init(wal)
+        # paxload read-path admission (serve/): built only when armed.
+        self._deferred_read_count = 0
+        self._wm_dirty = False  # executed advanced since last drain
+        from frankenpaxos_tpu.serve.admission import (
+            AdmissionController,
+            options_from_flat,
+        )
+
+        admission_options = options_from_flat(options)
+        if admission_options is not None:
+            self.admission = AdmissionController(
+                admission_options, role=f"replica_{self.index}",
+                metrics=transport.runtime_metrics)
+            transport.note_admission(address, self)
         self.recover_timer = None
         if wal is not None:
             self._recover_from_wal()
@@ -211,10 +238,34 @@ class Replica(Actor, DurableRole):
         self.deferred_reads.garbage_collect(self.executed_watermark)
 
     def on_drain(self) -> None:
+        # Drain-granular watermark tail (paxload): the every-N
+        # notification above leaves the leader's view up to N-1 slots
+        # stale when the pipeline goes quiet mid-decade -- with a
+        # watermark-tied in-flight admission budget that staleness is
+        # a LIVENESS hole (the span never drops below the limit and
+        # every retry is rejected until budgets exhaust). One extra
+        # message per drain, from one replica (slot-round-robin),
+        # closes the tail.
+        if (self._wm_dirty
+                and self.executed_watermark
+                % self.options.send_chosen_watermark_every_n_entries
+                and self.executed_watermark % self.config.num_replicas
+                == self.index):
+            self._send_chosen_watermark()
+        self._wm_dirty = False
         # GROUP COMMIT (DurableRole): one fsync covers every chosen
         # entry this drain logged; only then do the replies it
         # produced go out.
         self._wal_drain()
+
+    def _send_chosen_watermark(self) -> None:
+        watermark = ChosenWatermark(slot=self.executed_watermark)
+        proxy = self._proxy_replica_address()
+        if proxy is not None:
+            self._wal_send(proxy, watermark)
+        else:
+            for leader in self.config.leader_addresses:
+                self._wal_send(leader, watermark)
 
     # --- helpers ----------------------------------------------------------
     def _proxy_replica_address(self) -> Optional[Address]:
@@ -273,18 +324,13 @@ class Replica(Actor, DurableRole):
             if reads is not None:
                 self._process_deferred_reads(reads)
             self.executed_watermark += 1
+            self._wm_dirty = True
 
             every_n = self.options.send_chosen_watermark_every_n_entries
             if (self.executed_watermark % every_n == 0
                     and (self.executed_watermark // every_n)
                     % self.config.num_replicas == self.index):
-                watermark = ChosenWatermark(slot=self.executed_watermark)
-                proxy = self._proxy_replica_address()
-                if proxy is not None:
-                    self._wal_send(proxy, watermark)
-                else:
-                    for leader in self.config.leader_addresses:
-                        self._wal_send(leader, watermark)
+                self._send_chosen_watermark()
 
     def _execute_read(self, command: Command) -> ReadReply:
         result = self.state_machine.run(command.command)
@@ -301,7 +347,43 @@ class Replica(Actor, DurableRole):
                 self.send(reply.command_id.client_address, reply)
 
     def _process_deferred_reads(self, reads: list[Command]) -> None:
+        self._deferred_read_count -= len(reads)
+        if self.admission is not None:
+            self.admission.set_inflight(self._deferred_read_count)
         self._send_read_replies([self._execute_read(c) for c in reads])
+
+    def _admit_read(self, command: Command, sync: bool = True) -> bool:
+        """paxload read admission: the in-flight measure is the
+        deferred-read backlog; refusal answers the CLIENT (not the
+        read batcher) with an explicit Rejected so its backoff engages
+        instead of a resend storm. ``sync=False`` skips the backlog
+        resync so batch callers can sync ONCE and let ``admit()``'s
+        increments accumulate across the batch -- resyncing per
+        command would erase them and the limit would never bind
+        within one batch."""
+        admission = self.admission
+        if admission is None:
+            return True
+        if sync:
+            admission.set_inflight(self._deferred_read_count)
+        if admission.admit(1):
+            return True
+        from frankenpaxos_tpu.serve.messages import Rejected
+
+        cid = command.command_id
+        self.send(cid.client_address, Rejected(
+            entries=((cid.client_pseudonym, cid.client_id),),
+            retry_after_ms=admission.retry_after_ms(),
+            reason=admission.last_reason))
+        return False
+
+    def _defer_read(self, slot: int, command: Command) -> None:
+        reads = self.deferred_reads.get(slot)
+        if reads is None:
+            self.deferred_reads.put(slot, [command])
+        else:
+            reads.append(command)
+        self._deferred_read_count += 1
 
     # --- handlers ---------------------------------------------------------
     def receive(self, src: Address, message) -> None:
@@ -330,24 +412,59 @@ class Replica(Actor, DurableRole):
             self._handle_read_request_batch(src, ReadRequestBatch(
                 slot=message.slot, commands=message.commands))
         elif isinstance(message, EventualReadRequestBatch):
-            self._send_read_replies(
-                [self._execute_read(c) for c in message.commands])
+            self._handle_eventual_read_batch(message)
         else:
             self.logger.fatal(f"unexpected replica message {message!r}")
+
+    def _handle_eventual_read_batch(self, batch) -> None:
+        """Batched eventual reads execute immediately (no defer), but
+        still pass read admission: each refused command's client gets
+        a Rejected, like the single-message path. Sync once per batch
+        so the limit binds within it, then settle back to the
+        deferred-read backlog."""
+        admission = self.admission
+        if admission is None:
+            commands = batch.commands
+        else:
+            admission.set_inflight(self._deferred_read_count)
+            commands = [c for c in batch.commands
+                        if self._admit_read(c, sync=False)]
+        try:
+            if commands:
+                self._send_read_replies(
+                    [self._execute_read(c) for c in commands])
+        finally:
+            if admission is not None:
+                admission.set_inflight(self._deferred_read_count)
 
     def _handle_read_request_batch(self, src: Address,
                                    batch: ReadRequestBatch) -> None:
         """Batched deferrable reads (Replica.scala:478-530
         handleDeferrableReads)."""
-        if batch.slot >= self.executed_watermark:
-            reads = self.deferred_reads.get(batch.slot)
-            if reads is None:
-                self.deferred_reads.put(batch.slot, list(batch.commands))
-            else:
-                reads.extend(batch.commands)
-            return
-        self._send_read_replies(
-            [self._execute_read(c) for c in batch.commands])
+        admission = self.admission
+        if admission is None:
+            # Admission-off fast path: no per-command filter call (the
+            # disabled-path budget is one attribute load + is-None per
+            # frame, see runtime/actor.py).
+            commands = batch.commands
+        else:
+            admission.set_inflight(self._deferred_read_count)
+            commands = [c for c in batch.commands
+                        if self._admit_read(c, sync=False)]
+        try:
+            if not commands:
+                return
+            if batch.slot >= self.executed_watermark:
+                for command in commands:
+                    self._defer_read(batch.slot, command)
+                return
+            self._send_read_replies(
+                [self._execute_read(c) for c in commands])
+        finally:
+            # Settle to the true backlog: deferred reads are in
+            # _deferred_read_count; immediately-executed ones release.
+            if admission is not None:
+                admission.set_inflight(self._deferred_read_count)
 
     def _wal_log_chosen_run(self, start_slot: int, values,
                             all_new: bool) -> None:
@@ -426,12 +543,10 @@ class Replica(Actor, DurableRole):
                              request: ReadRequest) -> None:
         """Linearizable read at a slot; defer until executed
         (Replica.scala:455-530)."""
+        if not self._admit_read(request.command):
+            return
         if request.slot >= self.executed_watermark:
-            reads = self.deferred_reads.get(request.slot)
-            if reads is None:
-                self.deferred_reads.put(request.slot, [request.command])
-            else:
-                reads.append(request.command)
+            self._defer_read(request.slot, request.command)
             return
         self.send(src, self._execute_read(request.command))
 
@@ -445,4 +560,6 @@ class Replica(Actor, DurableRole):
 
     def _handle_eventual_read_request(self, src: Address,
                                       request: EventualReadRequest) -> None:
+        if not self._admit_read(request.command):
+            return
         self.send(src, self._execute_read(request.command))

@@ -58,6 +58,14 @@ class Actor(abc.ABC):
     # subclass can still pin its own serializer.
     serializer: Serializer = DEFAULT_SERIALIZER
 
+    # paxload (serve/): an attached serve.AdmissionController makes the
+    # transports enforce this actor's bounded client-lane inbox and
+    # CoDel drain-delay shedding, and lets the role's own handlers
+    # admit/reject client commands. None (the default) keeps every
+    # hook to one attribute load + an ``is None`` test -- the <3%
+    # disabled-path budget (bench_results/overload_lt.json).
+    admission = None
+
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger):
         self.address = address

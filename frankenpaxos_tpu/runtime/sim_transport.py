@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+from collections import deque
 from typing import Callable, Optional, Union
 
 from frankenpaxos_tpu.runtime.actor import Actor
@@ -49,6 +50,11 @@ class SimTimer(Timer):
         self.delay_s = delay_s
         self._f = f
         self.running = False
+        # Bumped on every start(): reused timer objects (clients keep
+        # one resend timer per pseudonym) need restarts distinguishable
+        # from still-running, or a virtual-time pump keeps the OLD
+        # operation's deadline for the new one (serve/loadgen.py).
+        self.starts = 0
 
     @property
     def name(self) -> str:
@@ -60,15 +66,23 @@ class SimTimer(Timer):
 
     def start(self) -> None:
         self.running = True
+        self.starts += 1
+        # The transport's registry holds RUNNING timers only: clients
+        # create a fresh timer per resend/backoff, so registering for
+        # the timer object's lifetime would leak the dict (and the
+        # per-tick running_timers() scan) without bound under
+        # sustained load (serve/loadgen.py pumps millions).
+        self._transport.timers[self._id] = self
 
     def stop(self) -> None:
         self.running = False
+        self._transport.timers.pop(self._id, None)
 
     def run(self) -> None:
         """Fire the timer (one-shot: stops first, like
         FakeTransport.scala:40-46)."""
         if self.running:
-            self.running = False
+            self.stop()
             self._f()
 
 
@@ -100,18 +114,122 @@ class SimTransport(Transport):
         self.partitioned: set[Address] = set()
         self.history: list[SimCommand] = []
         self._ids = itertools.count()
+        # paxload (serve/): destinations with a bounded client-lane
+        # inbox -- address -> that actor's AdmissionController -- the
+        # per-destination count of buffered client-lane frames, and
+        # those frames themselves in arrival order (so drop-oldest is
+        # an O(capacity) deque pop, not a frame_lane scan of the whole
+        # buffer, which goes quadratic exactly when shedding must be
+        # cheap). All three dicts stay empty unless a registered actor
+        # carries an admission controller with an inbox capacity, so
+        # the admission-off hot path pays one falsy-dict test per send.
+        self._inbox_policies: dict[Address, object] = {}
+        self._inbox_depth: dict[Address, int] = {}
+        self._client_inbox: dict[Address, deque] = {}
 
     # --- Transport API ----------------------------------------------------
     def register(self, address: Address, actor: Actor) -> None:
         if address in self.actors:
             raise ValueError(f"an actor is already registered at {address}")
         self.actors[address] = actor
+        if actor.admission is not None:
+            self.note_admission(address, actor)
+
+    def note_admission(self, address: Address, actor: Actor) -> None:
+        """Arm the bounded client-lane inbox for ``address``. Called
+        from register() when the controller predates registration, and
+        by roles that attach one AFTER ``Actor.__init__`` registered
+        them (the usual order: options are parsed in the subclass
+        constructor)."""
+        admission = actor.admission
+        if admission is not None and admission.options.inbox_capacity:
+            from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, frame_lane
+
+            self._inbox_policies[address] = admission
+            # Recompute rather than trust stale state: a crash ->
+            # restart leaves the dead incarnation's frames buffered
+            # (the network does not know about the crash) and they
+            # deliver to whatever re-registers here.
+            self._client_inbox[address] = deque(
+                m for m in self.messages
+                if m.dst == address and frame_lane(m.data) == LANE_CLIENT)
+            self._inbox_depth[address] = len(self._client_inbox[address])
 
     def send(self, src: Address, dst: Address, data: bytes) -> None:
+        tracked = False
+        if self._inbox_policies:
+            verdict = self._admit_to_inbox(src, dst, data)
+            if not verdict:
+                return
+            tracked = verdict == "track"
         tracer = self.tracer
         trace = tracer.current if tracer is not None else None
-        self.messages.append(
-            SimMessage(next(self._ids), src, dst, data, trace))
+        message = SimMessage(next(self._ids), src, dst, data, trace)
+        self.messages.append(message)
+        if tracked:
+            self._client_inbox.setdefault(dst, deque()).append(message)
+
+    def _admit_to_inbox(self, src: Address, dst: Address,
+                        data: bytes) -> Optional[str]:
+        """Bounded-inbox enforcement for ``dst`` (serve/admission.py).
+        Only CLIENT-lane frames count against (or are ever shed from)
+        the bound; control-plane frames always buffer. Returns None
+        when the frame must NOT be buffered (reject-newest) -- the
+        ONLY falsy verdict, chaos tests hook this to assert control
+        frames are never refused -- "buffer" for frames outside the
+        bound, or "track" for client-lane frames counted against it
+        (mirrored in ``_client_inbox``)."""
+        admission = self._inbox_policies.get(dst)
+        if admission is None:
+            return "buffer"
+        from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, frame_lane
+
+        if frame_lane(data) != LANE_CLIENT:
+            return "buffer"
+        depth = self._inbox_depth.get(dst, 0)
+        if admission.inbox_full(depth):
+            if admission.options.inbox_policy == "drop":
+                # Drop-oldest: shed the longest-waiting client frame
+                # (it has aged the most; the newest arrival has the
+                # best chance of completing inside its deadline).
+                # _client_inbox mirrors the buffered client-lane
+                # frames in arrival order, so this is O(capacity).
+                pending = self._client_inbox.get(dst)
+                while pending:
+                    oldest = pending.popleft()
+                    try:
+                        self.messages.remove(oldest)
+                        break
+                    except ValueError:
+                        continue  # removed out-of-band (live.py drop)
+                admission.note_shed("drop-oldest")
+                depth -= 1
+            else:
+                # Reject-newest: never buffered, and the client hears
+                # about it NOW -- synthesize the Rejected wire replies
+                # (extended tag page) from the would-be receiver.
+                admission.note_shed("reject-newest")
+                self._send_reject_replies(dst, data)
+                return None
+        self._inbox_depth[dst] = depth + 1
+        admission.note_inbox_depth(depth + 1)
+        return "track"
+
+    def _send_reject_replies(self, dst: Address, data: bytes) -> None:
+        from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+        from frankenpaxos_tpu.serve.admission import reject_replies_for
+        from frankenpaxos_tpu.serve.messages import REASON_QUEUE
+
+        admission = self._inbox_policies[dst]
+        try:
+            message = DEFAULT_SERIALIZER.from_bytes(data)
+        except ValueError:
+            return  # corrupt frame: nothing to reject, just shed
+        for client, reply in reject_replies_for(
+                message, admission.retry_after_ms(), REASON_QUEUE):
+            self.messages.append(SimMessage(
+                next(self._ids), dst, client,
+                DEFAULT_SERIALIZER.to_bytes(reply), None))
 
     def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
         self.send(src, dst, data)
@@ -121,9 +239,9 @@ class SimTransport(Transport):
 
     def timer(self, address: Address, name: str, delay_s: float,
               f: Callable[[], None]) -> SimTimer:
-        t = SimTimer(self, next(self._ids), address, name, delay_s, f)
-        self.timers[t.id] = t
-        return t
+        # Registration happens in SimTimer.start(): self.timers holds
+        # running timers only (see SimTimer.start).
+        return SimTimer(self, next(self._ids), address, name, delay_s, f)
 
     # --- test / simulator API (FakeTransport.scala:142-230) ---------------
     def running_timers(self) -> list[SimTimer]:
@@ -152,6 +270,21 @@ class SimTransport(Transport):
         except ValueError:
             self.logger.warn(f"delivering unbuffered message {message}")
             return None
+        if self._inbox_policies and message.dst in self._inbox_policies:
+            from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, frame_lane
+
+            if frame_lane(message.data) == LANE_CLIENT:
+                self._inbox_depth[message.dst] = max(
+                    0, self._inbox_depth.get(message.dst, 0) - 1)
+                pending = self._client_inbox.get(message.dst)
+                if pending:
+                    # Usually the leftmost (FIFO delivery); adversarial
+                    # sims deliver out of order, but the deque is
+                    # capacity-bounded so remove() stays O(capacity).
+                    try:
+                        pending.remove(message)
+                    except ValueError:
+                        pass
         if (message.dst in self.partitioned
                 or message.src in self.partitioned):
             # Dropped at the partition: not part of the delivered history
@@ -278,6 +411,12 @@ class SimTransport(Transport):
         if self.tracer is not None:
             self.tracer.event(f"crash {address}")
         self.actors.pop(address, None)
+        # The bounded-inbox policy dies with its controller; the
+        # restarted actor's register() re-attaches (and recomputes the
+        # buffered depth) if it carries admission again.
+        self._inbox_policies.pop(address, None)
+        self._inbox_depth.pop(address, None)
+        self._client_inbox.pop(address, None)
         for timer_id in [tid for tid, t in self.timers.items()
                          if t.address == address]:
             del self.timers[timer_id]

@@ -104,11 +104,20 @@ class TcpTimer(Timer):
 
 class _Conn:
     """One outbound connection with lazy connect + pending buffer
-    (NettyTcpTransport.scala:377-445)."""
+    (NettyTcpTransport.scala:377-445). The buffer is BOUNDED
+    (paxload): a slow or dead peer must not grow it without limit --
+    past the cap the oldest frames drop (at-most-once transport;
+    protocol resends cover) and the stall is counted."""
 
     def __init__(self):
         self.writer: Optional[asyncio.StreamWriter] = None
         self.pending: list[bytes] = []
+        self.pending_bytes = 0
+        # Largest pending_bytes already pushed to the HWM gauge: the
+        # gauge (a mutex-protected prometheus read+set) is only touched
+        # when this connection sets a NEW high-water mark, keeping the
+        # per-frame cost to one int compare.
+        self.hwm_reported = 0
         self.connecting = False
 
 
@@ -117,6 +126,13 @@ class TcpTransport(Transport):
     thread (``start()``) for synchronous callers like the CLI mains."""
 
     threaded = True
+
+    #: Per-connection outbound buffer cap in bytes (paxload). Past it
+    #: the OLDEST pending frames drop -- within the at-most-once
+    #: transport contract, like the dead-writer loss path above -- and
+    #: fpx_runtime_outbound_stalls_total counts the overflow. Large
+    #: enough that only a genuinely wedged/slow peer ever hits it.
+    outbound_buffer_cap = 16 * 1024 * 1024
 
     def __init__(self, listen_address: Optional[Address] = None,
                  logger: Optional[Logger] = None):
@@ -128,6 +144,12 @@ class TcpTransport(Transport):
         self._servers: dict[Address, asyncio.AbstractServer] = {}
         self._drain_scheduled: set = set()
         self._batch_depth: dict = {}  # messages in the current drain
+        # CLIENT-lane messages in the current drain batch -- the
+        # bounded-inbox measure (serve/lanes.py): only client frames
+        # may count against (or be shed by) admission_inbox_capacity;
+        # a Phase1b/watermark burst must never trip it.
+        self._client_batch_depth: dict = {}
+        self._batch_t0: dict = {}     # first delivery time (CoDel)
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
 
@@ -308,6 +330,10 @@ class TcpTransport(Transport):
 
     def _deliver(self, actor: Actor, src: Address, message,
                  ctx: "Optional[TraceContext]" = None) -> None:
+        admission = actor.admission
+        if admission is not None and self._shed_inbound(actor, admission,
+                                                        message):
+            return
         tracer = self.tracer
         if tracer is None:
             metrics = self.runtime_metrics
@@ -327,9 +353,18 @@ class TcpTransport(Transport):
             with span:
                 with tracer.stage("handler"):
                     actor.receive(src, message)
-        if self.runtime_metrics is not None:
+        if self.runtime_metrics is not None or admission is not None:
             self._batch_depth[actor] = \
                 self._batch_depth.get(actor, 0) + 1
+        if admission is not None and admission.options.inbox_capacity:
+            from frankenpaxos_tpu.serve.lanes import (
+                LANE_CLIENT,
+                message_lane,
+            )
+
+            if message_lane(message) == LANE_CLIENT:
+                self._client_batch_depth[actor] = \
+                    self._client_batch_depth.get(actor, 0) + 1
         # Defer on_drain to the end of this event-loop pass so every
         # frame already buffered (a burst of Phase2bs) lands in ONE
         # drain -- the batching the device kernels amortize over
@@ -337,19 +372,67 @@ class TcpTransport(Transport):
         # frames, then flush).
         if actor not in self._drain_scheduled:
             self._drain_scheduled.add(actor)
+            if admission is not None \
+                    and admission.options.codel_target_s:
+                # CoDel's sojourn clock starts at the batch's FIRST
+                # delivery; note_drain_delay closes it after on_drain.
+                self._batch_t0[actor] = time.perf_counter()
             self.loop.call_soon(self._drain_actor, actor)
+
+    def _shed_inbound(self, actor: Actor, admission, message) -> bool:
+        """Bounded inbox + CoDel shedding at delivery (client lane
+        only; serve/lanes.py). True = the frame was shed -- the client
+        got an explicit Rejected instead of a handler call. TCP
+        enforces reject-newest for both policies: already-delivered
+        frames cannot be un-delivered, so drop-oldest only differs on
+        SimTransport's buffered queue."""
+        from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, message_lane
+
+        if message_lane(message) != LANE_CLIENT:
+            return False
+        if admission.shed_active():
+            reason_queue = False
+        elif admission.inbox_full(self._client_batch_depth.get(actor, 0)):
+            reason_queue = True
+        else:
+            return False
+        from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+        from frankenpaxos_tpu.serve.admission import reject_replies_for
+        from frankenpaxos_tpu.serve.messages import (
+            REASON_CODEL,
+            REASON_QUEUE,
+        )
+
+        admission.note_shed("reject-newest")
+        for client, reply in reject_replies_for(
+                message, admission.retry_after_ms(),
+                REASON_QUEUE if reason_queue else REASON_CODEL):
+            self._write(actor.address, client,
+                        DEFAULT_SERIALIZER.to_bytes(reply), flush=True)
+        return True
 
     def _drain_actor(self, actor: Actor) -> None:
         self._drain_scheduled.discard(actor)
+        depth = self._batch_depth.pop(actor, 0)
+        client_depth = self._client_batch_depth.pop(actor, 0)
         if self.runtime_metrics is not None:
-            self.runtime_metrics.observe_batch(
-                self._batch_depth.pop(actor, 0))
+            self.runtime_metrics.observe_batch(depth)
         tracer = self.tracer
         if tracer is None:
             actor.on_drain()
-            return
-        with tracer.drain_span(str(actor.address)):
-            actor.on_drain()
+        else:
+            with tracer.drain_span(str(actor.address)):
+                actor.on_drain()
+        admission = actor.admission
+        if admission is not None:
+            t0 = self._batch_t0.pop(actor, None)
+            if t0 is not None:
+                admission.note_drain_delay(time.perf_counter() - t0)
+            # Client-lane depth only: the gauge is the BOUNDED-inbox
+            # depth (what inbox_full checks), not the all-lane drain
+            # batch -- a healthy Phase2b burst must not read as a
+            # client inbox spike (SimTransport reports the same).
+            admission.note_inbox_depth(client_depth)
 
     def listen_on(self, address: Address) -> None:
         """Bind a listener for ``address`` ahead of actor registration
@@ -410,7 +493,33 @@ class TcpTransport(Transport):
             # at-most-once transport contract; protocol resends cover
             # them.
             conn.writer = None
-        conn.pending.append(_encode_frame(src, data, ctx))
+        frame = _encode_frame(src, data, ctx)
+        conn.pending.append(frame)
+        conn.pending_bytes += len(frame)
+        if conn.pending_bytes > conn.hwm_reported:
+            conn.hwm_reported = conn.pending_bytes
+            metrics = self.runtime_metrics
+            if metrics is not None:
+                metrics.outbound_buffer_hwm(conn.pending_bytes)
+        if conn.pending_bytes > self.outbound_buffer_cap:
+            # Bounded outbound buffer (paxload): a slow or dead peer
+            # used to grow ``pending`` without limit (reachable under
+            # chaos since the PR 3 reconnect fix). Shed the OLDEST
+            # frames -- they have aged the most and their resend
+            # timers are the closest to firing -- and count the stall.
+            dropped = 0
+            while conn.pending_bytes > self.outbound_buffer_cap \
+                    and len(conn.pending) > 1:
+                conn.pending_bytes -= len(conn.pending[0])
+                del conn.pending[0]
+                dropped += 1
+            metrics = self.runtime_metrics
+            if metrics is not None:
+                metrics.outbound_stall(dropped)
+            self.logger.warn(
+                f"outbound buffer to {dst} over "
+                f"{self.outbound_buffer_cap} bytes; dropped {dropped} "
+                f"oldest frames (peer slow or gone; resends cover)")
         if conn.writer is not None:
             if flush:
                 self._flush_conn(conn)
@@ -426,6 +535,7 @@ class TcpTransport(Transport):
             self.logger.warn(f"connect to {dst} failed: {e}; "
                              f"dropping {len(conn.pending)} pending")
             conn.pending.clear()
+            conn.pending_bytes = 0
             conn.connecting = False
             return
         conn.writer = writer
@@ -444,6 +554,7 @@ class TcpTransport(Transport):
             self.logger.warn(f"write failed ({e}); dropping connection")
             conn.writer = None
         conn.pending.clear()
+        conn.pending_bytes = 0
 
     def _send_ctx(self) -> "Optional[TraceContext]":
         """The trace context to stamp on an outbound frame: captured at

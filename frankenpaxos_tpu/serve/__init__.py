@@ -1,0 +1,65 @@
+"""paxload: million-session overload robustness (docs/SERVING.md).
+
+The serving tier the ROADMAP "million-client serving tier" item asks
+for, in four pieces:
+
+  * ``messages``/``wire`` -- the ``Rejected`` wire reply (extended tag
+    page, tag 132): the explicit drop/reject signal that replaces
+    silent timeout storms when the edge sheds.
+  * ``lanes`` -- frame-layer priority lanes: client-request frames are
+    classified by their leading wire tag (one byte inspected, no
+    decode), so bounded inboxes and CoDel shedding only ever touch the
+    client lane -- Phase1/reconfig/heartbeat/vote traffic is NEVER
+    shed.
+  * ``admission`` -- the server-side robustness layer: token-bucket +
+    in-flight-slot admission (the slot budget is the run pipeline's
+    proposed-minus-chosen watermark span, so admission is
+    drain-granular), CoDel-style queue-delay shedding at the drain
+    boundary, and the bounded-inbox drop/reject policies both
+    transports enforce.
+  * ``backoff`` -- client-side jittered exponential backoff with retry
+    budgets that distinguish ``Rejected`` (back off, same leader) from
+    timeout (failover/resend); ``loadgen`` -- the vectorized load tier
+    that simulates 1M+ client sessions as SoA numpy arrays (open-loop
+    Poisson/heavy-tailed arrivals, Zipf key skew, diurnal ramps)
+    without a Python object per session.
+
+"The Performance of Paxos in the Cloud" (PAPERS.md) documents the
+overload pathologies this tier exists to fix: at offered loads past
+capacity the system must degrade by SHEDDING (bounded queues, explicit
+rejects, preserved goodput) -- never by OOM or timeout amplification.
+The SLO gate lives in ``bench/overload_lt.py`` ->
+``bench_results/overload_lt.json``.
+"""
+
+from frankenpaxos_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionOptions,
+    reject_replies_for,
+)
+from frankenpaxos_tpu.serve.backoff import (
+    RETRY_EXHAUSTED,
+    Backoff,
+)
+from frankenpaxos_tpu.serve.lanes import (
+    LANE_CLIENT,
+    LANE_CONTROL,
+    frame_lane,
+)
+from frankenpaxos_tpu.serve.messages import Rejected
+
+# Codec registration (tag 132 on the extended page) is an import side
+# effect, like every other wire module.
+from frankenpaxos_tpu.serve import wire  # noqa: F401
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionOptions",
+    "Backoff",
+    "LANE_CLIENT",
+    "LANE_CONTROL",
+    "RETRY_EXHAUSTED",
+    "Rejected",
+    "frame_lane",
+    "reject_replies_for",
+]

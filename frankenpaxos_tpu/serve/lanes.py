@@ -1,0 +1,79 @@
+"""Frame-layer priority lanes (paxload).
+
+Shedding decisions must be CHEAP (they run on every frame when a
+bounded inbox is attached) and must NEVER touch the control plane --
+Phase1/epoch/heartbeat/vote traffic starving behind client writes is
+how an overloaded cluster loses its leader and turns congestion into
+an outage. So lane classification reads exactly one or two bytes: the
+frame's leading wire tag (runtime/serializer.py -- primary page tags
+1..127 as the first byte, extended page 0x00 + tag byte, pickle
+streams lead with 0x80+).
+
+The CLIENT lane is the closed set of client-REQUEST message types
+below, resolved to tags through the codec registry at first use.
+Everything else -- votes, phase messages, epoch commits, heartbeats,
+replies, and every pickled long-tail message -- is CONTROL and is
+never shed (conservative by construction: an unclassifiable frame is
+control).
+"""
+
+from __future__ import annotations
+
+from frankenpaxos_tpu.runtime import serializer
+
+LANE_CONTROL = 0
+LANE_CLIENT = 1
+
+#: Client-request message TYPE names (the shedable lane). Names, not
+#: tags: the mapping survives tag reshuffles and covers every protocol
+#: that registers a codec for one of these shapes (multipaxos and
+#: mencius share ClientRequest/ClientRequestArray/ClientRequestBatch).
+CLIENT_LANE_TYPE_NAMES = frozenset({
+    "ClientRequest",
+    "ClientRequestArray",
+    "ClientRequestBatch",
+    "MaxSlotRequest",
+    "ReadRequest",
+    "ReadRequestBatch",
+    "SequentialReadRequest",
+    "SequentialReadRequestBatch",
+    "EventualReadRequest",
+    "EventualReadRequestBatch",
+})
+
+_cache: tuple[int, frozenset] | None = None
+
+
+def client_lane_tags() -> frozenset:
+    """Wire tags currently registered for client-lane types. Cached
+    against the registry size (codecs register at protocol import and
+    never unregister)."""
+    global _cache
+    registry = serializer._CODECS_BY_TAG
+    if _cache is None or _cache[0] != len(registry):
+        _cache = (len(registry), frozenset(
+            tag for tag, codec in registry.items()
+            if codec.message_type.__name__ in CLIENT_LANE_TYPE_NAMES))
+    return _cache[1]
+
+
+def frame_lane(data: bytes) -> int:
+    """The lane of an ENCODED frame payload, from its leading tag
+    byte(s). Pickle frames (0x80+) and unknown tags are CONTROL."""
+    if not data:
+        return LANE_CONTROL
+    tag = data[0]
+    if tag == 0:  # extended page escape
+        if len(data) < 2:
+            return LANE_CONTROL
+        tag = 128 + data[1]
+    elif tag >= 128:  # pickle stream
+        return LANE_CONTROL
+    return LANE_CLIENT if tag in client_lane_tags() else LANE_CONTROL
+
+
+def message_lane(message) -> int:
+    """The lane of a DECODED message (role-level admission sites)."""
+    return (LANE_CLIENT
+            if type(message).__name__ in CLIENT_LANE_TYPE_NAMES
+            else LANE_CONTROL)

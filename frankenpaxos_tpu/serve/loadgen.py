@@ -1,0 +1,315 @@
+"""The vectorized million-session load tier (paxload).
+
+Simulates 1M+ client SESSIONS as SoA numpy arrays -- per-session state
+is one byte + two floats in vectorized columns, never a Python object
+-- while the bounded set of IN-FLIGHT operations rides the real client
+actor (so the wire path, coalescing, Rejected handling, and backoff
+under test are the production code paths). Arrivals come from the
+shared :class:`~frankenpaxos_tpu.bench.workload.OpenLoopWorkload`
+(open-loop Poisson / Pareto-burst processes, Zipf key skew, diurnal
+ramps) -- the SAME generator the deployed driver uses
+(bench/client_main.py --open_loop), so sim and deployed arms mean the
+same thing by "10x offered load".
+
+:class:`SimOverloadDriver` adds the virtual-time service model that
+makes overload meaningful on SimTransport: the cluster gets a CPU
+budget of one virtual second per virtual second, each delivered
+message costs ``msg_cost_s`` and each completed command
+``1/capacity_cmds_per_s``; offered load beyond capacity therefore
+builds REAL queues (in the transport buffer) with REAL queueing delay
+(in virtual seconds), deterministically -- seeds fully reproduce every
+curve in bench_results/overload_lt.json. Timers fire on virtual
+deadlines (delay_s from each timer), so client resends and backoff
+behave as deployed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
+
+IDLE, PENDING = 0, 1
+
+
+class SessionArrays:
+    """SoA state for ``n`` sessions: one uint8 + two float64 columns
+    (25 MB at n=1M), vectorized arrival sampling against them."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.state = np.zeros(n, dtype=np.uint8)
+        self.issue_time = np.zeros(n, dtype=np.float64)
+        self.ops_issued = np.zeros(n, dtype=np.int32)
+        # Did the CURRENT op ever get a Rejected? Cleared at issue;
+        # separates admitted-on-arrival completions (the gate's
+        # "admitted-request p99") from backoff-retried ones whose
+        # latency is dominated by client-side backoff sleeps.
+        self.rejected_once = np.zeros(n, dtype=np.uint8)
+
+    @property
+    def pending(self) -> int:
+        return int(np.count_nonzero(self.state == PENDING))
+
+    def touched(self) -> int:
+        """Distinct sessions that ever issued (the active working set
+        a window this short actually exercises out of the n)."""
+        return int(np.count_nonzero(self.ops_issued))
+
+
+class SimOverloadDriver:
+    """Drive one open-loop arm against a SimTransport cluster under
+    the virtual-time service model. ``sim`` is a multipaxos harness
+    object (tests/protocols/multipaxos_harness.make_multipaxos) whose
+    clients[0] is the coalescing gateway client."""
+
+    def __init__(self, sim, workload, *, num_sessions: int = 1_000_000,
+                 capacity_cmds_per_s: float = 400.0,
+                 msg_cost_s: float = 0.0002, dt: float = 0.02,
+                 slo_deadline_s: float = 1.0, seed: int = 0,
+                 payload_bytes: int = 8):
+        self.sim = sim
+        self.workload = workload
+        self.sessions = SessionArrays(num_sessions)
+        self.capacity = capacity_cmds_per_s
+        self.cmd_cost = 1.0 / capacity_cmds_per_s
+        self.msg_cost = msg_cost_s
+        self.dt = dt
+        self.slo_deadline_s = slo_deadline_s
+        self.payload_bytes = payload_bytes
+        self.np_rng = np.random.default_rng(seed)
+        self.now = 0.0
+        self.budget = 0.0
+        # Outcome accounting. Completions are (issue_t, latency_s,
+        # admitted_first_try); giveups are explicit RETRY_EXHAUSTED
+        # conclusions.
+        self.completions: list[tuple[float, float, bool]] = []
+        self.giveups = 0
+        self.suppressed = 0
+        self.issued = 0
+        self.max_queue_depth = 0
+        #: timer id -> (virtual deadline, SimTimer.starts generation at
+        #: stamp time). The generation detects a stop+restart between
+        #: ticks (clients reuse one resend timer per pseudonym): a
+        #: restarted timer gets a FRESH deadline, not the old op's.
+        self._timer_deadlines: dict[int, tuple[float, int]] = {}
+        self._bind_virtual_clocks()
+        self._hook_rejections()
+
+    # --- virtual time plumbing ---------------------------------------------
+    def _bind_virtual_clocks(self) -> None:
+        """Point every admission controller's clock (token-bucket
+        refill, CoDel interval) at the driver's virtual clock so the
+        arm is deterministic and rate limits mean virtual rates."""
+        clock = lambda: self.now  # noqa: E731
+
+        for actor in self.sim.transport.actors.values():
+            admission = actor.admission
+            if admission is not None:
+                admission.clock = clock
+                if admission.bucket is not None:
+                    admission.bucket.clock = clock
+                    admission.bucket._last = 0.0
+
+    def _hook_rejections(self) -> None:
+        """Mark sessions whose current op got a ``Rejected`` (wrapping
+        the client's handler): their completion latency is dominated
+        by client-side backoff sleeps, so the SLO gate's
+        "admitted-request p99" excludes them (they still count for
+        goodput when they finish inside the deadline, and for the
+        giveup accounting when they exhaust the budget)."""
+        sessions = self.sessions
+        for client in self.sim.clients:
+            original = client._handle_rejected
+
+            def wrapped(*args, _original=original):
+                rejected = args[-1]
+                for pseudonym, _client_id in rejected.entries:
+                    if pseudonym < sessions.n:
+                        sessions.rejected_once[pseudonym] = 1
+                return _original(*args)
+
+            client._handle_rejected = wrapped
+
+    def _pump_timers(self) -> None:
+        """Fire running sim timers on virtual deadlines: a timer first
+        seen running at t fires once now >= t + delay_s (resend and
+        backoff discipline in virtual time)."""
+        transport = self.sim.transport
+        running = {t.id: t for t in transport.running_timers()}
+        for tid, timer in running.items():
+            rec = self._timer_deadlines.get(tid)
+            if rec is None or rec[1] != timer.starts:
+                self._timer_deadlines[tid] = (self.now + timer.delay_s,
+                                              timer.starts)
+        stale = [tid for tid in self._timer_deadlines
+                 if tid not in running]
+        for tid in stale:
+            del self._timer_deadlines[tid]
+        due = sorted((d, tid)
+                     for tid, (d, _) in self._timer_deadlines.items()
+                     if d <= self.now)
+        for _, tid in due:
+            del self._timer_deadlines[tid]
+            transport.trigger_timer(tid)
+
+    # --- the tick loop -----------------------------------------------------
+    def _issue_arrivals(self) -> None:
+        sessions = self.sessions
+        k = self.workload.arrival_count(self.np_rng, self.now, self.dt)
+        if k <= 0:
+            return
+        sids = self.np_rng.integers(0, sessions.n, k)
+        keys = self.workload.sample_keys(self.np_rng, k)
+        client = self.sim.clients[0]
+        for s, key in zip(sids.tolist(), keys.tolist()):
+            if sessions.state[s] != IDLE:
+                # Open-loop thinning: the session's previous op is
+                # still pending (rare at 1M sessions); counted, not
+                # queued client-side -- client-side queues are the
+                # unbounded-latency pathology this tier exists to
+                # remove.
+                self.suppressed += 1
+                continue
+            sessions.state[s] = PENDING
+            sessions.issue_time[s] = self.now
+            sessions.rejected_once[s] = 0
+            sessions.ops_issued[s] += 1
+            payload = b"k%d.s%d.%d" % (key, s, sessions.ops_issued[s])
+            client.write(s, payload, self._completion_callback(s))
+            self.issued += 1
+        client.flush_writes()
+
+    def _completion_callback(self, s: int):
+        sessions = self.sessions
+
+        def done(result) -> None:
+            sessions.state[s] = IDLE
+            if result is RETRY_EXHAUSTED:
+                self.giveups += 1
+            else:
+                issued_at = float(sessions.issue_time[s])
+                # Completion lands somewhere inside the current tick;
+                # crediting the tick's END makes latency >= dt (a
+                # same-tick completion is "one service quantum", not
+                # zero) and keeps percentiles honest at tick
+                # granularity.
+                self.completions.append(
+                    (issued_at, self.now + self.dt - issued_at,
+                     not sessions.rejected_once[s]))
+
+        return done
+
+    def _deliver_budgeted(self) -> None:
+        """Spend the tick's CPU budget delivering messages in
+        coalesced waves: ``msg_cost_s`` per delivery plus
+        ``1/capacity`` per command completion. Whatever the budget
+        cannot cover stays queued -- THE queue overload builds."""
+        transport = self.sim.transport
+        while self.budget > 0 and transport.messages:
+            wave = transport.messages[:4096]
+            touched: list = []
+            seen: set = set()
+            for message in wave:
+                if self.budget <= 0:
+                    break
+                # Only genuine completions cost server capacity; a
+                # giveup (RETRY_EXHAUSTED concluded inside a Rejected
+                # delivery) is client-local bookkeeping -- charging it
+                # cmd_cost would make SHEDDING as expensive as serving
+                # and spiral the budget into debt exactly when the
+                # edge is doing its job.
+                before = len(self.completions)
+                actor = transport._deliver(message)
+                after = len(self.completions)
+                self.budget -= self.msg_cost \
+                    + (after - before) * self.cmd_cost
+                if actor is not None and id(actor) not in seen:
+                    seen.add(id(actor))
+                    touched.append(actor)
+            for actor in touched:
+                transport._drain(actor)
+
+    def queue_depth(self) -> int:
+        staged = sum(len(getattr(c, "_staged_writes", ()))
+                     for c in self.sim.clients)
+        return len(self.sim.transport.messages) + staged
+
+    def tick(self, arrivals: bool = True) -> None:
+        if arrivals:
+            self._issue_arrivals()
+        self._pump_timers()
+        # Backoff expiries re-stage through the coalescing client;
+        # ship them even when arrivals are off (the settle phase).
+        for client in self.sim.clients:
+            client.flush_writes()
+        self.budget = min(self.budget + self.dt, 4 * self.dt) \
+            if self.budget > 0 else self.budget + self.dt
+        self._deliver_budgeted()
+        self.max_queue_depth = max(self.max_queue_depth,
+                                   self.queue_depth())
+        self.now += self.dt
+
+    def run(self, duration_s: float, warmup_s: float = 0.0,
+            settle_s: float = 5.0) -> dict:
+        """Run the arm: warmup + measured window + a no-arrivals
+        settle phase (pending operations conclude -- complete, get
+        rejected into give-up, or exhaust retries). Returns the stats
+        dict the overload bench records."""
+        t_measure = self.now + warmup_s
+        t_end = t_measure + duration_s
+        while self.now < t_end:
+            self.tick(arrivals=True)
+        settle_deadline = self.now + settle_s
+        while self.now < settle_deadline and (
+                self.sessions.pending or self.sim.transport.messages):
+            self.tick(arrivals=False)
+        measured = [(t0, lat, first) for t0, lat, first in self.completions
+                    if t_measure <= t0 < t_end]
+        latencies = np.array([lat for _, lat, _ in measured]) \
+            if measured else np.zeros(0)
+        admitted = np.array([lat for _, lat, first in measured if first]) \
+            if measured else np.zeros(0)
+        in_slo = int(np.count_nonzero(latencies <= self.slo_deadline_s))
+        stats = {
+            "offered_rate": self.workload.rate,
+            "num_sessions": self.sessions.n,
+            "sessions_touched": self.sessions.touched(),
+            "issued": self.issued,
+            "suppressed_arrivals": self.suppressed,
+            "completed": len(measured),
+            "completed_first_try": int(len(admitted)),
+            "completed_in_slo": in_slo,
+            "goodput_cmds_per_s": round(in_slo / duration_s, 2),
+            "giveups": self.giveups,
+            "pending_after_settle": self.sessions.pending,
+            "max_queue_depth": self.max_queue_depth,
+        }
+        for q in (50, 99, 99.9):
+            suffix = str(q).replace(".", "")
+            stats[f"p{suffix}_latency_s"] = (
+                round(float(np.percentile(latencies, q)), 4)
+                if len(latencies) else None)
+            # The ADMITTED-request percentile: ops served on first
+            # admission, no client backoff in the number -- the
+            # latency the server actually delivered to admitted work
+            # (the ISSUE gate's p99).
+            stats[f"p{suffix}_admitted_s"] = (
+                round(float(np.percentile(admitted, q)), 4)
+                if len(admitted) else None)
+        stats["admission"] = self.admission_stats()
+        return stats
+
+    def admission_stats(self) -> dict:
+        out: dict = {"admitted": 0, "rejected": {}, "shed": {}}
+        for actor in self.sim.transport.actors.values():
+            admission = actor.admission
+            if admission is None:
+                continue
+            out["admitted"] += admission.admitted
+            for reason, n in admission.rejected.items():
+                bucket = ("shed" if reason.startswith("shed_")
+                          else "rejected")
+                key = reason[len("shed_"):] if bucket == "shed" else reason
+                out[bucket][key] = out[bucket].get(key, 0) + n
+        return out

@@ -44,16 +44,67 @@ def _panel(panel_id: int, title: str, expr: str, legend: str, unit: str,
     }
 
 
-# The SHARED runtime row (paxtrace, obs/): the same three panels on
-# every protocol dashboard, over the uniform fpx_runtime_* metrics the
+# The SHARED runtime row (paxtrace, obs/): the same panels on every
+# protocol dashboard, over the uniform fpx_runtime_* metrics the
 # transports/WAL export for every role (see obs.RuntimeMetrics) --
-# drain-stage time share, inbound queue depth, and WAL group-commit
-# fsync latency. Panel ids 9000+ so they never collide with the
-# per-role panels (generated) or the hand-written multipaxos ones.
-RUNTIME_ROW_TITLE = "Runtime (drain stages / queue depth / WAL fsync)"
+# drain-stage time share, inbound queue depth, WAL group-commit fsync
+# latency, and (paxload, serve/) the admission/backpressure band:
+# admitted-vs-rejected rates, shed/reject reasons, bounded-queue depth
+# + in-flight span, client retry discipline. Panel ids 9000+ so they
+# never collide with the per-role panels (generated) or the
+# hand-written multipaxos ones.
+RUNTIME_ROW_TITLE = ("Runtime (drain stages / queue depth / WAL fsync / "
+                     "admission)")
+
+#: Total grid height of the runtime row: header (1) + the paxtrace
+#: band (8) + the paxload admission band (8). dashboard() and
+#: inject_runtime_row() both lay out protocol panels below this line.
+RUNTIME_ROW_H = 17
 
 
 def runtime_row_panels(y: int = 0) -> list:
+    fsync = _panel(
+        9003, "WAL fsync latency p99 / mean",
+        "histogram_quantile(0.99, sum by (le) "
+        "(rate(fpx_runtime_wal_fsync_seconds_bucket[5s])))",
+        "p99", "s", x=16, y=y + 1, w=8)
+    # The fsync panel charts the p99 AND the mean on one graph.
+    fsync["targets"].append({
+        "expr": ("sum(rate(fpx_runtime_wal_fsync_seconds_sum[5s])) / "
+                 "sum(rate(fpx_runtime_wal_fsync_seconds_count[5s]))"),
+        "legendFormat": "mean",
+        "refId": "B",
+    })
+    admitted = _panel(
+        9004, "Admission: admitted vs rejected",
+        "sum by (role) "
+        "(rate(fpx_runtime_admission_admitted_total[5s]))",
+        "admitted {{role}}", "ops", x=0, y=y + 9, w=6)
+    admitted["targets"].append({
+        "expr": ("sum(rate(fpx_runtime_admission_rejected_total[5s]))"),
+        "legendFormat": "rejected (all)",
+        "refId": "B",
+    })
+    reasons = _panel(
+        9005, "Rejections by reason / sheds by policy",
+        "sum by (reason) "
+        "(rate(fpx_runtime_admission_rejected_total[5s]))",
+        "{{reason}}", "ops", x=6, y=y + 9, w=6)
+    reasons["targets"].append({
+        "expr": ("sum by (policy) "
+                 "(rate(fpx_runtime_admission_shed_total[5s]))"),
+        "legendFormat": "shed {{policy}}",
+        "refId": "B",
+    })
+    depth = _panel(
+        9006, "Bounded-inbox depth / in-flight span",
+        "fpx_runtime_admission_queue_depth",
+        "inbox {{role}}", "short", x=12, y=y + 9, w=6)
+    depth["targets"].append({
+        "expr": "fpx_runtime_admission_inflight",
+        "legendFormat": "inflight {{role}}",
+        "refId": "B",
+    })
     return [
         {
             "id": 9000,
@@ -72,43 +123,37 @@ def runtime_row_panels(y: int = 0) -> list:
             9002, "Inbound queue depth (msgs/drain)",
             "fpx_runtime_inbound_queue_depth",
             "{{role}}", "short", x=8, y=y + 1, w=8),
+        fsync,
+        admitted,
+        reasons,
+        depth,
         _panel(
-            9003, "WAL fsync latency p99 / mean",
-            "histogram_quantile(0.99, sum by (le) "
-            "(rate(fpx_runtime_wal_fsync_seconds_bucket[5s])))",
-            "p99", "s", x=16, y=y + 1, w=8),
+            9007, "Client retries (backoff/failover/giveup)",
+            "sum by (kind) "
+            "(rate(fpx_runtime_client_retries_total[5s]))",
+            "{{kind}}", "ops", x=18, y=y + 9, w=6),
     ]
-
-
-# The fsync panel charts the p99 AND the mean on one graph.
-_FSYNC_MEAN_TARGET = {
-    "expr": ("sum(rate(fpx_runtime_wal_fsync_seconds_sum[5s])) / "
-             "sum(rate(fpx_runtime_wal_fsync_seconds_count[5s]))"),
-    "legendFormat": "mean",
-    "refId": "B",
-}
 
 
 def dashboard(protocol: str, roles: list) -> dict:
     panels = runtime_row_panels(y=0)
-    panels[-1]["targets"].append(dict(_FSYNC_MEAN_TARGET))
-    # Role panels start right under the runtime row (header h=1 +
-    # panels h=8 -> y=9); Grafana renders stored gridPos verbatim, so
-    # a gap here would show as a blank band on every dashboard.
+    # Role panels start right under the runtime row; Grafana renders
+    # stored gridPos verbatim, so a gap here would show as a blank
+    # band on every dashboard.
     for row, role in enumerate(roles):
         pretty = role.replace("_", " ").capitalize()
         metric = f"{protocol}_{role}"
         panels.append(_panel(
             2 * row, f"{pretty} request throughput",
             f"sum(rate({metric}_requests_total[1s])) by (type)",
-            "{{type}}", "ops", x=0, y=9 + 8 * row))
+            "{{type}}", "ops", x=0, y=RUNTIME_ROW_H + 8 * row))
         panels.append(_panel(
             2 * row + 1, f"{pretty} handler latency (mean)",
             f"sum(rate({metric}_requests_latency_seconds_sum[1s])) "
             f"by (type) / "
             f"sum(rate({metric}_requests_latency_seconds_count[1s])) "
             f"by (type)",
-            "{{type}}", "s", x=12, y=9 + 8 * row))
+            "{{type}}", "s", x=12, y=RUNTIME_ROW_H + 8 * row))
     return {
         "uid": f"fpx-{protocol}",
         "title": f"FrankenPaxos TPU / {protocol}",
@@ -131,19 +176,18 @@ def dashboard(protocol: str, roles: list) -> dict:
 def inject_runtime_row(path: str) -> None:
     """Prepend the shared runtime row to a HAND-WRITTEN dashboard
     (multipaxos, batching) without touching its own panels: existing
-    9000-series panels are replaced (re-running is idempotent), and
-    everything else shifts below the row."""
+    9000-series panels are replaced and the board's own panels are
+    re-based to start exactly at RUNTIME_ROW_H -- idempotent under
+    re-runs AND under runtime-row height changes (the paxload band
+    grew it from 9 to 17)."""
     with open(path) as f:
         board = json.load(f)
     own = [p for p in board["panels"] if p["id"] < 9000]
     row = runtime_row_panels(y=0)
-    row[-1]["targets"].append(dict(_FSYNC_MEAN_TARGET))
-    row_height = 1 + max(p["gridPos"]["h"] for p in row[1:])
-    shifted_ids = {p["id"] for p in board["panels"]} != {
-        p["id"] for p in own}
+    own_top = min((p["gridPos"]["y"] for p in own), default=0)
+    delta = RUNTIME_ROW_H - own_top
     for panel in own:
-        if not shifted_ids:  # first injection: move them down once
-            panel["gridPos"]["y"] += row_height
+        panel["gridPos"]["y"] += delta
     board["panels"] = row + own
     with open(path, "w") as f:
         json.dump(board, f, indent=2)

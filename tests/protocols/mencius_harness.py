@@ -78,7 +78,9 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
                  num_batchers=0, num_proxy_replicas=0, num_clients=1,
                  batch_size=1, lag_threshold=100, coalesced=False,
                  state_machine_factory=AppendLog, seed=0,
-                 wal=False) -> MenciusSim:
+                 wal=False, leader_admission: dict | None = None,
+                 client_retry_budget: int = 0,
+                 client_backoff=None) -> MenciusSim:
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     wal_storages: dict = {}
@@ -110,7 +112,8 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
     leaders = [MenciusLeader(a, transport, logger, config,
                              send_high_watermark_every_n=3,
                              send_noop_range_if_lagging_by=lag_threshold,
-                             seed=seed + 10 + g * 10 + i)
+                             seed=seed + 10 + g * 10 + i,
+                             **(leader_admission or {}))
                for g, group in enumerate(config.leader_addresses)
                for i, a in enumerate(group)]
     proxy_leaders = [MenciusProxyLeader(a, transport, logger, config,
@@ -132,11 +135,16 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
     # coalesce while odd ones send per-message ClientRequests, so
     # strided runs and per-slot proposals interleave in one cluster.
     assert coalesced in (False, True, "mixed"), coalesced
+    client_extra: dict = {}
+    if client_retry_budget:
+        client_extra["retry_budget"] = client_retry_budget
+    if client_backoff is not None:
+        client_extra["backoff"] = client_backoff
     clients = [MenciusClient(f"client-{i}", transport, logger, config,
                              coalesce_writes=(
                                  coalesced is True
                                  or (coalesced == "mixed" and i % 2 == 0)),
-                             seed=seed + 90 + i)
+                             seed=seed + 90 + i, **client_extra)
                for i in range(num_clients)]
     return MenciusSim(transport, config, batchers, leaders, proxy_leaders,
                       acceptors, replicas, proxy_replicas, clients,

@@ -142,6 +142,9 @@ def make_multipaxos(
     wal: "bool | str" = False,
     epoch_tag_runs: bool = False,
     epoch_quorums: bool = False,
+    leader_admission: dict | None = None,
+    client_retry_budget: int = 0,
+    client_backoff=None,
 ) -> MultiPaxosSim:
     """``wal``: False (reference in-memory behavior), True (MemStorage
     WALs, the crash-restart sims), or a directory path (FileStorage
@@ -195,7 +198,8 @@ def make_multipaxos(
         Leader(a, transport, logger, config,
                LeaderOptions(resend_phase1as_period_s=5.0,
                              phase1_backend=phase1_backend,
-                             epoch_tag_runs=epoch_tag_runs),
+                             epoch_tag_runs=epoch_tag_runs,
+                             **(leader_admission or {})),
                seed=seed + i)
         for i, a in enumerate(config.leader_addresses)]
     proxy_leaders = [
@@ -227,11 +231,17 @@ def make_multipaxos(
     # typo'd mode would silently run fully per-message and a config
     # labeled "coalesced" would cover nothing.
     assert coalesced in (False, True, "mixed"), coalesced
+    client_opt_extra: dict = {}
+    if client_retry_budget:
+        client_opt_extra["retry_budget"] = client_retry_budget
+    if client_backoff is not None:
+        client_opt_extra["backoff"] = client_backoff
     clients = [
         Client(f"client-{i}", transport, logger, config,
                ClientOptions(coalesce_writes=(
                    coalesced is True
-                   or (coalesced == "mixed" and i % 2 == 0))),
+                   or (coalesced == "mixed" and i % 2 == 0)),
+                   **client_opt_extra),
                seed=seed + 30 + i)
         for i in range(num_clients)]
 

@@ -295,6 +295,24 @@ CONFIGS.extend([
      lambda: MultiPaxosReconfigSimulated(f=2, coalesced="mixed")),
 ])
 
+# paxload overload chaos (serve/, docs/SERVING.md): burst load past
+# the armed in-flight budget + bounded inbox, interleaved with the
+# kill-restart and reconfiguration schedules above. Adds two oracles:
+# acked writes are never missing from executed state, and
+# control-plane frames are never refused by a bounded inbox.
+from tests.protocols.test_overload_chaos import (  # noqa: E402
+    MultiPaxosOverloadSimulated,
+)
+
+CONFIGS.extend([
+    ("overload-chaos/multipaxos-f1",
+     lambda: MultiPaxosOverloadSimulated(f=1)),
+    ("overload-chaos/multipaxos-f1-coalesced",
+     lambda: MultiPaxosOverloadSimulated(f=1, coalesced=True)),
+    ("overload-chaos/multipaxos-f2-mixed",
+     lambda: MultiPaxosOverloadSimulated(f=2, coalesced="mixed")),
+])
+
 
 def _expand(entry, num_runs: int):
     """(name, factory[, runs_scale]) -> (name, factory, scaled runs) --

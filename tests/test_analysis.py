@@ -249,6 +249,146 @@ def test_pax110_pragma_suppresses(tmp_path):
     assert "PAX110" not in rules_of(findings)
 
 
+# --- PAX111: unbounded inbound buffers / sleep-retry loops (paxload) -------
+
+
+def test_pax111_unbounded_list_inbox_in_handler(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def __init__(self):
+            self.inbox = []
+
+        def receive(self, src, message):
+            self.inbox.append(message)
+    """}))
+    assert any(f.rule == "PAX111" and f.detail == "self.inbox"
+               for f in findings)
+
+
+def test_pax111_unbounded_deque_via_closure(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    import collections
+
+    class Bad(Actor):
+        def __init__(self):
+            self.pending_frames = collections.deque()
+
+        def receive(self, src, message):
+            self._stash(message)
+
+        def _stash(self, message):
+            self.pending_frames.appendleft(message)
+    """}))
+    assert any(f.rule == "PAX111" and f.scope == "Bad._stash"
+               for f in findings)
+
+
+def test_pax111_maxlen_deque_and_len_guard_are_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    import collections
+
+    class Capped(Actor):
+        def __init__(self):
+            self.inbox = collections.deque(maxlen=64)
+
+        def receive(self, src, message):
+            self.inbox.append(message)
+
+    class Guarded(Actor):
+        def __init__(self):
+            self.queue = []
+
+        def receive(self, src, message):
+            if len(self.queue) < 64:
+                self.queue.append(message)
+    """}))
+    assert "PAX111" not in rules_of(findings)
+
+
+def test_pax111_inbox_full_admission_guard_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Admitted(Actor):
+        def __init__(self, admission):
+            self.admission = admission
+            self.inbound = []
+
+        def receive(self, src, message):
+            if not self.admission.inbox_full(len(self.inbound)):
+                self.inbound.append(message)
+    """}))
+    assert "PAX111" not in rules_of(findings)
+
+
+def test_pax111_sleep_retry_loop_in_transport_code(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "runtime/conn.py": """
+    import time
+
+    def connect_with_retry(dial):
+        while True:
+            try:
+                return dial()
+            except OSError:
+                time.sleep(0.5)
+    """,
+        # The same loop outside role/transport code is out of scope.
+        "bench/poll.py": """
+    import time
+
+    def poll(ready):
+        while not ready():
+            time.sleep(0.5)
+    """}))
+    hits = [f for f in findings if f.rule == "PAX111"]
+    assert [f.file for f in hits] == ["pkg/runtime/conn.py"]
+    assert hits[0].detail == "time.sleep"
+
+
+def test_pax111_nested_loops_report_one_finding_per_sleep(tmp_path):
+    findings = run_rules(project(tmp_path, {"runtime/conn.py": """
+    import time
+
+    def connect_with_retry(dial):
+        while True:
+            for attempt in range(3):
+                try:
+                    return dial()
+                except OSError:
+                    time.sleep(0.5)
+    """}))
+    hits = [f for f in findings if f.rule == "PAX111"]
+    assert len(hits) == 1
+
+
+def test_pax111_sleep_in_function_defined_inside_loop_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"runtime/conn.py": """
+    import time
+
+    def make_delayers(delays):
+        # The closures are DEFINED in a loop but run elsewhere (on a
+        # transport timer, say): not a sleeping retry loop.
+        out = []
+        for delay in delays:
+            def wait(delay=delay):
+                time.sleep(delay)
+            out.append(wait)
+        return out
+    """}))
+    assert "PAX111" not in rules_of(findings)
+
+
+def test_pax111_pragma_suppresses(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Pragmad(Actor):
+        def __init__(self):
+            self.inbox = []
+
+        def receive(self, src, message):
+            self.inbox.append(message)  # paxlint: disable=PAX111
+    """}))
+    assert "PAX111" not in rules_of(findings)
+
+
 def test_pax106_call_soon_threadsafe_is_fine(tmp_path):
     findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
     class Fine(Actor):

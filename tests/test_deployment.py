@@ -106,6 +106,40 @@ def test_multipaxos_supernode_benchmark():
     assert stats["num_requests"] > 0
 
 
+def test_multipaxos_open_loop_client_driver():
+    """paxload deployed arm: run_open_loop draws from the SAME
+    OpenLoopWorkload the sim tier uses and drives a real TCP cluster
+    whose leader has admission armed -- ops conclude (acks, and under
+    the token bucket possibly explicit Rejected-backoff retries /
+    giveups), never a wedge."""
+    import json
+
+    from frankenpaxos_tpu.bench.client_main import run_open_loop
+    from frankenpaxos_tpu.bench.multipaxos_suite import _launch_and_warm
+    from frankenpaxos_tpu.bench.workload import OpenLoopWorkload
+
+    suite = SuiteDirectory(tempfile.mkdtemp(prefix="fpx_test_"),
+                           "multipaxos_openloop")
+    bench = suite.benchmark_directory()
+    config_path, _config = _launch_and_warm(
+        bench, MultiPaxosInput(duration_s=2.0, coalesced=True))
+    try:
+        with open(config_path) as f:
+            config_raw = json.load(f)
+        rows = run_open_loop(
+            "multipaxos", config_raw,
+            OpenLoopWorkload(rate=300.0, zipf_s=1.1, num_keys=64),
+            num_sessions=128, duration_s=1.5, seed=3,
+            overrides={"coalesce_writes": "true",
+                       "retry_budget": "4"})
+    finally:
+        bench.cleanup()
+    completed = [r for r in rows if r[0] == "write"]
+    assert completed, rows[:5]
+    # Latencies are sane wall-clock numbers, not sentinels.
+    assert all(0 <= lat < 30 for _, _, lat in completed)
+
+
 def test_multipaxos_wal_survives_acceptor_sigkill(tmp_path):
     """Process-failure chaos on a REAL deployment: SIGKILL an acceptor
     mid-run, relaunch it with the same --wal_dir, then SIGKILL a

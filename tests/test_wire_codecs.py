@@ -807,6 +807,13 @@ def all_codec_samples() -> dict:
         rc.EpochPhase2aRun(epoch=1, start_slot=64, round=2,
                            values=(batch, mp.NOOP)),
     ]
+    # serve (paxload): the admission-control reject reply.
+    from frankenpaxos_tpu import serve
+
+    samples += [
+        serve.Rejected(entries=((2, 7), (3, 9)), retry_after_ms=250,
+                       reason=2),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
