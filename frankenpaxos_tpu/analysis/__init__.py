@@ -25,11 +25,6 @@ Run it with ``python -m frankenpaxos_tpu.analysis``; see
 (``# paxlint: disable=<rule>``), and baseline management.
 """
 
-from frankenpaxos_tpu.analysis.core import (
-    Finding,
-    Project,
-    RULES,
-    run_rules,
-)
+from frankenpaxos_tpu.analysis.core import Finding, Project, RULES, run_rules
 
 __all__ = ["Finding", "Project", "RULES", "run_rules"]

@@ -3,6 +3,11 @@ gated.
 
 Exit 0 when every finding is grandfathered in the baseline (or there
 are none); exit 1 on any new finding. See docs/ANALYSIS.md.
+
+``--write-flowgraphs`` regenerates the committed per-protocol
+role x message flow-graph artifacts under docs/flowgraphs/ (paxflow);
+``--check-flowgraphs`` exits 1 when the committed artifacts are stale
+against the source tree (the CI freshness gate).
 """
 
 from __future__ import annotations
@@ -11,7 +16,7 @@ import argparse
 import os
 import sys
 
-from frankenpaxos_tpu.analysis import baseline as baseline_mod
+from frankenpaxos_tpu.analysis import baseline as baseline_mod, flowgraph
 from frankenpaxos_tpu.analysis.core import (
     _ensure_loaded,
     Project,
@@ -41,6 +46,17 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print every rule ID with its description and exit")
+    parser.add_argument(
+        "--write-flowgraphs", action="store_true",
+        help="regenerate docs/flowgraphs/*.{json,dot} (paxflow "
+             "artifacts) and exit 0")
+    parser.add_argument(
+        "--check-flowgraphs", action="store_true",
+        help="exit 1 if the committed docs/flowgraphs artifacts are "
+             "stale against the source tree")
+    parser.add_argument(
+        "--flowgraph-dir", default=None,
+        help="artifact directory (default: <root>/docs/flowgraphs)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -53,6 +69,28 @@ def main(argv=None) -> int:
         os.path.abspath(__file__))))
     baseline_path = args.baseline or os.path.join(
         root, ".paxlint-baseline.json")
+    flowgraph_dir = args.flowgraph_dir or os.path.join(
+        root, "docs", "flowgraphs")
+
+    if args.write_flowgraphs:
+        written = flowgraph.write_artifacts(Project(root), flowgraph_dir)
+        print(f"paxflow: wrote {len(written)} artifact(s) to "
+              f"{flowgraph_dir}")
+        return 0
+
+    if args.check_flowgraphs:
+        stale = flowgraph.check_artifacts(Project(root), flowgraph_dir)
+        if stale:
+            print(f"paxflow: {len(stale)} stale flow-graph artifact(s) "
+                  f"in {flowgraph_dir}:")
+            for rel in stale:
+                print(f"  {rel}")
+            print("\npaxflow: regenerate with `python -m "
+                  "frankenpaxos_tpu.analysis --write-flowgraphs` and "
+                  "commit the result.")
+            return 1
+        print(f"paxflow: OK -- docs/flowgraphs artifacts are fresh")
+        return 0
 
     project = Project(root)
     findings = run_rules(project)

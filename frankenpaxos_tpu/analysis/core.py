@@ -132,9 +132,12 @@ def _ensure_loaded() -> None:
     from frankenpaxos_tpu.analysis import (  # noqa: F401
         actor_rules,
         codec_rules,
+        durability_rules,
         epoch_rules,
+        flow_rules,
         hotpath_rules,
         overload_rules,
+        shape_rules,
     )
 
 
@@ -225,9 +228,24 @@ def call_name(node: ast.Call) -> str:
     return dotted(node.func)
 
 
+#: Memo for :func:`import_aliases`, keyed by tree identity (trees are
+#: held alive by their Project for the process lifetime; the cache
+#: pins them, which is what makes id() a safe key). Rule families call
+#: this per (module, class, function) -- the walk must not repeat.
+_ALIAS_CACHE: dict = {}
+
+
 def import_aliases(tree: ast.Module, package: str) -> dict:
     """local alias -> fully qualified module or symbol name, for both
     ``import x.y as z`` and ``from x import y [as z]``."""
+    hit = _ALIAS_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    if len(_ALIAS_CACHE) > 4096:
+        # Bound the pinned-tree set: long test runs construct many
+        # throwaway Projects, and the id()-keyed entries would
+        # otherwise hold every one of their ASTs forever.
+        _ALIAS_CACHE.clear()
     out: dict = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -237,4 +255,5 @@ def import_aliases(tree: ast.Module, package: str) -> dict:
         elif isinstance(node, ast.ImportFrom) and node.module:
             for a in node.names:
                 out[a.asname or a.name] = f"{node.module}.{a.name}"
+    _ALIAS_CACHE[id(tree)] = (tree, out)
     return out

@@ -1,0 +1,166 @@
+"""DUR5xx: durability dataflow -- the group-commit contract as a rule.
+
+paxlog's safety argument (wal/role.py) is one ordering: records staged
+during a drain are fsynced ONCE, and only then do the acks that depend
+on them leave the actor. The WAL-wired roles uphold it by routing
+every state-acknowledging reply through ``_wal_send`` (held in
+``_wal_sends`` until ``_wal_drain``'s sync). These rules make the
+ordering machine-checked for EVERY WAL-wired role, present and future:
+
+  * DUR501 -- a handler (or drain) method that appends a WAL record
+    AND releases a non-Nack reply via direct ``send``/``broadcast``:
+    the ack can reach the wire before the fsync, so a crash loses
+    acked state. (Nacks are exempt: a rejection acknowledges nothing.)
+  * DUR502 -- a class that touches the WAL surface (``wal.append`` /
+    ``_wal_send`` / ``_wal_drain``) without mixing in DurableRole: the
+    group-commit machinery isn't wired, so deferred sends either crash
+    or silently bypass the fsync.
+  * DUR503 -- a DurableRole subclass whose ``on_drain`` never reaches
+    ``_wal_drain``: staged records are never synced and held acks
+    never released (the role deadlocks its own clients).
+
+The rules are name-based like the rest of paxlint: DurableRole
+membership walks the base-name chain project-wide, and the handler
+closure reuses the flow graph's receive-flow scan.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis import flowgraph
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    Project,
+    qualname_index,
+    register_rules,
+)
+
+RULES = {
+    "DUR501": "direct send of a reply in a WAL-appending handler "
+              "(ack may precede the group commit)",
+    "DUR502": "WAL surface used without the DurableRole mixin",
+    "DUR503": "DurableRole on_drain never reaches _wal_drain",
+}
+
+#: Direct-send entry points (NOT ``_wal_send`` -- that is the held,
+#: group-committed path the rule steers toward).
+_DIRECT_SENDS = frozenset({"send", "send_no_flush", "broadcast"})
+
+#: The WAL touchpoints whose presence marks a class as WAL-wired.
+_WAL_SURFACE = frozenset({"_wal_send", "_wal_drain", "_wal_init"})
+
+
+def _is_durable(name: str, classes: dict, seen: set | None = None) -> bool:
+    if name == "DurableRole":
+        return True
+    seen = seen or set()
+    if name in seen or name not in classes:
+        return False
+    seen.add(name)
+    for _, node in classes[name]:
+        for base in node.bases:
+            if _is_durable(dotted(base).split(".")[-1], classes, seen):
+                return True
+    return False
+
+
+def _wal_appends(fn) -> list:
+    """``self.wal.append(...)`` call nodes inside ``fn``."""
+    return [node for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+            and dotted(node.func).endswith("wal.append")]
+
+
+def check(project: Project):
+    findings: list = []
+    classes = flowgraph._class_index(project)
+
+    for mod in project:
+        quals = qualname_index(mod.tree)
+        ns = flowgraph._module_namespace(project, mod)
+        for cls in mod.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            durable = any(
+                _is_durable(dotted(b).split(".")[-1], classes)
+                for b in cls.bases)
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            uses_wal = any(
+                (isinstance(node, ast.Call)
+                 and (dotted(node.func).endswith("wal.append")
+                      or dotted(node.func).split(".")[-1]
+                      in _WAL_SURFACE))
+                for fn in methods.values() for node in ast.walk(fn))
+
+            if uses_wal and not durable and cls.name != "DurableRole":
+                findings.append(Finding(
+                    rule="DUR502", file=mod.path, line=cls.lineno,
+                    scope=cls.name, detail=cls.name,
+                    message=f"{cls.name} uses the WAL surface "
+                            f"(wal.append/_wal_send) but does not mix "
+                            f"in DurableRole: deferred sends bypass "
+                            f"the group commit"))
+
+            if not durable:
+                continue
+
+            # DUR503: an on_drain override must reach _wal_drain
+            # (directly or through its self-call closure).
+            if "on_drain" in methods:
+                scan = flowgraph._RoleScan(ns, mod, cls, quals)
+                closure = scan._closure(["on_drain"])
+                reaches = any(
+                    isinstance(node, ast.Call)
+                    and dotted(node.func).split(".")[-1] == "_wal_drain"
+                    for m in closure
+                    for node in ast.walk(methods[m]))
+                if not reaches:
+                    findings.append(Finding(
+                        rule="DUR503", file=mod.path,
+                        line=methods["on_drain"].lineno,
+                        scope=f"{cls.name}.on_drain",
+                        detail=f"{cls.name}.on_drain",
+                        message=f"{cls.name}.on_drain never calls "
+                                f"_wal_drain: staged WAL records are "
+                                f"never fsynced and held acks never "
+                                f"released"))
+
+            # DUR501: append + direct non-Nack send in one method.
+            for name, fn in methods.items():
+                appends = _wal_appends(fn)
+                if not appends:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    leaf = dotted(node.func).split(".")[-1]
+                    if leaf not in _DIRECT_SENDS:
+                        continue
+                    for arg in node.args:
+                        top = flowgraph._unwrap_replace(arg)
+                        if not isinstance(top, ast.Call):
+                            continue
+                        found = ns.resolve(mod, dotted(top.func))
+                        if found is None:
+                            continue
+                        msg = found[1].name
+                        if "Nack" in msg:
+                            continue
+                        findings.append(Finding(
+                            rule="DUR501", file=mod.path,
+                            line=node.lineno,
+                            scope=f"{cls.name}.{name}",
+                            detail=f"{leaf}:{msg}",
+                            message=f"{cls.name}.{name} appends a WAL "
+                                    f"record but releases {msg} via "
+                                    f"direct {leaf}(): the ack can "
+                                    f"precede the drain's fsync -- "
+                                    f"route it through _wal_send"))
+    return findings
+
+
+register_rules(RULES, check)

@@ -1,0 +1,227 @@
+"""FLOW4xx: message-topology contracts over the paxflow graph.
+
+The flow graph (flowgraph.py) recovers, per protocol unit, which role
+sends which message to whom. These rules turn that recovered topology
+into CI-gated contracts:
+
+  * FLOW401 -- a message some role SENDS that no role anywhere in the
+    project handles: the frame arrives and hits the ``unexpected
+    message`` fatal (or silently pickles into a dead inbox).
+  * FLOW402 -- a message some role HANDLES that nothing in the project
+    ever sends or wire-encodes: dead dispatch arms rot (the handler
+    executes only in a test's imagination).
+  * FLOW403 -- a registered wire-codec tag whose message has no send
+    or encode site anywhere: an orphan tag squats on the closed 1..255
+    tag space (the scarcest wire resource) for a message that never
+    crosses the wire.
+  * FLOW404 -- a ``*Request`` message with no reply path (no chain of
+    send edges from its handler roles back to a sender role) and no
+    timer-driven resend: if the request or its effect is dropped, the
+    sender hangs forever.
+  * FLOW405 -- serve/lanes.py lane classification disagreeing with the
+    graph: (a) a name in CLIENT_LANE_TYPE_NAMES that is sent but has
+    NO codec tag -- the frame-layer classifier is tag-based, so the
+    pickled frame silently rides the control lane and the bounded
+    inbox can never shed it; (b) a codec-tagged client-edge message
+    (sent only by Client*/Batcher roles, ``*Request*`` name) missing
+    from CLIENT_LANE_TYPE_NAMES -- unshedable client traffic that
+    bypasses overload admission at the frame layer.
+
+Messages that exist only as nested payload of another sent message
+(``Command`` inside ``ClientRequest``) are decoded by the outer codec,
+not dispatched, so payload-only senders never trip FLOW401.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis import flowgraph
+from frankenpaxos_tpu.analysis.core import Finding, Project, register_rules
+
+RULES = {
+    "FLOW401": "message is sent but handled by no role anywhere",
+    "FLOW402": "message is handled but never sent or encoded",
+    "FLOW403": "registered codec tag has no send or encode site",
+    "FLOW404": "request message with no reply path and no timer resend",
+    "FLOW405": "serve/lanes.py lane classification disagrees with the "
+               "flow graph",
+}
+
+#: WAL record codecs (wal/records.py) declare ``message_type``/``tag``
+#: like wire codecs but live in their OWN closed tag space appended to
+#: disk, never sent -- they are not FLOW403's surface.
+_WAL_PREFIX = "wal/"
+
+_REQUEST_SUFFIXES = ("Request", "RequestBatch")
+
+
+def _lane_type_names(project: Project) -> tuple:
+    """(lanes module path, line, frozenset of names) parsed from the
+    CLIENT_LANE_TYPE_NAMES literal in serve/lanes.py (pure AST -- the
+    analysis never imports runtime modules)."""
+    path = f"{project.package}/serve/lanes.py"
+    mod = project.modules.get(path)
+    if mod is None:
+        return path, 1, frozenset()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "CLIENT_LANE_TYPE_NAMES":
+            names = {c.value for c in ast.walk(node.value)
+                     if isinstance(c, ast.Constant)
+                     and isinstance(c.value, str)}
+            return path, node.lineno, frozenset(names)
+    return path, 1, frozenset()
+
+
+def _client_edge_roles(senders) -> bool:
+    """Every sending role is a client-side edge role (clients and the
+    batchers that front them)."""
+    return bool(senders) and all(
+        "Client" in r or "Batcher" in r for r in senders)
+
+
+def check(project: Project):
+    findings: list = []
+    graphs = flowgraph.build_all(project)
+    sent_any = set(flowgraph.global_sent_types(project))
+    handled_any = set(flowgraph.global_handled_types(project))
+    for g in graphs.values():
+        for mname, info in g.messages.items():
+            if info.senders:
+                sent_any.add((info.module, mname))
+            if info.handlers:
+                handled_any.add((info.module, mname))
+
+    lanes_path, lanes_line, lane_names = _lane_type_names(project)
+    flagged_403: set = set()
+    flagged_405: set = set()
+
+    for unit in sorted(graphs):
+        g = graphs[unit]
+        # Role-level send graph for FLOW404's reply reachability.
+        role_edges: dict = {}
+        for info in g.messages.values():
+            for s in info.senders:
+                for h in info.handlers:
+                    role_edges.setdefault(s, set()).add(h)
+        # Only units that register codecs at all participate in
+        # frame-lane shedding; an all-pickled protocol rides the
+        # control lane uniformly, which is no DISAGREEMENT (405a).
+        unit_tagged = any(m.codec_tag is not None
+                          for m in g.messages.values())
+
+        for mname in sorted(g.messages):
+            info = g.messages[mname]
+            key = (info.module, mname)
+            real_senders = {r for r, kinds in info.senders.items()
+                            if kinds - {"payload"}}
+
+            if real_senders and not info.handlers \
+                    and key not in handled_any:
+                findings.append(Finding(
+                    rule="FLOW401", file=info.module, line=info.line,
+                    scope=mname, detail=f"{unit}:{mname}",
+                    message=f"{mname} is sent by "
+                            f"{'/'.join(sorted(real_senders))} but no "
+                            f"role anywhere handles it: the receiver "
+                            f"hits its unexpected-message fatal"))
+
+            if info.handlers and not info.senders \
+                    and key not in sent_any:
+                findings.append(Finding(
+                    rule="FLOW402", file=info.module, line=info.line,
+                    scope=mname, detail=f"{unit}:{mname}",
+                    message=f"{mname} is handled by "
+                            f"{'/'.join(sorted(info.handlers))} but "
+                            f"nothing ever sends it: dead dispatch "
+                            f"arm"))
+
+            if mname.endswith(_REQUEST_SUFFIXES) and info.senders \
+                    and info.handlers \
+                    and "timer" not in info.send_origins:
+                seen: set = set()
+                stack = list(info.handlers)
+                while stack:
+                    r = stack.pop()
+                    if r in seen:
+                        continue
+                    seen.add(r)
+                    stack.extend(role_edges.get(r, ()))
+                if not (seen & set(info.senders)):
+                    findings.append(Finding(
+                        rule="FLOW404", file=info.module,
+                        line=info.line, scope=mname,
+                        detail=f"{unit}:{mname}",
+                        message=f"{mname} "
+                                f"({'/'.join(sorted(info.senders))} -> "
+                                f"{'/'.join(sorted(info.handlers))}) "
+                                f"has no reply path back to its "
+                                f"sender and no timer resend: a "
+                                f"dropped request hangs forever"))
+
+            # FLOW405a: named in the client lane, but unclassifiable
+            # at the frame layer (no codec tag -> pickled -> control).
+            if mname in lane_names and real_senders \
+                    and info.codec_tag is None and unit_tagged \
+                    and key not in flagged_405:
+                flagged_405.add(key)
+                findings.append(Finding(
+                    rule="FLOW405", file=info.module, line=info.line,
+                    scope=mname, detail=f"untagged-lane:{mname}",
+                    message=f"{mname} is in serve/lanes.py "
+                            f"CLIENT_LANE_TYPE_NAMES but has no "
+                            f"registered codec: its pickled frames "
+                            f"ride the CONTROL lane, so the bounded "
+                            f"inbox can never shed it (give it a "
+                            f"fixed-layout codec)"))
+
+            # FLOW405b: client-edge-shaped and tagged, but missing
+            # from the lane list -- unshedable client traffic.
+            if mname not in lane_names and info.codec_tag is not None \
+                    and "Request" in mname \
+                    and not mname.endswith("Reply") \
+                    and _client_edge_roles(real_senders) \
+                    and info.handlers and key not in flagged_405:
+                flagged_405.add(key)
+                findings.append(Finding(
+                    rule="FLOW405", file=lanes_path, line=lanes_line,
+                    scope="CLIENT_LANE_TYPE_NAMES",
+                    detail=f"unclassified:{mname}",
+                    message=f"{mname} (tag {info.codec_tag}, sent "
+                            f"only by "
+                            f"{'/'.join(sorted(real_senders))}) is "
+                            f"client-edge traffic missing from "
+                            f"CLIENT_LANE_TYPE_NAMES: it can never "
+                            f"be shed under overload"))
+
+    # FLOW403: orphan codec tags, project-wide.
+    for (mod_path, mname), tag in sorted(
+            flowgraph._codec_tags(project).items()):
+        if mod_path.startswith(f"{project.package}/{_WAL_PREFIX}"):
+            continue
+        if (mod_path, mname) in sent_any:
+            continue
+        if (mod_path, mname) in flagged_403:
+            continue
+        flagged_403.add((mod_path, mname))
+        mod = project.modules.get(mod_path)
+        line = 1
+        if mod is not None:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) \
+                        and node.name == mname:
+                    line = node.lineno
+                    break
+        findings.append(Finding(
+            rule="FLOW403", file=mod_path, line=line, scope=mname,
+            detail=f"tag:{tag}:{mname}",
+            message=f"codec tag {tag} is registered for {mname} but "
+                    f"nothing sends or encodes it: orphan tag in the "
+                    f"closed wire tag space"))
+
+    return findings
+
+
+register_rules(RULES, check)

@@ -1,0 +1,1138 @@
+"""paxflow: whole-program role x message flow graphs, one per protocol.
+
+Every protocol package under ``protocols/`` is a *unit*: either a
+directory package (``multipaxos/``) or a single module plus its
+optional ``<name>_wire.py`` sibling. For each unit this module
+recovers, by pure AST analysis (nothing imported or executed):
+
+  * **roles** -- every ``Actor`` subclass (name-based base-chain walk,
+    like the rest of paxlint);
+  * **messages** -- the unit's wire dataclasses, plus any shared
+    message (reconfig/, serve/) its roles send or handle;
+  * **send edges** -- ``send`` / ``send_no_flush`` / ``broadcast`` /
+    ``_wal_send`` call sites, resolved through direct construction,
+    function-local aliases, sender-helper parameter flow
+    (``self._send_to_owning_leaders(Recover(...), slot)``), factory
+    parameters (craq's ``self._start(pseudonym, lambda cid:
+    Write(...), ...)``), ``dataclasses.replace`` of a known message,
+    typed forwarding of handler parameters (annotations and
+    ``isinstance`` narrowing), and unbatch loops (``for reply in
+    batch.batch: self.send(...)`` typed through the container field's
+    element annotation); messages constructed *inside* another sent
+    message (``TailRead(ReadBatch(...))``) get a ``payload`` edge --
+    they cross the wire, but as nested payload;
+  * **receive edges** -- ``isinstance`` dispatch chains, dispatch
+    tables (dict or ``(Class, label, handler)`` lists), and parameter
+    annotations, tracked along the *message-parameter flow* from
+    ``receive`` so payload-struct ``isinstance`` tests (a replica
+    walking its log) don't read as wire handlers;
+  * **origins** -- whether a send fires from a ``receive`` handler, the
+    ``on_drain`` boundary, a transport timer callback (resends), or a
+    construction/API path;
+  * **codec tags** -- the wire-codec registry entries resolved to the
+    unit's messages (reusing codec_rules' import-accurate resolution).
+
+The graph is the machine-checked form of "which role sends which
+message to whom, and what replies": FLOW4xx (flow_rules.py) and DUR5xx
+(durability_rules.py) gate on it in CI, and the committed
+``docs/flowgraphs/*.json`` + ``.dot`` artifacts are the per-protocol
+porting checklist for the run-pipeline unification refactor
+(ROADMAP.md). JSON emission is deterministic (sorted keys, sorted
+edge lists) so the artifacts are diff-stable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+
+from frankenpaxos_tpu.analysis import codec_rules
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    import_aliases,
+    Module,
+    Project,
+    qualname_index,
+)
+
+#: Send entry points (the actor API plus the durable deferred-send
+#: alias). Values classify the edge kind in the emitted graph.
+SEND_KINDS = {
+    "send": "send",
+    "send_no_flush": "send",
+    "broadcast": "broadcast",
+    "_wal_send": "wal_send",
+}
+
+#: Protocol-tree modules that are not protocol units of their own.
+_NON_UNIT_STEMS = frozenset({"__init__", "driver_util", "baseline_wire"})
+
+#: Dataclass-name suffixes that are configuration, not wire messages.
+_NON_MESSAGE_SUFFIXES = ("Config", "Options")
+
+
+def _unwrap_replace(arg: ast.AST) -> ast.AST:
+    """See through ``dataclasses.replace(msg, ...)``: the sent value
+    has the first argument's message type."""
+    while isinstance(arg, ast.Call) \
+            and dotted(arg.func).split(".")[-1] == "replace" \
+            and arg.args:
+        arg = arg.args[0]
+    return arg
+
+
+@dataclasses.dataclass
+class MessageInfo:
+    name: str
+    module: str                # defining module path
+    line: int
+    external: bool             # defined outside the unit (reconfig/serve)
+    codec_tag: int | None = None
+    # role name -> set of edge kinds ("send"/"broadcast"/"wal_send")
+    senders: dict = dataclasses.field(default_factory=dict)
+    # role name -> set of handler function qualnames
+    handlers: dict = dataclasses.field(default_factory=dict)
+    # (module path, line) per send site, for findings
+    send_sites: list = dataclasses.field(default_factory=list)
+    # origins of send sites: subset of {handler, drain, timer, api}
+    send_origins: set = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class RoleInfo:
+    name: str
+    module: str
+    line: int
+    handles: set = dataclasses.field(default_factory=set)
+    sends: set = dataclasses.field(default_factory=set)
+    # handler function qualname -> set of message names it dispatches
+    handler_funcs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FlowGraph:
+    unit: str
+    modules: list
+    roles: dict                # name -> RoleInfo
+    messages: dict             # name -> MessageInfo
+
+    def edges(self) -> list:
+        """(sender role, message, handler role, kind) tuples, sorted.
+        Handler role "?" marks a message sent but handled by no role in
+        the unit (cross-unit or dead -- FLOW401's surface). Payload
+        kinds (nested constructions) with no handler are omitted:
+        every protocol nests Command/CommandId inside its requests,
+        and those structs are decoded by the outer codec, not
+        dispatched."""
+        out = []
+        for name in sorted(self.messages):
+            info = self.messages[name]
+            handlers = sorted(info.handlers) or ["?"]
+            for sender in sorted(info.senders):
+                for kind in sorted(info.senders[sender]):
+                    for h in handlers:
+                        if kind == "payload" and h == "?":
+                            continue
+                        out.append((sender, name, h, kind))
+        return out
+
+
+# --- unit discovery ---------------------------------------------------------
+
+
+def unit_modules(project: Project) -> dict:
+    """{unit name: [Module, ...]} for every protocol unit."""
+    units: dict = {}
+    base = f"{project.package}/protocols/"
+    for mod in project:
+        if not mod.path.startswith(base):
+            continue
+        rest = mod.path[len(base):]
+        if "/" in rest:
+            unit = rest.split("/", 1)[0]
+        else:
+            stem = rest[:-len(".py")]
+            if stem in _NON_UNIT_STEMS:
+                continue
+            unit = stem[:-len("_wire")] if stem.endswith("_wire") else stem
+        units.setdefault(unit, []).append(mod)
+    return {unit: sorted(mods, key=lambda m: m.path)
+            for unit, mods in sorted(units.items())}
+
+
+def _class_index(project: Project) -> dict:
+    """class name -> [(Module, ClassDef)] across the whole project.
+    Cached on the project (three rule families consult it)."""
+    cached = getattr(project, "_flow_class_index", None)
+    if cached is not None:
+        return cached
+    out: dict = {}
+    for mod in project:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                out.setdefault(node.name, []).append((mod, node))
+    project._flow_class_index = out
+    return out
+
+
+def _module_namespace(project: Project, mod: Module) -> "_Namespace":
+    """A single-module _Namespace, cached on the project -- the
+    project-wide scans (sends, handlers, durability) all need one per
+    module and must not rebuild the import resolution each time."""
+    cache = getattr(project, "_flow_mod_ns", None)
+    if cache is None:
+        cache = project._flow_mod_ns = {}
+    ns = cache.get(mod.path)
+    if ns is None:
+        ns = cache[mod.path] = _Namespace(project, [mod])
+    return ns
+
+
+def _is_actor(name: str, classes: dict, seen: set | None = None) -> bool:
+    """Does class ``name``'s base chain (name-keyed, project-wide)
+    reach ``Actor``?"""
+    if name == "Actor":
+        return True
+    seen = seen or set()
+    if name in seen or name not in classes:
+        return False
+    seen.add(name)
+    for _, node in classes[name]:
+        for base in node.bases:
+            if _is_actor(dotted(base).split(".")[-1], classes, seen):
+                return True
+    return False
+
+
+def _is_message_class(node: ast.ClassDef) -> bool:
+    if not codec_rules._is_dataclass(node):
+        return False
+    if node.name.startswith("_"):
+        return False
+    return not node.name.endswith(_NON_MESSAGE_SUFFIXES)
+
+
+# --- per-unit message namespace ---------------------------------------------
+
+
+class _Namespace:
+    """Message-class resolution for one unit: local definitions plus
+    imports of dataclasses from elsewhere in the project (reconfig/,
+    serve/, a sibling protocol)."""
+
+    def __init__(self, project: Project, mods: list):
+        self.project = project
+        self.unit_paths = {m.path for m in mods}
+        # name -> (Module, ClassDef) for unit-defined messages.
+        self.local: dict = {}
+        for mod in mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) \
+                        and _is_message_class(node):
+                    self.local.setdefault(node.name, (mod, node))
+        # per-module import resolution cache: path -> {name: (mod, cls)}
+        self._imported: dict = {}
+        for mod in mods:
+            table: dict = {}
+            for alias, target in import_aliases(
+                    mod.tree, mod.name).items():
+                if "." not in target:
+                    continue
+                found = self._resolve_imported(target)
+                if found is not None and _is_message_class(found[1]):
+                    table[alias] = found
+            self._imported[mod.path] = table
+
+    def _resolve_imported(self, qualified: str):
+        cache = getattr(self.project, "_flow_import_cache", None)
+        if cache is None:
+            cache = self.project._flow_import_cache = {}
+        if qualified in cache:
+            return cache[qualified]
+        result = None
+        parts = qualified.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            mod = self.project.by_name.get(".".join(parts[:split]))
+            if mod is not None and split == len(parts) - 1:
+                result = codec_rules._class_in_module(
+                    self.project, mod, parts[-1])
+                break
+        cache[qualified] = result
+        return result
+
+    def resolve(self, mod: Module, name: str):
+        """(Module, ClassDef) for a message-class reference ``name``
+        as written in ``mod``; None when it isn't a message class."""
+        leaf = name.split(".")[-1]
+        table = self._imported.get(mod.path, {})
+        if leaf in table:
+            return table[leaf]
+        if leaf in self.local:
+            return self.local[leaf]
+        return None
+
+    def field_elem(self, found, field: str):
+        """(Module, ClassDef) of the element type of a container
+        field (``batch: tuple[ClientReply, ...]``) on the resolved
+        message class ``found``; None when the annotation names no
+        message class. Drives the unbatch-loop idiom (``for reply in
+        message.batch: self.send(dst, reply)``)."""
+        def_mod, cls = found
+        for node in cls.body:
+            if not (isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and node.target.id == field):
+                continue
+            for sub in ast.walk(node.annotation):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    d = dotted(sub)
+                    if d.split(".")[-1] in ("tuple", "Tuple", "list",
+                                            "List", "frozenset", "set",
+                                            "Optional", "Sequence"):
+                        continue
+                    hit = self.resolve(def_mod, d) if d else None
+                    if hit is None and d:
+                        hit = self.local.get(d.split(".")[-1])
+                    if hit is not None:
+                        return hit
+        return None
+
+
+# --- per-role extraction ----------------------------------------------------
+
+
+class _RoleScan:
+    """One Actor subclass: methods, the self-call graph, the message-
+    parameter flow from receive, timer callbacks, and send sites."""
+
+    def __init__(self, ns: _Namespace, mod: Module, cls: ast.ClassDef,
+                 quals: dict):
+        self.ns = ns
+        self.mod = mod
+        self.cls = cls
+        self.quals = quals
+        self.methods: dict = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        # method name -> called self-method names
+        self.self_calls: dict = {
+            name: self._called_methods(fn)
+            for name, fn in self.methods.items()}
+        # method name -> set of its params that reach a send call's
+        # message position (sender helpers), computed to fixpoint.
+        self.sender_params: dict = self._sender_params()
+        # method name -> params CALLED with the result sent (factory
+        # parameters: craq's ``_start(pseudonym, make_request, ...)``).
+        self.factory_params: dict = self._factory_params()
+        # method name -> message-parameter name (param-flow closure
+        # from receive; the dispatch surface for handler extraction)
+        self.msg_params: dict = self._message_params()
+        # methods registered as transport timer callbacks
+        self.timer_callbacks: set = self._timer_callbacks()
+        # origin classification roots
+        self.origins: dict = self._origins()
+
+    # -- plumbing --
+    def _called_methods(self, fn) -> set:
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                parts = d.split(".")
+                if len(parts) == 2 and parts[0] in ("self", "cls") \
+                        and parts[1] in self.methods:
+                    out.add(parts[1])
+        return out
+
+    def _closure(self, roots) -> set:
+        seen: set = set()
+        stack = [r for r in roots if r in self.methods]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.self_calls.get(cur, ()))
+        return seen
+
+    @staticmethod
+    def _params(fn) -> list:
+        return [a.arg for a in fn.args.args if a.arg != "self"]
+
+    def _sender_params(self) -> dict:
+        """Fixpoint: params of each method that flow into the message
+        position of a send (directly, or via another sender helper)."""
+        flows: dict = {name: set() for name in self.methods}
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in self.methods.items():
+                params = set(self._params(fn))
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = dotted(node.func).split(".")
+                    leaf = d[-1]
+                    if leaf in SEND_KINDS:
+                        for arg in node.args:
+                            if isinstance(arg, ast.Name) \
+                                    and arg.id in params \
+                                    and arg.id not in flows[name]:
+                                flows[name].add(arg.id)
+                                changed = True
+                    elif len(d) == 2 and d[0] == "self" \
+                            and d[1] in self.methods:
+                        callee_params = self._params(self.methods[d[1]])
+                        for pos, arg in enumerate(node.args):
+                            if pos < len(callee_params) \
+                                    and callee_params[pos] \
+                                    in flows[d[1]] \
+                                    and isinstance(arg, ast.Name) \
+                                    and arg.id in params \
+                                    and arg.id not in flows[name]:
+                                flows[name].add(arg.id)
+                                changed = True
+        return flows
+
+    def _factory_params(self) -> dict:
+        """Params whose CALL RESULT reaches a send's message position:
+        directly (``send(dst, make(...))``) or via a local
+        (``request = make(cid); ... send(dst, request)``). Lambda
+        arguments bound to these params at call sites carry messages."""
+        out: dict = {name: set() for name in self.methods}
+        for name, fn in self.methods.items():
+            params = set(self._params(fn))
+            sent_locals: set = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func).split(".")[-1] not in SEND_KINDS:
+                    continue
+                for arg in node.args:
+                    arg = _unwrap_replace(arg)
+                    if isinstance(arg, ast.Name):
+                        sent_locals.add(arg.id)
+                    elif isinstance(arg, ast.Call) \
+                            and isinstance(arg.func, ast.Name) \
+                            and arg.func.id in params:
+                        out[name].add(arg.func.id)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and isinstance(node.value.func, ast.Name) \
+                        and node.value.func.id in params:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id in sent_locals:
+                            out[name].add(node.value.func.id)
+        return out
+
+    def _message_params(self) -> dict:
+        """{method name: message param name} along the receive flow.
+
+        ``receive(self, src, message)`` seeds the flow; a call that
+        passes the current message param positionally extends it to
+        the callee's matching parameter. Dispatch-table handler values
+        (``{Klass: self._f}`` / ``[(Klass, label, self._f)]``) get
+        their LAST parameter, matching the (src, message) convention.
+        """
+        out: dict = {}
+        recv = self.methods.get("receive")
+        if recv is None:
+            return out
+        params = self._params(recv)
+        if not params:
+            return out
+        out["receive"] = params[-1]
+        stack = ["receive"]
+        while stack:
+            cur = stack.pop()
+            fn = self.methods[cur]
+            msg = out[cur]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func).split(".")
+                if len(d) == 2 and d[0] == "self" \
+                        and d[1] in self.methods and d[1] not in out:
+                    callee_params = self._params(self.methods[d[1]])
+                    for pos, arg in enumerate(node.args):
+                        if isinstance(arg, ast.Name) and arg.id == msg \
+                                and pos < len(callee_params):
+                            out[d[1]] = callee_params[pos]
+                            stack.append(d[1])
+            for table_cls, handler in self._dispatch_entries(fn):
+                if handler in self.methods and handler not in out:
+                    callee_params = self._params(self.methods[handler])
+                    if callee_params:
+                        out[handler] = callee_params[-1]
+                        stack.append(handler)
+        return out
+
+    def _dispatch_entries(self, fn):
+        """(class dotted name, self-method name | None) pairs from
+        dispatch tables: dict literals ``{Klass: self._f}`` and
+        list/tuple literals ``(Klass, ..., self._f)``. A lambda value
+        (``Phase2aAnyAck: lambda s, m: None`` -- an explicit ack sink)
+        yields None: the message is handled, by the enclosing method."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    k = dotted(key) if key is not None else ""
+                    if not k:
+                        continue
+                    if isinstance(value, ast.Lambda):
+                        yield k, None
+                        continue
+                    v = dotted(value).split(".")
+                    if len(v) == 2 and v[0] == "self":
+                        yield k, v[1]
+            elif isinstance(node, (ast.Tuple, ast.List)) \
+                    and len(node.elts) >= 2:
+                k = dotted(node.elts[0])
+                if not k:
+                    continue
+                if isinstance(node.elts[-1], ast.Lambda):
+                    yield k, None
+                    continue
+                v = dotted(node.elts[-1]).split(".")
+                if len(v) == 2 and v[0] == "self":
+                    yield k, v[1]
+
+    def _timer_callbacks(self) -> set:
+        out: set = set()
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func).split(".")[-1] != "timer":
+                    continue
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    d = dotted(arg).split(".")
+                    if len(d) == 2 and d[0] == "self" \
+                            and d[1] in self.methods:
+                        out.add(d[1])
+                    elif isinstance(arg, ast.Lambda):
+                        for sub in ast.walk(arg.body):
+                            if isinstance(sub, ast.Call):
+                                sd = dotted(sub.func).split(".")
+                                if len(sd) == 2 and sd[0] == "self" \
+                                        and sd[1] in self.methods:
+                                    out.add(sd[1])
+        return out
+
+    def _origins(self) -> dict:
+        """{method name: set of origins} -- which execution context
+        reaches each method (handler / drain / timer / api)."""
+        out: dict = {name: set() for name in self.methods}
+        roots = [("handler", ["receive"]
+                  + [m for m in self.msg_params if m != "receive"]),
+                 ("drain", ["on_drain"]),
+                 ("timer", sorted(self.timer_callbacks))]
+        rooted: set = set()
+        for origin, seeds in roots:
+            closure = self._closure(seeds)
+            rooted |= closure
+            for name in closure:
+                out[name].add(origin)
+        for name in self.methods:
+            if name not in rooted:
+                out[name].add("api")
+        return out
+
+    # -- extraction --
+    def handled(self) -> dict:
+        """{message name: set of handler method qualnames}."""
+        out: dict = {}
+
+        def note(clsname: str, fn_name: str):
+            found = self.ns.resolve(self.mod, clsname)
+            if found is None:
+                return
+            qual = f"{self.cls.name}.{fn_name}"
+            out.setdefault(found[1].name, set()).add(qual)
+
+        for fn_name, msg_param in self.msg_params.items():
+            fn = self.methods[fn_name]
+            # Annotation of the message parameter itself.
+            for a in fn.args.args:
+                if a.arg == msg_param and a.annotation is not None:
+                    ann = dotted(a.annotation)
+                    if ann:
+                        note(ann, fn_name)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and dotted(node.func) == "isinstance" \
+                        and len(node.args) == 2 \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id == msg_param:
+                    target = node.args[1]
+                    elts = target.elts if isinstance(
+                        target, ast.Tuple) else [target]
+                    for e in elts:
+                        d = dotted(e)
+                        if d:
+                            note(d, fn_name)
+                elif isinstance(node, ast.Compare) \
+                        and isinstance(node.left, ast.Call) \
+                        and dotted(node.left.func) == "type" \
+                        and len(node.left.args) == 1 \
+                        and isinstance(node.left.args[0], ast.Name) \
+                        and node.left.args[0].id == msg_param:
+                    for comp in node.comparators:
+                        d = dotted(comp)
+                        if d:
+                            note(d, fn_name)
+            for table_cls, handler in self._dispatch_entries(fn):
+                target = handler if handler in self.methods else fn_name
+                note(table_cls, target)
+        return out
+
+    def sent(self) -> list:
+        """(message name, kind, origin set, module path, line) per
+        send site. Kind ``payload`` marks a message constructed inside
+        another sent message's expression (nested wire payload)."""
+        out: list = []
+        for fn_name, fn in self.methods.items():
+            origins = self.origins.get(fn_name, {"api"})
+            local_types = self._local_message_types(fn)
+            typed = self._typed_params(fn)
+            self._add_unbatch_types(fn, local_types, typed)
+            timer_spans = self._local_timer_spans(fn)
+
+            def site_origins(node):
+                # A send inside a nested def registered as a timer
+                # callback fires when the TIMER fires (resend loops).
+                for lo, hi in timer_spans:
+                    if lo <= node.lineno <= hi:
+                        return {"timer"}
+                return origins
+
+            def emit(arg, node, kind):
+                for name, nested in self._arg_message(
+                        arg, local_types, typed):
+                    out.append((name, "payload" if nested else kind,
+                                site_origins(node), self.mod.path,
+                                node.lineno))
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func).split(".")
+                leaf = d[-1]
+                if leaf in SEND_KINDS:
+                    for arg in node.args:
+                        emit(arg, node, SEND_KINDS[leaf])
+                elif len(d) == 2 and d[0] == "self" \
+                        and d[1] in self.methods:
+                    flows = self.sender_params.get(d[1], set())
+                    factories = self.factory_params.get(d[1], set())
+                    callee_params = self._params(self.methods[d[1]])
+                    for pos, arg in enumerate(node.args):
+                        if pos >= len(callee_params):
+                            break
+                        if callee_params[pos] in flows:
+                            emit(arg, node, "send")
+                        if callee_params[pos] in factories \
+                                and isinstance(arg, ast.Lambda):
+                            emit(arg.body, node, "send")
+        return out
+
+    def _local_timer_spans(self, fn) -> list:
+        """(lineno, end_lineno) spans of nested defs registered as
+        transport timer callbacks inside ``fn`` -- the ubiquitous
+        client idiom ``def resend(): self.send(...)`` +
+        ``self.timer(..., resend)``."""
+        nested = {n.name: n for n in ast.walk(fn)
+                  if isinstance(n, ast.FunctionDef) and n is not fn}
+        spans: list = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func).split(".")[-1] != "timer":
+                continue
+            for arg in list(node.args) + [
+                    kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in nested:
+                    d = nested[arg.id]
+                    spans.append((d.lineno,
+                                  getattr(d, "end_lineno", d.lineno)))
+        return spans
+
+    def _arg_message(self, arg, local_types: dict, typed: dict):
+        """(message name, nested) pairs an argument expression may
+        carry: the outer value itself, plus any message constructed
+        inside it (wire payload of the outer message)."""
+        outer: set = set()
+        top = _unwrap_replace(arg)
+        if isinstance(top, ast.Call):
+            found = self.ns.resolve(self.mod, dotted(top.func))
+            if found is not None:
+                outer.add(found[1].name)
+        elif isinstance(top, ast.Name):
+            if top.id in local_types:
+                outer.add(local_types[top.id])
+            outer |= typed.get(top.id, set())
+        for name in sorted(outer):
+            yield name, False
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call):
+                found = self.ns.resolve(self.mod, dotted(sub.func))
+                if found is not None and found[1].name not in outer:
+                    yield found[1].name, True
+
+    def _local_message_types(self, fn) -> dict:
+        """{local var: message name} for vars assigned a constructed
+        message in this function."""
+        out: dict = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                found = self.ns.resolve(self.mod,
+                                        dotted(node.value.func))
+                if found is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = found[1].name
+        return out
+
+    def _typed_params(self, fn) -> dict:
+        """{name: set of message names} from parameter annotations and
+        flow-insensitive ``isinstance`` narrowing (typed forwarding: a
+        handler re-sending or unbatching its own inbound message)."""
+        out: dict = {}
+        for a in fn.args.args:
+            if a.annotation is None or a.arg == "self":
+                continue
+            found = self.ns.resolve(self.mod, dotted(a.annotation))
+            if found is not None:
+                out.setdefault(a.arg, set()).add(found[1].name)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func) == "isinstance" \
+                    and len(node.args) == 2 \
+                    and isinstance(node.args[0], ast.Name):
+                target = node.args[1]
+                elts = target.elts if isinstance(
+                    target, ast.Tuple) else [target]
+                for e in elts:
+                    found = self.ns.resolve(self.mod, dotted(e))
+                    if found is not None:
+                        out.setdefault(node.args[0].id, set()).add(
+                            found[1].name)
+        return out
+
+    def _add_unbatch_types(self, fn, local_types: dict,
+                           typed: dict) -> None:
+        """Type for-loop targets iterating (a) a known message's
+        container field through the field's element annotation (the
+        proxy unbatch idiom: ``for reply in message.batch:
+        send(...)``) or (b) a local list typed by annotation
+        (``replies: list[ClientReply] = []``) or by what gets
+        ``.append``-ed to it."""
+        local_elems: dict = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                for sub in ast.walk(node.annotation):
+                    if isinstance(sub, (ast.Name, ast.Attribute)):
+                        found = self.ns.resolve(self.mod, dotted(sub))
+                        if found is not None:
+                            local_elems.setdefault(
+                                node.target.id, set()).add(
+                                found[1].name)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "append" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    found = self.ns.resolve(self.mod,
+                                            dotted(arg.func))
+                    if found is not None:
+                        local_elems.setdefault(
+                            node.func.value.id, set()).add(
+                            found[1].name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.For) \
+                    or not isinstance(node.target, ast.Name):
+                continue
+            if isinstance(node.iter, ast.Name):
+                elems = local_elems.get(node.iter.id, set())
+                if elems:
+                    typed.setdefault(node.target.id, set()).update(
+                        elems)
+                continue
+            if not (isinstance(node.iter, ast.Attribute)
+                    and isinstance(node.iter.value, ast.Name)):
+                continue
+            src = node.iter.value.id
+            cand: set = set(typed.get(src, ()))
+            if src in local_types:
+                cand.add(local_types[src])
+            for cname in cand:
+                found = self.ns.resolve(self.mod, cname) \
+                    or self.ns.local.get(cname)
+                if found is None:
+                    continue
+                elem = self.ns.field_elem(found, node.iter.attr)
+                if elem is not None:
+                    typed.setdefault(node.target.id, set()).add(
+                        elem[1].name)
+
+
+# --- graph construction -----------------------------------------------------
+
+
+def _codec_tags(project: Project) -> dict:
+    """{(defining module path, message name): tag} for every codec."""
+    out: dict = {}
+    for mod, cls, msg_dotted in codec_rules._codec_classes(project):
+        entry = codec_rules._resolve_message_class(project, mod,
+                                                   msg_dotted)
+        if entry is None:
+            continue
+        msg_mod, msg_cls = entry
+        tag = None
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "tag" \
+                    and isinstance(stmt.value, ast.Constant):
+                tag = stmt.value.value
+        out[(msg_mod.path, msg_cls.name)] = tag
+    return out
+
+
+def build(project: Project, unit: str, mods: list,
+          classes: dict, tags: dict) -> FlowGraph:
+    ns = _Namespace(project, mods)
+    roles: dict = {}
+    messages: dict = {}
+
+    def message_info(found) -> MessageInfo:
+        # Messages are keyed by bare name within a unit; when two
+        # same-named classes from different modules both appear, the
+        # FIRST wins -- and the unit-local seed below runs first, so a
+        # unit's own definition always shadows an imported name twin.
+        mod, cls = found
+        info = messages.get(cls.name)
+        if info is None:
+            info = messages[cls.name] = MessageInfo(
+                name=cls.name, module=mod.path, line=cls.lineno,
+                external=mod.path not in ns.unit_paths,
+                codec_tag=tags.get((mod.path, cls.name)))
+        return info
+
+    # Seed with unit-defined messages so dead classes still appear.
+    for name in sorted(ns.local):
+        message_info(ns.local[name])
+
+    for mod in mods:
+        quals = qualname_index(mod.tree)
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef) \
+                    or not _is_actor(node.name, classes):
+                continue
+            scan = _RoleScan(ns, mod, node, quals)
+            role = roles.setdefault(node.name, RoleInfo(
+                name=node.name, module=mod.path, line=node.lineno))
+            for msg_name, funcs in scan.handled().items():
+                found = ns.resolve(mod, msg_name) \
+                    or ns.local.get(msg_name)
+                if found is None:
+                    continue
+                info = message_info(found)
+                info.handlers.setdefault(node.name, set()).update(funcs)
+                role.handles.add(info.name)
+                for fn in funcs:
+                    role.handler_funcs.setdefault(fn, set()).add(
+                        info.name)
+            for msg_name, kind, origins, path, line in scan.sent():
+                found = ns.resolve(mod, msg_name) \
+                    or ns.local.get(msg_name)
+                if found is None:
+                    continue
+                info = message_info(found)
+                info.senders.setdefault(node.name, set()).add(kind)
+                info.send_sites.append((path, line))
+                info.send_origins |= origins
+                role.sends.add(info.name)
+    return FlowGraph(unit=unit, modules=[m.path for m in mods],
+                     roles=roles, messages=messages)
+
+
+def _inherit_roles(graphs: dict, classes: dict) -> None:
+    """Merge base-class behavior into subclass roles ACROSS units:
+    ``GcBPaxosLeader(BPaxosLeader)`` handles and sends everything its
+    simplebpaxos base does, but that behavior was scanned into the
+    simplebpaxos graph. Without the merge, derived protocols look
+    like dead shells (no reply paths -- FLOW404 false positives)."""
+    role_home: dict = {}
+    for unit, g in graphs.items():
+        for rname in g.roles:
+            role_home.setdefault(rname, (unit, g))
+
+    def base_chain(name: str, seen: set) -> list:
+        out = []
+        for _, node in classes.get(name, ()):
+            for b in node.bases:
+                bname = dotted(b).split(".")[-1]
+                if bname not in seen:
+                    seen.add(bname)
+                    out.append(bname)
+                    out.extend(base_chain(bname, seen))
+        return out
+
+    for unit, g in graphs.items():
+        for rname, role in list(g.roles.items()):
+            for bname in base_chain(rname, {rname}):
+                home = role_home.get(bname)
+                if home is None or home[1] is g:
+                    continue
+                src_g = home[1]
+                src_role = src_g.roles[bname]
+                for mname in src_role.handles | src_role.sends:
+                    src_info = src_g.messages[mname]
+                    info = g.messages.get(mname)
+                    if info is None:
+                        info = g.messages[mname] = MessageInfo(
+                            name=mname, module=src_info.module,
+                            line=src_info.line, external=True,
+                            codec_tag=src_info.codec_tag)
+                    if mname in src_role.handles:
+                        info.handlers.setdefault(rname, set()).update(
+                            src_info.handlers.get(bname, ()))
+                        role.handles.add(mname)
+                    if bname in src_info.senders \
+                            and mname in src_role.sends:
+                        info.senders.setdefault(rname, set()).update(
+                            src_info.senders[bname])
+                        info.send_origins |= src_info.send_origins
+                        role.sends.add(mname)
+
+
+def build_all(project: Project) -> dict:
+    """{unit name: FlowGraph} for every protocol unit. Cached on the
+    project instance -- three rule families and the artifact emitter
+    all consume the same graphs."""
+    cached = getattr(project, "_flowgraphs", None)
+    if cached is not None:
+        return cached
+    classes = _class_index(project)
+    tags = _codec_tags(project)
+    graphs = {unit: build(project, unit, mods, classes, tags)
+              for unit, mods in unit_modules(project).items()}
+    _inherit_roles(graphs, classes)
+    project._flowgraphs = graphs
+    return graphs
+
+
+# --- project-wide send scan (FLOW403's surface) ------------------------------
+
+
+def global_sent_types(project: Project) -> dict:
+    """{(defining module path, message name): [(module, line), ...]}
+    for every message-class send OR wire-encode site anywhere in the
+    project: serve/ and reconfig/ roles send protocol messages, and
+    admin edges (bench/chaos.py) put messages on the wire via
+    ``serializer.to_bytes(...)`` without a transport send. Nested
+    constructions count -- a message wrapped inside another sent
+    message still crosses the wire as payload."""
+    cached = getattr(project, "_flow_global_sent", None)
+    if cached is not None:
+        return cached
+    leaves = set(SEND_KINDS) | {"to_bytes"}
+    out: dict = {}
+    for mod in project:
+        ns = _module_namespace(project, mod)
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            local_types: dict = {}
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    found = ns.resolve(mod, dotted(node.value.func))
+                    if found is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_types[t.id] = found
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func).split(".")[-1] not in leaves:
+                    continue
+                for arg in node.args:
+                    hits = []
+                    top = _unwrap_replace(arg)
+                    if isinstance(top, ast.Name) \
+                            and top.id in local_types:
+                        hits.append(local_types[top.id])
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Call):
+                            found = ns.resolve(mod, dotted(sub.func))
+                            if found is not None:
+                                hits.append(found)
+                    for fmod, fcls in hits:
+                        out.setdefault((fmod.path, fcls.name),
+                                       []).append((mod.path,
+                                                   node.lineno))
+    project._flow_global_sent = out
+    return out
+
+
+def global_handled_types(project: Project) -> dict:
+    """{(defining module path, message name): set of handler quals}
+    for every Actor handler ANYWHERE in the project. Actors outside
+    the protocol tree (election/, reconfig/, serve/) handle messages
+    protocol roles send -- FLOW401 must see those handlers."""
+    cached = getattr(project, "_flow_global_handled", None)
+    if cached is not None:
+        return cached
+    classes = _class_index(project)
+    out: dict = {}
+    for mod in project:
+        ns = _module_namespace(project, mod)
+        quals = qualname_index(mod.tree)
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef) \
+                    or not _is_actor(node.name, classes):
+                continue
+            scan = _RoleScan(ns, mod, node, quals)
+            for msg_name, funcs in scan.handled().items():
+                found = ns.resolve(mod, msg_name) \
+                    or ns.local.get(msg_name)
+                if found is None:
+                    continue
+                out.setdefault((found[0].path, found[1].name),
+                               set()).update(funcs)
+    project._flow_global_handled = out
+    return out
+
+
+# --- artifact emission ------------------------------------------------------
+
+#: Bump when the JSON schema changes; the staleness gate compares
+#: regenerated bytes, so a version mismatch reads as stale.
+SCHEMA_VERSION = 1
+
+
+def to_json(graph: FlowGraph) -> dict:
+    roles = {}
+    for name in sorted(graph.roles):
+        r = graph.roles[name]
+        roles[name] = {
+            "module": r.module,
+            "handles": sorted(r.handles),
+            "sends": sorted(r.sends),
+        }
+    messages = {}
+    for name in sorted(graph.messages):
+        m = graph.messages[name]
+        messages[name] = {
+            "module": m.module,
+            "external": m.external,
+            "codec_tag": m.codec_tag,
+            "senders": {role: sorted(kinds) for role, kinds
+                        in sorted(m.senders.items())},
+            "handlers": {role: sorted(funcs) for role, funcs
+                         in sorted(m.handlers.items())},
+            "timer_resent": "timer" in m.send_origins,
+        }
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "protocol": graph.unit,
+        "modules": sorted(graph.modules),
+        "roles": roles,
+        "messages": messages,
+        "edges": [
+            {"from": s, "message": m, "to": h, "kind": k}
+            for s, m, h, k in graph.edges()],
+    }
+
+
+def to_dot(graph: FlowGraph) -> str:
+    """A role-level digraph; edges labeled with message names.
+    Parallel edges between one role pair collapse into one label."""
+    pairs: dict = {}
+    for sender, msg, handler, kind in graph.edges():
+        key = (sender, handler)
+        pairs.setdefault(key, set()).add(
+            msg + ("*" if kind == "wal_send" else ""))
+    lines = [f'digraph "{graph.unit}" {{',
+             "  rankdir=LR;",
+             '  node [shape=box, fontname="monospace"];']
+    for role in sorted(graph.roles):
+        lines.append(f'  "{role}";')
+    if any(h == "?" for _, h in pairs):
+        lines.append('  "?" [shape=ellipse, style=dashed, '
+                     'label="(no in-unit handler)"];')
+    for (sender, handler) in sorted(pairs):
+        label = "\\n".join(sorted(pairs[(sender, handler)]))
+        lines.append(f'  "{sender}" -> "{handler}" '
+                     f'[label="{label}", fontname="monospace", '
+                     f'fontsize=9];')
+    lines.append("}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render(project: Project) -> dict:
+    """{relative artifact path: content} for every protocol unit."""
+    out: dict = {}
+    for unit, graph in sorted(build_all(project).items()):
+        payload = json.dumps(to_json(graph), indent=1,
+                             sort_keys=True) + "\n"
+        out[f"{unit}.json"] = payload
+        out[f"{unit}.dot"] = to_dot(graph)
+    return out
+
+
+def write_artifacts(project: Project, out_dir: str) -> list:
+    os.makedirs(out_dir, exist_ok=True)
+    expected = render(project)
+    written = []
+    for rel, content in expected.items():
+        path = os.path.join(out_dir, rel)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(content)
+        written.append(path)
+    # A renamed/removed protocol must not leave an orphan artifact
+    # behind (check_artifacts flags them as stale).
+    for rel in sorted(os.listdir(out_dir)):
+        if rel.endswith((".json", ".dot")) and rel not in expected:
+            os.remove(os.path.join(out_dir, rel))
+    return written
+
+
+def check_artifacts(project: Project, out_dir: str) -> list:
+    """Stale/missing/orphan artifact relative paths (empty = fresh).
+    Orphans -- committed artifacts no registered protocol produces
+    anymore (a removed or renamed unit) -- count as stale too."""
+    expected = render(project)
+    stale = []
+    for rel, content in expected.items():
+        path = os.path.join(out_dir, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                on_disk = f.read()
+        except OSError:
+            stale.append(rel + " (missing)")
+            continue
+        if on_disk != content:
+            stale.append(rel)
+    try:
+        on_disk_files = sorted(os.listdir(out_dir))
+    except OSError:
+        on_disk_files = []
+    for rel in on_disk_files:
+        if rel.endswith((".json", ".dot")) and rel not in expected:
+            stale.append(rel + " (orphan)")
+    return stale

@@ -1,0 +1,323 @@
+"""The tooled import-sort pass (the PR 1 graduation plan).
+
+``ruff``'s ``I`` rules gate new packages in CI, but the container this
+repo grows in has no ruff binary -- so the mechanical pass that
+graduates the legacy tree lives here, as part of the analysis toolkit,
+with the SAME conventions pyproject.toml configures for ruff's isort:
+
+  * sections: ``__future__`` / stdlib / third-party / first-party
+    (``frankenpaxos_tpu``) / relative, one blank line between;
+  * statements sorted by module name, case-insensitive
+    (``case-sensitive = false``), ``import x`` before ``from x
+    import`` for the same module;
+  * member lists sorted case-insensitively regardless of symbol kind
+    (``order-by-type = false``); duplicate from-imports of one module
+    merged.
+
+Only TOP-LEVEL import blocks are rewritten (a block = consecutive
+top-level import statements; any other statement ends it), so
+function-local imports and ``try:``-gated fallbacks are untouched.
+Comment lines directly above a statement move with it; a statement's
+trailing comment stays on its first line; statements with interior
+standalone comments keep their text verbatim (only their position
+changes). After rewriting, the module is re-parsed and the imported
+(module, name, alias) multiset is asserted unchanged -- the pass can
+reorder, never alter, the import surface.
+
+CLI::
+
+    python -m frankenpaxos_tpu.analysis.import_sort [--check] [paths]
+
+``--check`` exits 1 listing files that would change (the CI gate);
+without it, files are rewritten in place. Default paths: the package,
+``tests/``, and top-level ``*.py``, minus the ``E402``-exempt entry
+points (``__graft_entry__.py``, ``bench.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+#: Must match pyproject.toml's [tool.ruff.lint.isort] known-first-party.
+FIRST_PARTY = ("frankenpaxos_tpu", "tests")
+
+#: E402-exempt entry points: they mutate sys.path before importing, so
+#: their import order is load-bearing and stays hand-written.
+EXCLUDED = ("__graft_entry__.py", "bench.py")
+
+_FUTURE, _STDLIB, _THIRD, _FIRST, _LOCAL = range(5)
+
+#: Single-line regeneration budget: the repo's prevailing style keeps
+#: imports comfortably inside ruff's 100-column limit.
+_WIDTH = 79
+
+
+def _section(node) -> int:
+    if isinstance(node, ast.ImportFrom):
+        if node.level > 0:
+            return _LOCAL
+        module = node.module or ""
+    else:
+        module = node.names[0].name
+    root = module.split(".")[0]
+    if root == "__future__":
+        return _FUTURE
+    if root in FIRST_PARTY:
+        return _FIRST
+    if root in sys.stdlib_module_names:
+        return _STDLIB
+    return _THIRD
+
+
+def _module_of(node) -> str:
+    if isinstance(node, ast.ImportFrom):
+        return "." * node.level + (node.module or "")
+    return node.names[0].name
+
+
+def _stmt_key(node) -> tuple:
+    module = _module_of(node)
+    kind = 1 if isinstance(node, ast.ImportFrom) else 0
+    return (module.lower(), module, kind)
+
+
+def _name_key(alias: ast.alias) -> tuple:
+    return (alias.name.lower(), alias.name)
+
+
+def _render_names(names) -> list:
+    out = []
+    for a in sorted(names, key=_name_key):
+        out.append(a.name + (f" as {a.asname}" if a.asname else ""))
+    return out
+
+
+def _render(node, trailing: str) -> str:
+    """Canonical statement text: single line when it fits, else a
+    parenthesized one-per-line list with trailing comma."""
+    if isinstance(node, ast.Import):
+        a = node.names[0]
+        line = "import " + a.name + (
+            f" as {a.asname}" if a.asname else "")
+        return line + trailing
+    head = f"from {_module_of(node)} import "
+    rendered = _render_names(node.names)
+    one = head + ", ".join(rendered) + trailing
+    if len(one) <= _WIDTH + (len(trailing) if trailing else 0) \
+            and len(one) - len(trailing) <= _WIDTH:
+        return one
+    lines = [head + "(" + trailing]
+    lines += [f"    {n}," for n in rendered]
+    lines.append(")")
+    return "\n".join(lines)
+
+
+class _Entry:
+    """One import statement with its attached comments and source."""
+
+    def __init__(self, node, comments, text, verbatim):
+        self.node = node
+        self.comments = comments      # standalone lines above it
+        self.text = text              # verbatim source (may be multiline)
+        self.verbatim = verbatim      # keep text as-is (interior comments)
+        first = text.split("\n")[0]
+        self.trailing = ""
+        if "#" in first:
+            # A trailing comment on the first physical line survives
+            # regeneration (``# noqa``, layout notes). Import
+            # statements contain no string literals, so the first
+            # ``#`` IS the comment.
+            head, _, tail = first.partition("#")
+            stripped = head.rstrip()
+            ok = stripped.endswith("(")
+            if not ok:
+                try:
+                    ast.parse(stripped or "pass")
+                    ok = True
+                except SyntaxError:
+                    pass
+            if ok:
+                self.trailing = "  #" + tail
+
+    def render(self) -> str:
+        body = self.text if self.verbatim else _render(
+            self.node, self.trailing)
+        if self.comments:
+            return "\n".join(self.comments + [body])
+        return body
+
+
+def _import_surface(tree) -> set:
+    """The set of (module, name, asname) for every top-level import --
+    the invariant the rewrite must preserve (merging may dedupe an
+    identical double-import, so a set, not a multiset)."""
+    out = set()
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.add(("", a.name, a.asname))
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                out.add(("." * node.level + (node.module or ""),
+                         a.name, a.asname))
+    return out
+
+
+def sort_source(src: str) -> str:
+    """The rewritten module source (identical when already sorted).
+    Iterates to a fixpoint: moving comment-attached statements can
+    reshape a block's regions, so one pass may not converge."""
+    for _ in range(5):
+        new = _sort_once(src)
+        if new == src:
+            return new
+        src = new
+    raise AssertionError("import-sort failed to converge")
+
+
+def _sort_once(src: str) -> str:
+    tree = ast.parse(src)
+    before = _import_surface(tree)
+    lines = src.split("\n")
+
+    # Top-level blocks: consecutive Import/ImportFrom in body order.
+    blocks: list = []
+    current: list = []
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            current.append(node)
+        elif current:
+            blocks.append(current)
+            current = []
+    if current:
+        blocks.append(current)
+
+    for block in reversed(blocks):
+        entries = []
+        region_start = None
+        for node in block:
+            start = node.lineno
+            comments = []
+            probe = start - 1
+            while probe >= 1:
+                text = lines[probe - 1].strip()
+                if text.startswith("#"):
+                    comments.insert(0, lines[probe - 1])
+                    probe -= 1
+                else:
+                    break
+            if region_start is None:
+                region_start = probe + 1
+            seg = lines[node.lineno - 1:node.end_lineno]
+            interior = any(
+                s.strip().startswith("#") for s in seg[1:])
+            if isinstance(node, ast.Import) and len(node.names) > 1 \
+                    and not interior:
+                # ``import os, sys`` splits into per-module entries.
+                for a in node.names:
+                    single = ast.Import(names=[a])
+                    entries.append(_Entry(single, comments,
+                                          f"import {a.name}"
+                                          + (f" as {a.asname}"
+                                             if a.asname else ""),
+                                          False))
+                    comments = []
+                continue
+            entries.append(_Entry(node, comments, "\n".join(seg),
+                                  interior))
+        region_end = block[-1].end_lineno
+
+        # Merge duplicate from-imports of one module (non-verbatim).
+        merged: dict = {}
+        out_entries = []
+        for e in entries:
+            if isinstance(e.node, ast.ImportFrom) and not e.verbatim:
+                key = (e.node.level, e.node.module)
+                prior = merged.get(key)
+                if prior is not None and not prior.trailing \
+                        and not e.trailing and not e.comments:
+                    seen = {(a.name, a.asname)
+                            for a in prior.node.names}
+                    prior.node.names.extend(
+                        a for a in e.node.names
+                        if (a.name, a.asname) not in seen)
+                    continue
+                merged.setdefault(key, e)
+            out_entries.append(e)
+
+        sections: dict = {}
+        for e in out_entries:
+            sections.setdefault(_section(e.node), []).append(e)
+        rendered_sections = []
+        for sec in sorted(sections):
+            stmts = sorted(sections[sec],
+                           key=lambda e: _stmt_key(e.node))
+            rendered_sections.append(
+                "\n".join(e.render() for e in stmts))
+        new_region = "\n\n".join(rendered_sections)
+        lines[region_start - 1:region_end] = new_region.split("\n")
+
+    new_src = "\n".join(lines)
+    new_tree = ast.parse(new_src)  # must still parse
+    assert _import_surface(new_tree) == before, \
+        "import-sort changed the import surface; refusing"
+    return new_src
+
+
+def _targets(root: str) -> list:
+    out = []
+    for base in ("frankenpaxos_tpu", "tests"):
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(root, base)):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py") and fn not in EXCLUDED:
+                    out.append(os.path.join(dirpath, fn))
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py") and fn not in EXCLUDED:
+            out.append(os.path.join(root, fn))
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m frankenpaxos_tpu.analysis.import_sort")
+    parser.add_argument("paths", nargs="*",
+                        help="files to sort (default: the repo)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 listing files that would change")
+    parser.add_argument("--root", default=None)
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = args.paths or _targets(root)
+    changed = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        new = sort_source(src)
+        if new != src:
+            changed.append(path)
+            if not args.check:
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(new)
+    if args.check and changed:
+        print(f"import-sort: {len(changed)} file(s) need sorting:")
+        for p in changed:
+            print(f"  {os.path.relpath(p, root)}")
+        print("\nimport-sort: run `python -m "
+              "frankenpaxos_tpu.analysis.import_sort` and commit.")
+        return 1
+    verb = "would sort" if args.check else "sorted"
+    print(f"import-sort: {verb} {len(changed)} of {len(paths)} "
+          f"file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,9 +34,9 @@ from frankenpaxos_tpu.analysis.actor_rules import (
     _handler_closure,
 )
 from frankenpaxos_tpu.analysis.core import (
+    dotted,
     Finding,
     Project,
-    dotted,
     register_rules,
 )
 

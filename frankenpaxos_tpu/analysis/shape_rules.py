@@ -1,0 +1,262 @@
+"""SHAPE6xx: abstract shape/dtype interpretation over kernel code.
+
+The ops/ kernels are jitted once and replayed per drain; XLA traces
+them against concrete shapes and dtypes. Three hazard classes survive
+unit tests on CPU (where retraces are cheap and x64 flags differ) and
+then bite on a real TPU as retrace storms or ConcretizationErrors.
+These rules catch them statically, inside every jitted function
+(decorated ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` or
+wrapped module-level ``f2 = jax.jit(f)``):
+
+  * SHAPE601 -- data-dependent output shapes: ``jnp.nonzero`` /
+    ``flatnonzero`` / ``argwhere`` / ``unique`` / ``compress`` /
+    ``extract`` / one-argument ``jnp.where`` without a static
+    ``size=``. Under jit the output shape depends on VALUES, which is
+    a trace-time error (or, via host fallback, a silent sync).
+  * SHAPE602 -- dtype-coercion retrace hazards: ``.astype(int/float/
+    bool)`` (the builtin resolves differently under the x64 flag, so
+    two hosts trace two dtypes for one kernel), and value-typed array
+    creation (``jnp.array`` / ``jnp.full`` / ``jnp.arange``) without
+    an explicit ``dtype=`` -- the weak dtype follows the argument's
+    Python type, so an int-vs-float caller flips the traced dtype and
+    retraces.
+  * SHAPE603 -- shard-axis mismatches: a string axis name used in a
+    collective (``lax.psum(x, axis_name="...")``) or a
+    ``PartitionSpec`` that no mesh declaration, ``*_axis`` parameter
+    binding, or partition constant in the project ever declares --
+    a typo'd axis name fails only when the sharded path finally runs
+    on a multi-chip mesh.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    import_aliases,
+    Project,
+    qualname_index,
+    register_rules,
+)
+from frankenpaxos_tpu.analysis.hotpath_rules import (
+    _is_jit_name,
+    _jit_info,
+    _own_nodes,
+)
+
+RULES = {
+    "SHAPE601": "data-dependent output shape in a jitted fn "
+                "(nonzero/unique/1-arg where without size=)",
+    "SHAPE602": "dtype-coercion retrace hazard in a jitted fn "
+                "(builtin astype / value-typed creation without "
+                "dtype=)",
+    "SHAPE603": "shard axis name used but declared by no mesh, "
+                "*_axis binding, or partition constant",
+}
+
+_DATA_DEP_LEAVES = frozenset({
+    "nonzero", "flatnonzero", "argwhere", "unique", "compress",
+    "extract",
+})
+
+_VALUE_TYPED_CREATORS = frozenset({"array", "full", "arange"})
+
+_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "axis_index", "psum_scatter", "all_to_all",
+})
+
+_PSPEC_NAMES = frozenset({"PartitionSpec", "P"})
+
+
+def _is_jnp(name: str, aliases: dict) -> bool:
+    root = name.split(".")[0]
+    target = aliases.get(root, root)
+    return target in ("jax.numpy", "jnp") or root == "jnp" \
+        or target.endswith(".numpy")
+
+
+def _kw(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)
+
+
+def _jitted_functions(mod, aliases: dict):
+    """(qualname, FunctionDef) for decorator-jitted functions plus
+    module-level ``wrapped = jax.jit(local_fn, ...)`` targets."""
+    quals = qualname_index(mod.tree)
+    by_name: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if _jit_info(node, aliases) is not None:
+                yield quals[id(node)], node
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and _is_jit_name(node.value.func, aliases) \
+                and node.value.args:
+            target = dotted(node.value.args[0])
+            fn = by_name.get(target.split(".")[-1])
+            if fn is not None and _jit_info(fn, aliases) is None:
+                yield quals[id(fn)], fn
+
+
+def _declared_axes(mod, aliases: dict) -> set:
+    """Axis names this module declares: Mesh constructions,
+    ``axis_names=`` keywords, ``mesh.shape["..."]`` subscripts,
+    ``*_axis`` parameter defaults and keyword bindings, and strings in
+    module-level ``*PARTITION*``/``*AXES*`` constants."""
+    out: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            leaf = dotted(node.func).split(".")[-1]
+            if leaf in ("Mesh", "make_mesh"):
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    out.update(c.value for c in ast.walk(arg)
+                               if isinstance(c, ast.Constant)
+                               and isinstance(c.value, str))
+            for kw in node.keywords:
+                if kw.arg and (kw.arg == "axis_names"
+                               or kw.arg.endswith("_axis")):
+                    out.update(c.value for c in ast.walk(kw.value)
+                               if isinstance(c, ast.Constant)
+                               and isinstance(c.value, str))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "shape" \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            out.add(node.slice.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = list(args.posonlyargs) + list(args.args)
+            for a, default in zip(pos[len(pos) - len(args.defaults):],
+                                  args.defaults):
+                if a.arg.endswith("_axis") \
+                        and isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str):
+                    out.add(default.value)
+            for a, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None and a.arg.endswith("_axis") \
+                        and isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str):
+                    out.add(default.value)
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and any(k in node.targets[0].id.upper()
+                        for k in ("PARTITION", "AXES", "AXIS")):
+            out.update(c.value for c in ast.walk(node.value)
+                       if isinstance(c, ast.Constant)
+                       and isinstance(c.value, str))
+    return out
+
+
+def _used_axes(mod) -> list:
+    """(axis name, lineno, context) literals this module consumes:
+    collectives' ``axis_name=`` and PartitionSpec positional args."""
+    out: list = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = dotted(node.func).split(".")[-1]
+        if leaf in _COLLECTIVES:
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) \
+                                and isinstance(c.value, str):
+                            out.append((c.value, node.lineno, leaf))
+        elif leaf in _PSPEC_NAMES:
+            for arg in node.args:
+                for c in ast.walk(arg):
+                    if isinstance(c, ast.Constant) \
+                            and isinstance(c.value, str):
+                        out.append((c.value, node.lineno, leaf))
+    return out
+
+
+def check(project: Project):
+    findings: list = []
+
+    # SHAPE601/602 inside every jitted function.
+    for mod in project:
+        aliases = import_aliases(mod.tree, mod.name)
+        for qual, fn in _jitted_functions(mod, aliases):
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                leaf = d.split(".")[-1]
+                if leaf in _DATA_DEP_LEAVES and _is_jnp(d, aliases) \
+                        and not _kw(node, "size"):
+                    findings.append(Finding(
+                        rule="SHAPE601", file=mod.path,
+                        line=node.lineno, scope=qual, detail=d,
+                        message=f"{d} without size= inside a jitted "
+                                f"function: the output shape depends "
+                                f"on runtime values, which cannot "
+                                f"trace (pass size=/fill_value=)"))
+                elif leaf == "where" and _is_jnp(d, aliases) \
+                        and len(node.args) == 1 \
+                        and not _kw(node, "size"):
+                    findings.append(Finding(
+                        rule="SHAPE601", file=mod.path,
+                        line=node.lineno, scope=qual, detail="where/1",
+                        message="one-argument jnp.where inside a "
+                                "jitted function has a data-dependent "
+                                "output shape; use the three-argument "
+                                "form or pass size="))
+                elif leaf == "astype" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in ("int", "float", "bool"):
+                        findings.append(Finding(
+                            rule="SHAPE602", file=mod.path,
+                            line=node.lineno, scope=qual,
+                            detail=f"astype:{arg.id}",
+                            message=f"astype({arg.id}) inside a "
+                                    f"jitted function resolves "
+                                    f"through the x64 flag: two "
+                                    f"hosts trace two dtypes for one "
+                                    f"kernel -- name the dtype "
+                                    f"explicitly (jnp.int32, ...)"))
+                elif leaf in _VALUE_TYPED_CREATORS \
+                        and _is_jnp(d, aliases) \
+                        and not _kw(node, "dtype"):
+                    findings.append(Finding(
+                        rule="SHAPE602", file=mod.path,
+                        line=node.lineno, scope=qual, detail=d,
+                        message=f"{d} without dtype= inside a jitted "
+                                f"function: the weak dtype follows "
+                                f"the argument's Python type, so an "
+                                f"int-vs-float caller retraces the "
+                                f"kernel -- pin dtype= explicitly"))
+
+    # SHAPE603 project-wide: axis-name vocabulary.
+    declared: set = set()
+    per_mod: dict = {}
+    for mod in project:
+        aliases = import_aliases(mod.tree, mod.name)
+        per_mod[mod.path] = _declared_axes(mod, aliases)
+        declared |= per_mod[mod.path]
+    if declared:
+        for mod in project:
+            for axis, lineno, ctx in _used_axes(mod):
+                if axis not in declared:
+                    findings.append(Finding(
+                        rule="SHAPE603", file=mod.path, line=lineno,
+                        scope="<module>", detail=f"{ctx}:{axis}",
+                        message=f"axis name {axis!r} used in {ctx} is "
+                                f"declared by no mesh, *_axis "
+                                f"binding, or partition constant "
+                                f"anywhere in the project: typo'd "
+                                f"shard axes fail only on a real "
+                                f"multi-chip mesh"))
+    return findings
+
+
+register_rules(RULES, check)

@@ -29,10 +29,10 @@ import time
 from frankenpaxos_tpu.bench.harness import free_port
 from frankenpaxos_tpu.bench.workload import (
     READ_METHODS,
-    WRITE,
-    WriteOnlyWorkload,
     StringWorkload,
     workload_from_dict,
+    WRITE,
+    WriteOnlyWorkload,
 )
 from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel

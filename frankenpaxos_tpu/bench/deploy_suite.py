@@ -17,8 +17,8 @@ import time
 
 from frankenpaxos_tpu.bench.harness import (
     BenchmarkDirectory,
-    LocalHost,
     free_port,
+    LocalHost,
 )
 from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel

@@ -19,8 +19,8 @@ from frankenpaxos_tpu.bench.harness import (
     latency_throughput_stats,
 )
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
-from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
 from frankenpaxos_tpu.runtime.serializer import PickleSerializer
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
 from frankenpaxos_tpu.statemachine import SetRequest
 
 

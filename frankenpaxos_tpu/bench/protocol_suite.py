@@ -22,18 +22,15 @@ import sys
 import tempfile
 import time
 
-from frankenpaxos_tpu.bench.deploy_suite import (
-    launch_roles,
-    role_process_env,
-)
+from frankenpaxos_tpu.bench.deploy_suite import launch_roles, role_process_env
 from frankenpaxos_tpu.bench.harness import (
     BenchmarkDirectory,
-    LocalHost,
-    SuiteDirectory,
     free_port,
     latency_throughput_stats,
+    LocalHost,
+    SuiteDirectory,
 )
-from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, get_protocol
+from frankenpaxos_tpu.deploy import get_protocol, PROTOCOL_NAMES
 
 
 # Single-decree protocols livelock under concurrent dueling proposers

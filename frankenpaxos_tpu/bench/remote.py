@@ -44,8 +44,8 @@ import dataclasses
 import json
 import shlex
 import subprocess
-import uuid
 from typing import Optional, Sequence
+import uuid
 
 from frankenpaxos_tpu.bench.harness import LocalHost
 

@@ -27,7 +27,7 @@ import json
 import sys
 import time
 
-from frankenpaxos_tpu.deploy import PROTOCOL_NAMES, DeployCtx, get_protocol
+from frankenpaxos_tpu.deploy import DeployCtx, get_protocol, PROTOCOL_NAMES
 from frankenpaxos_tpu.runtime import LogLevel, PrintLogger
 from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
 

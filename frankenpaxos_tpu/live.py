@@ -28,10 +28,10 @@ Usage::
 
 from __future__ import annotations
 
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import json
 import random
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.viz import snapshot_actor

@@ -35,8 +35,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import time
-import zlib
 from typing import Callable, Optional
+import zlib
 
 _MASK64 = (1 << 64) - 1
 

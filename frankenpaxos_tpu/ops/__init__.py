@@ -10,7 +10,10 @@ one fused XLA step per event-loop drain.
 """
 
 from frankenpaxos_tpu.ops.quorum import TpuQuorumChecker, VoteBoard
-from frankenpaxos_tpu.ops.watermark import quorum_watermark, quorum_watermark_vector
+from frankenpaxos_tpu.ops.watermark import (
+    quorum_watermark,
+    quorum_watermark_vector,
+)
 
 __all__ = [
     "TpuQuorumChecker",

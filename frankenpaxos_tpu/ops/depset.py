@@ -119,7 +119,7 @@ def equal(a: DepSetBatch, b: DepSetBatch) -> jax.Array:
 def contains(d: DepSetBatch, leader: jax.Array, vid: jax.Array) -> jax.Array:
     """[B] bool: does each row contain vertex (leader[b], vid[b])?"""
     b = d.watermarks.shape[0]
-    rows = jnp.arange(b)
+    rows = jnp.arange(b, dtype=jnp.int32)
     in_prefix = vid < d.watermarks[rows, leader]
     off = vid - d.tail_base
     off_c = jnp.clip(off, 0, d.tails.shape[-1] - 1)

@@ -18,23 +18,22 @@ from __future__ import annotations
 
 import struct
 
-from frankenpaxos_tpu.protocols import batchedunreplicated as bu
-from frankenpaxos_tpu.protocols import caspaxos as cp
-from frankenpaxos_tpu.protocols import echo as ec
-from frankenpaxos_tpu.protocols import fastpaxos as fp
-from frankenpaxos_tpu.protocols import matchmakerpaxos as mp
-from frankenpaxos_tpu.protocols import paxos as px
-from frankenpaxos_tpu.protocols import unreplicated as ur
+from frankenpaxos_tpu.protocols import (
+    batchedunreplicated as bu,
+    caspaxos as cp,
+    echo as ec,
+    fastpaxos as fp,
+    matchmakerpaxos as mp,
+    paxos as px,
+    unreplicated as ur,
+)
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
     _put_address,
     _put_bytes,
     _take_address,
     _take_bytes,
 )
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I32 = struct.Struct("<i")
 _I64 = struct.Struct("<q")

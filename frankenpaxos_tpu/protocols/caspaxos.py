@@ -19,7 +19,7 @@ import dataclasses
 import random
 from typing import Callable, Optional
 
-from frankenpaxos_tpu.roundsystem import RoundSystem, RotatedClassicRoundRobin
+from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin, RoundSystem
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 

@@ -12,14 +12,14 @@ import dataclasses
 import random
 from typing import Callable, Optional
 
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.epaxos.messages import (
     ClientReply,
     ClientRequest,
     Command,
 )
 from frankenpaxos_tpu.protocols.epaxos.replica import EPaxosConfig
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
 
 
 @dataclasses.dataclass

@@ -6,11 +6,11 @@ import dataclasses
 import enum
 from typing import Optional, Union
 
-from frankenpaxos_tpu.runtime.transport import Address
 from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
     Instance,
     InstancePrefixSet,
 )
+from frankenpaxos_tpu.runtime.transport import Address
 
 # Ballots order lexicographically by (ordering, replica_index)
 # (EPaxos.proto:46-52).

@@ -13,24 +13,18 @@ blocking dependencies.
 
 from __future__ import annotations
 
+from collections import Counter as _Counter
 import dataclasses
 import random
-from collections import Counter as _Counter
 from typing import Optional, Union
 
-from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable, Executed
+from frankenpaxos_tpu.clienttable import ClientTable, Executed, NOT_EXECUTED
 from frankenpaxos_tpu.depgraph import make_dependency_graph
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.utils.topk import TUPLE_VERTEX_LIKE
 from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
     Instance,
     InstancePrefixSet,
 )
 from frankenpaxos_tpu.protocols.epaxos.messages import (
-    NOOP,
-    NULL_BALLOT,
     Accept,
     AcceptOk,
     Ballot,
@@ -40,12 +34,18 @@ from frankenpaxos_tpu.protocols.epaxos.messages import (
     CommandStatus,
     Commit,
     Nack,
+    NOOP,
     Noop,
+    NULL_BALLOT,
     PreAccept,
     PreAcceptOk,
     Prepare,
     PrepareOk,
 )
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils.topk import TUPLE_VERTEX_LIKE
 
 @dataclasses.dataclass(frozen=True)
 class EPaxosConfig:

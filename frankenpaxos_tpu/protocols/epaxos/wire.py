@@ -35,10 +35,7 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
     _take_address,
     _take_bytes,
 )
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I32 = struct.Struct("<i")
 _I64 = struct.Struct("<q")

@@ -31,10 +31,10 @@ Liveness/performance knobs:
 
 from __future__ import annotations
 
+from collections import Counter
 import dataclasses
 import random
 import time
-from collections import Counter
 from typing import Callable, Optional, Union
 
 from frankenpaxos_tpu.election.raft import (

@@ -12,8 +12,8 @@ popular-items/majority-of-quorum rule, Leader.scala:150-190).
 
 from __future__ import annotations
 
-import dataclasses
 from collections import Counter
+import dataclasses
 from typing import Callable, Optional
 
 from frankenpaxos_tpu.runtime import Actor, Logger

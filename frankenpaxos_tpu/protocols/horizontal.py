@@ -18,12 +18,15 @@ import dataclasses
 import random
 from typing import Callable, Optional, Union
 
-from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
+from frankenpaxos_tpu.election.basic import (
+    ElectionOptions,
+    ElectionParticipant,
+)
 from frankenpaxos_tpu.quorums import (
-    QuorumSystem,
-    SimpleMajority,
     quorum_system_from_dict,
     quorum_system_to_dict,
+    QuorumSystem,
+    SimpleMajority,
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger

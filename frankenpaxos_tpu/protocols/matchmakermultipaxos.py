@@ -36,10 +36,10 @@ from typing import Callable, Optional, Union
 import numpy as np
 
 from frankenpaxos_tpu.quorums import (
-    QuorumSystem,
-    SimpleMajority,
     quorum_system_from_dict,
     quorum_system_to_dict,
+    QuorumSystem,
+    SimpleMajority,
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Logger

@@ -14,6 +14,10 @@ consecutive owned slots stay implicit and idle groups' slots keep
 coalescing into the noop-range skip machinery.
 """
 
+# Importing registers the Mencius-specific binary codecs with the
+# hybrid serializer (the inner MultiPaxos machinery's types are
+# registered by protocols.multipaxos).
+from frankenpaxos_tpu.protocols.mencius import wire  # noqa: F401
 from frankenpaxos_tpu.protocols.mencius.common import (
     DistributionScheme,
     MenciusConfig,
@@ -29,10 +33,6 @@ from frankenpaxos_tpu.protocols.mencius.roles import (
     MenciusLeader,
     MenciusProxyLeader,
 )
-# Importing registers the Mencius-specific binary codecs with the
-# hybrid serializer (the inner MultiPaxos machinery's types are
-# registered by protocols.multipaxos).
-from frankenpaxos_tpu.protocols.mencius import wire  # noqa: F401
 
 
 __all__ = [

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from frankenpaxos_tpu.runtime.transport import Address
 
 # Re-used value/message shapes identical to MultiPaxos. The
 # transport-level coalescing envelopes (ClientRequestArray /
@@ -19,7 +18,6 @@ from frankenpaxos_tpu.runtime.transport import Address
 # multipaxos/wire.py and carry no slot semantics, so the Mencius twist
 # (strided slot ownership) never reaches them.
 from frankenpaxos_tpu.protocols.multipaxos.messages import (  # noqa: F401
-    NOOP,
     ChosenWatermark,
     ClientReply,
     ClientReplyArray,
@@ -32,6 +30,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (  # noqa: F401
     CommandBatchOrNoop,
     CommandId,
     Nack,
+    NOOP,
     Noop,
     Phase1a,
     Phase1b,
@@ -40,6 +39,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (  # noqa: F401
     Phase2b,
     Recover,
 )
+from frankenpaxos_tpu.runtime.transport import Address
 
 
 class DistributionScheme(enum.Enum):

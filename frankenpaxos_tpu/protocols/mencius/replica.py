@@ -13,26 +13,6 @@ import random
 import struct
 from typing import Callable, Optional
 
-from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.serve.messages import Rejected
-from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.utils import BufferMap
-from frankenpaxos_tpu.wal import (
-    DurableRole,
-    WalChosenRun,
-    WalNoopRange,
-    WalSnapshot,
-)
-from frankenpaxos_tpu.protocols.multipaxos.wire import (
-    _put_address,
-    _put_bytes,
-    _take_address,
-    _take_bytes,
-    decode_value_array,
-    encode_value_array,
-)
 from frankenpaxos_tpu.protocols.mencius.common import (
     Chosen,
     ChosenNoopRange,
@@ -53,6 +33,26 @@ from frankenpaxos_tpu.protocols.mencius.common import (
     Noop,
     NotLeaderClient,
     Recover,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+    decode_value_array,
+    encode_value_array,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.serve.messages import Rejected
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+from frankenpaxos_tpu.wal import (
+    DurableRole,
+    WalChosenRun,
+    WalNoopRange,
+    WalSnapshot,
 )
 
 

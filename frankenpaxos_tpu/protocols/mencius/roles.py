@@ -14,36 +14,11 @@ try:
 except ImportError:  # stripped environments: pure-Python fallback
     from frankenpaxos_tpu.utils.sorted_compat import SortedDict
 
-from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
-from frankenpaxos_tpu.reconfig import (
-    EpochAck,
-    EpochCommit,
-    EpochConfig,
-    EpochStore,
-    Reconfigure,
-    decode_epoch_config,
-    encode_epoch_config,
-)
-from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.wal import (
-    DurableRole,
-    WalEpoch,
-    WalNoopRange,
-    WalPromise,
-    WalSnapshot,
-    WalVote,
-    WalVoteRun,
-)
-from frankenpaxos_tpu.protocols.multipaxos.wire import (
-    decode_value,
-    decode_value_array,
-    encode_value,
-    encode_value_array,
+from frankenpaxos_tpu.election.basic import (
+    ElectionOptions,
+    ElectionParticipant,
 )
 from frankenpaxos_tpu.protocols.mencius.common import (
-    NOOP,
     Chosen,
     ChosenNoopRange,
     ChosenRun,
@@ -60,6 +35,7 @@ from frankenpaxos_tpu.protocols.mencius.common import (
     LeaderInfoRequestClient,
     MenciusConfig,
     Nack,
+    NOOP,
     NotLeaderBatcher,
     NotLeaderClient,
     Phase1a,
@@ -72,6 +48,33 @@ from frankenpaxos_tpu.protocols.mencius.common import (
     Phase2bNoopRange,
     Phase2bRun,
     Recover,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    decode_value,
+    decode_value_array,
+    encode_value,
+    encode_value_array,
+)
+from frankenpaxos_tpu.reconfig import (
+    decode_epoch_config,
+    encode_epoch_config,
+    EpochAck,
+    EpochCommit,
+    EpochConfig,
+    EpochStore,
+    Reconfigure,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.wal import (
+    DurableRole,
+    WalEpoch,
+    WalNoopRange,
+    WalPromise,
+    WalSnapshot,
+    WalVote,
+    WalVoteRun,
 )
 
 

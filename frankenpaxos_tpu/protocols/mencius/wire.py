@@ -17,21 +17,26 @@ from frankenpaxos_tpu.protocols.mencius.common import (
     ChosenNoopRange,
     ChosenRun,
     HighWatermark,
+    LeaderInfoReplyBatcher,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestBatcher,
+    LeaderInfoRequestClient,
+    NotLeaderBatcher,
+    NotLeaderClient,
     Phase2aNoopRange,
     Phase2aRun,
     Phase2bNoopRange,
     Phase2bRun,
 )
+from frankenpaxos_tpu.protocols.multipaxos.messages import ClientRequestBatch
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _EmptyCodec,
     _put_value,
     _put_value_array,
     _take_value,
     _take_value_array,
 )
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I64 = struct.Struct("<q")
 _QQI = struct.Struct("<qqi")
@@ -176,8 +181,86 @@ class MenciusChosenRunCodec(MessageCodec):
                          values=values), at
 
 
+# Leader-change client redirects on the extended tag page. Mencius's
+# shapes carry the owning leader GROUP index on top of multipaxos's
+# (tags 138-143); hot exactly during failover storms, when every
+# queued client op resends at once (COD301 burn-down, paxflow PR).
+
+_IQ = struct.Struct("<iq")
+
+
+class MenciusNotLeaderClientCodec(MessageCodec):
+    message_type = NotLeaderClient
+    tag = 144
+
+    def encode(self, out, message):
+        out += _I64.pack(message.leader_group_index)
+
+    def decode(self, buf, at):
+        (group,) = _I64.unpack_from(buf, at)
+        return NotLeaderClient(leader_group_index=group), at + 8
+
+
+class MenciusLeaderInfoRequestClientCodec(_EmptyCodec):
+    message_type = LeaderInfoRequestClient
+    tag = 145
+
+
+class MenciusLeaderInfoReplyClientCodec(MessageCodec):
+    message_type = LeaderInfoReplyClient
+    tag = 146
+
+    def encode(self, out, message):
+        out += _IQ.pack(message.leader_group_index, message.round)
+
+    def decode(self, buf, at):
+        group, round = _IQ.unpack_from(buf, at)
+        return LeaderInfoReplyClient(leader_group_index=group,
+                                     round=round), at + _IQ.size
+
+
+class MenciusNotLeaderBatcherCodec(MessageCodec):
+    message_type = NotLeaderBatcher
+    tag = 147
+
+    def encode(self, out, message):
+        out += _I64.pack(message.leader_group_index)
+        _put_value(out, message.client_request_batch.batch)
+
+    def decode(self, buf, at):
+        (group,) = _I64.unpack_from(buf, at)
+        batch, at = _take_value(buf, at + 8)
+        return NotLeaderBatcher(
+            leader_group_index=group,
+            client_request_batch=ClientRequestBatch(batch)), at
+
+
+class MenciusLeaderInfoRequestBatcherCodec(_EmptyCodec):
+    message_type = LeaderInfoRequestBatcher
+    tag = 148
+
+
+class MenciusLeaderInfoReplyBatcherCodec(MessageCodec):
+    message_type = LeaderInfoReplyBatcher
+    tag = 149
+
+    def encode(self, out, message):
+        out += _IQ.pack(message.leader_group_index, message.round)
+
+    def decode(self, buf, at):
+        group, round = _IQ.unpack_from(buf, at)
+        return LeaderInfoReplyBatcher(leader_group_index=group,
+                                      round=round), at + _IQ.size
+
+
 for _codec in (MenciusChosenCodec(), HighWatermarkCodec(),
                Phase2aNoopRangeCodec(), Phase2bNoopRangeCodec(),
                ChosenNoopRangeCodec(), MenciusPhase2aRunCodec(),
-               MenciusPhase2bRunCodec(), MenciusChosenRunCodec()):
+               MenciusPhase2bRunCodec(), MenciusChosenRunCodec(),
+               MenciusNotLeaderClientCodec(),
+               MenciusLeaderInfoRequestClientCodec(),
+               MenciusLeaderInfoReplyClientCodec(),
+               MenciusNotLeaderBatcherCodec(),
+               MenciusLeaderInfoRequestBatcherCodec(),
+               MenciusLeaderInfoReplyBatcherCodec()):
     register_codec(_codec)

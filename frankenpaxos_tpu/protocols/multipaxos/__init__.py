@@ -10,8 +10,17 @@ a pluggable quorum tracker; the "tpu" backend batches votes onto the
 TpuQuorumChecker vote board (ops/quorum.py) once per event-loop drain.
 """
 
-from frankenpaxos_tpu.protocols.multipaxos.acceptor import Acceptor, AcceptorOptions
-from frankenpaxos_tpu.protocols.multipaxos.batcher import Batcher, BatcherOptions
+# Importing registers the hot-path binary codecs with the hybrid
+# serializer (its module docstring explains the wire schema).
+from frankenpaxos_tpu.protocols.multipaxos import wire  # noqa: F401
+from frankenpaxos_tpu.protocols.multipaxos.acceptor import (
+    Acceptor,
+    AcceptorOptions,
+)
+from frankenpaxos_tpu.protocols.multipaxos.batcher import (
+    Batcher,
+    BatcherOptions,
+)
 from frankenpaxos_tpu.protocols.multipaxos.client import Client, ClientOptions
 from frankenpaxos_tpu.protocols.multipaxos.config import (
     DistributionScheme,
@@ -30,10 +39,10 @@ from frankenpaxos_tpu.protocols.multipaxos.read_batcher import (
     ReadBatcher,
     ReadBatchingScheme,
 )
-from frankenpaxos_tpu.protocols.multipaxos.replica import Replica, ReplicaOptions
-# Importing registers the hot-path binary codecs with the hybrid
-# serializer (its module docstring explains the wire schema).
-from frankenpaxos_tpu.protocols.multipaxos import wire  # noqa: F401
+from frankenpaxos_tpu.protocols.multipaxos.replica import (
+    Replica,
+    ReplicaOptions,
+)
 
 __all__ = [
     "Acceptor",

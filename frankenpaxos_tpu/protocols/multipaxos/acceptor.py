@@ -14,30 +14,7 @@ try:
 except ImportError:  # stripped environments: pure-Python fallback
     from frankenpaxos_tpu.utils.sorted_compat import SortedDict
 
-from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.wal import (
-    DurableRole,
-    WalEpoch,
-    WalPromise,
-    WalSnapshot,
-    WalVote,
-    WalVoteRun,
-)
-from frankenpaxos_tpu.reconfig import (
-    EpochAck,
-    EpochCommit,
-    decode_epoch_config,
-    encode_epoch_config,
-)
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
-from frankenpaxos_tpu.protocols.multipaxos.wire import (
-    decode_value,
-    decode_value_array,
-    encode_value,
-    encode_value_array,
-)
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     BatchMaxSlotReply,
     BatchMaxSlotRequest,
@@ -53,6 +30,29 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Phase2b,
     Phase2bRange,
     Phase2bVotes,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    decode_value,
+    decode_value_array,
+    encode_value,
+    encode_value_array,
+)
+from frankenpaxos_tpu.reconfig import (
+    decode_epoch_config,
+    encode_epoch_config,
+    EpochAck,
+    EpochCommit,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.wal import (
+    DurableRole,
+    WalEpoch,
+    WalPromise,
+    WalSnapshot,
+    WalVote,
+    WalVoteRun,
 )
 
 

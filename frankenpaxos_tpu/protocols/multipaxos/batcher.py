@@ -12,9 +12,6 @@ from __future__ import annotations
 
 import dataclasses
 
-from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ClientRequest,
@@ -25,6 +22,9 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     LeaderInfoRequestBatcher,
     NotLeaderBatcher,
 )
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
 
 
 @dataclasses.dataclass(frozen=True)

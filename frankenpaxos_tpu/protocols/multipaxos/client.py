@@ -22,11 +22,6 @@ import dataclasses
 import random
 from typing import Callable, Optional
 
-from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED, Backoff
-from frankenpaxos_tpu.serve.messages import Rejected
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ClientReply,
@@ -45,6 +40,11 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ReadRequest,
     SequentialReadRequest,
 )
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.serve.backoff import Backoff, RETRY_EXHAUSTED
+from frankenpaxos_tpu.serve.messages import Rejected
 
 Callback = Callable[[bytes], None]
 

@@ -24,7 +24,34 @@ import dataclasses
 import random
 from typing import Optional
 
-from frankenpaxos_tpu.election.basic import ElectionOptions, ElectionParticipant
+from frankenpaxos_tpu.election.basic import (
+    ElectionOptions,
+    ElectionParticipant,
+)
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    DistributionScheme,
+    MultiPaxosConfig,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ChosenWatermark,
+    ClientRequest,
+    ClientRequestArray,
+    ClientRequestBatch,
+    CommandBatch,
+    LeaderInfoReplyBatcher,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestBatcher,
+    LeaderInfoRequestClient,
+    Nack,
+    NOOP,
+    NotLeaderBatcher,
+    NotLeaderClient,
+    Phase1a,
+    Phase1b,
+    Phase2a,
+    Phase2aRun,
+    Recover,
+)
 from frankenpaxos_tpu.reconfig import (
     EpochAck,
     EpochCommit,
@@ -36,30 +63,6 @@ from frankenpaxos_tpu.reconfig import (
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.protocols.multipaxos.config import (
-    DistributionScheme,
-    MultiPaxosConfig,
-)
-from frankenpaxos_tpu.protocols.multipaxos.messages import (
-    NOOP,
-    ChosenWatermark,
-    ClientRequest,
-    ClientRequestArray,
-    ClientRequestBatch,
-    CommandBatch,
-    LeaderInfoReplyBatcher,
-    LeaderInfoReplyClient,
-    LeaderInfoRequestBatcher,
-    LeaderInfoRequestClient,
-    Nack,
-    NotLeaderBatcher,
-    NotLeaderClient,
-    Phase1a,
-    Phase1b,
-    Phase2a,
-    Phase2aRun,
-    Recover,
-)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,16 +20,6 @@ import random
 
 import numpy as np
 
-from frankenpaxos_tpu.reconfig import (
-    EpochAck,
-    EpochCommit,
-    EpochConfig,
-    EpochPhase2aRun,
-    EpochQuorumTracker,
-    EpochStore,
-)
-from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Chosen,
@@ -45,6 +35,16 @@ from frankenpaxos_tpu.protocols.multipaxos.quorum_tracker import (
     QuorumTracker,
     TpuQuorumTracker,
 )
+from frankenpaxos_tpu.reconfig import (
+    EpochAck,
+    EpochCommit,
+    EpochConfig,
+    EpochPhase2aRun,
+    EpochQuorumTracker,
+    EpochStore,
+)
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
 
 
 @dataclasses.dataclass(frozen=True)

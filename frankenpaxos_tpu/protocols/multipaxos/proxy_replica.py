@@ -10,8 +10,6 @@ from __future__ import annotations
 
 import dataclasses
 
-from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ChosenWatermark,
@@ -19,6 +17,8 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ReadReplyBatch,
     Recover,
 )
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
 
 
 @dataclasses.dataclass(frozen=True)

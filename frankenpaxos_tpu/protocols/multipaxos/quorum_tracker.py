@@ -22,9 +22,9 @@ import abc
 
 import numpy as np
 
+from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.quorums import QuorumSpec
 from frankenpaxos_tpu.quorums.spec import ANY
-from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 
 
 class QuorumTracker(abc.ABC):

@@ -19,8 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
+
 from frankenpaxos_tpu.protocols.multipaxos.config import MultiPaxosConfig
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
     BatchMaxSlotReply,
@@ -33,6 +32,8 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     SequentialReadRequest,
     SequentialReadRequestBatch,
 )
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,19 +16,6 @@ import random
 import struct
 from typing import Optional
 
-from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.utils import BufferMap
-from frankenpaxos_tpu.wal import DurableRole, WalChosenRun, WalSnapshot
-from frankenpaxos_tpu.protocols.multipaxos.wire import (
-    _put_address,
-    _put_bytes,
-    _take_address,
-    _take_bytes,
-    decode_value_array,
-    encode_value_array,
-)
 from frankenpaxos_tpu.protocols.multipaxos.config import (
     DistributionScheme,
     MultiPaxosConfig,
@@ -53,6 +40,19 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     SequentialReadRequest,
     SequentialReadRequestBatch,
 )
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_address,
+    _put_bytes,
+    _take_address,
+    _take_bytes,
+    decode_value_array,
+    encode_value_array,
+)
+from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils import BufferMap
+from frankenpaxos_tpu.wal import DurableRole, WalChosenRun, WalSnapshot
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,11 +20,9 @@ from __future__ import annotations
 
 import struct
 
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    BatchMaxSlotReply,
+    BatchMaxSlotRequest,
     Chosen,
     ChosenRun,
     ChosenWatermark,
@@ -38,10 +36,17 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     CommandBatch,
     CommandId,
     EventualReadRequest,
+    EventualReadRequestBatch,
+    LeaderInfoReplyBatcher,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestBatcher,
+    LeaderInfoRequestClient,
     MaxSlotReply,
     MaxSlotRequest,
-    Noop,
     NOOP,
+    Noop,
+    NotLeaderBatcher,
+    NotLeaderClient,
     Phase2a,
     Phase2aRun,
     Phase2b,
@@ -50,8 +55,11 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ReadReply,
     ReadReplyBatch,
     ReadRequest,
+    ReadRequestBatch,
     SequentialReadRequest,
+    SequentialReadRequestBatch,
 )
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I64 = struct.Struct("<q")
 _I64I64 = struct.Struct("<qq")
@@ -669,6 +677,164 @@ class ClientReplyBatchCodec(_ReplyBatchCodec):
     tag = 125
 
 
+# The read-BATCHER path and the leader-change client redirects, on the
+# extended tag page (133+; primary 1..127 is fully allocated). paxflow
+# FLOW405 surfaced the batch shapes: they are named in serve/lanes.py's
+# client lane, but the frame-layer classifier is TAG-based, so without
+# codecs their pickled frames rode the control lane and could never be
+# shed. The redirect shapes (NotLeader*/LeaderInfo*) are hot exactly
+# during failover storms, when every queued client op resends at once.
+
+
+class _CommandsBatchCodec(MessageCodec):
+    """Shared layout for the (slot, commands) read request batches."""
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        out += _I32.pack(len(message.commands))
+        for command in message.commands:
+            _put_command(out, command)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        (n,) = _I32.unpack_from(buf, at + 8)
+        at += 12
+        commands = []
+        for _ in range(n):
+            command, at = _take_command(buf, at)
+            commands.append(command)
+        return self.message_type(slot=slot,
+                                 commands=tuple(commands)), at
+
+
+class ReadRequestBatchCodec(_CommandsBatchCodec):
+    message_type = ReadRequestBatch
+    tag = 133
+
+
+class SequentialReadRequestBatchCodec(_CommandsBatchCodec):
+    message_type = SequentialReadRequestBatch
+    tag = 134
+
+
+class EventualReadRequestBatchCodec(MessageCodec):
+    message_type = EventualReadRequestBatch
+    tag = 135
+
+    def encode(self, out, message):
+        out += _I32.pack(len(message.commands))
+        for command in message.commands:
+            _put_command(out, command)
+
+    def decode(self, buf, at):
+        (n,) = _I32.unpack_from(buf, at)
+        at += 4
+        commands = []
+        for _ in range(n):
+            command, at = _take_command(buf, at)
+            commands.append(command)
+        return EventualReadRequestBatch(commands=tuple(commands)), at
+
+
+class BatchMaxSlotRequestCodec(MessageCodec):
+    message_type = BatchMaxSlotRequest
+    tag = 136
+
+    def encode(self, out, message):
+        out += _QI.pack(message.read_batcher_id,
+                        message.read_batcher_index)
+
+    def decode(self, buf, at):
+        batcher_id, index = _QI.unpack_from(buf, at)
+        return BatchMaxSlotRequest(read_batcher_index=index,
+                                   read_batcher_id=batcher_id), at + 12
+
+
+_QIIIQ = struct.Struct("<qiiiq")
+
+
+class BatchMaxSlotReplyCodec(MessageCodec):
+    message_type = BatchMaxSlotReply
+    tag = 137
+
+    def encode(self, out, message):
+        out += _QIIIQ.pack(message.read_batcher_id,
+                            message.read_batcher_index,
+                            message.group_index,
+                            message.acceptor_index, message.slot)
+
+    def decode(self, buf, at):
+        batcher_id, index, group, acceptor, slot = \
+            _QIIIQ.unpack_from(buf, at)
+        return BatchMaxSlotReply(read_batcher_index=index,
+                                 read_batcher_id=batcher_id,
+                                 group_index=group,
+                                 acceptor_index=acceptor,
+                                 slot=slot), at + _QIIIQ.size
+
+
+class _EmptyCodec(MessageCodec):
+    """Zero-field redirect markers: the tag IS the message."""
+
+    def encode(self, out, message):
+        pass
+
+    def decode(self, buf, at):
+        return self.message_type(), at
+
+
+class NotLeaderClientCodec(_EmptyCodec):
+    message_type = NotLeaderClient
+    tag = 138
+
+
+class LeaderInfoRequestClientCodec(_EmptyCodec):
+    message_type = LeaderInfoRequestClient
+    tag = 139
+
+
+class LeaderInfoReplyClientCodec(MessageCodec):
+    message_type = LeaderInfoReplyClient
+    tag = 140
+
+    def encode(self, out, message):
+        out += _I64.pack(message.round)
+
+    def decode(self, buf, at):
+        (round,) = _I64.unpack_from(buf, at)
+        return LeaderInfoReplyClient(round=round), at + 8
+
+
+class NotLeaderBatcherCodec(MessageCodec):
+    message_type = NotLeaderBatcher
+    tag = 141
+
+    def encode(self, out, message):
+        _put_value(out, message.client_request_batch.batch)
+
+    def decode(self, buf, at):
+        batch, at = _take_value(buf, at)
+        return NotLeaderBatcher(
+            client_request_batch=ClientRequestBatch(batch)), at
+
+
+class LeaderInfoRequestBatcherCodec(_EmptyCodec):
+    message_type = LeaderInfoRequestBatcher
+    tag = 142
+
+
+class LeaderInfoReplyBatcherCodec(MessageCodec):
+    message_type = LeaderInfoReplyBatcher
+    tag = 143
+
+    def encode(self, out, message):
+        out += _I64.pack(message.round)
+
+    def decode(self, buf, at):
+        (round,) = _I64.unpack_from(buf, at)
+        return LeaderInfoReplyBatcher(round=round), at + 8
+
+
 for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                ClientRequestCodec(), ClientRequestBatchCodec(),
                ClientReplyCodec(), ChosenWatermarkCodec(),
@@ -678,5 +844,12 @@ for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                MaxSlotRequestCodec(), MaxSlotReplyCodec(),
                ReadRequestCodec(), SequentialReadRequestCodec(),
                EventualReadRequestCodec(), ReadReplyBatchCodec(),
-               ClientReplyBatchCodec()):
+               ClientReplyBatchCodec(), ReadRequestBatchCodec(),
+               SequentialReadRequestBatchCodec(),
+               EventualReadRequestBatchCodec(),
+               BatchMaxSlotRequestCodec(), BatchMaxSlotReplyCodec(),
+               NotLeaderClientCodec(), LeaderInfoRequestClientCodec(),
+               LeaderInfoReplyClientCodec(), NotLeaderBatcherCodec(),
+               LeaderInfoRequestBatcherCodec(),
+               LeaderInfoReplyBatcherCodec()):
     register_codec(_codec)

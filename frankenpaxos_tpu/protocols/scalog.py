@@ -158,7 +158,7 @@ class ClientReplyBatch:
     """A replica's replies from one Chosen batch, routed through a
     ProxyReplica (scalog/ProxyReplica.scala:130-147)."""
 
-    batch: tuple
+    batch: tuple[ClientReply, ...]
 
 
 class ScalogServer(Actor):

@@ -11,11 +11,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Union
 
-from frankenpaxos_tpu.runtime.transport import Address
 from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
     Instance as VertexId,
     InstancePrefixSet as VertexIdPrefixSet,
 )
+from frankenpaxos_tpu.runtime.transport import Address
 
 
 @dataclasses.dataclass(frozen=True)

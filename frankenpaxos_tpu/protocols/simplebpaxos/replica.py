@@ -12,11 +12,8 @@ import dataclasses
 import random
 from typing import Callable, Optional
 
-from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable
+from frankenpaxos_tpu.clienttable import ClientTable, NOT_EXECUTED
 from frankenpaxos_tpu.depgraph import make_dependency_graph
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.statemachine import StateMachine
 from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     ClientReply,
     ClientRequest,
@@ -27,6 +24,9 @@ from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     SimpleBPaxosConfig,
     VertexId,
 )
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
 
 
 @dataclasses.dataclass

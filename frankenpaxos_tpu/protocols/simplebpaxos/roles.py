@@ -14,18 +14,13 @@ import dataclasses
 import random
 from typing import Optional
 
-from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.utils.topk import TUPLE_VERTEX_LIKE
 from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
-    NOOP,
     ClientRequest,
     Commit,
     DependencyReply,
     DependencyRequest,
     Nack,
+    NOOP,
     Noop,
     Phase1a,
     Phase1b,
@@ -38,6 +33,11 @@ from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     VertexIdPrefixSet,
     VoteValue,
 )
+from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.utils.topk import TUPLE_VERTEX_LIKE
 
 VERTEX_LIKE = TUPLE_VERTEX_LIKE
 

@@ -12,10 +12,7 @@ from __future__ import annotations
 
 import struct
 
-from frankenpaxos_tpu.protocols.epaxos.wire import (
-    _put_deps,
-    _take_deps,
-)
+from frankenpaxos_tpu.protocols.epaxos.wire import _put_deps, _take_deps
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
     _put_address,
     _put_bytes,
@@ -37,10 +34,7 @@ from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     VertexId,
     VoteValue,
 )
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I32 = struct.Struct("<i")
 _I64 = struct.Struct("<q")

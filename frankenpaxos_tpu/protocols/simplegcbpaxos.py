@@ -29,9 +29,6 @@ import dataclasses
 from typing import Optional
 
 from frankenpaxos_tpu.clienttable import ClientTable
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.utils.watermark import QuorumWatermarkVector
 from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     Commit,
     Recover,
@@ -46,6 +43,9 @@ from frankenpaxos_tpu.protocols.simplebpaxos.roles import (
     BPaxosLeader,
     BPaxosProposer,
 )
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.utils.watermark import QuorumWatermarkVector
 
 
 @dataclasses.dataclass(frozen=True)

@@ -22,18 +22,18 @@ import dataclasses
 import random
 from typing import Callable, Optional, Union
 
-from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable, Executed
+from frankenpaxos_tpu.clienttable import ClientTable, Executed, NOT_EXECUTED
 from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
-from frankenpaxos_tpu.runtime import Actor, Logger
-from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.statemachine import StateMachine
 from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     Command,
-    Noop,
     NOOP,
+    Noop,
     VertexId,
 )
 from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+from frankenpaxos_tpu.statemachine import StateMachine
 
 
 @dataclasses.dataclass(frozen=True)

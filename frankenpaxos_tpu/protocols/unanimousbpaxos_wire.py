@@ -11,24 +11,15 @@ from __future__ import annotations
 import struct
 
 from frankenpaxos_tpu.protocols import unanimousbpaxos as m
-from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
-    Noop,
-    NOOP,
-)
+from frankenpaxos_tpu.protocols.multipaxos.wire import _put_bytes, _take_bytes
+from frankenpaxos_tpu.protocols.simplebpaxos.messages import NOOP, Noop
 from frankenpaxos_tpu.protocols.simplebpaxos.wire import (
     _put_command,
-    _take_command,
     _put_vertex,
+    _take_command,
     _take_vertex,
 )
-from frankenpaxos_tpu.protocols.multipaxos.wire import (
-    _put_bytes,
-    _take_bytes,
-)
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I32 = struct.Struct("<i")
 _I64 = struct.Struct("<q")

@@ -14,11 +14,11 @@ slots on the MXU (see ops/quorum.py).
 from frankenpaxos_tpu.quorums.spec import QuorumSpec
 from frankenpaxos_tpu.quorums.systems import (
     Grid,
+    quorum_system_from_dict,
+    quorum_system_to_dict,
     QuorumSystem,
     SimpleMajority,
     UnanimousWrites,
-    quorum_system_from_dict,
-    quorum_system_to_dict,
 )
 
 __all__ = [

@@ -23,19 +23,14 @@ condition, watermark-bounded handover); Mencius reuses the store,
 messages, and tracker per leader group.
 """
 
-from frankenpaxos_tpu.reconfig.epoch import (  # noqa: F401
-    EpochConfig,
-    EpochStore,
-)
+from frankenpaxos_tpu.reconfig.epoch import EpochConfig, EpochStore  # noqa: F401
 from frankenpaxos_tpu.reconfig.messages import (  # noqa: F401
     EpochAck,
     EpochCommit,
     EpochPhase2aRun,
     Reconfigure,
 )
-from frankenpaxos_tpu.reconfig.tracker import (  # noqa: F401
-    EpochQuorumTracker,
-)
+from frankenpaxos_tpu.reconfig.tracker import EpochQuorumTracker  # noqa: F401
 # Importing the wire module registers the extended-page codecs.
 from frankenpaxos_tpu.reconfig.wire import (  # noqa: F401
     decode_epoch_config,

@@ -23,10 +23,7 @@ from frankenpaxos_tpu.reconfig.messages import (
     EpochPhase2aRun,
     Reconfigure,
 )
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I64I64 = struct.Struct("<qq")
 _I32 = struct.Struct("<i")

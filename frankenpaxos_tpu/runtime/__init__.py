@@ -14,8 +14,8 @@ from frankenpaxos_tpu.runtime.actor import Actor, Chan
 from frankenpaxos_tpu.runtime.logger import (
     FakeLogger,
     FileLogger,
-    LogLevel,
     Logger,
+    LogLevel,
     PrintLogger,
 )
 from frankenpaxos_tpu.runtime.monitoring import (
@@ -28,10 +28,7 @@ from frankenpaxos_tpu.runtime.monitoring import (
     PrometheusCollectors,
     Summary,
 )
-from frankenpaxos_tpu.runtime.serializer import (
-    PickleSerializer,
-    Serializer,
-)
+from frankenpaxos_tpu.runtime.serializer import PickleSerializer, Serializer
 from frankenpaxos_tpu.runtime.sim_transport import SimTimer, SimTransport
 from frankenpaxos_tpu.runtime.transport import Timer, Transport
 

@@ -13,10 +13,7 @@ from typing import Any, Callable, Generic, TypeVar
 
 from frankenpaxos_tpu.obs.trace import stage_scope
 from frankenpaxos_tpu.runtime.logger import Logger
-from frankenpaxos_tpu.runtime.serializer import (
-    DEFAULT_SERIALIZER,
-    Serializer,
-)
+from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER, Serializer
 from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
 
 M = TypeVar("M")

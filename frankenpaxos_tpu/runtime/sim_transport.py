@@ -14,9 +14,9 @@ from a partitioned actor are dropped at delivery time.
 
 from __future__ import annotations
 
+from collections import deque
 import dataclasses
 import itertools
-from collections import deque
 from typing import Callable, Optional, Union
 
 from frankenpaxos_tpu.runtime.actor import Actor

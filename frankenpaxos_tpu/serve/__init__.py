@@ -32,25 +32,17 @@ The SLO gate lives in ``bench/overload_lt.py`` ->
 ``bench_results/overload_lt.json``.
 """
 
+# Codec registration (tag 132 on the extended page) is an import side
+# effect, like every other wire module.
+from frankenpaxos_tpu.serve import wire  # noqa: F401
 from frankenpaxos_tpu.serve.admission import (
     AdmissionController,
     AdmissionOptions,
     reject_replies_for,
 )
-from frankenpaxos_tpu.serve.backoff import (
-    RETRY_EXHAUSTED,
-    Backoff,
-)
-from frankenpaxos_tpu.serve.lanes import (
-    LANE_CLIENT,
-    LANE_CONTROL,
-    frame_lane,
-)
+from frankenpaxos_tpu.serve.backoff import Backoff, RETRY_EXHAUSTED
+from frankenpaxos_tpu.serve.lanes import frame_lane, LANE_CLIENT, LANE_CONTROL
 from frankenpaxos_tpu.serve.messages import Rejected
-
-# Codec registration (tag 132 on the extended page) is an import side
-# effect, like every other wire module.
-from frankenpaxos_tpu.serve import wire  # noqa: F401
 
 __all__ = [
     "AdmissionController",

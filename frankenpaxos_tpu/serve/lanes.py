@@ -33,12 +33,22 @@ CLIENT_LANE_TYPE_NAMES = frozenset({
     "ClientRequestArray",
     "ClientRequestBatch",
     "MaxSlotRequest",
+    "BatchMaxSlotRequest",
     "ReadRequest",
     "ReadRequestBatch",
     "SequentialReadRequest",
     "SequentialReadRequestBatch",
     "EventualReadRequest",
     "EventualReadRequestBatch",
+    # Client-edge request shapes surfaced by paxflow FLOW405: every
+    # protocol's client-originated traffic must be shedable, not just
+    # multipaxos/mencius's. Leader-discovery requests are client-edge
+    # too -- the post-failover LeaderInfo thundering herd is exactly
+    # what admission should bound (replies from leaders stay control).
+    "EchoRequest",
+    "ProposeRequest",
+    "LeaderInfoRequestClient",
+    "LeaderInfoRequestBatcher",
 })
 
 _cache: tuple[int, frozenset] | None = None

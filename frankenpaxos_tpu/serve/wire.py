@@ -11,10 +11,7 @@ from __future__ import annotations
 
 import struct
 
-from frankenpaxos_tpu.runtime.serializer import (
-    MessageCodec,
-    register_codec,
-)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 from frankenpaxos_tpu.serve.messages import Rejected
 
 _HDR = struct.Struct("<iib")  # count, retry_after_ms, reason

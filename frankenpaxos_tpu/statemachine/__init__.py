@@ -8,9 +8,9 @@ KeyValueStore.scala:38+, Noop.scala:10+, Register.scala:10+).
 from frankenpaxos_tpu.statemachine.base import (
     ConflictIndex,
     NaiveConflictIndex,
+    state_machine_by_name,
     StateMachine,
     TypedStateMachine,
-    state_machine_by_name,
 )
 from frankenpaxos_tpu.statemachine.impls import (
     AppendLog,

@@ -2,7 +2,10 @@
 
 from frankenpaxos_tpu.utils.buffer_map import BufferMap
 from frankenpaxos_tpu.utils.topk import TopK, TopOne, VertexIdLike
-from frankenpaxos_tpu.utils.watermark import QuorumWatermark, QuorumWatermarkVector
+from frankenpaxos_tpu.utils.watermark import (
+    QuorumWatermark,
+    QuorumWatermarkVector,
+)
 
 __all__ = [
     "BufferMap",

@@ -23,13 +23,7 @@ executed watermark after ``kill -9``, then rejoin the cluster.
     group-commit buffer dies with the actor).
 """
 
-from frankenpaxos_tpu.wal.log import (  # noqa: F401
-    FileStorage,
-    MemStorage,
-    Wal,
-    WalMetrics,
-)
-from frankenpaxos_tpu.wal.role import DurableRole  # noqa: F401
+from frankenpaxos_tpu.wal.log import FileStorage, MemStorage, Wal, WalMetrics  # noqa: F401
 from frankenpaxos_tpu.wal.records import (  # noqa: F401
     WalChosenRun,
     WalEpoch,
@@ -39,3 +33,4 @@ from frankenpaxos_tpu.wal.records import (  # noqa: F401
     WalVote,
     WalVoteRun,
 )
+from frankenpaxos_tpu.wal.role import DurableRole  # noqa: F401

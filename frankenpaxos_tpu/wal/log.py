@@ -31,8 +31,8 @@ from __future__ import annotations
 import dataclasses
 import os
 import struct
-import zlib
 from typing import Iterable
+import zlib
 
 from frankenpaxos_tpu.wal.records import WAL_SERIALIZER, WalSnapshot
 

@@ -22,9 +22,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+from jax.sharding import Mesh  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
-from jax.sharding import Mesh  # noqa: E402
 
 
 @pytest.fixture

@@ -11,8 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.mencius import (
     MenciusAcceptor,
     MenciusBatcher,
@@ -23,6 +21,8 @@ from frankenpaxos_tpu.protocols.mencius import (
     MenciusProxyReplica,
     MenciusReplica,
 )
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 @dataclasses.dataclass

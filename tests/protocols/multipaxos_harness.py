@@ -9,8 +9,6 @@ from __future__ import annotations
 
 import dataclasses
 
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog, StateMachine
 from frankenpaxos_tpu.protocols.multipaxos import (
     Acceptor,
     Batcher,
@@ -29,6 +27,8 @@ from frankenpaxos_tpu.protocols.multipaxos import (
     Replica,
     ReplicaOptions,
 )
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog, StateMachine
 
 
 @dataclasses.dataclass

@@ -10,6 +10,13 @@ from typing import Optional
 
 import pytest
 
+from frankenpaxos_tpu.protocols.epaxos import (
+    EPaxosClient,
+    EPaxosConfig,
+    EPaxosReplica,
+    EPaxosReplicaOptions,
+)
+from frankenpaxos_tpu.protocols.epaxos.replica import CommittedEntry
 from frankenpaxos_tpu.runtime import (
     FakeLogger,
     LogLevel,
@@ -23,13 +30,6 @@ from frankenpaxos_tpu.statemachine import (
     KeyValueStore,
     SetRequest,
 )
-from frankenpaxos_tpu.protocols.epaxos import (
-    EPaxosClient,
-    EPaxosConfig,
-    EPaxosReplica,
-    EPaxosReplicaOptions,
-)
-from frankenpaxos_tpu.protocols.epaxos.replica import CommittedEntry
 
 SER = PickleSerializer()
 

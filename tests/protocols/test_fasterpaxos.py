@@ -2,8 +2,6 @@
 round changes."""
 
 from frankenpaxos_tpu.heartbeat import HeartbeatOptions, HeartbeatParticipant
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.fasterpaxos import (
     ClientRequest,
     Command,
@@ -15,6 +13,8 @@ from frankenpaxos_tpu.protocols.fasterpaxos import (
     Noop,
     Phase2a,
 )
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 def make_fasterpaxos(f=1, num_clients=2, seed=0,
@@ -208,11 +208,7 @@ import random as _random  # noqa: E402
 
 from frankenpaxos_tpu.sim import Simulator  # noqa: E402
 
-from .sim_util import (  # noqa: E402
-    ChaosCmd,
-    PrefixAgreementSim,
-    per_slot_agreement,
-)
+from .sim_util import ChaosCmd, per_slot_agreement, PrefixAgreementSim  # noqa: E402
 
 
 class FasterPaxosSimulated(PrefixAgreementSim):

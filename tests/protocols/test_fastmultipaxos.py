@@ -2,15 +2,15 @@
 recovery, and raft election."""
 
 
-from frankenpaxos_tpu.roundsystem import RoundZeroFast
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.fastmultipaxos import (
     FastMultiPaxosAcceptor,
     FastMultiPaxosClient,
     FastMultiPaxosConfig,
     FastMultiPaxosLeader,
 )
+from frankenpaxos_tpu.roundsystem import RoundZeroFast
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
 def make_fmp(f=1, num_clients=2, seed=0):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
@@ -222,7 +222,7 @@ import random as _random  # noqa: E402
 
 from frankenpaxos_tpu.sim import Simulator  # noqa: E402
 
-from .sim_util import ChaosCmd, PrefixAgreementSim, per_slot_agreement  # noqa: E402
+from .sim_util import ChaosCmd, per_slot_agreement, PrefixAgreementSim  # noqa: E402
 
 
 class FastMultiPaxosSimulated(PrefixAgreementSim):

@@ -1,8 +1,5 @@
 """Horizontal MultiPaxos: chunked log with live chunk reconfiguration."""
 
-from frankenpaxos_tpu.quorums import SimpleMajority
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.horizontal import (
     HorizontalAcceptor,
     HorizontalClient,
@@ -10,6 +7,9 @@ from frankenpaxos_tpu.protocols.horizontal import (
     HorizontalLeader,
     HorizontalReplica,
 )
+from frankenpaxos_tpu.quorums import SimpleMajority
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 def make_horizontal(f=1, num_acceptors=5, num_clients=2, alpha=2, seed=0):

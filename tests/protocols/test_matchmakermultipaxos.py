@@ -1,8 +1,5 @@
 """MatchmakerMultiPaxos: live acceptor reconfiguration mid-stream."""
 
-from frankenpaxos_tpu.quorums import SimpleMajority
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
     Die,
     MatchmakerMultiPaxosConfig,
@@ -13,6 +10,9 @@ from frankenpaxos_tpu.protocols.matchmakermultipaxos import (
     MMPReconfigurer,
     MMPReplica,
 )
+from frankenpaxos_tpu.quorums import SimpleMajority
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 def make_mmp(f=1, num_acceptors=5, num_clients=2, seed=0,
@@ -234,11 +234,7 @@ import random as _random  # noqa: E402
 
 from frankenpaxos_tpu.sim import Simulator  # noqa: E402
 
-from .sim_util import (  # noqa: E402
-    ChaosCmd,
-    PrefixAgreementSim,
-    per_slot_agreement,
-)
+from .sim_util import ChaosCmd, per_slot_agreement, PrefixAgreementSim  # noqa: E402
 
 
 class MMPSimulated(PrefixAgreementSim):

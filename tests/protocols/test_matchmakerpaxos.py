@@ -3,7 +3,6 @@ earlier configs, safety under contention."""
 
 import random
 
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.protocols.matchmakerpaxos import (
     Matchmaker,
     MatchmakerPaxosAcceptor,
@@ -11,6 +10,7 @@ from frankenpaxos_tpu.protocols.matchmakerpaxos import (
     MatchmakerPaxosConfig,
     MatchmakerPaxosLeader,
 )
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 
 
 def make_matchmaker_paxos(f=1, num_acceptors=None, num_clients=2, seed=0):

@@ -7,7 +7,6 @@ from typing import Optional
 import pytest
 
 from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-
 from tests.protocols.mencius_harness import (
     executed_prefix,
     make_mencius as _make_mencius_sim,

@@ -12,17 +12,12 @@ from typing import Optional
 import pytest
 
 from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-
 from tests.protocols.mencius_harness import (
     crash_restart_acceptor,
     crash_restart_replica,
     make_mencius,
 )
-from tests.protocols.test_multipaxos import (
-    FlushCmd,
-    TransportCmd,
-    WriteCmd,
-)
+from tests.protocols.test_multipaxos import FlushCmd, TransportCmd, WriteCmd
 from tests.protocols.test_multipaxos_wal import SettleCmd
 
 

@@ -10,16 +10,8 @@ import pytest
 
 from frankenpaxos_tpu.runtime import PickleSerializer
 from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-from frankenpaxos_tpu.statemachine import (
-    GetRequest,
-    KeyValueStore,
-    SetRequest,
-)
-
-from tests.protocols.multipaxos_harness import (
-    executed_prefix,
-    make_multipaxos,
-)
+from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
+from tests.protocols.multipaxos_harness import executed_prefix, make_multipaxos
 
 SER = PickleSerializer()
 

@@ -15,18 +15,13 @@ from typing import Optional
 import pytest
 
 from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-
 from tests.protocols.multipaxos_harness import (
     crash_restart_acceptor,
     crash_restart_replica,
     executed_prefix,
     make_multipaxos,
 )
-from tests.protocols.test_multipaxos import (
-    FlushCmd,
-    TransportCmd,
-    WriteCmd,
-)
+from tests.protocols.test_multipaxos import FlushCmd, TransportCmd, WriteCmd
 
 
 def drive(sim, lo, hi, got):

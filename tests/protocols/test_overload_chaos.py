@@ -33,18 +33,15 @@ import pytest
 
 from frankenpaxos_tpu.reconfig import Reconfigure
 from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
-from frankenpaxos_tpu.serve.lanes import LANE_CONTROL, frame_lane
+from frankenpaxos_tpu.serve.lanes import frame_lane, LANE_CONTROL
 from frankenpaxos_tpu.sim import Simulator
-
 from tests.protocols.multipaxos_harness import (
     add_replacement_acceptor,
     crash_restart_acceptor,
     make_multipaxos,
 )
 from tests.protocols.test_multipaxos import WriteCmd
-from tests.protocols.test_protocol_reconfig import (
-    MultiPaxosReconfigSimulated,
-)
+from tests.protocols.test_protocol_reconfig import MultiPaxosReconfigSimulated
 
 #: Deterministic admission knobs (no token bucket / CoDel: those read
 #: a clock; see module docstring). Tight enough that bursts overflow.

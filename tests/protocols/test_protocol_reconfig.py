@@ -11,7 +11,6 @@ import pytest
 
 from frankenpaxos_tpu.reconfig import Reconfigure
 from frankenpaxos_tpu.sim import Simulator
-
 from tests.protocols.multipaxos_harness import (
     add_replacement_acceptor,
     crash_restart_acceptor,

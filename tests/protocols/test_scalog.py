@@ -1,7 +1,5 @@
 """Scalog: shard logs + cut ordering end-to-end."""
 
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.scalog import (
     ScalogAcceptor,
     ScalogAggregator,
@@ -11,6 +9,8 @@ from frankenpaxos_tpu.protocols.scalog import (
     ScalogReplica,
     ScalogServer,
 )
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 def make_scalog(f=1, num_shards=2, num_clients=2, push_size=1,

@@ -3,15 +3,6 @@
 import random
 from typing import Optional
 
-
-from frankenpaxos_tpu.runtime import (
-    FakeLogger,
-    LogLevel,
-    PickleSerializer,
-    SimTransport,
-)
-from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
 from frankenpaxos_tpu.protocols.simplebpaxos import (
     BPaxosAcceptor,
     BPaxosClient,
@@ -21,6 +12,14 @@ from frankenpaxos_tpu.protocols.simplebpaxos import (
     BPaxosReplica,
     SimpleBPaxosConfig,
 )
+from frankenpaxos_tpu.runtime import (
+    FakeLogger,
+    LogLevel,
+    PickleSerializer,
+    SimTransport,
+)
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
 
 SER = PickleSerializer()
 

@@ -1,12 +1,5 @@
 """SimpleGcBPaxos: end-to-end with garbage collection actually pruning."""
 
-from frankenpaxos_tpu.runtime import (
-    FakeLogger,
-    LogLevel,
-    PickleSerializer,
-    SimTransport,
-)
-from frankenpaxos_tpu.statemachine import KeyValueStore, SetRequest
 from frankenpaxos_tpu.protocols.simplebpaxos.replica import BPaxosClient
 from frankenpaxos_tpu.protocols.simplegcbpaxos import (
     GarbageCollector,
@@ -17,6 +10,13 @@ from frankenpaxos_tpu.protocols.simplegcbpaxos import (
     GcBPaxosProposer,
     GcBPaxosReplica,
 )
+from frankenpaxos_tpu.runtime import (
+    FakeLogger,
+    LogLevel,
+    PickleSerializer,
+    SimTransport,
+)
+from frankenpaxos_tpu.statemachine import KeyValueStore, SetRequest
 
 SER = PickleSerializer()
 

@@ -15,7 +15,6 @@ from __future__ import annotations
 import random
 from typing import Optional
 
-
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
 

@@ -5,28 +5,6 @@ shared/src/test/scala)."""
 import random
 from typing import Optional
 
-
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.sim import Simulator
-from frankenpaxos_tpu.statemachine import AppendLog
-from frankenpaxos_tpu.protocols.paxos import (
-    PaxosAcceptor,
-    PaxosClient,
-    PaxosConfig,
-    PaxosLeader,
-)
-from frankenpaxos_tpu.protocols.fastpaxos import (
-    FastPaxosAcceptor,
-    FastPaxosClient,
-    FastPaxosConfig,
-    FastPaxosLeader,
-)
-from frankenpaxos_tpu.protocols.caspaxos import (
-    CasPaxosAcceptor,
-    CasPaxosClient,
-    CasPaxosConfig,
-    CasPaxosLeader,
-)
 from frankenpaxos_tpu.protocols.batchedunreplicated import (
     BatchedUnreplicatedBatcher,
     BatchedUnreplicatedClient,
@@ -34,11 +12,28 @@ from frankenpaxos_tpu.protocols.batchedunreplicated import (
     BatchedUnreplicatedProxyServer,
     BatchedUnreplicatedServer,
 )
-from frankenpaxos_tpu.protocols.craq import (
-    ChainNode,
-    CraqClient,
-    CraqConfig,
+from frankenpaxos_tpu.protocols.caspaxos import (
+    CasPaxosAcceptor,
+    CasPaxosClient,
+    CasPaxosConfig,
+    CasPaxosLeader,
 )
+from frankenpaxos_tpu.protocols.craq import ChainNode, CraqClient, CraqConfig
+from frankenpaxos_tpu.protocols.fastpaxos import (
+    FastPaxosAcceptor,
+    FastPaxosClient,
+    FastPaxosConfig,
+    FastPaxosLeader,
+)
+from frankenpaxos_tpu.protocols.paxos import (
+    PaxosAcceptor,
+    PaxosClient,
+    PaxosConfig,
+    PaxosLeader,
+)
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.sim import Simulator
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 def sim_logger():

@@ -1,12 +1,5 @@
 """UnanimousBPaxos: fast path, slow path on dependency disagreement."""
 
-from frankenpaxos_tpu.runtime import (
-    FakeLogger,
-    LogLevel,
-    PickleSerializer,
-    SimTransport,
-)
-from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
 from frankenpaxos_tpu.protocols.unanimousbpaxos import (
     UnanimousBPaxosAcceptor,
     UnanimousBPaxosClient,
@@ -14,6 +7,13 @@ from frankenpaxos_tpu.protocols.unanimousbpaxos import (
     UnanimousBPaxosDepServiceNode,
     UnanimousBPaxosLeader,
 )
+from frankenpaxos_tpu.runtime import (
+    FakeLogger,
+    LogLevel,
+    PickleSerializer,
+    SimTransport,
+)
+from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
 
 SER = PickleSerializer()
 

@@ -3,15 +3,15 @@
 import random
 from typing import Optional
 
-from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
-from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
-from frankenpaxos_tpu.statemachine import AppendLog
 from frankenpaxos_tpu.protocols.vanillamencius import (
     ChosenEntry,
     VanillaMenciusClient,
     VanillaMenciusConfig,
     VanillaMenciusServer,
 )
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from frankenpaxos_tpu.statemachine import AppendLog
 
 
 def make_vanilla(f=1, num_clients=2, seed=0):

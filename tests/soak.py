@@ -45,7 +45,6 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 from frankenpaxos_tpu.sim import Simulator  # noqa: E402
-
 from tests.protocols.test_epaxos import EPaxosSimulated, make_epaxos
 from tests.protocols.test_fasterpaxos import (
     FasterPaxosF1OptSimulated,
@@ -61,13 +60,13 @@ from tests.protocols.test_horizontal import (
     make_horizontal,
 )
 from tests.protocols.test_matchmakermultipaxos import (
+    make_mmp,
     MMPReconfigHeavySimulated,
     MMPSimulated,
-    make_mmp,
 )
 from tests.protocols.test_mencius import MenciusSimulated
 from tests.protocols.test_multipaxos import MultiPaxosSimulated
-from tests.protocols.test_scalog import ScalogSimulated, make_scalog
+from tests.protocols.test_scalog import make_scalog, ScalogSimulated
 from tests.protocols.test_simplebpaxos import BPaxosSimulated, make_bpaxos
 from tests.protocols.test_simplegcbpaxos import (
     GcBPaxosSimulated,
@@ -78,8 +77,8 @@ from tests.protocols.test_small_protocols import (
     UnanimousBPaxosSimulated,
 )
 from tests.protocols.test_vanillamencius import (
-    VanillaMenciusSimulated,
     make_vanilla,
+    VanillaMenciusSimulated,
 )
 
 
@@ -252,12 +251,8 @@ CONFIGS: list[tuple] = [
 # partitions, and leader changes. Kept in their own list so
 # ``--only wal`` (and the wal_chaos_soak artifact) can run exactly
 # this family; run_soak covers CONFIGS + WAL_CHAOS_CONFIGS.
-from tests.protocols.test_mencius_wal import (  # noqa: E402
-    MenciusWalSimulated,
-)
-from tests.protocols.test_multipaxos_wal import (  # noqa: E402
-    MultiPaxosWalSimulated,
-)
+from tests.protocols.test_mencius_wal import MenciusWalSimulated  # noqa: E402
+from tests.protocols.test_multipaxos_wal import MultiPaxosWalSimulated  # noqa: E402
 
 WAL_CHAOS_CONFIGS: list[tuple] = [
     ("wal-chaos/multipaxos-f1",
@@ -282,9 +277,7 @@ CONFIGS.extend(WAL_CHAOS_CONFIGS)
 # (reconfig/, docs/RECONFIG.md): member swaps to fresh replacement
 # acceptors mid-traffic under the same SM-prefix + chosen-uniqueness
 # + exactly-once oracle.
-from tests.protocols.test_protocol_reconfig import (  # noqa: E402
-    MultiPaxosReconfigSimulated,
-)
+from tests.protocols.test_protocol_reconfig import MultiPaxosReconfigSimulated  # noqa: E402
 
 CONFIGS.extend([
     ("reconfig-chaos/multipaxos-f1",
@@ -300,9 +293,7 @@ CONFIGS.extend([
 # kill-restart and reconfiguration schedules above. Adds two oracles:
 # acked writes are never missing from executed state, and
 # control-plane frames are never refused by a bounded inbox.
-from tests.protocols.test_overload_chaos import (  # noqa: E402
-    MultiPaxosOverloadSimulated,
-)
+from tests.protocols.test_overload_chaos import MultiPaxosOverloadSimulated  # noqa: E402
 
 CONFIGS.extend([
     ("overload-chaos/multipaxos-f1",

@@ -954,3 +954,618 @@ def test_list_rules():
     assert proc.returncode == 0
     for rule in ("PAX101", "TPU201", "COD301", "COD302"):
         assert rule in proc.stdout
+
+
+# --- FLOW4xx: message-topology contracts (paxflow) --------------------------
+
+FLOW_PREAMBLE = """\
+    import dataclasses
+
+    class Actor:
+        def receive(self, src, message): ...
+        def on_drain(self): ...
+        def timer(self, name, delay_s, f): ...
+        def send(self, dst, message): ...
+        def broadcast(self, dsts, message): ...
+"""
+
+
+def test_flow401_sent_but_unhandled(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class Ping:
+        n: int
+
+    class Sender(Actor):
+        def receive(self, src, message):
+            self.send(src, Ping(n=1))
+    """}))
+    assert any(f.rule == "FLOW401" and f.scope == "Ping"
+               for f in findings)
+
+
+def test_flow401_quiet_when_handled_outside_protocols(tmp_path):
+    """A handler in election/-style code outside the protocol tree
+    still counts (the global handler scan)."""
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    from pkg.election import Ping
+
+    class Sender(Actor):
+        def receive(self, src, message):
+            self.send(src, Ping(n=1))
+    """,
+        "election.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class Ping:
+        n: int
+
+    class Participant(Actor):
+        def receive(self, src, message):
+            if isinstance(message, Ping):
+                pass
+    """}))
+    assert "FLOW401" not in rules_of(findings)
+
+
+def test_flow401_payload_only_construction_is_not_a_send(tmp_path):
+    """A message nested inside another sent message is wire payload,
+    not an unhandled dispatch target."""
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class Inner:
+        n: int
+
+    @dataclasses.dataclass
+    class Outer:
+        inner: Inner
+
+    class Sender(Actor):
+        def receive(self, src, message):
+            self.send(src, Outer(inner=Inner(n=1)))
+
+    class Receiver(Actor):
+        def receive(self, src, message):
+            if isinstance(message, Outer):
+                pass
+    """}))
+    assert all(not (f.rule == "FLOW401" and f.scope == "Inner")
+               for f in findings)
+
+
+def test_flow402_handled_but_never_sent(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class Dead:
+        n: int
+
+    class Receiver(Actor):
+        def receive(self, src, message):
+            if isinstance(message, Dead):
+                pass
+    """}))
+    assert any(f.rule == "FLOW402" and f.scope == "Dead"
+               for f in findings)
+
+
+def test_flow403_orphan_codec_tag(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class Orphan:
+        n: int
+
+    class OrphanCodec:
+        message_type = Orphan
+        tag = 99
+
+        def encode(self, out, message):
+            out += bytes([message.n])
+
+        def decode(self, buf, at):
+            return Orphan(n=buf[at]), at + 1
+    """}))
+    assert any(f.rule == "FLOW403" and f.scope == "Orphan"
+               for f in findings)
+
+
+def test_flow404_request_without_reply_or_timer(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class FetchRequest:
+        n: int
+
+    class Requester(Actor):
+        def kick(self):
+            self.send("server", FetchRequest(n=1))
+
+        def receive(self, src, message):
+            pass
+
+    class Server(Actor):
+        def receive(self, src, message):
+            if isinstance(message, FetchRequest):
+                pass
+    """}))
+    assert any(f.rule == "FLOW404" and f.scope == "FetchRequest"
+               for f in findings)
+
+
+def test_flow404_quiet_with_reply_path(tmp_path):
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class FetchRequest:
+        n: int
+
+    @dataclasses.dataclass
+    class FetchReply:
+        n: int
+
+    class Requester(Actor):
+        def kick(self):
+            self.send("server", FetchRequest(n=1))
+
+        def receive(self, src, message):
+            if isinstance(message, FetchReply):
+                pass
+
+    class Server(Actor):
+        def receive(self, src, message):
+            if isinstance(message, FetchRequest):
+                self.send(src, FetchReply(n=message.n))
+    """}))
+    assert "FLOW404" not in rules_of(findings)
+
+
+def test_flow404_quiet_with_nested_def_resend_timer(tmp_path):
+    """The ubiquitous client idiom: a nested ``def resend`` registered
+    as a timer callback makes the request timer-resent."""
+    findings = run_rules(project(tmp_path, {
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class FetchRequest:
+        n: int
+
+    class Requester(Actor):
+        def kick(self):
+            request = FetchRequest(n=1)
+            self.send("server", request)
+
+            def resend():
+                self.send("server", request)
+
+            self.timer("resend", 1.0, resend).start()
+
+        def receive(self, src, message):
+            pass
+
+    class Server(Actor):
+        def receive(self, src, message):
+            if isinstance(message, FetchRequest):
+                pass
+    """}))
+    assert "FLOW404" not in rules_of(findings)
+
+
+_LANES_FIXTURE = """\
+    CLIENT_LANE_TYPE_NAMES = frozenset({
+        "ClientRequest",
+    })
+"""
+
+
+def test_flow405_lane_name_without_codec_tag(tmp_path):
+    """A client-lane NAME whose message has no codec: the tag-based
+    frame classifier can never shed it."""
+    findings = run_rules(project(tmp_path, {
+        "serve/lanes.py": _LANES_FIXTURE,
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class ClientRequest:
+        n: int
+
+    @dataclasses.dataclass
+    class Other:
+        n: int
+
+    class OtherCodec:
+        message_type = Other
+        tag = 98
+
+        def encode(self, out, message):
+            out += bytes([message.n])
+
+        def decode(self, buf, at):
+            return Other(n=buf[at]), at + 1
+
+    class ToyClient(Actor):
+        def kick(self):
+            self.send("server", ClientRequest(n=1))
+            self.send("server", Other(n=2))
+
+        def receive(self, src, message):
+            pass
+
+    class Server(Actor):
+        def receive(self, src, message):
+            if isinstance(message, (ClientRequest, Other)):
+                self.send(src, Other(n=0))
+    """}))
+    assert any(f.rule == "FLOW405"
+               and f.detail == "untagged-lane:ClientRequest"
+               for f in findings)
+
+
+def test_flow405_unclassified_client_edge_message(tmp_path):
+    """A codec-tagged *Request* sent only by client-edge roles but
+    missing from CLIENT_LANE_TYPE_NAMES."""
+    findings = run_rules(project(tmp_path, {
+        "serve/lanes.py": _LANES_FIXTURE,
+        "protocols/toy.py": FLOW_PREAMBLE + """
+    @dataclasses.dataclass
+    class FetchRequest:
+        n: int
+
+    class FetchRequestCodec:
+        message_type = FetchRequest
+        tag = 97
+
+        def encode(self, out, message):
+            out += bytes([message.n])
+
+        def decode(self, buf, at):
+            return FetchRequest(n=buf[at]), at + 1
+
+    class ToyClient(Actor):
+        def kick(self):
+            request = FetchRequest(n=1)
+            self.send("server", request)
+
+            def resend():
+                self.send("server", request)
+
+            self.timer("resend", 1.0, resend).start()
+
+        def receive(self, src, message):
+            pass
+
+    class Server(Actor):
+        def receive(self, src, message):
+            if isinstance(message, FetchRequest):
+                pass
+    """}))
+    assert any(f.rule == "FLOW405"
+               and f.detail == "unclassified:FetchRequest"
+               for f in findings)
+
+
+# --- DUR5xx: durability dataflow --------------------------------------------
+
+DUR_PREAMBLE = """\
+    import dataclasses
+
+    class Actor:
+        def receive(self, src, message): ...
+        def on_drain(self): ...
+        def timer(self, name, delay_s, f): ...
+        def send(self, dst, message): ...
+        def broadcast(self, dsts, message): ...
+
+    class DurableRole:
+        def _wal_init(self, wal): ...
+        def _wal_send(self, dst, message): ...
+        def _wal_drain(self): ...
+
+    @dataclasses.dataclass
+    class Record:
+        n: int
+
+    @dataclasses.dataclass
+    class Ack:
+        n: int
+
+    @dataclasses.dataclass
+    class Nack:
+        n: int
+"""
+
+
+def test_dur501_direct_send_after_append(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Bad(Actor, DurableRole):
+        def receive(self, src, message):
+            self.wal.append(Record(n=1))
+            self.send(src, Ack(n=1))
+    """}))
+    assert any(f.rule == "DUR501" and f.detail == "send:Ack"
+               for f in findings)
+
+
+def test_dur501_quiet_for_wal_send(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Good(Actor, DurableRole):
+        def receive(self, src, message):
+            self.wal.append(Record(n=1))
+            self._wal_send(src, Ack(n=1))
+    """}))
+    assert "DUR501" not in rules_of(findings)
+
+
+def test_dur501_nack_is_exempt(tmp_path):
+    """A nack acknowledges nothing: the early-reject path may send it
+    directly even in an appending handler."""
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Good(Actor, DurableRole):
+        def receive(self, src, message):
+            if message.n < 0:
+                self.send(src, Nack(n=0))
+                return
+            self.wal.append(Record(n=1))
+            self._wal_send(src, Ack(n=1))
+    """}))
+    assert "DUR501" not in rules_of(findings)
+
+
+def test_dur502_wal_use_without_mixin(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Bad(Actor):
+        def receive(self, src, message):
+            self.wal.append(Record(n=1))
+    """}))
+    assert any(f.rule == "DUR502" and f.scope == "Bad"
+               for f in findings)
+
+
+def test_dur502_quiet_with_mixin(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Good(Actor, DurableRole):
+        def receive(self, src, message):
+            self.wal.append(Record(n=1))
+            self._wal_send(src, Ack(n=1))
+
+        def on_drain(self):
+            self._wal_drain()
+    """}))
+    assert "DUR502" not in rules_of(findings)
+
+
+def test_dur503_on_drain_without_wal_drain(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Bad(Actor, DurableRole):
+        def receive(self, src, message):
+            self.wal.append(Record(n=1))
+            self._wal_send(src, Ack(n=1))
+
+        def on_drain(self):
+            pass
+    """}))
+    assert any(f.rule == "DUR503" and f.scope == "Bad.on_drain"
+               for f in findings)
+
+
+def test_dur503_quiet_when_reached_through_helper(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": DUR_PREAMBLE + """
+    class Good(Actor, DurableRole):
+        def receive(self, src, message):
+            self.wal.append(Record(n=1))
+            self._wal_send(src, Ack(n=1))
+
+        def on_drain(self):
+            self._finish()
+
+        def _finish(self):
+            self._wal_drain()
+    """}))
+    assert "DUR503" not in rules_of(findings)
+
+
+# --- SHAPE6xx: abstract shape/dtype interpretation --------------------------
+
+SHAPE_PREAMBLE = """\
+    import jax
+    import jax.numpy as jnp
+"""
+
+
+def test_shape601_nonzero_without_size(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    @jax.jit
+    def kernel(x):
+        return jnp.nonzero(x > 0)
+    """}))
+    assert any(f.rule == "SHAPE601" for f in findings)
+
+
+def test_shape601_quiet_with_size(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    @jax.jit
+    def kernel(x):
+        return jnp.nonzero(x > 0, size=8, fill_value=0)
+    """}))
+    assert "SHAPE601" not in rules_of(findings)
+
+
+def test_shape601_one_arg_where(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    @jax.jit
+    def kernel(x):
+        return jnp.where(x > 0)
+
+    @jax.jit
+    def fine(x):
+        return jnp.where(x > 0, x, 0)
+    """}))
+    assert sum(f.rule == "SHAPE601" for f in findings) == 1
+
+
+def test_shape602_builtin_astype(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    @jax.jit
+    def kernel(x):
+        return x.astype(int)
+    """}))
+    assert any(f.rule == "SHAPE602" and f.detail == "astype:int"
+               for f in findings)
+
+
+def test_shape602_value_typed_arange(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    @jax.jit
+    def kernel(x):
+        return jnp.arange(x.shape[0])
+
+    @jax.jit
+    def fine(x):
+        return jnp.arange(x.shape[0], dtype=jnp.int32)
+    """}))
+    assert sum(f.rule == "SHAPE602" for f in findings) == 1
+
+
+def test_shape602_jit_wrapped_module_level(tmp_path):
+    """``kernel2 = jax.jit(kernel)`` marks ``kernel`` as jitted even
+    without a decorator."""
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    def kernel(x):
+        return x.astype(float)
+
+    kernel2 = jax.jit(kernel)
+    """}))
+    assert any(f.rule == "SHAPE602" and f.detail == "astype:float"
+               for f in findings)
+
+
+def test_shape603_undeclared_axis_name(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    from jax import lax
+    from jax.sharding import Mesh
+
+    def make(devices):
+        return Mesh(devices, ("group", "slot"))
+
+    @jax.jit
+    def kernel(x):
+        return lax.psum(x, axis_name="grp")
+    """}))
+    assert any(f.rule == "SHAPE603" and f.detail == "psum:grp"
+               for f in findings)
+
+
+def test_shape603_quiet_when_declared(tmp_path):
+    findings = run_rules(project(tmp_path, {"k.py": SHAPE_PREAMBLE + """
+    from jax import lax
+    from jax.sharding import Mesh
+
+    def make(devices):
+        return Mesh(devices, ("group", "slot"))
+
+    @jax.jit
+    def kernel(x):
+        return lax.psum(x, axis_name="group")
+    """}))
+    assert "SHAPE603" not in rules_of(findings)
+
+
+# --- paxflow graph artifacts ------------------------------------------------
+
+
+def test_flowgraph_covers_every_protocol_unit():
+    """Registry completeness: every protocol package yields a
+    non-empty flow graph (roles, messages, and at least one edge)."""
+    from frankenpaxos_tpu.analysis import flowgraph
+
+    proj = Project(".")
+    graphs = flowgraph.build_all(proj)
+    units = set(flowgraph.unit_modules(proj))
+    assert units == set(graphs)
+    assert len(graphs) >= 20
+    for unit, graph in graphs.items():
+        assert graph.roles, unit
+        assert graph.messages, unit
+        assert graph.edges(), unit
+
+
+def test_flowgraph_golden_multipaxos_mencius():
+    """The committed docs/flowgraphs artifacts for the two run-pipeline
+    protocols match a fresh build byte-for-byte, and a second
+    independent build is bit-identical (deterministic, diff-stable)."""
+    from frankenpaxos_tpu.analysis import flowgraph
+
+    first = flowgraph.render(Project("."))
+    second = flowgraph.render(Project("."))
+    assert first == second
+    for unit in ("multipaxos", "mencius"):
+        for ext in ("json", "dot"):
+            with open(f"docs/flowgraphs/{unit}.{ext}",
+                      encoding="utf-8") as f:
+                assert f.read() == first[f"{unit}.{ext}"], (
+                    f"{unit}.{ext} is stale: regenerate with "
+                    f"python -m frankenpaxos_tpu.analysis "
+                    f"--write-flowgraphs")
+
+
+# --- import_sort: the tooled import-order pass ------------------------------
+
+
+def test_import_sort_sections_and_members():
+    from frankenpaxos_tpu.analysis.import_sort import sort_source
+
+    src = textwrap.dedent("""\
+    \"\"\"doc.\"\"\"
+
+    from frankenpaxos_tpu.utils import BufferMap
+    import sys
+    from typing import Optional
+    import jax
+    from frankenpaxos_tpu.runtime import Logger, Actor
+    """)
+    out = sort_source(src)
+    want = textwrap.dedent("""\
+    \"\"\"doc.\"\"\"
+
+    import sys
+    from typing import Optional
+
+    import jax
+
+    from frankenpaxos_tpu.runtime import Actor, Logger
+    from frankenpaxos_tpu.utils import BufferMap
+    """)
+    assert out == want
+    assert sort_source(out) == out  # idempotent
+
+
+def test_import_sort_preserves_noqa_and_interior_comments():
+    from frankenpaxos_tpu.analysis.import_sort import sort_source
+
+    src = textwrap.dedent("""\
+    from frankenpaxos_tpu.wal.log import (  # noqa: F401
+        Wal,
+        MemStorage,
+    )
+    from frankenpaxos_tpu.obs import (
+        Tracer,
+        # the flight recorder survives kill -9
+        FlightRecorder,
+    )
+    """)
+    out = sort_source(src)
+    assert "# noqa: F401" in out
+    # The interior-comment statement is kept verbatim (unsorted names
+    # and all) -- only its position may change.
+    assert "# the flight recorder survives kill -9" in out
+    assert out.index("frankenpaxos_tpu.obs") < out.index(
+        "frankenpaxos_tpu.wal")
+
+
+def test_import_sort_repo_gate():
+    """The CI gate: the repo's import order is check-clean."""
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "frankenpaxos_tpu.analysis.import_sort", "--check"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -13,9 +13,9 @@ This is the validation path for the driver's ``dryrun_multichip``
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 import numpy as np
 import pytest
-from jax.sharding import Mesh
 
 from frankenpaxos_tpu.bench.pipeline import (
     make_sharded_step,

@@ -10,9 +10,9 @@ kernels on dep batches built from REAL InstancePrefixSets.
 """
 
 import jax
+from jax.sharding import NamedSharding, PartitionSpec
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec
 
 from frankenpaxos_tpu.ops import depset
 from frankenpaxos_tpu.protocols.epaxos.device_deps import to_batch

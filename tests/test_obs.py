@@ -13,13 +13,13 @@ import pytest
 
 from frankenpaxos_tpu.obs import (
     FlightRecorder,
+    latency_breakdown,
     RuntimeMetrics,
+    to_chrome_trace,
+    trace_tree,
     TraceContext,
     Tracer,
     VirtualClock,
-    latency_breakdown,
-    to_chrome_trace,
-    trace_tree,
 )
 from frankenpaxos_tpu.obs.trace import stage_scope
 from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer

@@ -6,7 +6,10 @@ import random
 import numpy as np
 import pytest
 
-from frankenpaxos_tpu.ops.quorum import MultiConfigQuorumChecker, TpuQuorumChecker
+from frankenpaxos_tpu.ops.quorum import (
+    MultiConfigQuorumChecker,
+    TpuQuorumChecker,
+)
 from frankenpaxos_tpu.quorums import Grid, SimpleMajority, UnanimousWrites
 
 

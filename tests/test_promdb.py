@@ -5,7 +5,7 @@ prometheus.py:10-132 query surface without a prometheus binary."""
 
 import pytest
 
-from frankenpaxos_tpu.bench.promdb import MetricsDB, _parse_scraped_key
+from frankenpaxos_tpu.bench.promdb import _parse_scraped_key, MetricsDB
 
 
 def make_db(ticks):

@@ -12,10 +12,10 @@ import pytest
 
 from frankenpaxos_tpu.quorums import (
     Grid,
-    SimpleMajority,
-    UnanimousWrites,
     quorum_system_from_dict,
     quorum_system_to_dict,
+    SimpleMajority,
+    UnanimousWrites,
 )
 
 

@@ -12,13 +12,15 @@ import numpy as np
 import pytest
 
 from frankenpaxos_tpu.ops.quorum import (
-    EpochSegmentedChecker,
-    TpuQuorumChecker,
     epoch_column_map,
+    EpochSegmentedChecker,
     reshape_block,
+    TpuQuorumChecker,
 )
 from frankenpaxos_tpu.quorums import Grid, SimpleMajority
 from frankenpaxos_tpu.reconfig import (
+    decode_epoch_config,
+    encode_epoch_config,
     EpochAck,
     EpochCommit,
     EpochConfig,
@@ -26,8 +28,6 @@ from frankenpaxos_tpu.reconfig import (
     EpochQuorumTracker,
     EpochStore,
     Reconfigure,
-    decode_epoch_config,
-    encode_epoch_config,
 )
 from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
 from frankenpaxos_tpu.wal import MemStorage, Wal, WalEpoch

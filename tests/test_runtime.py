@@ -14,9 +14,9 @@ from frankenpaxos_tpu.runtime import (
     FakeCollectors,
     FakeLogger,
     LogLevel,
+    serializer as serializer_mod,
     SimTransport,
 )
-from frankenpaxos_tpu.runtime import serializer as serializer_mod
 from frankenpaxos_tpu.runtime.logger import FatalError
 from frankenpaxos_tpu.statemachine import AppendLog, KeyValueStore
 

@@ -21,17 +21,17 @@ import pytest
 
 from frankenpaxos_tpu import serve
 from frankenpaxos_tpu.runtime.serializer import (
-    DEFAULT_SERIALIZER,
     _CODECS_BY_TAG,
+    DEFAULT_SERIALIZER,
 )
 from frankenpaxos_tpu.serve import lanes
 from frankenpaxos_tpu.serve.admission import (
     AdmissionController,
     AdmissionOptions,
-    TokenBucket,
     reject_replies_for,
+    TokenBucket,
 )
-from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED, Backoff
+from frankenpaxos_tpu.serve.backoff import Backoff, RETRY_EXHAUSTED
 from frankenpaxos_tpu.serve.messages import (
     REASON_CODEL,
     REASON_INFLIGHT,
@@ -39,7 +39,6 @@ from frankenpaxos_tpu.serve.messages import (
     REASON_TOKENS,
     Rejected,
 )
-
 from tests.protocols.multipaxos_harness import make_multipaxos
 
 

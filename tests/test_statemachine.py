@@ -4,7 +4,7 @@ ClientTable (clienttable/ClientTableTest)."""
 
 import pytest
 
-from frankenpaxos_tpu.clienttable import NOT_EXECUTED, ClientTable, Executed
+from frankenpaxos_tpu.clienttable import ClientTable, Executed, NOT_EXECUTED
 from frankenpaxos_tpu.statemachine import (
     AppendLog,
     GetReply,

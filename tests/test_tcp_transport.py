@@ -13,7 +13,7 @@ from frankenpaxos_tpu.protocols.unreplicated import (
     UnreplicatedServer,
 )
 from frankenpaxos_tpu.runtime import FakeLogger
-from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport, _encode_frame
+from frankenpaxos_tpu.runtime.tcp_transport import _encode_frame, TcpTransport
 from frankenpaxos_tpu.statemachine import AppendLog
 
 

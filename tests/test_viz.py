@@ -3,7 +3,6 @@
 import json
 
 from frankenpaxos_tpu.viz import TraceRecorder, viewer_path
-
 from tests.protocols.multipaxos_harness import make_multipaxos
 
 

@@ -5,7 +5,6 @@ import struct
 
 import pytest
 
-from frankenpaxos_tpu.wal.records import WAL_SERIALIZER
 from frankenpaxos_tpu.wal import (
     FileStorage,
     MemStorage,
@@ -17,6 +16,7 @@ from frankenpaxos_tpu.wal import (
     WalVote,
     WalVoteRun,
 )
+from frankenpaxos_tpu.wal.records import WAL_SERIALIZER
 
 RECORDS = [
     WalPromise(round=3),

@@ -12,7 +12,6 @@ import pytest
 
 import frankenpaxos_tpu.protocols.multipaxos  # noqa: F401 - registers codecs
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
-    NOOP,
     Chosen,
     ChosenWatermark,
     ClientReply,
@@ -21,6 +20,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     Command,
     CommandBatch,
     CommandId,
+    NOOP,
     Phase1a,
     Phase2a,
     Phase2b,
@@ -668,6 +668,23 @@ def all_codec_samples() -> dict:
         mp.EventualReadRequest(command=command),
         mp.ReadReplyBatch(batch=(mp.ReadReply(cid, 9, b"r1"),)),
         mp.ClientReplyBatch(batch=(mp.ClientReply(cid, 11, b"x"),)),
+        # multipaxos read-batcher + leader-change redirects (paxflow
+        # COD301 burn-down, extended tags 133-143)
+        mp.ReadRequestBatch(slot=5, commands=(command,)),
+        mp.SequentialReadRequestBatch(slot=-1, commands=(command,)),
+        mp.EventualReadRequestBatch(commands=(command, command)),
+        mp.BatchMaxSlotRequest(read_batcher_index=1,
+                               read_batcher_id=7),
+        mp.BatchMaxSlotReply(read_batcher_index=1, read_batcher_id=7,
+                             group_index=0, acceptor_index=2,
+                             slot=1 << 40),
+        mp.NotLeaderClient(),
+        mp.LeaderInfoRequestClient(),
+        mp.LeaderInfoReplyClient(round=9),
+        mp.NotLeaderBatcher(
+            client_request_batch=mp.ClientRequestBatch(batch)),
+        mp.LeaderInfoRequestBatcher(),
+        mp.LeaderInfoReplyBatcher(round=2),
         # mencius
         mn.Chosen(slot=7, value=mn.NOOP),
         mn.HighWatermark(next_slot=1 << 33),
@@ -683,6 +700,15 @@ def all_codec_samples() -> dict:
         mn.Phase2bRun(acceptor_group_index=0, acceptor_index=1,
                       start_slot=1, count=2, stride=2, round=0),
         mn.ChosenRun(start_slot=1, stride=2, values=(batch,)),
+        # mencius leader-change redirects (extended tags 144-149)
+        mn.NotLeaderClient(leader_group_index=2),
+        mn.LeaderInfoRequestClient(),
+        mn.LeaderInfoReplyClient(leader_group_index=1, round=5),
+        mn.NotLeaderBatcher(
+            leader_group_index=0,
+            client_request_batch=mp.ClientRequestBatch(batch)),
+        mn.LeaderInfoRequestBatcher(),
+        mn.LeaderInfoReplyBatcher(leader_group_index=3, round=9),
         # epaxos
         em.PreAccept(Instance(0, 4), (1, 0), ecommand, 7, edeps),
         em.PreAcceptOk(Instance(0, 4), (1, 0), 2, 7, edeps),

@@ -6,24 +6,20 @@ import random
 import pytest
 
 from frankenpaxos_tpu.bench.workload import (
-    READ,
-    WRITE,
     BernoulliSingleKeyWorkload,
     PointSkewedReadWriteWorkload,
+    READ,
     StringWorkload,
     UniformMultiKeyReadWriteWorkload,
     UniformReadWriteWorkload,
     UniformSingleKeyWorkload,
-    WriteOnlyWorkload,
     workload_from_dict,
     workload_to_dict,
+    WRITE,
+    WriteOnlyWorkload,
 )
 from frankenpaxos_tpu.runtime.serializer import PickleSerializer
-from frankenpaxos_tpu.statemachine import (
-    GetRequest,
-    KeyValueStore,
-    SetRequest,
-)
+from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
 
 SER = PickleSerializer()
 
