@@ -136,6 +136,7 @@ def _ensure_loaded() -> None:
         epoch_rules,
         flow_rules,
         hotpath_rules,
+        net_rules,
         overload_rules,
         shape_rules,
     )

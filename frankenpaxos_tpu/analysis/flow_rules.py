@@ -53,6 +53,32 @@ RULES = {
 #: disk, never sent -- they are not FLOW403's surface.
 _WAL_PREFIX = "wal/"
 
+
+def _transport_layer_codecs(project: Project) -> set:
+    """Message keys of codecs whose class body sets
+    ``transport_layer = True``: paxwire batch envelopes encoded by the
+    TRANSPORT's flush planner and expanded before delivery
+    (runtime/paxwire.py, Phase2bAckBatch) -- deliberately no role send
+    site, so FLOW403's orphan-tag surface excludes them."""
+    from frankenpaxos_tpu.analysis import codec_rules
+
+    marked: set = set()
+    for mod, cls, msg_dotted in codec_rules._codec_classes(project):
+        if not any(isinstance(stmt, ast.Assign)
+                   and len(stmt.targets) == 1
+                   and isinstance(stmt.targets[0], ast.Name)
+                   and stmt.targets[0].id == "transport_layer"
+                   and isinstance(stmt.value, ast.Constant)
+                   and stmt.value.value is True
+                   for stmt in cls.body):
+            continue
+        entry = codec_rules._resolve_message_class(project, mod,
+                                                   msg_dotted)
+        if entry is not None:
+            msg_mod, msg_cls = entry
+            marked.add((msg_mod.path, msg_cls.name))
+    return marked
+
 _REQUEST_SUFFIXES = ("Request", "RequestBatch")
 
 
@@ -197,9 +223,12 @@ def check(project: Project):
                             f"be shed under overload"))
 
     # FLOW403: orphan codec tags, project-wide.
+    transport_layer = _transport_layer_codecs(project)
     for (mod_path, mname), tag in sorted(
             flowgraph._codec_tags(project).items()):
         if mod_path.startswith(f"{project.package}/{_WAL_PREFIX}"):
+            continue
+        if (mod_path, mname) in transport_layer:
             continue
         if (mod_path, mname) in sent_any:
             continue

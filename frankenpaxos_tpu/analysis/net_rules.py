@@ -1,0 +1,127 @@
+"""paxwire transport-contract rules (NET7xx).
+
+  * NET701 -- a per-message FLUSHING send inside a loop in a
+    drain-granular handler (``on_drain`` or a helper it calls): each
+    iteration schedules its own flush where a batch path exists.
+    ``Actor.send_batch`` (or ``send_no_flush`` + one ``flush``) ships
+    the loop's messages as ONE transport batch -- one writev, adjacent
+    same-type payloads coalesced into a batch frame
+    (runtime/paxwire.py, docs/TRANSPORT.md).
+
+Per-DESTINATION fan-out loops (the destination expression depends on
+the loop variable: one reply array per client, one Phase2a per
+acceptor group) are not flagged -- those are different connections, so
+there is nothing to batch per peer; the transport's per-pass flush
+already coalesces them. Only loops that push multiple messages at one
+fixed destination with a flushing ``send`` per iteration are the
+anti-pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.actor_rules import _actor_classes, _methods
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    Project,
+    register_rules,
+)
+
+RULES = {
+    "NET701": "per-message flushing send in a loop in a drain-granular "
+              "handler where a batch path exists",
+}
+
+#: Handlers whose loops are drain-granular by construction: the batch
+#: boundary the whole run pipeline amortizes over.
+_DRAIN_SEEDS = ("on_drain",)
+
+
+def _drain_closure(cls: ast.ClassDef) -> list:
+    """``on_drain`` plus every same-class helper reachable from it
+    through ``self.X()`` calls."""
+    methods = _methods(cls)
+    seen: set = set()
+    queue = [s for s in _DRAIN_SEEDS if s in methods]
+    out = []
+    while queue:
+        name = queue.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        func = methods[name]
+        out.append(func)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                callee = dotted(node.func)
+                if callee.startswith("self."):
+                    queue.append(callee.split(".", 1)[1])
+    return out
+
+
+def _walk_same_scope(node: ast.AST):
+    """Walk ``node`` without descending into nested function/class
+    definitions (their bodies run in another scope/time)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        yield from _walk_same_scope(child)
+
+
+def _target_names(target: ast.AST) -> set:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def _expr_names(expr: ast.AST) -> set:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def check(project: Project):
+    findings: list = []
+    for mod, cls in _actor_classes(project):
+        for func in _drain_closure(cls):
+            for loop in ast.walk(func):
+                if not isinstance(loop, ast.For):
+                    continue
+                loop_names = _target_names(loop.target)
+                for node in _walk_same_scope(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = dotted(node.func)
+                    if callee == "self.send":
+                        if not node.args:
+                            continue
+                        dst = node.args[0]
+                    elif callee.endswith(".send") \
+                            and isinstance(node.func, ast.Attribute) \
+                            and isinstance(node.func.value, ast.Name) \
+                            and node.func.value.id != "self":
+                        # chan.send(...) on a channel bound outside
+                        # the loop: the destination is the channel.
+                        if node.func.value.id in loop_names:
+                            continue
+                        dst = node.func.value
+                    else:
+                        continue
+                    if _expr_names(dst) & loop_names:
+                        continue  # per-destination fan-out: fine
+                    findings.append(Finding(
+                        rule="NET701", file=mod.path, line=node.lineno,
+                        scope=f"{cls.name}.{func.name}",
+                        detail=callee,
+                        message="per-message flushing send to a fixed "
+                                "destination inside a drain-granular "
+                                "loop: every iteration schedules its "
+                                "own flush -- stage the loop's "
+                                "messages and ship them with "
+                                "Actor.send_batch (or send_no_flush + "
+                                "one flush) so paxwire coalesces them "
+                                "into one batch frame and one writev"))
+    return findings
+
+
+register_rules(RULES, check)

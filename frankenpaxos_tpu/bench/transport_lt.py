@@ -1,0 +1,258 @@
+"""transport_lt: paired A/B of the paxwire batched TcpTransport vs the
+per-frame baseline (docs/TRANSPORT.md).
+
+    python -m frankenpaxos_tpu.bench.transport_lt \
+        --out bench_results/transport_lt.json
+
+Methodology (the multipaxos_lt/overload_lt paired-arm shape, applied
+at the TRANSPORT layer): per in-flight width, the SAME closed-loop
+request/reply workload runs over two real-TCP transport pairs in one
+process --
+
+  * ``per_frame``: ``TcpTransport(batching=False)`` -- the historical
+    path, one encoded frame and one flush per ``send`` (the deployed
+    transport before paxwire);
+  * ``batched``: the default paxwire path -- per-event-loop-pass
+    flushes, batch frames over adjacent same-type payloads, one
+    scatter/gather writev per peer per pass.
+
+The workload is the deployed wire's own message shapes (multipaxos
+ClientRequest -> ClientReply through the registered fixed-layout
+codecs), N pipelined in-flight commands per width, closed loop: every
+reply immediately issues the next request. Both arms pay identical
+codec, delivery, and handler costs; only the frame/flush/syscall layer
+differs -- which is exactly what this artifact measures. Recorded per
+arm: end-to-end cmds/s, syscalls/cmd (the transports' own counters:
+one per writev/write call -- asyncio issues one ``send`` per
+uncongested write), wire frames/cmd, and bytes/drain (batched bytes
+per flush). Widths cover 16..4096; each pair is best-of-``reps`` on a
+fresh transport pair (alternating arm order to split any thermal/GC
+drift).
+
+The committed artifact's gates (ISSUE 8 acceptance):
+  * batched/per_frame throughput >= 2x at every width >= 256;
+  * syscalls/cmd reduced >= 10x at 1024 in-flight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import threading
+import time
+
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ClientReply,
+    ClientRequest,
+    Command,
+    CommandId,
+)
+from frankenpaxos_tpu.runtime import FakeLogger
+from frankenpaxos_tpu.runtime.actor import Actor
+from frankenpaxos_tpu.runtime.logger import LogLevel
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+WIDTHS = (16, 64, 256, 1024, 4096)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _EchoServer(Actor):
+    """Replies per request -- the reply stream is what the batched
+    transport coalesces into batch frames."""
+
+    def receive(self, src, message):
+        self.send(src, ClientReply(
+            command_id=message.command.command_id, slot=0,
+            result=message.command.command))
+
+
+class _LoadClient(Actor):
+    """Closed loop: ``width`` pipelined commands; each reply issues the
+    next request until ``total`` have been acknowledged."""
+
+    def __init__(self, address, transport, logger, server, width,
+                 total):
+        super().__init__(address, transport, logger)
+        self.server = server
+        self.width = width
+        self.total = total
+        self.sent = 0
+        self.acked = 0
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.done = threading.Event()
+
+    def start(self) -> None:
+        def kick():
+            self.t0 = time.perf_counter()
+            for _ in range(min(self.width, self.total)):
+                self._send_next()
+
+        self.transport.loop.call_soon_threadsafe(kick)
+
+    def _send_next(self) -> None:
+        i = self.sent
+        self.sent += 1
+        self.send(self.server, ClientRequest(Command(
+            CommandId(self.address, 0, i), b"w%010d" % i)))
+
+    def receive(self, src, message) -> None:
+        self.acked += 1
+        if self.acked >= self.total:
+            self.t1 = time.perf_counter()
+            self.done.set()
+        elif self.sent < self.total:
+            self._send_next()
+
+
+def run_arm(batching: bool, width: int, total: int) -> dict:
+    logger = FakeLogger(LogLevel.FATAL)
+    server_addr = ("127.0.0.1", _free_port())
+    client_addr = ("127.0.0.1", _free_port())
+    server_t = TcpTransport(server_addr, logger, batching=batching)
+    client_t = TcpTransport(client_addr, logger, batching=batching)
+    server_t.start()
+    client_t.start()
+    try:
+        _EchoServer(server_addr, server_t, logger)
+        client = _LoadClient(client_addr, client_t, logger,
+                             server_addr, width, total)
+        client.start()
+        if not client.done.wait(timeout=120):
+            raise RuntimeError(
+                f"arm wedged: {client.acked}/{total} acked")
+        elapsed = client.t1 - client.t0
+        syscalls = server_t.stat_syscalls + client_t.stat_syscalls
+        frames = server_t.stat_frames + client_t.stat_frames
+        flushes = server_t.stat_flushes + client_t.stat_flushes
+        batch_bytes = (server_t.stat_batch_bytes
+                       + client_t.stat_batch_bytes)
+        return {
+            "batching": batching,
+            "in_flight": width,
+            "num_commands": total,
+            "elapsed_s": elapsed,
+            "cmds_per_s": total / elapsed,
+            "syscalls": syscalls,
+            "syscalls_per_cmd": syscalls / total,
+            "frames": frames,
+            "frames_per_cmd": frames / total,
+            "flushes": flushes,
+            "bytes_per_drain": (batch_bytes / flushes
+                                if batching and flushes else None),
+            "coalesced_acks": (server_t.stat_coalesced_acks
+                               + client_t.stat_coalesced_acks),
+        }
+    finally:
+        server_t.stop()
+        client_t.stop()
+
+
+def run_pair(width: int, total: int, reps: int) -> dict:
+    """Best-of-``reps`` for each arm on fresh transports, order
+    alternated so drift lands on both arms equally."""
+    best: dict = {}
+    for rep in range(reps):
+        arms = (False, True) if rep % 2 == 0 else (True, False)
+        for batching in arms:
+            stats = run_arm(batching, width, total)
+            key = "batched" if batching else "per_frame"
+            if key not in best or stats["cmds_per_s"] \
+                    > best[key]["cmds_per_s"]:
+                best[key] = stats
+    pair = dict(best)
+    pair["throughput_ratio"] = (best["batched"]["cmds_per_s"]
+                                / best["per_frame"]["cmds_per_s"])
+    pair["syscall_reduction"] = (
+        best["per_frame"]["syscalls_per_cmd"]
+        / max(best["batched"]["syscalls_per_cmd"], 1e-12))
+    return pair
+
+
+def evaluate_gates(pairs: dict) -> dict:
+    """The ISSUE 8 acceptance clauses over the measured pairs."""
+    throughput_2x = {
+        str(w): pairs[w]["throughput_ratio"]
+        for w in pairs if w >= 256}
+    syscalls_at_1024 = (pairs[1024]["syscall_reduction"]
+                        if 1024 in pairs else None)
+    return {
+        "throughput_ratio_at_ge_256": throughput_2x,
+        "throughput_2x_passed": all(
+            r >= 2.0 for r in throughput_2x.values()),
+        "syscall_reduction_at_1024": syscalls_at_1024,
+        "syscalls_10x_passed": (syscalls_at_1024 is not None
+                                and syscalls_at_1024 >= 10.0),
+        # The control-never-shed-behind-client-batches clause is a
+        # TEST, not a measurement:
+        # tests/test_paxwire.py::test_outbound_shed_drops_client_lane_before_control
+        # and the native/Python bit-parity clause is
+        # tests/test_native_parity.py.
+        "gate_passed": None,  # filled below
+    }
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser(
+        description="paxwire batched-transport A/B (docs/TRANSPORT.md)")
+    parser.add_argument("--out", default=None,
+                        help="write the JSON artifact here")
+    parser.add_argument("--smoke", action="store_true",
+                        help="reduced widths/commands (~30 s)")
+    parser.add_argument("--reps", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    widths = (16, 256, 1024) if args.smoke else WIDTHS
+    reps = 2 if args.smoke else args.reps
+    pairs: dict = {}
+    for width in widths:
+        total = min(max(width * 30, 2000),
+                    8000 if args.smoke else 40000)
+        pairs[width] = run_pair(width, total, reps)
+        p = pairs[width]
+        print(f"in_flight={width:5d}: per_frame "
+              f"{p['per_frame']['cmds_per_s']:9.0f}/s "
+              f"batched {p['batched']['cmds_per_s']:9.0f}/s "
+              f"ratio {p['throughput_ratio']:.2f}x "
+              f"syscalls/cmd {p['per_frame']['syscalls_per_cmd']:.2f}"
+              f"->{p['batched']['syscalls_per_cmd']:.4f} "
+              f"({p['syscall_reduction']:.0f}x)")
+    gates = evaluate_gates(pairs)
+    gates["gate_passed"] = (gates["throughput_2x_passed"]
+                            and gates["syscalls_10x_passed"])
+    result = {
+        "benchmark": "transport_lt",
+        "methodology": (
+            "paired real-TCP closed-loop A/B in one process "
+            "(multipaxos_lt deployed-points shape at the transport "
+            "layer): per width, the same ClientRequest->ClientReply "
+            "workload over TcpTransport(batching=False) vs the "
+            "paxwire batched default; best-of-reps per arm on fresh "
+            "transports, arm order alternated. syscalls = the "
+            "transports' writev/write counters (one asyncio send per "
+            "uncongested write); bytes_per_drain = batched bytes per "
+            "flush pass."),
+        "smoke": bool(args.smoke),
+        "reps": reps,
+        "pairs": {str(w): pairs[w] for w in sorted(pairs)},
+        "gates": gates,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    print(f"gate_passed={gates['gate_passed']}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,13 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fpx_scan_frames.restype = ctypes.c_longlong
         lib.fpx_scan_frames.argtypes = [
             u8p, ctypes.c_uint64, u64p, ctypes.c_uint32, u64p]
+        lib.fpx_batch_header.restype = ctypes.c_longlong
+        lib.fpx_batch_header.argtypes = [
+            ctypes.c_uint8, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, u8p, ctypes.c_uint64]
+        lib.fpx_scan_batch.restype = ctypes.c_longlong
+        lib.fpx_scan_batch.argtypes = [
+            u8p, ctypes.c_uint64, u64p, ctypes.c_uint32]
         lib.fpx_pack_votes.restype = ctypes.c_longlong
         lib.fpx_pack_votes.argtypes = [
             i32p, i32p, i32p, ctypes.c_uint32, u8p, ctypes.c_uint64]
@@ -77,6 +84,35 @@ def load() -> Optional[ctypes.CDLL]:
 def _as_u8p(buf) -> ctypes.POINTER(ctypes.c_uint8):  # type: ignore[misc]
     return (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) if buf else \
         ctypes.cast(0, ctypes.POINTER(ctypes.c_uint8))
+
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _as_u8p_view(buf, offset: int = 0):
+    """READ-ONLY pointer to ``buf[offset:]`` WITHOUT copying the buffer
+    (the `_as_u8p` copy was the receive path's quadratic cost: every
+    4096-frame scan pass re-copied the whole inbound buffer). Returns
+    ``(pointer, keepalive)`` -- the caller must hold ``keepalive`` for
+    the duration of the native call and drop it before mutating ``buf``
+    (a live ``from_buffer`` export makes ``bytearray`` resizes raise
+    BufferError)."""
+    n = len(buf) - offset
+    if n <= 0:
+        return ctypes.cast(0, _U8P), None
+    if isinstance(buf, (bytearray, memoryview)):
+        # The ARRAY OBJECT itself is the pointer argument (ctypes
+        # accepts arrays where POINTER(c_uint8) is declared). Never
+        # ``ctypes.cast`` it: the cast pointer participates in a
+        # reference cycle, so the buffer export would survive until a
+        # gc pass and any bytearray resize in between would raise
+        # BufferError. Dropping the array releases it immediately.
+        arr = (ctypes.c_uint8 * n).from_buffer(buf, offset)
+        return arr, arr
+    # bytes (immutable): c_char_p points at the object's internal
+    # storage; no copy, kept alive by holding the bytes object itself.
+    base = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value
+    return ctypes.cast(ctypes.c_void_p(base + offset), _U8P), buf
 
 
 def encode_frame(header: bytes, payload: bytes) -> bytes:
@@ -111,27 +147,116 @@ def encode_frames(header: bytes, payloads: list[bytes]) -> bytes:
     return bytes(out[:n])
 
 
-def scan_frames(buf: bytes, max_frames: int = 4096
+def scan_frames(buf, max_frames: int = 4096, offset: int = 0
                 ) -> tuple[list[tuple[int, int]], int]:
-    """Complete frames' (start, end) inner offsets + consumed bytes."""
+    """Complete frames' (start, end) inner offsets + consumed cursor.
+
+    ``buf`` may be bytes, bytearray, or a memoryview; the scan starts
+    at ``offset`` and NEVER copies the buffer (the transport keeps an
+    offset cursor into its growing inbound bytearray instead of
+    re-slicing per pass). Returned offsets and the consumed cursor are
+    ABSOLUTE positions in ``buf``."""
     lib = load()
     if lib is None:
-        frames, pos = [], 0
-        while pos + 4 <= len(buf):
+        frames, pos, end = [], offset, len(buf)
+        while pos + 4 <= end and len(frames) < max_frames:
             (inner,) = _LEN.unpack_from(buf, pos)
-            if pos + 4 + inner > len(buf):
+            if inner > 10 * 1024 * 1024:
+                raise ValueError("frame exceeds the 10 MiB cap")
+            if pos + 4 + inner > end:
                 break
             frames.append((pos + 4, pos + 4 + inner))
             pos += 4 + inner
         return frames, pos
     offsets = (ctypes.c_uint64 * (2 * max_frames))()
     consumed = ctypes.c_uint64()
-    n = lib.fpx_scan_frames(_as_u8p(buf), len(buf), offsets, max_frames,
-                            ctypes.byref(consumed))
+    ptr, keepalive = _as_u8p_view(buf, offset)
+    try:
+        n = lib.fpx_scan_frames(ptr, len(buf) - offset, offsets,
+                                max_frames, ctypes.byref(consumed))
+    finally:
+        del ptr, keepalive  # release the buffer export before returning
     if n == -2:
         raise ValueError("frame exceeds the 10 MiB cap")
-    return ([(offsets[2 * i], offsets[2 * i + 1]) for i in range(n)],
-            consumed.value)
+    return ([(offset + offsets[2 * i], offset + offsets[2 * i + 1])
+             for i in range(n)],
+            offset + consumed.value)
+
+
+# --- paxwire batch frames ---------------------------------------------------
+# One batch frame carries a whole drain's same-type messages to a peer:
+#   [0x00][batch tag - 128][u32le count][count * u32le seg_len][segments]
+# The header (everything before the segments) is built in ONE native
+# call; the segments ride as raw scatter/gather slices (sendmsg) or one
+# join -- either way the bytes on the wire are identical.
+
+_U32LE = struct.Struct("<I")
+
+
+def batch_header(tag: int, seg_lens) -> bytes:
+    """The batch payload header for extended-page wire ``tag`` over
+    segments of the given lengths (the vectorized encode: one dispatch
+    per drain's batch, not one struct.pack per message)."""
+    n = len(seg_lens)
+    lib = load()
+    if lib is None:
+        out = bytearray(2 + 4 + 4 * n)
+        out[0] = 0
+        out[1] = tag - 128
+        _U32LE.pack_into(out, 2, n)
+        pos = 6
+        for seg_len in seg_lens:
+            _U32LE.pack_into(out, pos, seg_len)
+            pos += 4
+        return bytes(out)
+    lens = (ctypes.c_uint32 * n)(*seg_lens)
+    out = (ctypes.c_uint8 * (6 + 4 * n))()
+    written = lib.fpx_batch_header(tag - 128, lens, n, out, len(out))
+    assert written == len(out)
+    return bytes(out)
+
+
+def scan_batch(buf, at: int, max_segs: int = 1 << 20
+               ) -> list[tuple[int, int]]:
+    """Segment (start, end) offsets of a batch payload whose u32 count
+    sits at ``buf[at:]`` (the two leading tag bytes already consumed).
+    Raises ValueError on a malformed table -- the containment channel
+    for torn/corrupt batch frames (count or lengths exceeding the
+    payload, trailing garbage)."""
+    lib = load()
+    n_left = len(buf) - at
+    if lib is None:
+        if n_left < 4:
+            raise ValueError("malformed batch frame: short count header")
+        (n,) = _U32LE.unpack_from(buf, at)
+        if n > max_segs or 4 + 4 * n > n_left:
+            raise ValueError(
+                f"malformed batch frame: count {n} exceeds payload")
+        pos = at + 4 + 4 * n
+        segs = []
+        for i in range(n):
+            (seg_len,) = _U32LE.unpack_from(buf, at + 4 + 4 * i)
+            if pos + seg_len > len(buf):
+                raise ValueError(
+                    "malformed batch frame: segment overruns payload")
+            segs.append((pos, pos + seg_len))
+            pos += seg_len
+        if pos != len(buf):
+            raise ValueError("malformed batch frame: trailing garbage")
+        return segs
+    # Cap the offsets table by what the payload could possibly hold so
+    # a hostile count can never size a huge allocation.
+    cap = min(max_segs, max(n_left // 4, 1))
+    offsets = (ctypes.c_uint64 * (2 * cap))()
+    ptr, keepalive = _as_u8p_view(buf, at)
+    try:
+        n = lib.fpx_scan_batch(ptr, n_left, offsets, cap)
+    finally:
+        del ptr, keepalive
+    if n < 0:
+        raise ValueError("malformed batch frame")
+    return [(at + offsets[2 * i], at + offsets[2 * i + 1])
+            for i in range(n)]
 
 
 def pack_votes(slots: np.ndarray, nodes: np.ndarray,

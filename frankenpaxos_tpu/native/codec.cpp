@@ -101,6 +101,57 @@ long long fpx_scan_frames(const uint8_t* buf, uint64_t len,
   return found;
 }
 
+// --- paxwire batch frames ---------------------------------------------------
+// A batch frame coalesces a drain's same-type messages to one peer into
+// ONE wire frame. Its payload is
+//   [0x00][batch tag - 128][u32le count][count * u32le seg_len][segments]
+// (the leading two bytes are a normal extended-page wire tag, so the
+// frame-layer lane classifier in serve/lanes.py reads batch frames like
+// any other codec'd message -- no decode needed to shed or spare them).
+// The segments are the messages' ordinary wire payloads, copied raw: a
+// run/reply-array whose value bytes are LazyValueArray segments is
+// batched without ever re-materializing a value.
+
+// Write the batch payload HEADER (escape, tag byte, count, lens) in one
+// call -- the vectorized replacement for count * struct.pack on the hot
+// flush path. Returns bytes written or -1 if out_cap is too small.
+long long fpx_batch_header(uint8_t tag_byte, const uint32_t* seg_lens,
+                           uint32_t n, uint8_t* out, uint64_t out_cap) {
+  const uint64_t total = 2ull + 4ull + 4ull * n;
+  if (total > out_cap) return -1;
+  out[0] = 0;  // extended-page escape
+  out[1] = tag_byte;
+  std::memcpy(out + 2, &n, 4);  // little-endian like every codec field
+  std::memcpy(out + 6, seg_lens, 4ull * n);
+  return static_cast<long long>(total);
+}
+
+// Scan a batch payload's segment table. `buf` points AT the u32 count
+// (the 0x00 + tag bytes already consumed); writes (start, end) offsets
+// relative to `buf` into `offsets` (2 per segment). Returns the segment
+// count, or -1 if the table is malformed (count/lens exceeding `len` --
+// the containment contract: a torn or hostile batch frame must fail
+// validation here, before any consumer trusts a length).
+long long fpx_scan_batch(const uint8_t* buf, uint64_t len,
+                         uint64_t* offsets, uint32_t max_segs) {
+  if (len < 4) return -1;
+  uint32_t n;
+  std::memcpy(&n, buf, 4);
+  if (n > max_segs) return -1;
+  if (4ull + 4ull * n > len) return -1;
+  uint64_t at = 4ull + 4ull * n;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t seg_len;
+    std::memcpy(&seg_len, buf + 4 + 4ull * i, 4);
+    if (at + seg_len > len) return -1;
+    offsets[2 * i] = at;
+    offsets[2 * i + 1] = at + seg_len;
+    at += seg_len;
+  }
+  if (at != len) return -1;  // trailing garbage = torn/corrupt frame
+  return n;
+}
+
 // --- Phase2b vote-batch codec ---------------------------------------------
 // Wire layout: [u32 count][count * (i32 slot, i32 node, i32 round)] with
 // little-endian fixed-width ints (the host side hands these straight to

@@ -174,8 +174,30 @@ class RuntimeMetrics:
             labels=("role",)).labels(role)
         self._outbuf_stalls = collectors.counter(
             "fpx_runtime_outbound_stalls_total",
-            help="Outbound buffer overflows (oldest frames dropped; "
-                 "protocol resends cover)",
+            help="Outbound buffer overflows (oldest frames dropped, "
+                 "client lane first; protocol resends cover)",
+            labels=("role",)).labels(role)
+        # paxwire (runtime/paxwire.py + docs/TRANSPORT.md): the batched
+        # transport's health triple -- how many wire frames each writev
+        # carried, how many Phase2b acks the flush-time coalescers
+        # merged away, and how many bytes left through batched flushes.
+        self._transport_fpw = collectors.gauge(
+            "fpx_runtime_transport_frames_per_writev",
+            help="Wire frames carried by the most recent batched "
+                 "flush (writev)",
+            labels=("role",)).labels(role)
+        self._transport_coalesced = collectors.counter(
+            "fpx_runtime_transport_coalesced_acks_total",
+            help="Phase2b/ack messages merged into run-granular ack "
+                 "ranges by the flush-time coalescers",
+            labels=("role",)).labels(role)
+        # (Named without the counter-conventional _total suffix: the
+        # metric name is part of the paxwire metrics contract
+        # (docs/TRANSPORT.md) and the generated dashboards chart it
+        # verbatim.)
+        self._transport_batch_bytes = collectors.counter(
+            "fpx_runtime_transport_batch_bytes",
+            help="Bytes sent through the batched (paxwire) flush path",
             labels=("role",)).labels(role)
         self._adm_rejected_children: dict = {}
         self._adm_shed_children: dict = {}
@@ -230,6 +252,14 @@ class RuntimeMetrics:
 
     def outbound_stall(self, n: int = 1) -> None:
         self._outbuf_stalls.inc(n)
+
+    # --- paxwire batched transport (runtime/paxwire.py) -----------------
+    def transport_flush(self, frames: int, nbytes: int) -> None:
+        self._transport_fpw.set(frames)
+        self._transport_batch_bytes.inc(nbytes)
+
+    def transport_coalesced_acks(self, n: int) -> None:
+        self._transport_coalesced.inc(n)
 
 
 class _Scope:

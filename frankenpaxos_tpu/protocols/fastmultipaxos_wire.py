@@ -168,7 +168,25 @@ class FMPValueChosenCodec(MessageCodec):
         return fmp.ValueChosen(slot=slot, value=value), at
 
 
+class FMPPhase1bNackCodec(MessageCodec):
+    """Round-race feedback on the fast path (COD301 burn-down, paxwire
+    extended tag page): per-failover, but a failover storm is when the
+    wire is busiest."""
+
+    message_type = fmp.Phase1bNack
+    tag = 157
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.acceptor_id, message.round)
+
+    def decode(self, buf, at):
+        acceptor_id, round = _I64I64.unpack_from(buf, at)
+        return fmp.Phase1bNack(acceptor_id=acceptor_id,
+                               round=round), at + 16
+
+
 for _codec in (FMPProposeRequestCodec(), FMPProposeReplyCodec(),
                FMPPhase2aCodec(), FMPPhase2bCodec(),
-               FMPPhase2bBufferCodec(), FMPValueChosenCodec()):
+               FMPPhase2bBufferCodec(), FMPValueChosenCodec(),
+               FMPPhase1bNackCodec()):
     register_codec(_codec)

@@ -18,6 +18,7 @@ process-wide; both sides of every channel share the schema.
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 
 from frankenpaxos_tpu.protocols.multipaxos.messages import (
@@ -43,10 +44,14 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     LeaderInfoRequestClient,
     MaxSlotReply,
     MaxSlotRequest,
+    Nack,
     NOOP,
     Noop,
     NotLeaderBatcher,
     NotLeaderClient,
+    Phase1a,
+    Phase1b,
+    Phase1bSlotInfo,
     Phase2a,
     Phase2aRun,
     Phase2b,
@@ -56,6 +61,7 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     ReadReplyBatch,
     ReadRequest,
     ReadRequestBatch,
+    Recover,
     SequentialReadRequest,
     SequentialReadRequestBatch,
 )
@@ -835,6 +841,225 @@ class LeaderInfoReplyBatcherCodec(MessageCodec):
         return LeaderInfoReplyBatcher(round=round), at + 8
 
 
+# --- paxwire ack coalescing (tag 152) ---------------------------------------
+# A drain's per-message Phase2b stream from one acceptor to one proxy
+# leader merges into ONE frame of run-granular ack ranges at the
+# TRANSPORT's flush (runtime/paxwire.py coalescer registry): 25 bytes
+# per ack become ~32 bytes per contiguous RUN. Receivers expand the
+# batch back into the messages the ProxyLeader already handles --
+# width-1 entries as plain Phase2b (its never-sent-a-Phase2a tripwire
+# stays armed), wider runs as Phase2bRange.
+
+_ACK_RANGE = struct.Struct("<qqqii")  # start, end, round, group, acceptor
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase2bAckBatch:
+    """Coalesced Phase2b acks: (start, end, round, group, acceptor)
+    runs, in first-ack order."""
+
+    ranges: tuple
+
+    def __wire_expand__(self, serializer):
+        for start, end, round, group, acceptor in self.ranges:
+            if end - start == 1:
+                yield Phase2b(group_index=group, acceptor_index=acceptor,
+                              slot=start, round=round)
+            else:
+                yield Phase2bRange(group_index=group,
+                                   acceptor_index=acceptor,
+                                   slot_start_inclusive=start,
+                                   slot_end_exclusive=end, round=round)
+
+
+class Phase2bAckBatchCodec(MessageCodec):
+    message_type = Phase2bAckBatch
+    tag = 152
+    # Encoded by the transport's flush-time coalescer, decoded and
+    # expanded by the transport -- no role send site (paxflow FLOW403
+    # skips transport_layer codecs).
+    transport_layer = True
+
+    def encode(self, out, message):
+        out += _I32.pack(len(message.ranges))
+        for entry in message.ranges:
+            out += _ACK_RANGE.pack(*entry)
+
+    def decode(self, buf, at):
+        (n,) = _I32.unpack_from(buf, at)
+        at += 4
+        if n < 0 or at + n * _ACK_RANGE.size > len(buf):
+            raise ValueError(
+                f"malformed ack batch: count {n} exceeds payload")
+        ranges = []
+        for _ in range(n):
+            ranges.append(_ACK_RANGE.unpack_from(buf, at))
+            at += _ACK_RANGE.size
+        return Phase2bAckBatch(ranges=tuple(ranges)), at
+
+
+def _coalesce_phase2b(payloads: list):
+    """paxwire coalescer for runs of tag-1 (Phase2b) payloads: merge
+    slot-contiguous same-(round, group, acceptor) acks into ranges.
+    Acks are commutative on the quorum trackers, so reordering inside
+    the run is safe. Returns None (decline -> generic batch frame) on
+    any unexpected layout."""
+    acks = []
+    for payload in payloads:
+        if len(payload) != 25 or payload[0] != Phase2bCodec.tag:
+            return None
+        acks.append(_QQII.unpack_from(payload, 1))
+    # Sort by (round, group, acceptor, slot); emit contiguous runs.
+    acks.sort(key=lambda a: (a[1], a[2], a[3], a[0]))
+    ranges = []
+    for slot, round, group, acceptor in acks:
+        if ranges:
+            start, end, pround, pgroup, pacceptor = ranges[-1]
+            if (pround, pgroup, pacceptor) == (round, group, acceptor):
+                if slot == end:
+                    ranges[-1] = (start, end + 1, pround, pgroup,
+                                  pacceptor)
+                    continue
+                if slot < end:  # duplicate ack; keep it a lone entry
+                    ranges.append((slot, slot + 1, round, group,
+                                   acceptor))
+                    continue
+        ranges.append((slot, slot + 1, round, group, acceptor))
+    out = bytearray((0, Phase2bAckBatchCodec.tag - 128))
+    Phase2bAckBatchCodec().encode(
+        out, Phase2bAckBatch(ranges=tuple(ranges)))
+    return bytes(out)
+
+
+def _register_coalescers() -> None:
+    from frankenpaxos_tpu.runtime import paxwire
+
+    paxwire.register_coalescer(Phase2bCodec.tag, _coalesce_phase2b)
+
+
+# --- cold-path codecs (COD301 burn-down, extended tags 153-156) -------------
+# The failover path: Phase1a/Phase1b/Nack/Recover are per-leader-change
+# rather than per-command, but a failover STORM is exactly when the
+# wire is busiest -- and the paxwire batch encoder can only vectorize
+# messages with fixed layouts.
+
+
+class Phase1aCodec(MessageCodec):
+    message_type = Phase1a
+    tag = 153
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.round, message.chosen_watermark)
+
+    def decode(self, buf, at):
+        round, watermark = _I64I64.unpack_from(buf, at)
+        return Phase1a(round=round, chosen_watermark=watermark), at + 16
+
+
+def _put_vote_value(out: bytearray, value) -> None:
+    """A Phase1b vote value: the ordinary CommandBatchOrNoop layout
+    (kinds 0/1), with a pickled escape hatch (kind 2) for the exotic
+    values sim harnesses store in acceptors (the same trade-off as the
+    address escape hatch; Phase1b is per-failover, never hot)."""
+    if isinstance(value, Noop):
+        out.append(0)
+        return
+    if isinstance(value, CommandBatch):
+        tmp = bytearray()
+        try:
+            _put_value(tmp, value)
+        except (AttributeError, TypeError, struct.error):
+            pass  # toy commands: fall through to the escape hatch
+        else:
+            out += tmp
+            return
+    from frankenpaxos_tpu.runtime import serializer
+
+    out.append(2)
+    _put_bytes(out, serializer.guarded_pickle_dumps(
+        value, "phase1b vote value"))
+
+
+def _take_vote_value(buf: bytes, at: int):
+    if buf[at] == 2:
+        from frankenpaxos_tpu.runtime import serializer
+
+        raw, at = _take_bytes(buf, at + 1)
+        return serializer.guarded_pickle_loads(
+            bytes(raw), "phase1b vote value"), at
+    return _take_value(buf, at)
+
+
+class Phase1bCodec(MessageCodec):
+    """Votes ride (slot, vote_round, value) entries; discovered epochs
+    ride as length-prefixed sub-frames through the serializer (the
+    reconfig EpochCommit codec, tag 129)."""
+
+    message_type = Phase1b
+    tag = 154
+
+    def encode(self, out, message):
+        from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+        out += _I32.pack(message.group_index)
+        out += _I32.pack(message.acceptor_index)
+        out += _I64.pack(message.round)
+        out += _I32.pack(len(message.info))
+        for info in message.info:
+            out += _I64I64.pack(info.slot, info.vote_round)
+            _put_vote_value(out, info.vote_value)
+        out += _I32.pack(len(message.epochs))
+        for epoch in message.epochs:
+            _put_bytes(out, DEFAULT_SERIALIZER.to_bytes(epoch))
+
+    def decode(self, buf, at):
+        from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+        group, acceptor = _I32I32.unpack_from(buf, at)
+        (round,) = _I64.unpack_from(buf, at + 8)
+        (n,) = _I32.unpack_from(buf, at + 16)
+        at += 20
+        info = []
+        for _ in range(n):
+            slot, vote_round = _I64I64.unpack_from(buf, at)
+            value, at = _take_vote_value(buf, at + 16)
+            info.append(Phase1bSlotInfo(slot=slot, vote_round=vote_round,
+                                        vote_value=value))
+        (k,) = _I32.unpack_from(buf, at)
+        at += 4
+        epochs = []
+        for _ in range(k):
+            raw, at = _take_bytes(buf, at)
+            epochs.append(DEFAULT_SERIALIZER.from_bytes(bytes(raw)))
+        return Phase1b(group_index=group, acceptor_index=acceptor,
+                       round=round, info=tuple(info),
+                       epochs=tuple(epochs)), at
+
+
+class NackCodec(MessageCodec):
+    message_type = Nack
+    tag = 155
+
+    def encode(self, out, message):
+        out += _I64.pack(message.round)
+
+    def decode(self, buf, at):
+        (round,) = _I64.unpack_from(buf, at)
+        return Nack(round=round), at + 8
+
+
+class RecoverCodec(MessageCodec):
+    message_type = Recover
+    tag = 156
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        return Recover(slot=slot), at + 8
+
+
 for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                ClientRequestCodec(), ClientRequestBatchCodec(),
                ClientReplyCodec(), ChosenWatermarkCodec(),
@@ -851,5 +1076,9 @@ for _codec in (Phase2bCodec(), Phase2aCodec(), ChosenCodec(),
                NotLeaderClientCodec(), LeaderInfoRequestClientCodec(),
                LeaderInfoReplyClientCodec(), NotLeaderBatcherCodec(),
                LeaderInfoRequestBatcherCodec(),
-               LeaderInfoReplyBatcherCodec()):
+               LeaderInfoReplyBatcherCodec(), Phase2bAckBatchCodec(),
+               Phase1aCodec(), Phase1bCodec(), NackCodec(),
+               RecoverCodec()):
     register_codec(_codec)
+
+_register_coalescers()

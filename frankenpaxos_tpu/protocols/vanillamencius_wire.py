@@ -144,6 +144,39 @@ class VMClientReplyCodec(MessageCodec):
 
 
 
+class VMPhase1NackCodec(MessageCodec):
+    """Revocation-race feedback (COD301 burn-down, paxwire extended tag
+    page): per-revocation rather than per-command, but revocation
+    storms ride the same congested wire as the commands that caused
+    them."""
+
+    message_type = vm.Phase1Nack
+    tag = 158
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.start_slot_inclusive,
+                         message.stop_slot_exclusive, message.round)
+
+    def decode(self, buf, at):
+        start, stop, round = _QQQ.unpack_from(buf, at)
+        return vm.Phase1Nack(start_slot_inclusive=start,
+                             stop_slot_exclusive=stop,
+                             round=round), at + _QQQ.size
+
+
+class VMPhase2NackCodec(MessageCodec):
+    message_type = vm.Phase2Nack
+    tag = 159
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.slot, message.round)
+
+    def decode(self, buf, at):
+        slot, round = _I64I64.unpack_from(buf, at)
+        return vm.Phase2Nack(slot=slot, round=round), at + 16
+
+
 for _codec in (VMClientRequestCodec(), VMPhase2aCodec(), VMSkipCodec(),
-               VMPhase2bCodec(), VMChosenCodec(), VMClientReplyCodec()):
+               VMPhase2bCodec(), VMChosenCodec(), VMClientReplyCodec(),
+               VMPhase1NackCodec(), VMPhase2NackCodec()):
     register_codec(_codec)

@@ -21,23 +21,52 @@ Addresses are ``(host, port)`` tuples. Each frame is prefixed by the
 sender's address (so the receiving actor sees a meaningful ``src``),
 mirroring the reference where inbound connections learn the remote actor
 address from the channel.
+
+paxwire (docs/TRANSPORT.md): with ``batching=True`` (the default) the
+send path is DRAIN-GRANULAR -- ``send`` queues ``(header, payload)``
+entries and one flush per event-loop pass turns a connection's backlog
+into batch frames (adjacent same-type messages -> one frame, Phase2b
+ack streams -> run-granular ack ranges via registered coalescers) and
+pushes the whole thing out with ONE ``socket.sendmsg`` scatter/gather
+writev over the original payload bytes -- no per-frame encode, no
+per-frame ``bytes`` join, no per-message syscall. ``batching=False``
+preserves the historical frame-per-message path (the A/B baseline arm
+in ``bench/transport_lt.py``). The receive path scans the inbound
+buffer over an offset cursor (no re-copy per scan pass) and expands
+batch frames back into their original messages before delivery, so
+actors, admission, and tracing see per-message semantics unchanged.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import struct
 import threading
 import time
 from typing import Callable, Optional
 
 from frankenpaxos_tpu.obs.trace import TraceContext
+from frankenpaxos_tpu.runtime import paxwire
 from frankenpaxos_tpu.runtime.actor import Actor
 from frankenpaxos_tpu.runtime.logger import Logger, PrintLogger
 from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
 
 MAX_FRAME = 10 * 1024 * 1024  # 10 MiB, like the reference's frame decoder
 _LEN = struct.Struct(">I")
+
+_frame_lane_fn = None
+
+
+def _get_frame_lane():
+    """serve.lanes.frame_lane, lazily bound once (serve imports at
+    module scope would cycle; a per-send module import would cost a
+    sys.modules lookup on the hot path)."""
+    global _frame_lane_fn
+    if _frame_lane_fn is None:
+        from frankenpaxos_tpu.serve.lanes import frame_lane
+        _frame_lane_fn = frame_lane
+    return _frame_lane_fn
 
 
 def _encode_frame(src: Address, data: bytes,
@@ -106,12 +135,23 @@ class _Conn:
     """One outbound connection with lazy connect + pending buffer
     (NettyTcpTransport.scala:377-445). The buffer is BOUNDED
     (paxload): a slow or dead peer must not grow it without limit --
-    past the cap the oldest frames drop (at-most-once transport;
-    protocol resends cover) and the stall is counted."""
+    past the cap pending entries drop client-lane-oldest-first (the
+    control plane is never shed behind client batches; at-most-once
+    transport, protocol resends cover) and the stall is counted.
+
+    ``pending`` holds ``(header, payload, lane, size)`` entries: the
+    frame header bytes, the message payload bytes (frame assembly is
+    deferred to the flush's batch planner), the frame lane for shed
+    priority, and the entry's accounted wire size. The legacy
+    per-frame arm (``batching=False``) stores the fully encoded frame
+    in ``payload`` with ``header=None``."""
+
+    __slots__ = ("writer", "pending", "pending_bytes", "hwm_reported",
+                 "connecting", "header0", "headers")
 
     def __init__(self):
         self.writer: Optional[asyncio.StreamWriter] = None
-        self.pending: list[bytes] = []
+        self.pending: list = []
         self.pending_bytes = 0
         # Largest pending_bytes already pushed to the HWM gauge: the
         # gauge (a mutex-protected prometheus read+set) is only touched
@@ -119,6 +159,12 @@ class _Conn:
         # per-frame cost to one int compare.
         self.hwm_reported = 0
         self.connecting = False
+        # Encoded frame headers, cached per connection: the no-context
+        # header (the common case) directly, traced headers by context
+        # -- the per-send f-string format + encode was measurable at
+        # batched rates.
+        self.header0: Optional[bytes] = None
+        self.headers: dict = {}
 
 
 class TcpTransport(Transport):
@@ -134,15 +180,41 @@ class TcpTransport(Transport):
     #: enough that only a genuinely wedged/slow peer ever hits it.
     outbound_buffer_cap = 16 * 1024 * 1024
 
+    #: Use ``socket.sendmsg`` scatter/gather output when the platform
+    #: and the asyncio transport allow it (class-level so tests can
+    #: force the contiguous-write fallback and assert bit-identity).
+    use_sendmsg = True
+
     def __init__(self, listen_address: Optional[Address] = None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 batching: bool = True):
         self.logger = logger or PrintLogger()
         self.listen_address = listen_address
+        #: paxwire drain-granular batching; False = the historical
+        #: frame-per-message path (the transport_lt baseline arm).
+        self.batching = batching
         self.actors: dict[Address, Actor] = {}
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._conns: dict[tuple[Address, Address], _Conn] = {}
         self._servers: dict[Address, asyncio.AbstractServer] = {}
         self._drain_scheduled: set = set()
+        # Connections with unflushed sends this event-loop pass; one
+        # call_soon drains them all (_flush_pass) so every message a
+        # drain produces rides one writev per peer.
+        self._flush_queue: list = []
+        self._flush_dirty: set = set()
+        self._flush_scheduled = False
+        # Transport counters (the transport_lt A/B instruments these;
+        # /metrics exports them when runtime_metrics is attached).
+        # "syscalls" counts our sendmsg calls plus writer.write calls
+        # (asyncio issues one send per uncongested write) -- the
+        # syscalls/cmd proxy the A/B gate records.
+        self.stat_syscalls = 0
+        self.stat_flushes = 0
+        self.stat_frames = 0
+        self.stat_messages = 0
+        self.stat_batch_bytes = 0
+        self.stat_coalesced_acks = 0
         self._batch_depth: dict = {}  # messages in the current drain
         # CLIENT-lane messages in the current drain batch -- the
         # bounded-inbox measure (serve/lanes.py): only client frames
@@ -213,10 +285,16 @@ class TcpTransport(Transport):
         # fpx_scan_frames) instead of two awaits per frame: a burst of
         # small frames costs ONE read syscall and one scan, and every
         # complete frame in the chunk dispatches in the same loop pass
-        # (so they land in one actor drain; see _deliver).
+        # (so they land in one actor drain; see _deliver). The scan
+        # rides an OFFSET CURSOR into the growing bytearray: the old
+        # ``scan_frames(bytes(buf))`` re-copied the whole inbound
+        # buffer every 4096-frame pass (quadratic on deep backlogs),
+        # and the per-pass ``del buf[:consumed]`` memmoved the tail the
+        # same way -- now the prefix compacts only when it is large.
         from frankenpaxos_tpu import native
 
         buf = bytearray()
+        pos = 0  # buf[:pos] is already dispatched
         try:
             while True:
                 chunk = await reader.read(1 << 16)
@@ -234,83 +312,102 @@ class TcpTransport(Transport):
                 # native scanner caps one pass at 4096 frames -- a
                 # single pass over a deeper backlog would strand the
                 # remainder until the peer happened to send more.
-                while len(buf) >= 4:
-                    (inner,) = _LEN.unpack_from(buf, 0)
+                while len(buf) - pos >= 4:
+                    (inner,) = _LEN.unpack_from(buf, pos)
                     if inner > MAX_FRAME:
                         self.logger.error(
                             f"oversized frame ({inner} bytes)")
                         return
-                    if len(buf) < 4 + inner:
+                    if len(buf) - pos < 4 + inner:
                         break
                     try:
-                        frames, consumed = native.scan_frames(bytes(buf))
+                        frames, pos = native.scan_frames(buf, offset=pos)
                     except ValueError as e:  # a mid-buffer oversized frame
                         self.logger.error(str(e))
                         return
                     for start, end in frames:
-                        # A corrupt frame (bad header length, non-UTF8
-                        # header, malformed port, message decode error)
-                        # must not kill the connection task with an
-                        # unretrieved exception: log it and drop the
-                        # connection cleanly. Only parse/decode runs
-                        # under this guard -- exceptions from the
-                        # actor's own receive() on a VALID frame are a
-                        # different failure class and propagate (a
-                        # FatalError from logger.fatal must stay fatal,
-                        # matching the reference's crash-the-process
-                        # check semantics, Logger.scala:62-117).
-                        try:
-                            (hlen,) = _LEN.unpack_from(buf, start)
-                            if hlen > end - start - 4:
-                                raise ValueError(
-                                    f"header length {hlen} exceeds frame "
-                                    f"payload {end - start - 4}")
-                            header = bytes(
-                                buf[start + 4:start + 4 + hlen]).decode()
-                            # paxtrace: ``host:port|<ctx>`` -- the
-                            # address part first, then the optional
-                            # frame-layer trace context.
-                            addr_part, _, trace_part = header.partition(
-                                "|")
-                            host, _, port = addr_part.rpartition(":")
-                            src: Address = (host, int(port))
-                            ctx = (TraceContext.decode(trace_part)
-                                   if trace_part else None)
-                            data = bytes(buf[start + 4 + hlen:end])
-                            tracer = self.tracer
-                            metrics = self.runtime_metrics
-                            if tracer is not None and ctx is not None \
-                                    and ctx.sampled:
-                                m0 = tracer.mono()
-                                delivery = self._decode(local, src, data)
-                                if delivery is not None:
-                                    tracer.record_stage("decode", m0,
-                                                        ctx)
-                            elif metrics is not None:
-                                # Unsampled (or context-less) frame
-                                # with /metrics on: the drain-stage
-                                # histogram still sees EVERY decode --
-                                # sampling must not starve it.
-                                p0 = time.perf_counter()
-                                delivery = self._decode(local, src, data)
-                                if delivery is not None:
-                                    metrics.observe_stage(
-                                        "decode",
-                                        time.perf_counter() - p0)
-                            else:
-                                delivery = self._decode(local, src, data)
-                        except Exception as e:
-                            self.logger.error(
-                                f"dropping connection on corrupt frame: "
-                                f"{e!r}")
+                        if not self._dispatch_frame(buf, start, end,
+                                                    local):
                             return
-                        if delivery is not None:
-                            self._deliver(*delivery, ctx)
-                    del buf[:consumed]
+                # Compact the dispatched prefix only when it is big
+                # enough to matter (or the buffer is fully consumed):
+                # each del memmoves the tail, so doing it per pass is
+                # the quadratic copy this cursor exists to avoid.
+                if pos and (pos >= len(buf) or pos >= (1 << 18)):
+                    del buf[:pos]
+                    pos = 0
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
+
+    def _dispatch_frame(self, buf: bytearray, start: int, end: int,
+                        local: Address) -> bool:
+        """Parse, decode, and deliver one wire frame (batch frames
+        expand to their segments). False = corrupt frame, drop the
+        connection.
+
+        A corrupt frame (bad header length, non-UTF8 header, malformed
+        port, message decode error, torn batch table) must not kill
+        the connection task with an unretrieved exception: log it and
+        drop the connection cleanly. Only parse/decode runs under the
+        corrupt-frame guard -- exceptions from the actor's own
+        ``receive()`` on a VALID frame are a different failure class
+        and propagate (a FatalError from logger.fatal must stay fatal,
+        matching the reference's crash-the-process check semantics,
+        Logger.scala:62-117)."""
+        try:
+            (hlen,) = _LEN.unpack_from(buf, start)
+            if hlen > end - start - 4:
+                raise ValueError(
+                    f"header length {hlen} exceeds frame "
+                    f"payload {end - start - 4}")
+            header = bytes(
+                buf[start + 4:start + 4 + hlen]).decode()
+            # paxtrace: ``host:port|<ctx>`` -- the address part first,
+            # then the optional frame-layer trace context. On a batch
+            # frame this ONE header (context included) covers every
+            # expanded segment.
+            addr_part, _, trace_part = header.partition("|")
+            host, _, port = addr_part.rpartition(":")
+            src: Address = (host, int(port))
+            ctx = (TraceContext.decode(trace_part)
+                   if trace_part else None)
+            data = bytes(buf[start + 4 + hlen:end])
+            if paxwire.is_batch_payload(data):
+                segments = paxwire.split_batch(data)
+            else:
+                segments = (data,)
+            deliveries = []
+            tracer = self.tracer
+            metrics = self.runtime_metrics
+            for segment in segments:
+                if tracer is not None and ctx is not None \
+                        and ctx.sampled:
+                    m0 = tracer.mono()
+                    delivery = self._decode(local, src, segment)
+                    if delivery is not None:
+                        tracer.record_stage("decode", m0, ctx)
+                elif metrics is not None:
+                    # Unsampled (or context-less) frame with /metrics
+                    # on: the drain-stage histogram still sees EVERY
+                    # decode -- sampling must not starve it.
+                    p0 = time.perf_counter()
+                    delivery = self._decode(local, src, segment)
+                    if delivery is not None:
+                        metrics.observe_stage(
+                            "decode", time.perf_counter() - p0)
+                else:
+                    delivery = self._decode(local, src, segment)
+                if delivery is not None:
+                    deliveries.append(delivery)
+        except Exception as e:
+            self.logger.error(
+                f"dropping connection on corrupt frame: {e!r}")
+            return False
+        for delivery in deliveries:
+            self._deliver(*delivery, ctx)
+        return True
 
     def _decode(self, local: Address, src: Address, data: bytes):
         """Frame payload -> (actor, src, message), or None if no actor
@@ -330,6 +427,14 @@ class TcpTransport(Transport):
 
     def _deliver(self, actor: Actor, src: Address, message,
                  ctx: "Optional[TraceContext]" = None) -> None:
+        expand = getattr(message, "__wire_expand__", None)
+        if expand is not None:
+            # A coalesced wire envelope (paxwire): flatten back into
+            # the messages the sender queued -- admission, tracing, and
+            # the protocol handlers see per-message semantics.
+            for inner in expand(actor.serializer):
+                self._deliver(actor, src, inner, ctx)
+            return
         admission = actor.admission
         if admission is not None and self._shed_inbound(actor, admission,
                                                         message):
@@ -477,6 +582,27 @@ class TcpTransport(Transport):
             self._conns[key] = conn
         return conn
 
+    def _header_for(self, conn: _Conn, src: Address,
+                    ctx: "Optional[TraceContext]") -> bytes:
+        """The frame header bytes (``host:port`` or
+        ``host:port|<ctx>``), cached per connection -- the per-send
+        f-string format + encode was measurable at batched rates."""
+        if ctx is None:
+            header = conn.header0
+            if header is None:
+                host, port = src
+                header = conn.header0 = f"{host}:{port}".encode()
+            return header
+        key = (ctx.trace_id, ctx.span_id, ctx.sampled)
+        header = conn.headers.get(key)
+        if header is None:
+            host, port = src
+            header = f"{host}:{port}|{ctx.encode()}".encode()
+            if len(conn.headers) > 256:  # sampled-trace churn bound
+                conn.headers.clear()
+            conn.headers[key] = header
+        return header
+
     def _write(self, src: Address, dst: Address, data: bytes,
                flush: bool,
                ctx: "Optional[TraceContext]" = None) -> None:
@@ -493,39 +619,103 @@ class TcpTransport(Transport):
             # at-most-once transport contract; protocol resends cover
             # them.
             conn.writer = None
-        frame = _encode_frame(src, data, ctx)
-        conn.pending.append(frame)
-        conn.pending_bytes += len(frame)
+        lane = _get_frame_lane()(data)
+        if self.batching:
+            header = self._header_for(conn, src, ctx)
+            if 4 + len(header) + len(data) > MAX_FRAME:
+                # Same cap the receiver enforces -- but _write runs as
+                # a loop callback (or inline inside a handler's send),
+                # so raising here would abort the sending actor or
+                # vanish into the loop's exception handler. Dropping
+                # with a stall count is the documented at-most-once
+                # behavior for an unsendable frame.
+                metrics = self.runtime_metrics
+                if metrics is not None:
+                    metrics.outbound_stall(1)
+                self.logger.error(
+                    f"dropping {len(data)}-byte message to {dst}: "
+                    f"frame exceeds the 10 MiB cap")
+                return
+            size = 12 + len(header) + len(data)
+            conn.pending.append((header, data, lane, size))
+        else:
+            frame = _encode_frame(src, data, ctx)
+            size = len(frame)
+            conn.pending.append((None, frame, lane, size))
+        conn.pending_bytes += size
         if conn.pending_bytes > conn.hwm_reported:
             conn.hwm_reported = conn.pending_bytes
             metrics = self.runtime_metrics
             if metrics is not None:
                 metrics.outbound_buffer_hwm(conn.pending_bytes)
         if conn.pending_bytes > self.outbound_buffer_cap:
-            # Bounded outbound buffer (paxload): a slow or dead peer
-            # used to grow ``pending`` without limit (reachable under
-            # chaos since the PR 3 reconnect fix). Shed the OLDEST
-            # frames -- they have aged the most and their resend
-            # timers are the closest to firing -- and count the stall.
-            dropped = 0
-            while conn.pending_bytes > self.outbound_buffer_cap \
-                    and len(conn.pending) > 1:
-                conn.pending_bytes -= len(conn.pending[0])
-                del conn.pending[0]
-                dropped += 1
+            dropped = self._shed_outbound(conn)
             metrics = self.runtime_metrics
             if metrics is not None:
                 metrics.outbound_stall(dropped)
             self.logger.warn(
                 f"outbound buffer to {dst} over "
                 f"{self.outbound_buffer_cap} bytes; dropped {dropped} "
-                f"oldest frames (peer slow or gone; resends cover)")
+                f"oldest frames, client lane first (peer slow or gone; "
+                f"resends cover)")
         if conn.writer is not None:
             if flush:
-                self._flush_conn(conn)
+                if self.batching:
+                    self._schedule_flush(conn)
+                else:
+                    self._flush_conn(conn)
         elif not conn.connecting:
             conn.connecting = True
             self.loop.create_task(self._connect(conn, dst))
+
+    def _shed_outbound(self, conn: _Conn) -> int:
+        """Bounded outbound buffer (paxload): a slow or dead peer must
+        not grow ``pending`` without limit (reachable under chaos since
+        the PR 3 reconnect fix). Sheds the OLDEST entries -- they have
+        aged the most and their resend timers are the closest to
+        firing -- CLIENT-LANE FIRST: control traffic (votes, Phase1,
+        epoch commits, heartbeats) is never shed behind a backlog of
+        client batches, the invariant the overload chaos tests assert.
+        The newest entry always survives so a send makes progress."""
+        from frankenpaxos_tpu.serve.lanes import LANE_CLIENT
+
+        dropped = 0
+        for pass_lane in (LANE_CLIENT, None):
+            if conn.pending_bytes <= self.outbound_buffer_cap:
+                break
+            pending = conn.pending
+            kept: list = []
+            last = len(pending) - 1
+            for k, entry in enumerate(pending):
+                if (conn.pending_bytes > self.outbound_buffer_cap
+                        and k != last
+                        and (pass_lane is None
+                             or entry[2] == pass_lane)):
+                    conn.pending_bytes -= entry[3]
+                    dropped += 1
+                else:
+                    kept.append(entry)
+            conn.pending = kept
+        return dropped
+
+    def _schedule_flush(self, conn: _Conn) -> None:
+        """Queue ``conn`` for the end-of-pass flush: every send of the
+        current event-loop pass (one actor drain's whole output, often
+        several actors') lands in the same writev."""
+        if conn in self._flush_dirty:
+            return
+        self._flush_dirty.add(conn)
+        self._flush_queue.append(conn)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self.loop.call_soon(self._flush_pass)
+
+    def _flush_pass(self) -> None:
+        self._flush_scheduled = False
+        queue, self._flush_queue = self._flush_queue, []
+        self._flush_dirty.clear()
+        for conn in queue:
+            self._flush_conn(conn)
 
     async def _connect(self, conn: _Conn, dst: Address) -> None:
         host, port = dst
@@ -545,16 +735,95 @@ class TcpTransport(Transport):
     def _flush_conn(self, conn: _Conn) -> None:
         if conn.writer is None or not conn.pending:
             return
+        entries = conn.pending
+        conn.pending = []
+        conn.pending_bytes = 0
+        writer = conn.writer
+        self.stat_flushes += 1
+        self.stat_messages += len(entries)
+        if not self.batching:
+            # Legacy per-frame arm: frames were encoded at send time;
+            # one join + write per flush (today's == pre-paxwire
+            # behavior, the A/B baseline).
+            self.stat_frames += len(entries)
+            try:
+                writer.write(b"".join(e[1] for e in entries))
+                self.stat_syscalls += 1
+            except (OSError, RuntimeError) as e:
+                self.logger.warn(
+                    f"write failed ({e}); dropping connection")
+                conn.writer = None
+            return
+        plan = paxwire.plan_flush(entries)
+        self.stat_frames += plan.frames
+        self.stat_batch_bytes += plan.nbytes
+        self.stat_coalesced_acks += plan.coalesced_acks
+        metrics = self.runtime_metrics
+        if metrics is not None:
+            metrics.transport_flush(plan.frames, plan.nbytes)
+            if plan.coalesced_acks:
+                metrics.transport_coalesced_acks(plan.coalesced_acks)
         try:
-            conn.writer.write(b"".join(conn.pending))
+            if not self._writev(writer, plan.segments):
+                writer.write(b"".join(plan.segments))
+                self.stat_syscalls += 1
         except (OSError, RuntimeError) as e:
             # Connection torn down mid-write: drop the writer; the
             # next send reconnects (see _write) and resends cover the
             # loss.
             self.logger.warn(f"write failed ({e}); dropping connection")
             conn.writer = None
-        conn.pending.clear()
-        conn.pending_bytes = 0
+
+    #: sendmsg iovec ceiling (POSIX IOV_MAX is commonly 1024).
+    _IOV_MAX = 1024
+
+    def _writev(self, writer: asyncio.StreamWriter,
+                segments: list) -> bool:
+        """Zero-copy scatter/gather output: push the flush plan's
+        segments with ``os.writev`` -- the payload ``bytes`` objects go
+        straight to the kernel as an iovec, never joined. Only safe
+        when asyncio's own write buffer is empty (ordering); on a
+        partial or blocked send the remainder is handed to
+        ``writer.write`` and asyncio's flow control takes over. Returns
+        False when writev cannot be used at all (caller falls back to
+        one join+write)."""
+        if not self.use_sendmsg:
+            return False
+        transport = writer.transport
+        sock = transport.get_extra_info("socket")
+        if sock is None or transport.get_write_buffer_size() != 0:
+            return False
+        try:
+            fd = sock.fileno()
+        except (OSError, ValueError):
+            return False
+        if fd < 0:
+            return False
+        i, n = 0, len(segments)
+        while i < n:
+            chunk = segments[i:i + self._IOV_MAX]
+            try:
+                sent = os.writev(fd, chunk)
+                self.stat_syscalls += 1
+            except (BlockingIOError, InterruptedError):
+                sent = 0
+            total = sum(len(s) for s in chunk)
+            if sent == total:
+                i += self._IOV_MAX
+                continue
+            # Kernel buffer full mid-flush: asyncio owns the rest.
+            rest: list = []
+            for seg in chunk:
+                if sent >= len(seg):
+                    sent -= len(seg)
+                    continue
+                rest.append(seg[sent:] if sent else seg)
+                sent = 0
+            rest.extend(segments[i + self._IOV_MAX:])
+            writer.write(b"".join(rest))
+            self.stat_syscalls += 1
+            return True
+        return True
 
     def _send_ctx(self) -> "Optional[TraceContext]":
         """The trace context to stamp on an outbound frame: captured at
@@ -574,8 +843,15 @@ class TcpTransport(Transport):
             lambda: self._write(src, dst, data, flush=False, ctx=ctx))
 
     def flush(self, src: Address, dst: Address) -> None:
-        self._call_on_loop(
-            lambda: self._flush_conn(self._conn_for(src, dst)))
+        if self.batching:
+            # Ride the end-of-pass flush: the explicit flush's messages
+            # still leave in this loop pass, batched with everything
+            # else the drain produced.
+            self._call_on_loop(
+                lambda: self._schedule_flush(self._conn_for(src, dst)))
+        else:
+            self._call_on_loop(
+                lambda: self._flush_conn(self._conn_for(src, dst)))
 
     def _on_loop(self) -> bool:
         """Is THIS thread currently running our event loop? Never

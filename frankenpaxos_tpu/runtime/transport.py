@@ -81,6 +81,17 @@ class Transport(abc.ABC):
     def flush(self, src: Address, dst: Address) -> None:
         ...
 
+    def send_batch(self, src: Address, dst: Address, datas) -> None:
+        """Queue a drain's already-encoded messages to one destination
+        and flush ONCE (paxwire): on TcpTransport the whole batch rides
+        one writev and adjacent same-type payloads coalesce into batch
+        frames; the default is the portable send_no_flush/flush
+        spelling, so SimTransport and custom transports need no
+        batching support."""
+        for data in datas:
+            self.send_no_flush(src, dst, data)
+        self.flush(src, dst)
+
     @abc.abstractmethod
     def timer(self, address: Address, name: str, delay_s: float,
               f: Callable[[], None]) -> Timer:

@@ -49,6 +49,11 @@ CLIENT_LANE_TYPE_NAMES = frozenset({
     "ProposeRequest",
     "LeaderInfoRequestClient",
     "LeaderInfoRequestBatcher",
+    # paxwire: a batch frame of client requests must shed like the
+    # requests themselves -- the transport's flush planner wraps runs
+    # of client-lane payloads in this envelope (runtime/paxwire.py),
+    # and both the tag-level and type-level classifiers need to see it.
+    "ClientFrameBatch",
 })
 
 _cache: tuple[int, frozenset] | None = None

@@ -57,9 +57,10 @@ RUNTIME_ROW_TITLE = ("Runtime (drain stages / queue depth / WAL fsync / "
                      "admission)")
 
 #: Total grid height of the runtime row: header (1) + the paxtrace
-#: band (8) + the paxload admission band (8). dashboard() and
-#: inject_runtime_row() both lay out protocol panels below this line.
-RUNTIME_ROW_H = 17
+#: band (8) + the paxload admission band (8) + the paxwire transport
+#: band (8). dashboard() and inject_runtime_row() both lay out
+#: protocol panels below this line.
+RUNTIME_ROW_H = 25
 
 
 def runtime_row_panels(y: int = 0) -> list:
@@ -132,6 +133,22 @@ def runtime_row_panels(y: int = 0) -> list:
             "sum by (kind) "
             "(rate(fpx_runtime_client_retries_total[5s]))",
             "{{kind}}", "ops", x=18, y=y + 9, w=6),
+        # paxwire batched-transport band (docs/TRANSPORT.md): writev
+        # batching effectiveness, ack coalescing rate, batched bytes.
+        _panel(
+            9008, "Transport: frames per writev",
+            "fpx_runtime_transport_frames_per_writev",
+            "{{role}}", "short", x=0, y=y + 17, w=8),
+        _panel(
+            9009, "Transport: coalesced acks/s",
+            "sum by (role) "
+            "(rate(fpx_runtime_transport_coalesced_acks_total[5s]))",
+            "{{role}}", "ops", x=8, y=y + 17, w=8),
+        _panel(
+            9010, "Transport: batched bytes/s",
+            "sum by (role) "
+            "(rate(fpx_runtime_transport_batch_bytes[5s]))",
+            "{{role}}", "Bps", x=16, y=y + 17, w=8),
     ]
 
 

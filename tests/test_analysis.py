@@ -1569,3 +1569,123 @@ def test_import_sort_repo_gate():
          "frankenpaxos_tpu.analysis.import_sort", "--check"],
         capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- NET7xx: paxwire transport contract -------------------------------------
+
+
+def test_net701_flushing_send_loop_in_on_drain(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            for reply in self.staged:
+                self.send(self.leader, reply)
+    """}))
+    assert "NET701" in rules_of(findings)
+    f = next(f for f in findings if f.rule == "NET701")
+    assert f.scope == "Bad.on_drain"
+
+
+def test_net701_reaches_drain_helper_closure(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            self._release()
+
+        def _release(self):
+            for ack in self.acks:
+                self.send(self.proxy, ack)
+    """}))
+    assert "NET701" in rules_of(findings)
+    assert any(f.rule == "NET701" and f.scope == "Bad._release"
+               for f in findings)
+
+
+def test_net701_chan_send_on_loop_invariant_channel(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Bad(Actor):
+        def on_drain(self):
+            chan = self.chan(self.leader)
+            for reply in self.staged:
+                chan.send(reply)
+    """}))
+    assert "NET701" in rules_of(findings)
+
+
+def test_net701_per_destination_fanout_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Good(Actor):
+        def on_drain(self):
+            for client, reply in self.staged.items():
+                self.send(client, reply)
+    """}))
+    assert "NET701" not in rules_of(findings)
+
+
+def test_net701_send_no_flush_plus_flush_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Good(Actor):
+        def send_no_flush(self, dst, message): ...
+        def flush(self, dst): ...
+        def on_drain(self):
+            for reply in self.staged:
+                self.send_no_flush(self.leader, reply)
+            self.flush(self.leader)
+    """}))
+    assert "NET701" not in rules_of(findings)
+
+
+def test_net701_receive_loops_not_flagged(tmp_path):
+    """Only DRAIN-granular handlers are in scope: a receive() handling
+    one inbound message sends per message by definition."""
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Good(Actor):
+        def receive(self, src, message):
+            for dst in range(3):
+                self.send(self.leader, message)
+    """}))
+    assert "NET701" not in rules_of(findings)
+
+
+def test_net701_pragma_suppresses(tmp_path):
+    findings = run_rules(project(tmp_path, {"a.py": ACTOR_PREAMBLE + """
+    class Tolerated(Actor):
+        def on_drain(self):
+            for reply in self.staged:
+                self.send(self.leader, reply)  # paxlint: disable=NET701
+    """}))
+    assert "NET701" not in rules_of(findings)
+
+
+def test_flow403_transport_layer_codec_excluded(tmp_path):
+    """A codec marked ``transport_layer = True`` (paxwire batch
+    envelopes: encoded by the transport's flush planner, never by a
+    role) is not an orphan tag; the unmarked twin still is."""
+    files = {
+        "serve/lanes.py": "CLIENT_LANE_TYPE_NAMES = frozenset()\n",
+        "wire.py": """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Envelope:
+        segments: tuple
+
+    @dataclasses.dataclass(frozen=True)
+    class Orphan:
+        segments: tuple
+
+    class MessageCodec: ...
+
+    class EnvelopeCodec(MessageCodec):
+        message_type = Envelope
+        tag = 150
+        transport_layer = True
+
+    class OrphanCodec(MessageCodec):
+        message_type = Orphan
+        tag = 151
+    """}
+    findings = run_rules(project(tmp_path, files))
+    flow403 = {f.scope for f in findings if f.rule == "FLOW403"}
+    assert "Orphan" in flow403
+    assert "Envelope" not in flow403

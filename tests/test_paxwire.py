@@ -1,0 +1,463 @@
+"""paxwire: batch frames, flush planning, ack coalescing, lane
+classification, outbound shed priority, and the batched TcpTransport
+end to end (docs/TRANSPORT.md)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from frankenpaxos_tpu import native
+import frankenpaxos_tpu.protocols.multipaxos  # noqa: F401 - registers codecs
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    Chosen,
+    ClientRequest,
+    Command,
+    CommandId,
+    NOOP,
+    Phase2b,
+    Phase2bRange,
+)
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _coalesce_phase2b,
+    Phase2bAckBatch,
+)
+from frankenpaxos_tpu.runtime import FakeLogger, paxwire
+from frankenpaxos_tpu.runtime.actor import Actor
+from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+from frankenpaxos_tpu.serve.lanes import frame_lane, LANE_CLIENT, LANE_CONTROL
+
+_LEN = struct.Struct(">I")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _client_request(i: int) -> bytes:
+    return DEFAULT_SERIALIZER.to_bytes(
+        ClientRequest(Command(CommandId(("10.0.0.1", 7), 0, i), b"x")))
+
+
+def _phase2b(slot: int, round: int = 0) -> bytes:
+    return DEFAULT_SERIALIZER.to_bytes(
+        Phase2b(group_index=0, acceptor_index=1, slot=slot, round=round))
+
+
+# --- flush planning ---------------------------------------------------------
+
+
+def test_plan_flush_batches_adjacent_same_type_runs():
+    header = b"10.0.0.1:9"
+    entries = [(header, _client_request(i), LANE_CLIENT, 0)
+               for i in range(5)]
+    plan = paxwire.plan_flush(entries)
+    assert plan.frames == 1
+    assert plan.messages == 5
+    wire = b"".join(bytes(s) for s in plan.segments)
+    assert len(wire) == plan.nbytes
+    # The batch frame's payload leads with the CLIENT batch tag.
+    (inner,) = _LEN.unpack_from(wire, 0)
+    (hlen,) = _LEN.unpack_from(wire, 4)
+    payload = wire[8 + hlen:4 + inner + 4]
+    assert payload[0] == 0
+    assert payload[1] + 128 == paxwire.CLIENT_BATCH_TAG
+
+
+def test_plan_flush_preserves_order_across_type_boundaries():
+    header = b"h:1"
+    chosen = DEFAULT_SERIALIZER.to_bytes(Chosen(slot=4, value=NOOP))
+    entries = [(header, _client_request(0), LANE_CLIENT, 0),
+               (header, chosen, LANE_CONTROL, 0),
+               (header, _client_request(1), LANE_CLIENT, 0),
+               (header, _client_request(2), LANE_CLIENT, 0)]
+    plan = paxwire.plan_flush(entries)
+    # No merge across the Chosen: 1 plain + 1 plain + 1 batch(2).
+    assert plan.frames == 3
+    wire = b"".join(bytes(s) for s in plan.segments)
+    messages = _scan_messages(wire)
+    assert [type(m).__name__ for m in messages] == [
+        "ClientRequest", "Chosen", "ClientRequest", "ClientRequest"]
+
+
+def test_plan_flush_singletons_stay_plain_frames():
+    header = b"h:1"
+    entries = [(header, _client_request(0), LANE_CLIENT, 0)]
+    plan = paxwire.plan_flush(entries)
+    assert plan.frames == 1
+    wire = b"".join(bytes(s) for s in plan.segments)
+    (hlen,) = _LEN.unpack_from(wire, 4)
+    assert not paxwire.is_batch_payload(wire[8 + hlen:])
+
+
+def _scan_messages(wire: bytes) -> list:
+    out = []
+    frames, consumed = native.scan_frames(wire)
+    assert consumed == len(wire)
+    for start, end in frames:
+        (hlen,) = _LEN.unpack_from(wire, start)
+        data = wire[start + 4 + hlen:end]
+        if paxwire.is_batch_payload(data):
+            for seg in paxwire.split_batch(data):
+                out.append(DEFAULT_SERIALIZER.from_bytes(seg))
+        else:
+            out.append(DEFAULT_SERIALIZER.from_bytes(data))
+    return out
+
+
+def test_batch_frame_round_trip_and_torn_tail_containment():
+    segs = [_client_request(i) for i in range(4)]
+    batch = paxwire.ClientFrameBatch(tuple(segs))
+    data = DEFAULT_SERIALIZER.to_bytes(batch)
+    decoded = DEFAULT_SERIALIZER.from_bytes(data)
+    assert decoded == batch
+    assert [type(m).__name__
+            for m in decoded.__wire_expand__(DEFAULT_SERIALIZER)] \
+        == ["ClientRequest"] * 4
+    # Every truncation either raises ValueError or decodes to garbage
+    # -- never an uncontrolled exception (the containment contract).
+    for cut in range(2, len(data)):
+        try:
+            DEFAULT_SERIALIZER.from_bytes(data[:cut])
+        except ValueError:
+            pass
+    # Bit flips in the segment table.
+    import random
+
+    rng = random.Random(3)
+    for _ in range(60):
+        corrupt = bytearray(data)
+        corrupt[rng.randrange(2, len(corrupt))] ^= 1 << rng.randrange(8)
+        try:
+            got = DEFAULT_SERIALIZER.from_bytes(bytes(corrupt))
+            if hasattr(got, "segments"):
+                for seg in got.segments:
+                    try:
+                        DEFAULT_SERIALIZER.from_bytes(bytes(seg))
+                    except ValueError:
+                        pass
+        except ValueError:
+            pass
+
+
+# --- lane classification ----------------------------------------------------
+
+
+def test_batch_frames_classify_by_lane_without_decode():
+    client = paxwire.ClientFrameBatch((_client_request(0),))
+    control = paxwire.FrameBatch((_phase2b(1),))
+    assert frame_lane(DEFAULT_SERIALIZER.to_bytes(client)) == LANE_CLIENT
+    assert frame_lane(DEFAULT_SERIALIZER.to_bytes(control)) \
+        == LANE_CONTROL
+    # And the planner picks the client tag for client-lane runs.
+    header = b"h:1"
+    plan = paxwire.plan_flush(
+        [(header, _client_request(i), LANE_CLIENT, 0)
+         for i in range(3)])
+    wire = b"".join(bytes(s) for s in plan.segments)
+    (hlen,) = _LEN.unpack_from(wire, 4)
+    assert frame_lane(bytes(wire[8 + hlen:])) == LANE_CLIENT
+
+
+# --- ack coalescing ---------------------------------------------------------
+
+
+def test_phase2b_coalescer_builds_run_granular_ranges():
+    payloads = [_phase2b(s) for s in (5, 6, 7, 9, 12, 13)]
+    merged = _coalesce_phase2b(payloads)
+    assert merged is not None
+    assert len(merged) < sum(len(p) for p in payloads)
+    batch = DEFAULT_SERIALIZER.from_bytes(merged)
+    assert isinstance(batch, Phase2bAckBatch)
+    expanded = list(batch.__wire_expand__(DEFAULT_SERIALIZER))
+    # Runs >= 2 expand to Phase2bRange; singletons stay Phase2b so the
+    # proxy leader's never-sent-a-Phase2a tripwire stays armed.
+    kinds = [(type(m).__name__,
+              getattr(m, "slot", None),
+              getattr(m, "slot_start_inclusive", None),
+              getattr(m, "slot_end_exclusive", None))
+             for m in expanded]
+    assert kinds == [("Phase2bRange", None, 5, 8),
+                     ("Phase2b", 9, None, None),
+                     ("Phase2bRange", None, 12, 14)]
+
+
+def test_phase2b_coalescer_declines_mixed_or_foreign_payloads():
+    assert _coalesce_phase2b([_phase2b(1), _client_request(0)]) is None
+    assert _coalesce_phase2b([b"", b""]) is None
+
+
+def test_plan_flush_invokes_registered_coalescer():
+    header = b"h:1"
+    entries = [(header, _phase2b(s), LANE_CONTROL, 0)
+               for s in range(100, 164)]
+    plan = paxwire.plan_flush(entries)
+    assert plan.frames == 1
+    assert plan.coalesced_acks == 64
+    messages = _scan_messages(
+        b"".join(bytes(s) for s in plan.segments))
+    # One contiguous 64-slot run.
+    assert len(messages) == 1
+    assert isinstance(messages[0], Phase2bAckBatch)
+    (entry,) = messages[0].ranges
+    assert entry[:2] == (100, 164)
+
+
+# --- outbound shed priority -------------------------------------------------
+
+
+def test_outbound_shed_drops_client_lane_before_control():
+    """Control-lane frames are NEVER shed behind client batches: when
+    the bounded outbound buffer overflows, the oldest CLIENT entries
+    drop first; control survives as long as any client entry remains."""
+    logger = FakeLogger()
+    transport = TcpTransport(None, logger)
+    transport.outbound_buffer_cap = 8 * 1024
+    transport.start()
+    try:
+        dst = ("127.0.0.1", 1)  # nobody listening
+
+        def fill():
+            conn = transport._conn_for(("x", 0), dst)
+            conn.connecting = True  # pin: pending only grows
+            control = DEFAULT_SERIALIZER.to_bytes(
+                Phase2b(group_index=0, acceptor_index=0, slot=1,
+                        round=0))
+            client = DEFAULT_SERIALIZER.to_bytes(ClientRequest(
+                Command(CommandId(("c", 1), 0, 0), b"p" * 400)))
+            for _ in range(8):
+                transport._write(("x", 0), dst, control, flush=False)
+            for _ in range(64):
+                transport._write(("x", 0), dst, client, flush=False)
+            return conn
+
+        import asyncio
+
+        future = asyncio.run_coroutine_threadsafe(
+            _async_value(fill), transport.loop)
+        conn = future.result(timeout=5)
+        assert conn.pending_bytes <= transport.outbound_buffer_cap
+        lanes = [entry[2] for entry in conn.pending]
+        # All 8 control frames survived even though they are the
+        # OLDEST entries; only client frames were shed.
+        assert lanes.count(LANE_CONTROL) == 8
+        assert 0 < lanes.count(LANE_CLIENT) < 64
+    finally:
+        transport.stop()
+
+
+async def _async_value(f):
+    return f()
+
+
+# --- batched TcpTransport end to end ---------------------------------------
+
+
+@pytest.fixture
+def transports():
+    created = []
+
+    def make(address=None, **kwargs):
+        t = TcpTransport(address, FakeLogger(), **kwargs)
+        t.start()
+        created.append(t)
+        return t
+
+    yield make
+    for t in created:
+        t.stop()
+
+
+class _Sink(Actor):
+    def __init__(self, address, transport, logger):
+        super().__init__(address, transport, logger)
+        self.got: list = []
+        self.done = threading.Event()
+        self.want = 0
+
+    def receive(self, src, message):
+        self.got.append(message)
+        if self.want and len(self.got) >= self.want:
+            self.done.set()
+
+
+class _Src(Actor):
+    def receive(self, src, message):
+        pass
+
+
+@pytest.mark.parametrize("sendmsg", [True, False],
+                         ids=["writev", "joined-write"])
+def test_batched_sends_arrive_and_coalesce(transports, sendmsg):
+    """A drain's worth of same-type messages to one peer arrives
+    intact through the batched path -- and rode (far) fewer wire
+    frames and syscalls than messages. The joined-write arm pins the
+    wire format: writev and join produce bit-identical bytes, so both
+    must decode."""
+    logger = FakeLogger()
+    a_addr = ("127.0.0.1", free_port())
+    b_addr = ("127.0.0.1", free_port())
+    ta = transports(a_addr)
+    ta.use_sendmsg = sendmsg
+    tb = transports(b_addr)
+    sink = _Sink(b_addr, tb, logger)
+    sink.want = 200
+    src = _Src(a_addr, ta, logger)
+
+    def send_all():
+        for i in range(200):
+            src.send(b_addr, ClientRequest(
+                Command(CommandId(("c", 1), 0, i), b"w%d" % i)))
+
+    ta.loop.call_soon_threadsafe(send_all)
+    assert sink.done.wait(10), f"only {len(sink.got)}/200 delivered"
+    ids = [m.command.command_id.client_id for m in sink.got]
+    assert ids == list(range(200))  # order preserved
+    assert ta.stat_messages == 200
+    assert ta.stat_frames < 20  # batched, not per-message
+    assert ta.stat_syscalls < 20
+
+
+def test_ack_coalescing_end_to_end(transports):
+    """A per-message Phase2b burst to one peer coalesces at flush into
+    run-granular ranges and expands back to the messages the proxy
+    leader handles."""
+    logger = FakeLogger()
+    a_addr = ("127.0.0.1", free_port())
+    b_addr = ("127.0.0.1", free_port())
+    ta = transports(a_addr)
+    tb = transports(b_addr)
+    sink = _Sink(b_addr, tb, logger)
+    sink.want = 1  # at least the range
+    src = _Src(a_addr, ta, logger)
+
+    def send_acks():
+        for slot in range(50, 114):
+            src.send(b_addr, Phase2b(group_index=0, acceptor_index=1,
+                                     slot=slot, round=3))
+
+    ta.loop.call_soon_threadsafe(send_acks)
+    assert wait_for(lambda: sum(
+        (m.slot_end_exclusive - m.slot_start_inclusive)
+        if isinstance(m, Phase2bRange) else 1
+        for m in sink.got) == 64)
+    assert ta.stat_coalesced_acks == 64
+    ranges = [m for m in sink.got if isinstance(m, Phase2bRange)]
+    assert ranges and all(m.round == 3 for m in ranges)
+
+
+def test_legacy_sender_interoperates_with_batched_receiver(transports):
+    """batching=False frames decode unchanged on a batched receiver
+    (and vice versa): the wire format is a superset, not a fork."""
+    logger = FakeLogger()
+    a_addr = ("127.0.0.1", free_port())
+    b_addr = ("127.0.0.1", free_port())
+    legacy = transports(a_addr, batching=False)
+    batched = transports(b_addr)
+    sink = _Sink(b_addr, batched, logger)
+    sink.want = 40
+    src = _Src(a_addr, legacy, logger)
+
+    def send_all():
+        for i in range(40):
+            src.send(b_addr, ClientRequest(
+                Command(CommandId(("c", 1), 0, i), b"x")))
+
+    legacy.loop.call_soon_threadsafe(send_all)
+    assert sink.done.wait(10)
+    assert legacy.stat_frames == 40  # truly per-message on the wire
+
+
+def test_trace_context_rides_batch_header(transports):
+    """The frame-header TraceContext covers every message expanded
+    from a batch frame: receive spans on the peer parent to the
+    SENDER's context."""
+    from frankenpaxos_tpu.obs import TraceContext, Tracer
+
+    logger = FakeLogger()
+    a_addr = ("127.0.0.1", free_port())
+    b_addr = ("127.0.0.1", free_port())
+    ta = transports(a_addr)
+    tb = transports(b_addr)
+    tracer = Tracer("sink", sample_rate=1.0)
+    tb.tracer = tracer
+    sink = _Sink(b_addr, tb, logger)
+    sink.want = 30
+    src = _Src(a_addr, ta, logger)
+    ctx = TraceContext(trace_id=0xABC, span_id=0x123, sampled=True)
+
+    def send_all():
+        data = [DEFAULT_SERIALIZER.to_bytes(ClientRequest(
+            Command(CommandId(("c", 1), 0, i), b"x")))
+            for i in range(30)]
+        for payload in data:
+            ta._write(a_addr, b_addr, payload, flush=True, ctx=ctx)
+
+    ta.loop.call_soon_threadsafe(send_all)
+    assert sink.done.wait(10)
+    # One batched wire frame, yet every receive span is parented by
+    # the sender's context.
+    assert wait_for(lambda: len(
+        [s for s in tracer.spans if s.cat == "receive"]) >= 30)
+    receive_spans = [s for s in tracer.spans if s.cat == "receive"]
+    assert len(receive_spans) == 30
+    assert all(s.trace_id == 0xABC and s.parent_id == 0x123
+               for s in receive_spans)
+    assert ta.stat_frames < len(receive_spans)
+
+
+# --- receive path: no quadratic copying -------------------------------------
+
+
+def test_scan_frames_over_offset_cursor_does_not_copy_buffer():
+    """Regression for the receive-path copy: scanning a large
+    multi-pass buffer must not allocate anything proportional to the
+    whole buffer per pass (the old ``scan_frames(bytes(buf))``
+    re-copied all of it every 4096 frames)."""
+    import tracemalloc
+
+    frame = native.encode_frame(b"10.0.0.1:9000", b"p" * 400)
+    n = 20000  # ~5 passes of the 4096-frame scanner
+    buf = bytearray(frame * n)
+    total = len(buf)
+
+    tracemalloc.start()
+    pos = 0
+    passes = 0
+    count = 0
+    while pos < total:
+        frames, pos = native.scan_frames(buf, offset=pos)
+        count += len(frames)
+        passes += 1
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert count == n
+    assert passes >= 5
+    # The old path's per-pass bytes(buf) would have peaked >= total.
+    assert peak < total / 2, (peak, total)
+
+
+def test_scan_frames_offset_handles_torn_tail():
+    frame = native.encode_frame(b"h:1", b"abc")
+    buf = bytearray(b"\x00" * 7 + frame + frame[: len(frame) - 2])
+    frames, consumed = native.scan_frames(buf, offset=7)
+    assert len(frames) == 1
+    assert consumed == 7 + len(frame)
+    start, end = frames[0]
+    assert bytes(buf[end - 3:end]) == b"abc"

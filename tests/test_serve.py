@@ -499,9 +499,10 @@ def test_tcp_outbound_buffer_bounded_drops_oldest():
             _async_value(fill), transport.loop).result(timeout=5)
         assert conn.pending_bytes <= transport.outbound_buffer_cap
         assert 0 < len(conn.pending) < 64
-        # Oldest dropped, newest kept.
-        assert conn.pending[-1].endswith(b"p" * 256)
-        assert b"0063" in conn.pending[-1]
+        # Oldest dropped, newest kept (paxwire entries: the message
+        # payload rides entry[1], frame assembly is deferred to flush).
+        assert conn.pending[-1][1].endswith(b"p" * 256)
+        assert b"0063" in conn.pending[-1][1]
     finally:
         transport.stop()
 

@@ -21,7 +21,6 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     CommandBatch,
     CommandId,
     NOOP,
-    Phase1a,
     Phase2a,
     Phase2b,
 )
@@ -93,7 +92,12 @@ def test_binary_round_trip(message):
 
 
 def test_unregistered_types_fall_back_to_pickle():
-    message = Phase1a(round=1, chosen_watermark=0)
+    # Phase1a graduated to a fixed layout (tag 153, paxwire COD301
+    # burn-down); simplebpaxos's Recover is still a pickled cold-path
+    # message (grandfathered in .paxlint-baseline.json).
+    from frankenpaxos_tpu.protocols.simplebpaxos import messages as bp
+
+    message = bp.Recover(vertex_id=bp.VertexId(0, 3))
     data = DEFAULT_SERIALIZER.to_bytes(message)
     assert data[0] >= 128  # pickle PROTO opcode
     assert DEFAULT_SERIALIZER.from_bytes(data) == message
@@ -839,6 +843,36 @@ def all_codec_samples() -> dict:
     samples += [
         serve.Rejected(entries=((2, 7), (3, 9)), retry_after_ms=250,
                        reason=2),
+    ]
+    # paxwire (runtime/paxwire.py + protocols/multipaxos/wire.py): the
+    # batch envelopes and the coalesced ack batch -- transport-layer
+    # frames, but they share the wire tag space and the containment
+    # contract, so they fuzz like every role-sent message.
+    from frankenpaxos_tpu.protocols.multipaxos.wire import Phase2bAckBatch
+    from frankenpaxos_tpu.runtime import paxwire
+
+    seg1 = DEFAULT_SERIALIZER.to_bytes(HOT_MESSAGES[0])
+    seg2 = DEFAULT_SERIALIZER.to_bytes(HOT_MESSAGES[6])
+    samples += [
+        paxwire.FrameBatch((seg1, seg1, seg2)),
+        paxwire.ClientFrameBatch((seg2,)),
+        Phase2bAckBatch(ranges=((5, 9, 1, 0, 2), (11, 12, 1, 0, 2))),
+        # COD301 burn-down (tags 153-159): the failover cold path.
+        mp.Phase1a(round=3, chosen_watermark=64),
+        mp.Phase1b(group_index=0, acceptor_index=1, round=3,
+                   info=(mp.Phase1bSlotInfo(slot=5, vote_round=1,
+                                            vote_value=batch),
+                         mp.Phase1bSlotInfo(slot=6, vote_round=2,
+                                            vote_value=mp.NOOP)),
+                   epochs=(rc.EpochCommit(epoch=1, start_slot=64, f=1,
+                                          round=2,
+                                          members=("a0", "a1")),)),
+        mp.Nack(round=7),
+        mp.Recover(slot=99),
+        fmp.Phase1bNack(acceptor_id=1, round=5),
+        vm.Phase1Nack(start_slot_inclusive=2, stop_slot_exclusive=9,
+                      round=4),
+        vm.Phase2Nack(slot=3, round=6),
     ]
     by_tag: dict = {}
     for message in samples:
