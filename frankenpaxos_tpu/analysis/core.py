@@ -135,6 +135,7 @@ def _ensure_loaded() -> None:
         durability_rules,
         epoch_rules,
         flow_rules,
+        geo_rules,
         hotpath_rules,
         net_rules,
         overload_rules,

@@ -1,0 +1,77 @@
+"""paxgeo determinism-contract rules (GEO8xx).
+
+  * GEO801 -- a wall-clock read or unseeded randomness inside the geo
+    simulation layer (``geo/``). The whole wide-area suite rests on
+    one invariant: same seed => byte-identical event sequence (the
+    committed golden test, the sharp virtual-latency gates in
+    bench/geo_lt.py, minimizer-replayable chaos traces). One
+    ``time.time()`` in a delay computation or one module-level
+    ``random.random()`` silently breaks all three. Virtual time comes
+    from ``GeoSimTransport.now``; randomness comes from a
+    ``random.Random`` seeded with a STRING key (sha512 seeding --
+    stable across processes, unlike ``hash()`` under
+    PYTHONHASHSEED).
+
+Seeded generators (``random.Random(...)`` instances) and reading the
+virtual clock are of course fine; only the module-level conveniences
+and OS entropy/clock sources are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    Project,
+    register_rules,
+)
+
+RULES = {
+    "GEO801": "wall-clock read or unseeded randomness in the geo "
+              "simulation layer (breaks same-seed determinism)",
+}
+
+#: Dotted call names that introduce nondeterminism. ``random.Random``
+#: (the seeded constructor) is explicitly NOT here.
+_FORBIDDEN_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.time_ns",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "os.urandom", "uuid.uuid4", "uuid.uuid1", "secrets.token_bytes",
+    "random.random", "random.randint", "random.randrange",
+    "random.uniform", "random.choice", "random.choices",
+    "random.shuffle", "random.sample", "random.getrandbits",
+    "numpy.random.random", "numpy.random.rand", "numpy.random.randn",
+    "numpy.random.randint", "np.random.random", "np.random.rand",
+    "np.random.randn", "np.random.randint",
+})
+
+
+def check(project: Project):
+    findings: list = []
+    base = f"{project.package}/geo/"
+    for mod in project:
+        if not mod.path.startswith(base):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if callee not in _FORBIDDEN_CALLS:
+                continue
+            findings.append(Finding(
+                rule="GEO801", file=mod.path, line=node.lineno,
+                scope=callee, detail=callee,
+                message=f"{callee}() in the geo simulation layer "
+                        "breaks the same-seed determinism contract "
+                        "(golden delivery order, virtual-latency "
+                        "gates, minimizer replays) -- take the "
+                        "virtual clock from the transport and draw "
+                        "jitter from a string-seeded random.Random "
+                        "(docs/GEO.md)"))
+    return findings
+
+
+register_rules(RULES, check)

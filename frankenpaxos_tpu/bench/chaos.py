@@ -162,3 +162,69 @@ def reconfigure_acceptors(transport, leader_addresses,
     data = DEFAULT_SERIALIZER.to_bytes(Reconfigure(members=members))
     for leader in leader_addresses:
         transport.send(transport.listen_address, tuple(leader), data)
+
+
+# --- paxgeo: zone-scoped failure + object placement -------------------------
+
+
+def zone_labels(labels, zone_roles) -> list:
+    """The deployed labels belonging to one zone, in kill order
+    (leader first so nothing proposes into a dying row). ``labels``
+    is the bench's ``labeled_procs`` keys; ``zone_roles`` the exact
+    role labels the zone owns (e.g. from ``wpaxos_zone_roles``)."""
+    return [label for label in zone_roles if label in labels]
+
+
+def wpaxos_zone_roles(raw_config: dict, zone: int) -> list:
+    """Role labels for zone ``zone`` of a deployed wpaxos cluster
+    (the deploy registry's label scheme: leader_<z>, acceptor_<flat
+    index>, replica_<z>)."""
+    width = len(raw_config["acceptors"][zone])
+    return ([f"leader_{zone}"]
+            + [f"acceptor_{zone * width + i}" for i in range(width)]
+            + [f"replica_{zone}"])
+
+
+def sigkill_zone(bench: BenchmarkDirectory, labels) -> None:
+    """Zone outage: ``kill -9`` EVERY role in the zone through the
+    PR 3 SIGKILL machinery (flight-recorder post-mortems included),
+    instead of the per-role loops the scenario drivers used to
+    hand-roll."""
+    for label in labels:
+        sigkill_role(bench, label)
+
+
+def relaunch_zone(bench: BenchmarkDirectory, labels,
+                  host: "LocalHost | None" = None) -> list:
+    """Relaunch a killed zone VERBATIM from the recorded role
+    commands (same ports, same ``--wal_dir``): acceptors recover
+    their promises/votes/epochs from their WALs, the leader and
+    replica come back fresh and re-acquire state through steals and
+    hole recovery."""
+    return [relaunch_role(bench, label, host=host)
+            for label in labels]
+
+
+def kill_restart_zone(bench: BenchmarkDirectory, labels,
+                      down_s: float = 0.5,
+                      host: "LocalHost | None" = None) -> list:
+    """SIGKILL a whole zone, leave it dark for ``down_s`` (steals of
+    its objects block on the dead row -- the f_z = 0 tradeoff,
+    docs/GEO.md), then relaunch it verbatim."""
+    sigkill_zone(bench, labels)
+    time.sleep(down_s)
+    return relaunch_zone(bench, labels, host=host)
+
+
+def steal_group(transport, leader_address, group: int) -> None:
+    """Admin trigger: make ``leader_address``'s zone steal object
+    group ``group`` (the placement driver's adapt step and the
+    zone-outage repair path). Call from off the transport's loop
+    thread, like :func:`reconfigure_acceptors`."""
+    from frankenpaxos_tpu.protocols.wpaxos.messages import Steal
+    from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+    data = DEFAULT_SERIALIZER.to_bytes(Steal(group=group))
+    transport.send(transport.listen_address, tuple(leader_address)
+                   if isinstance(leader_address, list)
+                   else leader_address, data)

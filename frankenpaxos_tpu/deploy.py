@@ -980,6 +980,59 @@ def _scalog() -> Protocol:
     )
 
 
+def _wpaxos() -> Protocol:
+    from frankenpaxos_tpu.protocols import wpaxos as m
+
+    def load(raw):
+        config = m.WPaxosConfig(
+            zones=tuple(raw["zones"]),
+            leader_addresses=_addrs(raw["leaders"]),
+            acceptor_addresses=tuple(
+                tuple(_addrs(row)) for row in raw["acceptors"]),
+            replica_addresses=_addrs(raw["replicas"]),
+            num_groups=raw.get("num_groups", 4))
+        config.check_valid()
+        return config
+
+    return Protocol(
+        name="wpaxos",
+        load_config=load,
+        roles={
+            "leader": Role(
+                lambda c: list(c.leader_addresses),
+                lambda ctx, a, i: m.WPaxosLeader(
+                    a, ctx.transport, ctx.logger, ctx.config,
+                    ctx.opts(m.WPaxosLeaderOptions))),
+            "acceptor": Role(
+                lambda c: [a for row in c.acceptor_addresses
+                           for a in row],
+                lambda ctx, a, i: m.WPaxosAcceptor(
+                    a, ctx.transport, ctx.logger, ctx.config,
+                    wal=ctx.wal(f"acceptor_{i}"))),
+            "replica": Role(
+                lambda c: list(c.replica_addresses),
+                lambda ctx, a, i: m.WPaxosReplica(
+                    a, ctx.transport, ctx.logger, ctx.config,
+                    **ctx.kw(m.WPaxosReplica))),
+        },
+        make_client=lambda ctx, a: m.WPaxosClient(
+            a, ctx.transport, ctx.logger, ctx.config, seed=ctx.seed,
+            options=ctx.opts(m.WPaxosClientOptions)),
+        # Pseudonyms rotate so closed-loop drivers can keep several
+        # commands in flight; keys spread the load across groups.
+        drive=lambda client, tag, cb: client.write(
+            tag % 16, b"w%d" % tag, cb, key=b"obj-%d" % (tag % 8)),
+        cluster=lambda f, port: {
+            "zones": [f"zone-{z}" for z in range(3)],
+            "leaders": [port() for _ in range(3)],
+            "acceptors": [[port() for _ in range(2 * f + 1)]
+                          for _ in range(3)],
+            "replicas": [port() for _ in range(3)],
+            "num_groups": 4,
+        },
+    )
+
+
 REGISTRY: "dict[str, Callable[[], Protocol]]" = {
     "echo": _echo,
     "unreplicated": _unreplicated,
@@ -1001,6 +1054,7 @@ REGISTRY: "dict[str, Callable[[], Protocol]]" = {
     "fasterpaxos": _fasterpaxos,
     "craq": _craq,
     "scalog": _scalog,
+    "wpaxos": _wpaxos,
 }
 
 PROTOCOL_NAMES = sorted(REGISTRY)

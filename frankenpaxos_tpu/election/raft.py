@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import time
 from typing import Callable, Optional, Sequence
 
 from frankenpaxos_tpu.runtime import Actor, Logger
@@ -41,6 +42,13 @@ class RaftElectionOptions:
     no_ping_timeout_max_s: float = 12.0
     not_enough_votes_timeout_min_s: float = 10.0
     not_enough_votes_timeout_max_s: float = 12.0
+    # Jitter tolerance: derive the no-ping deadline from observed
+    # inter-ping gaps (EWMA + deviation, geo.RttEstimator) instead of
+    # the fixed window -- see election/basic.py's twin knobs.
+    adaptive: bool = False
+    adaptive_multiplier: float = 3.0
+    min_no_ping_timeout_s: float = 0.01
+    initial_no_ping_timeout_s: float = 1.0
 
 
 class RaftElectionParticipant(Actor):
@@ -50,12 +58,21 @@ class RaftElectionParticipant(Actor):
                  logger: Logger, addresses: Sequence[Address],
                  leader: Optional[Address] = None,
                  options: RaftElectionOptions = RaftElectionOptions(),
-                 seed: int = 0):
+                 seed: int = 0,
+                 clock: Optional[Callable[[], float]] = None):
         super().__init__(address, transport, logger)
         self.addresses = list(addresses)
         logger.check(address in self.addresses)
         self.options = options
         self._rng = random.Random(seed)
+        self.clock = clock or time.monotonic
+        if options.adaptive:
+            from frankenpaxos_tpu.geo.rtt import RttEstimator
+
+            self._gap_estimator: Optional[RttEstimator] = RttEstimator()
+        else:
+            self._gap_estimator = None
+        self._last_ping_at: Optional[float] = None
         self.callbacks: list[Callable[[Address], None]] = []
         self.round = 0
         self.votes: set[Address] = set()
@@ -90,12 +107,29 @@ class RaftElectionParticipant(Actor):
         timer.start()
         self._timer = timer
 
+    def _no_ping_delay(self) -> float:
+        fixed = self._rng.uniform(self.options.no_ping_timeout_min_s,
+                                  self.options.no_ping_timeout_max_s)
+        est = self._gap_estimator
+        if est is None:
+            return fixed
+        if est.srtt is None:
+            return max(fixed, self.options.initial_no_ping_timeout_s)
+        delay = est.timeout(fixed) * self.options.adaptive_multiplier
+        delay *= 1 + self._rng.uniform(0, 0.5)
+        return max(self.options.min_no_ping_timeout_s, delay)
+
+    def _observe_ping_gap(self) -> None:
+        if self._gap_estimator is None:
+            return
+        now = self.clock()
+        if self._last_ping_at is not None:
+            self._gap_estimator.observe(now - self._last_ping_at)
+        self._last_ping_at = now
+
     def _start_no_ping_timer(self) -> None:
-        timer = self.timer(
-            "noPing",
-            self._rng.uniform(self.options.no_ping_timeout_min_s,
-                              self.options.no_ping_timeout_max_s),
-            self._transition_to_candidate)
+        timer = self.timer("noPing", self._no_ping_delay(),
+                           self._transition_to_candidate)
         timer.start()
         self._timer = timer
 
@@ -115,6 +149,9 @@ class RaftElectionParticipant(Actor):
     def _transition_to_follower(self, new_round: int,
                                 leader: Address) -> None:
         self._stop_timer()
+        # Gaps spanning an election outage / leader change are not
+        # RTT samples; restart observation from the next ping.
+        self._last_ping_at = None
         self.round = new_round
         self.state = "follower"
         self.leader_address = leader
@@ -124,6 +161,7 @@ class RaftElectionParticipant(Actor):
 
     def _transition_to_candidate(self) -> None:
         self._stop_timer()
+        self._last_ping_at = None
         self.round += 1
         self.state = "candidate"
         self.votes = set()
@@ -151,6 +189,9 @@ class RaftElectionParticipant(Actor):
         if self.state == "leaderless_follower":
             self._transition_to_follower(ping.round, src)
         elif self.state == "follower":
+            self._observe_ping_gap()
+            if self._gap_estimator is not None:
+                self._timer.set_delay(self._no_ping_delay())
             self._timer.reset()
         elif self.state == "candidate":
             self._transition_to_follower(ping.round, src)

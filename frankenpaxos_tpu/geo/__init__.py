@@ -1,0 +1,37 @@
+"""paxgeo: wide-area simulation + per-object multi-leader machinery.
+
+The geo layer has two halves (docs/GEO.md):
+
+  * a SIMULATION substrate -- :class:`GeoTopology` (named zones
+    grouped into regions, a per-link latency/jitter matrix sampled
+    deterministically per seed, link-level partition/degrade controls)
+    and :class:`GeoSimTransport` (a ``SimTransport`` whose deliveries
+    are ordered by VIRTUAL ARRIVAL TIME, not FIFO enqueue, with a
+    virtual-clock event loop for latency benchmarking); and
+
+  * PROTOCOL machinery for WPaxos-style per-object leadership --
+    :class:`ObjectEpochStore` (one paxepoch-flavored epoch chain per
+    object group; an object steal is an epoch change),
+    :class:`GeoQuorumTracker` (dict oracle / fused
+    ``EpochSegmentedChecker`` vote counting over per-epoch
+    ``ZoneGrid`` specs), and :class:`RttEstimator` (the EWMA +
+    deviation timeout bound heartbeat/election/clients derive their
+    timers from once links have real latency).
+"""
+
+from frankenpaxos_tpu.geo.epochs import GeoEpoch, ObjectEpochStore
+from frankenpaxos_tpu.geo.quorum import GeoQuorumTracker
+from frankenpaxos_tpu.geo.rtt import RttEstimator
+from frankenpaxos_tpu.geo.topology import GeoTopology, Link
+from frankenpaxos_tpu.geo.transport import GeoSimTimer, GeoSimTransport
+
+__all__ = [
+    "GeoEpoch",
+    "GeoQuorumTracker",
+    "GeoSimTimer",
+    "GeoSimTransport",
+    "GeoTopology",
+    "Link",
+    "ObjectEpochStore",
+    "RttEstimator",
+]

@@ -1,0 +1,144 @@
+"""GeoQuorumTracker: per-object-group vote counting over epoch planes.
+
+The paxgeo twin of ``reconfig.tracker.EpochQuorumTracker``. Each
+object group's slot space is partitioned by its steal epochs, and each
+epoch's Phase2 predicate is a ``ZoneGrid.home_write_spec`` -- a
+majority of the home zone's row over the full grid universe. Two
+backends, bit-identical (tests/test_geo.py):
+
+  * ``dict`` -- the oracle: per-(slot, ballot) voter sets checked with
+    ``QuorumSpec.check`` against the slot's epoch plane.
+  * ``tpu`` -- one ``ops.quorum.EpochSegmentedChecker`` scatter per
+    event-loop drain; the plane is selected per slot INSIDE the fused
+    kernel, so a drain spanning a steal handover stays one dispatch --
+    the specs feed the checker UNCHANGED, which is the point: the
+    fused TPU quorum machinery already speaks flexible grid quorums.
+
+Both report each (slot, ballot)'s quorum exactly once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from frankenpaxos_tpu.geo.epochs import ObjectEpochStore
+from frankenpaxos_tpu.quorums import ZoneGrid
+
+
+class GeoQuorumTracker:
+    def __init__(self, store: ObjectEpochStore, group: int,
+                 grid: ZoneGrid, backend: str = "dict",
+                 window: int = 4096):
+        if backend not in ("dict", "tpu"):
+            raise ValueError(f"unknown geo tracker backend {backend!r}")
+        self.store = store
+        self.group = group
+        self.grid = grid
+        self.backend = backend
+        self.window = window
+        self._known = store.known(group)
+        # dict backend: (slot, ballot) -> set of acceptor ids; None
+        # once reported (Done).
+        self._states: dict = {}
+        self._newly: list = []
+        # tpu backend: per-drain vote buffer + the segmented checker.
+        self._checker = None
+        self._slots: list = []
+        self._cols: list = []
+        self._ballots: list = []
+        self._chunk = 256
+        if backend == "tpu":
+            self._build_checker()
+
+    def _specs_and_starts(self) -> tuple:
+        chain = self.store.known(self.group)
+        return ([self.grid.home_write_spec(e.home_zone) for e in chain],
+                [e.start_slot for e in chain])
+
+    def _build_checker(self) -> None:
+        from frankenpaxos_tpu.ops.quorum import EpochSegmentedChecker
+
+        specs, starts = self._specs_and_starts()
+        self._checker = EpochSegmentedChecker(specs, starts,
+                                              window=self.window)
+        # Prewarm the scatter buckets before client traffic.
+        self._checker.record_and_check([0], [0], [-1])
+        self._checker.release([0])
+
+    def note_epochs(self) -> None:
+        """Refresh after the store committed a steal. Pure appends
+        extend the checker's plane stack in place (the universe is the
+        fixed grid, so columns never move); a ballot-superseded newest
+        epoch (a lost steal race) rebuilds it, dropping buffered votes
+        -- they voted for the superseded owner's proposals, which
+        protocol-level resends re-drive."""
+        known = self.store.known(self.group)
+        if known == self._known:
+            return
+        if self._checker is not None:
+            if known[:len(self._known)] == self._known:
+                for entry in known[len(self._known):]:
+                    self._checker.add_epoch(
+                        self.grid.home_write_spec(entry.home_zone),
+                        entry.start_slot)
+            else:
+                self._build_checker()
+                self._slots, self._cols, self._ballots = [], [], []
+        self._known = known
+
+    # --- recording (per message, O(1) Python) -------------------------------
+    def record(self, slot: int, ballot: int, acceptor: int) -> None:
+        if self.backend == "dict":
+            self._record_dict(slot, ballot, acceptor)
+            return
+        self._slots.append(slot)
+        self._cols.append(acceptor)
+        self._ballots.append(ballot)
+
+    def _record_dict(self, slot: int, ballot: int, acceptor: int) -> None:
+        key = (slot, ballot)
+        votes = self._states.get(key)
+        if votes is None and key in self._states:
+            return  # Done
+        if votes is None:
+            votes = set()
+            self._states[key] = votes
+        votes.add(acceptor)
+        entry = self.store.epoch_of_slot(self.group, slot)
+        spec = self.grid.home_write_spec(entry.home_zone)
+        if spec.check(votes):
+            self._states[key] = None
+            self._newly.append(key)
+
+    # --- drain --------------------------------------------------------------
+    def drain(self) -> list:
+        """Newly complete ``(slot, ballot)`` quorums since the last
+        drain (one fused kernel dispatch per drain on the tpu
+        backend)."""
+        if self.backend == "dict":
+            newly, self._newly = self._newly, []
+            return newly
+        if not self._slots:
+            return []
+        slots = np.asarray(self._slots, dtype=np.int64)
+        cols = np.asarray(self._cols, dtype=np.int32)
+        ballots = np.asarray(self._ballots, dtype=np.int32)
+        self._slots, self._cols, self._ballots = [], [], []
+        out: list = []
+        seen: set = set()
+        for at in range(0, slots.size, self._chunk):
+            sl = slots[at:at + self._chunk]
+            newly = self._checker.record_and_check(
+                sl, cols[at:at + self._chunk],
+                ballots[at:at + self._chunk])
+            for i in np.flatnonzero(newly).tolist():
+                key = (int(sl[i]), int(ballots[at + i]))
+                if key[0] not in seen:
+                    seen.add(key[0])
+                    out.append(key)
+        return out
+
+    def release(self, slots) -> None:
+        """Watermark GC passthrough (ring wrap for the tpu board)."""
+        if self._checker is not None and len(slots):
+            self._checker.release(np.asarray(slots))

@@ -1,0 +1,227 @@
+"""GeoSimTransport: SimTransport with latency-ordered delivery.
+
+Plain ``SimTransport`` buffers frames and lets the caller deliver them
+in any order -- FIFO for integration tests, adversarially for the
+randomized sims. The geo transport adds a VIRTUAL CLOCK: every send
+samples its link's delay from the :class:`GeoTopology` matrix
+(deterministic per seed) and stamps an arrival time, and the event
+loop (:meth:`run_for` / :meth:`run_until`) delivers strictly in
+arrival order -- so a zone-local ack genuinely overtakes a WAN frame
+sent earlier, which is the whole phenomenon the wide-area suite
+exists to exercise. Latencies measured against :attr:`now` are exact
+virtual durations, which is what makes the bench gates in
+``bench/geo_lt.py`` sharp instead of host-noise-bound.
+
+Event scheduling is a pair of LAZY HEAPS (arrival times, timer
+deadlines): push on send/start, validate against the authoritative
+dicts on pop -- out-of-band removals (adversarial deliveries, link
+drops, timer stops) just leave stale heap entries to be skipped, so
+every per-event operation is O(log n) instead of a buffer scan.
+
+The adversarial simulator API is unchanged: ``generate_command`` /
+``deliver_message`` still deliver ANY buffered frame, so the chaos
+sims explore reorderings beyond what latencies would produce, with
+link partitions/degrades applied at delivery time. The bounded-inbox
+admission path is NOT armed here (geo harnesses attach no admission
+controllers); arrival stamping covers synthesized reject replies
+anyway because stamps are derived per buffered frame.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Optional
+
+from frankenpaxos_tpu.geo.topology import GeoTopology
+from frankenpaxos_tpu.runtime.logger import Logger
+from frankenpaxos_tpu.runtime.sim_transport import (
+    SimMessage,
+    SimTimer,
+    SimTransport,
+)
+from frankenpaxos_tpu.runtime.transport import Address
+
+
+class GeoSimTimer(SimTimer):
+    """A SimTimer with a virtual deadline: (re)stamped from the
+    transport's clock on every start, so the event loop fires it at
+    ``now + delay_s`` like a real timer wheel."""
+
+    def start(self) -> None:
+        super().start()
+        deadline = self._transport.now + self.delay_s
+        self._transport._deadlines[self._id] = deadline
+        heapq.heappush(self._transport._deadline_heap,
+                       (deadline, self._id))
+
+    def stop(self) -> None:
+        super().stop()
+        self._transport._deadlines.pop(self._id, None)
+
+
+class GeoSimTransport(SimTransport):
+    def __init__(self, topology: GeoTopology,
+                 logger: Optional[Logger] = None):
+        super().__init__(logger)
+        self.topology = topology
+        #: The virtual clock, in seconds. Advanced only by the event
+        #: loop (never by wall time -- determinism contract, GEO801).
+        self.now = 0.0
+        #: message id -> virtual arrival time (authoritative; heap
+        #: entries are valid only while they match).
+        self.arrivals: dict[int, float] = {}
+        self._by_id: dict[int, SimMessage] = {}
+        self._arrival_heap: list = []
+        #: timer id -> virtual deadline (running timers only).
+        self._deadlines: dict[int, float] = {}
+        self._deadline_heap: list = []
+
+    # --- sending ----------------------------------------------------------
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        before = len(self.messages)
+        super().send(src, dst, data)
+        # Stamp every frame this send buffered (the frame itself, plus
+        # any reject replies a bounded inbox synthesized), each over
+        # its OWN link.
+        for message in self.messages[before:]:
+            arrival = self.now + self.topology.sample_delay(
+                message.src, message.dst, message.id)
+            self.arrivals[message.id] = arrival
+            self._by_id[message.id] = message
+            heapq.heappush(self._arrival_heap, (arrival, message.id))
+
+    def timer(self, address: Address, name: str, delay_s: float,
+              f) -> GeoSimTimer:
+        return GeoSimTimer(self, next(self._ids), address, name,
+                           delay_s, f)
+
+    # --- delivery ---------------------------------------------------------
+    def _deliver(self, message: SimMessage):
+        self.arrivals.pop(message.id, None)
+        self._by_id.pop(message.id, None)
+        if not self.topology.link_up(message.src, message.dst):
+            # Dropped on the partitioned link: consume the frame
+            # without running the handler (the sim's per-address
+            # ``partitioned`` drop semantics, at link granularity).
+            try:
+                self.messages.remove(message)
+            except ValueError:
+                self.logger.warn(
+                    f"dropping unbuffered message {message}")
+            return None
+        return super()._deliver(message)
+
+    # --- the virtual-time event loop --------------------------------------
+    @staticmethod
+    def _peek(heap: list, live: dict) -> Optional[float]:
+        while heap:
+            t, key = heap[0]
+            if live.get(key) == t:
+                return t
+            heapq.heappop(heap)
+        return None
+
+    def next_event_time(self) -> Optional[float]:
+        t_msg = self._peek(self._arrival_heap, self.arrivals)
+        t_tmr = self._peek(self._deadline_heap, self._deadlines)
+        if t_msg is None:
+            return t_tmr
+        if t_tmr is None:
+            return t_msg
+        return min(t_msg, t_tmr)
+
+    def _pop_due_messages(self, t: float) -> list:
+        """Every buffered frame with arrival <= ``t``, in (arrival,
+        send id) order; their heap/stamp entries are consumed."""
+        due = []
+        while self._arrival_heap:
+            arrival, message_id = self._arrival_heap[0]
+            if arrival > t:
+                break
+            heapq.heappop(self._arrival_heap)
+            if self.arrivals.get(message_id) == arrival:
+                message = self._by_id.get(message_id)
+                if message is not None:
+                    due.append(message)
+        return due
+
+    def run_until(self, t_end: float, max_steps: int = 1_000_000) -> int:
+        """Advance virtual time to ``t_end``, delivering frames in
+        arrival order and firing timers at their deadlines. Frames
+        sharing one timestamp land as one wave and each touched
+        destination drains once -- the event-loop batching semantics
+        of the real transport. Returns the number of events run."""
+        steps = 0
+        while steps < max_steps:
+            t = self.next_event_time()
+            if t is None or t > t_end:
+                break
+            self.now = t
+            touched: list = []
+            seen: set[int] = set()
+            for message in self._pop_due_messages(t):
+                actor = self._deliver(message)
+                steps += 1
+                if actor is not None and id(actor) not in seen:
+                    seen.add(id(actor))
+                    touched.append(actor)
+            for actor in touched:
+                self._drain(actor)
+            # Timers due at (or before) t.
+            while self._deadline_heap:
+                deadline, timer_id = self._deadline_heap[0]
+                if deadline > t:
+                    break
+                heapq.heappop(self._deadline_heap)
+                if self._deadlines.get(timer_id) == deadline:
+                    self.trigger_timer(timer_id)
+                    steps += 1
+        self.now = max(self.now, t_end)
+        return steps
+
+    def run_for(self, duration: float,
+                max_steps: int = 1_000_000) -> int:
+        return self.run_until(self.now + duration, max_steps=max_steps)
+
+    def run_until_quiescent(self, max_steps: int = 1_000_000,
+                            horizon_s: float = 3600.0) -> int:
+        """Deliver every in-flight frame (following arrival order and
+        any sends they trigger) WITHOUT firing timers -- virtual time
+        advances past deadlines but the timers stay pending, so a
+        settle can never be kept awake by resend churn. Bounded by
+        ``horizon_s`` of virtual time. The settle primitive for
+        integration tests; timer-driven runs use :meth:`run_for`."""
+        steps = 0
+        t_end = self.now + horizon_s
+        while steps < max_steps:
+            t = self._peek(self._arrival_heap, self.arrivals)
+            if t is None or t > t_end:
+                break
+            self.now = max(self.now, t)
+            _, message_id = heapq.heappop(self._arrival_heap)
+            message = self._by_id.get(message_id)
+            if message is None:
+                continue
+            actor = self._deliver(message)
+            steps += 1
+            if actor is not None:
+                self._drain(actor)
+        return steps
+
+    def crash(self, address: Address) -> None:
+        super().crash(address)
+        self._deadlines = {tid: d for tid, d in self._deadlines.items()
+                           if tid in self.timers}
+
+
+def delivery_schedule(transport: GeoSimTransport) -> list:
+    """The in-flight frames as ``(arrival_s, id, src, dst)`` rows in
+    delivery order -- the projection the golden determinism test
+    snapshots (tests/test_geo.py)."""
+    rows = []
+    for message in transport.messages:
+        arrival = transport.arrivals.get(message.id)
+        if arrival is not None:
+            heapq.heappush(rows, (round(arrival, 12), message.id,
+                                  str(message.src), str(message.dst)))
+    return [heapq.heappop(rows) for _ in range(len(rows))]

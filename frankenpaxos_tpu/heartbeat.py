@@ -33,12 +33,29 @@ class Pong:
 @dataclasses.dataclass(frozen=True)
 class HeartbeatOptions:
     """Mimics TCP keepalive's interval/time/retry knobs
-    (Participant.scala:38-60)."""
+    (Participant.scala:38-60).
+
+    ``adaptive=True`` derives each peer's fail deadline from OBSERVED
+    round-trip times instead of the fixed ``fail_period_s``: a
+    Jacobson/Karels estimator (geo.RttEstimator, EWMA + mean
+    deviation) per peer, with the deadline at ``srtt + 4 * dev``
+    clamped to ``[min_fail_period_s, max_fail_period_s]``. Fixed
+    deadlines false-positive the moment links have real latency and
+    jitter (a WAN brownout under GeoTopology blows straight through
+    any constant chosen for the fast path -- tests/test_geo.py);
+    ``fail_period_s`` remains the deadline until the first pong."""
 
     fail_period_s: float = 5.0
     success_period_s: float = 10.0
     num_retries: int = 3
     network_delay_alpha: float = 0.9
+    adaptive: bool = False
+    min_fail_period_s: float = 0.01
+    max_fail_period_s: float = 120.0
+    # Until the first pong there is no RTT sample, so adaptive mode
+    # starts CONSERVATIVE (TCP's initial-RTO discipline) instead of
+    # trusting a constant that may sit below the real RTT.
+    initial_fail_period_s: float = 1.0
 
 
 class HeartbeatParticipant(Actor):
@@ -54,9 +71,19 @@ class HeartbeatParticipant(Actor):
         self.clock = clock
         self.num_retries = [0] * len(self.addresses)
         self.network_delay_nanos: dict[int, float] = {}
+        if options.adaptive:
+            from frankenpaxos_tpu.geo.rtt import RttEstimator
+
+            self.rtt_estimators = [RttEstimator()
+                                   for _ in self.addresses]
+        else:
+            self.rtt_estimators = None
         self.alive: set[Address] = set(self.addresses)
+        initial_fail_s = (max(options.fail_period_s,
+                              options.initial_fail_period_s)
+                          if options.adaptive else options.fail_period_s)
         self.fail_timers = [
-            self.timer(f"fail-{a}", options.fail_period_s,
+            self.timer(f"fail-{a}", initial_fail_s,
                        lambda i=i: self._fail(i))
             for i, a in enumerate(self.addresses)]
         self.success_timers = [
@@ -77,11 +104,22 @@ class HeartbeatParticipant(Actor):
             self.logger.fatal(f"unexpected heartbeat message {message!r}")
 
     def _handle_pong(self, pong: Pong) -> None:
-        delay = (self.clock() - pong.nanotime) / 2
+        rtt_nanos = self.clock() - pong.nanotime
+        delay = rtt_nanos / 2
         alpha = self.options.network_delay_alpha
         old = self.network_delay_nanos.get(pong.index)
         self.network_delay_nanos[pong.index] = (
             delay if old is None else alpha * delay + (1 - alpha) * old)
+        if self.rtt_estimators is not None:
+            # Jitter-tolerant deadlines (geo.RttEstimator): retune the
+            # peer's fail timer to srtt + 4*dev before its next start,
+            # so one WAN jitter spike no longer burns a retry.
+            estimator = self.rtt_estimators[pong.index]
+            estimator.observe(rtt_nanos / 1e9)
+            self.fail_timers[pong.index].set_delay(min(
+                self.options.max_fail_period_s,
+                max(self.options.min_fail_period_s,
+                    estimator.timeout(self.options.fail_period_s))))
         self.alive.add(self.addresses[pong.index])
         self.num_retries[pong.index] = 0
         self.fail_timers[pong.index].stop()

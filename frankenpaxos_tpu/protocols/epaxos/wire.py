@@ -23,11 +23,15 @@ from frankenpaxos_tpu.protocols.epaxos.messages import (
     ClientReply,
     ClientRequest,
     Command,
+    CommandStatus,
     Commit,
+    Nack,
     NOOP,
     Noop,
     PreAccept,
     PreAcceptOk,
+    Prepare,
+    PrepareOk,
 )
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
     _put_address,
@@ -216,7 +220,92 @@ class EPaxosClientReplyCodec(MessageCodec):
         return ClientReply(pseudonym, id, result), at
 
 
+# --- the recovery cold path (COD301 burn-down, extended tags 173-175) -------
+
+_STATUS_CODES = {
+    CommandStatus.NOT_SEEN: 0,
+    CommandStatus.PRE_ACCEPTED: 1,
+    CommandStatus.ACCEPTED: 2,
+    CommandStatus.COMMITTED: 3,
+}
+_STATUS_BY_CODE = {v: k for k, v in _STATUS_CODES.items()}
+
+
+class PrepareCodec(MessageCodec):
+    message_type = Prepare
+    tag = 173
+
+    def encode(self, out, message):
+        _put_header(out, message.instance, message.ballot)
+
+    def decode(self, buf, at):
+        instance, ballot, at = _take_header(buf, at)
+        return Prepare(instance=instance, ballot=ballot), at
+
+
+class EPaxosNackCodec(MessageCodec):
+    message_type = Nack
+    tag = 174
+
+    def encode(self, out, message):
+        _put_header(out, message.instance, message.largest_ballot)
+
+    def decode(self, buf, at):
+        instance, ballot, at = _take_header(buf, at)
+        return Nack(instance=instance, largest_ballot=ballot), at
+
+
+class PrepareOkCodec(MessageCodec):
+    """header + replica + vote ballot + status byte + optional
+    (command, seq, deps) -- absent exactly when the acceptor had
+    NOT_SEEN state (the reply's Optionals)."""
+
+    message_type = PrepareOk
+    tag = 175
+
+    def encode(self, out, message):
+        _put_header(out, message.instance, message.ballot)
+        out += _I32.pack(message.replica_index)
+        out += _I64.pack(message.vote_ballot[0])
+        out += _I32.pack(message.vote_ballot[1])
+        out.append(_STATUS_CODES[message.status])
+        if message.command_or_noop is None:
+            out.append(0)
+            return
+        out.append(1)
+        _put_command_or_noop(out, message.command_or_noop)
+        out += _I64.pack(message.sequence_number)
+        _put_deps(out, message.dependencies)
+
+    def decode(self, buf, at):
+        instance, ballot, at = _take_header(buf, at)
+        (replica,) = _I32.unpack_from(buf, at)
+        (b0,) = _I64.unpack_from(buf, at + 4)
+        (b1,) = _I32.unpack_from(buf, at + 12)
+        at += 16
+        status = _STATUS_BY_CODE.get(buf[at])
+        if status is None:
+            raise ValueError(f"unknown PrepareOk status {buf[at]}")
+        present = buf[at + 1]
+        at += 2
+        if not present:
+            return PrepareOk(ballot=ballot, instance=instance,
+                             replica_index=replica,
+                             vote_ballot=(b0, b1), status=status,
+                             command_or_noop=None,
+                             sequence_number=None,
+                             dependencies=None), at
+        value, at = _take_command_or_noop(buf, at)
+        (seq,) = _I64.unpack_from(buf, at)
+        deps, at = _take_deps(buf, at + 8)
+        return PrepareOk(ballot=ballot, instance=instance,
+                         replica_index=replica, vote_ballot=(b0, b1),
+                         status=status, command_or_noop=value,
+                         sequence_number=seq, dependencies=deps), at
+
+
 for _codec in (PreAcceptCodec(), PreAcceptOkCodec(), AcceptCodec(),
                AcceptOkCodec(), CommitCodec(),
-               EPaxosClientRequestCodec(), EPaxosClientReplyCodec()):
+               EPaxosClientRequestCodec(), EPaxosClientReplyCodec(),
+               PrepareCodec(), EPaxosNackCodec(), PrepareOkCodec()):
     register_codec(_codec)

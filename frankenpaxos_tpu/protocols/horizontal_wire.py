@@ -138,6 +138,90 @@ class HClientReplyCodec(MessageCodec):
                              result), at
 
 
+# --- the reconfiguration/chaos cold path (COD301 burn-down, 179-180) --------
+
+_I32 = struct.Struct("<i")
+_QS_KINDS = {"simple_majority": 0, "unanimous_writes": 1, "grid": 2,
+             "zone_grid": 3}
+_QS_BY_CODE = {v: k for k, v in _QS_KINDS.items()}
+_MAX_NODES = 4096
+
+
+def _take_node_list(buf: bytes, at: int):
+    (n,) = _I32.unpack_from(buf, at)
+    at += 4
+    if not 0 <= n <= _MAX_NODES:
+        raise ValueError(f"malformed node list: count {n}")
+    nodes = []
+    for _ in range(n):
+        (node,) = _I64.unpack_from(buf, at)
+        nodes.append(node)
+        at += 8
+    return nodes, at
+
+
+class HReconfigureCodec(MessageCodec):
+    """The wire form of ``quorums.quorum_system_to_dict``: a kind
+    byte plus the member list (flat kinds) or the row-major grid."""
+
+    message_type = m.Reconfigure
+    tag = 179
+
+    def encode(self, out, message):
+        d = message.quorum_system
+        code = _QS_KINDS.get(d.get("kind"))
+        if code is None:
+            raise ValueError(f"unknown quorum system {d!r}")
+        out.append(code)
+        if code >= 2:
+            grid = d["grid"]
+            out += _I32.pack(len(grid))
+            out += _I32.pack(len(grid[0]) if grid else 0)
+            for row in grid:
+                for node in row:
+                    out += _I64.pack(node)
+        else:
+            out += _I32.pack(len(d["members"]))
+            for node in d["members"]:
+                out += _I64.pack(node)
+
+    def decode(self, buf, at):
+        kind = _QS_BY_CODE.get(buf[at])
+        if kind is None:
+            raise ValueError(f"unknown quorum system code {buf[at]}")
+        at += 1
+        if kind in ("grid", "zone_grid"):
+            (rows,) = _I32.unpack_from(buf, at)
+            (cols,) = _I32.unpack_from(buf, at + 4)
+            at += 8
+            if not (0 <= rows <= _MAX_NODES
+                    and 0 <= cols <= _MAX_NODES):
+                raise ValueError(f"malformed grid {rows}x{cols}")
+            grid = []
+            for _ in range(rows):
+                row = []
+                for _ in range(cols):
+                    (node,) = _I64.unpack_from(buf, at)
+                    row.append(node)
+                    at += 8
+                grid.append(row)
+            return m.Reconfigure({"kind": kind, "grid": grid}), at
+        members, at = _take_node_list(buf, at)
+        return m.Reconfigure({"kind": kind, "members": members}), at
+
+
+class HDieCodec(MessageCodec):
+    message_type = m.Die
+    tag = 180
+
+    def encode(self, out, message):
+        pass
+
+    def decode(self, buf, at):
+        return m.Die(), at
+
+
 for _codec in (HClientRequestCodec(), HPhase2aCodec(), HPhase2bCodec(),
+               HReconfigureCodec(), HDieCodec(),
                HChosenCodec(), HClientReplyCodec()):
     register_codec(_codec)

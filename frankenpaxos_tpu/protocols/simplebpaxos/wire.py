@@ -26,8 +26,11 @@ from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     Commit,
     DependencyReply,
     DependencyRequest,
+    Nack,
     NOOP,
     Noop,
+    Phase1a,
+    Phase1b,
     Phase2a,
     Phase2b,
     Propose,
@@ -221,8 +224,70 @@ class BPaxosClientReplyCodec(MessageCodec):
         return ClientReply(pseudonym, id, result), at
 
 
+# --- the recovery cold path (COD301 burn-down, extended tags 176-178) -------
+
+
+class BPaxosPhase1aCodec(MessageCodec):
+    message_type = Phase1a
+    tag = 176
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        out += _I64.pack(message.round)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        (round,) = _I64.unpack_from(buf, at)
+        return Phase1a(vertex_id=vertex_id, round=round), at + 8
+
+
+class BPaxosPhase1bCodec(MessageCodec):
+    message_type = Phase1b
+    tag = 177
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        out += _I32.pack(message.acceptor_id)
+        out += _I64I64.pack(message.round, message.vote_round)
+        if message.vote_value is None:
+            out.append(0)
+        else:
+            out.append(1)
+            _put_vote_value(out, message.vote_value)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        (acceptor_id,) = _I32.unpack_from(buf, at)
+        round, vote_round = _I64I64.unpack_from(buf, at + 4)
+        present = buf[at + 20]
+        at += 21
+        vote_value = None
+        if present:
+            vote_value, at = _take_vote_value(buf, at)
+        return Phase1b(vertex_id=vertex_id, acceptor_id=acceptor_id,
+                       round=round, vote_round=vote_round,
+                       vote_value=vote_value), at
+
+
+class BPaxosNackCodec(MessageCodec):
+    message_type = Nack
+    tag = 178
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+        out += _I64.pack(message.higher_round)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        (higher_round,) = _I64.unpack_from(buf, at)
+        return Nack(vertex_id=vertex_id,
+                    higher_round=higher_round), at + 8
+
+
 for _codec in (BPaxosClientRequestCodec(), DependencyRequestCodec(),
                DependencyReplyCodec(), ProposeCodec(),
                BPaxosPhase2aCodec(), BPaxosPhase2bCodec(),
-               BPaxosCommitCodec(), BPaxosClientReplyCodec()):
+               BPaxosCommitCodec(), BPaxosClientReplyCodec(),
+               BPaxosPhase1aCodec(), BPaxosPhase1bCodec(),
+               BPaxosNackCodec()):
     register_codec(_codec)

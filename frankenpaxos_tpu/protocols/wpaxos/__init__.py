@@ -1,0 +1,35 @@
+"""WPaxos: wide-area per-object multi-leader Paxos (paxgeo).
+
+Per-object leader placement across zones with asymmetric flexible
+grid quorums (WPaxos, arxiv 1703.08905; quorum relaxation licensed by
+Flexible Paxos, arxiv 1608.06696): commands partition by object into
+groups, each group's leader lives in the object's home zone and
+commits through a zone-local ``ZoneGrid`` row, and moving an object is
+an epoch change (``geo.ObjectEpochStore``) committed by a cross-zone
+Phase1 at f+1 WAL-durable old-home acks -- the paxepoch recipe, so
+steals inherit WAL durability and watermark-bounded handover for
+free. See docs/GEO.md.
+"""
+
+from frankenpaxos_tpu.protocols.wpaxos import wire  # noqa: F401  - registers codecs
+from frankenpaxos_tpu.protocols.wpaxos.acceptor import WPaxosAcceptor
+from frankenpaxos_tpu.protocols.wpaxos.client import (
+    WPaxosClient,
+    WPaxosClientOptions,
+)
+from frankenpaxos_tpu.protocols.wpaxos.config import WPaxosConfig
+from frankenpaxos_tpu.protocols.wpaxos.leader import (
+    WPaxosLeader,
+    WPaxosLeaderOptions,
+)
+from frankenpaxos_tpu.protocols.wpaxos.replica import WPaxosReplica
+
+__all__ = [
+    "WPaxosAcceptor",
+    "WPaxosClient",
+    "WPaxosClientOptions",
+    "WPaxosConfig",
+    "WPaxosLeader",
+    "WPaxosLeaderOptions",
+    "WPaxosReplica",
+]

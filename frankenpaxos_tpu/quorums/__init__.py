@@ -19,6 +19,7 @@ from frankenpaxos_tpu.quorums.systems import (
     QuorumSystem,
     SimpleMajority,
     UnanimousWrites,
+    ZoneGrid,
 )
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "QuorumSystem",
     "SimpleMajority",
     "Grid",
+    "ZoneGrid",
     "UnanimousWrites",
     "quorum_system_from_dict",
     "quorum_system_to_dict",

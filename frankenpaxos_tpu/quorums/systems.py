@@ -169,6 +169,110 @@ class Grid(QuorumSystem):
         )
 
 
+class ZoneGrid(QuorumSystem):
+    """WPaxos-flavored asymmetric grid: rows are availability ZONES.
+
+    The transpose of :class:`Grid`'s asymmetry, tuned for wide-area
+    deployments (paxgeo, docs/GEO.md): a WRITE (Phase2) quorum is a
+    majority of ANY single row -- in steady state the leader uses its
+    home zone's row, so commits never cross a zone boundary -- while a
+    READ (Phase1) quorum takes a majority of EVERY row, the cross-zone
+    column sweep an object steal pays exactly once. Intersection
+    (Flexible Paxos, arxiv 1608.06696): a read quorum contains a
+    majority of whichever row a write quorum majority came from, and
+    two majorities of one row always intersect. This is the f_z = 0
+    WPaxos deployment (arxiv 1703.08905): zone-local commits, with a
+    full-zone outage stalling steals of that zone's objects until f+1
+    of its members recover from their WALs.
+    """
+
+    def __init__(self, grid: Sequence[Sequence[int]]):
+        if not grid:
+            raise ValueError("ZoneGrid needs at least one row")
+        if any(len(row) != len(grid[0]) for row in grid):
+            raise ValueError("ZoneGrid rows must be equal-sized")
+        self.grid = tuple(tuple(row) for row in grid)
+        self._rows = [frozenset(row) for row in self.grid]
+        self._nodes = frozenset().union(*self._rows)
+        if len(self._nodes) != sum(len(r) for r in self._rows):
+            raise ValueError("ZoneGrid rows must be disjoint")
+        self._universe = tuple(sorted(self._nodes))
+        self.row_majority = len(self.grid[0]) // 2 + 1
+
+    def __repr__(self):
+        return f"ZoneGrid(grid={self.grid})"
+
+    def nodes(self) -> frozenset[int]:
+        return self._nodes
+
+    def random_read_quorum(self, rng: random.Random) -> set[int]:
+        out: set[int] = set()
+        for row in self.grid:
+            out.update(rng.sample(row, self.row_majority))
+        return out
+
+    def random_write_quorum(self, rng: random.Random) -> set[int]:
+        row = self.grid[rng.randrange(len(self.grid))]
+        return set(rng.sample(row, self.row_majority))
+
+    def is_superset_of_read_quorum(self, xs: Iterable[int]) -> bool:
+        xs = set(xs)
+        return all(len(row & xs) >= self.row_majority
+                   for row in self._rows)
+
+    def is_superset_of_write_quorum(self, xs: Iterable[int]) -> bool:
+        xs = set(xs)
+        return any(len(row & xs) >= self.row_majority
+                   for row in self._rows)
+
+    def _row_masks(self) -> np.ndarray:
+        masks = np.zeros((len(self._rows), len(self._universe)),
+                         dtype=np.uint8)
+        col = {node: i for i, node in enumerate(self._universe)}
+        for g, row in enumerate(self._rows):
+            for node in row:
+                masks[g, col[node]] = 1
+        return masks
+
+    def read_spec(self) -> QuorumSpec:
+        return QuorumSpec(
+            masks=self._row_masks(),
+            thresholds=np.full(len(self._rows), self.row_majority,
+                               dtype=np.int32),
+            combine=ALL,
+            universe=self._universe,
+        )
+
+    def write_spec(self) -> QuorumSpec:
+        return QuorumSpec(
+            masks=self._row_masks(),
+            thresholds=np.full(len(self._rows), self.row_majority,
+                               dtype=np.int32),
+            combine=ANY,
+            universe=self._universe,
+        )
+
+    def home_write_spec(self, row_index: int) -> QuorumSpec:
+        """The write predicate ANCHORED at one row: a majority of row
+        ``row_index`` over the FULL grid universe (other rows' columns
+        are zero-masked, so their votes never count). This is the
+        per-epoch Phase2 spec paxgeo feeds the fused checkers -- each
+        object-steal epoch selects its home zone's plane."""
+        if not 0 <= row_index < len(self.grid):
+            raise ValueError(f"row {row_index} outside 0.."
+                             f"{len(self.grid) - 1}")
+        masks = np.zeros((1, len(self._universe)), dtype=np.uint8)
+        col = {node: i for i, node in enumerate(self._universe)}
+        for node in self.grid[row_index]:
+            masks[0, col[node]] = 1
+        return QuorumSpec(
+            masks=masks,
+            thresholds=np.array([self.row_majority], dtype=np.int32),
+            combine=ANY,
+            universe=self._universe,
+        )
+
+
 class UnanimousWrites(QuorumSystem):
     """One write quorum (all members); every non-empty subset reads.
 
@@ -217,6 +321,8 @@ def quorum_system_to_dict(qs: QuorumSystem) -> dict:
         return {"kind": "simple_majority", "members": sorted(qs.members)}
     if isinstance(qs, UnanimousWrites):
         return {"kind": "unanimous_writes", "members": sorted(qs.members)}
+    if isinstance(qs, ZoneGrid):
+        return {"kind": "zone_grid", "grid": [list(row) for row in qs.grid]}
     if isinstance(qs, Grid):
         return {"kind": "grid", "grid": [list(row) for row in qs.grid]}
     raise TypeError(f"unserializable quorum system {qs!r}")
@@ -231,4 +337,6 @@ def quorum_system_from_dict(d: dict) -> QuorumSystem:
         return UnanimousWrites(d["members"])
     if kind == "grid":
         return Grid(d["grid"])
+    if kind == "zone_grid":
+        return ZoneGrid(d["grid"])
     raise ValueError(f"unknown quorum system kind {kind!r}")

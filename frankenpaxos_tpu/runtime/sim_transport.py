@@ -78,6 +78,9 @@ class SimTimer(Timer):
         self.running = False
         self._transport.timers.pop(self._id, None)
 
+    def set_delay(self, delay_s: float) -> None:
+        self.delay_s = delay_s
+
     def run(self) -> None:
         """Fire the timer (one-shot: stops first, like
         FakeTransport.scala:40-46)."""

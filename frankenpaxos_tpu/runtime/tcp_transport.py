@@ -115,6 +115,9 @@ class TcpTimer(Timer):
     def stop(self) -> None:
         self._loop.call_soon_threadsafe(self._stop_on_loop)
 
+    def set_delay(self, delay_s: float) -> None:
+        self._delay_s = delay_s
+
     def _stop_on_loop(self) -> None:
         if self._handle is not None:
             self._handle.cancel()

@@ -42,6 +42,15 @@ class Timer(abc.ABC):
         self.stop()
         self.start()
 
+    def set_delay(self, delay_s: float) -> None:
+        """Update the delay used by the NEXT start(); a running
+        countdown is unaffected. Transports whose timers support
+        retuning override this -- it is how RTT-adaptive timeouts
+        (geo.RttEstimator: heartbeat fail periods, election no-ping
+        deadlines) retune without reconstructing timers."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support set_delay")
+
 
 class Transport(abc.ABC):
     """Asynchronous, unordered, at-most-once message delivery between

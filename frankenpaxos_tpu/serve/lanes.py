@@ -49,6 +49,12 @@ CLIENT_LANE_TYPE_NAMES = frozenset({
     "ProposeRequest",
     "LeaderInfoRequestClient",
     "LeaderInfoRequestBatcher",
+    # paxgeo: the WPaxos client write (protocols/wpaxos). Steal-mode
+    # resends ride the same type -- shedding them under overload is
+    # correct (the client keeps its failover budget); the steal
+    # CONTROL flow (WPhase1a/WEpochCommit) is leader-originated and
+    # stays control lane.
+    "WRequest",
     # paxwire: a batch frame of client requests must shed like the
     # requests themselves -- the transport's flush planner wraps runs
     # of client-lane payloads in this envelope (runtime/paxwire.py),

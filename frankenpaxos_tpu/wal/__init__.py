@@ -27,6 +27,9 @@ from frankenpaxos_tpu.wal.log import FileStorage, MemStorage, Wal, WalMetrics  #
 from frankenpaxos_tpu.wal.records import (  # noqa: F401
     WalChosenRun,
     WalEpoch,
+    WalGeoEpoch,
+    WalGeoPromise,
+    WalGeoVote,
     WalNoopRange,
     WalPromise,
     WalSnapshot,

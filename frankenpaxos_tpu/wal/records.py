@@ -96,6 +96,39 @@ class WalEpoch:
 
 
 @dataclasses.dataclass(frozen=True)
+class WalGeoPromise:
+    """paxgeo (protocols/wpaxos): the acceptor promised ``ballot`` for
+    object group ``group``. Durable BEFORE the Phase1b ack leaves the
+    acceptor -- a row-majority of these durable acks from the old home
+    zone is an object steal's commit point (docs/GEO.md)."""
+
+    group: int
+    ballot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class WalGeoVote:
+    """paxgeo: a per-(group, slot) vote; ``value`` is one wire-encoded
+    CommandBatchOrNoop (``wire.encode_value``, shared with
+    multipaxos)."""
+
+    group: int
+    slot: int
+    ballot: int
+    value: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class WalGeoEpoch:
+    """paxgeo: a committed object-steal epoch entry; ``payload`` is
+    the role-encoded GeoEpoch (``wpaxos.wire.encode_geo_epoch`` --
+    group, epoch, activation start slot, home zone, ballot). One
+    layout for the wire and the log, like WalEpoch."""
+
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
 class WalSnapshot:
     """A compaction base: everything before this record is superseded.
 
@@ -208,6 +241,47 @@ class WalEpochCodec(MessageCodec):
         return WalEpoch(payload=payload), at
 
 
+class WalGeoPromiseCodec(MessageCodec):
+    message_type = WalGeoPromise
+    tag = 8
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.group, message.ballot)
+
+    def decode(self, buf, at):
+        group, ballot = _I64I64.unpack_from(buf, at)
+        return WalGeoPromise(group=group, ballot=ballot), at + 16
+
+
+class WalGeoVoteCodec(MessageCodec):
+    message_type = WalGeoVote
+    tag = 9
+
+    def encode(self, out, message):
+        out += _QQQ.pack(message.group, message.slot, message.ballot)
+        out += _I32.pack(len(message.value))
+        out += message.value
+
+    def decode(self, buf, at):
+        group, slot, ballot = _QQQ.unpack_from(buf, at)
+        value, at = _take_bytes(buf, at + 24)
+        return WalGeoVote(group=group, slot=slot, ballot=ballot,
+                          value=value), at
+
+
+class WalGeoEpochCodec(MessageCodec):
+    message_type = WalGeoEpoch
+    tag = 10
+
+    def encode(self, out, message):
+        out += _I32.pack(len(message.payload))
+        out += message.payload
+
+    def decode(self, buf, at):
+        payload, at = _take_bytes(buf, at)
+        return WalGeoEpoch(payload=payload), at
+
+
 class WalSnapshotCodec(MessageCodec):
     message_type = WalSnapshot
     tag = 6
@@ -260,6 +334,8 @@ WAL_SERIALIZER = WalRecordSerializer()
 
 for _codec in (WalPromiseCodec(), WalVoteCodec(), WalVoteRunCodec(),
                WalNoopRangeCodec(), WalChosenRunCodec(),
-               WalSnapshotCodec(), WalEpochCodec()):
+               WalSnapshotCodec(), WalEpochCodec(),
+               WalGeoPromiseCodec(), WalGeoVoteCodec(),
+               WalGeoEpochCodec()):
     _RECORD_CODECS_BY_TYPE[_codec.message_type] = _codec
     _RECORD_CODECS_BY_TAG[_codec.tag] = _codec

@@ -1,0 +1,519 @@
+"""WPaxos (paxgeo): integration + chaos tests.
+
+Deterministic integration tests pin the steady-state zone-local
+commit path, steal adoption, WAL'd steal durability, zone outage ->
+WAL restart -> steal repair, and cross-region partition SAFETY (the
+minority side cannot steal). The chaos SimulatedSystem interleaves
+writes with link partitions, object steals, zone kills, and
+crash-restarts under the chosen-uniqueness / exactly-once oracle
+(tier-1 runs regression-smoke scale; tests/soak.py runs the full
+500x250 matrix)."""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+import pytest
+
+from frankenpaxos_tpu.geo import GeoTopology
+from frankenpaxos_tpu.protocols.wpaxos.messages import Steal
+from frankenpaxos_tpu.sim import SimulatedSystem, Simulator
+from tests.protocols.wpaxos_harness import (
+    crash_restart_acceptor,
+    crash_restart_leader,
+    crash_restart_replica,
+    crash_zone,
+    drive,
+    make_wpaxos,
+    restart_zone,
+    settle,
+)
+
+
+def geo3(seed: int = 0, jitter: float = 0.05) -> GeoTopology:
+    return GeoTopology({"r0": ["zone-0"], "r1": ["zone-1"],
+                        "r2": ["zone-2"]}, seed=seed, jitter=jitter)
+
+
+class TestIntegration:
+    def test_writes_ack_and_execute_on_every_replica(self):
+        sim = make_wpaxos()
+        got = drive(sim, 8, key_prefix=b"obj1")
+        assert got == [b"obj1-%d" % n for n in range(8)]
+        seqs = [r.group_sequences() for r in sim.replicas]
+        assert seqs[0] == seqs[1] == seqs[2]
+        group = sim.config.group_of_key(b"obj1")
+        assert seqs[0][group] == tuple(got)
+
+    def test_objects_partition_across_groups_and_zones(self):
+        sim = make_wpaxos(num_groups=4)
+        keys = [b"obj-%d" % i for i in range(8)]
+        groups = {key: sim.config.group_of_key(key) for key in keys}
+        assert len(set(groups.values())) > 1
+        got: list = []
+        for n, key in enumerate(keys):
+            start = len(got)
+            sim.clients[0].write(0, b"%s/w%d" % (key, n), got.append,
+                                 key=key)
+            settle(sim, lambda: len(got) > start)
+        assert len(got) == 8
+        # Each group's log lives with its home zone's leader.
+        for key, group in groups.items():
+            home = sim.config.initial_home[group]
+            assert group in sim.leaders[home].active
+
+    def test_home_zone_commits_are_zone_local(self):
+        topo = geo3()
+        sim = make_wpaxos(num_clients=3, topology=topo)
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        drive(sim, 6, client=home, key_prefix=b"obj1")
+        # Past the bootstrap steal, commits never leave the zone:
+        # p50 well under the cross-region RTT.
+        steady = sorted(lat for _, _, lat
+                        in sim.clients[home].latencies)[:-1]
+        assert max(steady) < 0.25 * topo.wan_rtt()
+
+    def test_remote_zone_redirect_then_steal_localizes_traffic(self):
+        topo = geo3()
+        sim = make_wpaxos(num_clients=3, topology=topo)
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        remote = (home + 1) % 3
+        drive(sim, 3, client=remote, key_prefix=b"obj1")
+        # Remote traffic pays the WAN per commit before the steal...
+        assert sim.clients[remote].latencies[-1][2] > topo.wan_rtt()
+        sim.leaders[remote].receive("admin", Steal(group))
+        settle(sim, lambda: group in sim.leaders[remote].active)
+        drive(sim, 3, client=remote, key_prefix=b"obj1")
+        # ...and is zone-local after it (traffic migration arm).
+        assert sim.clients[remote].latencies[-1][2] \
+            < 0.25 * topo.wan_rtt()
+        event = sim.leaders[remote].steal_events[-1]
+        assert event["active_s"] - event["started_s"] \
+            <= 3 * topo.wan_rtt()
+
+    def test_steal_adopts_in_flight_values(self):
+        """Chosen-uniqueness across a steal: values committed (or in
+        flight) under the old owner survive into the new epoch."""
+        sim = make_wpaxos()
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        drive(sim, 4, key_prefix=b"obj1", client=0)
+        before = sim.replicas[0].group_sequences()[group]
+        # A write delivered to the home leader whose Phase2 acks are
+        # still in flight when the steal begins:
+        got: list = []
+        sim.clients[0].write(0, b"obj1-inflight", got.append,
+                             key=b"obj1")
+        # Deliver ONLY up to the leader + acceptor votes, not the acks.
+        for _ in range(4):
+            if sim.transport.messages:
+                sim.transport.deliver_message(sim.transport.messages[0])
+        thief = sim.leaders[(home + 1) % 3]
+        thief.receive("admin", Steal(group))
+        settle(sim, lambda: group in thief.active)
+        settle(sim, lambda: len(got) >= 1)
+        seqs = [r.group_sequences()[group] for r in sim.replicas]
+        assert seqs[0] == seqs[1] == seqs[2]
+        assert seqs[0][:len(before)] == before
+        assert seqs[0].count(b"obj1-inflight") == 1
+
+    def test_steal_is_wal_durable_before_ack(self):
+        """The paxepoch commit rule inherited: an acceptor's WPhase1b
+        leaves only after its promise is group-commit-fsynced, so a
+        crash-restarted old-home acceptor still refuses the old
+        ballot."""
+        sim = make_wpaxos(wal=True)
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        drive(sim, 2, key_prefix=b"obj1")
+        thief = sim.leaders[(home + 1) % 3]
+        thief.receive("admin", Steal(group))
+        settle(sim, lambda: group in thief.active)
+        stolen_ballot = thief.active[group].ballot
+        # Restart every old-home acceptor from WAL: promises survive.
+        for i, acceptor in enumerate(sim.acceptors):
+            if acceptor.zone == home:
+                crash_restart_acceptor(sim, i)
+        for acceptor in sim.acceptors:
+            if acceptor.zone == home:
+                assert acceptor.promised.get(group, -1) >= stolen_ballot
+                assert acceptor.epochs.current(group).home_zone \
+                    == thief.zone
+
+    def test_zone_outage_wal_restart_then_steal_repairs(self):
+        """The zone-outage scenario: groups homed in a dead zone stall
+        (f_z = 0: steals need a majority of every row), the zone-kill
+        helper relaunches it from WALs, and a steal then moves the
+        groups -- with every acked write intact."""
+        sim = make_wpaxos(wal=True, num_clients=3)
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        drive(sim, 4, client=home, key_prefix=b"obj1")
+
+        crash_zone(sim, home)
+        thief = sim.leaders[(home + 1) % 3]
+        thief.receive("admin", Steal(group))
+        sim.transport.deliver_all_coalesced(max_steps=2000)
+        assert group not in thief.active  # blocked: dead row
+
+        restart_zone(sim, home)
+        settle(sim, lambda: group in thief.active)
+        got = drive(sim, 3, client=(home + 1) % 3,
+                    key_prefix=b"obj1")
+        assert len(got) == 3
+        seqs = [r.group_sequences()[group] for r in sim.replicas]
+        # The restarted replica re-learns from leaders; all agree on
+        # the common prefix and the acked writes are all present.
+        live = [s for i, s in enumerate(seqs) if i != home]
+        assert live[0] == live[1]
+        for n in range(4):
+            assert live[0].count(b"obj1-%d" % n) == 1
+
+    def test_cross_region_partition_minority_cannot_steal(self):
+        """SAFETY under partition: a leader cut off from the other
+        regions cannot complete a steal (its Phase1 cannot reach a
+        majority of every row), so the majority side's history is
+        never forked; healing lets the steal finish."""
+        topo = geo3()
+        sim = make_wpaxos(num_clients=3, topology=topo)
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        drive(sim, 3, client=home, key_prefix=b"obj1")
+
+        isolated = (home + 1) % 3
+        topo.partition_zone(f"zone-{isolated}")
+        thief = sim.leaders[isolated]
+        thief.receive("admin", Steal(group))
+        sim.transport.run_for(5.0, max_steps=50000)
+        assert group not in thief.active
+        # The home zone keeps serving zone-locally meanwhile.
+        drive(sim, 2, client=home, key_prefix=b"obj1")
+
+        topo.heal_zone(f"zone-{isolated}")
+        settle(sim, lambda: group in thief.active)
+        got = drive(sim, 2, client=isolated, key_prefix=b"obj1")
+        assert len(got) == 2
+        seqs = [r.group_sequences()[group] for r in sim.replicas]
+        n = min(len(s) for s in seqs)
+        assert all(s[:n] == seqs[0][:n] for s in seqs)
+
+    def test_client_failover_steals_after_home_zone_death(self):
+        """Liveness without an admin: the client's resend/failover
+        budget rotates zones with steal=True."""
+        sim = make_wpaxos(wal=True)
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        drive(sim, 2, key_prefix=b"obj1")
+        crash_zone(sim, home)
+        restart_zone(sim, home)  # acceptors back (WAL), leader amnesiac
+        got: list = []
+        sim.clients[0].write(0, b"obj1-post", got.append, key=b"obj1")
+        settle(sim, lambda: bool(got), max_waves=400)
+        assert got == [b"obj1-post"]
+
+    def test_duplicate_suppression_across_resends(self):
+        """A resent command (lost reply) never executes twice."""
+        sim = make_wpaxos()
+        group = sim.config.group_of_key(b"obj1")
+        got = drive(sim, 3, key_prefix=b"obj1")
+        client = sim.clients[0]
+        # Force a resend of an op whose reply we drop.
+        client.write(0, b"obj1-dup", got.append, key=b"obj1")
+        settle(sim, lambda: len(got) >= 4)
+        # Replay the identical request frame at the leader (network
+        # duplicate): nothing new executes.
+        seq_before = sim.replicas[0].group_sequences()[group]
+        home = sim.config.initial_home[group]
+        from frankenpaxos_tpu.protocols.wpaxos.messages import (
+            Command,
+            CommandId,
+            WRequest,
+        )
+
+        sim.leaders[home].receive(
+            client.address,
+            WRequest(group=group, command=Command(
+                command_id=CommandId(client.address, 0, 3),
+                command=b"obj1-dup")))
+        sim.transport.deliver_all_coalesced()
+        seqs = [r.group_sequences()[group] for r in sim.replicas]
+        assert seqs[0] == seq_before
+        assert seqs[0].count(b"obj1-dup") == 1
+
+    def test_tpu_quorum_backend_matches_dict(self):
+        """The fused EpochSegmentedChecker path drives the same
+        protocol outcome as the dict oracle, across a steal."""
+        results = {}
+        for backend in ("dict", "tpu"):
+            sim = make_wpaxos(quorum_backend=backend)
+            group = sim.config.group_of_key(b"obj1")
+            drive(sim, 4, key_prefix=b"obj1")
+            thief = sim.leaders[
+                (sim.config.initial_home[group] + 1) % 3]
+            thief.receive("admin", Steal(group))
+            settle(sim, lambda: group in thief.active)
+            drive(sim, 4, key_prefix=b"obj1")
+            results[backend] = sim.replicas[0].group_sequences()
+        assert results["dict"] == results["tpu"]
+
+
+# --- the chaos simulated system ---------------------------------------------
+
+
+class WriteCmd:
+    def __init__(self, client, pseudonym, payload):
+        self.client = client
+        self.pseudonym = pseudonym
+        self.payload = payload
+
+    def __repr__(self):
+        return f"Write({self.client}, {self.pseudonym}, {self.payload!r})"
+
+
+class TransportCmd:
+    def __init__(self, command):
+        self.command = command
+
+    def __repr__(self):
+        return f"Transport({self.command!r})"
+
+
+class StealCmd:
+    def __init__(self, group, zone):
+        self.group = group
+        self.zone = zone
+
+    def __repr__(self):
+        return f"Steal({self.group} -> zone {self.zone})"
+
+
+class LinkCmd:
+    def __init__(self, zone_a, zone_b, heal):
+        self.zone_a = zone_a
+        self.zone_b = zone_b
+        self.heal = heal
+
+    def __repr__(self):
+        verb = "HealLink" if self.heal else "CutLink"
+        return f"{verb}({self.zone_a}, {self.zone_b})"
+
+
+class CrashCmd:
+    def __init__(self, kind, index):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Crash({self.kind}, {self.index})"
+
+
+class ZoneCmd:
+    def __init__(self, zone, restart):
+        self.zone = zone
+        self.restart = restart
+
+    def __repr__(self):
+        verb = "RestartZone" if self.restart else "KillZone"
+        return f"{verb}({self.zone})"
+
+
+class SettleCmd:
+    def __repr__(self):
+        return "Settle()"
+
+
+class WPaxosGeoSimulated(SimulatedSystem):
+    """Writes + adversarial delivery INTERLEAVED with object steals,
+    link partitions, zone kills (all roles down, acceptors restart
+    from WAL), and individual crash-restarts, under the paxgeo oracle:
+
+      * per-(group, slot) chosen-value uniqueness across every
+        leader's and replica's log;
+      * per-group replica SM prefix compatibility;
+      * exactly-once execution (payloads are globally unique);
+      * per-replica growth except across that replica's own crash.
+    """
+
+    def __init__(self, num_zones: int = 3, row_width: int = 3,
+                 num_groups: int = 3, jitter: float = 1.0):
+        self.num_zones = num_zones
+        self.row_width = row_width
+        self.num_groups = num_groups
+        self.jitter = jitter
+
+    def new_system(self, seed: int):
+        regions = {f"r{z}": [f"zone-{z}"]
+                   for z in range(self.num_zones)}
+        topo = GeoTopology(regions, seed=seed, jitter=self.jitter)
+        sim = make_wpaxos(num_zones=self.num_zones,
+                          row_width=self.row_width,
+                          num_groups=self.num_groups,
+                          num_clients=self.num_zones, topology=topo,
+                          wal=True, seed=seed)
+        sim._counter = 0
+        sim._dead_zone = None
+        sim._crash_epochs = {"replica": [0] * len(sim.replicas)}
+        return sim
+
+    def generate_command(self, sim, rng: random.Random):
+        choices: list = []
+        idle = [(c, p) for c, client in enumerate(sim.clients)
+                for p in range(2) if p not in client.pending]
+        if idle:
+            choices.extend(["write"] * 2)
+        transport_cmd = sim.transport.generate_command(rng)
+        if transport_cmd is not None:
+            choices.extend(["transport"] * 6)
+        if rng.random() < 0.12:
+            choices.append("steal")
+        if rng.random() < 0.12:
+            choices.append("link")
+        if rng.random() < 0.15:
+            choices.append("crash")
+        if sim._dead_zone is None:
+            if rng.random() < 0.05:
+                choices.append("kill_zone")
+        elif rng.random() < 0.5:
+            choices.append("restart_zone")
+        if rng.random() < 0.08:
+            choices.append("settle")
+        if not choices:
+            return None
+        kind = rng.choice(choices)
+        if kind == "write":
+            client, pseudonym = rng.choice(idle)
+            sim._counter += 1
+            return WriteCmd(client, pseudonym,
+                            b"w%d" % sim._counter)
+        if kind == "steal":
+            return StealCmd(rng.randrange(self.num_groups),
+                            rng.randrange(self.num_zones))
+        if kind == "link":
+            zones = rng.sample(range(self.num_zones), 2)
+            partitioned = not sim.topology.link(
+                f"zone-{zones[0]}", f"zone-{zones[1]}").up
+            return LinkCmd(zones[0], zones[1], heal=partitioned)
+        if kind == "crash":
+            if rng.random() < 0.5:
+                return CrashCmd("acceptor",
+                                rng.randrange(len(sim.acceptors)))
+            return CrashCmd("replica",
+                            rng.randrange(len(sim.replicas)))
+        if kind == "kill_zone":
+            return ZoneCmd(rng.randrange(self.num_zones),
+                           restart=False)
+        if kind == "restart_zone":
+            return ZoneCmd(sim._dead_zone, restart=True)
+        if kind == "settle":
+            return SettleCmd()
+        return TransportCmd(transport_cmd)
+
+    def run_command(self, sim, command):
+        if isinstance(command, WriteCmd):
+            client = sim.clients[command.client]
+            if command.pseudonym not in client.pending:
+                client.write(command.pseudonym, command.payload,
+                             key=command.payload)
+        elif isinstance(command, StealCmd):
+            sim.leaders[command.zone].receive(
+                "chaos-admin", Steal(command.group))
+        elif isinstance(command, LinkCmd):
+            a, b = f"zone-{command.zone_a}", f"zone-{command.zone_b}"
+            if command.heal:
+                sim.topology.heal_link(a, b)
+            else:
+                sim.topology.partition_link(a, b)
+        elif isinstance(command, CrashCmd):
+            index = command.index
+            if command.kind == "acceptor":
+                index %= len(sim.acceptors)
+                if sim.acceptors[index].zone != sim._dead_zone:
+                    crash_restart_acceptor(sim, index)
+            else:
+                index %= len(sim.replicas)
+                if index != sim._dead_zone:
+                    crash_restart_replica(sim, index)
+                    sim._crash_epochs["replica"][index] += 1
+        elif isinstance(command, ZoneCmd):
+            if command.restart:
+                if sim._dead_zone is not None:
+                    restart_zone(sim, sim._dead_zone)
+                    sim._crash_epochs["replica"][sim._dead_zone] += 1
+                    sim._dead_zone = None
+            elif sim._dead_zone is None:
+                crash_zone(sim, command.zone)
+                sim._dead_zone = command.zone
+        elif isinstance(command, SettleCmd):
+            sim.transport.deliver_all_coalesced(max_steps=400)
+        else:
+            sim.transport.run_command(command.command)
+        return sim
+
+    # --- the oracle ---------------------------------------------------------
+    def state_invariant(self, sim) -> Optional[str]:
+        # Chosen-value uniqueness per (group, slot), across every
+        # surviving log: leaders' chosen maps and replicas' logs.
+        chosen: dict = {}
+        logs = []
+        for i, leader in enumerate(sim.leaders):
+            for group in range(sim.config.num_groups):
+                logs.append((f"leader-{i}", group,
+                             leader.chosen[group]))
+        for i, replica in enumerate(sim.replicas):
+            for group in range(sim.config.num_groups):
+                logs.append((f"replica-{i}", group,
+                             replica.logs[group]))
+        for who, group, log in logs:
+            for slot, value in log.items():
+                prev = chosen.get((group, slot))
+                if prev is not None and prev[1] != value:
+                    return (f"group {group} slot {slot} chosen twice: "
+                            f"{prev[0]} has {prev[1]!r}, {who} has "
+                            f"{value!r}")
+                chosen[(group, slot)] = (who, value)
+        # Per-group SM prefix compatibility + exactly-once.
+        for group in range(sim.config.num_groups):
+            seqs = [r.executed[group] for r in sim.replicas]
+            for i in range(len(seqs)):
+                for j in range(i + 1, len(seqs)):
+                    n = min(len(seqs[i]), len(seqs[j]))
+                    if seqs[i][:n] != seqs[j][:n]:
+                        return (f"group {group} SM sequences diverge: "
+                                f"{seqs[i]!r} vs {seqs[j]!r}")
+        for i, replica in enumerate(sim.replicas):
+            flat = [p for seq in replica.executed for p in seq]
+            if len(set(flat)) != len(flat):
+                return f"replica {i} executed a payload twice: {flat!r}"
+        return None
+
+    def get_state(self, sim):
+        return tuple(
+            (sim._crash_epochs["replica"][i],
+             tuple(tuple(seq) for seq in r.executed))
+        for i, r in enumerate(sim.replicas))
+
+    def step_invariant(self, old_state, new_state) -> Optional[str]:
+        for (old_epoch, old_seqs), (new_epoch, new_seqs) in zip(
+                old_state, new_state):
+            if new_epoch != old_epoch:
+                continue  # this replica crashed: regression is legal
+            for old, new in zip(old_seqs, new_seqs):
+                if new[:len(old)] != old:
+                    return (f"replica SM sequence shrank/rewrote "
+                            f"without a crash: {old} -> {new}")
+        return None
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(),
+    dict(num_zones=2, row_width=3, num_groups=2),
+    dict(jitter=4.0),
+], ids=["z3", "z2", "high-jitter"])
+def test_simulation_geo_chaos_no_divergence(kwargs):
+    """Regression-smoke scale; tests/soak.py runs the 500x250 soak."""
+    simulated = WPaxosGeoSimulated(**kwargs)
+    failure = Simulator(simulated, run_length=150, num_runs=10).run(seed=0)
+    assert failure is None, str(failure)

@@ -304,6 +304,26 @@ CONFIGS.extend([
      lambda: MultiPaxosOverloadSimulated(f=2, coalesced="mixed")),
 ])
 
+# paxgeo chaos (geo/ + protocols/wpaxos, docs/GEO.md): object steals
+# interleaved with link partitions, zone kills (all roles down,
+# acceptors restart from WAL), and crash-restarts, under the
+# chosen-uniqueness / exactly-once oracle -- the full scenario matrix
+# at soak scale.
+from tests.protocols.test_wpaxos import WPaxosGeoSimulated  # noqa: E402
+
+GEO_CHAOS_CONFIGS: list[tuple] = [
+    ("geo-chaos/wpaxos-z3", lambda: WPaxosGeoSimulated()),
+    ("geo-chaos/wpaxos-z2-groups2",
+     lambda: WPaxosGeoSimulated(num_zones=2, row_width=3,
+                                num_groups=2)),
+    ("geo-chaos/wpaxos-z4-wide",
+     lambda: WPaxosGeoSimulated(num_zones=4, row_width=3,
+                                num_groups=4)),
+    ("geo-chaos/wpaxos-high-jitter",
+     lambda: WPaxosGeoSimulated(jitter=4.0)),
+]
+CONFIGS.extend(GEO_CHAOS_CONFIGS)
+
 
 def _expand(entry, num_runs: int):
     """(name, factory[, runs_scale]) -> (name, factory, scaled runs) --

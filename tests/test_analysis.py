@@ -1689,3 +1689,73 @@ def test_flow403_transport_layer_codec_excluded(tmp_path):
     flow403 = {f.scope for f in findings if f.rule == "FLOW403"}
     assert "Orphan" in flow403
     assert "Envelope" not in flow403
+
+
+# --- GEO8xx: paxgeo determinism contract ------------------------------------
+
+
+def test_geo801_wall_clock_in_geo_layer(tmp_path):
+    findings = run_rules(project(tmp_path, {"geo/topology.py": """
+    import time
+
+    def sample_delay(src, dst):
+        return time.time() * 0.001
+    """}))
+    assert "GEO801" in rules_of(findings)
+    f = next(f for f in findings if f.rule == "GEO801")
+    assert "time.time" in f.detail
+
+
+def test_geo801_unseeded_random_in_geo_layer(tmp_path):
+    findings = run_rules(project(tmp_path, {"geo/jitter.py": """
+    import random
+
+    def jitter():
+        return random.random()
+    """}))
+    assert "GEO801" in rules_of(findings)
+
+
+def test_geo801_os_entropy_in_geo_layer(tmp_path):
+    findings = run_rules(project(tmp_path, {"geo/seed.py": """
+    import os
+
+    def fresh():
+        return os.urandom(8)
+    """}))
+    assert "GEO801" in rules_of(findings)
+
+
+def test_geo801_seeded_random_is_fine(tmp_path):
+    findings = run_rules(project(tmp_path, {"geo/topology.py": """
+    import random
+
+    def sample_delay(seed, src, dst, frame_id):
+        return random.Random(f"{seed}|{src}|{dst}|{frame_id}").random()
+    """}))
+    assert "GEO801" not in rules_of(findings)
+
+
+def test_geo801_scoped_to_geo_tree(tmp_path):
+    # The same construct OUTSIDE geo/ (a bench's wall-clock timing) is
+    # not this rule's business.
+    findings = run_rules(project(tmp_path, {"bench/geo_lt.py": """
+    import time
+
+    def measure():
+        return time.time()
+    """}))
+    assert "GEO801" not in rules_of(findings)
+
+
+def test_geo801_repo_is_clean():
+    from frankenpaxos_tpu.analysis.core import Project as _P
+    from frankenpaxos_tpu.analysis.geo_rules import check as _geo_check
+
+    import frankenpaxos_tpu
+    import os as _os
+
+    root = _os.path.dirname(_os.path.dirname(
+        frankenpaxos_tpu.__file__))
+    findings = list(_geo_check(_P(root, package="frankenpaxos_tpu")))
+    assert findings == []

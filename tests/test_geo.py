@@ -1,0 +1,486 @@
+"""paxgeo substrate tests: GeoTopology link math + chaos controls,
+GeoSimTransport arrival-ordered delivery (+ the committed golden
+determinism test: same seed => byte-identical event sequence),
+ZoneGrid quorum geometry, GeoQuorumTracker dict-vs-fused parity, and
+the jitter-tolerant heartbeat/election timeouts (the satellite that
+keeps failure detectors honest once links have real latency)."""
+
+import json
+import os
+
+import pytest
+
+from frankenpaxos_tpu.geo import (
+    GeoQuorumTracker,
+    GeoSimTransport,
+    GeoTopology,
+    ObjectEpochStore,
+    RttEstimator,
+)
+from frankenpaxos_tpu.geo.epochs import GeoEpoch
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions, HeartbeatParticipant
+from frankenpaxos_tpu.quorums import ZoneGrid
+from frankenpaxos_tpu.runtime import Actor, FakeLogger, LogLevel
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "geo_delivery_order.json")
+
+
+def three_regions(seed: int = 0, jitter: float = 0.05) -> GeoTopology:
+    return GeoTopology({"us": ["us-a", "us-b"], "eu": ["eu-a"],
+                        "ap": ["ap-a"]}, seed=seed, jitter=jitter)
+
+
+# --- GeoTopology -----------------------------------------------------------
+
+
+class TestGeoTopology:
+    def test_link_tiers(self):
+        topo = three_regions()
+        assert topo.link("us-a", "us-a").base_s == topo.intra_zone_s
+        assert topo.link("us-a", "us-b").base_s == topo.intra_region_s
+        assert topo.link("us-a", "eu-a").base_s == topo.cross_region_s
+        assert topo.wan_rtt() == 2 * topo.cross_region_s
+
+    def test_delay_deterministic_per_seed_and_frame(self):
+        a = three_regions(seed=7)
+        b = three_regions(seed=7)
+        c = three_regions(seed=8)
+        a.place("x", "us-a"), a.place("y", "eu-a")
+        b.place("x", "us-a"), b.place("y", "eu-a")
+        c.place("x", "us-a"), c.place("y", "eu-a")
+        assert a.sample_delay("x", "y", 3) == b.sample_delay("x", "y", 3)
+        assert a.sample_delay("x", "y", 3) != c.sample_delay("x", "y", 3)
+        assert a.sample_delay("x", "y", 3) != a.sample_delay("x", "y", 4)
+        # Jitter is one-sided: base is the floor.
+        assert a.sample_delay("x", "y", 3) >= a.cross_region_s
+
+    def test_unplaced_addresses_are_free_and_reachable(self):
+        topo = three_regions()
+        assert topo.sample_delay("admin", "anything", 1) == 0.0
+        assert topo.link_up("admin", "anything")
+
+    def test_partition_and_degrade_controls(self):
+        topo = three_regions()
+        topo.place("x", "us-a"), topo.place("y", "eu-a")
+        topo.place("z", "us-b")
+        topo.partition_link("us-a", "eu-a")
+        assert not topo.link_up("x", "y") and not topo.link_up("y", "x")
+        topo.heal_link("us-a", "eu-a")
+        assert topo.link_up("x", "y")
+
+        topo.degrade_link("us-a", "eu-a", 10.0)
+        assert topo.sample_delay("x", "y", 1) >= 10 * topo.cross_region_s
+        topo.heal_all()
+        assert topo.sample_delay("x", "y", 1) < 10 * topo.cross_region_s
+
+        topo.partition_zone("us-a")
+        assert not topo.link_up("x", "y") and not topo.link_up("x", "z")
+        topo.heal_zone("us-a")
+
+        topo.partition_regions("us", "eu")
+        assert not topo.link_up("x", "y")
+        assert not topo.link_up("z", "y")
+        assert topo.link_up("x", "z")  # intra-region unaffected
+        topo.heal_regions("us", "eu")
+        assert topo.link_up("x", "y")
+
+
+# --- GeoSimTransport -------------------------------------------------------
+
+
+class _Recorder(Actor):
+    """Echoes each payload back ``hops`` more times, recording every
+    receive against the virtual clock."""
+
+    def __init__(self, address, transport, logger, log):
+        super().__init__(address, transport, logger)
+        self.log = log
+
+    def receive(self, src, message):
+        hops, payload = message
+        self.log.append((round(self.transport.now, 9), str(src),
+                         str(self.address), payload))
+        if hops > 0:
+            self.send(src, (hops - 1, payload))
+
+
+def _run_recorder_scenario(seed: int):
+    topo = three_regions(seed=seed, jitter=0.5)
+    transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+    log: list = []
+    actors = {}
+    for zone in topo.zones:
+        address = f"actor-{zone}"
+        topo.place(address, zone)
+        actors[address] = _Recorder(address, transport,
+                                    transport.logger, log)
+    # Everyone opens a 3-hop exchange with everyone else.
+    addresses = sorted(actors)
+    for a in addresses:
+        for b in addresses:
+            if a != b:
+                actors[a].send(b, (3, f"{a}->{b}"))
+    transport.run_for(10.0)
+    return log
+
+
+class TestGeoSimTransport:
+    def test_delivery_ordered_by_arrival_not_enqueue(self):
+        topo = three_regions()
+        transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+        log: list = []
+        for zone in ("us-a", "us-b", "eu-a"):
+            topo.place(f"actor-{zone}", zone)
+            _Recorder(f"actor-{zone}", transport, transport.logger, log)
+        # WAN frame sent FIRST, zone-local frame second: the local one
+        # must arrive first.
+        sender = "actor-us-a"
+        first = (0, "wan")
+        second = (0, "local")
+        from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+        transport.send(sender, "actor-eu-a",
+                       DEFAULT_SERIALIZER.to_bytes(first))
+        transport.send(sender, "actor-us-b",
+                       DEFAULT_SERIALIZER.to_bytes(second))
+        transport.run_for(1.0)
+        assert [row[3] for row in log] == ["local", "wan"]
+
+    def test_link_partition_drops_at_delivery(self):
+        topo = three_regions()
+        transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+        log: list = []
+        topo.place("actor-us-a", "us-a"), topo.place("actor-eu-a", "eu-a")
+        a = _Recorder("actor-us-a", transport, transport.logger, log)
+        _Recorder("actor-eu-a", transport, transport.logger, log)
+        a.send("actor-eu-a", (0, "x"))
+        topo.partition_link("us-a", "eu-a")  # mid-flight
+        transport.run_for(1.0)
+        assert log == [] and transport.messages == []
+
+    def test_timers_fire_at_virtual_deadlines(self):
+        topo = three_regions()
+        transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+        fired = []
+        timer = transport.timer("a", "t", 0.25,
+                                lambda: fired.append(transport.now))
+        timer.start()
+        transport.run_for(0.2)
+        assert fired == []
+        transport.run_for(0.1)
+        assert fired == [pytest.approx(0.25)]
+
+    def test_same_seed_identical_event_sequence(self):
+        assert _run_recorder_scenario(seed=42) == \
+            _run_recorder_scenario(seed=42)
+        assert _run_recorder_scenario(seed=42) != \
+            _run_recorder_scenario(seed=43)
+
+    def test_golden_delivery_order(self):
+        """Byte-identical against the committed schedule: the
+        determinism contract holds across processes, platforms, and
+        PYTHONHASHSEED (regenerate with FPX_WRITE_GOLDEN=1)."""
+        got = json.dumps(_run_recorder_scenario(seed=42), indent=1)
+        if os.environ.get("FPX_WRITE_GOLDEN"):
+            with open(GOLDEN, "w") as f:
+                f.write(got + "\n")
+        with open(GOLDEN) as f:
+            assert f.read() == got + "\n"
+
+
+# --- ZoneGrid --------------------------------------------------------------
+
+
+class TestZoneGrid:
+    def test_quorum_geometry(self):
+        g = ZoneGrid([[0, 1, 2], [3, 4, 5], [6, 7, 8]])
+        # Phase2: any single row's majority; zone-local.
+        assert g.is_write_quorum({0, 1})
+        assert g.is_write_quorum({4, 5})
+        assert not g.is_write_quorum({0, 4})  # split across rows
+        # Phase1: a majority of EVERY row.
+        assert g.is_read_quorum({0, 1, 3, 4, 6, 7})
+        assert not g.is_read_quorum({0, 1, 3, 4, 6})
+
+    def test_every_read_intersects_every_write(self):
+        import itertools
+        import random as _random
+
+        g = ZoneGrid([[0, 1, 2], [3, 4, 5]])
+        rng = _random.Random(0)
+        for _ in range(200):
+            r = g.random_read_quorum(rng)
+            w = g.random_write_quorum(rng)
+            assert r & w, (r, w)
+        # Exhaustively: every minimal write quorum (a row majority)
+        # intersects every minimal read quorum.
+        rows = [list(row) for row in g.grid]
+        for row in rows:
+            for w in itertools.combinations(row, g.row_majority):
+                for r_parts in itertools.product(
+                        *[itertools.combinations(r, g.row_majority)
+                          for r in rows]):
+                    r = set().union(*map(set, r_parts))
+                    assert r & set(w)
+
+    def test_specs_match_set_oracle(self):
+        import random as _random
+
+        g = ZoneGrid([[0, 1, 2], [3, 4, 5], [6, 7, 8]])
+        rng = _random.Random(1)
+        nodes = sorted(g.nodes())
+        for _ in range(300):
+            xs = {n for n in nodes if rng.random() < 0.5}
+            assert g.read_spec().check(xs) == \
+                g.is_superset_of_read_quorum(xs)
+            assert g.write_spec().check(xs) == \
+                g.is_superset_of_write_quorum(xs)
+        for zone in range(3):
+            spec = g.home_write_spec(zone)
+            row = set(g.grid[zone])
+            for _ in range(100):
+                xs = {n for n in nodes if rng.random() < 0.5}
+                assert spec.check(xs) == \
+                    (len(xs & row) >= g.row_majority)
+
+    def test_rejects_malformed_grids(self):
+        with pytest.raises(ValueError):
+            ZoneGrid([])
+        with pytest.raises(ValueError):
+            ZoneGrid([[0, 1], [2]])
+        with pytest.raises(ValueError):
+            ZoneGrid([[0, 1], [1, 2]])  # overlapping rows
+
+    def test_dict_round_trip(self):
+        from frankenpaxos_tpu.quorums import (
+            quorum_system_from_dict,
+            quorum_system_to_dict,
+        )
+
+        g = ZoneGrid([[0, 1], [2, 3]])
+        d = quorum_system_to_dict(g)
+        assert d["kind"] == "zone_grid"
+        back = quorum_system_from_dict(d)
+        assert isinstance(back, ZoneGrid) and back.grid == g.grid
+
+
+# --- GeoQuorumTracker ------------------------------------------------------
+
+
+class TestGeoQuorumTracker:
+    def _store_with_steal(self):
+        store = ObjectEpochStore(2, [0, 1])
+        assert store.offer(GeoEpoch(group=0, epoch=1, start_slot=8,
+                                    home_zone=2, ballot=5)) == "new"
+        return store
+
+    def test_dict_and_tpu_backends_identical(self):
+        import random as _random
+
+        grid = ZoneGrid([[0, 1, 2], [3, 4, 5], [6, 7, 8]])
+        store = self._store_with_steal()
+        trackers = [GeoQuorumTracker(store, 0, grid, backend=b)
+                    for b in ("dict", "tpu")]
+        rng = _random.Random(3)
+        votes = []
+        for slot in range(16):
+            ballot = 0 if slot < 8 else 5
+            for acceptor in rng.sample(range(9), rng.randint(1, 9)):
+                votes.append((slot, ballot, acceptor))
+        rng.shuffle(votes)
+        outs = [[], []]
+        for i, (slot, ballot, acceptor) in enumerate(votes):
+            for t, out in zip(trackers, outs):
+                t.record(slot, ballot, acceptor)
+                if i % 5 == 4:
+                    out.extend(t.drain())
+        for t, out in zip(trackers, outs):
+            out.extend(t.drain())
+        assert sorted(outs[0]) == sorted(outs[1])
+        # Sanity: slots below the steal boundary needed zone 0's row,
+        # above it zone 2's.
+        chosen = dict(outs[0])
+        for slot in chosen:
+            assert (slot < 8 and chosen[slot] == 0) or \
+                (slot >= 8 and chosen[slot] == 5)
+
+    def test_steal_mid_stream_appends_plane(self):
+        grid = ZoneGrid([[0, 1, 2], [3, 4, 5], [6, 7, 8]])
+        store = ObjectEpochStore(1, [0])
+        trackers = [GeoQuorumTracker(store, 0, grid, backend=b)
+                    for b in ("dict", "tpu")]
+        for t in trackers:
+            t.record(0, 0, 0)
+            t.record(0, 0, 1)
+        store.offer(GeoEpoch(group=0, epoch=1, start_slot=1,
+                             home_zone=1, ballot=4))
+        for t in trackers:
+            t.note_epochs()
+            t.record(1, 4, 3)
+            t.record(1, 4, 4)
+        assert sorted(trackers[0].drain()) == \
+            sorted(trackers[1].drain()) == [(0, 0), (1, 4)]
+
+
+# --- RttEstimator ----------------------------------------------------------
+
+
+class TestRttEstimator:
+    def test_default_until_first_sample(self):
+        est = RttEstimator()
+        assert est.timeout(2.5) == 2.5
+        est.observe(0.1)
+        assert est.timeout(2.5) == pytest.approx(0.1 + 4 * 0.05)
+
+    def test_converges_and_bounds_jitter(self):
+        est = RttEstimator()
+        for rtt in [0.1, 0.12, 0.09, 0.11, 0.1, 0.13, 0.1] * 10:
+            est.observe(rtt)
+        t = est.timeout(99.0)
+        assert 0.1 < t < 0.35  # srtt ~0.107 + 4*dev
+
+    def test_clamps(self):
+        est = RttEstimator(floor_s=0.05, ceil_s=1.0)
+        est.observe(0.0)
+        assert est.timeout(9.0) == 0.05
+        est2 = RttEstimator(floor_s=0.05, ceil_s=1.0)
+        est2.observe(100.0)
+        assert est2.timeout(9.0) == 1.0
+
+
+# --- jitter-tolerant failure detection (the satellite) ---------------------
+
+
+class _WatchedHeartbeat(HeartbeatParticipant):
+    """Records false-death verdicts (peer removed from ``alive``)."""
+
+    def __init__(self, *args, **kwargs):
+        self.deaths: list = []
+        super().__init__(*args, **kwargs)
+
+    def _fail(self, index):
+        before = self.addresses[index] in self.alive
+        super()._fail(index)
+        if before and self.addresses[index] not in self.alive:
+            self.deaths.append((index, self.clock()))
+
+
+def _run_heartbeat(adaptive: bool, kill_peer: bool = False):
+    """Two participants across a HIGH-JITTER WAN link, fail deadline
+    configured below the link's worst-case RTT."""
+    topo = GeoTopology({"us": ["us-a"], "eu": ["eu-a"]},
+                       cross_region_s=0.04, jitter=4.0, seed=5)
+    transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+    clock = lambda: int(transport.now * 1e9)  # noqa: E731
+    options = HeartbeatOptions(fail_period_s=0.1,
+                               success_period_s=0.05, num_retries=2,
+                               adaptive=adaptive)
+    addresses = ["hb-us", "hb-eu"]
+    topo.place("hb-us", "us-a"), topo.place("hb-eu", "eu-a")
+    participants = [
+        _WatchedHeartbeat(a, transport, transport.logger,
+                          [b for b in addresses if b != a],
+                          options=options, clock=clock)
+        for a in addresses]
+    transport.run_for(10.0)
+    if kill_peer:
+        transport.crash("hb-eu")
+        transport.run_for(10.0)
+    return participants
+
+
+class TestJitterTolerantHeartbeat:
+    def test_fixed_deadline_false_positives_under_jitter(self):
+        us, eu = _run_heartbeat(adaptive=False)
+        assert us.deaths, \
+            "expected the fixed deadline to false-positive under " \
+            "4x-jitter WAN RTT"
+
+    def test_adaptive_deadline_rides_out_jitter(self):
+        us, eu = _run_heartbeat(adaptive=True)
+        assert us.deaths == [] and eu.deaths == []
+        assert us.unsafe_alive() == {"hb-eu"}
+        # The derived deadline grew past the configured constant.
+        assert us.fail_timers[0].delay_s > 0.1
+
+    def test_adaptive_still_detects_real_death(self):
+        us, _ = _run_heartbeat(adaptive=True, kill_peer=True)
+        assert us.unsafe_alive() == set()
+
+
+def _run_election(adaptive: bool):
+    from frankenpaxos_tpu.election.basic import (
+        ElectionOptions,
+        ElectionParticipant,
+    )
+
+    topo = GeoTopology({"us": ["us-a"], "eu": ["eu-a"]},
+                       cross_region_s=0.04, jitter=4.0, seed=11)
+    transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+    options = ElectionOptions(ping_period_s=0.1,
+                              no_ping_timeout_min_s=0.15,
+                              no_ping_timeout_max_s=0.2,
+                              adaptive=adaptive)
+    addresses = ["el-us", "el-eu"]
+    topo.place("el-us", "us-a"), topo.place("el-eu", "eu-a")
+    participants = [
+        ElectionParticipant(a, transport, transport.logger, addresses,
+                            initial_leader_index=0, options=options,
+                            seed=i, clock=lambda: transport.now)
+        for i, a in enumerate(addresses)]
+    seizures: list = []
+    participants[1].register(
+        lambda leader_index: seizures.append(leader_index))
+    transport.run_for(20.0)
+    return participants, seizures
+
+
+class TestJitterTolerantElection:
+    def test_fixed_timeout_seizes_leadership_under_jitter(self):
+        _, seizures = _run_election(adaptive=False)
+        assert seizures, \
+            "expected a spurious leadership seizure: ping-gap jitter " \
+            "exceeds the fixed no-ping window"
+
+    def test_adaptive_timeout_holds_steady(self):
+        participants, seizures = _run_election(adaptive=True)
+        assert seizures == []
+        assert participants[1].leader_index == 0
+        # The derived deadline grew past the fixed window.
+        assert participants[1].no_ping_timer.delay_s > 0.2
+
+
+def test_adaptive_election_ignores_failover_gap():
+    """A NEW leader's first ping (or a ping after a non-follower
+    period) must not feed the outage-sized silence into the gap
+    estimator -- one such sample would push the adaptive deadline
+    out for minutes."""
+    from frankenpaxos_tpu.election.basic import (
+        ElectionOptions,
+        ElectionParticipant,
+        ElectionPing,
+    )
+    from frankenpaxos_tpu.runtime import SimTransport
+
+    transport = SimTransport(FakeLogger(LogLevel.FATAL))
+    t = [0.0]
+    follower = ElectionParticipant(
+        "el-1", transport, transport.logger, ["el-0", "el-1", "el-2"],
+        initial_leader_index=0,
+        options=ElectionOptions(ping_period_s=0.1, adaptive=True),
+        seed=1, clock=lambda: t[0])
+    # Steady pings from leader 0 at a 0.1s cadence.
+    for _ in range(10):
+        t[0] += 0.1
+        follower.receive("el-0", ElectionPing(round=0, leader_index=0))
+    steady = follower.no_ping_timer.delay_s
+    assert steady < 5.0
+    # Leader 0 dies; 300s later a NEW leader's first ping arrives.
+    t[0] += 300.0
+    follower.receive("el-2", ElectionPing(round=1, leader_index=2))
+    # The 300s silence was NOT observed as a gap sample...
+    t[0] += 0.1
+    follower.receive("el-2", ElectionPing(round=1, leader_index=2))
+    assert follower.no_ping_timer.delay_s < 5.0, \
+        "failover gap poisoned the adaptive deadline"

@@ -874,6 +874,50 @@ def all_codec_samples() -> dict:
                       round=4),
         vm.Phase2Nack(slot=3, round=6),
     ]
+    # paxgeo (protocols/wpaxos, tags 160-172): every message carries a
+    # fixed layout from day one.
+    import frankenpaxos_tpu.protocols.wpaxos  # noqa: F401
+    from frankenpaxos_tpu.geo.epochs import GeoEpoch
+    from frankenpaxos_tpu.protocols.wpaxos import messages as wp
+
+    wentry = GeoEpoch(group=2, epoch=3, start_slot=17, home_zone=1,
+                      ballot=7)
+    samples += [
+        wp.WRequest(group=2, command=command, steal=True),
+        wp.WReply(command_id=cid, group=2, slot=9, result=b"r"),
+        wp.WNotOwner(group=2, command_id=cid, home_zone=1, ballot=4),
+        wp.Steal(group=2),
+        wp.WPhase1a(group=2, ballot=7, epoch=3),
+        wp.WPhase1b(group=2, ballot=7, epoch=3, acceptor=5,
+                    votes=(wp.WVote(slot=4, ballot=1, value=batch),
+                           wp.WVote(slot=5, ballot=2, value=mp.NOOP)),
+                    epochs=(wentry,)),
+        wp.WPhase2a(group=2, slot=9, ballot=7, value=batch),
+        wp.WPhase2b(group=2, slot=9, ballot=7, acceptor=5),
+        wp.WNack(group=2, ballot=8, home_zone=0),
+        wp.WChosen(group=2, slot=9, value=batch),
+        wp.WEpochCommit(entry=wentry),
+        wp.WEpochAck(group=2, epoch=3),
+        wp.WRecover(group=2, slot=4),
+    ]
+    # COD301 burn-down tranche 3 (tags 173-180): the epaxos/bpaxos
+    # recovery cold paths + horizontal's reconfigure/chaos admin.
+    samples += [
+        em.Prepare(instance=Instance(0, 5), ballot=(2, 1)),
+        em.Nack(instance=Instance(1, 3), largest_ballot=(4, 0)),
+        em.PrepareOk(ballot=(2, 1), instance=Instance(0, 5),
+                     replica_index=1, vote_ballot=(1, 0),
+                     status=em.CommandStatus.ACCEPTED,
+                     command_or_noop=ecommand, sequence_number=7,
+                     dependencies=edeps),
+        bp.Phase1a(vertex_id=bp.VertexId(0, 3), round=2),
+        bp.Phase1b(vertex_id=bp.VertexId(0, 3), acceptor_id=1,
+                   round=2, vote_round=1,
+                   vote_value=bp.VoteValue(bcommand, bdeps)),
+        bp.Nack(vertex_id=bp.VertexId(1, 9), higher_round=4),
+        hz.Reconfigure({"kind": "grid", "grid": [[0, 1], [2, 3]]}),
+        hz.Die(),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
@@ -941,6 +985,10 @@ def test_registry_wide_corrupt_frame_containment():
     )
     from frankenpaxos_tpu.reconfig import encode_epoch_config
 
+    from frankenpaxos_tpu.geo.epochs import GeoEpoch as _GeoEpoch
+    from frankenpaxos_tpu.protocols.wpaxos.wire import encode_geo_epoch
+    from frankenpaxos_tpu.wal import WalGeoEpoch, WalGeoPromise, WalGeoVote
+
     for record in [WalPromise(round=3),
                    WalVote(slot=7, round=1, value=b"\x01ab"),
                    WalVoteRun(start_slot=1, stride=2, round=0,
@@ -950,6 +998,12 @@ def test_registry_wide_corrupt_frame_containment():
                    WalChosenRun(start_slot=3, stride=1, values=b"zz"),
                    WalEpoch(payload=encode_epoch_config(
                        1, 64, 1, 2, ("a0", ("10.0.0.2", 9001)))),
+                   WalGeoPromise(group=2, ballot=7),
+                   WalGeoVote(group=2, slot=9, ballot=7,
+                              value=b"\x01ab"),
+                   WalGeoEpoch(payload=encode_geo_epoch(_GeoEpoch(
+                       group=2, epoch=3, start_slot=17, home_zone=1,
+                       ballot=7))),
                    WalSnapshot(payload=b"snap")]:
         data = WAL_SERIALIZER.to_bytes(record)
         for _ in range(40):
@@ -1019,3 +1073,121 @@ def test_run_pipeline_codecs_fuzz():
                 list(d2.values)  # force the lazy decode
         except ValueError:
             pass  # the contract: ValueError or garbage, nothing else
+
+
+def test_wpaxos_codecs_round_trip():
+    """paxgeo (protocols/wpaxos): every message rides a fixed layout
+    from day one -- no pickle, extended tags 160-172."""
+    import frankenpaxos_tpu.protocols.wpaxos  # noqa: F401
+    from frankenpaxos_tpu.geo.epochs import GeoEpoch
+    from frankenpaxos_tpu.protocols.wpaxos import messages as wp
+
+    cid = wp.CommandId(("10.0.0.1", 9000), 2, 7)
+    sim_cid = wp.CommandId("client-0", 0, 3)
+    command = wp.Command(cid, b"geo-payload")
+    batch = wp.CommandBatch((command,))
+    entry = GeoEpoch(group=1, epoch=2, start_slot=64, home_zone=2,
+                     ballot=5)
+    for message in [
+        wp.WRequest(group=1, command=command),
+        wp.WRequest(group=1, command=wp.Command(sim_cid, b""),
+                    steal=True),
+        wp.WReply(command_id=cid, group=1, slot=64, result=b"ok"),
+        wp.WNotOwner(group=1, command_id=sim_cid, home_zone=2,
+                     ballot=5),
+        wp.Steal(group=3),
+        wp.WPhase1a(group=1, ballot=5, epoch=2),
+        wp.WPhase1b(group=1, ballot=5, epoch=2, acceptor=7,
+                    votes=(), epochs=(entry,)),
+        wp.WPhase1b(group=1, ballot=5, epoch=2, acceptor=7,
+                    votes=(wp.WVote(slot=3, ballot=2, value=batch),
+                           wp.WVote(slot=4, ballot=2,
+                                    value=wp.NOOP)),
+                    epochs=()),
+        wp.WPhase2a(group=1, slot=64, ballot=5, value=batch),
+        wp.WPhase2a(group=1, slot=64, ballot=5, value=wp.NOOP),
+        wp.WPhase2b(group=1, slot=64, ballot=5, acceptor=7),
+        wp.WNack(group=1, ballot=8, home_zone=0),
+        wp.WChosen(group=1, slot=64, value=batch),
+        wp.WEpochCommit(entry=entry),
+        wp.WEpochAck(group=1, epoch=2),
+        wp.WRecover(group=1, slot=12),
+    ]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] == 0, type(message).__name__  # extended page
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_wpaxos_request_is_client_lane():
+    """The frame classifier can shed WRequest under overload without
+    decoding it (serve/lanes.py); everything else in the unit --
+    votes, steals, epoch commits -- stays control."""
+    import frankenpaxos_tpu.protocols.wpaxos  # noqa: F401
+    from frankenpaxos_tpu.protocols.wpaxos import messages as wp
+    from frankenpaxos_tpu.serve.lanes import (
+        frame_lane,
+        LANE_CLIENT,
+        LANE_CONTROL,
+    )
+
+    command = wp.Command(wp.CommandId("c", 0, 1), b"x")
+    request = DEFAULT_SERIALIZER.to_bytes(
+        wp.WRequest(group=0, command=command))
+    assert frame_lane(request) == LANE_CLIENT
+    for message in [wp.WPhase1a(group=0, ballot=1, epoch=1),
+                    wp.WPhase2b(group=0, slot=1, ballot=1,
+                                acceptor=0),
+                    wp.Steal(group=0),
+                    wp.WEpochAck(group=0, epoch=1)]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert frame_lane(data) == LANE_CONTROL, type(message).__name__
+
+
+def test_cod301_burn_down_tranche3_round_trip():
+    """epaxos Prepare/PrepareOk/Nack, simplebpaxos Phase1a/Phase1b/
+    Nack, and horizontal Reconfigure/Die graduated from the pickle
+    fallback (tags 173-180; .paxlint-baseline.json 30 -> 22)."""
+    import frankenpaxos_tpu.protocols.epaxos  # noqa: F401
+    import frankenpaxos_tpu.protocols.horizontal as hz
+    import frankenpaxos_tpu.protocols.simplebpaxos  # noqa: F401
+    from frankenpaxos_tpu.protocols.epaxos import messages as em
+    from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+        Instance,
+        InstancePrefixSet,
+    )
+    from frankenpaxos_tpu.protocols.simplebpaxos import messages as bp
+
+    edeps = InstancePrefixSet(2)
+    edeps.add(Instance(0, 1))
+    bdeps = bp.VertexIdPrefixSet(2)
+    bdeps.add(bp.VertexId(0, 1))
+    for message in [
+        em.Prepare(instance=Instance(0, 5), ballot=(2, 1)),
+        em.Nack(instance=Instance(1, 3), largest_ballot=(4, 0)),
+        em.PrepareOk(ballot=(2, 1), instance=Instance(0, 5),
+                     replica_index=1, vote_ballot=(1, 0),
+                     status=em.CommandStatus.PRE_ACCEPTED,
+                     command_or_noop=em.Command("c", 0, 1, b"xyz"),
+                     sequence_number=7, dependencies=edeps),
+        em.PrepareOk(ballot=(2, 1), instance=Instance(0, 5),
+                     replica_index=1, vote_ballot=(-1, -1),
+                     status=em.CommandStatus.NOT_SEEN,
+                     command_or_noop=None, sequence_number=None,
+                     dependencies=None),
+        bp.Phase1a(vertex_id=bp.VertexId(0, 3), round=2),
+        bp.Phase1b(vertex_id=bp.VertexId(0, 3), acceptor_id=1,
+                   round=2, vote_round=-1, vote_value=None),
+        bp.Phase1b(vertex_id=bp.VertexId(0, 3), acceptor_id=1,
+                   round=2, vote_round=1,
+                   vote_value=bp.VoteValue(bp.NOOP, bdeps)),
+        bp.Nack(vertex_id=bp.VertexId(1, 9), higher_round=4),
+        hz.Reconfigure({"kind": "simple_majority",
+                        "members": [0, 1, 2]}),
+        hz.Reconfigure({"kind": "zone_grid",
+                        "grid": [[0, 1, 2], [3, 4, 5]]}),
+        hz.Die(),
+    ]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] == 0, type(message).__name__  # extended page
+        back = DEFAULT_SERIALIZER.from_bytes(data)
+        assert repr(back) == repr(message)
