@@ -13,6 +13,7 @@ against the source tree (the CI freshness gate).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -46,6 +47,18 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print every rule ID with its description and exit")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format: human text (default) or one JSON "
+             "document with file/line/rule/scope/detail/message/"
+             "baselined records (the CI lint job uploads this as an "
+             "artifact)")
+    parser.add_argument(
+        "--output", default=None,
+        help="write the JSON finding document to this file; works on "
+             "its own (stdout keeps the human report -- how the CI "
+             "lint job produces its artifact) or with --format=json "
+             "(stdout carries the same JSON)")
     parser.add_argument(
         "--write-flowgraphs", action="store_true",
         help="regenerate docs/flowgraphs/*.{json,dot} (paxflow "
@@ -103,6 +116,35 @@ def main(argv=None) -> int:
 
     entries = [] if args.no_baseline else baseline_mod.load(baseline_path)
     new, old, stale = baseline_mod.split(findings, entries)
+
+    if args.format == "json" or args.output:
+        grandfathered = {f.key for f in old}
+        document = {
+            "files_checked": len(project.modules),
+            "new": len(new),
+            "grandfathered": len(old),
+            "stale_baseline_entries": [list(k) for k in stale],
+            "findings": [
+                {
+                    "file": f.file,
+                    "line": f.line,
+                    "rule": f.rule,
+                    "scope": f.scope,
+                    "detail": f.detail,
+                    "message": f.message,
+                    "baselined": f.key in grandfathered,
+                }
+                for f in sorted(findings,
+                                key=lambda f: (f.file, f.line, f.rule))
+            ],
+        }
+        text = json.dumps(document, indent=1, sort_keys=True)
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as out:
+                out.write(text + "\n")
+        if args.format == "json":
+            print(text)
+            return 1 if new else 0
 
     if old:
         print(f"paxlint: {len(old)} grandfathered finding(s) "

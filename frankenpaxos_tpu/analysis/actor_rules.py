@@ -108,8 +108,10 @@ def _timer_callbacks(func: ast.AST) -> list:
 
 
 def _handler_closure(cls: ast.ClassDef) -> dict:
-    """Handler methods: seeds + self-call/timer-callback closure.
-    Returns {method name: node}."""
+    """Handler methods: seeds + self-call/timer-callback closure, plus
+    bound-method REFERENCES (``handlers = {Phase1a: self._handle_...}``
+    dispatch tables pass handlers as values, not calls). Returns
+    {method name: node}."""
     methods = _methods(cls)
     frontier = [m for m in _HANDLER_SEEDS if m in methods]
     closure: dict = {}
@@ -123,6 +125,11 @@ def _handler_closure(cls: ast.ClassDef) -> dict:
                 called = dotted(node.func)
                 if called.startswith("self.") and called.count(".") == 1:
                     frontier.append(called.split(".", 1)[1])
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and node.attr in methods:
+                frontier.append(node.attr)
         frontier.extend(_timer_callbacks(methods[name]))
     return closure
 

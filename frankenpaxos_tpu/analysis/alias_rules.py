@@ -1,0 +1,413 @@
+"""ALIAS10xx: sim-vs-deployed mutable-aliasing divergence races.
+
+SimTransport delivers message OBJECTS by reference; TcpTransport
+serializes at send time. The two agree only when messages are
+effectively immutable: a handler that embeds a live mutable container
+in an outgoing message -- or mutates a message it received -- behaves
+differently in simulation than deployed, which is exactly the class of
+bug the chaos soaks can never catch (the sim IS the oracle).
+
+  * ALIAS1001 -- a send whose message embeds an alias of mutable self
+    state: a ``list``/``dict``/``set``/``deque`` field passed into a
+    message construction without ``tuple()``/``copy()``/freezing,
+    where some handler later mutates that field. In the sim the
+    receiver observes the mutation (time travel); deployed it does
+    not.
+  * ALIAS1002 -- a handler mutates a message object it received
+    (``message.field = x``, ``message.values.append(...)``): visible
+    to the sender and to other recipients in sim only.
+
+Scope: Actor subclasses under ``protocols/``, ``reconfig/`` and
+``geo/``, over the PAX1xx handler closure. Sends resolve through the
+closure's helpers (``_wal_send``, ``send_batch``, and class-local
+sender helpers whose parameter flows into the message construction);
+received-message taint propagates through ``receive``'s dispatch calls
+into ``_handle_*`` helpers. Justified exceptions carry
+``# paxlint: disable=ALIAS100x`` with the argument for why the alias
+cannot race (e.g. the field is never mutated after the send by
+construction).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.actor_rules import (
+    _actor_classes,
+    _handler_closure,
+)
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    Project,
+    register_rules,
+)
+from frankenpaxos_tpu.analysis.safety_rules import _in_scope, _self_field
+
+RULES = {
+    "ALIAS1001": "message embeds an alias of mutable self state "
+                 "(sim delivers by reference; TCP serializes)",
+    "ALIAS1002": "handler mutates a received message object (visible "
+                 "to the sender in sim only)",
+}
+
+#: Constructors whose result is mutable (a field initialized to one is
+#: aliasing-hazardous when embedded in a message).
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "defaultdict", "deque", "OrderedDict",
+    "Counter", "SortedDict", "SortedSet", "bytearray",
+})
+
+#: Calls whose RESULT is a fresh object: wrapping the field in one
+#: breaks the alias.
+_SANITIZERS = frozenset({
+    "tuple", "list", "dict", "set", "frozenset", "sorted", "bytes",
+    "copy", "deepcopy", "min", "max", "len", "sum", "str", "repr",
+    "enumerate",
+})
+
+#: Method names that mutate their receiver in place.
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "add", "insert",
+    "update", "setdefault", "pop", "popitem", "popleft", "remove",
+    "discard", "clear", "sort", "reverse",
+})
+
+_SEND_NAMES = frozenset({"send", "send_no_flush", "_wal_send",
+                         "broadcast", "send_batch"})
+
+
+def _mutable_fields(cls: ast.ClassDef) -> set:
+    """Fields initialized to a mutable container anywhere in the class
+    (``__init__``, recovery helpers, handlers)."""
+    out: set = set()
+    for node in ast.walk(cls):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            v = node.value
+            mutable = isinstance(v, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)) \
+                or (isinstance(v, ast.Call)
+                    and dotted(v.func).split(".")[-1] in _MUTABLE_CTORS)
+            if mutable:
+                out.add(target.attr)
+    return out
+
+
+def _mutated_fields(closure: dict) -> set:
+    """Fields some handler-closure method mutates in place."""
+    out: set = set()
+    for func in closure.values():
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                field = _self_field(node.func.value)
+                if field is not None:
+                    out.add(field)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    if isinstance(target, ast.Subscript):
+                        field = _self_field(target)
+                        if field is not None:
+                            out.add(field)
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        field = _self_field(target)
+                        if field is not None:
+                            out.add(field)
+    return out
+
+
+def _alias_leaks(expr: ast.AST, fields: set, names: set) -> list:
+    """``(kind, name, node)`` for every UNSANITIZED embedding of
+    ``self.<field in fields>`` (kind "self") or a bare ``Name in
+    names`` (kind "name") inside ``expr``. A wrapping call to a
+    sanitizer -- or any method call / subscript, whose result is a
+    different object -- breaks the alias."""
+    out: list = []
+
+    def visit(node: ast.AST, sanitized: bool) -> None:
+        if isinstance(node, ast.Call):
+            leaf = dotted(node.func).split(".")[-1]
+            arg_sanitized = sanitized or leaf in _SANITIZERS
+            # The callee expression itself never embeds its owner.
+            visit(node.func, True)
+            for arg in node.args:
+                visit(arg, arg_sanitized)
+            for kw in node.keywords:
+                visit(kw.value, arg_sanitized)
+            return
+        if isinstance(node, ast.Subscript):
+            # Element reads are a different (possibly still mutable)
+            # object; out of scope for this rule.
+            visit(node.value, True)
+            if isinstance(node.slice, ast.AST):
+                visit(node.slice, True)
+            return
+        if isinstance(node, ast.Attribute):
+            field = _self_field(node)
+            if field is not None:
+                if not sanitized and field in fields \
+                        and isinstance(node.ctx, ast.Load):
+                    out.append(("self", field, node))
+                return
+            visit(node.value, True)  # obj.attr: a different object
+            return
+        if isinstance(node, ast.Name):
+            if not sanitized and node.id in names \
+                    and isinstance(node.ctx, ast.Load):
+                out.append(("name", node.id, node))
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, sanitized)
+
+    visit(expr, False)
+    return out
+
+
+def _methods(cls: ast.ClassDef) -> dict:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _message_exprs(func: ast.AST):
+    """``(send_call, expr)`` for every message expression handed to a
+    send-like call in ``func``: every arg past the destination, with
+    local names resolved to the construction they alias."""
+    local_ctors: dict = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            local_ctors[node.targets[0].id] = node.value
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func).split(".")[-1] in _SEND_NAMES):
+            continue
+        for arg in node.args[1:] + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in local_ctors:
+                yield node, local_ctors[arg.id]
+            else:
+                yield node, arg
+
+
+def _sender_param_sinks(cls: ast.ClassDef) -> dict:
+    """method name -> set of parameter names that flow UNSANITIZED into
+    a message expression of a send inside that method (the sender-
+    helper shape: ``def _reply(self, dst, values): self.send(dst,
+    Msg(values=values))``)."""
+    out: dict = {}
+    for name, func in _methods(cls).items():
+        params = {a.arg for a in func.args.args[1:]}
+        if not params:
+            continue
+        sinks: set = set()
+        for _, expr in _message_exprs(func):
+            for kind, leak, _node in _alias_leaks(expr, set(), params):
+                if kind == "name":
+                    sinks.add(leak)
+        if sinks:
+            out[name] = sinks
+    return out
+
+
+def _check_alias1001(mod, cls, closure, findings: list) -> None:
+    mutable = _mutable_fields(cls)
+    if not mutable:
+        return
+    hazardous = mutable & _mutated_fields(closure)
+    if not hazardous:
+        return
+    sinks = _sender_param_sinks(cls)
+    methods = _methods(cls)
+    for name, func in closure.items():
+        scope = f"{cls.name}.{name}"
+        # Direct sends (and sends of locally-constructed messages).
+        for send, expr in _message_exprs(func):
+            for kind, field, node in _alias_leaks(expr, hazardous,
+                                                  set()):
+                findings.append(Finding(
+                    rule="ALIAS1001", file=mod.path, line=node.lineno,
+                    scope=scope, detail=f"self.{field}",
+                    message=f"message embeds live mutable self.{field} "
+                            f"(a handler later mutates it): sim "
+                            f"delivers the alias, TCP serializes a "
+                            f"snapshot -- freeze it (tuple()/copy()) "
+                            f"at the send"))
+        # Sender helpers: the alias leaks at the CALL SITE.
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            called = dotted(node.func)
+            if not (called.startswith("self.")
+                    and called.count(".") == 1):
+                continue
+            helper = called.split(".", 1)[1]
+            if helper not in sinks or helper in _SEND_NAMES:
+                continue
+            helper_func = methods.get(helper)
+            if helper_func is None:
+                continue
+            params = [a.arg for a in helper_func.args.args[1:]]
+            bindings = list(zip(params, node.args)) + [
+                (kw.arg, kw.value) for kw in node.keywords]
+            for pname, arg in bindings:
+                if pname not in sinks[helper]:
+                    continue
+                for kind, field, leak_node in _alias_leaks(
+                        arg, hazardous, set()):
+                    findings.append(Finding(
+                        rule="ALIAS1001", file=mod.path,
+                        line=leak_node.lineno, scope=scope,
+                        detail=f"self.{field}",
+                        message=f"live mutable self.{field} flows "
+                                f"through self.{helper}() into a sent "
+                                f"message: freeze it (tuple()/copy()) "
+                                f"before handing it to the sender "
+                                f"helper"))
+
+
+def _tainted_params(cls: ast.ClassDef, closure: dict) -> dict:
+    """method name -> set of parameter names bound to a RECEIVED
+    message: ``receive``'s message param, ``_handle_*`` params past
+    ``src``, plus class-local propagation through calls that pass a
+    tainted name along."""
+    taint: dict = {name: set() for name in closure}
+    for name, func in closure.items():
+        args = [a.arg for a in func.args.args]
+        if name == "receive" and len(args) >= 3:
+            taint[name].update(args[2:])
+        elif name.startswith("_handle") and len(args) >= 3:
+            taint[name].update(args[2:])
+    changed = True
+    while changed:
+        changed = False
+        for name, func in closure.items():
+            if not taint[name]:
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                called = dotted(node.func)
+                if not (called.startswith("self.")
+                        and called.count(".") == 1):
+                    continue
+                callee = called.split(".", 1)[1]
+                if callee not in closure:
+                    continue
+                callee_args = [a.arg for a in
+                               closure[callee].args.args][1:]
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in taint[name] \
+                            and i < len(callee_args) \
+                            and callee_args[i] not in taint[callee]:
+                        taint[callee].add(callee_args[i])
+                        changed = True
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) \
+                            and kw.value.id in taint[name] \
+                            and kw.arg in callee_args \
+                            and kw.arg not in taint[callee]:
+                        taint[callee].add(kw.arg)
+                        changed = True
+    return taint
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """The base Name of an attribute/subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _check_alias1002(mod, cls, closure, findings: list) -> None:
+    taint = _tainted_params(cls, closure)
+    for name, func in closure.items():
+        tainted = set(taint.get(name, ()))
+        if not tainted:
+            continue
+        scope = f"{cls.name}.{name}"
+
+        def flag(node, what: str) -> None:
+            findings.append(Finding(
+                rule="ALIAS1002", file=mod.path, line=node.lineno,
+                scope=scope, detail=what,
+                message=f"handler mutates received message state "
+                        f"({what}): the sender (and every other "
+                        f"recipient) observes it in sim but not over "
+                        f"TCP -- copy before mutating"))
+
+        for node in ast.walk(func):
+            # Track locals aliasing message internals
+            # (``deps = msg.deps`` then ``deps.add(...)``).
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value,
+                                   (ast.Attribute, ast.Subscript)):
+                root = _root_name(node.value)
+                if root in tainted:
+                    tainted.add(node.targets[0].id)
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    if isinstance(target, (ast.Attribute,
+                                           ast.Subscript)):
+                        root = _root_name(target)
+                        if root in tainted:
+                            flag(node, dotted(target)
+                                 or f"{root}[...]")
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, (ast.Attribute,
+                                           ast.Subscript)):
+                        root = _root_name(target)
+                        if root in tainted:
+                            flag(node, dotted(target)
+                                 or f"{root}[...]")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                # The owner is message state whether it is an attribute
+                # chain (``message.values.append``) or a local aliasing
+                # one (``vals = message.values; vals.append``) -- taint
+                # covers both, and copies (``list(...)``) never taint.
+                root = _root_name(node.func.value)
+                if root in tainted:
+                    flag(node, dotted(node.func))
+
+
+def check(project: Project):
+    findings: list = []
+    for mod, cls in _actor_classes(project):
+        if not _in_scope(mod.path):
+            continue
+        closure = _handler_closure(cls)
+        if not closure:
+            continue
+        _check_alias1001(mod, cls, closure, findings)
+        _check_alias1002(mod, cls, closure, findings)
+    return findings
+
+
+register_rules(RULES, check)

@@ -131,6 +131,7 @@ def _ensure_loaded() -> None:
     """Import the rule-family modules (each registers itself)."""
     from frankenpaxos_tpu.analysis import (  # noqa: F401
         actor_rules,
+        alias_rules,
         codec_rules,
         durability_rules,
         epoch_rules,
@@ -139,6 +140,7 @@ def _ensure_loaded() -> None:
         hotpath_rules,
         net_rules,
         overload_rules,
+        safety_rules,
         shape_rules,
     )
 

@@ -1,0 +1,627 @@
+"""SAFE9xx: Paxos safety disciplines as dataflow over role-state writes.
+
+Every safety bug this repo has shipped-then-caught was found
+dynamically by chaos soaks (the PR 3 next_slot-unclamped double-choose,
+the PR 5 adopted-epoch double-choose, the PR 9 restart-ballot reuse) --
+and soaks cover 4 of the 20 protocols. These rules enforce the
+disciplines the Flexible Paxos / WPaxos safety arguments rest on
+MECHANICALLY, over every protocol unit:
+
+  * SAFE901 -- ballot/round adoption without a monotonicity guard: a
+    handler stores an incoming round into role state
+    (``self.round = msg.round``) with no comparison against the stored
+    round anywhere on the handler path. An unguarded adoption lets a
+    stale leader roll the promise backwards, breaking the quorum
+    intersection argument.
+  * SAFE902 -- vote-store writes that are not write-once-per
+    (slot, ballot): overwriting a vote record without a round compare
+    or an existing-entry check lets one acceptor report two different
+    values for the same (slot, ballot) -- two choosable values.
+  * SAFE903 -- ``next_slot`` derived from the Phase1 voted max without
+    a chosen-watermark clamp (the PR 3 double-choose class): Phase1bs
+    report nothing below the watermark, so ``voted_max + 1`` can land
+    INSIDE already-chosen slots and re-propose fresh commands there.
+  * SAFE904 -- watermark fields updated non-monotonically: a plain
+    assignment (no ``max()``, no guard) lets a stale/duplicate message
+    rewind GC or execution watermarks, un-protecting state the role
+    already discarded.
+  * SAFE905 -- promise state mutated after the corresponding
+    Phase1b/WPhase1b send in the same handler: the promise must be
+    complete BEFORE it is announced -- post-send mutation diverges
+    between SimTransport (by-reference: receiver sees the final state)
+    and TcpTransport (serialized at send: receiver sees the stale one),
+    and under durability the WAL record order inverts.
+
+Scope: Actor subclasses under ``protocols/``, ``reconfig/`` and
+``geo/`` (the protocol units), over the PAX1xx handler closure
+(``receive``/``on_drain`` + self-call/timer-callback closure).
+Guards resolve INTERPROCEDURALLY through that closure: a round compare
+in the dispatching handler clears the adoption inside the helper it
+calls (the ``_handle_phase2a_run`` -> ``_store_run`` shape).
+Justified exceptions carry ``# paxlint: disable=SAFE90x`` with the
+safety argument in the comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from frankenpaxos_tpu.analysis.actor_rules import (
+    _actor_classes,
+    _handler_closure,
+)
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    Project,
+    register_rules,
+)
+
+RULES = {
+    "SAFE901": "ballot/round adoption without a monotonicity guard on "
+               "the stored round",
+    "SAFE902": "vote-store write that is not write-once-per-"
+               "(slot, ballot)",
+    "SAFE903": "next_slot derived from the Phase1 voted max without a "
+               "chosen-watermark clamp",
+    "SAFE904": "watermark field updated non-monotonically (assignment "
+               "without max()/guard)",
+    "SAFE905": "promise state mutated after the Phase1b send in the "
+               "same handler",
+}
+
+#: Module-path segments that mark protocol units (matched like the
+#: PAX111 scopes, so fixture projects scope identically).
+_ROLE_SCOPES = ("/protocols/", "/reconfig/", "/geo/")
+
+#: Round/ballot-valued state: ``round``, ``ballot``, ``vote_round``,
+#: ``ballots[group]``... but NOT ``round_system``/``round_type``
+#: (machinery, not state).
+_ROUND_RE = re.compile(r"(^|_)(round|ballot)s?($|_)")
+_ROUND_DENY = frozenset({"round_system", "roundsystem", "round_type",
+                         "round_robin"})
+
+#: Vote-store state (SAFE902): per-slot vote records. Deliberately
+#: name-based on ``vote``/``accepted`` only -- leader-side ``states``
+#: maps are per-instance STATE MACHINES, not vote stores.
+_VOTE_RE = re.compile(r"(^|_)(vote|voted|votes|accepted)s?($|_)")
+_VOTE_EXACT = frozenset()
+
+_WATERMARK_RE = re.compile(r"watermark")
+_WATERMARK_EXACT = frozenset({"max_voted_slot", "max_slot"})
+
+_SEND_NAMES = frozenset({"send", "send_no_flush", "_wal_send",
+                         "broadcast", "send_batch"})
+
+
+def _in_scope(path: str) -> bool:
+    return any(seg in path for seg in _ROLE_SCOPES)
+
+
+def _is_round_field(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    return low not in _ROUND_DENY and bool(_ROUND_RE.search(low))
+
+
+def _is_vote_field(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    return low in _VOTE_EXACT or bool(_VOTE_RE.search(low))
+
+
+def _is_watermark_field(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    return low in _WATERMARK_EXACT or bool(_WATERMARK_RE.search(low))
+
+
+def _self_field(node: ast.AST) -> str | None:
+    """``self.X`` / ``self.X[...]`` / ``self.X[...][...]`` -> ``X``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _field_writes(func: ast.AST):
+    """Yield ``(stmt_node, field, target, value_or_None, augmented)``
+    for every write to ``self.X`` / ``self.X[...]`` in ``func``,
+    skipping nested function/class bodies (other scopes)."""
+    yield from _field_writes_of(func, roots=list(
+        ast.iter_child_nodes(func)))
+
+
+def _field_writes_of(stmt: ast.AST, roots: list | None = None):
+    """Like :func:`_field_writes` but over one statement subtree
+    (the statement itself included)."""
+    stack = roots if roots is not None else [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        targets, value, augmented = [], None, False
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value, augmented = [node.target], node.value, True
+        for target, tvalue in _unpacked(targets, value):
+            field = _self_field(target)
+            if field is not None:
+                yield node, field, target, tvalue, augmented
+
+
+def _unpacked(targets: list, value):
+    """Flatten tuple/list assignment targets, pairing each element with
+    its RHS component when the RHS is a matching display
+    (``self.a, self.b = m.x, m.y``) and with the whole RHS otherwise --
+    a tuple-written round adoption must not be invisible."""
+    for target in targets:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elements = target.elts
+            if isinstance(value, (ast.Tuple, ast.List)) \
+                    and len(value.elts) == len(elements):
+                yield from zip(elements, value.elts)
+            else:
+                for element in elements:
+                    yield element, value
+        else:
+            yield target, value
+
+
+def _mentions(tree: ast.AST, pred) -> bool:
+    """Any Name/Attribute leaf in ``tree`` whose name satisfies
+    ``pred``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and pred(node.attr):
+            return True
+        if isinstance(node, ast.Name) and pred(node.id):
+            return True
+    return False
+
+
+def _has_guard_compare(func: ast.AST, pred) -> bool:
+    """A Compare whose leaves mention a name satisfying ``pred`` --
+    the shape of every monotonicity/write-once guard
+    (``if msg.round < self.round``, ``while w in self.log``...)."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Compare):
+            if _mentions(node, pred):
+                return True
+    return False
+
+
+def _reads_self_field(tree: ast.AST, field: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == field \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def _calls_get_on(func: ast.AST, field: str) -> bool:
+    """``self.<field>.get(...)`` / ``self.<field>[...].get(...)`` --
+    the read-before-write shape of a write-once check."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("get", "setdefault") \
+                and _self_field(node.func.value) == field:
+            return True
+    return False
+
+
+def _is_constant(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.operand,
+                                                    ast.Constant):
+        return True
+    return False
+
+
+def _closure_callers(closure: dict) -> dict:
+    """method name -> set of DIRECT caller method names, within the
+    handler closure."""
+    callers: dict = {name: set() for name in closure}
+    for name, func in closure.items():
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                called = dotted(node.func)
+                if called.startswith("self.") and called.count(".") == 1:
+                    callee = called.split(".", 1)[1]
+                    if callee in callers:
+                        callers[callee].add(name)
+    return callers
+
+
+def _guard_contexts(name: str, closure: dict, callers: dict) -> list:
+    """The function plus every transitive caller inside the closure:
+    a guard anywhere on the call-in path clears the write."""
+    seen = {name}
+    frontier = [name]
+    while frontier:
+        cur = frontier.pop()
+        for caller in callers.get(cur, ()):
+            if caller not in seen:
+                seen.add(caller)
+                frontier.append(caller)
+    return [closure[n] for n in seen]
+
+
+def _is_phase1b_ctor(name: str) -> bool:
+    """Promise announcements: ``Phase1b``, ``WPhase1b``,
+    ``MatchPhase1b``... -- but never the ``*Nack`` refusals."""
+    return "Phase1b" in name and "Nack" not in name
+
+
+def _phase1b_sends(func: ast.AST) -> list:
+    """The send CALL NODES whose message is (or aliases a local
+    assigned from) a ``*Phase1b*`` construction."""
+    locals_p1b: set = set()
+    out = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _is_phase1b_ctor(dotted(node.value.func)
+                                     .split(".")[-1]):
+            locals_p1b.add(node.targets[0].id)
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func).split(".")[-1] in _SEND_NAMES):
+            continue
+        for arg in node.args[1:] + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in locals_p1b:
+                out.append(node)
+                break
+            if any(isinstance(sub, ast.Call)
+                   and _is_phase1b_ctor(dotted(sub.func).split(".")[-1])
+                   for sub in ast.walk(arg)):
+                out.append(node)
+                break
+    return out
+
+
+def _post_send_statements(func: ast.AST, send_call: ast.Call) -> list:
+    """The statements CONTROL-FLOW-AFTER ``send_call`` inside ``func``:
+    for each block on the send's ancestor chain, the statements
+    following the ancestor -- stopping outward once a block's tail
+    guarantees termination (return/raise), and never crossing into a
+    sibling branch of the same ``if`` (line numbers alone would)."""
+    # Parent map over the statement tree (cheap: one walk per call).
+    parents: dict = {}
+    for node in ast.walk(func):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    # The statement containing the send.
+    stmt = send_call
+    while id(stmt) in parents and not isinstance(stmt, ast.stmt):
+        stmt = parents[id(stmt)]
+    out: list = []
+    while isinstance(stmt, ast.stmt):
+        parent = parents.get(id(stmt))
+        if parent is None:
+            break
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            block = getattr(parent, field, None)
+            if isinstance(block, list) and stmt in block:
+                i = block.index(stmt)
+                tail = block[i + 1:]
+                out.extend(tail)
+                if any(isinstance(s, (ast.Return, ast.Raise,
+                                      ast.Continue, ast.Break))
+                       for s in tail):
+                    return out
+                break
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda, ast.ClassDef)):
+            # A send inside a nested def (the resend-timer idiom) has
+            # no post-send region in the ENCLOSING handler: the outer
+            # statements run before the timer ever fires.
+            break
+        if not isinstance(parent, ast.stmt):
+            break
+        stmt = parent
+    return out
+
+
+def _is_slot_cursor(field: str) -> bool:
+    low = field.lower().lstrip("_")
+    return ("next" in low and "slot" in low) \
+        or low in ("delegate_start", "start_slot")
+
+
+def _watermark_leaf(name: str) -> bool:
+    low = name.lower()
+    return "watermark" in low or "chosen" in low
+
+
+def _local_env(func: ast.AST) -> dict:
+    """name -> [RHS exprs] for every bare-Name assignment in ``func``
+    (all of them: provenance is merged conservatively toward
+    cleanliness)."""
+    env: dict = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    env.setdefault(target.id, []).append(node.value)
+    return env
+
+
+#: Names that LOOK slot/round-valued but are machinery, never state.
+_MACHINERY = frozenset({"slot_system", "round_system", "roundsystem"})
+
+
+def _slot_leaves(expr: ast.AST, func: ast.AST,
+                 env: dict | None = None,
+                 exclude: str | None = None) -> tuple:
+    """(watermark, voted_max, params) over ``expr`` with ONE level of
+    local-name expansion. ``watermark`` counts any leaf (message
+    fields included); ``voted_max`` counts only bare locals and
+    ``self.*`` reads whose name says max/slot (a ``msg.start_slot``
+    field was clamped by its producer -- the producer's own write is
+    where the rule bites). ``exclude`` drops the field being written
+    (reading yourself is not a voted max)."""
+    if env is None:
+        env = _local_env(func)
+    params = {a.arg for a in getattr(func, "args").args[1:]} \
+        if hasattr(func, "args") else set()
+    watermark = False
+    voted_max = False
+    params_used: set = set()
+    seen_locals: set = set()
+
+    def slotish(name: str) -> bool:
+        low = name.lower()
+        return name != exclude and low not in _MACHINERY \
+            and ("max" in low or "slot" in low)
+
+    def scan(node: ast.AST, expand: bool) -> None:
+        nonlocal watermark, voted_max
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                if _watermark_leaf(sub.attr):
+                    watermark = True
+                elif isinstance(sub.value, ast.Name) \
+                        and sub.value.id == "self" \
+                        and slotish(sub.attr):
+                    voted_max = True
+            elif isinstance(sub, ast.Name):
+                low = sub.id.lower()
+                if _watermark_leaf(low):
+                    watermark = True
+                elif slotish(sub.id):
+                    voted_max = True
+                if sub.id in params:
+                    # Only DIRECT param reads defer to call sites; a
+                    # param buried one expansion deep (a comprehension
+                    # over message fields) is this site's own value.
+                    if expand:
+                        params_used.add(sub.id)
+                elif expand and sub.id in env \
+                        and sub.id not in seen_locals:
+                    seen_locals.add(sub.id)
+                    for rhs in env[sub.id]:
+                        scan(rhs, False)
+
+    scan(expr, True)
+    return watermark, voted_max, params_used
+
+
+def _check_next_slot(mod, cls, closure: dict) -> list:
+    """SAFE903 proper: see the family docstring."""
+    findings: list = []
+    #: callee -> {param: [fields it writes into]} for deferred
+    #: call-site checks.
+    deferred: dict = {}
+    for name, func in closure.items():
+        scope = f"{cls.name}.{name}"
+        env = _local_env(func)
+        for node, field, target, value, augmented in _field_writes(func):
+            if not _is_slot_cursor(field) or augmented \
+                    or value is None or _is_constant(value):
+                continue
+            watermark, voted_max, params_used = _slot_leaves(
+                value, func, env, exclude=field)
+            if watermark:
+                continue
+            if _has_guard_compare(
+                    func, lambda n, f=field: n == f
+                    or "next_slot" in n.lower()):
+                continue  # a monotone guard on the cursor itself
+            if params_used:
+                for p in params_used:
+                    deferred.setdefault(name, {}).setdefault(
+                        p, []).append(field)
+                continue
+            if voted_max:
+                findings.append(Finding(
+                    rule="SAFE903", file=mod.path, line=node.lineno,
+                    scope=scope, detail=f"self.{field}",
+                    message=f"self.{field} derived from a voted max "
+                            f"with no chosen-watermark clamp: Phase1bs "
+                            f"report nothing below the watermark, so "
+                            f"voted_max+1 can re-propose into "
+                            f"already-chosen slots (clamp with "
+                            f"max(..., chosen_watermark))"))
+    if not deferred:
+        return findings
+    # Call sites of the deferred helpers: the clamp must exist where
+    # the slot value is COMPUTED.
+    for name, func in closure.items():
+        scope = f"{cls.name}.{name}"
+        env = _local_env(func)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            called = dotted(node.func)
+            if not (called.startswith("self.")
+                    and called.count(".") == 1):
+                continue
+            callee = called.split(".", 1)[1]
+            if callee not in deferred:
+                continue
+            callee_params = [a.arg for a in
+                             closure[callee].args.args[1:]]
+            bindings = list(zip(callee_params, node.args)) + [
+                (kw.arg, kw.value) for kw in node.keywords]
+            for pname, arg in bindings:
+                if pname not in deferred[callee]:
+                    continue
+                watermark, voted_max, _ = _slot_leaves(arg, func, env)
+                if watermark or not voted_max:
+                    continue
+                # A clamp expressed as a guard must compare THE VALUE
+                # BEING PASSED against the watermark -- an unrelated
+                # watermark compare elsewhere in the function is not a
+                # clamp.
+                if isinstance(arg, ast.Name) and any(
+                        isinstance(cmp, ast.Compare)
+                        and _mentions(cmp, _watermark_leaf)
+                        and _mentions(cmp, lambda n, a=arg.id: n == a)
+                        for cmp in ast.walk(func)):
+                    continue
+                fields = sorted(set(deferred[callee][pname]))
+                findings.append(Finding(
+                    rule="SAFE903", file=mod.path, line=node.lineno,
+                    scope=scope, detail=f"{callee}:{pname}",
+                    message=f"slot cursor(s) "
+                            f"{', '.join('self.' + f for f in fields)} "
+                            f"set via self.{callee}({pname}=...) from "
+                            f"a voted max with no chosen-watermark "
+                            f"clamp: Phase1bs report nothing below "
+                            f"the watermark, so voted_max+1 can "
+                            f"re-propose into already-chosen slots "
+                            f"(clamp with "
+                            f"max(..., chosen_watermark))"))
+    return findings
+
+
+def check(project: Project):
+    findings: list = []
+    for mod, cls in _actor_classes(project):
+        if not _in_scope(mod.path):
+            continue
+        closure = _handler_closure(cls)
+        if not closure:
+            continue
+        callers = _closure_callers(closure)
+        for name, func in closure.items():
+            scope = f"{cls.name}.{name}"
+            contexts = None  # computed lazily, shared by every rule
+
+            def guards():
+                nonlocal contexts
+                if contexts is None:
+                    contexts = _guard_contexts(name, closure, callers)
+                return contexts
+
+            for node, field, target, value, augmented in \
+                    _field_writes(func):
+                # --- SAFE901: round adoption needs a monotonicity
+                # guard somewhere on the handler path.
+                if _is_round_field(field) and not field.startswith(
+                        ("vote", "_vote")):
+                    if augmented or value is None \
+                            or _is_constant(value) \
+                            or _reads_self_field(value, field):
+                        pass  # bump / reset / self-derived: monotone
+                    elif not any(_has_guard_compare(ctx, _is_round_field)
+                                 for ctx in guards()):
+                        findings.append(Finding(
+                            rule="SAFE901", file=mod.path,
+                            line=node.lineno, scope=scope,
+                            detail=f"self.{field}",
+                            message=f"handler adopts a round into "
+                                    f"self.{field} with no comparison "
+                                    f"against the stored round on the "
+                                    f"handler path: a stale message "
+                                    f"can roll the promise backwards "
+                                    f"(compare msg round to "
+                                    f"self.{field}, or use max())"))
+                # --- SAFE902: vote-store writes must be write-once
+                # per (slot, ballot).
+                if _is_vote_field(field) and not augmented \
+                        and value is not None and not _is_constant(value):
+                    ok = any(
+                        _has_guard_compare(ctx, _is_round_field)
+                        or _calls_get_on(ctx, field)
+                        for ctx in guards())
+                    if not ok:
+                        findings.append(Finding(
+                            rule="SAFE902", file=mod.path,
+                            line=node.lineno, scope=scope,
+                            detail=f"self.{field}",
+                            message=f"vote-store write to self.{field} "
+                                    f"with neither a round compare nor "
+                                    f"an existing-entry check on the "
+                                    f"handler path: votes must be "
+                                    f"write-once per (slot, ballot) or "
+                                    f"one acceptor can report two "
+                                    f"values for one (slot, ballot)"))
+                # --- SAFE904: watermark updates must be monotone.
+                if _is_watermark_field(field) and not augmented \
+                        and value is not None and not _is_constant(value):
+                    is_max = isinstance(value, ast.Call) \
+                        and dotted(value.func) == "max" \
+                        and any(_self_field(a) == field
+                                for a in value.args)
+                    # A Load of the field anywhere in the function
+                    # counts as a guard: the wm = self.W; while ...:
+                    # wm += 1; self.W = wm walk is monotone by
+                    # construction.
+                    guarded = is_max \
+                        or _reads_self_field(func, field) \
+                        or any(_has_guard_compare(
+                            ctx, _is_watermark_field)
+                            for ctx in guards())
+                    if not guarded:
+                        findings.append(Finding(
+                            rule="SAFE904", file=mod.path,
+                            line=node.lineno, scope=scope,
+                            detail=f"self.{field}",
+                            message=f"non-monotone watermark update to "
+                                    f"self.{field}: a stale/duplicate "
+                                    f"message can rewind it and "
+                                    f"un-protect discarded state (use "
+                                    f"max(self.{field}, ...) or guard "
+                                    f"the assignment)"))
+            # --- SAFE905: no promise mutation after the Phase1b send
+            # (control-flow-after, not merely line-after: a sibling
+            # elif branch is NOT post-send).
+            for send_call in _phase1b_sends(func):
+                post = _post_send_statements(func, send_call)
+                for stmt in post:
+                    for node, field, target, value, augmented in \
+                            _field_writes_of(stmt):
+                        if _is_round_field(field):
+                            findings.append(Finding(
+                                rule="SAFE905", file=mod.path,
+                                line=node.lineno, scope=scope,
+                                detail=f"self.{field}",
+                                message=f"self.{field} mutated after "
+                                        f"the Phase1b send at line "
+                                        f"{send_call.lineno}: the "
+                                        f"promise must be complete "
+                                        f"before it is announced (sim "
+                                        f"delivers by reference, TCP "
+                                        f"serializes at send -- the "
+                                        f"two diverge)"))
+        # --- SAFE903: slot cursors derived from the Phase1 voted max
+        # must clamp to the chosen watermark (the PR 3 double-choose
+        # class), tracked through one level of local provenance and
+        # through sender-helper parameters.
+        findings.extend(_check_next_slot(mod, cls, closure))
+    return findings
+
+
+register_rules(RULES, check)

@@ -451,7 +451,15 @@ class FasterPaxosServer(Actor):
         self.delegates = tuple([self.index]
                                + sorted(self.rng.sample(others,
                                                         self.config.f)))
-        start = max_slot + 1
+        # The delegate stripe must clear the chosen watermark, not just
+        # the voted max: Phase1bs report nothing below
+        # phase1a.chosen_watermark, so on a quiescent failover max_slot
+        # is -1 and an unclamped start rewinds to 0 -- any delegate
+        # with a hole below the watermark (it missed a Chosen while
+        # partitioned) would then re-propose a FRESH command into an
+        # already-chosen slot and commit it with f+1 delegate votes
+        # (the PR 3 double-choose class; found by paxsafe SAFE903).
+        start = max(max_slot + 1, self.executed_watermark)
         any_message = Phase2aAny(round=self.round,
                                  delegates=self.delegates,
                                  start_slot=start)

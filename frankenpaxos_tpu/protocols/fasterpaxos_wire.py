@@ -20,6 +20,7 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
 )
 from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
+_I32 = struct.Struct("<i")
 _I64 = struct.Struct("<q")
 _I64I64 = struct.Struct("<qq")
 _QQQ = struct.Struct("<qqq")
@@ -143,7 +144,85 @@ class FPClientReplyCodec(MessageCodec):
                              result), at
 
 
+def _put_delegates(out: bytearray, delegates: tuple) -> None:
+    out += _I32.pack(len(delegates))
+    for index in delegates:
+        out += _I32.pack(index)
+
+
+def _take_delegates(buf: bytes, at: int):
+    (n,) = _I32.unpack_from(buf, at)
+    if n < 0 or n > (len(buf) - at - 4) // 4:
+        raise ValueError(f"hostile delegate count {n}")
+    at += 4
+    delegates = []
+    for _ in range(n):
+        (index,) = _I32.unpack_from(buf, at)
+        if not 0 <= index < (1 << 20):
+            # Validate VALUES at the trust boundary too: a negative
+            # index would silently wrap server_addresses[i] and
+            # misroute; a huge one would IndexError deep in the actor
+            # loop instead of being dropped as a corrupt frame here.
+            raise ValueError(f"hostile delegate index {index}")
+        delegates.append(index)
+        at += 4
+    return tuple(delegates), at
+
+
+class FPPhase2aAnyCodec(MessageCodec):
+    """The delegation handoff (extended tag 192; paxsafe COD301
+    burn-down): carried on every round change, i.e. exactly when a
+    failover storm is also resending every queued client op."""
+
+    message_type = m.Phase2aAny
+    tag = 192
+
+    def encode(self, out, message):
+        out += _I64.pack(message.round)
+        _put_delegates(out, message.delegates)
+        out += _I64.pack(message.start_slot)
+
+    def decode(self, buf, at):
+        (round,) = _I64.unpack_from(buf, at)
+        delegates, at = _take_delegates(buf, at + 8)
+        (start_slot,) = _I64.unpack_from(buf, at)
+        return m.Phase2aAny(round=round, delegates=delegates,
+                            start_slot=start_slot), at + 8
+
+
+class FPPhase2aAnyAckCodec(MessageCodec):
+    message_type = m.Phase2aAnyAck
+    tag = 193
+
+    def encode(self, out, message):
+        out += _I32.pack(message.server_index)
+        out += _I64.pack(message.round)
+
+    def decode(self, buf, at):
+        (server,) = _I32.unpack_from(buf, at)
+        (round,) = _I64.unpack_from(buf, at + 4)
+        return m.Phase2aAnyAck(server_index=server, round=round), at + 12
+
+
+class FPRoundInfoCodec(MessageCodec):
+    """Leader -> client delegate discovery (extended tag 194): the
+    reply every redirected client gets during a failover."""
+
+    message_type = m.RoundInfo
+    tag = 194
+
+    def encode(self, out, message):
+        out += _I64.pack(message.round)
+        _put_delegates(out, message.delegates)
+
+    def decode(self, buf, at):
+        (round,) = _I64.unpack_from(buf, at)
+        delegates, at = _take_delegates(buf, at + 8)
+        return m.RoundInfo(round=round, delegates=delegates), at
+
+
 for _codec in (FPClientRequestCodec(), FPPhase2aCodec(),
                FPPhase2bCodec(), FPPhase3aCodec(),
-               FPClientReplyCodec()):
+               FPClientReplyCodec(), FPPhase2aAnyCodec(),
+               FPPhase2aAnyAckCodec(), FPRoundInfoCodec()):
     register_codec(_codec)

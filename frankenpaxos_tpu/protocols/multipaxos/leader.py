@@ -232,7 +232,11 @@ class Leader(Actor):
                               if self.index == 0 else _Inactive())
 
     # --- helpers ----------------------------------------------------------
-    def _acceptor_address(self, flat: int) -> Address:
+    # Flat grid-index arithmetic for the flexible-grid branch, which
+    # runs only when self.epochs is None: grid deployments are
+    # epoch-frozen (docs/RECONFIG.md "Supported shapes"), so the static
+    # config IS the membership.
+    def _acceptor_address(self, flat: int) -> Address:  # paxlint: disable=PAX110
         return self.config.acceptor_addresses[flat // self._row_size][
             flat % self._row_size]
 
@@ -449,6 +453,9 @@ class Leader(Actor):
             for acceptor in targets:
                 self.send(acceptor, phase1a)
         elif not self.config.flexible:
+            # self.epochs is None on this path: multi-group striping
+            # is epoch-frozen (docs/RECONFIG.md "Supported shapes").
+            # paxlint: disable=PAX110
             for group in self.config.acceptor_addresses:
                 for acceptor in self.rng.sample(list(group),
                                                 self.config.f + 1):

@@ -149,6 +149,50 @@ class SClientReplyCodec(MessageCodec):
                              result), at
 
 
+class ProposeCutCodec(MessageCodec):
+    """The aggregator -> leader cut proposal (extended tag 190; paxsafe
+    COD301 burn-down -- steady-state per-proposal traffic that was
+    riding pickle)."""
+
+    message_type = m.ProposeCut
+    tag = 190
+
+    def encode(self, out, message):
+        _put_watermark(out, message.cut.watermark)
+
+    def decode(self, buf, at):
+        watermark, at = _take_watermark(buf, at)
+        return m.ProposeCut(m.GlobalCut(watermark)), at
+
+
+class RawCutChosenCodec(MessageCodec):
+    """Leader -> aggregator chosen raw cut (extended tag 191): a
+    GlobalCut-or-Noop behind a one-byte flag."""
+
+    message_type = m.RawCutChosen
+    tag = 191
+
+    def encode(self, out, message):
+        out += _I64.pack(message.slot)
+        if isinstance(message.raw_cut_or_noop, m.Noop):
+            out.append(0)
+        else:
+            out.append(1)
+            _put_watermark(out, message.raw_cut_or_noop.watermark)
+
+    def decode(self, buf, at):
+        (slot,) = _I64.unpack_from(buf, at)
+        kind = buf[at + 8]
+        at += 9
+        if kind == 0:
+            return m.RawCutChosen(slot, m.Noop()), at
+        if kind != 1:
+            raise ValueError(f"bad RawCutChosen flag {kind}")
+        watermark, at = _take_watermark(buf, at)
+        return m.RawCutChosen(slot, m.GlobalCut(watermark)), at
+
+
 for _codec in (SClientRequestCodec(), BackupCodec(), ShardInfoCodec(),
-               CutChosenCodec(), SChosenCodec(), SClientReplyCodec()):
+               CutChosenCodec(), SChosenCodec(), SClientReplyCodec(),
+               ProposeCutCodec(), RawCutChosenCodec()):
     register_codec(_codec)

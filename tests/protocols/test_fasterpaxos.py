@@ -199,6 +199,66 @@ def test_heartbeat_drives_round_change_off_dead_delegate():
         assert server.state_machine.get().count(b"post") == 1
 
 
+def test_round_change_after_quiescence_clamps_delegate_slots():
+    """paxsafe SAFE903 regression: the delegate stripe after a round
+    change must start at max(voted_max + 1, executed_watermark), not
+    voted_max + 1. On a quiescent failover the Phase1bs report nothing
+    at/above the new leader's watermark (the Phase1a carries it as the
+    report floor), so an unclamped start rewinds to slot 0 and a
+    delegate with a hole below the watermark re-proposes fresh
+    commands into already-chosen slots -- its stale vote at the chosen
+    slot can then resurrect through a later Phase1 (vote_round beats
+    the original), the PR 3 double-choose class."""
+    # seed=4: the new leader picks the behind server (2) as its
+    # co-delegate, the worst case.
+    transport, _, servers, clients = make_fasterpaxos(seed=4)
+    got = []
+    clients[0].write(0, b"a", got.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: bool(got))
+    # Server 2 misses a whole write (chosen by the other two) -- it
+    # now has holes below the others' executed watermark.
+    transport.partition("server-2")
+    got2 = []
+    clients[1].write(0, b"b", got2.append)
+    transport.deliver_all()
+    assert pump(transport, lambda: bool(got2))
+    transport.heal("server-2")
+    assert servers[2].executed_watermark < servers[1].executed_watermark
+    # Quiescent failover: server 1 takes over a fresh round with
+    # nothing in flight.
+    servers[1].start_round_change(
+        servers[1].round_system.next_classic_round(1, servers[1].round))
+    transport.deliver_all()
+    assert servers[1].is_leader
+    assert 2 in servers[1].delegates
+    wm = servers[1].executed_watermark
+    # The clamp: no delegate's stripe may start below the chosen
+    # watermark the Phase1 was anchored at.
+    assert servers[1].delegate_start >= wm, servers[1].delegate_start
+    for server in servers:
+        if server.is_delegate:
+            assert server.delegate_start >= wm
+            assert server.next_owned_slot >= wm
+    # A request handled by the once-behind delegate lands in fresh
+    # slots; every chosen slot still agrees across servers.
+    servers[2].receive("client-z", ClientRequest(
+        round=servers[2].round, command=cmd(9, client="client-z")))
+    transport.deliver_all()
+    pump(transport, lambda: False, rounds=5)
+    from .sim_util import per_slot_agreement
+    error = per_slot_agreement(
+        (i, ((slot, entry.vote_value)
+             for slot, entry in server.log.items() if entry.chosen))
+        for i, server in enumerate(servers))
+    assert error is None, error
+    for server in servers:
+        for slot, entry in server.log.items():
+            if not isinstance(entry.vote_value, Noop) \
+                    and entry.vote_value.command == b"c9":
+                assert slot >= wm, (slot, wm)
+
+
 # ---------------------------------------------------------------------------
 # Randomized simulation: delegate-striped writes under arbitrary
 # reordering/duplication/loss.

@@ -918,6 +918,31 @@ def all_codec_samples() -> dict:
         hz.Reconfigure({"kind": "grid", "grid": [[0, 1], [2, 3]]}),
         hz.Die(),
     ]
+    # COD301 burn-down tranche 4 (tags 181-191): the matchmaker
+    # epoch-change single-decree Paxos + GC pair, and scalog's
+    # steady-state cut proposal loop.
+    mmp_mc = mmp.MatchmakerConfiguration(
+        epoch=2, reconfigurer_index=1, matchmaker_indices=(3, 4, 5))
+    samples += [
+        mmp.Stopped(epoch=2),
+        mmp.GarbageCollect(matchmaker_configuration=mmp_mc,
+                           gc_watermark=9),
+        mmp.GarbageCollectAck(epoch=2, matchmaker_index=4,
+                              gc_watermark=9),
+        mmp.MatchPhase1a(matchmaker_configuration=mmp_mc, round=7),
+        mmp.MatchPhase1b(epoch=2, round=7, matchmaker_index=3,
+                         vote_round=5, vote_value=mmp_mc),
+        mmp.MatchPhase2a(matchmaker_configuration=mmp_mc, round=7,
+                         value=mmp_mc),
+        mmp.MatchPhase2b(epoch=2, round=7, matchmaker_index=3),
+        mmp.MatchChosen(value=mmp_mc),
+        mmp.MatchNack(epoch=2, round=7),
+        sc.ProposeCut(sc.GlobalCut((3, 5, 1 << 40))),
+        sc.RawCutChosen(slot=6, raw_cut_or_noop=sc.GlobalCut((3, 5))),
+        fsp.Phase2aAny(round=3, delegates=(0, 2), start_slot=64),
+        fsp.Phase2aAnyAck(server_index=2, round=3),
+        fsp.RoundInfo(round=3, delegates=(0, 2)),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
@@ -1191,3 +1216,83 @@ def test_cod301_burn_down_tranche3_round_trip():
         assert data[0] == 0, type(message).__name__  # extended page
         back = DEFAULT_SERIALIZER.from_bytes(data)
         assert repr(back) == repr(message)
+
+
+def test_cod301_burn_down_tranche4_round_trip():
+    """Matchmaker epoch-change Paxos (Stopped/GC/GCAck/MatchPhase1a/
+    1b/2a/2b/MatchChosen/MatchNack) and scalog's ProposeCut/
+    RawCutChosen graduated from the pickle fallback (tags 181-191;
+    .paxlint-baseline.json 22 -> 8)."""
+    import frankenpaxos_tpu.protocols.matchmakermultipaxos as mmp
+    import frankenpaxos_tpu.protocols.scalog as sc
+
+    mc = mmp.MatchmakerConfiguration(
+        epoch=3, reconfigurer_index=0, matchmaker_indices=(0, 1, 2))
+    mc2 = mmp.MatchmakerConfiguration(
+        epoch=4, reconfigurer_index=1, matchmaker_indices=(3, 4, 5))
+    for message in [
+        mmp.Stopped(epoch=0),
+        mmp.GarbageCollect(matchmaker_configuration=mc,
+                           gc_watermark=1 << 40),
+        mmp.GarbageCollectAck(epoch=3, matchmaker_index=2,
+                              gc_watermark=0),
+        mmp.MatchPhase1a(matchmaker_configuration=mc, round=9),
+        mmp.MatchPhase1b(epoch=3, round=9, matchmaker_index=1,
+                         vote_round=-1, vote_value=None),
+        mmp.MatchPhase1b(epoch=3, round=9, matchmaker_index=1,
+                         vote_round=4, vote_value=mc2),
+        mmp.MatchPhase2a(matchmaker_configuration=mc, round=9,
+                         value=mc2),
+        mmp.MatchPhase2b(epoch=3, round=9, matchmaker_index=0),
+        mmp.MatchChosen(value=mc2),
+        mmp.MatchNack(epoch=3, round=9),
+        sc.ProposeCut(sc.GlobalCut(())),
+        sc.ProposeCut(sc.GlobalCut((0, 7, 1 << 50))),
+        sc.RawCutChosen(slot=0, raw_cut_or_noop=sc.Noop()),
+        sc.RawCutChosen(slot=1 << 40,
+                        raw_cut_or_noop=sc.GlobalCut((1, 2, 3))),
+    ]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] == 0, type(message).__name__  # extended page
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+    # The fasterpaxos delegation-control trio (tags 192-194): the
+    # protocol whose SAFE903 double-choose this PR fixed keeps its
+    # failover traffic off the pickle fallback too.
+    import frankenpaxos_tpu.protocols.fasterpaxos as fsp
+
+    for message in [
+        fsp.Phase2aAny(round=0, delegates=(), start_slot=0),
+        fsp.Phase2aAny(round=9, delegates=(0, 1, 4), start_slot=1 << 40),
+        fsp.Phase2aAnyAck(server_index=4, round=9),
+        fsp.RoundInfo(round=9, delegates=(2,)),
+    ]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] == 0, type(message).__name__  # extended page
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_tranche4_rejects_hostile_index_values():
+    """Index VALUES are validated at decode, not just counts: a
+    negative delegate/matchmaker index would silently wrap a Python
+    list lookup (misrouting), and a huge one would IndexError deep in
+    the actor loop instead of dying here as a corrupt frame."""
+    import pytest
+
+    import frankenpaxos_tpu.protocols.fasterpaxos as fsp
+    import frankenpaxos_tpu.protocols.matchmakermultipaxos as mmp
+
+    good = DEFAULT_SERIALIZER.to_bytes(
+        fsp.RoundInfo(round=1, delegates=(0,)))
+    hostile = bytearray(good)
+    # delegates live after [0x00][tag][i64 round][i32 count]: flip the
+    # sole index to -1.
+    hostile[-4:] = (-1).to_bytes(4, "little", signed=True)
+    with pytest.raises(ValueError):
+        DEFAULT_SERIALIZER.from_bytes(bytes(hostile))
+    good = DEFAULT_SERIALIZER.to_bytes(mmp.MatchChosen(
+        value=mmp.MatchmakerConfiguration(
+            epoch=1, reconfigurer_index=0, matchmaker_indices=(2,))))
+    hostile = bytearray(good)
+    hostile[-4:] = (1 << 30).to_bytes(4, "little", signed=True)
+    with pytest.raises(ValueError):
+        DEFAULT_SERIALIZER.from_bytes(bytes(hostile))
