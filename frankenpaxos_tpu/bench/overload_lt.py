@@ -168,10 +168,17 @@ def evaluate_gate(arms: dict) -> dict:
 
 
 def _nohooks_patch():
-    """(enter, exit) swapping the paxload hook sites for verbatim
-    PRE-paxload bodies: SimTransport send/_deliver without the
-    bounded-inbox checks, and the leader client-request handlers
-    without the _admit early-outs."""
+    """(enter, exit) swapping the paxload hook sites for hook-free
+    bodies: SimTransport send without the bounded-inbox admission
+    check, and the leader client-request handlers without the _admit
+    early-outs.
+
+    Post-paxsim the benched delivery path is the wave engine
+    (``_run_wave``), where the admission-off inbox cost is one falsy
+    branch per delivered frame -- there is no per-message ``_deliver``
+    hook left to strip, and patching ``_deliver`` would disable the
+    wave fast path in this arm only (sim_transport.WAVE_SAFE_DELIVERS),
+    so the A/B would measure engines, not hooks."""
     from frankenpaxos_tpu.protocols.multipaxos import leader as leader_mod
     from frankenpaxos_tpu.protocols.multipaxos.leader import (
         Leader,
@@ -185,7 +192,6 @@ def _nohooks_patch():
         Phase2aRun,
     )
     from frankenpaxos_tpu.runtime.sim_transport import (
-        DeliverMessage,
         SimMessage,
         SimTransport,
     )
@@ -195,35 +201,6 @@ def _nohooks_patch():
         trace = tracer.current if tracer is not None else None
         self.messages.append(
             SimMessage(next(self._ids), src, dst, data, trace))
-
-    def _deliver(self, message):
-        try:
-            self.messages.remove(message)
-        except ValueError:
-            self.logger.warn(f"delivering unbuffered message {message}")
-            return None
-        if (message.dst in self.partitioned
-                or message.src in self.partitioned):
-            return None
-        self.history.append(DeliverMessage(message))
-        actor = self.actors.get(message.dst)
-        if actor is None:
-            self.logger.warn(f"no actor registered at {message.dst}")
-            return None
-        tracer = self.tracer
-        if tracer is None:
-            actor.receive(message.src,
-                          actor.serializer.from_bytes(message.data))
-            return actor
-        span = tracer.receive_span(str(message.dst), "?", message.trace)
-        with span:
-            with tracer.stage("decode"):
-                decoded = actor.serializer.from_bytes(message.data)
-            span.name = (f"receive:{type(decoded).__name__}"
-                         f"@{message.dst}")
-            with tracer.stage("handler"):
-                actor.receive(message.src, decoded)
-        return actor
 
     def _handle_client_request(self, src, request):
         if isinstance(self.state, _Inactive):
@@ -271,21 +248,20 @@ def _nohooks_patch():
     def _handle_chosen_watermark(self, src, msg):
         self.chosen_watermark = max(self.chosen_watermark, msg.slot)
 
-    originals = (SimTransport.send, SimTransport._deliver,
+    originals = (SimTransport.send,
                  Leader._handle_client_request,
                  Leader._handle_client_request_array,
                  Leader._handle_chosen_watermark)
 
     def enter():
         SimTransport.send = send
-        SimTransport._deliver = _deliver
         Leader._handle_client_request = _handle_client_request
         Leader._handle_client_request_array = _handle_client_request_array
         Leader._handle_chosen_watermark = _handle_chosen_watermark
         leader_mod  # keep the import referenced
 
     def exit():
-        (SimTransport.send, SimTransport._deliver,
+        (SimTransport.send,
          Leader._handle_client_request,
          Leader._handle_client_request_array,
          Leader._handle_chosen_watermark) = originals

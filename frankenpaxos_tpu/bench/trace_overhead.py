@@ -36,8 +36,18 @@ ARMS = ("no-hooks", "off", "sampled", "full")
 
 
 def _nohooks_patch():
-    """(enter, exit) functions swapping SimTransport's traced
-    deliver/drain/send for verbatim PRE-paxtrace bodies."""
+    """(enter, exit) functions swapping SimTransport's traced send and
+    drain for hook-free bodies.
+
+    Post-paxsim, the benched ``deliver_all_coalesced`` path runs the
+    wave engine (``_run_wave``), whose per-message tracer cost when a
+    tracer is detached is one ``is not None`` branch -- there is no
+    per-message ``_deliver`` hook left to strip, and patching
+    ``_deliver`` anyway would disable the wave fast path in THIS arm
+    only (sim_transport.WAVE_SAFE_DELIVERS), turning the hook A/B into
+    an engine A/B. So the no-hooks arm strips exactly the surviving
+    per-event hook sites: the send-side trace stamp and the per-drain
+    tracer check."""
     from frankenpaxos_tpu.runtime.sim_transport import (
         SimMessage,
         SimTransport,
@@ -47,43 +57,17 @@ def _nohooks_patch():
         self.messages.append(
             SimMessage(next(self._ids), src, dst, data))
 
-    def _deliver(self, message):
-        try:
-            self.messages.remove(message)
-        except ValueError:
-            self.logger.warn(
-                f"delivering unbuffered message {message}")
-            return None
-        if (message.dst in self.partitioned
-                or message.src in self.partitioned):
-            return None
-        from frankenpaxos_tpu.runtime.sim_transport import (
-            DeliverMessage,
-        )
-
-        self.history.append(DeliverMessage(message))
-        actor = self.actors.get(message.dst)
-        if actor is None:
-            self.logger.warn(f"no actor registered at {message.dst}")
-            return None
-        actor.receive(message.src,
-                      actor.serializer.from_bytes(message.data))
-        return actor
-
     def _drain(self, actor):
         actor.on_drain()
 
-    originals = (SimTransport.send, SimTransport._deliver,
-                 SimTransport._drain)
+    originals = (SimTransport.send, SimTransport._drain)
 
     def enter():
         SimTransport.send = send
-        SimTransport._deliver = _deliver
         SimTransport._drain = _drain
 
     def exit():
-        (SimTransport.send, SimTransport._deliver,
-         SimTransport._drain) = originals
+        (SimTransport.send, SimTransport._drain) = originals
 
     return enter, exit
 

@@ -23,6 +23,10 @@ import dataclasses
 import random
 from typing import Iterable, Mapping, Optional, Sequence
 
+import numpy as np
+
+from frankenpaxos_tpu.ops.simwave import UNPLACED_ZONE
+
 
 @dataclasses.dataclass
 class Link:
@@ -73,12 +77,29 @@ class GeoTopology:
         # mutates IN PLACE (partition/degrade flip fields), so cached
         # entries stay live; only (re)placement invalidates.
         self._address_links: dict = {}
+        # paxsim: integer zone ids for the vectorized wave masks.
+        # ``_zone_ids`` indexes self.zones; ``_addr_zone_ids`` interns
+        # per placed address (UNPLACED_ZONE for everything else).
+        # ``up_matrix`` caches against ``_up_gen``, bumped by every
+        # partition/heal (degrade does not change reachability).
+        self._zone_ids: dict[str, int] = {z: i
+                                          for i, z in enumerate(self.zones)}
+        self._addr_zone_ids: dict = {}
+        self._up_gen = 0
+        self._up_cache: tuple = (None, -1)
+        # One reusable MT instance for per-frame jitter: ``seed(key)``
+        # runs the same version-2 string seeding as ``Random(key)``
+        # (sha512, PYTHONHASHSEED-proof), so draws are BIT-IDENTICAL
+        # to a fresh instance per key -- the goldens prove it -- at
+        # about half the cost (no 2.5KB state allocation per frame).
+        self._jitter_rng = random.Random(0)
 
     # --- placement --------------------------------------------------------
     def place(self, address, zone: str) -> None:
         if zone not in self.region_of:
             raise ValueError(f"unknown zone {zone!r}")
         self._placement[address] = zone
+        self._addr_zone_ids[address] = self._zone_ids[zone]
         self._address_links.clear()
 
     def place_all(self, addresses: Iterable, zone: str) -> None:
@@ -126,6 +147,30 @@ class GeoTopology:
         link = self.link_for(src, dst)
         return link is None or link.up
 
+    def zone_id_of(self, address) -> int:
+        """The address's integer zone id for the vectorized wave masks
+        (``simwave.UNPLACED_ZONE`` when unplaced)."""
+        return self._addr_zone_ids.get(address, UNPLACED_ZONE)
+
+    def up_matrix(self) -> np.ndarray:
+        """``[Z+1, Z+1]`` bool reachability by zone id: entry
+        ``[s, d]`` is the directed link's ``up``; the last row/column
+        (reached by ``UNPLACED_ZONE`` = -1 via numpy wraparound) is the
+        always-up sentinel for unplaced endpoints. Cached against the
+        partition/heal generation; links never materialized by
+        :meth:`link` default to up, matching ``link_up``."""
+        cached, gen = self._up_cache
+        if cached is not None and gen == self._up_gen:
+            return cached
+        z = len(self.zones)
+        up = np.ones((z + 1, z + 1), dtype=bool)
+        zone_ids = self._zone_ids
+        for (src, dst), link in self._links.items():
+            if not link.up:
+                up[zone_ids[src], zone_ids[dst]] = False
+        self._up_cache = (up, self._up_gen)
+        return up
+
     def sample_delay(self, src, dst, frame_id: int) -> float:
         """The one-way delay for frame ``frame_id`` from ``src`` to
         ``dst``, deterministic per (topology seed, zone pair, frame).
@@ -136,10 +181,10 @@ class GeoTopology:
             return 0.0
         delay = link.base_s * link.degrade
         if link.jitter_s:
-            u = random.Random(
-                f"{self.seed}|{self._placement[src]}"
-                f"|{self._placement[dst]}|{frame_id}").random()
-            delay += link.jitter_s * link.degrade * u
+            rng = self._jitter_rng
+            rng.seed(f"{self.seed}|{self._placement[src]}"
+                     f"|{self._placement[dst]}|{frame_id}")
+            delay += link.jitter_s * link.degrade * rng.random()
         return delay
 
     def rtt(self, zone_a: str, zone_b: str) -> float:
@@ -158,12 +203,14 @@ class GeoTopology:
         self.link(zone_a, zone_b).up = False
         if both_ways:
             self.link(zone_b, zone_a).up = False
+        self._up_gen += 1
 
     def heal_link(self, zone_a: str, zone_b: str,
                   both_ways: bool = True) -> None:
         self.link(zone_a, zone_b).up = True
         if both_ways:
             self.link(zone_b, zone_a).up = True
+        self._up_gen += 1
 
     def degrade_link(self, zone_a: str, zone_b: str,
                      factor: float, both_ways: bool = True) -> None:
@@ -207,3 +254,4 @@ class GeoTopology:
         for link in self._links.values():
             link.up = True
             link.degrade = 1.0
+        self._up_gen += 1

@@ -25,6 +25,16 @@ link partitions/degrades applied at delivery time. The bounded-inbox
 admission path is NOT armed here (geo harnesses attach no admission
 controllers); arrival stamping covers synthesized reject replies
 anyway because stamps are derived per buffered frame.
+
+paxsim: the virtual-clock event loop is a POLICY over the shared wave
+engine (sim_transport._run_wave) -- the heap decides WHICH frames
+form the next wave (everything due at the next arrival time), then
+the same engine evaluates link/partition masks vectorized
+(topology.up_matrix x ops/simwave) and delivers with per-wave drains.
+Delivered frames tombstone out of the public buffer list
+(``_consume_buffered``) instead of paying a ``list.remove`` scan per
+message, which is what makes 1000-zone topologies and million-event
+schedules linear instead of quadratic (bench/sim_core_ab.py).
 """
 
 from __future__ import annotations
@@ -32,12 +42,16 @@ from __future__ import annotations
 import heapq
 from typing import Optional
 
+import numpy as np
+
 from frankenpaxos_tpu.geo.topology import GeoTopology
+from frankenpaxos_tpu.ops import simwave
 from frankenpaxos_tpu.runtime.logger import Logger
 from frankenpaxos_tpu.runtime.sim_transport import (
     SimMessage,
     SimTimer,
     SimTransport,
+    WAVE_SAFE_DELIVERS,
 )
 from frankenpaxos_tpu.runtime.transport import Address
 
@@ -75,6 +89,7 @@ class GeoSimTransport(SimTransport):
         #: timer id -> virtual deadline (running timers only).
         self._deadlines: dict[int, float] = {}
         self._deadline_heap: list = []
+        self._link_only_check = None
 
     # --- sending ----------------------------------------------------------
     def send(self, src: Address, dst: Address, data: bytes) -> None:
@@ -103,13 +118,55 @@ class GeoSimTransport(SimTransport):
             # Dropped on the partitioned link: consume the frame
             # without running the handler (the sim's per-address
             # ``partitioned`` drop semantics, at link granularity).
-            try:
-                self.messages.remove(message)
-            except ValueError:
+            if not self._remove_buffered(message):
                 self.logger.warn(
                     f"dropping unbuffered message {message}")
             return None
         return super()._deliver(message)
+
+    # --- paxsim wave-engine policy hooks ----------------------------------
+    def _drop_schedule_stamps(self, wave) -> None:
+        """FIFO drains consume frames outside the arrival-order loop;
+        their stamps and lazy-heap entries must die with them or a
+        later ``run_until`` would double-deliver (the legacy core did
+        this inside its per-message ``_deliver``)."""
+        arrivals = self.arrivals
+        by_id = self._by_id
+        for message in wave:
+            arrivals.pop(message.id, None)
+            by_id.pop(message.id, None)
+
+    def _wave_keep_mask(self, wave) -> Optional[np.ndarray]:
+        n = len(wave)
+        if n < simwave.WAVE_VECTOR_MIN:
+            return None
+        topo = self.topology
+        zid = topo.zone_id_of
+        src_z = np.fromiter((zid(m.src) for m in wave), np.int32, n)
+        dst_z = np.fromiter((zid(m.dst) for m in wave), np.int32, n)
+        keep = simwave.LINK_KEEP_MASK(src_z, dst_z, topo.up_matrix())
+        # The wave is already above WAVE_VECTOR_MIN, so the base mask
+        # is exactly the partitioned-address mask (None when no
+        # addresses are partitioned).
+        partitioned = super()._wave_keep_mask(wave)
+        if partitioned is not None:
+            keep &= partitioned
+        return keep
+
+    def _per_message_check(self):
+        base = super()._per_message_check()
+        if base is None:
+            # The common case (no per-address partitions): one cached
+            # closure instead of an allocation per (often singleton)
+            # wave.
+            check = self._link_only_check
+            if check is None:
+                link_up = self.topology.link_up
+                check = self._link_only_check = \
+                    lambda m: link_up(m.src, m.dst)
+            return check
+        link_up = self.topology.link_up
+        return lambda m: base(m) and link_up(m.src, m.dst)
 
     # --- the virtual-time event loop --------------------------------------
     @staticmethod
@@ -132,7 +189,7 @@ class GeoSimTransport(SimTransport):
 
     def _pop_due_messages(self, t: float) -> list:
         """Every buffered frame with arrival <= ``t``, in (arrival,
-        send id) order; their heap/stamp entries are consumed."""
+        send id) order; their heap entries are consumed."""
         due = []
         while self._arrival_heap:
             arrival, message_id = self._arrival_heap[0]
@@ -151,6 +208,43 @@ class GeoSimTransport(SimTransport):
         sharing one timestamp land as one wave and each touched
         destination drains once -- the event-loop batching semantics
         of the real transport. Returns the number of events run."""
+        if not self._wave_fast_path_ok():
+            return self._run_until_compat(t_end, max_steps)
+        steps = 0
+        try:
+            while steps < max_steps:
+                t = self.next_event_time()
+                if t is None or t > t_end:
+                    break
+                self.now = t
+                # The whole same-timestamp wave delivers even when it
+                # overshoots max_steps -- the legacy loop counted steps
+                # per message but only checked the cap between waves,
+                # and truncating here would let the timers due at t
+                # fire BEFORE the wave's tail (a schedule divergence).
+                wave = self._pop_due_messages(t)
+                if wave:
+                    self._drop_schedule_stamps(wave)
+                    self._consume_buffered(wave)
+                    steps += len(wave)
+                    self._run_wave(wave, coalesce=True)
+                # Timers due at (or before) t.
+                while self._deadline_heap:
+                    deadline, timer_id = self._deadline_heap[0]
+                    if deadline > t:
+                        break
+                    heapq.heappop(self._deadline_heap)
+                    if self._deadlines.get(timer_id) == deadline:
+                        self.trigger_timer(timer_id)
+                        steps += 1
+        finally:
+            self._compact_messages()
+        self.now = max(self.now, t_end)
+        return steps
+
+    def _run_until_compat(self, t_end: float, max_steps: int) -> int:
+        """Per-message fallback for intercepted delivery (identical
+        order/drain semantics, every frame through ``_deliver``)."""
         steps = 0
         while steps < max_steps:
             t = self.next_event_time()
@@ -167,7 +261,6 @@ class GeoSimTransport(SimTransport):
                     touched.append(actor)
             for actor in touched:
                 self._drain(actor)
-            # Timers due at (or before) t.
             while self._deadline_heap:
                 deadline, timer_id = self._deadline_heap[0]
                 if deadline > t:
@@ -191,21 +284,32 @@ class GeoSimTransport(SimTransport):
         settle can never be kept awake by resend churn. Bounded by
         ``horizon_s`` of virtual time. The settle primitive for
         integration tests; timer-driven runs use :meth:`run_for`."""
+        fast = self._wave_fast_path_ok()
         steps = 0
         t_end = self.now + horizon_s
-        while steps < max_steps:
-            t = self._peek(self._arrival_heap, self.arrivals)
-            if t is None or t > t_end:
-                break
-            self.now = max(self.now, t)
-            _, message_id = heapq.heappop(self._arrival_heap)
-            message = self._by_id.get(message_id)
-            if message is None:
-                continue
-            actor = self._deliver(message)
-            steps += 1
-            if actor is not None:
-                self._drain(actor)
+        try:
+            while steps < max_steps:
+                t = self._peek(self._arrival_heap, self.arrivals)
+                if t is None or t > t_end:
+                    break
+                self.now = max(self.now, t)
+                _, message_id = heapq.heappop(self._arrival_heap)
+                message = self._by_id.get(message_id)
+                if message is None:
+                    continue
+                if fast:
+                    self.arrivals.pop(message_id, None)
+                    self._by_id.pop(message_id, None)
+                    self._consume_buffered((message,))
+                    steps += 1
+                    self._run_wave([message], coalesce=True)
+                else:
+                    actor = self._deliver(message)
+                    steps += 1
+                    if actor is not None:
+                        self._drain(actor)
+        finally:
+            self._compact_messages()
         return steps
 
     def crash(self, address: Address) -> None:
@@ -214,10 +318,19 @@ class GeoSimTransport(SimTransport):
                            if tid in self.timers}
 
 
+# The geo `_deliver` override is wave-aware (its link/partition drops
+# are exactly what `_wave_keep_mask`/`_per_message_check` evaluate), so
+# the wave engine may bypass it. Subclasses pinning a DIFFERENT
+# `_deliver` (sim_legacy) fall back to per-message delivery.
+WAVE_SAFE_DELIVERS.add(GeoSimTransport._deliver)
+
+
 def delivery_schedule(transport: GeoSimTransport) -> list:
     """The in-flight frames as ``(arrival_s, id, src, dst)`` rows in
     delivery order -- the projection the golden determinism test
     snapshots (tests/test_geo.py)."""
+    if transport._consumed:
+        transport._compact_messages()
     rows = []
     for message in transport.messages:
         arrival = transport.arrivals.get(message.id)

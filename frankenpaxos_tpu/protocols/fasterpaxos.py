@@ -554,6 +554,24 @@ class FasterPaxosServer(Actor):
             # we switched to the command; it doesn't count.
             return
         if isinstance(pending, Noop) and phase2b.command is not None:
+            if phase2b.slot < self.delegate_start:
+                # Case (f) is UNSOUND for Phase1 REPAIR re-proposals
+                # (every pending slot below the delegation stripe):
+                # this noop is the safe value computed from the read
+                # quorum, so it may already be CHOSEN at servers
+                # outside that quorum, and the reported command rides
+                # an OLDER-round vote that must not count toward a
+                # current-round quorum. Switching here let a noop
+                # chosen in round r be overwritten by a command in
+                # round r' > r (chosen-uniqueness violation; found by
+                # the full-scale soak, seed 412 -- regression test in
+                # tests/protocols/test_fasterpaxos.py). Ignoring the
+                # ack stalls only this slot until a delegation that
+                # includes a server that saw the choice; the fresh-
+                # stripe switch below stays sound because quorum
+                # intersection proves no chosen value can hide above
+                # Phase1's max_slot.
+                return
             # Case (f): our noop lost to a command; start counting
             # command votes (ours + the sender's).
             value: CommandOrNoop = phase2b.command

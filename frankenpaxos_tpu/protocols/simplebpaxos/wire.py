@@ -34,6 +34,7 @@ from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
     Phase2a,
     Phase2b,
     Propose,
+    Recover,
     VertexId,
     VoteValue,
 )
@@ -284,10 +285,27 @@ class BPaxosNackCodec(MessageCodec):
                     higher_round=higher_round), at + 8
 
 
+class BPaxosRecoverCodec(MessageCodec):
+    """Hole recovery for a committed-but-unexecuted vertex (paxsim
+    COD301 burn-down): per-hole traffic, but it is exactly what is on
+    the wire while a replica catches up after a crash, and pickled
+    frames are refused under ``set_pickle_fallback(False)``."""
+
+    message_type = Recover
+    tag = 200
+
+    def encode(self, out, message):
+        _put_vertex(out, message.vertex_id)
+
+    def decode(self, buf, at):
+        vertex_id, at = _take_vertex(buf, at)
+        return Recover(vertex_id=vertex_id), at
+
+
 for _codec in (BPaxosClientRequestCodec(), DependencyRequestCodec(),
                DependencyReplyCodec(), ProposeCodec(),
                BPaxosPhase2aCodec(), BPaxosPhase2bCodec(),
                BPaxosCommitCodec(), BPaxosClientReplyCodec(),
                BPaxosPhase1aCodec(), BPaxosPhase1bCodec(),
-               BPaxosNackCodec()):
+               BPaxosNackCodec(), BPaxosRecoverCodec()):
     register_codec(_codec)

@@ -83,6 +83,22 @@ class Actor(abc.ABC):
     def receive(self, src: Address, message: Any) -> None:
         ...
 
+    def receive_batch(self, batch: list) -> None:
+        """paxsim: a consecutive same-destination run of one delivery
+        wave, as raw ``(src, frame_bytes)`` pairs in arrival order.
+        This default decodes and feeds ``receive`` one frame at a time
+        -- bit-identical to per-message delivery, which is why the sim
+        wave engine may group through it. SoA-native actors (bench
+        sinks, loadgen-style drivers) override it to consume the run
+        as arrays with no per-message Python; the engine only routes
+        through an OVERRIDE (sim_transport._run_wave), so this body is
+        the contract, not a hot path. Overrides MUST process frames in
+        order for the determinism contract to hold."""
+        serializer = self.serializer
+        receive = self.receive
+        for src, data in batch:
+            receive(src, serializer.from_bytes(data))
+
     def on_drain(self) -> None:
         """Called by the transport after it finishes delivering a batch of
         inbound messages. Actors that stage work for batched device kernels

@@ -1,0 +1,173 @@
+"""Frozen PRE-paxsim simulator delivery machinery (the legacy core).
+
+PR "paxsim" rebuilt the simulator core around batched SoA delivery
+waves (``sim_transport._run_wave``); this module pins the replaced
+per-message machinery VERBATIM -- ``list.remove``-by-equality buffer
+consumption, per-message partition/link checks, the duplicated
+``deliver_all``/``deliver_all_coalesced`` drain loops, and the geo
+event loop's per-message heap pops. It exists for two reasons:
+
+1. **A/B truth**: ``bench/sim_core_ab.py`` measures the vectorized
+   core against THIS arm (the same discipline as paxwire's
+   ``batching=False`` legacy transport arm) -- the committed
+   ``bench_results/sim_core_ab.json`` speedups are meaningless unless
+   the baseline is the real pre-refactor code, not a degraded shim.
+2. **Schedule equivalence**: ``tests/test_sim_core.py`` replays fixed
+   seeds through both cores and asserts byte-identical delivery
+   orders, which is what lets the chaos soaks and the geo goldens
+   trust the new core without re-blessing every artifact.
+
+Do not "improve" these bodies; they are a reference, not a code path.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Optional
+
+from frankenpaxos_tpu.geo.transport import GeoSimTransport
+from frankenpaxos_tpu.runtime.actor import Actor
+from frankenpaxos_tpu.runtime.sim_transport import (
+    DeliverMessage,
+    SimMessage,
+    SimTransport,
+)
+
+
+def _legacy_plain_deliver(self, message: SimMessage) -> Optional[Actor]:
+    """Verbatim pre-paxsim ``SimTransport._deliver``: consume via
+    ``list.remove`` (dataclass ``__eq__`` scan), then the per-message
+    partition check / inbox bookkeeping / decode / receive."""
+    try:
+        self.messages.remove(message)
+    except ValueError:
+        self.logger.warn(f"delivering unbuffered message {message}")
+        return None
+    if self._inbox_policies and message.dst in self._inbox_policies:
+        from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, frame_lane
+
+        if frame_lane(message.data) == LANE_CLIENT:
+            self._inbox_depth[message.dst] = max(
+                0, self._inbox_depth.get(message.dst, 0) - 1)
+            pending = self._client_inbox.get(message.dst)
+            if pending:
+                try:
+                    pending.remove(message)
+                except ValueError:
+                    pass
+    if (message.dst in self.partitioned
+            or message.src in self.partitioned):
+        return None
+    self.history.append(DeliverMessage(message))
+    actor = self.actors.get(message.dst)
+    if actor is None:
+        self.logger.warn(f"no actor registered at {message.dst}")
+        return None
+    tracer = self.tracer
+    if tracer is None:
+        actor.receive(message.src,
+                      actor.serializer.from_bytes(message.data))
+        return actor
+    span = tracer.receive_span(str(message.dst), "?", message.trace)
+    with span:
+        with tracer.stage("decode"):
+            decoded = actor.serializer.from_bytes(message.data)
+        span.name = (f"receive:{type(decoded).__name__}"
+                     f"@{message.dst}")
+        with tracer.stage("handler"):
+            actor.receive(message.src, decoded)
+    return actor
+
+
+class LegacySimTransport(SimTransport):
+    """Pre-paxsim :class:`SimTransport`: per-message Python dispatch."""
+
+    def _deliver(self, message: SimMessage) -> Optional[Actor]:
+        return _legacy_plain_deliver(self, message)
+
+    def deliver_all(self, max_steps: int = 100000) -> int:
+        steps = 0
+        while self.messages and steps < max_steps:
+            self.deliver_message(self.messages[0])
+            steps += 1
+        return steps
+
+    def deliver_all_coalesced(self, max_steps: int = 100000) -> int:
+        steps = 0
+        while self.messages and steps < max_steps:
+            wave = list(self.messages[:max_steps - steps])
+            touched: list[Actor] = []
+            seen: set[int] = set()
+            for message in wave:
+                actor = self._deliver(message)
+                steps += 1
+                if actor is not None and id(actor) not in seen:
+                    seen.add(id(actor))
+                    touched.append(actor)
+            for actor in touched:
+                self._drain(actor)
+        return steps
+
+
+class LegacyGeoSimTransport(GeoSimTransport):
+    """Pre-paxsim :class:`GeoSimTransport`: per-message heap pops and
+    link checks, ``list.remove`` buffer consumption."""
+
+    def _deliver(self, message: SimMessage):
+        self.arrivals.pop(message.id, None)
+        self._by_id.pop(message.id, None)
+        if not self.topology.link_up(message.src, message.dst):
+            try:
+                self.messages.remove(message)
+            except ValueError:
+                self.logger.warn(
+                    f"dropping unbuffered message {message}")
+            return None
+        return _legacy_plain_deliver(self, message)
+
+    def run_until(self, t_end: float, max_steps: int = 1_000_000) -> int:
+        steps = 0
+        while steps < max_steps:
+            t = self.next_event_time()
+            if t is None or t > t_end:
+                break
+            self.now = t
+            touched: list = []
+            seen: set[int] = set()
+            for message in self._pop_due_messages(t):
+                actor = self._deliver(message)
+                steps += 1
+                if actor is not None and id(actor) not in seen:
+                    seen.add(id(actor))
+                    touched.append(actor)
+            for actor in touched:
+                self._drain(actor)
+            while self._deadline_heap:
+                deadline, timer_id = self._deadline_heap[0]
+                if deadline > t:
+                    break
+                heapq.heappop(self._deadline_heap)
+                if self._deadlines.get(timer_id) == deadline:
+                    self.trigger_timer(timer_id)
+                    steps += 1
+        self.now = max(self.now, t_end)
+        return steps
+
+    def run_until_quiescent(self, max_steps: int = 1_000_000,
+                            horizon_s: float = 3600.0) -> int:
+        steps = 0
+        t_end = self.now + horizon_s
+        while steps < max_steps:
+            t = self._peek(self._arrival_heap, self.arrivals)
+            if t is None or t > t_end:
+                break
+            self.now = max(self.now, t)
+            _, message_id = heapq.heappop(self._arrival_heap)
+            message = self._by_id.get(message_id)
+            if message is None:
+                continue
+            actor = self._deliver(message)
+            steps += 1
+            if actor is not None:
+                self._drain(actor)
+        return steps

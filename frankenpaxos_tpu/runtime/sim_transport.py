@@ -10,6 +10,20 @@ perfectly deterministic for a given command sequence.
 
 Also supports actor partitioning (JsTransport.scala:77): messages to or
 from a partitioned actor are dropped at delivery time.
+
+paxsim (docs/SIMULATION.md): the NON-adversarial delivery paths --
+``deliver_all``/``deliver_all_coalesced`` here and the geo transport's
+virtual-clock event loop -- share one wave engine, ``_run_wave``: the
+batch of frames consumed in one step is spliced out of the buffer as a
+unit (never ``list.remove`` per message), drop decisions evaluate as a
+vectorized mask over the wave's SoA columns (ops/simwave.py) above
+``WAVE_VECTOR_MIN``, and consecutive same-destination frames deliver
+through ``Actor.receive_batch`` when the actor opts in. The
+adversarial API (``deliver_message`` of ANY buffered frame,
+``generate_command``, partition/crash controls) is unchanged, and the
+engine steps aside -- falling back to the per-message compat loop --
+whenever delivery is intercepted (viz instance wraps, the overhead
+benches' class patches, runtime/sim_legacy.py).
 """
 
 from __future__ import annotations
@@ -19,6 +33,9 @@ import dataclasses
 import itertools
 from typing import Callable, Optional, Union
 
+import numpy as np
+
+from frankenpaxos_tpu.ops import simwave
 from frankenpaxos_tpu.runtime.actor import Actor
 from frankenpaxos_tpu.runtime.logger import Logger, PrintLogger
 from frankenpaxos_tpu.runtime.transport import Address, Timer, Transport
@@ -129,6 +146,29 @@ class SimTransport(Transport):
         self._inbox_policies: dict[Address, object] = {}
         self._inbox_depth: dict[Address, int] = {}
         self._client_inbox: dict[Address, deque] = {}
+        # paxsim wave engine state. ``_consumed`` tombstones message
+        # ids the geo wave path has delivered but not yet compacted out
+        # of ``messages`` (the public buffer list stays a plain list
+        # for the adversarial API; splicing it per delivery is the
+        # legacy quadratic the wave engine exists to kill). Non-empty
+        # ONLY inside a wave loop -- every public entry point compacts
+        # first. ``_addr_ids`` interns addresses to ints for the
+        # vectorized drop masks (ops/simwave.py).
+        self._consumed: set[int] = set()
+        self._addr_ids: dict[Address, int] = {}
+        # Frames shed by drop-oldest while they sat in an in-flight
+        # wave (already spliced from ``messages``): the wave engine
+        # must not deliver them -- legacy delivery would have found
+        # them unbuffered. Only ever populated when an admission
+        # policy is armed.
+        self._wave_shed: set[int] = set()
+        #: Record delivered/triggered events into ``history``. The
+        #: default matches the reference; schedule-scale harnesses
+        #: (bench/sim_core_ab.py million-event runs) disable it --
+        #: history is an append-only list of per-event dataclasses,
+        #: which at 1M+ events is hundreds of MB of bookkeeping no
+        #: oracle reads.
+        self.record_history: bool = True
 
     # --- Transport API ----------------------------------------------------
     def register(self, address: Address, actor: Actor) -> None:
@@ -148,6 +188,8 @@ class SimTransport(Transport):
         if admission is not None and admission.options.inbox_capacity:
             from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, frame_lane
 
+            if self._consumed:
+                self._compact_messages()
             self._inbox_policies[address] = admission
             # Recompute rather than trust stale state: a crash ->
             # restart leaves the dead incarnation's frames buffered
@@ -200,11 +242,18 @@ class SimTransport(Transport):
                 pending = self._client_inbox.get(dst)
                 while pending:
                     oldest = pending.popleft()
-                    try:
-                        self.messages.remove(oldest)
+                    if self._remove_buffered(oldest):
                         break
-                    except ValueError:
-                        continue  # removed out-of-band (live.py drop)
+                    # Not buffered: the frame either sits in an
+                    # in-flight wave (spliced out ahead of delivery --
+                    # mark it shed so the wave engine skips it, else a
+                    # frame the admission controller counted as
+                    # dropped would still reach its handler; ids are
+                    # never reused, so a stale mark is inert) or was
+                    # removed out-of-band (live.py drop; same marking,
+                    # same inertness).
+                    self._wave_shed.add(oldest.id)
+                    break
                 admission.note_shed("drop-oldest")
                 depth -= 1
             else:
@@ -265,12 +314,53 @@ class SimTransport(Transport):
         with tracer.drain_span(str(actor.address)):
             actor.on_drain()
 
+    # --- the paxsim buffer bookkeeping ------------------------------------
+    def _remove_buffered(self, message: SimMessage) -> bool:
+        """Consume ``message`` from the buffer: scan by id (an integer
+        compare per probe, where the legacy ``list.remove`` paid a
+        field-tuple ``__eq__`` per probe -- 70%+ of the geo event
+        loop), then verify FULL equality on the hit. The equality
+        check is load-bearing: minimization replays
+        (sim/simulator.py) deliver messages recorded from a DIFFERENT
+        execution, and a same-id frame with different bytes must read
+        as "no longer applies" exactly like the legacy
+        remove-by-equality did. Ids are unique in the buffer, so one
+        probe decides."""
+        if self._consumed:
+            self._compact_messages()
+        mid = message.id
+        messages = self.messages
+        for i, m in enumerate(messages):
+            if m.id == mid:
+                if m == message:
+                    del messages[i]
+                    return True
+                return False
+        return False
+
+    def _compact_messages(self) -> None:
+        """Apply pending wave tombstones to the public buffer list."""
+        if self._consumed:
+            consumed = self._consumed
+            self.messages[:] = [m for m in self.messages
+                                if m.id not in consumed]
+            consumed.clear()
+
+    def _consume_buffered(self, wave) -> None:
+        """Tombstone a delivered wave; compact once the dead fraction
+        dominates (amortized O(1) per message -- each compaction
+        removes at least half the list)."""
+        consumed = self._consumed
+        for message in wave:
+            consumed.add(message.id)
+        if (len(consumed) > 1024
+                and 2 * len(consumed) >= len(self.messages)):
+            self._compact_messages()
+
     def _deliver(self, message: SimMessage) -> Optional[Actor]:
         """Deliver without draining; returns the receiving actor (None if
         the message was dropped) so callers control drain granularity."""
-        try:
-            self.messages.remove(message)
-        except ValueError:
+        if not self._remove_buffered(message):
             self.logger.warn(f"delivering unbuffered message {message}")
             return None
         if self._inbox_policies and message.dst in self._inbox_policies:
@@ -293,7 +383,8 @@ class SimTransport(Transport):
             # Dropped at the partition: not part of the delivered history
             # (the trace viewer renders history entries as deliveries).
             return None
-        self.history.append(DeliverMessage(message))
+        if self.record_history:
+            self.history.append(DeliverMessage(message))
         actor = self.actors.get(message.dst)
         if actor is None:
             self.logger.warn(f"no actor registered at {message.dst}")
@@ -323,8 +414,9 @@ class SimTransport(Transport):
         if timer.address in self.partitioned:
             timer.stop()
             return
-        self.history.append(
-            TriggerTimer(timer.address, timer.name, timer_id))
+        if self.record_history:
+            self.history.append(
+                TriggerTimer(timer.address, timer.name, timer_id))
         tracer = self.tracer
         if tracer is None:
             timer.run()
@@ -340,6 +432,8 @@ class SimTransport(Transport):
 
     def possible_commands(self) -> list[SimCommand]:
         """Everything that could happen next (FakeTransport.scala:196-220)."""
+        if self._consumed:
+            self._compact_messages()
         commands: list[SimCommand] = [DeliverMessage(m)
                                       for m in self.messages]
         commands.extend(TriggerTimer(t.address, t.name, t.id)
@@ -349,6 +443,8 @@ class SimTransport(Transport):
     def generate_command(self, rng) -> Optional[SimCommand]:
         """Pick a random next step, weighting deliveries vs. timers by
         availability (the spirit of FakeTransport.generateCommand)."""
+        if self._consumed:
+            self._compact_messages()
         n_msgs = len(self.messages)
         running = self.running_timers()
         total = n_msgs + len(running)
@@ -362,13 +458,10 @@ class SimTransport(Transport):
                             running[i - n_msgs].id)
 
     def deliver_all(self, max_steps: int = 100000) -> int:
-        """FIFO-deliver until no messages remain (no timers). Convenience
-        for non-adversarial integration tests."""
-        steps = 0
-        while self.messages and steps < max_steps:
-            self.deliver_message(self.messages[0])
-            steps += 1
-        return steps
+        """FIFO-deliver until no messages remain (no timers), draining
+        after EVERY message. Convenience for non-adversarial
+        integration tests."""
+        return self._deliver_fifo(max_steps, coalesce=False)
 
     def deliver_all_coalesced(self, max_steps: int = 100000) -> int:
         """FIFO-deliver in WAVES, draining each touched actor once per
@@ -379,8 +472,54 @@ class SimTransport(Transport):
         join the next one. This is the right mode for benchmarking
         batch-amortized actors over SimTransport; adversarial sims keep
         per-message drains (``deliver_message``)."""
+        return self._deliver_fifo(max_steps, coalesce=True)
+
+    # --- the paxsim wave engine -------------------------------------------
+    def _wave_fast_path_ok(self) -> bool:
+        """Whether the wave engine may splice the buffer and dispatch
+        waves directly. False when delivery is intercepted -- a viz
+        recorder wrapped this instance's ``deliver_message``, or an
+        overhead bench / sim_legacy pinned a different ``_deliver`` on
+        the class -- so every delivered frame still flows through the
+        interceptor via the per-message compat loop."""
+        return ("deliver_message" not in self.__dict__
+                and type(self)._deliver in WAVE_SAFE_DELIVERS)
+
+    def _deliver_fifo(self, max_steps: int, coalesce: bool) -> int:
+        """The ONE parameterized FIFO drain loop (both public modes
+        differ only in drain granularity). Waves are buffer-prefix
+        snapshots: sends made by handlers append behind the snapshot
+        and join the next wave, which reproduces the legacy loops'
+        strict send-order delivery exactly."""
+        if not self._wave_fast_path_ok():
+            return self._deliver_fifo_compat(max_steps, coalesce)
+        steps = 0
+        messages = self.messages
+        while messages and steps < max_steps:
+            wave = messages[:max_steps - steps]
+            del messages[:len(wave)]
+            self._drop_schedule_stamps(wave)
+            steps += len(wave)
+            self._run_wave(wave, coalesce)
+        return steps
+
+    def _drop_schedule_stamps(self, wave) -> None:
+        """Scheduler-policy hook: consume any per-frame scheduling
+        state for frames leaving the buffer outside the policy's own
+        loop (the geo transport pops arrival stamps here, so a FIFO
+        drain can never leave a stale stamp for ``run_until`` to
+        double-deliver)."""
+
+    def _deliver_fifo_compat(self, max_steps: int, coalesce: bool) -> int:
+        """Per-message fallback: identical delivery order and drain
+        granularity, routed through ``deliver_message``/``_deliver`` so
+        interceptors observe every step."""
         steps = 0
         while self.messages and steps < max_steps:
+            if not coalesce:
+                self.deliver_message(self.messages[0])
+                steps += 1
+                continue
             wave = list(self.messages[:max_steps - steps])
             touched: list[Actor] = []
             seen: set[int] = set()
@@ -393,6 +532,162 @@ class SimTransport(Transport):
             for actor in touched:
                 self._drain(actor)
         return steps
+
+    def _wave_keep_mask(self, wave) -> Optional[np.ndarray]:
+        """Vectorized drop mask over a wave (True = deliver), or None
+        to decide per message via ``_per_message_check`` -- small waves
+        skip array staging entirely (ops/simwave.WAVE_VECTOR_MIN)."""
+        if not self.partitioned or len(wave) < simwave.WAVE_VECTOR_MIN:
+            return None
+        n = len(wave)
+        intern = self._intern
+        src = np.fromiter((intern(m.src) for m in wave), np.int64, n)
+        dst = np.fromiter((intern(m.dst) for m in wave), np.int64, n)
+        blocked = np.fromiter((intern(a) for a in self.partitioned),
+                              np.int64, len(self.partitioned))
+        return simwave.keep_mask(src, dst, blocked)
+
+    def _per_message_check(self) -> Optional[Callable]:
+        """Scalar drop check used when ``_wave_keep_mask`` returned
+        None; None means nothing can drop (no partitions)."""
+        part = self.partitioned
+        if not part:
+            return None
+        return lambda m: m.src not in part and m.dst not in part
+
+    def _intern(self, address) -> int:
+        ids = self._addr_ids
+        aid = ids.get(address)
+        if aid is None:
+            aid = ids[address] = len(ids)
+        return aid
+
+    def _run_wave(self, wave, coalesce: bool) -> int:
+        """Deliver one wave. PRECONDITION: the wave's frames are
+        already consumed from the buffer (prefix splice or tombstones).
+        Returns the number of frames that reached an actor.
+
+        Delivery order is exactly per-message FIFO; the only batching
+        is that consecutive frames to one destination hand off through
+        ``Actor.receive_batch`` when (a) drains are coalesced and (b)
+        the actor OVERRIDES it -- the default body replays decode +
+        ``receive`` in order, so grouping is order-equivalent by
+        construction."""
+        keep = self._wave_keep_mask(wave)
+        check = self._per_message_check() if keep is None else None
+        actors = self.actors
+        record = self.record_history
+        history = self.history
+        tracer = self.tracer
+        inbox = bool(self._inbox_policies)
+        shed = self._wave_shed if inbox or self._wave_shed else None
+        touched: dict[int, Actor] = {}
+        delivered = 0
+        n = len(wave)
+        i = 0
+        while i < n:
+            message = wave[i]
+            if shed and message.id in shed:
+                # Drop-oldest shed this frame out of the in-flight wave
+                # (a handler's send overflowed the bounded inbox
+                # mid-wave); legacy delivery would have found it
+                # unbuffered and skipped it -- before any inbox
+                # accounting.
+                shed.discard(message.id)
+                i += 1
+                continue
+            if inbox:
+                # BEFORE the drop mask: legacy _deliver decrements the
+                # bounded-inbox depth even for frames a partition then
+                # drops (the frame left the buffer either way). Geo
+                # link drops differ in legacy (no admission is ever
+                # armed on geo harnesses), so the wave engine applies
+                # the plain-transport rule uniformly.
+                self._note_wave_delivery(message)
+            if (keep is not None and not keep[i]) or \
+                    (check is not None and not check(message)):
+                # Dropped at a partition (or, in the geo subclass, a
+                # downed link): consumed, no history entry, no drain.
+                i += 1
+                continue
+            dst = message.dst
+            actor = actors.get(dst)
+            if record:
+                history.append(DeliverMessage(message))
+            if actor is None:
+                self.logger.warn(f"no actor registered at {dst}")
+                i += 1
+                continue
+            if tracer is not None:
+                self._traced_receive(actor, message)
+                delivered += 1
+                i += 1
+            elif (coalesce and type(actor).receive_batch
+                    is not Actor.receive_batch):
+                j = i + 1
+                while (j < n and wave[j].dst == dst
+                       and (keep[j] if keep is not None
+                            else check is None or check(wave[j]))
+                       and not (shed and wave[j].id in shed)):
+                    j += 1
+                run = wave[i:j]
+                for m in run[1:]:
+                    if inbox:
+                        self._note_wave_delivery(m)
+                    if record:
+                        history.append(DeliverMessage(m))
+                actor.receive_batch([(m.src, m.data) for m in run])
+                delivered += j - i
+                i = j
+            else:
+                actor.receive(message.src,
+                              actor.serializer.from_bytes(message.data))
+                delivered += 1
+                i += 1
+            if coalesce:
+                if id(actor) not in touched:
+                    touched[id(actor)] = actor
+            else:
+                self._drain(actor)
+        if coalesce:
+            for actor in touched.values():
+                self._drain(actor)
+        return delivered
+
+    def _traced_receive(self, actor: Actor, message: SimMessage) -> None:
+        """Per-message traced delivery (paxtrace): the wave engine
+        never groups under a tracer, so span structure matches the
+        per-message path byte for byte."""
+        tracer = self.tracer
+        span = tracer.receive_span(str(message.dst), "?", message.trace)
+        with span:
+            with tracer.stage("decode"):
+                decoded = actor.serializer.from_bytes(message.data)
+            span.name = (f"receive:{type(decoded).__name__}"
+                         f"@{message.dst}")
+            with tracer.stage("handler"):
+                actor.receive(message.src, decoded)
+
+    def _note_wave_delivery(self, message: SimMessage) -> None:
+        """Bounded-inbox accounting for one delivered frame (the wave
+        twin of the block in ``_deliver``)."""
+        if message.dst not in self._inbox_policies:
+            return
+        from frankenpaxos_tpu.serve.lanes import LANE_CLIENT, frame_lane
+
+        if frame_lane(message.data) != LANE_CLIENT:
+            return
+        self._inbox_depth[message.dst] = max(
+            0, self._inbox_depth.get(message.dst, 0) - 1)
+        pending = self._client_inbox.get(message.dst)
+        if pending:
+            if pending[0] is message:
+                pending.popleft()
+            else:
+                try:
+                    pending.remove(message)
+                except ValueError:
+                    pass
 
     def partition(self, address: Address) -> None:
         self.partitioned.add(address)
@@ -423,3 +718,11 @@ class SimTransport(Transport):
         for timer_id in [tid for tid, t in self.timers.items()
                          if t.address == address]:
             del self.timers[timer_id]
+
+
+#: ``_deliver`` implementations the wave engine is allowed to bypass:
+#: the base transport's, plus wave-aware subclasses that register here
+#: (geo/transport.py). Any OTHER ``_deliver`` on the class -- an
+#: overhead bench's no-hooks patch, sim_legacy's frozen bodies --
+#: disables the fast path so per-message interception keeps working.
+WAVE_SAFE_DELIVERS: set = {SimTransport._deliver}

@@ -83,6 +83,12 @@ class Simulator(Generic[System, Command]):
         self.run_length = run_length
         self.num_runs = num_runs
         self.minimize = minimize
+        #: Commands executed across every run this instance performed,
+        #: including minimization replays (they are part of the work a
+        #: soak pays for). tests/soak.py divides by wall time to track
+        #: sim-core throughput across PRs (bench_results/
+        #: soak_summary.json).
+        self.commands_run = 0
 
     def run(self, seed: int = 0) -> Optional[BadHistory]:
         """Run ``num_runs`` random executions; return the first failure
@@ -129,6 +135,7 @@ class Simulator(Generic[System, Command]):
             else:
                 command = history[step]
             executed.append(command)
+            self.commands_run += 1
             system = self.sim.run_command(system, command)
             states.append(self.sim.get_state(system))
 

@@ -322,3 +322,20 @@ def test_simulation_f1_optimization_no_divergence():
     failure = Simulator(FasterPaxosF1OptSimulated(), run_length=250,
                         num_runs=100).run(seed=0)
     assert failure is None, str(failure)
+
+
+def test_repair_noop_not_switched_by_command_ack_seed412():
+    """Chosen-uniqueness regression (found by the full-scale paxsim
+    soak, seed 412): a round-change leader's REPAIR re-proposal of the
+    safe value Noop must not be switched to an acceptor's
+    ackNoopsWithCommands command -- the noop can already be chosen at
+    servers outside the Phase1 read quorum, and the reported command
+    rides an older-round vote. Pre-fix this run chooses slot 3 twice
+    (Command vs Noop); the processPhase2b case-(f) switch is now
+    restricted to fresh stripe slots (>= delegate_start)."""
+    failure = Simulator(FasterPaxosSimulated(), run_length=250,
+                        num_runs=1, minimize=False).run(seed=412)
+    assert failure is None, str(failure)
+    failure = Simulator(FasterPaxosF1OptSimulated(), run_length=250,
+                        num_runs=1, minimize=False).run(seed=412)
+    assert failure is None, str(failure)

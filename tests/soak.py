@@ -321,6 +321,16 @@ GEO_CHAOS_CONFIGS: list[tuple] = [
                                 num_groups=4)),
     ("geo-chaos/wpaxos-high-jitter",
      lambda: WPaxosGeoSimulated(jitter=4.0)),
+    # paxsim size growth: the vectorized sim core (docs/SIMULATION.md)
+    # makes wider geo meshes affordable at full soak scale -- these
+    # two rows are the registered post-paxsim sizes (6 zones x 6
+    # groups, and a 2x-depth z4 exploration via runs_scale).
+    ("geo-chaos/wpaxos-z6-groups6",
+     lambda: WPaxosGeoSimulated(num_zones=6, row_width=3,
+                                num_groups=6)),
+    ("geo-chaos/wpaxos-z4-deep",
+     lambda: WPaxosGeoSimulated(num_zones=4, row_width=3,
+                                num_groups=4, jitter=2.0), 2.0),
 ]
 CONFIGS.extend(GEO_CHAOS_CONFIGS)
 
@@ -342,19 +352,27 @@ def run_soak(num_runs: int = 500, run_length: int = 250, seed: int = 0,
         if only and only not in name:
             continue
         t0 = time.time()
+        simulator = Simulator(factory(), run_length=run_length,
+                              num_runs=runs, minimize=True)
         try:
-            failure = Simulator(factory(), run_length=run_length,
-                                num_runs=runs,
-                                minimize=True).run(seed=seed)
+            failure = simulator.run(seed=seed)
             failure = str(failure) if failure is not None else None
         except Exception as e:  # a crash IS a soak finding, not an abort
             failure = f"crash: {type(e).__name__}: {e}"
+        seconds = time.time() - t0
+        # events/s = sim commands executed per wall second (system
+        # construction + invariant checks included in the denominator:
+        # this tracks what a soak COSTS, per config, across PRs --
+        # the paxsim acceptance metric, bench_results/soak_summary.json).
+        events = simulator.commands_run
         row = {
             "config": name,
             "num_runs": runs,
             "run_length": run_length,
             "seed": seed,
-            "seconds": round(time.time() - t0, 1),
+            "seconds": round(seconds, 1),
+            "events": events,
+            "events_per_s": round(events / seconds) if seconds else None,
             "failure": failure,
         }
         rows.append(row)

@@ -1,0 +1,604 @@
+"""paxsim: the vectorized simulator core vs the frozen legacy core.
+
+The refactor's contract (docs/SIMULATION.md): for a fixed seed, the
+wave engine replays BYTE-IDENTICAL delivery orders against the
+pre-refactor per-message machinery pinned in runtime/sim_legacy.py --
+FIFO waves in both drain modes, the geo virtual-clock loop, whole
+protocols (multipaxos coalesced pipeline, wpaxos over a jittered geo
+topology), and property-randomized partition/drop-mask schedules.
+Plus the engine's own semantics: consecutive-run ``receive_batch``
+grouping preserves order, interception (viz instance wraps, class
+patches) falls back to per-message delivery, the vectorized masks
+agree with the scalar checks, and the drop-oldest mid-wave shed
+corner matches legacy "unbuffered" skips.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.geo.topology import GeoTopology
+from frankenpaxos_tpu.geo.transport import GeoSimTransport
+from frankenpaxos_tpu.ops import simwave
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+from frankenpaxos_tpu.runtime.actor import Actor
+from frankenpaxos_tpu.runtime.sim_legacy import (
+    LegacyGeoSimTransport,
+    LegacySimTransport,
+)
+from frankenpaxos_tpu.runtime.sim_transport import (
+    DeliverMessage,
+    SimTransport,
+    TriggerTimer,
+)
+
+
+def _logger():
+    return FakeLogger(LogLevel.FATAL)
+
+
+def projection(transport) -> list:
+    """The delivered history as comparable rows (ids are allocated in
+    construction order, so equal rows mean equal schedules)."""
+    rows = []
+    for command in transport.history:
+        if isinstance(command, DeliverMessage):
+            m = command.message
+            rows.append(("deliver", m.id, str(m.src), str(m.dst),
+                         bytes(m.data)))
+        elif isinstance(command, TriggerTimer):
+            rows.append(("timer", command.timer_id,
+                         str(command.address), command.name))
+    return rows
+
+
+class EchoActor(Actor):
+    """Deterministic fanout: a frame ``ttl|k`` re-sends ``ttl-1|k`` to
+    the next ``fanout`` peers; records every receive."""
+
+    def __init__(self, address, transport, logger, peers, fanout=2):
+        super().__init__(address, transport, logger)
+        self.peers = peers
+        self.fanout = fanout
+        self.log: list = []
+        self.drains = 0
+
+    def receive(self, src, message):
+        ttl, k = message
+        self.log.append((str(src), ttl, k))
+        if ttl > 0:
+            base = (k + ttl) % len(self.peers)
+            for step in range(self.fanout):
+                dst = self.peers[(base + step) % len(self.peers)]
+                if dst != self.address:
+                    self.send(dst, (ttl - 1, k))
+
+    def on_drain(self):
+        self.drains += 1
+
+
+def build_mesh(transport, n=9, fanout=2):
+    peers = [f"actor-{i}" for i in range(n)]
+    return [EchoActor(p, transport, transport.logger, peers, fanout)
+            for p in peers]
+
+
+def mesh_state(actors) -> list:
+    return [(a.log, a.drains) for a in actors]
+
+
+# --- FIFO wave equivalence -------------------------------------------------
+
+
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_fifo_equivalence_vs_legacy(coalesce):
+    """Same traffic, same partitions-between-waves schedule: the wave
+    engine and the legacy per-message loops produce identical
+    histories, actor logs, and drain counts."""
+    results = []
+    for cls in (LegacySimTransport, SimTransport):
+        t = cls(_logger())
+        actors = build_mesh(t)
+        rng = random.Random("fifo-equiv")
+        for round_ in range(12):
+            for k in range(40):  # > WAVE_VECTOR_MIN: masks vectorize
+                t.send("driver", f"actor-{rng.randrange(9)}",
+                       actors[0].serializer.to_bytes((2, k)))
+            if round_ % 3 == 1:
+                t.partition(f"actor-{rng.randrange(9)}")
+            if round_ % 4 == 3:
+                for a in list(t.partitioned):
+                    t.heal(a)
+            if coalesce:
+                t.deliver_all_coalesced()
+            else:
+                t.deliver_all()
+        results.append((projection(t), mesh_state(actors),
+                        len(t.messages)))
+    assert results[0] == results[1]
+
+
+def test_fifo_max_steps_equivalence():
+    for max_steps in (1, 7, 83, 250):
+        got = []
+        for cls in (LegacySimTransport, SimTransport):
+            t = cls(_logger())
+            actors = build_mesh(t)
+            for k in range(60):
+                t.send("driver", f"actor-{k % 9}",
+                       actors[0].serializer.to_bytes((3, k)))
+            steps = t.deliver_all_coalesced(max_steps=max_steps)
+            got.append((steps, projection(t), len(t.messages)))
+        assert got[0] == got[1], max_steps
+
+
+# --- geo equivalence -------------------------------------------------------
+
+
+def geo_topology(seed=7, zones_per_region=3, regions=3,
+                 jitter=0.05) -> GeoTopology:
+    return GeoTopology(
+        {f"r{r}": [f"z{r}-{z}" for z in range(zones_per_region)]
+         for r in range(regions)},
+        seed=seed, jitter=jitter)
+
+
+def build_geo(cls, seed=7):
+    topo = geo_topology(seed=seed)
+    t = cls(topo, _logger())
+    actors = build_mesh(t)
+    for i, actor in enumerate(actors):
+        topo.place(actor.address, topo.zones[i % len(topo.zones)])
+    return topo, t, actors
+
+
+def test_geo_run_until_equivalence_vs_legacy():
+    """Jittered arrivals, link partitions, per-address partitions, and
+    timers: run_until replays the legacy schedule exactly."""
+    results = []
+    for cls in (LegacyGeoSimTransport, GeoSimTransport):
+        topo, t, actors = build_geo(cls)
+        fired: list = []
+        timer = t.timer("actor-0", "tick", 0.011,
+                        lambda: fired.append(round(t.now, 9)))
+        timer.start()
+        rng = random.Random("geo-equiv")
+        for round_ in range(10):
+            for k in range(50):
+                t.send("driver", f"actor-{rng.randrange(9)}",
+                       actors[0].serializer.to_bytes((2, k)))
+            if round_ == 2:
+                topo.partition_link("z0-0", "z1-1")
+            if round_ == 4:
+                t.partition("actor-4")
+            if round_ == 6:
+                topo.heal_link("z0-0", "z1-1")
+                t.heal("actor-4")
+            if round_ == 7:
+                topo.partition_zone("z2-2")
+            t.run_for(0.03)
+        t.run_until_quiescent()
+        results.append((projection(t), mesh_state(actors), fired,
+                        round(t.now, 9), len(t.messages)))
+    assert results[0] == results[1]
+
+
+def test_geo_quiescent_equivalence_vs_legacy():
+    results = []
+    for cls in (LegacyGeoSimTransport, GeoSimTransport):
+        topo, t, actors = build_geo(cls)
+        for k in range(120):
+            t.send("driver", f"actor-{k % 9}",
+                   actors[0].serializer.to_bytes((1, k)))
+        steps = t.run_until_quiescent()
+        results.append((steps, projection(t), mesh_state(actors)))
+    assert results[0] == results[1]
+
+
+def test_geo_run_until_max_steps_equivalence():
+    """The cap may be overshot by a same-timestamp wave, exactly like
+    the legacy per-message loop (which only checked the cap between
+    waves): truncating the wave at max_steps would fire timers due at
+    t BEFORE the wave's tail and diverge the schedule."""
+    for max_steps in (1, 2, 5, 50):
+        results = []
+        for cls in (LegacyGeoSimTransport, GeoSimTransport):
+            topo, t, actors = build_geo(cls, seed=3)
+            fired: list = []
+            timer = t.timer("actor-0", "tick", 0.0005,
+                            lambda: fired.append(round(t.now, 9)))
+            timer.start()
+            # Zero jitter via direct same-zone sends: many frames
+            # share one arrival timestamp, so waves straddle the cap.
+            topo.jitter = 0.0
+            for k in range(12):
+                t.send("actor-1", "actor-2",
+                       actors[0].serializer.to_bytes((0, k)))
+            steps = t.run_for(1.0, max_steps=max_steps)
+            results.append((steps, projection(t), fired,
+                            len(t.messages)))
+        assert results[0] == results[1], max_steps
+
+
+def test_geo_fifo_drain_consumes_arrival_stamps():
+    """A FIFO drain on the geo transport must kill the drained frames'
+    arrival stamps: a stale stamp would make a later run_until pop the
+    heap entry and deliver the frame a SECOND time (the legacy core
+    popped stamps inside its per-message _deliver)."""
+    results = []
+    for cls in (LegacyGeoSimTransport, GeoSimTransport):
+        topo, t, actors = build_geo(cls)
+        for k in range(80):
+            t.send("driver", f"actor-{k % 9}",
+                   actors[0].serializer.to_bytes((1, k)))
+        t.deliver_all_coalesced()
+        t.run_for(10.0)  # would replay stale stamps if any survived
+        t.run_until_quiescent()
+        results.append((projection(t), mesh_state(actors),
+                        len(t.arrivals), len(t.messages)))
+    assert results[0] == results[1]
+    assert results[1][2] == 0 and results[1][3] == 0
+
+
+# --- property tests: random partition/drop-mask schedules ------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_random_masks_fifo(seed):
+    """Random traffic x random partition/heal schedules x random drain
+    modes: legacy and wave cores stay in lockstep."""
+    results = []
+    for cls in (LegacySimTransport, SimTransport):
+        t = cls(_logger())
+        actors = build_mesh(t, n=7, fanout=3)
+        rng = random.Random(f"mask-prop|{seed}")
+        for _ in range(15):
+            for _ in range(rng.randrange(1, 64)):
+                t.send("driver", f"actor-{rng.randrange(7)}",
+                       actors[0].serializer.to_bytes(
+                           (rng.randrange(3), rng.randrange(100))))
+            roll = rng.random()
+            if roll < 0.3:
+                t.partition(f"actor-{rng.randrange(7)}")
+            elif roll < 0.5 and t.partitioned:
+                t.heal(rng.choice(sorted(t.partitioned)))
+            if rng.random() < 0.5:
+                t.deliver_all_coalesced()
+            else:
+                t.deliver_all()
+        results.append((projection(t), mesh_state(actors),
+                        len(t.messages)))
+    assert results[0] == results[1]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_random_masks_geo(seed):
+    results = []
+    for cls in (LegacyGeoSimTransport, GeoSimTransport):
+        topo, t, actors = build_geo(cls, seed=seed)
+        zones = topo.zones
+        rng = random.Random(f"geo-mask-prop|{seed}")
+        for _ in range(12):
+            for _ in range(rng.randrange(1, 80)):
+                t.send("driver", f"actor-{rng.randrange(9)}",
+                       actors[0].serializer.to_bytes(
+                           (rng.randrange(2), rng.randrange(100))))
+            roll = rng.random()
+            if roll < 0.25:
+                topo.partition_link(rng.choice(zones),
+                                    rng.choice(zones))
+            elif roll < 0.4:
+                topo.heal_all()
+            elif roll < 0.5:
+                t.partition(f"actor-{rng.randrange(9)}")
+            elif roll < 0.6:
+                for a in list(t.partitioned):
+                    t.heal(a)
+            elif roll < 0.7:
+                topo.degrade_link(rng.choice(zones), rng.choice(zones),
+                                  rng.choice((1.0, 4.0)))
+            t.run_for(rng.choice((0.002, 0.02, 0.2)))
+        t.run_until_quiescent()
+        results.append((projection(t), mesh_state(actors),
+                        round(t.now, 9), len(t.messages)))
+    assert results[0] == results[1]
+
+
+# --- whole-protocol goldens ------------------------------------------------
+
+
+def test_multipaxos_coalesced_pipeline_equivalence(monkeypatch):
+    """The multipaxos drain-granular pipeline (the chaos-soak config
+    family's workhorse) produces identical delivery histories, replies,
+    and SM state on both cores, including a partition/heal cycle."""
+    import tests.protocols.multipaxos_harness as harness
+    from frankenpaxos_tpu.bench.wal_lt import _drive_waves
+
+    results = []
+    for cls in (LegacySimTransport, SimTransport):
+        monkeypatch.setattr(harness, "SimTransport", cls)
+        sim = harness.make_multipaxos(f=1, coalesced=True)
+        replies: list = []
+        _drive_waves(sim, 8, 4, b"a", replies)
+        sim.transport.partition("acceptor-0-1")
+        _drive_waves(sim, 8, 2, b"b", replies)
+        sim.transport.heal("acceptor-0-1")
+        _drive_waves(sim, 8, 2, b"c", replies)
+        results.append((projection(sim.transport), replies,
+                        [r.state_machine.get() for r in sim.replicas]))
+    assert results[0] == results[1]
+
+
+def test_wpaxos_geo_golden_equivalence(monkeypatch):
+    """wpaxos over a jittered geo topology (the geo-chaos soak shape):
+    writes from two zones, an object steal, and a link partition replay
+    identically on both cores."""
+    import tests.protocols.wpaxos_harness as harness
+    from frankenpaxos_tpu.protocols.wpaxos.messages import Steal
+    from tests.protocols.test_wpaxos import geo3
+    from tests.protocols.wpaxos_harness import drive, settle
+
+    results = []
+    for cls in (LegacyGeoSimTransport, GeoSimTransport):
+        monkeypatch.setattr(harness, "GeoSimTransport", cls)
+        sim = harness.make_wpaxos(num_clients=3, topology=geo3())
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        remote = (home + 1) % 3
+        drive(sim, 4, client=home, key_prefix=b"obj1")
+        sim.leaders[remote].receive("admin", Steal(group))
+        settle(sim, lambda: group in sim.leaders[remote].active)
+        sim.topology.partition_link(sim.topology.zones[home],
+                                    sim.topology.zones[remote])
+        drive(sim, 2, client=remote, key_prefix=b"obj1")
+        sim.topology.heal_all()
+        drive(sim, 2, client=remote, key_prefix=b"obj1")
+        results.append((projection(sim.transport),
+                        [r.group_sequences() for r in sim.replicas]))
+    assert results[0] == results[1]
+
+
+# --- wave-engine semantics -------------------------------------------------
+
+
+class BatchSink(Actor):
+    def __init__(self, address, transport, logger):
+        super().__init__(address, transport, logger)
+        self.batches: list = []
+        self.drains = 0
+
+    def receive(self, src, message):
+        self.batches.append([(str(src), message)])
+
+    def receive_batch(self, batch):
+        self.batches.append(
+            [(str(src), self.serializer.from_bytes(data))
+             for src, data in batch])
+
+    def on_drain(self):
+        self.drains += 1
+
+
+def test_receive_batch_groups_consecutive_runs_in_order():
+    t = SimTransport(_logger())
+    a = BatchSink("a", t, t.logger)
+    b = BatchSink("b", t, t.logger)
+    ser = a.serializer
+    for payload, dst in [(1, "a"), (2, "a"), (3, "b"), (4, "a"),
+                         (5, "a"), (6, "a")]:
+        t.send("driver", dst, ser.to_bytes(payload))
+    t.deliver_all_coalesced()
+    # Consecutive same-destination runs arrive as single batches, in
+    # arrival order; the cross-actor interleaving is preserved.
+    assert a.batches == [[("driver", 1), ("driver", 2)],
+                        [("driver", 4), ("driver", 5), ("driver", 6)]]
+    assert b.batches == [[("driver", 3)]]
+    assert a.drains == 1 and b.drains == 1
+    # deliver_all (per-message drains) never groups.
+    for payload in (7, 8):
+        t.send("driver", "a", ser.to_bytes(payload))
+    t.deliver_all()
+    assert a.batches[-2:] == [[("driver", 7)], [("driver", 8)]]
+    assert a.drains == 3
+
+
+def test_default_receive_batch_matches_per_message_delivery():
+    """The Actor.receive_batch default is the contract: decoding and
+    replaying ``receive`` in order is exactly per-message delivery."""
+    got = []
+    for sink_cls in (EchoActor,):  # does NOT override receive_batch
+        t = SimTransport(_logger())
+        actors = build_mesh(t)
+        for k in range(80):
+            t.send("driver", f"actor-{k % 9}",
+                   actors[0].serializer.to_bytes((1, k)))
+        t.deliver_all_coalesced()
+        got.append(mesh_state(actors))
+    t2 = LegacySimTransport(_logger())
+    actors2 = build_mesh(t2)
+    for k in range(80):
+        t2.send("driver", f"actor-{k % 9}",
+                actors2[0].serializer.to_bytes((1, k)))
+    t2.deliver_all_coalesced()
+    assert got[0] == mesh_state(actors2)
+
+
+def test_instance_wrapped_deliver_message_sees_every_delivery():
+    """The viz recorder wraps ``deliver_message`` on the INSTANCE; the
+    engine must fall back so the wrap observes deliver_all traffic."""
+    t = SimTransport(_logger())
+    actors = build_mesh(t, n=3)
+    seen = []
+    original = t.deliver_message
+
+    def recording(message):
+        seen.append(message.id)
+        original(message)
+
+    t.deliver_message = recording
+    assert not t._wave_fast_path_ok()
+    for k in range(10):
+        t.send("driver", f"actor-{k % 3}",
+               actors[0].serializer.to_bytes((0, k)))
+    t.deliver_all()
+    assert len(seen) == 10
+
+
+def test_class_patched_deliver_disables_fast_path():
+    class Patched(SimTransport):
+        def _deliver(self, message):
+            return super()._deliver(message)
+
+    t = Patched(_logger())
+    assert not t._wave_fast_path_ok()
+    t2 = SimTransport(_logger())
+    assert t2._wave_fast_path_ok()
+    assert not LegacySimTransport(_logger())._wave_fast_path_ok()
+    topo = geo_topology()
+    assert GeoSimTransport(topo, _logger())._wave_fast_path_ok()
+    assert not LegacyGeoSimTransport(topo, _logger()) \
+        ._wave_fast_path_ok()
+
+
+def test_record_history_off_still_delivers():
+    t = SimTransport(_logger())
+    t.record_history = False
+    actors = build_mesh(t, n=3)
+    for k in range(20):
+        t.send("driver", f"actor-{k % 3}",
+               actors[0].serializer.to_bytes((1, k)))
+    t.deliver_all_coalesced()
+    assert t.history == [] and not t.messages
+    assert sum(len(a.log) for a in actors) > 20
+
+
+def test_partition_drops_still_decrement_armed_inbox(monkeypatch):
+    """Legacy _deliver decrements the bounded-inbox depth BEFORE the
+    partition check (the frame left the buffer either way); the wave
+    engine must keep that order or a partitioned leader's inbox depth
+    ratchets up and sheds spuriously after heal."""
+    import tests.protocols.multipaxos_harness as harness
+
+    results = []
+    for cls in (LegacySimTransport, SimTransport):
+        monkeypatch.setattr(harness, "SimTransport", cls)
+        sim = harness.make_multipaxos(
+            f=1, coalesced=False,
+            leader_admission=dict(admission_inbox_capacity=40,
+                                  admission_inbox_policy="drop"))
+        leader = sim.leaders[0]
+        t = sim.transport
+        for i in range(36):  # > WAVE_VECTOR_MIN so the mask path runs
+            sim.clients[0].write(i, b"w%d" % i, lambda r: None)
+        t.partition(leader.address)
+        t.deliver_all_coalesced()
+        t.heal(leader.address)
+        for i in range(36, 44):
+            sim.clients[0].write(i, b"w%d" % i, lambda r: None)
+        t.deliver_all_coalesced()
+        results.append((t._inbox_depth.get(leader.address, 0),
+                        dict(leader.admission.rejected),
+                        projection(t)))
+    assert results[0] == results[1]
+
+
+def test_drop_oldest_mid_wave_shed_is_not_delivered(monkeypatch):
+    """A frame shed by drop-oldest while it sat in an in-flight wave
+    must not reach its handler (legacy found it unbuffered): flood an
+    armed leader from inside a wave handler and compare cores."""
+    import tests.protocols.multipaxos_harness as harness
+
+    results = []
+    for cls in (LegacySimTransport, SimTransport):
+        monkeypatch.setattr(harness, "SimTransport", cls)
+        sim = harness.make_multipaxos(
+            f=1, coalesced=False,
+            leader_admission=dict(admission_inbox_capacity=2,
+                                  admission_inbox_policy="drop"))
+        leader = sim.leaders[0]
+        # Buffer a burst of client frames, then deliver as one wave;
+        # the LAST write overflows the inbox mid-wave via the sends
+        # the earlier deliveries trigger.
+        for i in range(8):
+            sim.clients[0].write(i, b"w%d" % i, lambda r: None)
+        sim.transport.deliver_all_coalesced()
+        results.append((leader.admission.rejected.get(
+            "shed_drop-oldest", 0), projection(sim.transport)))
+    assert results[0] == results[1]
+
+
+# --- vectorized mask kernels ----------------------------------------------
+
+
+def test_simwave_masks_match_scalar_checks():
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 50, 500).astype(np.int64)
+    dst = rng.integers(0, 50, 500).astype(np.int64)
+    blocked = np.unique(rng.integers(0, 50, 7)).astype(np.int64)
+    mask = simwave.keep_mask(src, dst, blocked)
+    expected = [s not in blocked and d not in blocked
+                for s, d in zip(src, dst)]
+    assert mask.tolist() == expected
+    assert simwave.keep_mask(src, dst,
+                             np.empty(0, np.int64)).all()
+
+
+def test_simwave_link_mask_and_jit_parity():
+    rng = np.random.default_rng(4)
+    z = 12
+    up = rng.random((z + 1, z + 1)) < 0.8
+    up[z, :] = True
+    up[:, z] = True
+    src = rng.integers(-1, z, 700).astype(np.int32)
+    dst = rng.integers(-1, z, 700).astype(np.int32)
+    mask = simwave.link_keep_mask(src, dst, up)
+    expected = [bool(up[s, d]) for s, d in zip(src, dst)]
+    assert mask.tolist() == expected
+    jit_mask = simwave.link_keep_mask_jit(src, dst, up)
+    assert jit_mask.tolist() == expected
+
+
+def test_up_matrix_agrees_with_link_up():
+    topo = geo_topology()
+    t = GeoSimTransport(topo, _logger())
+    addrs = []
+    for i, zone in enumerate(topo.zones):
+        addr = f"n{i}"
+        topo.place(addr, zone)
+        addrs.append(addr)
+    addrs.append("unplaced-admin")
+    rng = random.Random("up-matrix")
+    for _ in range(30):
+        if rng.random() < 0.6:
+            topo.partition_link(rng.choice(topo.zones),
+                                rng.choice(topo.zones),
+                                both_ways=rng.random() < 0.5)
+        else:
+            topo.heal_link(rng.choice(topo.zones),
+                           rng.choice(topo.zones))
+        up = topo.up_matrix()
+        for a in addrs:
+            for b in addrs:
+                assert bool(up[topo.zone_id_of(a), topo.zone_id_of(b)]) \
+                    == topo.link_up(a, b), (a, b)
+    del t
+
+
+def test_jitter_rng_reuse_is_bit_identical_to_fresh_instances():
+    """sample_delay reuses one MT instance re-seeded per frame; the
+    determinism contract requires draws identical to a fresh
+    ``random.Random(key)`` per frame (the pre-paxsim form)."""
+    topo = geo_topology(seed=21)
+    topo.place("a", topo.zones[0])
+    topo.place("b", topo.zones[-1])
+    for frame_id in range(50):
+        got = topo.sample_delay("a", "b", frame_id)
+        link = topo.link_for("a", "b")
+        u = random.Random(
+            f"{topo.seed}|{topo._placement['a']}"
+            f"|{topo._placement['b']}|{frame_id}").random()
+        assert got == link.base_s * link.degrade \
+            + link.jitter_s * link.degrade * u

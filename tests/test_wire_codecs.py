@@ -92,12 +92,13 @@ def test_binary_round_trip(message):
 
 
 def test_unregistered_types_fall_back_to_pickle():
-    # Phase1a graduated to a fixed layout (tag 153, paxwire COD301
-    # burn-down); simplebpaxos's Recover is still a pickled cold-path
-    # message (grandfathered in .paxlint-baseline.json).
-    from frankenpaxos_tpu.protocols.simplebpaxos import messages as bp
+    # Recover graduated to a fixed layout (tag 200, paxsim COD301
+    # burn-down); simplegcbpaxos's SnapshotRequest is still a pickled
+    # cold-path admin message (grandfathered in
+    # .paxlint-baseline.json).
+    from frankenpaxos_tpu.protocols.simplegcbpaxos import SnapshotRequest
 
-    message = bp.Recover(vertex_id=bp.VertexId(0, 3))
+    message = SnapshotRequest()
     data = DEFAULT_SERIALIZER.to_bytes(message)
     assert data[0] >= 128  # pickle PROTO opcode
     assert DEFAULT_SERIALIZER.from_bytes(data) == message
@@ -943,6 +944,24 @@ def all_codec_samples() -> dict:
         fsp.Phase2aAnyAck(server_index=2, round=3),
         fsp.RoundInfo(round=3, delegates=(0, 2)),
     ]
+    # COD301 burn-down tranche 5 (tags 195-200, paxsim): the
+    # matchmaker whole-log transfers (round -> quorum-system dict
+    # logs) and simplebpaxos hole recovery.
+    mmp_configs = (
+        (3, {"kind": "simple_majority", "members": [0, 1, 2]}),
+        (5, {"kind": "grid", "grid": [[0, 1], [2, 3]]}),
+    )
+    samples += [
+        mmp.Stop(matchmaker_configuration=mmp_mc),
+        mmp.StopAck(matchmaker_index=4, epoch=2, gc_watermark=9,
+                    configurations=mmp_configs),
+        mmp.Bootstrap(epoch=3, reconfigurer_index=1, gc_watermark=9,
+                      configurations=mmp_configs),
+        mmp.BootstrapAck(matchmaker_index=4, epoch=3),
+        mmp.ReconfigureMatchmakers(matchmaker_configuration=mmp_mc,
+                                   new_matchmaker_indices=(6, 7, 8)),
+        bp.Recover(vertex_id=bp.VertexId(1, 9)),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
@@ -1269,6 +1288,70 @@ def test_cod301_burn_down_tranche4_round_trip():
         data = DEFAULT_SERIALIZER.to_bytes(message)
         assert data[0] == 0, type(message).__name__  # extended page
         assert DEFAULT_SERIALIZER.from_bytes(data) == message
+
+
+def test_cod301_burn_down_tranche5_round_trip():
+    """The matchmaker whole-log transfers (Stop/StopAck/Bootstrap/
+    BootstrapAck/ReconfigureMatchmakers, tags 195-199) and
+    simplebpaxos Recover (tag 200) graduated from the pickle fallback
+    (.paxlint-baseline.json 8 -> 2, paxsim). The quorum-system dict
+    payloads cover all four structured kinds plus the guarded-pickle
+    escape hatch for exotic dicts."""
+    import frankenpaxos_tpu.protocols.matchmakermultipaxos as mmp
+    from frankenpaxos_tpu.protocols.simplebpaxos import messages as bp
+    from frankenpaxos_tpu.runtime import serializer
+
+    mc = mmp.MatchmakerConfiguration(
+        epoch=3, reconfigurer_index=0, matchmaker_indices=(0, 1, 2))
+    configs = (
+        (0, {"kind": "simple_majority", "members": [0, 1, 2]}),
+        (2, {"kind": "unanimous_writes", "members": [3, 4]}),
+        (4, {"kind": "grid", "grid": [[0, 1, 2], [3, 4, 5]]}),
+        (6, {"kind": "zone_grid", "grid": [[0, 1], [2, 3], [4, 5]]}),
+        (8, {"kind": "grid", "grid": []}),
+    )
+    for message in [
+        mmp.Stop(matchmaker_configuration=mc),
+        mmp.StopAck(matchmaker_index=1, epoch=3, gc_watermark=1 << 40,
+                    configurations=configs),
+        mmp.StopAck(matchmaker_index=0, epoch=0, gc_watermark=-1,
+                    configurations=()),
+        mmp.Bootstrap(epoch=4, reconfigurer_index=1, gc_watermark=0,
+                      configurations=configs),
+        mmp.BootstrapAck(matchmaker_index=2, epoch=4),
+        mmp.ReconfigureMatchmakers(matchmaker_configuration=mc,
+                                   new_matchmaker_indices=()),
+        mmp.ReconfigureMatchmakers(matchmaker_configuration=mc,
+                                   new_matchmaker_indices=(5, 6, 7)),
+        bp.Recover(vertex_id=bp.VertexId(0, 0)),
+        bp.Recover(vertex_id=bp.VertexId(3, 1 << 40)),
+    ]:
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+        assert data[0] == 0, type(message).__name__  # extended page
+        assert DEFAULT_SERIALIZER.from_bytes(data) == message
+    # Exotic quorum-system dicts (unknown kind, non-int members) ride
+    # the guarded-pickle hatch: round-trip with the fallback enabled,
+    # refused at the SENDER with it disabled.
+    exotic = mmp.StopAck(
+        matchmaker_index=1, epoch=3, gc_watermark=2,
+        configurations=((1, {"kind": "weighted",
+                             "weights": {"a": 2}}),))
+    data = DEFAULT_SERIALIZER.to_bytes(exotic)
+    assert data[0] == 0
+    assert DEFAULT_SERIALIZER.from_bytes(data) == exotic
+    serializer.set_pickle_fallback(False)
+    try:
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="pickle fallback"):
+            DEFAULT_SERIALIZER.to_bytes(exotic)
+        # The structured kinds stay fully binary under the same flag.
+        plain = mmp.StopAck(matchmaker_index=1, epoch=3,
+                            gc_watermark=2, configurations=configs)
+        assert DEFAULT_SERIALIZER.from_bytes(
+            DEFAULT_SERIALIZER.to_bytes(plain)) == plain
+    finally:
+        serializer.set_pickle_fallback(True)
 
 
 def test_tranche4_rejects_hostile_index_values():
