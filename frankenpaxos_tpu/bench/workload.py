@@ -207,6 +207,10 @@ class OpenLoopWorkload:
     write_size_std: int = 0
     diurnal_amplitude: float = 0.0
     diurnal_period_s: float = 60.0
+    #: Phase shift of the diurnal ramp (paxworld follow-the-sun:
+    #: region k's lane runs the SAME ramp offset by k * period/3, so
+    #: the global peak walks around the planet).
+    diurnal_phase_s: float = 0.0
 
     def offered_rate(self, t: float) -> float:
         """The instantaneous target rate at virtual time ``t``
@@ -216,7 +220,8 @@ class OpenLoopWorkload:
         import math
 
         return self.rate * max(0.0, 1.0 + self.diurnal_amplitude
-                               * math.sin(2 * math.pi * t
+                               * math.sin(2 * math.pi
+                                          * (t + self.diurnal_phase_s)
                                           / self.diurnal_period_s))
 
     def arrival_count(self, np_rng, t: float, dt: float) -> int:

@@ -90,20 +90,48 @@ class GeoSimTransport(SimTransport):
         self._deadlines: dict[int, float] = {}
         self._deadline_heap: list = []
         self._link_only_check = None
+        #: paxworld fault bridge: address -> virtual time its sends
+        #: resume departing. A role stalled inside a blocking syscall
+        #: (an fsync-stall fault, wal/faults.py) emits its frames
+        #: late: arrival stamps base at the stall horizon instead of
+        #: ``now``. Empty (one falsy test per send) unless a fault
+        #: hook armed it.
+        self._stall_until: dict = {}
 
     # --- sending ----------------------------------------------------------
     def send(self, src: Address, dst: Address, data: bytes) -> None:
         before = len(self.messages)
         super().send(src, dst, data)
+        stalls = self._stall_until
         # Stamp every frame this send buffered (the frame itself, plus
         # any reject replies a bounded inbox synthesized), each over
-        # its OWN link.
+        # its OWN link -- and each from its OWN sender's stall
+        # horizon (a synthesized reject originates at dst, which may
+        # not share src's stall).
         for message in self.messages[before:]:
-            arrival = self.now + self.topology.sample_delay(
+            base = self.now
+            if stalls:
+                until = stalls.get(message.src)
+                if until is not None:
+                    if until > base:
+                        base = until
+                    else:
+                        del stalls[message.src]  # expired
+            arrival = base + self.topology.sample_delay(
                 message.src, message.dst, message.id)
             self.arrivals[message.id] = arrival
             self._by_id[message.id] = message
             heapq.heappush(self._arrival_heap, (arrival, message.id))
+
+    def stall_sender(self, address: Address, until_t: float) -> None:
+        """Model a role blocked in a syscall until virtual ``until_t``
+        (the wal/faults.py fsync-stall bridge): frames it sends before
+        then depart AT the stall horizon -- the event-loop pass that
+        issued the blocking call finishes late, exactly like a real
+        fsync stall holds a drain's group-commit release. Stalls only
+        extend (a second fault during one stall pushes the horizon)."""
+        if until_t > self._stall_until.get(address, 0.0):
+            self._stall_until[address] = until_t
 
     def timer(self, address: Address, name: str, delay_s: float,
               f) -> GeoSimTimer:
@@ -216,7 +244,15 @@ class GeoSimTransport(SimTransport):
                 t = self.next_event_time()
                 if t is None or t > t_end:
                     break
-                self.now = t
+                # max(): the clock never REWINDS. A budget-capped call
+                # (paxworld: run_until under the overload CPU model)
+                # can end with backlog whose arrival stamps are behind
+                # the t_end it advanced to; delivering that backlog
+                # next tick at its old stamps would move time backward
+                # -- and erase exactly the queueing delay the overload
+                # SLO clauses exist to measure. In the un-capped case
+                # arrivals pop in order, so this is the identity.
+                self.now = max(self.now, t)
                 # The whole same-timestamp wave delivers even when it
                 # overshoots max_steps -- the legacy loop counted steps
                 # per message but only checked the cap between waves,
@@ -250,7 +286,7 @@ class GeoSimTransport(SimTransport):
             t = self.next_event_time()
             if t is None or t > t_end:
                 break
-            self.now = t
+            self.now = max(self.now, t)  # never rewinds (see run_until)
             touched: list = []
             seen: set[int] = set()
             for message in self._pop_due_messages(t):

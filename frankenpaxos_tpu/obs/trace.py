@@ -199,9 +199,24 @@ class RuntimeMetrics:
             "fpx_runtime_transport_batch_bytes",
             help="Bytes sent through the batched (paxwire) flush path",
             labels=("role",)).labels(role)
+        # paxworld (scenarios/, docs/GLOBAL.md): per-region serving
+        # health for the Grafana "Global serving" band -- commands
+        # committed and client commands rejected/shed, labeled by the
+        # zone/region the exporting role serves.
+        self._region_goodput = collectors.counter(
+            "fpx_runtime_region_goodput_cmds_total",
+            help="Commands committed (chosen) by this role, by "
+                 "region/zone",
+            labels=("role", "region"))
+        self._region_shed = collectors.counter(
+            "fpx_runtime_region_shed_total",
+            help="Client commands rejected or shed by this role, by "
+                 "region/zone",
+            labels=("role", "region"))
         self._adm_rejected_children: dict = {}
         self._adm_shed_children: dict = {}
         self._retry_children: dict = {}
+        self._region_children: dict = {}
 
     def observe_stage(self, stage: str, dur_s: float) -> None:
         child = self._stage_children.get(stage)
@@ -244,6 +259,21 @@ class RuntimeMetrics:
         if child is None:
             child = self._retry_counter.labels(self.role, kind)
             self._retry_children[kind] = child
+        child.inc(n)
+
+    # --- paxworld global serving (scenarios/) ---------------------------
+    def region_goodput(self, region: str, n: int = 1) -> None:
+        child = self._region_children.get(("goodput", region))
+        if child is None:
+            child = self._region_goodput.labels(self.role, region)
+            self._region_children[("goodput", region)] = child
+        child.inc(n)
+
+    def region_shed(self, region: str, n: int = 1) -> None:
+        child = self._region_children.get(("shed", region))
+        if child is None:
+            child = self._region_shed.labels(self.role, region)
+            self._region_children[("shed", region)] = child
         child.inc(n)
 
     def outbound_buffer_hwm(self, size_bytes: int) -> None:

@@ -86,13 +86,33 @@ class ReadReply:
 
 
 class ChainNode(Actor):
+    """``admission`` (a serve.admission.AdmissionOptions, or None)
+    arms paxload admission control on this node's CLIENT edge: bare
+    ``Write``/``Read`` arrivals -- the only client-sent shapes -- are
+    admitted or answered with an explicit ``Rejected``, while the
+    chain's own replication traffic (``WriteBatch`` hops, ``Ack``,
+    ``TailRead``) is control plane and never touches the controller.
+    That puts CRAQ's read path under the same admission/client-lane/
+    Rejected-backoff discipline the Paxos write paths already have
+    (docs/SERVING.md), which is what lets the scenario matrix gate
+    zone-local chain reads on the same SLO clauses as writes."""
+
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: CraqConfig,
-                 resend_period_s: float = 1.0):
+                 resend_period_s: float = 1.0, admission=None):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
         self.index = list(config.chain_node_addresses).index(address)
+        if admission is not None and admission.any_enabled():
+            from frankenpaxos_tpu.serve.admission import (
+                AdmissionController,
+            )
+
+            self.admission = AdmissionController(
+                admission, role=f"craq_node_{self.index}",
+                metrics=transport.runtime_metrics)
+            transport.note_admission(address, self)
         self.is_head = self.index == 0
         self.is_tail = self.index == len(config.chain_node_addresses) - 1
         self.pending_writes: list[WriteBatch] = []
@@ -231,12 +251,44 @@ class ChainNode(Actor):
             self.versions += 1
 
     # --- dispatch ---------------------------------------------------------
+    def _admit_client(self, message) -> bool:
+        """Admit one client-edge command, or answer ``Rejected`` (the
+        client backs off and retries -- backoff.py discipline; reads
+        and writes share the controller)."""
+        if self.admission is None or self.admission.admit():
+            return True
+        from frankenpaxos_tpu.serve.messages import Rejected
+
+        cid = message.command_id
+        self.send(cid.client_address, Rejected(
+            entries=((cid.client_pseudonym, cid.client_id),),
+            retry_after_ms=self.admission.retry_after_ms(),
+            reason=self.admission.last_reason))
+        return False
+
+    def on_drain(self) -> None:
+        # Resync the admission in-flight measure where it changes
+        # (the wpaxos-leader discipline): reads complete inside their
+        # handler and writes complete on ack-apply, so the live span
+        # is the un-acked sequenced write backlog. Without this, an
+        # armed inflight_limit saturates after `limit` admits and the
+        # node rejects forever.
+        if self.admission is not None \
+                and self.admission.options.inflight_limit:
+            self.admission.set_inflight(
+                sum(len(batch.writes)
+                    for batch in self.pending_writes))
+
     def receive(self, src: Address, message) -> None:
         if isinstance(message, Write):
+            if not self._admit_client(message):
+                return
             self._process_write_batch(WriteBatch((message,)))
         elif isinstance(message, WriteBatch):
             self._process_write_batch(message)
         elif isinstance(message, Read):
+            if not self._admit_client(message):
+                return
             self._process_read_batch(ReadBatch((message,)))
         elif isinstance(message, ReadBatch):
             self._process_read_batch(message)
@@ -253,52 +305,131 @@ class _Pending:
     id: int
     callback: Callable
     resend_timer: object
+    request: object = None
+    dst: object = None
+    is_read: bool = False
+    attempts: int = 0
+    # A Rejected already rescheduled the timer: a duplicate refusal
+    # (original + resend both refused) must not double-consume the
+    # retry budget or re-widen the backoff.
+    backoff_pending: bool = False
 
 
 class CraqClient(Actor):
-    """Writes go to the head; reads go to a random node
-    (craq/Client.scala)."""
+    """Writes go to the head; reads go to a random node -- or, with
+    ``read_node`` pinned, to THAT node (the paxworld zone-local read
+    lane: a geo scenario pins each zone's client to its zone's chain
+    node). ``retry_budget``/``backoff`` arm the paxload retry
+    discipline (serve/backoff.py): a ``Rejected`` backs off with
+    jitter (honoring the server's retry_after hint) and retries the
+    same node, timeouts resend on the resend period, and both consume
+    the per-op budget -- exhaustion concludes the op with
+    RETRY_EXHAUSTED instead of retrying forever. A budget of 0 (the
+    default) preserves the pre-paxworld behavior exactly; when one is
+    armed, WRITE callbacks must accept the sentinel argument."""
 
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: CraqConfig,
-                 resend_period_s: float = 10.0, seed: int = 0):
+                 resend_period_s: float = 10.0, seed: int = 0,
+                 retry_budget: int = 0, backoff=None,
+                 read_node: Optional[int] = None):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
         self.rng = random.Random(seed)
         self.resend_period_s = resend_period_s
+        self.retry_budget = retry_budget
+        self.backoff = backoff
+        self.read_node = read_node
+        self.giveups = 0
+        # String-seeded: only the Rejected-backoff jitter draws here.
+        self._backoff_rng = random.Random(f"craq-client|{address}|{seed}")
         self.ids: dict[int, int] = {}
         self.pending: dict[int, _Pending] = {}
 
     def _start(self, pseudonym: int, make_request, dst: Address,
-               callback) -> None:
+               callback, is_read: bool) -> None:
         if pseudonym in self.pending:
             raise RuntimeError(f"pseudonym {pseudonym} has a pending op")
         id = self.ids.get(pseudonym, 0)
         self.ids[pseudonym] = id + 1
         request = make_request(CommandId(self.address, pseudonym, id))
-
-        def resend():
-            self.send(dst, request)
-            timer.start()
-
         self.send(dst, request)
         timer = self.timer(f"resend-{pseudonym}", self.resend_period_s,
-                           resend)
+                           lambda p=pseudonym: self._resend(p))
         timer.start()
-        self.pending[pseudonym] = _Pending(id, callback or (lambda *_: None),
-                                           timer)
+        self.pending[pseudonym] = _Pending(
+            id, callback or (lambda *_: None), timer,
+            request=request, dst=dst, is_read=is_read)
+
+    def _resend(self, pseudonym: int) -> None:
+        pending = self.pending.get(pseudonym)
+        if pending is None:
+            return
+        pending.backoff_pending = False
+        if self.retry_budget and pending.attempts >= self.retry_budget:
+            self._giveup(pseudonym)
+            return
+        pending.attempts += 1
+        self.send(pending.dst, pending.request)
+        timer = pending.resend_timer
+        timer.set_delay(self.resend_period_s)
+        timer.start()
+
+    def _giveup(self, pseudonym: int) -> None:
+        from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
+
+        pending = self.pending.pop(pseudonym)
+        pending.resend_timer.stop()
+        self.giveups += 1
+        pending.callback(RETRY_EXHAUSTED)
+
+    def _handle_rejected(self, src: Address, m) -> None:
+        """Admission refusal from a chain node: alive but saturated.
+        Back off (jittered, server hint as the floor) and retry the
+        SAME node on the rescheduled resend timer.
+
+        (Known accepted duplication: this budget/backoff_pending/
+        RETRY_EXHAUSTED state machine mirrors
+        protocols/wpaxos/client.py and the multipaxos/mencius retry
+        discipline, pending the protocol-neutral client-layer
+        refactor on the ROADMAP -- change one, check the others.)"""
+        for pseudonym, client_id in m.entries:
+            pending = self.pending.get(pseudonym)
+            if pending is None or pending.id != client_id \
+                    or pending.backoff_pending:
+                continue
+            pending.attempts += 1
+            if self.retry_budget \
+                    and pending.attempts >= self.retry_budget:
+                self._giveup(pseudonym)
+                continue
+            delay = self.resend_period_s
+            if self.backoff is not None:
+                delay = self.backoff.delay_s(
+                    pending.attempts - 1, self._backoff_rng,
+                    floor_s=getattr(m, "retry_after_ms", 0) / 1000.0)
+            pending.backoff_pending = True
+            timer = pending.resend_timer
+            timer.stop()
+            timer.set_delay(delay)
+            timer.start()
 
     def write(self, pseudonym: int, key: str, value: str,
               callback: Optional[Callable[[], None]] = None) -> None:
         self._start(pseudonym, lambda cid: Write(cid, key, value),
-                    self.config.chain_node_addresses[0], callback)
+                    self.config.chain_node_addresses[0], callback,
+                    is_read=False)
 
     def read(self, pseudonym: int, key: str,
              callback: Optional[Callable[[str], None]] = None) -> None:
-        node = self.config.chain_node_addresses[
-            self.rng.randrange(len(self.config.chain_node_addresses))]
-        self._start(pseudonym, lambda cid: Read(cid, key), node, callback)
+        if self.read_node is not None:
+            node = self.config.chain_node_addresses[self.read_node]
+        else:
+            node = self.config.chain_node_addresses[self.rng.randrange(
+                len(self.config.chain_node_addresses))]
+        self._start(pseudonym, lambda cid: Read(cid, key), node,
+                    callback, is_read=True)
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, ClientReply):
@@ -307,6 +438,9 @@ class CraqClient(Actor):
         elif isinstance(message, ReadReply):
             pseudonym = message.command_id.client_pseudonym
             result = message.value
+        elif type(message).__name__ == "Rejected":
+            self._handle_rejected(src, message)
+            return
         else:
             self.logger.fatal(f"unexpected client message {message!r}")
         pending = self.pending.get(pseudonym)

@@ -144,8 +144,46 @@ class CraqReadReplyCodec(MessageCodec):
         return cq.ReadReply(cid, value.decode()), at
 
 
+# The bare client-edge shapes (paxworld, extended tag page): what a
+# CraqClient actually puts on the wire is Write/Read, not the chain's
+# batch envelopes -- without their own tags these frames pickled, so
+# the frame-layer lane classifier (serve/lanes.py) was BLIND to them
+# and a bounded inbox could never shed CRAQ client traffic (the
+# FLOW405a class paxflow caught on the multipaxos read batchers).
+
+
+class CraqWriteCodec(MessageCodec):
+    message_type = cq.Write
+    tag = 201
+
+    def encode(self, out, message):
+        _cq_put_cid(out, message.command_id)
+        _put_bytes(out, message.key.encode())
+        _put_bytes(out, message.value.encode())
+
+    def decode(self, buf, at):
+        cid, at = _cq_take_cid(buf, at)
+        key, at = _take_bytes(buf, at)
+        value, at = _take_bytes(buf, at)
+        return cq.Write(cid, key.decode(), value.decode()), at
+
+
+class CraqReadCodec(MessageCodec):
+    message_type = cq.Read
+    tag = 202
+
+    def encode(self, out, message):
+        _cq_put_cid(out, message.command_id)
+        _put_bytes(out, message.key.encode())
+
+    def decode(self, buf, at):
+        cid, at = _cq_take_cid(buf, at)
+        key, at = _take_bytes(buf, at)
+        return cq.Read(cid, key.decode()), at
+
 
 for _codec in (CraqWriteBatchCodec(), CraqReadBatchCodec(),
                CraqTailReadCodec(), CraqAckCodec(),
-               CraqClientReplyCodec(), CraqReadReplyCodec()):
+               CraqClientReplyCodec(), CraqReadReplyCodec(),
+               CraqWriteCodec(), CraqReadCodec()):
     register_codec(_codec)

@@ -18,6 +18,7 @@ commit latency.
 from __future__ import annotations
 
 import dataclasses
+import random
 from typing import Callable, Optional
 
 from frankenpaxos_tpu.geo.rtt import RttEstimator
@@ -40,6 +41,16 @@ class WPaxosClientOptions:
     failover_after: int = 2
     #: Adaptive resend deadlines from observed request RTTs.
     adaptive_timeouts: bool = True
+    #: paxworld retry discipline (serve/backoff.py): total retries
+    #: (timeout resends + Rejected backoffs) per op before the op
+    #: concludes with RETRY_EXHAUSTED. 0 = unlimited (the pre-budget
+    #: behavior every existing sim/bench keeps). When a budget is
+    #: armed, write callbacks must accept the sentinel.
+    retry_budget: int = 0
+    #: Jittered exponential backoff applied on Rejected (a
+    #: serve.backoff.Backoff); None keeps the adaptive resend timer's
+    #: own pacing (the pre-paxworld behavior).
+    reject_backoff: object = None
 
 
 @dataclasses.dataclass
@@ -50,6 +61,11 @@ class _Pending:
     callback: Optional[Callable]
     target_zone: int
     resends: int = 0
+    rejects: int = 0
+    #: A Rejected arrived and the backoff timer is already rescheduled:
+    #: a duplicate Rejected (original + resend both refused) must not
+    #: double-consume the retry budget or re-widen the backoff.
+    backoff_pending: bool = False
     steal: bool = False
     sent_at: float = 0.0
     first_sent_at: float = 0.0
@@ -90,6 +106,13 @@ class WPaxosClient(Actor):
         #: (group, target_zone, latency_s) per completed op -- the
         #: bench's measurement surface.
         self.latencies: list[tuple] = []
+        #: RETRY_EXHAUSTED conclusions (the scenario matrix's loud,
+        #: bounded degradation path).
+        self.giveups = 0
+        # String-seeded (sha512, process-stable) -- only the Rejected
+        # backoff jitter draws from it, so budget-less clients replay
+        # byte-identically to pre-paxworld.
+        self._rng = random.Random(f"wpaxos-client|{address}|{seed}")
 
     # --- the write API ------------------------------------------------------
     def write(self, pseudonym: int, payload: bytes,
@@ -145,6 +168,11 @@ class WPaxosClient(Actor):
         op = self.pending.get(pseudonym)
         if op is None:
             return
+        op.backoff_pending = False
+        budget = self.options.retry_budget
+        if budget and op.resends + op.rejects >= budget:
+            self._giveup(pseudonym)
+            return
         op.resends += 1
         if op.resends % self.options.failover_after == 0:
             # The hinted zone is not answering: rotate and ask the
@@ -154,6 +182,19 @@ class WPaxosClient(Actor):
             op.steal = True
         self._send(op)
         self._restart_timer(pseudonym, resends=op.resends)
+
+    def _giveup(self, pseudonym: int) -> None:
+        """Retry budget exhausted: conclude LOUDLY with the sentinel
+        -- never a silent wedge (docs/SERVING.md discipline)."""
+        from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
+
+        op = self.pending.pop(pseudonym)
+        timer = self._timers.get(pseudonym)
+        if timer is not None:
+            timer.stop()
+        self.giveups += 1
+        if op.callback is not None:
+            op.callback(RETRY_EXHAUSTED)
 
     # --- handlers -----------------------------------------------------------
     def receive(self, src: Address, message) -> None:
@@ -199,10 +240,43 @@ class WPaxosClient(Actor):
             self._restart_timer(m.command_id.client_pseudonym)
 
     def _handle_rejected(self, src: Address, m) -> None:
-        """paxload admission refusal: back off (the resend timer is
-        already running; just don't hammer) and retry at the same
-        leader on the next resend tick."""
-        for pseudonym, _client_id in m.entries:
+        """paxload admission refusal: the leader is ALIVE but
+        saturated -- back off (jittered exponential when
+        ``reject_backoff`` is armed, honoring the server's
+        retry_after hint as a floor), consume the retry budget, and
+        retry the SAME leader; never treat it as a death signal (no
+        steal, no failover rotation).
+
+        (Known accepted duplication: this budget/backoff_pending/
+        RETRY_EXHAUSTED state machine mirrors protocols/craq.py and
+        the multipaxos/mencius retry discipline, pending the
+        protocol-neutral client-layer refactor on the ROADMAP --
+        change one, check the others.)"""
+        for pseudonym, client_id in m.entries:
             op = self.pending.get(pseudonym)
-            if op is not None:
-                op.steal = False
+            if op is None or op.command_id.client_id != client_id:
+                continue
+            op.steal = False
+            if op.backoff_pending:
+                continue  # duplicate refusal of one attempt
+            op.rejects += 1
+            budget = self.options.retry_budget
+            if budget and op.resends + op.rejects >= budget:
+                self._giveup(pseudonym)
+                continue
+            # Set UNCONDITIONALLY (cleared when the resend timer
+            # fires): with no backoff armed, a duplicate refusal of
+            # one attempt (original + resend both refused) must still
+            # not double-consume the budget.
+            op.backoff_pending = True
+            backoff = self.options.reject_backoff
+            if backoff is None:
+                continue  # the running resend timer paces the retry
+            delay = backoff.delay_s(
+                op.rejects - 1, self._rng,
+                floor_s=getattr(m, "retry_after_ms", 0) / 1000.0)
+            timer = self._timers.get(pseudonym)
+            if timer is not None:
+                timer.stop()
+                timer.set_delay(delay)
+                timer.start()

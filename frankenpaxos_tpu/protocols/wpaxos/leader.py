@@ -173,6 +173,7 @@ class WPaxosLeader(Actor):
         # group -> (timer, entry, set of acked acceptor ids)
         self._epoch_resends: dict[int, tuple] = {}
         # paxload admission (serve/): built only when a knob arms it.
+        self._rejected_exported = 0
         admission_options = options.admission_options()
         if admission_options is not None:
             from frankenpaxos_tpu.serve.admission import (
@@ -181,7 +182,8 @@ class WPaxosLeader(Actor):
 
             self.admission = AdmissionController(
                 admission_options, role=f"wpaxos_leader_{self.zone}",
-                clock=self._clock)
+                clock=self._clock,
+                metrics=transport.runtime_metrics)
             transport.note_admission(address, self)
 
     # --- handlers -----------------------------------------------------------
@@ -219,6 +221,27 @@ class WPaxosLeader(Actor):
             return
         entry = self.epochs.current(group)
         if m.steal or entry.home_zone == self.zone:
+            floor = self._ballot_floor.get(group, -1)
+            if not m.steal and floor > entry.ballot \
+                    and self.config.ballot_zone(floor) != self.zone:
+                # Our epoch store says this is our home group, but we
+                # have already been NACKED at a higher ballot whose
+                # zone-partitioned number names another zone's leader:
+                # a steal is in flight (or committed) and its
+                # WEpochCommit just has not reached us yet. Redirect
+                # the client there instead of stealing our old home
+                # straight back -- the boomerang re-steal otherwise
+                # turns every planned migration into a ballot war
+                # (follow-the-sun found this: the sun could never set
+                # on a zone with any residual traffic). The hint is
+                # routing advice only; if the preemptor is actually
+                # dead, the client's failover budget comes back with
+                # steal=True, which bypasses this branch.
+                self.send(src, WNotOwner(
+                    group=group, command_id=m.command.command_id,
+                    home_zone=self.config.ballot_zone(floor),
+                    ballot=floor))
+                return
             # Failover resend (the client gave up on the home zone),
             # or our own un-acquired home group (bootstrap, or an
             # amnesiac restart): acquire it with a fresh-ballot steal.
@@ -283,6 +306,7 @@ class WPaxosLeader(Actor):
         self._dirty.add(m.group)
 
     def on_drain(self) -> None:
+        commits = 0
         for group in sorted(self._dirty):
             self._dirty.discard(group)
             newly = self.trackers[group].drain()
@@ -296,6 +320,7 @@ class WPaxosLeader(Actor):
                 if proposal is None:
                     continue
                 value, client, cid = proposal
+                commits += 1
                 self._record_chosen(group, slot, value)
                 if client is not None:
                     result = value.commands[0].command \
@@ -315,6 +340,31 @@ class WPaxosLeader(Actor):
                 if "active_s" in event:
                     self._close_steal_event(group)
         self._flush_chosen()
+        # paxworld: resync the admission in-flight measure where it
+        # CHANGES -- quorums landing this drain popped proposals (and
+        # steals/releases moved whole groups). Admit()'s increments
+        # accrue between drains; without this resync the slot budget
+        # saturates after inflight_limit admits and the leader
+        # rejects forever (the PR 6 multipaxos bug class, found here
+        # by the scenario matrix's goodput floor).
+        if self.admission is not None:
+            self.admission.set_inflight(
+                sum(len(st.proposals)
+                    for st in self.active.values()))
+        # paxworld per-region serving health (Grafana "Global
+        # serving" band): commits this drain and the running
+        # rejected/shed delta, labeled with this leader's zone.
+        metrics = self.transport.runtime_metrics
+        if metrics is not None:
+            region = self.config.zones[self.zone]
+            if commits:
+                metrics.region_goodput(region, commits)
+            if self.admission is not None:
+                total = sum(self.admission.rejected.values())
+                delta = total - self._rejected_exported
+                if delta:
+                    metrics.region_shed(region, delta)
+                    self._rejected_exported = total
 
     def _record_chosen(self, group: int, slot: int, value) -> None:
         self.chosen[group][slot] = value

@@ -1,0 +1,26 @@
+"""paxworld: the planet-scale serving scenario matrix.
+
+The paxgeo x paxload fusion (docs/GLOBAL.md): the SoA open-loop load
+tier (serve/loadgen.py) drives WPaxos/CRAQ deployments over
+GeoSimTransport WAN topologies through deterministic, seeded chaos
+schedules -- zone outages at the diurnal peak, cross-region
+partitions, follow-the-sun traffic migration, two-continent hot-object
+contention, and cloud storage pathologies (fsync stalls) -- and every
+scenario is GATED on explicit SLO clauses: a goodput floor, admitted
+p99/p999 ceilings, zero acked-write loss, a control plane that is
+never shed, and bounded recovery time.
+
+``bench/global_lt.py`` runs the matrix and commits
+``bench_results/global_lt.json``; the CI ``global-smoke`` job enforces
+the gates on a reduced scale every PR.
+"""
+
+from frankenpaxos_tpu.scenarios.matrix import (  # noqa: F401
+    FULL,
+    history_digest,
+    run_matrix,
+    run_scenario,
+    Scale,
+    SCENARIOS,
+    SMOKE,
+)

@@ -1,0 +1,1018 @@
+"""The fused paxgeo x paxload scenario matrix (paxworld).
+
+Every scenario drives the SoA open-loop load tier
+(:class:`~frankenpaxos_tpu.serve.loadgen.GeoOverloadDriver`) against a
+WPaxos (or CRAQ) deployment over a :class:`GeoSimTransport` WAN
+topology, entirely on VIRTUAL time with ONE clock (the transport's):
+arrivals, admission token buckets, client backoff timers, link
+latencies, fault schedules, and the SLO measurements all read the same
+virtual instant, so a scenario is a pure function of its seed -- the
+golden test (tests/test_scenarios.py) pins byte-identical delivery
+history AND an identical SLO row per seed.
+
+THE SLO CONTRACT (every scenario row records these clauses, and
+``bench/global_lt.py`` gates CI on them):
+
+  * ``goodput_floor``        -- in-SLO completions/s over the measured
+                                window stays above the floor;
+  * ``p99_admitted_ceiling`` / ``p999_admitted_ceiling`` -- latency of
+                                requests admitted on arrival (client
+                                backoff excluded -- the latency the
+                                serving path actually delivered);
+  * ``zero_acked_write_loss`` -- an acked write is NEVER missing from
+                                the (healed, settled) replicated state;
+  * ``control_plane_never_shed`` -- no bounded inbox ever refuses a
+                                control-lane frame (votes, Phase1,
+                                epoch commits, chain hops);
+  * ``no_silent_wedge``      -- every issued request concludes: ack,
+                                explicit Rejected-driven backoff
+                                conclusion, or bounded-retry
+                                RETRY_EXHAUSTED (pending == 0 after
+                                settle);
+  * ``bounded_recovery``     -- where the scenario injects an outage/
+                                partition, time from repair to the
+                                first affected-lane completion is
+                                bounded;
+  * plus per-scenario extras (steal ping-pong bound, queue bound,
+    zone-local read p99, fsync tail amplification).
+
+The five fused scenarios (ISSUE 13) + the geo read-scaling row:
+
+  1. ``zone_outage_peak``    -- SIGKILL a whole zone at its diurnal
+                                maximum; WAL relaunch + steal repair.
+  2. ``region_partition``    -- cross-region partition: majority side
+                                within SLO, minority sheds loudly and
+                                heals without duplicate execution.
+  3. ``follow_the_sun``      -- the diurnal peak walks across regions
+                                and object steal chases it.
+  4. ``hot_contention``      -- Zipf-hot objects contended from two
+                                continents; steal ping-pong bounded.
+  5. ``fsync_stalls``        -- deterministic WAL fsync stalls
+                                (wal/faults.py): quorums mask single
+                                stalls, overlap amplifies p999 only.
+  6. ``craq_read_scaling``   -- WPaxos-style global writes + CRAQ
+                                zone-local chain reads under the same
+                                admission/Rejected/backoff discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+
+from frankenpaxos_tpu.bench.workload import OpenLoopWorkload
+from frankenpaxos_tpu.geo import GeoTopology
+from frankenpaxos_tpu.serve.backoff import Backoff
+from frankenpaxos_tpu.serve.lanes import frame_lane, LANE_CONTROL
+from frankenpaxos_tpu.serve.loadgen import GeoOverloadDriver, TrafficLane
+
+#: The virtual service model shared by every scenario: cluster
+#: capacity in commands/virtual-second, per-delivered-frame CPU cost,
+#: tick width, and the serving SLO deadline. Sized for ~40% steady
+#: utilization at the healthy offered load: the scenarios study
+#: FAULTS under load, not baseline congestion collapse -- retry
+#: amplification on top of a saturated baseline drowns every signal
+#: the clauses gate (and real planetary fleets are not provisioned
+#: at the knee either).
+CAPACITY_CMDS_S = 900.0
+MSG_COST_S = 0.0001
+DT_S = 0.02
+SLO_DEADLINE_S = 1.0
+
+#: Per-leader admission knobs (serve/admission.py, flat so they map
+#: onto WPaxosLeaderOptions verbatim): a token bucket above the
+#: healthy per-zone rate, a watermark-tied in-flight budget, and a
+#: bounded reject-newest client-lane inbox.
+ADMISSION = dict(
+    admission_token_rate=150.0,
+    admission_token_burst=30.0,
+    admission_inflight_limit=96,
+    admission_inbox_capacity=256,
+    admission_inbox_policy="reject",
+    admission_retry_after_ms=100,
+)
+#: Client retry discipline: total retries (timeouts + rejections) per
+#: op before a LOUD RETRY_EXHAUSTED conclusion. The resend timer is
+#: FIXED (adaptive RTT timeouts read a 100ms fsync stall or a
+#: transient queue as zone death and steal-failover out of a
+#: perfectly alive zone -- patience is the right failure detector
+#: when the fault model includes sub-outage stalls). Budget 2 with
+#: the client's 1.5x-widening resend schedule bounds an unservable
+#: op's lifetime to ~4.75 virtual seconds -- long enough to ride out
+#: an outage dwell, short enough that a cross-region partition
+#: visibly EXHAUSTS budgets (the loud-degradation clause) at both
+#: scales.
+RETRY_BUDGET = 2
+RESEND_PERIOD_S = 1.0
+REJECT_BACKOFF = Backoff(initial_s=0.1, max_s=1.0, multiplier=2.0,
+                         jitter=0.5)
+
+
+@dataclasses.dataclass(frozen=True)
+class Scale:
+    """One knob for smoke-vs-full sizing; everything else is shared so
+    the smoke exercises exactly the committed code paths."""
+
+    name: str
+    sessions_per_lane: int
+    per_zone_rate: float
+    duration_s: float
+    settle_s: float
+    outage_dwell_s: float
+
+
+SMOKE = Scale("smoke", sessions_per_lane=20_000, per_zone_rate=50.0,
+              duration_s=9.0, settle_s=10.0, outage_dwell_s=1.5)
+#: 3 lanes x 400k sessions = 1.2M open-loop sessions per scenario --
+#: the "millions of users worldwide" configuration (ROADMAP).
+FULL = Scale("full", sessions_per_lane=400_000, per_zone_rate=60.0,
+             duration_s=21.0, settle_s=12.0, outage_dwell_s=2.0)
+
+
+# --- clause / oracle helpers -------------------------------------------------
+
+
+def clause(value, bound, kind: str = "max") -> dict:
+    """One SLO clause row: ``kind`` is "max" (value <= bound), "min"
+    (value >= bound), or "zero". A missing measurement (None) FAILS --
+    an SLO you could not measure is not an SLO you met."""
+    if value is None:
+        passed = False
+    elif kind == "max":
+        passed = value <= bound
+    elif kind == "min":
+        passed = value >= bound
+    else:
+        passed = value == 0
+    if isinstance(value, float):
+        value = round(value, 4)
+    return {"value": value, "bound": bound, "kind": kind,
+            "passed": bool(passed)}
+
+
+def _arm_control_oracle(transport) -> list:
+    """Record any control-lane frame a bounded inbox refuses (the
+    clause demands the list stays empty)."""
+    refused: list = []
+    original = transport._admit_to_inbox
+
+    def checked(src, dst, data):
+        verdict = original(src, dst, data)
+        if not verdict and frame_lane(data) == LANE_CONTROL:
+            refused.append((str(src), str(dst)))
+        return verdict
+
+    transport._admit_to_inbox = checked
+    return refused
+
+
+def _wpaxos_safety(sim, acked) -> list:
+    """The paxgeo safety oracle over the healed, settled cluster --
+    chosen-value uniqueness, replica prefix compatibility,
+    exactly-once execution (the SAME invariant body the geo-chaos
+    soak enforces, so the scenario gate and the soak gate can never
+    silently drift apart) -- plus the matrix's own clause: no acked
+    write missing from the replicated state."""
+    from tests.protocols.test_wpaxos import WPaxosGeoSimulated
+
+    violations: list = []
+    # state_invariant reads only `sim`; borrow the soak's body
+    # unbound so there is exactly one implementation.
+    failure = WPaxosGeoSimulated.state_invariant(None, sim)
+    if failure is not None:
+        violations.append(failure)
+    executed_union: set = set()
+    for replica in sim.replicas:
+        for seq in replica.executed:
+            executed_union.update(seq)
+    lost = [p for p in acked if p not in executed_union]
+    if lost:
+        violations.append(
+            f"{len(lost)} acked writes missing from every replica "
+            f"(first: {lost[0]!r})")
+    return violations
+
+
+def history_digest(transport) -> str:
+    """sha256 over the delivered/triggered event history -- the golden
+    determinism test's byte-identity check."""
+    from frankenpaxos_tpu.runtime.sim_transport import DeliverMessage
+
+    h = hashlib.sha256()
+    for event in transport.history:
+        if isinstance(event, DeliverMessage):
+            m = event.message
+            h.update(b"D|%d|%s|%s|" % (m.id, str(m.src).encode(),
+                                       str(m.dst).encode()))
+            h.update(m.data)
+        else:
+            h.update(b"T|%d|%s|%s" % (event.timer_id,
+                                      str(event.address).encode(),
+                                      event.name.encode()))
+    return h.hexdigest()
+
+
+# --- cluster + lane builders -------------------------------------------------
+
+
+def _keys_for_zone(config, zone: int, n: int,
+                   exclude: tuple = ()) -> list:
+    """``n`` keys whose object groups are homed in ``zone`` (and not
+    in ``exclude``d groups)."""
+    keys: list = []
+    i = 0
+    while len(keys) < n:
+        key = b"obj-%d" % i
+        group = config.group_of_key(key)
+        if config.initial_home[group] == zone \
+                and group not in exclude:
+            keys.append(key)
+        i += 1
+    return keys
+
+
+def _wpaxos_cluster(seed: int, num_groups: int = 6,
+                    num_zones: int = 3, admission: bool = True):
+    from frankenpaxos_tpu.protocols.wpaxos import (
+        WPaxosClientOptions,
+        WPaxosLeaderOptions,
+    )
+    from tests.protocols.wpaxos_harness import make_wpaxos
+
+    regions = {f"r{z}": [f"zone-{z}"] for z in range(num_zones)}
+    topo = GeoTopology(regions, seed=seed)
+    sim = make_wpaxos(
+        num_zones=num_zones, row_width=3, num_groups=num_groups,
+        num_clients=num_zones, topology=topo, wal=True,
+        leader_options=WPaxosLeaderOptions(
+            **(ADMISSION if admission else {})),
+        client_options=WPaxosClientOptions(
+            resend_period_s=RESEND_PERIOD_S,
+            adaptive_timeouts=False,
+            retry_budget=RETRY_BUDGET,
+            reject_backoff=REJECT_BACKOFF),
+        seed=seed)
+    return sim, topo
+
+
+def _write_lane(name: str, client, keys: list, sessions: tuple,
+                workload: OpenLoopWorkload) -> TrafficLane:
+    def issue(client, pseudonym, payload, key_index, callback,
+              _keys=keys):
+        client.write(pseudonym, payload, callback,
+                     key=_keys[key_index % len(_keys)])
+
+    return TrafficLane(name, client, workload, sessions, issue)
+
+
+def _driver(sim, lanes, seed: int) -> GeoOverloadDriver:
+    return GeoOverloadDriver(
+        sim.transport, lanes, capacity_cmds_per_s=CAPACITY_CMDS_S,
+        msg_cost_s=MSG_COST_S, dt=DT_S,
+        slo_deadline_s=SLO_DEADLINE_S, seed=seed)
+
+
+def _finish_wpaxos(sim, topo, driver, scale: Scale) -> list:
+    """Heal every fault, settle, and run the safety oracle."""
+    topo.heal_all()
+    driver.settle(scale.settle_s)
+    return _wpaxos_safety(sim, driver.acked)
+
+
+def _recovery_s(driver, lane_index: int, t_repair: float):
+    """Virtual seconds from ``t_repair`` to the first completion on
+    ``lane_index`` at or after it; None if the lane never recovers."""
+    times = [t0 + lat for t0, lat, _, li in driver.completions
+             if li == lane_index and t0 + lat >= t_repair]
+    return min(times) - t_repair if times else None
+
+
+def _base_row(name: str, seed: int, scale: Scale, driver, transport,
+              t_measure: float, t_end: float, refused_control: list,
+              violations: list, t_wall: float) -> dict:
+    stats = driver.stats(t_measure, t_end, t_end - t_measure)
+    return {
+        "scenario": name,
+        "seed": seed,
+        "scale": scale.name,
+        "virtual_seconds": round(transport.now, 2),
+        "wall_seconds": round(time.perf_counter() - t_wall, 1),
+        "stats": stats,
+        "safety": {
+            "violations": violations,
+            "acked_writes": len(driver.acked),
+            "giveups": driver.giveups,
+            "control_frames_refused": len(refused_control),
+        },
+        "history_sha256": history_digest(transport),
+    }
+
+
+def _quantiles(driver, lanes: set, lo: float, hi: float):
+    """(p99, p999) of ADMITTED completion latencies over ``lanes``
+    issued in [lo, hi) -- the population each scenario's latency
+    ceilings gate (the lanes the fault should NOT have touched; the
+    affected lane is gated by its own recovery/loudness clauses)."""
+    lats = sorted(lat for t0, lat, first, li in driver.completions
+                  if li in lanes and first and lo <= t0 < hi)
+    if not lats:
+        return None, None
+    return (lats[min(len(lats) - 1, int(0.99 * len(lats)))],
+            lats[min(len(lats) - 1, int(0.999 * len(lats)))])
+
+
+def _common_clauses(row: dict, *, goodput_floor: float,
+                    p99_s, p99_ceiling_s: float,
+                    p999_s, p999_ceiling_s: float) -> dict:
+    stats = row["stats"]
+    safety = row["safety"]
+    return {
+        "goodput_floor": clause(stats["goodput_cmds_per_s"],
+                                goodput_floor, "min"),
+        "p99_admitted_ceiling_s": clause(p99_s, p99_ceiling_s),
+        "p999_admitted_ceiling_s": clause(p999_s, p999_ceiling_s),
+        "zero_acked_write_loss": clause(
+            len(safety["violations"]), 0, "zero"),
+        "control_plane_never_shed": clause(
+            safety["control_frames_refused"], 0, "zero"),
+        "no_silent_wedge": clause(stats["pending_after_settle"], 0,
+                                  "zero"),
+    }
+
+
+def _seal(row: dict, clauses: dict) -> dict:
+    row["slo"] = clauses
+    row["gate_passed"] = all(c["passed"] for c in clauses.values())
+    return row
+
+
+# --- scenario 1: zone outage during the regional peak ------------------------
+
+
+def scenario_zone_outage_peak(seed: int, scale: Scale) -> dict:
+    """SIGKILL zone 0 (leader + acceptor row + replica) exactly at its
+    diurnal maximum, dwell, relaunch the acceptors from their WALs
+    (leader/replica restart amnesiac), and let client failover + the
+    fresh-ballot steal discipline repair ownership -- under sustained
+    global load, with admission holding the surviving zones' p99."""
+    from tests.protocols.wpaxos_harness import crash_zone, restart_zone
+
+    t_wall = time.perf_counter()
+    sim, topo = _wpaxos_cluster(seed, num_groups=6)
+    period = scale.duration_s
+    warm = 1.0
+    lanes = []
+    n = scale.sessions_per_lane
+    for z in range(3):
+        keys = _keys_for_zone(sim.config, z, 24)
+        # Zone 0 carries the diurnal swing; the other regions run
+        # flat -- the "regional peak" shape. The phase shifts the
+        # ramp by the warm-up so the maximum lands EXACTLY at
+        # t_kill = warm + period/4 (the scenario's contract).
+        workload = OpenLoopWorkload(
+            rate=scale.per_zone_rate, zipf_s=1.1, num_keys=len(keys),
+            diurnal_amplitude=0.8 if z == 0 else 0.0,
+            diurnal_period_s=period, diurnal_phase_s=-warm)
+        lanes.append(_write_lane(f"zone-{z}", sim.clients[z], keys,
+                                 (z * n, (z + 1) * n), workload))
+    driver = _driver(sim, lanes, seed)
+    refused = _arm_control_oracle(sim.transport)
+
+    driver.run_for(warm)
+    t_measure = sim.transport.now
+    driver.run_for(period / 4)  # climb to zone 0's peak
+    t_kill = sim.transport.now
+    crash_zone(sim, 0)
+    driver.run_for(scale.outage_dwell_s)
+    t_restart = sim.transport.now
+    restart_zone(sim, 0)
+    driver.run_for(t_measure + scale.duration_s - sim.transport.now)
+    t_end = sim.transport.now
+    violations = _finish_wpaxos(sim, topo, driver, scale)
+
+    row = _base_row("zone_outage_peak", seed, scale, driver,
+                    sim.transport, t_measure, t_end, refused,
+                    violations, t_wall)
+    recovery = _recovery_s(driver, 0, t_restart)
+    row["events"] = {
+        "t_kill": round(t_kill, 2),
+        "t_restart": round(t_restart, 2),
+        "outage_dwell_s": scale.outage_dwell_s,
+        "recovery_after_relaunch_s":
+            round(recovery, 3) if recovery is not None else None,
+    }
+    offered = 3 * scale.per_zone_rate  # diurnal mean == base rate
+    # The latency ceilings gate the SURVIVING zones: admission holds
+    # their p99 while a third of the fleet is down; the dead zone's
+    # lane is gated by recovery + the goodput floor + loud-conclusion
+    # clauses instead (its in-outage completions are outage-shaped by
+    # definition).
+    p99, p999 = _quantiles(driver, {1, 2}, t_measure, t_end)
+    clauses = _common_clauses(
+        row, goodput_floor=0.55 * offered,
+        p99_s=p99, p99_ceiling_s=0.15,
+        p999_s=p999, p999_ceiling_s=0.4)
+    clauses["bounded_recovery_s"] = clause(recovery, 6.0)
+    return _seal(row, clauses)
+
+
+# --- scenario 2: cross-region partition with SLO-gated degradation -----------
+
+
+def scenario_region_partition(seed: int, scale: Scale) -> dict:
+    """Cut region r2 off from r0+r1 mid-window. The majority side
+    keeps committing zone-locally within SLO (WPaxos Phase2 never
+    leaves the home row); the minority's cross-region traffic sheds
+    LOUDLY -- timeouts walk the bounded retry budget into
+    RETRY_EXHAUSTED, steals block safely on the unreachable rows, the
+    client-lane queue stays bounded -- and the heal completes the
+    parked steals without duplicate execution."""
+    t_wall = time.perf_counter()
+    sim, topo = _wpaxos_cluster(seed, num_groups=6)
+    n = scale.sessions_per_lane
+    lanes = []
+    for z in range(2):  # the majority side: zone-local traffic
+        keys = _keys_for_zone(sim.config, z, 24)
+        lanes.append(_write_lane(
+            f"zone-{z}", sim.clients[z], keys, (z * n, (z + 1) * n),
+            OpenLoopWorkload(rate=scale.per_zone_rate, zipf_s=1.1,
+                             num_keys=len(keys))))
+    # The minority lane drives objects homed ACROSS the partition
+    # (zone 0): the cross-region dependence that must degrade loudly.
+    keys0 = _keys_for_zone(sim.config, 0, 24)
+    lanes.append(_write_lane(
+        "zone-2-remote", sim.clients[2], keys0, (2 * n, 3 * n),
+        OpenLoopWorkload(rate=scale.per_zone_rate, zipf_s=1.1,
+                         num_keys=len(keys0))))
+    driver = _driver(sim, lanes, seed)
+    refused = _arm_control_oracle(sim.transport)
+
+    warm = 1.0
+    driver.run_for(warm)
+    t_measure = sim.transport.now
+    # 20% healthy / 60% partitioned / 20% healed: the partition must
+    # outlive the client retry walk (~4s) so budgets visibly exhaust.
+    driver.run_for(0.2 * scale.duration_s)
+    t_cut = sim.transport.now
+    topo.partition_regions("r2", "r0")
+    topo.partition_regions("r2", "r1")
+    driver.run_for(0.6 * scale.duration_s)
+    t_heal = sim.transport.now
+    topo.heal_regions("r2", "r0")
+    topo.heal_regions("r2", "r1")
+    driver.run_for(0.2 * scale.duration_s)
+    t_end = sim.transport.now
+    violations = _finish_wpaxos(sim, topo, driver, scale)
+
+    row = _base_row("region_partition", seed, scale, driver,
+                    sim.transport, t_measure, t_end, refused,
+                    violations, t_wall)
+    recovery = _recovery_s(driver, 2, t_heal)
+    # Majority-side admitted p99 measured over the PARTITION window
+    # only -- the clause is "the majority never noticed".
+    majority = [lat for t0, lat, first, li in driver.completions
+                if li < 2 and first and t_cut <= t0 < t_heal]
+    majority.sort()
+    majority_p99 = (majority[int(0.99 * (len(majority) - 1))]
+                    if majority else None)
+    row["events"] = {
+        "t_cut": round(t_cut, 2),
+        "t_heal": round(t_heal, 2),
+        "minority_giveups": driver.giveups,
+        "recovery_after_heal_s":
+            round(recovery, 3) if recovery is not None else None,
+    }
+    offered_majority = 2 * scale.per_zone_rate
+    # Ceilings gate the MAJORITY side up to the heal (post-heal the
+    # minority's parked steal completes and ownership legitimately
+    # migrates -- zone 0's lane then pays the WAN to the new owner,
+    # which is routing policy, not an SLO violation).
+    p99, p999 = _quantiles(driver, {0, 1}, t_measure, t_heal)
+    clauses = _common_clauses(
+        row, goodput_floor=0.75 * offered_majority,
+        p99_s=p99, p99_ceiling_s=0.1,
+        p999_s=p999, p999_ceiling_s=0.3)
+    clauses["majority_p99_during_partition_s"] = clause(
+        majority_p99, 0.1)
+    # Loud, bounded degradation: the minority concluded un-servable
+    # ops explicitly (bounded-retry exhaustion), and queues never
+    # grew silently.
+    clauses["minority_sheds_loudly"] = clause(
+        driver.giveups, 1, "min")
+    clauses["queue_depth_bounded"] = clause(
+        driver.max_queue_depth, 80 * scale.per_zone_rate)
+    clauses["bounded_recovery_s"] = clause(recovery, 6.0)
+    return _seal(row, clauses)
+
+
+# --- scenario 3: follow-the-sun ----------------------------------------------
+
+
+def scenario_follow_the_sun(seed: int, scale: Scale) -> dict:
+    """One diurnal day split across three regions: each zone's lane
+    runs the same ramp phase-shifted a third of a period, and a
+    deterministic placement controller steals the shared "sun" object
+    groups to whichever region is hottest -- WPaxos's locality
+    argument as a gated scenario: the hot region's commits are
+    zone-local (sub-WAN-RTT p50) for the bulk of its shift."""
+    from frankenpaxos_tpu.protocols.wpaxos.messages import Steal
+
+    t_wall = time.perf_counter()
+    sim, topo = _wpaxos_cluster(seed, num_groups=6)
+    period = scale.duration_s
+    warm = 1.0
+    # The sun keys: objects every region serves in its shift
+    # (initially homed in zone 0; the controller re-homes them).
+    sun_keys = _keys_for_zone(sim.config, 0, 24)
+    sun_groups = sorted({sim.config.group_of_key(k) for k in sun_keys})
+    n = scale.sessions_per_lane
+    lanes = []
+    for z in range(3):
+        # Zone z's shift peaks at t = warm + (z + 0.5) * period / 3:
+        # sin peaks when (t + phase) = period/4 (mod period). `warm`
+        # appears here because measurement windows are computed from
+        # t_measure = warm -- the phases must track it.
+        phase = period / 4 - (warm + (z + 0.5) * period / 3)
+        lanes.append(_write_lane(
+            f"zone-{z}", sim.clients[z], sun_keys,
+            (z * n, (z + 1) * n),
+            OpenLoopWorkload(rate=scale.per_zone_rate, zipf_s=1.1,
+                             num_keys=len(sun_keys),
+                             diurnal_amplitude=0.9,
+                             diurnal_period_s=period,
+                             diurnal_phase_s=phase)))
+    driver = _driver(sim, lanes, seed)
+    refused = _arm_control_oracle(sim.transport)
+
+    driver.run_for(warm)
+    t_measure = sim.transport.now
+    t_end_target = t_measure + period
+    hot_zone = -1
+    steal_count = 0
+    while sim.transport.now < t_end_target - 1e-9:
+        shift = int(((sim.transport.now - t_measure) / period) * 3)
+        shift = min(shift, 2)
+        if shift != hot_zone:
+            hot_zone = shift
+            for group in sun_groups:
+                if group not in sim.leaders[hot_zone].active:
+                    sim.leaders[hot_zone].receive(
+                        "sun-controller", Steal(group))
+                    steal_count += 1
+        driver.tick()
+    t_end = sim.transport.now
+    violations = _finish_wpaxos(sim, topo, driver, scale)
+
+    row = _base_row("follow_the_sun", seed, scale, driver,
+                    sim.transport, t_measure, t_end, refused,
+                    violations, t_wall)
+    # Per-shift hot-lane locality: admitted completions of zone z's
+    # lane issued in the second half of z's shift (the first half
+    # absorbs the steal + client rerouting).
+    wan = topo.wan_rtt()
+    shift_p50 = {}
+    for z in range(3):
+        lo = t_measure + (z + 0.5) * period / 3
+        hi = t_measure + (z + 1) * period / 3
+        lats = sorted(lat for t0, lat, first, li in driver.completions
+                      if li == z and first and lo <= t0 < hi)
+        shift_p50[f"zone-{z}"] = (
+            round(lats[len(lats) // 2], 4) if lats else None)
+    row["events"] = {
+        "sun_groups": sun_groups,
+        "controller_steals": steal_count,
+        "hot_shift_p50_s": shift_p50,
+        "wan_rtt_s": wan,
+    }
+    offered = 3 * scale.per_zone_rate  # phase-shifted ramps sum flat
+    # Every lane here is sometimes-hot and sometimes-remote (there is
+    # no untouched lane to gate tightly): the latency ceilings bind
+    # the whole population to the serving deadline -- migration
+    # windows may queue remote traffic, but never silently past SLO
+    # scale (the goodput floor holds the in-SLO mass up).
+    p99, p999 = _quantiles(driver, {0, 1, 2}, t_measure, t_end)
+    clauses = _common_clauses(
+        row, goodput_floor=0.6 * offered,
+        p99_s=p99, p99_ceiling_s=SLO_DEADLINE_S,
+        p999_s=p999, p999_ceiling_s=2 * SLO_DEADLINE_S)
+    worst = (None if any(v is None for v in shift_p50.values())
+             else max(shift_p50.values()))
+    clauses["hot_region_p50_below_quarter_wan_rtt"] = clause(
+        worst, 0.25 * wan)
+    return _seal(row, clauses)
+
+
+# --- scenario 4: Zipf hot objects contended from two continents --------------
+
+
+def scenario_hot_contention(seed: int, scale: Scale) -> dict:
+    """Zones 0 and 2 (different continents) both hammer one Zipf-hot
+    object set while their placement controllers tug the groups back
+    and forth on a fixed cadence; zone 1 serves cold objects in
+    disjoint groups. The PR 9 nacked-steal backoff keeps the duel
+    bounded -- every steal completes in ~1 WAN RTT instead of
+    livelocking -- and the cold lane never notices."""
+    from frankenpaxos_tpu.protocols.wpaxos.messages import Steal
+
+    t_wall = time.perf_counter()
+    sim, topo = _wpaxos_cluster(seed, num_groups=9)
+    # Hot objects live in two zone-1-homed groups; cold traffic uses
+    # zone 1's OTHER groups, so the two interfere only through shared
+    # infrastructure (leader event loops, acceptor rows) -- exactly
+    # what the "cold objects unaffected" clause measures.
+    zone1_groups = [g for g in range(9)
+                    if sim.config.initial_home[g] == 1]
+    hot_groups = zone1_groups[:2]
+    hot_keys = []
+    i = 0
+    while len(hot_keys) < 16:
+        key = b"hot-%d" % i
+        if sim.config.group_of_key(key) in hot_groups:
+            hot_keys.append(key)
+        i += 1
+    cold_keys = _keys_for_zone(sim.config, 1, 24,
+                               exclude=tuple(hot_groups))
+    n = scale.sessions_per_lane
+    lanes = [
+        _write_lane("continent-0", sim.clients[0], hot_keys, (0, n),
+                    OpenLoopWorkload(rate=scale.per_zone_rate,
+                                     zipf_s=1.2,
+                                     num_keys=len(hot_keys))),
+        _write_lane("cold", sim.clients[1], cold_keys, (n, 2 * n),
+                    OpenLoopWorkload(rate=scale.per_zone_rate,
+                                     zipf_s=1.1,
+                                     num_keys=len(cold_keys))),
+        _write_lane("continent-2", sim.clients[2], hot_keys,
+                    (2 * n, 3 * n),
+                    OpenLoopWorkload(rate=scale.per_zone_rate,
+                                     zipf_s=1.2,
+                                     num_keys=len(hot_keys))),
+    ]
+    driver = _driver(sim, lanes, seed)
+    refused = _arm_control_oracle(sim.transport)
+
+    warm = 1.0
+    steal_period = 1.5
+    driver.run_for(warm)
+    t_measure = sim.transport.now
+    t_end_target = t_measure + scale.duration_s
+    next_steal = {0: t_measure + steal_period / 2,
+                  2: t_measure + steal_period}
+    while sim.transport.now < t_end_target - 1e-9:
+        for zone, due in next_steal.items():
+            if sim.transport.now >= due:
+                for group in hot_groups:
+                    if group not in sim.leaders[zone].active:
+                        sim.leaders[zone].receive(
+                            "placement-controller", Steal(group))
+                next_steal[zone] = due + steal_period
+        driver.tick()
+    t_end = sim.transport.now
+    violations = _finish_wpaxos(sim, topo, driver, scale)
+
+    row = _base_row("hot_contention", seed, scale, driver,
+                    sim.transport, t_measure, t_end, refused,
+                    violations, t_wall)
+    wan = topo.wan_rtt()
+    events = [e for leader in sim.leaders
+              for e in leader.steal_events
+              if e["group"] in hot_groups and "active_s" in e]
+    steal_latencies = sorted(e["active_s"] - e["started_s"]
+                             for e in events)
+    # The ping-pong bound: at most one completed steal per group per
+    # controller firing (plus bootstrap) -- a duel that re-escalated
+    # without the backoff would multiply this.
+    firings = 2 * int(scale.duration_s / steal_period + 1)
+    steal_bound = len(hot_groups) * (firings + 2)
+    row["events"] = {
+        "hot_groups": hot_groups,
+        "completed_steals": len(events),
+        "steal_bound": steal_bound,
+        "steal_p50_s": (round(steal_latencies[len(steal_latencies)
+                                              // 2], 4)
+                        if steal_latencies else None),
+        "wan_rtt_s": wan,
+    }
+    offered = 3 * scale.per_zone_rate
+    # The latency ceilings gate the COLD lane: hot-object contention
+    # may not leak into disjoint groups through shared leaders/rows.
+    p99, p999 = _quantiles(driver, {1}, t_measure, t_end)
+    clauses = _common_clauses(
+        row, goodput_floor=0.6 * offered,
+        p99_s=p99, p99_ceiling_s=0.1,
+        p999_s=p999, p999_ceiling_s=0.3)
+    clauses["steal_ping_pong_bounded"] = clause(len(events),
+                                                steal_bound)
+    clauses["steal_p50_within_3_wan_rtt"] = clause(
+        row["events"]["steal_p50_s"], 3 * wan)
+    return _seal(row, clauses)
+
+
+# --- scenario 5: cloud pathologies (fsync stalls) ----------------------------
+
+
+def scenario_fsync_stalls(seed: int, scale: Scale) -> dict:
+    """Deterministic WAL fsync stalls on two of zone 0's three
+    acceptors (wal/faults.py). The two cadences are chosen so the
+    fault schedule separates the two phenomena: acceptor 0 stalls
+    often (every 40th group commit) but ALONE -- the row quorum masks
+    every one of them (commit = 2nd-fastest ack), so the common case
+    never sees storage jitter; acceptor 1's cadence is a multiple
+    (every 200th), so each of its stalls OVERLAPS one of acceptor
+    0's -- the only drains where a quorum must include a stalled
+    fsync -- and exactly those reach the client tail: the "Paxos in
+    the Cloud" p999 amplification, reproduced on schedule, with group
+    commit + admission keeping it bounded. A fault-off arm (same
+    seed) pins the amplification factor."""
+    rows = {}
+    for arm in ("fault_off", "fault_on"):
+        t_wall = time.perf_counter()
+        sim, topo = _wpaxos_cluster(seed, num_groups=6)
+        stall_log: dict = {}
+        if arm == "fault_on":
+            transport = sim.transport
+            for idx, every in ((0, 40), (1, 200)):
+                acceptor = sim.acceptors[idx]  # zone 0's row
+                assert acceptor.zone == 0
+                from frankenpaxos_tpu.wal import FsyncStallStorage
+
+                address = acceptor.address
+
+                def bridge(stall_s, _a=address):
+                    transport.stall_sender(
+                        _a, transport.now + stall_s)
+
+                wrapped = FsyncStallStorage(
+                    acceptor.wal.storage, seed=seed,
+                    label=str(address), stall_every=every,
+                    stall_s=0.1, on_stall=bridge)
+                acceptor.wal.storage = wrapped
+                sim.wal_storages[address] = wrapped
+                stall_log[str(address)] = wrapped
+        n = scale.sessions_per_lane
+        lanes = []
+        for z in range(3):
+            keys = _keys_for_zone(sim.config, z, 24)
+            lanes.append(_write_lane(
+                f"zone-{z}", sim.clients[z], keys,
+                (z * n, (z + 1) * n),
+                OpenLoopWorkload(rate=scale.per_zone_rate,
+                                 zipf_s=1.1, num_keys=len(keys))))
+        driver = _driver(sim, lanes, seed)
+        refused = _arm_control_oracle(sim.transport)
+        warm = 1.0
+        driver.run_for(warm)
+        t_measure = sim.transport.now
+        driver.run_for(scale.duration_s)
+        t_end = sim.transport.now
+        violations = _finish_wpaxos(sim, topo, driver, scale)
+        row = _base_row(f"fsync_stalls/{arm}", seed, scale, driver,
+                        sim.transport, t_measure, t_end, refused,
+                        violations, t_wall)
+        row["_completions"] = driver.completions
+        row["events"] = {
+            "stalls_injected": {a: {"count": len(s.stalls),
+                                    "total_s": round(sum(s.stalls), 3)}
+                                for a, s in stall_log.items()},
+        }
+        rows[arm] = row
+
+    on, off = rows["fault_on"], rows["fault_off"]
+    zone0_on = on["stats"]["lanes"]["zone-0"]
+    zone0_off = off["stats"]["lanes"]["zone-0"]
+    p999_on = zone0_on["p999_admitted_s"]
+    p999_off = zone0_off["p999_admitted_s"]
+    # Fraction of the faulted zone's admitted completions slower than
+    # a stall could make a MASKED commit (2nd-fastest ack clean): if
+    # single stalls leaked past the quorum this would sit at acceptor
+    # 0's stall duty cycle (~5x the bound).
+    zone0 = [lat for _, lat, first, li in on["_completions"]
+             if li == 0 and first]
+    affected = (sum(1 for lat in zone0 if lat > 0.04) / len(zone0)
+                if zone0 else None)
+    del on["_completions"], off["_completions"]
+    on["events"]["fault_off_p999_s"] = p999_off
+    on["events"]["zone0_affected_fraction"] = (
+        round(affected, 4) if affected is not None else None)
+    amplification = (round(p999_on / p999_off, 2)
+                     if p999_on is not None and p999_off else None)
+    on["events"]["p999_amplification"] = amplification
+    offered = 3 * scale.per_zone_rate
+    clauses = _common_clauses(
+        on, goodput_floor=0.8 * offered,
+        p99_s=on["stats"]["p99_admitted_s"], p99_ceiling_s=0.1,
+        p999_s=on["stats"]["p999_admitted_s"], p999_ceiling_s=0.3)
+    # Quorum masking: acceptor 0 is inside a stall ~14% of the time
+    # (0.1s every 40 group commits at the zone's drain rate), but
+    # only overlap-affected commits -- the deliberate ~3% -- are
+    # slow. If single stalls leaked past the row quorum this would
+    # sit at the full duty cycle, ~3x the bound.
+    clauses["quorum_masks_single_stalls"] = clause(affected, 0.05)
+    # And the pathology actually REPRODUCES: the overlap tail is an
+    # order of magnitude over the clean arm's p999 (else the fault
+    # hook silently stopped injecting).
+    clauses["p999_amplified_vs_fault_off"] = clause(
+        amplification, 3.0, "min")
+    on["fault_off_row"] = {
+        k: off[k] for k in ("stats", "safety", "history_sha256")}
+    return _seal(on, clauses)
+
+
+# --- scenario 6: geo read scaling (WPaxos writes + CRAQ reads) ---------------
+
+
+def scenario_craq_read_scaling(seed: int, scale: Scale) -> dict:
+    """The headline global-serving read path: a CRAQ chain with one
+    node per zone serves ZONE-LOCAL reads under the same admission /
+    client-lane / Rejected-backoff discipline as the write paths.
+    Clean reads never leave the zone (p50/p99 local); only the dirty
+    tail pays the apportioned-queries forward to the (WAN) tail node.
+    An audit write lane with per-session keys carries the zero-
+    acked-write-loss clause; a dirty write lane keeps a sliver of the
+    read keyspace in flight so the forward path is actually
+    exercised."""
+    from frankenpaxos_tpu.protocols.craq import (
+        ChainNode,
+        CraqClient,
+        CraqConfig,
+    )
+    from frankenpaxos_tpu.geo import GeoSimTransport
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.serve.admission import AdmissionOptions
+
+    t_wall = time.perf_counter()
+    regions = {f"r{z}": [f"zone-{z}"] for z in range(3)}
+    topo = GeoTopology(regions, seed=seed)
+    logger = FakeLogger(LogLevel.FATAL)
+    transport = GeoSimTransport(topo, logger)
+    config = CraqConfig(chain_node_addresses=tuple(
+        f"chain-{z}" for z in range(3)))
+    # The per-node token bucket sits just above the steady per-zone
+    # read rate, so Poisson bursts actually exercise the read path's
+    # Rejected -> jittered-backoff -> retry discipline inside the
+    # committed run (not only in unit tests).
+    node_admission = AdmissionOptions(
+        token_rate=3.2 * scale.per_zone_rate, token_burst=25.0,
+        inbox_capacity=512, inbox_policy="reject",
+        retry_after_ms=100)
+    nodes = []
+    for z, address in enumerate(config.chain_node_addresses):
+        topo.place(address, f"zone-{z}")
+        nodes.append(ChainNode(address, transport, logger, config,
+                               resend_period_s=0.5,
+                               admission=node_admission))
+    clients = []
+    for z in range(3):
+        address = f"client-{z}"
+        topo.place(address, f"zone-{z}")
+        clients.append(CraqClient(
+            address, transport, logger, config, resend_period_s=1.0,
+            seed=seed + z, retry_budget=8, backoff=REJECT_BACKOFF,
+            read_node=z))
+
+    read_keys = 256
+    n = scale.sessions_per_lane
+    lanes = []
+    for z in range(3):
+        def read_issue(client, pseudonym, payload, key_index,
+                       callback):
+            client.read(pseudonym, "r%d" % key_index, callback)
+
+        lanes.append(TrafficLane(
+            f"reads-zone-{z}", clients[z],
+            OpenLoopWorkload(rate=3 * scale.per_zone_rate,
+                             zipf_s=1.1, num_keys=read_keys),
+            (z * n, (z + 1) * n), read_issue, record_acked=False))
+
+    def audit_write_issue(client, pseudonym, payload, key_index,
+                          callback):
+        client.write(pseudonym, "w%d" % pseudonym, payload.decode(),
+                     lambda result=None: callback(result))
+
+    def dirty_write_issue(client, pseudonym, payload, key_index,
+                          callback):
+        client.write(pseudonym, "r%d" % (key_index % read_keys),
+                     payload.decode(),
+                     lambda result=None: callback(result))
+
+    lanes.append(TrafficLane(
+        "writes-audit", clients[0],
+        OpenLoopWorkload(rate=0.2 * scale.per_zone_rate,
+                         num_keys=read_keys),
+        (3 * n, 4 * n), audit_write_issue))
+    lanes.append(TrafficLane(
+        "writes-dirty", clients[1],
+        OpenLoopWorkload(rate=0.15 * scale.per_zone_rate,
+                         num_keys=read_keys),
+        (4 * n, 5 * n), dirty_write_issue, record_acked=False))
+
+    driver = GeoOverloadDriver(
+        transport, lanes, capacity_cmds_per_s=2 * CAPACITY_CMDS_S,
+        msg_cost_s=MSG_COST_S, dt=DT_S,
+        slo_deadline_s=SLO_DEADLINE_S, seed=seed)
+    refused = _arm_control_oracle(transport)
+
+    warm = 1.0
+    driver.run_for(warm)
+    t_measure = transport.now
+    driver.run_for(scale.duration_s)
+    t_end = transport.now
+    driver.settle(scale.settle_s)
+
+    # Safety: per-session audit keys -- the tail's committed value for
+    # each session must be at least as new as its LAST ACKED write
+    # (chain seq + head dedup make per-session versions monotone).
+    violations: list = []
+    tail = nodes[-1]
+    last_acked: dict[int, int] = {}
+    for payload in driver.acked:
+        parts = payload.decode().split(".")
+        session = int(parts[1][1:])
+        op = int(parts[2])
+        last_acked[session] = max(last_acked.get(session, -1), op)
+    for session, op in last_acked.items():
+        value = tail.state_machine.get("w%d" % session)
+        got = int(value.split(".")[2]) if value else -1
+        if got < op:
+            violations.append(
+                f"acked write lost: session {session} acked op {op}, "
+                f"tail has {value!r}")
+    rejected = sum(
+        sum(node.admission.rejected.values())
+        for node in nodes if node.admission is not None)
+
+    row = _base_row("craq_read_scaling", seed, scale, driver,
+                    transport, t_measure, t_end, refused, violations,
+                    t_wall)
+    wan = topo.wan_rtt()
+    row["events"] = {
+        "wan_rtt_s": wan,
+        "chain": [str(a) for a in config.chain_node_addresses],
+        "admission_rejected": rejected,
+        "client_giveups": driver.giveups,
+    }
+    offered = 3 * 3 * scale.per_zone_rate + 0.35 * scale.per_zone_rate
+    # The ceilings gate the READ lanes: clean reads stay zone-local
+    # (p99 well under a WAN round trip); only the dirty tail pays the
+    # apportioned-queries forward to the (WAN) tail -- bounded by ~1
+    # WAN RTT + chain service, not SLO collapse.
+    p99, p999 = _quantiles(driver, {0, 1, 2}, t_measure, t_end)
+    clauses = _common_clauses(
+        row, goodput_floor=0.7 * offered,
+        p99_s=p99, p99_ceiling_s=0.25 * wan,
+        p999_s=p999, p999_ceiling_s=2 * wan)
+    # Writes walk the whole chain: head -> mid -> tail is two
+    # cross-region hops one way, plus the tail's cross-region reply
+    # -- ~1.5 WAN RTTs end to end before jitter and in-order batch
+    # queueing.
+    wp99, _ = _quantiles(driver, {3, 4}, t_measure, t_end)
+    clauses["chain_write_p99_s"] = clause(wp99, 2.5 * wan)
+    return _seal(row, clauses)
+
+
+# --- the matrix --------------------------------------------------------------
+
+
+SCENARIOS = (
+    ("zone_outage_peak", scenario_zone_outage_peak),
+    ("region_partition", scenario_region_partition),
+    ("follow_the_sun", scenario_follow_the_sun),
+    ("hot_contention", scenario_hot_contention),
+    ("fsync_stalls", scenario_fsync_stalls),
+    ("craq_read_scaling", scenario_craq_read_scaling),
+)
+
+
+def run_scenario(name: str, seed: int = 0,
+                 scale: Scale = SMOKE) -> dict:
+    for candidate, fn in SCENARIOS:
+        if candidate == name:
+            return fn(seed, scale)
+    raise ValueError(f"unknown scenario {name!r}; "
+                     f"known: {[n for n, _ in SCENARIOS]}")
+
+
+def run_matrix(seed: int = 0, scale: Scale = FULL,
+               only: str | None = None) -> dict:
+    rows = []
+    for name, fn in SCENARIOS:
+        if only and only not in name:
+            continue
+        rows.append(fn(seed, scale))
+    return {
+        "seed": seed,
+        "scale": scale.name,
+        "model": {
+            "capacity_cmds_per_s": CAPACITY_CMDS_S,
+            "msg_cost_s": MSG_COST_S,
+            "dt_s": DT_S,
+            "slo_deadline_s": SLO_DEADLINE_S,
+            "sessions_per_lane": scale.sessions_per_lane,
+            "per_zone_rate": scale.per_zone_rate,
+            "admission_knobs": ADMISSION,
+            "client_retry_budget": RETRY_BUDGET,
+        },
+        "rows": rows,
+        "gate_passed": all(r["gate_passed"] for r in rows),
+    }

@@ -62,20 +62,42 @@ CLIENT_LANE_TYPE_NAMES = frozenset({
     "ClientFrameBatch",
 })
 
-_cache: tuple[int, frozenset] | None = None
+#: Client-lane membership by EXPLICIT wire tag, for client-edge
+#: shapes whose names are too generic to claim globally (paxworld:
+#: CRAQ's bare Write/201 and Read/202 -- adding "Write"/"Read" to the
+#: name set would silently make ANY future protocol's same-named
+#: replication message sheddable). The chain's own hops (WriteBatch,
+#: Ack, TailRead) stay control lane: a shed mid-chain hop would wedge
+#: the chain, and it is not client-originated load anyway.
+CLIENT_LANE_EXTRA_TAGS = frozenset({201, 202})
+
+_cache: tuple[int, frozenset, frozenset] | None = None
 
 
-def client_lane_tags() -> frozenset:
-    """Wire tags currently registered for client-lane types. Cached
-    against the registry size (codecs register at protocol import and
-    never unregister)."""
+def _lane_cache() -> tuple:
+    """(registered client-lane tags, extra-tag message TYPES) --
+    cached against the registry size (codecs register at protocol
+    import and never unregister). Both classifiers read this one
+    cache so the frame-level and message-level verdicts can never
+    disagree."""
     global _cache
     registry = serializer._CODECS_BY_TAG
     if _cache is None or _cache[0] != len(registry):
-        _cache = (len(registry), frozenset(
+        tags = frozenset(
             tag for tag, codec in registry.items()
-            if codec.message_type.__name__ in CLIENT_LANE_TYPE_NAMES))
-    return _cache[1]
+            if codec.message_type.__name__ in CLIENT_LANE_TYPE_NAMES) \
+            | (CLIENT_LANE_EXTRA_TAGS & frozenset(registry))
+        extra_types = frozenset(
+            registry[tag].message_type
+            for tag in CLIENT_LANE_EXTRA_TAGS if tag in registry)
+        _cache = (len(registry), tags, extra_types)
+    return _cache
+
+
+def client_lane_tags() -> frozenset:
+    """Wire tags currently registered for client-lane types (names
+    plus the explicit-tag members)."""
+    return _lane_cache()[1]
 
 
 def frame_lane(data: bytes) -> int:
@@ -94,7 +116,9 @@ def frame_lane(data: bytes) -> int:
 
 
 def message_lane(message) -> int:
-    """The lane of a DECODED message (role-level admission sites)."""
-    return (LANE_CLIENT
-            if type(message).__name__ in CLIENT_LANE_TYPE_NAMES
+    """The lane of a DECODED message (role-level admission sites);
+    agrees with :func:`frame_lane` by construction (one cache)."""
+    if type(message).__name__ in CLIENT_LANE_TYPE_NAMES:
+        return LANE_CLIENT
+    return (LANE_CLIENT if type(message) in _lane_cache()[2]
             else LANE_CONTROL)

@@ -25,6 +25,8 @@ behave as deployed.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
@@ -103,33 +105,10 @@ class SimOverloadDriver:
         arm is deterministic and rate limits mean virtual rates."""
         clock = lambda: self.now  # noqa: E731
 
-        for actor in self.sim.transport.actors.values():
-            admission = actor.admission
-            if admission is not None:
-                admission.clock = clock
-                if admission.bucket is not None:
-                    admission.bucket.clock = clock
-                    admission.bucket._last = 0.0
+        bind_virtual_clocks(self.sim.transport.actors.values(), clock)
 
     def _hook_rejections(self) -> None:
-        """Mark sessions whose current op got a ``Rejected`` (wrapping
-        the client's handler): their completion latency is dominated
-        by client-side backoff sleeps, so the SLO gate's
-        "admitted-request p99" excludes them (they still count for
-        goodput when they finish inside the deadline, and for the
-        giveup accounting when they exhaust the budget)."""
-        sessions = self.sessions
-        for client in self.sim.clients:
-            original = client._handle_rejected
-
-            def wrapped(*args, _original=original):
-                rejected = args[-1]
-                for pseudonym, _client_id in rejected.entries:
-                    if pseudonym < sessions.n:
-                        sessions.rejected_once[pseudonym] = 1
-                return _original(*args)
-
-            client._handle_rejected = wrapped
+        hook_rejections(self.sim.clients, self.sessions)
 
     def _pump_timers(self) -> None:
         """Fire running sim timers on virtual deadlines: a timer first
@@ -204,31 +183,40 @@ class SimOverloadDriver:
         """Spend the tick's CPU budget delivering messages in
         coalesced waves: ``msg_cost_s`` per delivery plus
         ``1/capacity`` per command completion. Whatever the budget
-        cannot cover stays queued -- THE queue overload builds."""
+        cannot cover stays queued -- THE queue overload builds.
+
+        paxworld: delivery rides the paxsim wave engine
+        (``deliver_all_coalesced`` -> ``_run_wave`` ->
+        ``Actor.receive_batch``) instead of a per-message
+        ``_deliver`` loop -- the budget sizes each wave up front and
+        the completion cost is settled after the wave, so the
+        1M-session study exercises the same batched delivery path
+        every other sim does. The wave is sized so even an
+        all-completions wave cannot overdraw the budget by more than
+        ~one frame's costs -- the same debt bound the legacy loop's
+        per-message ``budget <= 0`` break enforced (an uncapped
+        frame-cost-only wave could charge capacity-scale debt in one
+        shot and turn steady overload into serve-burst/dead-stretch
+        cycles)."""
         transport = self.sim.transport
         while self.budget > 0 and transport.messages:
-            wave = transport.messages[:4096]
-            touched: list = []
-            seen: set = set()
-            for message in wave:
-                if self.budget <= 0:
-                    break
-                # Only genuine completions cost server capacity; a
-                # giveup (RETRY_EXHAUSTED concluded inside a Rejected
-                # delivery) is client-local bookkeeping -- charging it
-                # cmd_cost would make SHEDDING as expensive as serving
-                # and spiral the budget into debt exactly when the
-                # edge is doing its job.
-                before = len(self.completions)
-                actor = transport._deliver(message)
-                after = len(self.completions)
-                self.budget -= self.msg_cost \
-                    + (after - before) * self.cmd_cost
-                if actor is not None and id(actor) not in seen:
-                    seen.add(id(actor))
-                    touched.append(actor)
-            for actor in touched:
-                transport._drain(actor)
+            # Only genuine completions cost server capacity; a giveup
+            # (RETRY_EXHAUSTED concluded inside a Rejected delivery)
+            # is client-local bookkeeping -- charging it cmd_cost
+            # would make SHEDDING as expensive as serving and spiral
+            # the budget into debt exactly when the edge is doing its
+            # job.
+            wave_cap = min(
+                4096,
+                max(1, int(self.budget / self.msg_cost)),
+                max(1, int(self.budget / self.cmd_cost) + 1))
+            before = len(self.completions)
+            delivered = transport.deliver_all_coalesced(
+                max_steps=wave_cap)
+            if delivered == 0:
+                break
+            self.budget -= delivered * self.msg_cost \
+                + (len(self.completions) - before) * self.cmd_cost
 
     def queue_depth(self) -> int:
         staged = sum(len(getattr(c, "_staged_writes", ()))
@@ -285,31 +273,358 @@ class SimOverloadDriver:
             "pending_after_settle": self.sessions.pending,
             "max_queue_depth": self.max_queue_depth,
         }
-        for q in (50, 99, 99.9):
-            suffix = str(q).replace(".", "")
-            stats[f"p{suffix}_latency_s"] = (
-                round(float(np.percentile(latencies, q)), 4)
-                if len(latencies) else None)
-            # The ADMITTED-request percentile: ops served on first
-            # admission, no client backoff in the number -- the
-            # latency the server actually delivered to admitted work
-            # (the ISSUE gate's p99).
-            stats[f"p{suffix}_admitted_s"] = (
-                round(float(np.percentile(admitted, q)), 4)
-                if len(admitted) else None)
+        # The ADMITTED-request percentiles: ops served on first
+        # admission, no client backoff in the number -- the latency
+        # the server actually delivered to admitted work (the ISSUE
+        # gate's p99).
+        stats.update(percentile_rows(latencies, admitted))
         stats["admission"] = self.admission_stats()
         return stats
 
     def admission_stats(self) -> dict:
-        out: dict = {"admitted": 0, "rejected": {}, "shed": {}}
-        for actor in self.sim.transport.actors.values():
-            admission = actor.admission
-            if admission is None:
+        return admission_stats(self.sim.transport)
+
+
+def bind_virtual_clocks(actors, clock) -> None:
+    """Point every attached admission controller's clock (token-bucket
+    refill, CoDel interval, shed expiry) at ``clock`` -- ONE time
+    source per sim (craq nodes default to time.monotonic; wpaxos
+    leaders already bind the transport clock, so rebinding is
+    idempotent)."""
+    for actor in actors:
+        admission = actor.admission
+        if admission is not None:
+            admission.clock = clock
+            admission._last_feed = 0.0
+            if admission.bucket is not None:
+                admission.bucket.clock = clock
+                admission.bucket._last = 0.0
+
+
+def _rejected_entry_is_current(client, pseudonym, client_id) -> bool:
+    """Does a ``Rejected`` entry refer to the client's CURRENT op for
+    this pseudonym? A stale duplicate (the original and a resend both
+    refused, the second arriving after the op concluded) must not
+    taint the NEXT op's admitted-latency attribution. Duck-typed over
+    the client shapes the load tier drives: multipaxos ``states``
+    (``.id``), wpaxos ``pending`` (``.command_id.client_id``), craq
+    ``pending`` (``.id``); unknown shapes mark conservatively."""
+    ops = getattr(client, "pending", None)
+    if not isinstance(ops, dict):
+        ops = getattr(client, "states", None)
+    if not isinstance(ops, dict):
+        return True
+    op = ops.get(pseudonym)
+    if op is None:
+        return False
+    cid = getattr(op, "command_id", None)
+    if cid is not None:
+        return cid.client_id == client_id
+    return getattr(op, "id", client_id) == client_id
+
+
+def hook_rejections(clients, sessions: SessionArrays) -> None:
+    """Wrap each client's ``Rejected`` handler to mark sessions whose
+    CURRENT op was refused: their completion latency is dominated by
+    client-side backoff sleeps, so the SLO gates' "admitted-request"
+    percentiles exclude them (they still count for goodput when they
+    finish inside the deadline, and for the giveup accounting when
+    they exhaust the budget). Idempotent per client."""
+    for client in clients:
+        original = getattr(client, "_handle_rejected", None)
+        if original is None or getattr(original, "_loadgen_hook",
+                                       False):
+            continue
+
+        def wrapped(*args, _original=original, _client=client):
+            rejected = args[-1]
+            for pseudonym, client_id in rejected.entries:
+                if pseudonym < sessions.n and _rejected_entry_is_current(
+                        _client, pseudonym, client_id):
+                    sessions.rejected_once[pseudonym] = 1
+            return _original(*args)
+
+        wrapped._loadgen_hook = True
+        client._handle_rejected = wrapped
+
+
+def admission_stats(transport) -> dict:
+    """Aggregate every attached AdmissionController's counters."""
+    out: dict = {"admitted": 0, "rejected": {}, "shed": {}}
+    for actor in transport.actors.values():
+        admission = actor.admission
+        if admission is None:
+            continue
+        out["admitted"] += admission.admitted
+        for reason, n in admission.rejected.items():
+            bucket = ("shed" if reason.startswith("shed_")
+                      else "rejected")
+            key = reason[len("shed_"):] if bucket == "shed" else reason
+            out[bucket][key] = out[bucket].get(key, 0) + n
+    return out
+
+
+def percentile_rows(latencies, admitted) -> dict:
+    """The shared p50/p99/p999 row shape (overload_lt + global_lt)."""
+    rows: dict = {}
+    for q in (50, 99, 99.9):
+        suffix = str(q).replace(".", "")
+        rows[f"p{suffix}_latency_s"] = (
+            round(float(np.percentile(latencies, q)), 4)
+            if len(latencies) else None)
+        rows[f"p{suffix}_admitted_s"] = (
+            round(float(np.percentile(admitted, q)), 4)
+            if len(admitted) else None)
+    return rows
+
+
+# --- the geo-fused tier (paxworld, scenarios/) ------------------------------
+
+
+@dataclasses.dataclass
+class TrafficLane:
+    """One zone's open-loop arrival stream: a client actor, its
+    workload, a contiguous session block [lo, hi) in the shared
+    SessionArrays, and the ``issue`` hook that turns one arrival into
+    a client operation -- ``issue(client, pseudonym, payload,
+    key_index, callback)``. The hook owns the per-protocol client
+    signature (wpaxos write-with-key, craq zone-local read, ...), so
+    one driver fans one session array across heterogeneous serving
+    tiers. ``record_acked`` is False for read lanes: reads feed the
+    latency gates but not the acked-write-loss oracle."""
+
+    name: str
+    client: object
+    workload: object
+    sessions: tuple
+    issue: object
+    record_acked: bool = True
+
+
+class GeoOverloadDriver:
+    """Drive open-loop lanes against a virtual-clock transport
+    (GeoSimTransport): the paxgeo x paxload fusion.
+
+    ONE time source per sim: the transport's virtual clock is THE
+    clock -- arrivals are sampled against it, admission token buckets
+    refill from it, completion latencies are exact virtual durations
+    measured on it, and client resend/backoff timers fire inside
+    ``run_until`` on their native virtual deadlines (no shadow
+    deadline table like the plain-transport driver keeps). A driver
+    clock advancing independently of the transport's would silently
+    skew offered load against delivery -- the bug class this class
+    exists to make unconstructible.
+
+    The service model is the SimOverloadDriver's (a CPU budget of one
+    virtual second per virtual second, ``msg_cost_s`` per delivered
+    frame + ``1/capacity`` per completion), applied as a ``max_steps``
+    bound on the virtual-clock event loop: frames the budget cannot
+    cover stay queued past their arrival stamps, which IS queueing
+    delay in virtual time. Delivery rides the wave engine end to end
+    (``run_until`` -> ``_run_wave`` -> ``Actor.receive_batch``).
+
+    Oracle bookkeeping for the scenario matrix: ``acked`` payloads
+    (an acked write may never be lost), ``giveup_payloads``
+    (RETRY_EXHAUSTED conclusions -- the bounded, loud degradation
+    path), and per-lane completion attribution for per-region SLO
+    clauses."""
+
+    def __init__(self, transport, lanes, *,
+                 capacity_cmds_per_s: float = 400.0,
+                 msg_cost_s: float = 0.0002, dt: float = 0.02,
+                 slo_deadline_s: float = 1.0, seed: int = 0):
+        if not hasattr(transport, "now") \
+                or not hasattr(transport, "run_until"):
+            raise ValueError(
+                "GeoOverloadDriver needs a virtual-clock transport "
+                "(GeoSimTransport); plain SimTransport arms use "
+                "SimOverloadDriver")
+        self.transport = transport
+        self.lanes = list(lanes)
+        n = max(hi for _, hi in (lane.sessions for lane in self.lanes))
+        self.sessions = SessionArrays(n)
+        #: session id -> lane index (blocks are disjoint by contract).
+        self._lane_of = np.zeros(n, dtype=np.int16)
+        seen: list = []
+        for i, lane in enumerate(self.lanes):
+            lo, hi = lane.sessions
+            for plo, phi in seen:
+                if lo < phi and plo < hi:
+                    raise ValueError(
+                        f"lane session blocks overlap: ({lo}, {hi}) "
+                        f"vs ({plo}, {phi})")
+            seen.append((lo, hi))
+            self._lane_of[lo:hi] = i
+        self.capacity = capacity_cmds_per_s
+        self.cmd_cost = 1.0 / capacity_cmds_per_s
+        self.msg_cost = msg_cost_s
+        self.dt = dt
+        self.slo_deadline_s = slo_deadline_s
+        self.np_rng = np.random.default_rng(seed)
+        self.budget = 0.0
+        #: (issue_t, latency_s, admitted_first_try, lane_index)
+        self.completions: list[tuple] = []
+        self.acked: list[bytes] = []
+        self.giveups = 0
+        self.giveup_payloads: list[bytes] = []
+        self._inflight_payload: dict[int, bytes] = {}
+        self.suppressed = 0
+        self.issued = 0
+        self.max_queue_depth = 0
+        self._bind_virtual_clocks()
+        self._hook_rejections()
+
+    @property
+    def now(self) -> float:
+        """THE clock -- a read-through to the transport's virtual
+        clock, never an independently-advancing copy."""
+        return self.transport.now
+
+    # --- virtual time plumbing ---------------------------------------------
+    def _bind_virtual_clocks(self) -> None:
+        transport = self.transport
+        bind_virtual_clocks(transport.actors.values(),
+                            lambda: transport.now)
+
+    def _hook_rejections(self) -> None:
+        hook_rejections([lane.client for lane in self.lanes],
+                        self.sessions)
+
+    # --- the tick loop -----------------------------------------------------
+    def _issue_arrivals(self) -> None:
+        sessions = self.sessions
+        now = self.transport.now
+        for li, lane in enumerate(self.lanes):
+            k = lane.workload.arrival_count(self.np_rng, now, self.dt)
+            if k <= 0:
                 continue
-            out["admitted"] += admission.admitted
-            for reason, n in admission.rejected.items():
-                bucket = ("shed" if reason.startswith("shed_")
-                          else "rejected")
-                key = reason[len("shed_"):] if bucket == "shed" else reason
-                out[bucket][key] = out[bucket].get(key, 0) + n
-        return out
+            lo, hi = lane.sessions
+            sids = self.np_rng.integers(lo, hi, k)
+            keys = lane.workload.sample_keys(self.np_rng, k)
+            for s, key in zip(sids.tolist(), keys.tolist()):
+                if sessions.state[s] != IDLE:
+                    self.suppressed += 1
+                    continue
+                sessions.state[s] = PENDING
+                sessions.issue_time[s] = now
+                sessions.rejected_once[s] = 0
+                sessions.ops_issued[s] += 1
+                payload = b"%s.s%d.%d" % (lane.name.encode(), s,
+                                          sessions.ops_issued[s])
+                if lane.record_acked:
+                    self._inflight_payload[s] = payload
+                lane.issue(lane.client, s, payload, key,
+                           self._completion_callback(s))
+                self.issued += 1
+
+    def _completion_callback(self, s: int):
+        sessions = self.sessions
+        lane_idx = int(self._lane_of[s])
+
+        def done(result) -> None:
+            sessions.state[s] = IDLE
+            payload = self._inflight_payload.pop(s, None)
+            if result is RETRY_EXHAUSTED:
+                self.giveups += 1
+                if payload is not None:
+                    self.giveup_payloads.append(payload)
+                return
+            if payload is not None:
+                self.acked.append(payload)
+            issued_at = float(sessions.issue_time[s])
+            # The transport clock reads the exact virtual completion
+            # instant -- no tick-end crediting: geo latencies are
+            # genuine simulated durations (link delays + queueing).
+            self.completions.append(
+                (issued_at, self.transport.now - issued_at,
+                 not sessions.rejected_once[s], lane_idx))
+
+        return done
+
+    def _deliver_budgeted(self) -> None:
+        """One tick's event-loop work: run the virtual-clock loop to
+        the tick boundary under the CPU budget (``max_steps`` =
+        affordable frames). Whatever the budget cannot cover stays
+        queued past its arrival stamp -- queueing delay in virtual
+        time -- and the clock still reaches the boundary, so offered
+        load never stretches."""
+        transport = self.transport
+        t_end = transport.now + self.dt
+        while self.budget > 0:
+            # Sized so even an all-completions wave bounds the debt
+            # to ~one frame's costs (see SimOverloadDriver).
+            cap = min(max(1, int(self.budget / self.msg_cost)),
+                      max(1, int(self.budget / self.cmd_cost) + 1))
+            before = len(self.completions)
+            steps = transport.run_until(t_end, max_steps=cap)
+            self.budget -= steps * self.msg_cost \
+                + (len(self.completions) - before) * self.cmd_cost
+            if steps < cap:
+                break  # everything due by t_end is delivered
+        # Advance the clock to the boundary even when the budget is in
+        # debt (max_steps=0 delivers nothing, moves time).
+        transport.run_until(t_end, max_steps=0)
+
+    def queue_depth(self) -> int:
+        return len(self.transport.messages)
+
+    def tick(self, arrivals: bool = True) -> None:
+        if arrivals:
+            self._issue_arrivals()
+        self.budget = min(self.budget + self.dt, 4 * self.dt) \
+            if self.budget > 0 else self.budget + self.dt
+        self._deliver_budgeted()
+        self.max_queue_depth = max(self.max_queue_depth,
+                                   self.queue_depth())
+
+    def run_for(self, duration_s: float, arrivals: bool = True) -> None:
+        t_end = self.transport.now + duration_s - 1e-9
+        while self.transport.now < t_end:
+            self.tick(arrivals=arrivals)
+
+    def settle(self, settle_s: float) -> None:
+        """No-arrivals wind-down: every pending op concludes --
+        completes, or walks its bounded retry schedule into an ack /
+        RETRY_EXHAUSTED."""
+        deadline = self.transport.now + settle_s - 1e-9
+        while self.transport.now < deadline and (
+                self.sessions.pending or self.transport.messages):
+            self.tick(arrivals=False)
+
+    def stats(self, t_measure: float, t_end: float,
+              duration_s: float) -> dict:
+        measured = [row for row in self.completions
+                    if t_measure <= row[0] < t_end]
+        latencies = np.array([lat for _, lat, _, _ in measured]) \
+            if measured else np.zeros(0)
+        admitted = np.array([lat for _, lat, first, _ in measured
+                             if first]) if measured else np.zeros(0)
+        in_slo = int(np.count_nonzero(latencies <= self.slo_deadline_s))
+        stats = {
+            "num_sessions": self.sessions.n,
+            "sessions_touched": self.sessions.touched(),
+            "issued": self.issued,
+            "suppressed_arrivals": self.suppressed,
+            "completed": len(measured),
+            "completed_in_slo": in_slo,
+            "goodput_cmds_per_s": round(in_slo / duration_s, 2),
+            "giveups": self.giveups,
+            "pending_after_settle": self.sessions.pending,
+            "max_queue_depth": self.max_queue_depth,
+            **percentile_rows(latencies, admitted),
+            "admission": admission_stats(self.transport),
+            "lanes": {},
+        }
+        for li, lane in enumerate(self.lanes):
+            rows = [row for row in measured if row[3] == li]
+            lats = np.array([lat for _, lat, _, _ in rows]) \
+                if rows else np.zeros(0)
+            adm = np.array([lat for _, lat, first, _ in rows if first]) \
+                if rows else np.zeros(0)
+            stats["lanes"][lane.name] = {
+                "completed": len(rows),
+                "in_slo": int(np.count_nonzero(
+                    lats <= self.slo_deadline_s)),
+                **percentile_rows(lats, adm),
+            }
+        return stats

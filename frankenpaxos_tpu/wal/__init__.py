@@ -21,8 +21,12 @@ executed watermark after ``kill -9``, then rejoin the cluster.
     (real files + fsync) or ``MemStorage`` (the sim's crash-surviving
     stand-in: synced bytes survive ``crash_restart``, the unsynced
     group-commit buffer dies with the actor).
+  * ``wal.faults`` -- deterministic fsync-stall fault injection for
+    the paxworld scenario matrix (a wrapping storage: off by default,
+    zero cost on the unwrapped hot path).
 """
 
+from frankenpaxos_tpu.wal.faults import FsyncStallStorage  # noqa: F401
 from frankenpaxos_tpu.wal.log import FileStorage, MemStorage, Wal, WalMetrics  # noqa: F401
 from frankenpaxos_tpu.wal.records import (  # noqa: F401
     WalChosenRun,

@@ -1,0 +1,94 @@
+"""Deterministic WAL storage-fault injection (paxworld).
+
+"The Performance of Paxos in the Cloud" (PAPERS.md) attributes the
+worst deployed tail latencies to STORAGE, not the network: a single
+fsync stalling for tens of milliseconds holds the whole group commit,
+and every ack behind it, amplifying p999 by orders of magnitude. The
+scenario matrix (scenarios/, bench/global_lt.py) reproduces that
+pathology on virtual time with this module.
+
+:class:`FsyncStallStorage` wraps any WAL storage (MemStorage in sims,
+FileStorage on disk) and injects a stall after every ``stall_every``-th
+``sync``. Stall durations are drawn from a STRING-SEEDED
+``random.Random`` keyed ``(seed, label, sync index)`` -- sha512
+seeding, PYTHONHASHSEED-proof -- so a scenario's fault schedule is
+byte-reproducible per seed (the same determinism contract the geo
+layer enforces via paxlint GEO801). The wrapper reports each stall to
+``on_stall``; the scenario harness bridges that to
+``GeoSimTransport.stall_sender`` so the stalled role's drain releases
+its held acks late in VIRTUAL time (wal/role.py holds acks until the
+fsync returns -- the stall therefore lands exactly where a real one
+would: between the fsync and the send-release stage).
+
+OFF BY DEFAULT, ZERO HOT-PATH COST: fault injection is a wrapping
+storage object that only exists when a scenario arms it. The unwrapped
+Wal/FileStorage/MemStorage path is not touched by this module at all
+-- no flag test, no attribute, no import.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional
+
+
+class FsyncStallStorage:
+    """A WAL storage decorator injecting deterministic fsync stalls.
+
+    ``stall_every=k`` stalls every k-th sync; ``stall_s`` is the mean
+    stall with one-sided uniform jitter of +-``jitter`` fraction.
+    ``stall_every=0`` (the default) never stalls -- the wrapper then
+    only counts syncs."""
+
+    def __init__(self, inner, *, seed: int = 0, label: str = "",
+                 stall_every: int = 0, stall_s: float = 0.05,
+                 jitter: float = 0.5,
+                 on_stall: Optional[Callable[[float], None]] = None):
+        self.inner = inner
+        self.seed = seed
+        self.label = label
+        self.stall_every = stall_every
+        self.stall_s = stall_s
+        self.jitter = jitter
+        self.on_stall = on_stall
+        self.syncs = 0
+        #: Every injected stall duration, in order (the scenario
+        #: records the schedule next to the SLO row).
+        self.stalls: list[float] = []
+        self._rng = random.Random(0)
+
+    # --- the fault site ----------------------------------------------------
+    def sync(self, name: str) -> None:
+        self.inner.sync(name)
+        self.syncs += 1
+        if not self.stall_every or self.syncs % self.stall_every:
+            return
+        rng = self._rng
+        rng.seed(f"fsync-stall|{self.seed}|{self.label}|{self.syncs}")
+        lo = 1.0 - self.jitter
+        stall = self.stall_s * (lo + 2 * self.jitter * rng.random())
+        self.stalls.append(stall)
+        if self.on_stall is not None:
+            self.on_stall(stall)
+
+    # --- transparent delegation --------------------------------------------
+    def segments(self) -> list:
+        return self.inner.segments()
+
+    def read(self, name: str) -> bytes:
+        return self.inner.read(name)
+
+    def append(self, name: str, data: bytes) -> None:
+        self.inner.append(name, data)
+
+    def delete(self, name: str) -> None:
+        self.inner.delete(name)
+
+    def truncate(self, name: str, size: int) -> None:
+        self.inner.truncate(name, size)
+
+    def size(self, name: str) -> int:
+        return self.inner.size(name)
+
+    def close(self) -> None:
+        self.inner.close()

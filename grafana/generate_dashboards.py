@@ -58,9 +58,9 @@ RUNTIME_ROW_TITLE = ("Runtime (drain stages / queue depth / WAL fsync / "
 
 #: Total grid height of the runtime row: header (1) + the paxtrace
 #: band (8) + the paxload admission band (8) + the paxwire transport
-#: band (8). dashboard() and inject_runtime_row() both lay out
-#: protocol panels below this line.
-RUNTIME_ROW_H = 25
+#: band (8) + the paxworld global-serving band (8). dashboard() and
+#: inject_runtime_row() both lay out protocol panels below this line.
+RUNTIME_ROW_H = 33
 
 
 def runtime_row_panels(y: int = 0) -> list:
@@ -149,6 +149,19 @@ def runtime_row_panels(y: int = 0) -> list:
             "sum by (role) "
             "(rate(fpx_runtime_transport_batch_bytes[5s]))",
             "{{role}}", "Bps", x=16, y=y + 17, w=8),
+        # paxworld global-serving band (scenarios/, docs/GLOBAL.md):
+        # per-region committed goodput vs rejected/shed load -- the
+        # fleet view the SLO matrix gates in CI.
+        _panel(
+            9011, "Global serving: goodput by region",
+            "sum by (region) "
+            "(rate(fpx_runtime_region_goodput_cmds_total[5s]))",
+            "{{region}}", "ops", x=0, y=y + 25, w=12),
+        _panel(
+            9012, "Global serving: rejected/shed by region",
+            "sum by (region) "
+            "(rate(fpx_runtime_region_shed_total[5s]))",
+            "{{region}}", "ops", x=12, y=y + 25, w=12),
     ]
 
 

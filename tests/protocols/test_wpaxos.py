@@ -338,11 +338,17 @@ class WPaxosGeoSimulated(SimulatedSystem):
     """
 
     def __init__(self, num_zones: int = 3, row_width: int = 3,
-                 num_groups: int = 3, jitter: float = 1.0):
+                 num_groups: int = 3, jitter: float = 1.0,
+                 chaos_scale: float = 1.0):
         self.num_zones = num_zones
         self.row_width = row_width
         self.num_groups = num_groups
         self.jitter = jitter
+        #: Multiplies every chaos-command probability (steal, link
+        #: cut/heal, crash, zone kill) -- the paxworld "deeper
+        #: interleavings" soak rows run the SAME oracle with 2x the
+        #: fault density per run (tests/soak.py).
+        self.chaos_scale = chaos_scale
 
     def new_system(self, seed: int):
         regions = {f"r{z}": [f"zone-{z}"]
@@ -367,14 +373,15 @@ class WPaxosGeoSimulated(SimulatedSystem):
         transport_cmd = sim.transport.generate_command(rng)
         if transport_cmd is not None:
             choices.extend(["transport"] * 6)
-        if rng.random() < 0.12:
+        scale = self.chaos_scale
+        if rng.random() < 0.12 * scale:
             choices.append("steal")
-        if rng.random() < 0.12:
+        if rng.random() < 0.12 * scale:
             choices.append("link")
-        if rng.random() < 0.15:
+        if rng.random() < 0.15 * scale:
             choices.append("crash")
         if sim._dead_zone is None:
-            if rng.random() < 0.05:
+            if rng.random() < 0.05 * scale:
                 choices.append("kill_zone")
         elif rng.random() < 0.5:
             choices.append("restart_zone")

@@ -335,6 +335,69 @@ GEO_CHAOS_CONFIGS: list[tuple] = [
 CONFIGS.extend(GEO_CHAOS_CONFIGS)
 
 
+class WPaxosGeoStorm1000(WPaxosGeoSimulated):
+    """The paxworld 1000-zone storm row: the full steal/partition/
+    crash chaos schedule at planetary zone count (3000 acceptors,
+    1000 leaders/replicas/clients) riding the wave engine. The
+    per-command safety oracle is SAMPLED 1-in-25 (plus the run-final
+    check the Simulator always performs): the full-density oracle
+    scans every leader's and replica's log per command -- quadratic
+    in zones, ~100x the sim's own cost at this size -- and a
+    divergence still fails the run, just with a coarser minimization
+    anchor. get_state returns the LAST SAMPLE between samples, so the
+    step (SM-prefix-regression) oracle compares sample-to-sample --
+    intermediate steps see two references to one tuple (trivially
+    equal) and each fresh sample is checked against the previous one
+    across the 25-command gap."""
+
+    CHECK_EVERY = 25
+
+    def __init__(self):
+        super().__init__(num_zones=1000, row_width=3, num_groups=3,
+                         jitter=2.0)
+        self._checks = 0
+        self._sampled = ()
+
+    def new_system(self, seed: int):
+        # The Simulator reuses ONE SimulatedSystem instance across
+        # runs and minimization replays: the sampling counter and the
+        # cached sample must reset per run, or run N+1's first sample
+        # gets step-compared against run N's last one (a spurious
+        # "SM sequence rewrote" the moment the row commits anything).
+        self._checks = 0
+        self._sampled = ()
+        return super().new_system(seed)
+
+    def state_invariant(self, sim):
+        self._checks += 1
+        if self._checks % self.CHECK_EVERY:
+            return None
+        return super().state_invariant(sim)
+
+    def get_state(self, sim):
+        if self._checks % self.CHECK_EVERY == 0:
+            self._sampled = super().get_state(sim)
+        return self._sampled
+
+
+# paxworld (scenarios/, docs/GLOBAL.md): the post-ISSUE-13 geo-chaos
+# growth -- deeper fault interleavings (2x chaos density per run), a
+# wide high-jitter mesh, and the 1000-zone storm. Registered behind
+# the existing rows so `--only geo-chaos` covers old and new alike.
+GEO_CHAOS_CONFIGS.extend([
+    ("geo-chaos/wpaxos-z4-chaos2x",
+     lambda: WPaxosGeoSimulated(num_zones=4, row_width=3,
+                                num_groups=4, jitter=2.0,
+                                chaos_scale=2.0), 2.0),
+    ("geo-chaos/wpaxos-z10-storm",
+     lambda: WPaxosGeoSimulated(num_zones=10, row_width=3,
+                                num_groups=8, jitter=2.0,
+                                chaos_scale=1.5), 0.5),
+    ("geo-chaos/wpaxos-z1000-storm", WPaxosGeoStorm1000, 0.004),
+])
+CONFIGS.extend(GEO_CHAOS_CONFIGS[-3:])
+
+
 def _expand(entry, num_runs: int):
     """(name, factory[, runs_scale]) -> (name, factory, scaled runs) --
     the ONE place the optional scale element is interpreted."""

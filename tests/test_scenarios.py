@@ -1,0 +1,403 @@
+"""paxworld scenario-matrix tests: golden determinism, the fused
+safety oracle, and unit tests for the pieces the matrix wired
+together (CRAQ admission/backoff, the WPaxos client retry budget, the
+fsync-stall fault hook, the preemption-redirect fix, and the
+unified-virtual-clock loadgen driver)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from frankenpaxos_tpu.bench.workload import OpenLoopWorkload
+from frankenpaxos_tpu.geo import GeoSimTransport, GeoTopology
+from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+from frankenpaxos_tpu.scenarios import run_scenario, Scale
+from frankenpaxos_tpu.scenarios.matrix import (
+    _arm_control_oracle,
+    _driver,
+    _keys_for_zone,
+    _wpaxos_cluster,
+    _wpaxos_safety,
+    _write_lane,
+)
+from frankenpaxos_tpu.serve.backoff import Backoff, RETRY_EXHAUSTED
+
+#: CI-sized scale: every scenario finishes in ~1s of wall time.
+TEST_SCALE = Scale("test", sessions_per_lane=5_000, per_zone_rate=40.0,
+                   duration_s=5.0, settle_s=8.0, outage_dwell_s=1.0)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "global_scenario.json")
+
+
+class TestGoldenDeterminism:
+    def test_same_seed_byte_identical_and_matches_committed(self):
+        """Same seed => byte-identical delivery history AND an
+        identical SLO row -- run twice in-process, then against the
+        committed golden (regenerate with FPX_WRITE_GOLDEN=1)."""
+        rows = [run_scenario("region_partition", seed=3,
+                             scale=TEST_SCALE) for _ in range(2)]
+        for row in rows:
+            row.pop("wall_seconds")
+        assert rows[0]["history_sha256"] == rows[1]["history_sha256"]
+        assert json.dumps(rows[0], sort_keys=True) \
+            == json.dumps(rows[1], sort_keys=True)
+        snapshot = {
+            "scenario": rows[0]["scenario"],
+            "seed": rows[0]["seed"],
+            "history_sha256": rows[0]["history_sha256"],
+            "slo": rows[0]["slo"],
+            "issued": rows[0]["stats"]["issued"],
+            "completed": rows[0]["stats"]["completed"],
+            "giveups": rows[0]["stats"]["giveups"],
+        }
+        if os.environ.get("FPX_WRITE_GOLDEN"):
+            with open(GOLDEN, "w") as f:
+                json.dump(snapshot, f, indent=2, sort_keys=True)
+                f.write("\n")
+        with open(GOLDEN) as f:
+            committed = json.load(f)
+        assert snapshot == committed
+
+    def test_different_seed_differs(self):
+        a = run_scenario("fsync_stalls", seed=0, scale=TEST_SCALE)
+        b = run_scenario("fsync_stalls", seed=1, scale=TEST_SCALE)
+        assert a["history_sha256"] != b["history_sha256"]
+
+
+class TestFusedSafetyOracle:
+    def test_zone_kill_plus_partition_plus_heal(self):
+        """The matrix's safety clauses under the WORST fused schedule:
+        a zone dies at load, a cross-region partition lands while it
+        is down, the zone relaunches from WAL behind the partition,
+        everything heals. No acked write lost, exactly-once
+        execution, every request concludes, control never shed."""
+        from tests.protocols.wpaxos_harness import (
+            crash_zone,
+            restart_zone,
+        )
+
+        scale = TEST_SCALE
+        sim, topo = _wpaxos_cluster(11, num_groups=6)
+        n = scale.sessions_per_lane
+        lanes = []
+        for z in range(3):
+            keys = _keys_for_zone(sim.config, z, 12)
+            lanes.append(_write_lane(
+                f"zone-{z}", sim.clients[z], keys,
+                (z * n, (z + 1) * n),
+                OpenLoopWorkload(rate=scale.per_zone_rate, zipf_s=1.1,
+                                 num_keys=len(keys))))
+        driver = _driver(sim, lanes, 11)
+        refused = _arm_control_oracle(sim.transport)
+
+        driver.run_for(1.5)
+        crash_zone(sim, 0)
+        driver.run_for(1.0)
+        topo.partition_regions("r2", "r0")
+        topo.partition_regions("r2", "r1")
+        driver.run_for(1.5)
+        restart_zone(sim, 0)
+        driver.run_for(1.0)
+        topo.heal_all()
+        driver.run_for(1.5)
+        driver.settle(scale.settle_s)
+
+        violations = _wpaxos_safety(sim, driver.acked)
+        assert not violations, violations
+        # Every issued request concluded: acked or loud giveup.
+        assert driver.sessions.pending == 0
+        assert len(driver.completions) + driver.giveups \
+            == driver.issued
+        assert driver.giveups > 0  # the chaos actually bit
+        assert not refused  # control plane never shed
+
+
+class TestCraqServing:
+    def _chain(self, *, token_rate=0.0, inbox=0, budget=0,
+               backoff=None, read_node=None, seed=0):
+        from frankenpaxos_tpu.protocols.craq import (
+            ChainNode,
+            CraqClient,
+            CraqConfig,
+        )
+        from frankenpaxos_tpu.runtime import SimTransport
+        from frankenpaxos_tpu.serve.admission import AdmissionOptions
+
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = SimTransport(logger)
+        config = CraqConfig(chain_node_addresses=("n0", "n1", "n2"))
+        admission = AdmissionOptions(
+            token_rate=token_rate, token_burst=token_rate,
+            inbox_capacity=inbox, retry_after_ms=50) \
+            if token_rate or inbox else None
+        nodes = [ChainNode(a, transport, logger, config,
+                           admission=admission)
+                 for a in config.chain_node_addresses]
+        client = CraqClient("c", transport, logger, config,
+                            resend_period_s=0.5, seed=seed,
+                            retry_budget=budget, backoff=backoff,
+                            read_node=read_node)
+        return transport, nodes, client
+
+    def test_rejected_read_backs_off_and_retries_to_success(self):
+        """The read path's Rejected-with-backoff discipline: a
+        refused read answers Rejected, the client reschedules on the
+        backoff delay, and the retry (with tokens refilled) serves."""
+        transport, nodes, client = self._chain(
+            token_rate=1.0, budget=5,
+            backoff=Backoff(initial_s=0.1, jitter=0.0), read_node=1)
+        # Drain the bucket (burst=1): the first read is admitted.
+        got: list = []
+        client.read(0, "k", got.append)
+        transport.deliver_all()
+        assert got == ["default"]
+        # Bucket empty (clock is monotonic wall time; no refill in
+        # this test's instant): the next read is REJECTED.
+        nodes[1].admission.bucket.tokens = 0.0
+        nodes[1].admission.bucket.clock = lambda: 0.0
+        nodes[1].admission.clock = lambda: 0.0
+        client.read(1, "k", got.append)
+        transport.deliver_all()
+        assert got == ["default"]  # no reply yet
+        pending = client.pending[1]
+        assert pending.attempts == 1 and pending.backoff_pending
+        assert nodes[1].admission.rejected
+        # Refill and fire the rescheduled resend timer: served.
+        nodes[1].admission.bucket.tokens = 5.0
+        for timer in transport.running_timers():
+            if timer.name == "resend-1":
+                transport.trigger_timer(timer.id)
+        transport.deliver_all()
+        assert got == ["default", "default"]
+
+    def test_retry_budget_exhaustion_is_loud(self):
+        transport, nodes, client = self._chain(
+            token_rate=1.0, budget=2,
+            backoff=Backoff(initial_s=0.01, jitter=0.0), read_node=0)
+        nodes[0].admission.bucket.tokens = 0.0
+        nodes[0].admission.bucket.clock = lambda: 0.0
+        nodes[0].admission.clock = lambda: 0.0
+        got: list = []
+        client.read(0, "k", got.append)
+        transport.deliver_all()  # rejected: attempt 1
+        for _ in range(4):  # resend -> rejected -> ... -> giveup
+            for timer in transport.running_timers():
+                if timer.name == "resend-0":
+                    transport.trigger_timer(timer.id)
+            transport.deliver_all()
+        assert got and got[0] is RETRY_EXHAUSTED
+        assert client.giveups == 1
+        assert 0 not in client.pending
+
+    def test_chain_hops_are_control_lane(self):
+        """The client edge (bare Write/Read, tags 201/202) sheds; the
+        chain's replication traffic never does."""
+        from frankenpaxos_tpu.protocols import craq as cq
+        from frankenpaxos_tpu.runtime.serializer import (
+            DEFAULT_SERIALIZER,
+        )
+        from frankenpaxos_tpu.serve.lanes import (
+            LANE_CLIENT,
+            LANE_CONTROL,
+            frame_lane,
+        )
+
+        cid = cq.CommandId("c", 0, 1)
+        write = cq.Write(cid, "k", "v")
+        batch = cq.WriteBatch((write,), seq=3)
+        encode = DEFAULT_SERIALIZER.to_bytes
+        assert frame_lane(encode(write)) == LANE_CLIENT
+        assert frame_lane(encode(cq.Read(cid, "k"))) == LANE_CLIENT
+        assert frame_lane(encode(batch)) == LANE_CONTROL
+        assert frame_lane(encode(cq.Ack(batch))) == LANE_CONTROL
+        assert frame_lane(encode(cq.TailRead(
+            cq.ReadBatch((cq.Read(cid, "k"),))))) == LANE_CONTROL
+
+    def test_zone_local_read_pinning(self):
+        transport, nodes, client = self._chain(read_node=2)
+        got: list = []
+        client.read(0, "k", got.append)
+        assert transport.messages[-1].dst == "n2"
+        transport.deliver_all()
+        assert got == ["default"]
+
+
+class TestWPaxosClientBudget:
+    def test_giveup_after_budget_and_no_double_consume(self):
+        from frankenpaxos_tpu.protocols.wpaxos import (
+            WPaxosClientOptions,
+        )
+        from frankenpaxos_tpu.serve.messages import Rejected
+        from tests.protocols.wpaxos_harness import make_wpaxos
+
+        sim = make_wpaxos(
+            client_options=WPaxosClientOptions(
+                resend_period_s=0.5, adaptive_timeouts=False,
+                retry_budget=2,
+                reject_backoff=Backoff(initial_s=0.1, jitter=0.0)))
+        client = sim.clients[0]
+        got: list = []
+        client.write(0, b"w0", got.append, key=b"k")
+        op = client.pending[0]
+        rejected = Rejected(entries=((0, op.command_id.client_id),),
+                            retry_after_ms=50)
+        client._handle_rejected("leader-0", rejected)
+        assert op.rejects == 1 and op.backoff_pending
+        # A duplicate refusal of the same attempt is absorbed.
+        client._handle_rejected("leader-0", rejected)
+        assert op.rejects == 1
+        # The rescheduled timer fires (attempt 2) -> resend; the next
+        # rejection exhausts the budget LOUDLY.
+        client._resend(0)
+        assert not op.backoff_pending and op.resends == 1
+        client._handle_rejected("leader-0", rejected)
+        assert got and got[0] is RETRY_EXHAUSTED
+        assert client.giveups == 1 and 0 not in client.pending
+
+    def test_preempted_home_leader_redirects_instead_of_restealing(self):
+        """The follow-the-sun boomerang regression: a leader nacked at
+        a higher ballot belonging to ANOTHER zone redirects client
+        traffic there instead of stealing its old home group back
+        (which turned every planned migration into a ballot war)."""
+        from frankenpaxos_tpu.protocols.wpaxos.messages import (
+            Command,
+            CommandId,
+            WNotOwner,
+            WRequest,
+        )
+        from tests.protocols.wpaxos_harness import make_wpaxos
+
+        sim = make_wpaxos()
+        group = sim.config.group_of_key(b"obj1")
+        home = sim.config.initial_home[group]
+        leader = sim.leaders[home]
+        other = (home + 1) % 3
+        # Simulate the preemption window: a nack at other's ballot
+        # arrived, the WEpochCommit has not.
+        stolen_ballot = sim.config.next_ballot(other, 10)
+        leader._ballot_floor[group] = stolen_ballot
+        request = WRequest(group=group, command=Command(
+            command_id=CommandId("client-0", 0, 0), command=b"x"))
+        before = len(sim.transport.messages)
+        leader.receive("client-0", request)
+        assert group not in leader.stealing  # no boomerang
+        redirects = [m for m in sim.transport.messages[before:]]
+        assert len(redirects) == 1
+        decoded = leader.serializer.from_bytes(redirects[0].data)
+        assert isinstance(decoded, WNotOwner)
+        assert decoded.home_zone == other
+        assert decoded.ballot == stolen_ballot
+        # steal=True (the failover path) bypasses the redirect.
+        leader.receive("client-0", WRequest(
+            group=group, command=Command(
+                command_id=CommandId("client-0", 0, 1), command=b"y"),
+            steal=True))
+        assert group in leader.stealing
+
+
+class TestFsyncStallStorage:
+    def test_deterministic_schedule_and_delegation(self):
+        from frankenpaxos_tpu.wal import FsyncStallStorage, MemStorage
+
+        def build():
+            stalls: list = []
+            storage = FsyncStallStorage(
+                MemStorage(), seed=7, label="a-0", stall_every=3,
+                stall_s=0.1, on_stall=stalls.append)
+            return storage, stalls
+
+        a, stalls_a = build()
+        b, stalls_b = build()
+        for storage in (a, b):
+            for i in range(9):
+                storage.append("seg-0.wal", b"x")
+                storage.sync("seg-0.wal")
+        assert len(stalls_a) == 3
+        assert stalls_a == stalls_b == a.stalls
+        assert all(0.05 <= s <= 0.15 for s in stalls_a)
+        assert a.read("seg-0.wal") == b"x" * 9
+        assert a.segments() == ["seg-0.wal"]
+
+    def test_off_by_default_never_stalls(self):
+        from frankenpaxos_tpu.wal import FsyncStallStorage, MemStorage
+
+        storage = FsyncStallStorage(MemStorage(), seed=0, label="a")
+        for _ in range(100):
+            storage.sync("seg-0.wal")
+        assert storage.stalls == [] and storage.syncs == 100
+
+    def test_stall_sender_delays_departures(self):
+        """The virtual-time bridge: a stalled sender's frames depart
+        at the stall horizon, later sends are unaffected."""
+        topo = GeoTopology({"r0": ["z0"], "r1": ["z1"]}, jitter=0.0)
+        transport = GeoSimTransport(topo, FakeLogger(LogLevel.FATAL))
+
+        class Echo:
+            admission = None
+            serializer = None
+
+            def __init__(self, address):
+                self.address = address
+                transport.register(address, self)
+
+        a, b = Echo("a"), Echo("b")
+        topo.place("a", "z0")
+        topo.place("b", "z1")
+        transport.send("a", "b", b"before")
+        transport.stall_sender("a", 0.5)
+        transport.send("a", "b", b"stalled")
+        base = topo.cross_region_s
+        arrivals = sorted(transport.arrivals.values())
+        assert arrivals[0] == pytest.approx(base)
+        assert arrivals[1] == pytest.approx(0.5 + base)
+        # Past the horizon the stall expires.
+        transport.now = 1.0
+        transport.send("a", "b", b"after")
+        assert max(transport.arrivals.values()) \
+            == pytest.approx(1.0 + base)
+        assert not transport._stall_until
+
+
+class TestGeoOverloadDriver:
+    def test_one_clock_and_lane_validation(self):
+        from frankenpaxos_tpu.serve.loadgen import (
+            GeoOverloadDriver,
+            TrafficLane,
+        )
+        from frankenpaxos_tpu.runtime import SimTransport
+
+        sim, topo = _wpaxos_cluster(0, num_groups=3)
+        keys = _keys_for_zone(sim.config, 0, 4)
+        lane = _write_lane("z0", sim.clients[0], keys, (0, 100),
+                           OpenLoopWorkload(rate=10.0,
+                                            num_keys=len(keys)))
+        driver = _driver(sim, [lane], 0)
+        assert driver.now == sim.transport.now
+        driver.run_for(0.5)
+        assert driver.now == sim.transport.now > 0.4
+        with pytest.raises(ValueError, match="overlap"):
+            GeoOverloadDriver(sim.transport, [
+                TrafficLane("a", sim.clients[0],
+                            OpenLoopWorkload(rate=1.0), (0, 10),
+                            lane.issue),
+                TrafficLane("b", sim.clients[1],
+                            OpenLoopWorkload(rate=1.0), (5, 15),
+                            lane.issue),
+            ])
+        with pytest.raises(ValueError, match="virtual-clock"):
+            GeoOverloadDriver(SimTransport(FakeLogger(LogLevel.FATAL)),
+                              [lane])
+
+    def test_diurnal_phase_shifts_the_peak(self):
+        base = OpenLoopWorkload(rate=100.0, diurnal_amplitude=1.0,
+                                diurnal_period_s=12.0)
+        shifted = OpenLoopWorkload(rate=100.0, diurnal_amplitude=1.0,
+                                   diurnal_period_s=12.0,
+                                   diurnal_phase_s=4.0)
+        assert base.offered_rate(3.0) == pytest.approx(200.0)
+        assert shifted.offered_rate(3.0 - 4.0 + 12.0) \
+            == pytest.approx(200.0)

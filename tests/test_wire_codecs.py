@@ -787,6 +787,10 @@ def all_codec_samples() -> dict:
         cq.Ack(cq.WriteBatch((cq.Write(ccid, "k", "v"),), seq=7)),
         cq.ClientReply(ccid),
         cq.ReadReply(ccid, "v"),
+        # paxworld (tags 201-202): the bare client-edge shapes, so
+        # the lane classifier sees CRAQ client traffic.
+        cq.Write(ccid, "k", "v"),
+        cq.Read(ccid, "k"),
         # fastmultipaxos
         fmp.ProposeRequest(fcommand),
         fmp.ProposeReply(fmp.CommandId(("h", 5), 3), b"r", round=2),
