@@ -1,0 +1,206 @@
+"""The WAL group-commit batching cliff (paxchaos, "Paxos in the Cloud").
+
+"The Performance of Paxos in the Cloud" (PAPERS.md) shows deployed
+Paxos throughput living or dying on how many log records amortize one
+fsync: below the knee of the batch-size curve every record pays a
+whole (sometimes stalled) fsync and throughput falls off a cliff;
+past it the fsync amortizes away and the curve plateaus. This bench
+drives a REAL FileStorage WAL through that curve under the fsync
+fault hook (``wal/faults.FsyncStallStorage``, count-cadence BLOCKING
+stalls -- the deployed storage-fault arm), locates the knee, and
+GATES that the configured operating point sits on the plateau side of
+it: a regression that moves the knee past the operating point (a
+heavier record codec, an extra fsync on the commit path, a lost
+buffering layer) fails CI before it ships as a silent 10x deployed
+throughput loss.
+
+Two arms per run: fault-on (the gated one -- stalls amplify exactly
+the per-sync cost the knee measures, pushing it right) and a
+fault-off reference curve. Committed artifact:
+``bench_results/batching_cliff.json``.
+
+Usage::
+
+    python -m frankenpaxos_tpu.bench.batching_cliff \
+        --out bench_results/batching_cliff.json
+    python -m frankenpaxos_tpu.bench.batching_cliff --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import time
+
+from frankenpaxos_tpu.wal import FileStorage, FsyncStallStorage, Wal
+from frankenpaxos_tpu.wal.records import WalVote
+
+#: The operating point the gate protects: WAL group commit is
+#: per-DRAIN (one sync per event-loop drain), and a LOADED role's
+#: drain batches its whole event-loop pass -- easily 100+ records --
+#: so the knee must sit at or below this batch size for production
+#: group commits to run on the amortized side of the cliff.
+OPERATING_BATCH = 128
+
+#: The knee: the smallest batch size reaching this fraction of the
+#: largest batch's throughput. 0.4 is chosen to be HOST-ROBUST: in
+#: the fsync-dominated limit rps is linear in batch size, so
+#: rps(128)/rps(256) -> 0.5 > 0.4 on arbitrarily slow storage --
+#: the knee can only blow past 128 if something per-RECORD got
+#: fsync-expensive, which is exactly the regression to catch.
+KNEE_FRACTION = 0.4
+
+BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+#: Fault cadence: a blocking stall every 25th sync (in-process there
+#: is no cross-process alignment to preserve, so the count cadence is
+#: the right shape -- it scales stall exposure with SYNC COUNT, which
+#: is exactly the cliff's mechanism: small batches -> more syncs ->
+#: more stalls per record).
+STALL_EVERY = 25
+STALL_S = 0.002
+
+PAYLOAD = b"x" * 64
+
+
+def _quantile(sorted_values: list, q: float) -> float:
+    return sorted_values[min(len(sorted_values) - 1,
+                             int(q * len(sorted_values)))]
+
+
+def run_arm(root: str, *, records: int, fault: bool,
+            batch_sizes=BATCH_SIZES, seed: int = 0) -> dict:
+    curve: dict = {}
+    for batch in batch_sizes:
+        directory = os.path.join(
+            root, f"b{batch}_{'on' if fault else 'off'}")
+        storage = FileStorage(directory)
+        if fault:
+            storage = FsyncStallStorage(
+                storage, seed=seed, label=f"b{batch}",
+                stall_every=STALL_EVERY, stall_s=STALL_S,
+                blocking=True)
+        wal = Wal(storage, segment_bytes=64 << 20,
+                  compact_every_bytes=256 << 20)
+        latencies: list = []
+        n = 0
+        t0 = time.perf_counter()
+        while n < records:
+            t_batch = time.perf_counter()
+            for i in range(batch):
+                wal.append(WalVote(slot=n + i, round=1,
+                                   value=PAYLOAD))
+            wal.sync()
+            latencies.append(time.perf_counter() - t_batch)
+            n += batch
+        total = time.perf_counter() - t0
+        stalls = len(storage.stalls) if fault else 0
+        wal.close()
+        latencies.sort()
+        curve[batch] = {
+            "records_per_s": round(n / total, 1),
+            "syncs": len(latencies),
+            "stalls": stalls,
+            "p50_commit_s": round(_quantile(latencies, 0.5), 6),
+            "p99_commit_s": round(_quantile(latencies, 0.99), 6),
+        }
+    return curve
+
+
+def find_knee(curve: dict) -> dict:
+    plateau = max(row["records_per_s"] for row in curve.values())
+    knee = next(batch for batch in sorted(curve)
+                if curve[batch]["records_per_s"]
+                >= KNEE_FRACTION * plateau)
+    floor = curve[min(curve)]["records_per_s"]
+    return {
+        "plateau_records_per_s": plateau,
+        "knee_batch": knee,
+        "knee_fraction": KNEE_FRACTION,
+        "cliff_depth": round(plateau / floor, 1),
+    }
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--smoke", action="store_true",
+                        help="reduced record count (CI/test sizing)")
+    parser.add_argument("--records", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    records = args.records or (1024 if args.smoke else 4096)
+    root = tempfile.mkdtemp(prefix="fpx_batching_cliff_")
+    t0 = time.time()
+    try:
+        arms = {
+            "fault_on": run_arm(root, records=records, fault=True,
+                                seed=args.seed),
+            "fault_off": run_arm(root, records=records, fault=False,
+                                 seed=args.seed),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    knees = {arm: find_knee(curve) for arm, curve in arms.items()}
+    on = knees["fault_on"]
+    gates = {
+        # The operating point sits on the plateau side of the knee,
+        # UNDER the fault: production drains never pay the cliff.
+        "knee_at_or_below_operating_point": {
+            "knee_batch": on["knee_batch"],
+            "operating_batch": OPERATING_BATCH,
+            "passed": on["knee_batch"] <= OPERATING_BATCH,
+        },
+        # The cliff is real (else the bench measures nothing -- and a
+        # per-record fsync regression FLATTENS the curve, failing
+        # here): the plateau clears the single-record floor by a wide
+        # margin.
+        "cliff_exists": {
+            "cliff_depth": on["cliff_depth"],
+            "bound": 10.0,
+            "passed": on["cliff_depth"] >= 10.0,
+        },
+    }
+    result = {
+        "benchmark": "batching_cliff",
+        "host_cpus": os.cpu_count(),
+        "records_per_batch_size": records,
+        "stall_every": STALL_EVERY,
+        "stall_s": STALL_S,
+        "curves": arms,
+        "knees": knees,
+        "gates": gates,
+        "gate_passed": all(g["passed"] for g in gates.values()),
+        "seconds": round(time.time() - t0, 1),
+        "methodology": (
+            "append B WalVote records + one group-commit sync per "
+            "batch against a real FileStorage (blocking "
+            "FsyncStallStorage every 25th sync on the fault-on arm); "
+            "knee = smallest B reaching 40% of the largest batch's "
+            "records/s; gate: knee <= the per-drain operating point "
+            "(128) so production group commits run on the amortized "
+            "side, plus a >=10x cliff-depth floor that a per-record "
+            "fsync regression would flatten."),
+    }
+    print(json.dumps({
+        "gate_passed": result["gate_passed"],
+        "knee_on": on["knee_batch"],
+        "knee_off": knees["fault_off"]["knee_batch"],
+        "cliff_depth_on": on["cliff_depth"],
+        "plateau_on": on["plateau_records_per_s"],
+        "seconds": result["seconds"],
+    }, indent=2))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    return result
+
+
+if __name__ == "__main__":
+    raise SystemExit(0 if main()["gate_passed"] else 1)

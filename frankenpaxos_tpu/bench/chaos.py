@@ -68,9 +68,12 @@ def collect_flight_record(bench: BenchmarkDirectory,
 
 def relaunch_role(bench: BenchmarkDirectory, label: str,
                   host: "LocalHost | None" = None):
-    """Restart ``label`` with its recorded command. The old log moves
-    aside (``<label>.log.killed<N>``) so the relaunch does not destroy
-    the pre-kill evidence."""
+    """Restart ``label`` with its recorded command, RE-READ from the
+    launch spec at call time (``bench.role_commands`` -- so a spec
+    updated since launch, e.g. by a replacement swap, relaunches the
+    current membership, not a stale snapshot). The old log moves aside
+    (``<label>.log.killed<N>``) so the relaunch does not destroy the
+    pre-kill evidence."""
     cmd, env = bench.role_commands[label]
     log = bench.abspath(f"{label}.log")
     if os.path.exists(log):
@@ -81,14 +84,60 @@ def relaunch_role(bench: BenchmarkDirectory, label: str,
     return bench.popen(host or LocalHost(), label, cmd, env=env)
 
 
+def wait_relaunched_ready(bench: BenchmarkDirectory, labels,
+                          host: "LocalHost | None" = None,
+                          timeout_s: float = 60.0) -> None:
+    """Block until every relaunched ``label`` reports "listening" in
+    its FRESH log (relaunch_role moved the pre-kill log aside, so the
+    grep can't match stale output). The launch-time connect-back
+    handshake is gone by now -- its listener closed after
+    ``launch_roles`` -- so readiness after a mid-run relaunch is the
+    log-grep seam, same as remote hosts use at launch."""
+    host = host or LocalHost()
+    deadline = time.time() + timeout_s
+    pending = set(labels)
+    while pending and time.time() < deadline:
+        ready = host.grep_ready(
+            [bench.abspath(f"{label}.log") for label in pending],
+            "listening")
+        pending -= {label for label in pending
+                    if bench.abspath(f"{label}.log") in ready}
+        if pending:
+            time.sleep(0.1)
+    if pending:
+        raise RuntimeError(
+            f"relaunched roles never became ready: {sorted(pending)}")
+
+
+def kill_relaunch(bench: BenchmarkDirectory, labels, *,
+                  down_s: float = 0.5,
+                  host: "LocalHost | None" = None,
+                  wait_ready: bool = False,
+                  ready_timeout_s: float = 60.0) -> list:
+    """THE kill -> dwell -> relaunch (-> reready) sequence, shared by
+    the per-role and per-zone wrappers below and by the paxchaos
+    deployed fault backend (faults/deployed_backend.py) -- previously
+    copied three times with drifting details. SIGKILLs every label (no
+    grace, flight post-mortems snapshotted), leaves them dead for
+    ``down_s`` (requests that depended on them must ride resends),
+    relaunches each verbatim from the recorded launch spec, and
+    optionally blocks until the relaunches report listening."""
+    for label in labels:
+        sigkill_role(bench, label)
+    time.sleep(down_s)
+    procs = [relaunch_role(bench, label, host=host) for label in labels]
+    if wait_ready:
+        wait_relaunched_ready(bench, labels, host=host,
+                              timeout_s=ready_timeout_s)
+    return procs
+
+
 def kill_restart_role(bench: BenchmarkDirectory, label: str,
                       down_s: float = 0.5,
                       host: "LocalHost | None" = None):
-    """SIGKILL ``label``, leave it dead for ``down_s`` (requests that
-    depended on it must ride resends), then relaunch it."""
-    sigkill_role(bench, label)
-    time.sleep(down_s)
-    return relaunch_role(bench, label, host=host)
+    """SIGKILL ``label``, dwell, relaunch (one label through
+    :func:`kill_relaunch`)."""
+    return kill_relaunch(bench, [label], down_s=down_s, host=host)[0]
 
 
 # --- paxepoch repair: reconfigure-out + replacement -------------------------
@@ -210,10 +259,9 @@ def kill_restart_zone(bench: BenchmarkDirectory, labels,
                       host: "LocalHost | None" = None) -> list:
     """SIGKILL a whole zone, leave it dark for ``down_s`` (steals of
     its objects block on the dead row -- the f_z = 0 tradeoff,
-    docs/GEO.md), then relaunch it verbatim."""
-    sigkill_zone(bench, labels)
-    time.sleep(down_s)
-    return relaunch_zone(bench, labels, host=host)
+    docs/GEO.md), then relaunch it verbatim (one zone through
+    :func:`kill_relaunch`)."""
+    return kill_relaunch(bench, labels, down_s=down_s, host=host)
 
 
 def steal_group(transport, leader_address, group: int) -> None:

@@ -49,6 +49,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                  wal_dir: "str | None" = None,
                  trace_dir: "str | None" = None,
                  trace_sample: float = 1.0,
+                 extra_role_args: "dict | None" = None,
                  host=None) -> list:
     """Start every role of ``protocol_name`` as a subprocess and wait
     until each reports it is listening.
@@ -78,6 +79,12 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
     WAL-capable roles log to <wal_dir>/<label> and recover on
     relaunch -- the seam the chaos driver (bench/chaos.py) uses to
     SIGKILL and resurrect roles mid-benchmark.
+
+    ``extra_role_args`` maps a role label to extra CLI args appended
+    to THAT role's command only (paxchaos: per-acceptor
+    ``--fault_fsync`` arming from ``faults.fsync_fault_args``); the
+    args are recorded in the launch spec, so a chaos relaunch keeps
+    the role's fault arming.
 
     ``trace_dir`` turns on paxtrace (``--trace``, obs/): every role
     emits spans to <trace_dir>/<label>.trace.jsonl and keeps its
@@ -144,6 +151,7 @@ def launch_roles(bench: BenchmarkDirectory, protocol_name: str,
                     "--trace_sample", str(trace_sample)]
         for key, value in (overrides or {}).items():
             cmd.append(f"--options.{key}={value}")
+        cmd += (extra_role_args or {}).get(label, [])
         bench.role_commands[label] = (cmd, env)
         bench.popen(host, label, cmd, env=env)
     bench.prometheus_ports = prometheus_ports

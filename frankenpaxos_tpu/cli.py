@@ -76,6 +76,19 @@ def main(argv=None) -> None:
                              "it on startup, so a SIGKILL'd role "
                              "relaunched with the same wal_dir rejoins "
                              "with its state intact")
+    parser.add_argument("--fault_fsync", default=None,
+                        metavar="P:PERIOD:WINDOW|C:EVERY:STALL_S:SEED",
+                        help="paxchaos storage-fault arm (faults/): "
+                             "wrap this role's WAL storage in a "
+                             "BLOCKING FsyncStallStorage -- "
+                             "P:<period_s>:<window_s> sleeps through "
+                             "the first <window_s> of every "
+                             "<period_s> on the host wall clock "
+                             "(aligned across role processes); "
+                             "C:<every>:<stall_s>:<seed> stalls after "
+                             "every EVERY-th group commit. The "
+                             "deployed twin of the scenario matrix's "
+                             "fsync-stall schedule")
     parser.add_argument("--ready_addr", default=None,
                         help="host:port the launcher listens on for the "
                              "wait-for-listen handshake: once this role "
@@ -182,7 +195,8 @@ def main(argv=None) -> None:
     ctx = DeployCtx(config=config, transport=transport, logger=logger,
                     overrides=overrides, seed=args.seed,
                     state_machine=args.state_machine,
-                    collectors=collectors, wal_dir=args.wal_dir)
+                    collectors=collectors, wal_dir=args.wal_dir,
+                    wal_fault=args.fault_fsync)
 
     def make_instrumented(role, role_name, role_address, index):
         """Construct the role actor and, when metrics are on, wrap its

@@ -86,6 +86,12 @@ class DeployCtx:
     # construction -- a SIGKILL'd role relaunched with the same
     # wal_dir rejoins with its promises/votes/SM state intact.
     wal_dir: Any = None
+    # paxchaos (--fault_fsync "every:stall_s:seed"): wrap this role's
+    # WAL storage in a BLOCKING FsyncStallStorage -- the deployed twin
+    # of the scenario matrix's storage-fault arm (faults/,
+    # wal/faults.py). None (the default) leaves the WAL path
+    # completely untouched.
+    wal_fault: Any = None
     consumed: set = dataclasses.field(default_factory=set)
 
     def sm(self):
@@ -101,7 +107,29 @@ class DeployCtx:
 
         from frankenpaxos_tpu.wal import FileStorage, Wal
 
-        return Wal(FileStorage(os.path.join(self.wal_dir, label)))
+        storage = FileStorage(os.path.join(self.wal_dir, label))
+        if self.wal_fault:
+            from frankenpaxos_tpu.wal import FsyncStallStorage
+
+            parts = self.wal_fault.split(":")
+            if parts[0] == "P" and len(parts) == 3:
+                # Periodic windows on the host wall clock -- aligned
+                # across every role process on the machine.
+                storage = FsyncStallStorage(
+                    storage, label=label,
+                    stall_period_s=float(parts[1]),
+                    stall_window_s=float(parts[2]), blocking=True)
+            elif parts[0] == "C" and len(parts) == 4:
+                storage = FsyncStallStorage(
+                    storage, seed=int(parts[3]), label=label,
+                    stall_every=int(parts[1]),
+                    stall_s=float(parts[2]), blocking=True)
+            else:
+                raise ValueError(
+                    "--fault_fsync spec must be P:<period_s>:"
+                    "<window_s> or C:<every>:<stall_s>:<seed>; "
+                    f"got {self.wal_fault!r}")
+        return Wal(storage)
 
     def kw(self, fn) -> dict:
         out = ctor_kwargs(fn, self.overrides)

@@ -1,0 +1,26 @@
+"""paxchaos: one deterministic fault plane compiled to two worlds.
+
+``FaultSchedule`` (string-seeded, digest-identified) + the sim and
+deployed backends -- see schedule.py for the contract and
+docs/GLOBAL.md for the twin methodology.
+"""
+
+from frankenpaxos_tpu.faults.deployed_backend import (  # noqa: F401
+    DeployedBackend,
+    fsync_fault_args,
+    LinkFaults,
+    run_wall,
+)
+from frankenpaxos_tpu.faults.schedule import (  # noqa: F401
+    craq_chain_kill_schedule,
+    FaultEvent,
+    FaultSchedule,
+    fsync_stall_schedule,
+    KINDS,
+    ScheduleRunner,
+    zone_outage_schedule,
+)
+from frankenpaxos_tpu.faults.sim_backend import (  # noqa: F401
+    SimCraqBackend,
+    SimWPaxosBackend,
+)

@@ -1,0 +1,252 @@
+"""The deployed-world compiler for FaultSchedules (paxchaos).
+
+The same abstract plan the sim replays on virtual time, applied to a
+REAL deployment wall-clock: SIGKILL + verbatim relaunch through the
+``bench/chaos.py`` machinery (flight-recorder post-mortems included),
+SIGSTOP/SIGCONT pauses via ``os.kill``, fsync stalls via
+``FsyncStallStorage`` over the role's real ``FileStorage`` (armed at
+launch through the CLI's ``--fault_fsync`` flag -- storage wrapping
+cannot cross a process boundary mid-run, so deployed schedules arm
+storage faults at t=0, which is exactly where the twin scenarios put
+them), and link latency/partition injection at the ``TcpTransport``
+send path (:class:`LinkFaults`).
+
+The wall clock is the caller's: the twin driver polls its
+:class:`~frankenpaxos_tpu.faults.schedule.ScheduleRunner` from a chaos
+thread (`run_wall`), because kill/relaunch/reready block for real
+seconds and must not stall the client event loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from frankenpaxos_tpu.faults.schedule import (
+    FaultEvent,
+    FaultSchedule,
+    ScheduleRunner,
+)
+
+
+class LinkFaults:
+    """The TcpTransport send-path fault table: per (src zone, dst
+    zone) extra latency (seconds) or drop (None). A transport arms it
+    by setting ``transport.link_faults = table.check``; the common
+    case (no entry) costs one dict lookup per outbound message, and an
+    unarmed transport pays nothing at all (the attribute is None).
+
+    Zones are resolved through ``zone_of``, a caller-supplied
+    ``address -> zone | None`` map (deployed addresses are (host,
+    port) tuples; the twin driver builds the map from its cluster
+    config). Unmapped endpoints ride untouched."""
+
+    DROP = None
+
+    def __init__(self, zone_of: Callable):
+        self.zone_of = zone_of
+        #: (src zone, dst zone) -> extra delay seconds, or DROP.
+        self.table: dict = {}
+        self.dropped = 0
+
+    def set_latency(self, zone_a: str, zone_b: str,
+                    extra_s: float, both_ways: bool = True) -> None:
+        self.table[(zone_a, zone_b)] = extra_s
+        if both_ways:
+            self.table[(zone_b, zone_a)] = extra_s
+
+    def partition(self, zone_a: str, zone_b: str,
+                  both_ways: bool = True) -> None:
+        self.table[(zone_a, zone_b)] = self.DROP
+        if both_ways:
+            self.table[(zone_b, zone_a)] = self.DROP
+
+    def heal(self, zone_a: str, zone_b: str,
+             both_ways: bool = True) -> None:
+        self.table.pop((zone_a, zone_b), None)
+        if both_ways:
+            self.table.pop((zone_b, zone_a), None)
+
+    def heal_all(self) -> None:
+        self.table.clear()
+
+    def check(self, src, dst) -> float:
+        """The transport hook: extra delay seconds for this message
+        (0.0 = send now), or None to drop it (partition)."""
+        if not self.table:
+            return 0.0
+        verdict = self.table.get((self.zone_of(src), self.zone_of(dst)),
+                                 0.0)
+        if verdict is None:
+            self.dropped += 1
+        return verdict
+
+
+def fsync_fault_args(schedule: FaultSchedule,
+                     acceptor_label: Callable) -> dict:
+    """Per-role extra CLI args arming the schedule's t=0 fsync-stall
+    events: {role label: ["--fault_fsync", "<spec>"]} where spec is
+    ``P:<period>:<window>`` (periodic windows on the shared wall
+    clock) or ``C:<every>:<stall_s>:<seed>`` (count cadence).
+    ``acceptor_label`` maps the event's "zone:member" target to the
+    deploy registry's role label (e.g. ``acceptor_3``)."""
+    args: dict = {}
+    for event in schedule.launch_events():
+        zone_s, _, member_s = event.target.partition(":")
+        label = acceptor_label(int(zone_s), int(member_s))
+        if event.get("period_s"):
+            spec = (f"P:{float(event.get('period_s'))}"
+                    f":{float(event.get('window_s'))}")
+        else:
+            spec = (f"C:{int(event.get('every'))}"
+                    f":{float(event.get('stall_s'))}:{schedule.seed}")
+        args[label] = ["--fault_fsync", spec]
+    return args
+
+
+class DeployedBackend:
+    """Compile fault events onto a live ``BenchmarkDirectory``
+    deployment. ``zone_roles`` maps zone index -> role labels in kill
+    order (``chaos.wpaxos_zone_roles`` for wpaxos clusters);
+    ``link_faults`` (optional) receives partitions/brownouts;
+    ``on_repair`` (optional) is the protocol-level repair hook the
+    craq twin wires to its ChainReconfigure driver."""
+
+    def __init__(self, bench, *, zone_roles: Optional[dict] = None,
+                 host=None, link_faults: Optional[LinkFaults] = None,
+                 on_repair: Optional[Callable] = None,
+                 ready_timeout_s: float = 60.0):
+        self.bench = bench
+        self.zone_roles = zone_roles or {}
+        self.host = host
+        self.link_faults = link_faults
+        self.on_repair = on_repair
+        self.ready_timeout_s = ready_timeout_s
+        #: wall timestamps of applied events (the twin row records
+        #: them next to the SLO clauses).
+        self.applied: list = []
+
+    def _note(self, event: FaultEvent) -> None:
+        self.applied.append((round(time.time(), 3), event.kind,
+                             event.target))
+
+    # --- process faults ----------------------------------------------------
+    def do_crash_zone(self, event: FaultEvent) -> None:
+        from frankenpaxos_tpu.bench import chaos
+
+        chaos.sigkill_zone(self.bench,
+                           self.zone_roles[int(event.target)])
+        self._note(event)
+
+    def do_restart_zone(self, event: FaultEvent) -> None:
+        from frankenpaxos_tpu.bench import chaos
+
+        labels = self.zone_roles[int(event.target)]
+        chaos.relaunch_zone(self.bench, labels, host=self.host)
+        chaos.wait_relaunched_ready(self.bench, labels, host=self.host,
+                                    timeout_s=self.ready_timeout_s)
+        self._note(event)
+
+    def do_crash_role(self, event: FaultEvent) -> None:
+        from frankenpaxos_tpu.bench import chaos
+
+        chaos.sigkill_role(self.bench, event.target)
+        self._note(event)
+
+    def do_restart_role(self, event: FaultEvent) -> None:
+        from frankenpaxos_tpu.bench import chaos
+
+        chaos.relaunch_role(self.bench, event.target, host=self.host)
+        chaos.wait_relaunched_ready(self.bench, [event.target],
+                                    host=self.host,
+                                    timeout_s=self.ready_timeout_s)
+        self._note(event)
+
+    # --- pause / resume (the real SIGSTOP) ---------------------------------
+    def do_pause(self, event: FaultEvent) -> None:
+        proc = self.bench.labeled_procs[event.target]
+        os.kill(proc.pid(), signal.SIGSTOP)
+        self._note(event)
+
+    def do_resume(self, event: FaultEvent) -> None:
+        proc = self.bench.labeled_procs[event.target]
+        os.kill(proc.pid(), signal.SIGCONT)
+        self._note(event)
+
+    # --- storage faults ----------------------------------------------------
+    def do_fsync_stall(self, event: FaultEvent) -> None:
+        """Deployed storage faults are armed at LAUNCH (the CLI wraps
+        the role's FileStorage before any traffic): the twin driver
+        passes ``fsync_fault_args(schedule, ...)`` into its launch.
+        Firing here just validates the plan put the event at t=0."""
+        if event.t_s != 0.0:
+            raise ValueError(
+                "deployed fsync stalls arm at launch (t=0); "
+                f"got t={event.t_s}")
+        self._note(event)
+
+    # --- network faults ----------------------------------------------------
+    def _links(self) -> LinkFaults:
+        if self.link_faults is None:
+            raise ValueError("no LinkFaults armed on this deployment")
+        return self.link_faults
+
+    def do_partition(self, event: FaultEvent) -> None:
+        links = self._links()
+        region_a, region_b = event.get("region_a"), event.get("region_b")
+        links.partition(region_a, region_b)
+        self._note(event)
+
+    def do_heal(self, event: FaultEvent) -> None:
+        self._links().heal(event.get("region_a"), event.get("region_b"))
+        self._note(event)
+
+    def do_brownout(self, event: FaultEvent) -> None:
+        # ``extra_s`` of added one-way latency -- the same unit the
+        # sim backend expresses through its degrade factor.
+        self._links().set_latency(event.get("zone_a"),
+                                  event.get("zone_b"),
+                                  float(event.get("extra_s", 0.0)))
+        self._note(event)
+
+    def do_heal_all(self, event: FaultEvent) -> None:
+        if self.link_faults is not None:
+            self.link_faults.heal_all()
+        self._note(event)
+
+    def do_repair(self, event: FaultEvent) -> None:
+        if self.on_repair is None:
+            raise ValueError("schedule contains a repair event but no "
+                             "on_repair hook was wired")
+        self.on_repair(event)
+        self._note(event)
+
+
+def run_wall(runner: ScheduleRunner,
+             stop: Optional[threading.Event] = None,
+             tick_s: float = 0.05) -> threading.Thread:
+    """Replay a schedule wall-clock on a daemon chaos thread: sleeps
+    to each event's offset from the thread's start and applies it
+    (kill/relaunch/reready block for real seconds, which is why this
+    never runs on the client event loop). Returns the started
+    thread; join it (or set ``stop``) before tearing the bench down."""
+    stop = stop or threading.Event()
+
+    def loop() -> None:
+        t0 = time.monotonic()
+        while not runner.done() and not stop.is_set():
+            t_next = runner.next_time()
+            now = time.monotonic() - t0
+            if t_next > now:
+                stop.wait(min(tick_s, t_next - now))
+                continue
+            runner.poll(now)
+
+    thread = threading.Thread(target=loop, daemon=True,
+                              name="paxchaos-wall")
+    thread.stop = stop  # type: ignore[attr-defined]
+    thread.start()
+    return thread

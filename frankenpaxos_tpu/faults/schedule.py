@@ -1,0 +1,254 @@
+"""paxchaos: one fault plane, two worlds (the FaultSchedule).
+
+The scenario matrix (PR 13) and the deployed chaos harness (PR 3/9)
+inject the SAME fault classes -- role kills, zone outages, fsync
+stalls, partitions -- through two completely disjoint sets of ad-hoc
+wiring: virtual-time calls sprinkled through ``scenarios/matrix.py``
+on one side, SIGKILL helpers hand-sequenced inside deployment tests on
+the other. Nothing guaranteed the two worlds ever ran the *same*
+fault plan, so no deployed run could be called a twin of a sim row.
+
+This module is the single fault plane: a :class:`FaultSchedule` is a
+frozen, string-seeded list of :class:`FaultEvent` rows (time offset,
+kind, target, params) that COMPILES TO BOTH BACKENDS --
+
+* the sim world (:mod:`frankenpaxos_tpu.faults.sim_backend`):
+  ``GeoSimTransport`` chaos controls, ``GeoTopology`` partitions/
+  brownouts, ``wal/faults.FsyncStallStorage`` with the virtual-time
+  ``stall_sender`` bridge, harness ``crash_zone``/``restart_zone``;
+* the deployed world (:mod:`frankenpaxos_tpu.faults.deployed_backend`):
+  ``bench/chaos.py``'s SIGKILL + verbatim-relaunch machinery, SIGSTOP/
+  SIGCONT via ``os.kill``, ``FsyncStallStorage`` wrapping a real
+  ``FileStorage`` (armed at role launch through the CLI), and latency/
+  partition injection at the ``TcpTransport`` send path.
+
+DETERMINISM: a schedule is a pure function of ``(name, seed)``. Event
+parameters that want jitter draw from :meth:`FaultSchedule.rng`, a
+``random.Random`` seeded with the STRING key
+``paxchaos|<name>|<seed>|<event index>`` (sha512 string seeding,
+PYTHONHASHSEED-proof -- the same contract the geo layer and
+``FsyncStallStorage`` already enforce). :meth:`FaultSchedule.digest`
+is a sha256 over the canonical event list; the sim golden pins it and
+the deployed twin records it next to its SLO row, so "both worlds ran
+the same schedule" is a checkable equality, not a comment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import random
+from typing import Iterable, Optional
+
+#: The closed fault vocabulary. Backends must implement every kind
+#: (``do_<kind>``); an unknown kind fails schedule construction, not a
+#: run half-way through.
+KINDS = (
+    "crash_role",        # target: role label ("leader_0", sim: address)
+    "restart_role",      # relaunch target verbatim (WAL roles recover)
+    "crash_zone",        # target: zone index as str ("0")
+    "restart_zone",      # relaunch a killed zone (acceptors from WAL)
+    "pause",             # SIGSTOP twin: target stops making progress
+    "resume",            # SIGCONT: target runs again
+    "fsync_stall",       # arm FsyncStallStorage on target acceptor
+    "partition",         # params: region_a, region_b (both directions)
+    "heal",              # undo one partition
+    "brownout",          # params: zone_a, zone_b, extra_s -- ADD this
+                         # many seconds of one-way latency to the link
+                         # (0 restores). Sim maps it onto the
+                         # topology's multiplicative degrade; deployed
+                         # injects it flat at the send path -- SAME
+                         # physical meaning in both worlds.
+    "heal_all",          # heal every partition/brownout
+    "repair",            # protocol-level repair (CRAQ chain re-link)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One fault at ``t_s`` seconds after the schedule's start.
+
+    ``params`` is a tuple of ``(key, value)`` pairs (sorted by key at
+    construction) so events stay hashable and the digest is canonical.
+    """
+
+    t_s: float
+    kind: str
+    target: str = ""
+    params: tuple = ()
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"known: {KINDS}")
+        object.__setattr__(self, "params",
+                           tuple(sorted(self.params)))
+
+    def get(self, key: str, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+    def canonical(self) -> str:
+        params = ",".join(f"{k}={v!r}" for k, v in self.params)
+        return f"{self.t_s:.6f}|{self.kind}|{self.target}|{params}"
+
+
+class FaultSchedule:
+    """An ordered, immutable fault plan. Build with :meth:`add` (which
+    returns self for chaining) then treat as frozen: backends iterate
+    ``events``; :meth:`digest` identifies the plan."""
+
+    def __init__(self, name: str, seed: int = 0,
+                 events: Optional[Iterable[FaultEvent]] = None):
+        self.name = name
+        self.seed = seed
+        self.events: tuple[FaultEvent, ...] = tuple(
+            sorted(events or (), key=lambda e: e.t_s))
+
+    def add(self, t_s: float, kind: str, target: str = "",
+            **params) -> "FaultSchedule":
+        event = FaultEvent(t_s=t_s, kind=kind, target=target,
+                           params=tuple(params.items()))
+        self.events = tuple(sorted(self.events + (event,),
+                                   key=lambda e: e.t_s))
+        return self
+
+    def rng(self, event_index: int) -> random.Random:
+        """String-seeded per-event RNG for parameter jitter (sha512
+        seeding -- deterministic across processes and platforms)."""
+        return random.Random(
+            f"paxchaos|{self.name}|{self.seed}|{event_index}")
+
+    def canonical(self) -> str:
+        head = f"paxchaos-schedule|{self.name}|{self.seed}\n"
+        return head + "\n".join(e.canonical() for e in self.events)
+
+    def digest(self) -> str:
+        """sha256 over the canonical event list -- the cross-world
+        identity the twin rows record and the golden test pins."""
+        return hashlib.sha256(self.canonical().encode()).hexdigest()
+
+    def launch_events(self) -> list:
+        """Events at t == 0 that deployed backends must apply BEFORE
+        role launch (fsync-stall arming crosses the process boundary
+        via CLI flags; it cannot be injected into a live role)."""
+        return [e for e in self.events
+                if e.t_s == 0.0 and e.kind == "fsync_stall"]
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+
+class ScheduleRunner:
+    """Replays one schedule against one backend. The caller owns the
+    clock: ``poll(now)`` fires everything due at or before ``now``
+    (sim: the transport's virtual clock between driver ticks;
+    deployed: ``time.monotonic() - t0`` from the chaos thread), so the
+    runner itself contains no time source and stays world-neutral."""
+
+    def __init__(self, schedule: FaultSchedule, backend, t0: float = 0.0):
+        self.schedule = schedule
+        self.backend = backend
+        self.t0 = t0
+        self._next = 0
+        #: (fire_time, event) log -- the twin rows record it.
+        self.fired: list = []
+
+    def next_time(self) -> Optional[float]:
+        if self._next >= len(self.schedule.events):
+            return None
+        return self.t0 + self.schedule.events[self._next].t_s
+
+    def poll(self, now: float) -> int:
+        """Fire every event due at or before ``now``; returns how many
+        fired."""
+        fired = 0
+        events = self.schedule.events
+        while self._next < len(events) \
+                and self.t0 + events[self._next].t_s <= now + 1e-9:
+            event = events[self._next]
+            self._next += 1
+            getattr(self.backend, f"do_{event.kind}")(event)
+            self.fired.append((now, event))
+            fired += 1
+        return fired
+
+    def done(self) -> bool:
+        return self._next >= len(self.schedule.events)
+
+    def drive(self, driver, t_end: float) -> None:
+        """Sim-side exact-time replay: advance a GeoOverloadDriver to
+        each event's virtual instant, fire it, and continue to
+        ``t_end`` -- the schedule lands at the same virtual times the
+        hand-rolled scenario code used to pick, so per-seed delivery
+        histories stay byte-reproducible."""
+        while True:
+            t = self.next_time()
+            if t is None or t >= t_end - 1e-9:
+                break
+            if t > driver.now:
+                driver.run_for(t - driver.now)
+            self.poll(driver.now)
+        if t_end > driver.now:
+            driver.run_for(t_end - driver.now)
+
+
+# --- the twin schedules ------------------------------------------------------
+#
+# The matrix scenarios and their deployed twins build their plans HERE
+# -- one builder, two worlds -- so the only thing a world contributes
+# is its backend and its clock.
+
+
+def zone_outage_schedule(*, t_kill: float, dwell_s: float,
+                         zone: int = 0, seed: int = 0) -> FaultSchedule:
+    """SIGKILL a whole zone at ``t_kill``, relaunch it ``dwell_s``
+    later (acceptors recover from their WALs, leader/replica come back
+    amnesiac) -- the ``zone_outage_peak`` fault plan."""
+    return (FaultSchedule("zone_outage", seed=seed)
+            .add(t_kill, "crash_zone", str(zone))
+            .add(t_kill + dwell_s, "restart_zone", str(zone)))
+
+
+def fsync_stall_schedule(*, window_s: float = 0.15,
+                         zone: int = 0,
+                         periods: tuple = ((0, 0.8), (1, 2.4)),
+                         seed: int = 0) -> FaultSchedule:
+    """Arm deterministic PERIODIC-WINDOW fsync stalls on two of
+    ``zone``'s acceptors (armed at t=0 -- storage wrapping happens
+    before traffic): each target's disk is slow for the first
+    ``window_s`` of every period (the background-flush shape from
+    "Paxos in the Cloud"). The periods are chosen so acceptor 0
+    stalls often but usually ALONE (the row quorum masks it) while
+    every one of acceptor 1's windows OVERLAPS one of acceptor 0's
+    (2.4 is a multiple of 0.8) -- only those commits reach the client
+    tail. Windows anchor at clock zero (virtual clock in the sim, the
+    shared host wall clock deployed), so the overlap alignment holds
+    in BOTH worlds -- a sync-count cadence drifts apart deployed the
+    moment one blocking stall compresses the stalled role's backlog
+    into a single drain."""
+    schedule = FaultSchedule("fsync_stalls", seed=seed)
+    for member, period_s in periods:
+        schedule.add(0.0, "fsync_stall", f"{zone}:{member}",
+                     period_s=period_s, window_s=window_s)
+    return schedule
+
+
+def craq_chain_kill_schedule(*, t_kill: float, node: int,
+                             reconfigure_after_s: float,
+                             seed: int = 0) -> FaultSchedule:
+    """Kill chain node ``node`` mid-run, then (after a detection
+    dwell) re-link the chain around it -- the plan that ends the craq
+    row's chaos exemption. The re-link itself is protocol machinery
+    (``ChainReconfigure`` with the dirty-version handoff); the
+    backend's ``do_repair`` fires it, so both worlds kill and re-link
+    on the same plan."""
+    return (FaultSchedule("craq_chain_kill", seed=seed)
+            .add(t_kill, "crash_role", f"chain_node_{node}")
+            .add(t_kill + reconfigure_after_s, "repair",
+                 f"chain:{node}"))

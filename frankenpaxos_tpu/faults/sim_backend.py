@@ -1,0 +1,178 @@
+"""The sim-world compiler for FaultSchedules (paxchaos).
+
+Maps the abstract fault vocabulary onto the virtual-time chaos
+controls that already exist -- harness ``crash_zone``/``restart_zone``
+(SIGKILL semantics: volatile state dies, ``MemStorage`` WALs survive),
+``GeoTopology`` partitions/brownouts (vectorized into the wave
+engine's link masks), ``GeoSimTransport.stall_sender`` (a role blocked
+in a syscall emits late), and ``wal/faults.FsyncStallStorage`` with
+the virtual-time bridge -- so a schedule replayed here is a pure
+function of its seed: the golden test pins both the schedule digest
+and the delivery-history digest.
+"""
+
+from __future__ import annotations
+
+from frankenpaxos_tpu.faults.schedule import FaultEvent
+
+
+class SimWPaxosBackend:
+    """Compile fault events onto a ``WPaxosSim`` + ``GeoTopology``
+    pair (the scenario matrix's cluster shape)."""
+
+    def __init__(self, sim, topology, seed: int = 0):
+        self.sim = sim
+        self.topology = topology
+        self.seed = seed
+        #: address -> armed FsyncStallStorage (the scenario records
+        #: the injected schedule next to its SLO row).
+        self.stall_storages: dict = {}
+
+    # --- process faults ----------------------------------------------------
+    def do_crash_zone(self, event: FaultEvent) -> None:
+        from tests.protocols.wpaxos_harness import crash_zone
+
+        crash_zone(self.sim, int(event.target))
+
+    def do_restart_zone(self, event: FaultEvent) -> None:
+        from tests.protocols.wpaxos_harness import restart_zone
+
+        restart_zone(self.sim, int(event.target))
+
+    def do_crash_role(self, event: FaultEvent) -> None:
+        self.sim.transport.crash(event.target)
+
+    def do_restart_role(self, event: FaultEvent) -> None:
+        raise NotImplementedError(
+            "sim role restarts are zone-granular (restart_zone); "
+            "per-role restarts need a harness-specific backend")
+
+    # --- pause (the SIGSTOP twin) ------------------------------------------
+    def do_pause(self, event: FaultEvent) -> None:
+        """A paused process makes no progress: its sends hold until
+        the resume horizon (``stall_sender``). Approximation relative
+        to a real SIGSTOP: inbound frames still deliver to the actor's
+        handler at arrival (as they would queue in the kernel), but
+        every visible effect -- acks, votes, timer-driven resends'
+        frames -- departs at the horizon, which is the part the
+        protocols can observe. ``until_s`` is the schedule-relative
+        resume time (the paired ``resume`` event documents it)."""
+        until = event.get("until_s")
+        if until is None:
+            raise ValueError("pause needs until_s (sim stalls only "
+                             "extend; see stall_sender)")
+        self.sim.transport.stall_sender(event.target, float(until))
+
+    def do_resume(self, event: FaultEvent) -> None:
+        # stall_sender horizons expire on their own once the clock
+        # passes them; resume is explicit only in the deployed world
+        # (SIGCONT). Nothing to do here.
+        pass
+
+    # --- storage faults ----------------------------------------------------
+    def do_fsync_stall(self, event: FaultEvent) -> None:
+        """Wrap acceptor ``zone:member``'s WAL storage in a
+        deterministic FsyncStallStorage (periodic-window mode on the
+        VIRTUAL clock) and bridge each stall into virtual time: the
+        stalled role's drain releases its held acks at the stall
+        horizon, exactly where a real fsync stall lands (between the
+        fsync and the send-release stage)."""
+        from frankenpaxos_tpu.wal import FsyncStallStorage
+
+        zone_s, _, member_s = event.target.partition(":")
+        zone, member = int(zone_s), int(member_s)
+        row_width = len(self.sim.config.acceptor_addresses[0])
+        acceptor = self.sim.acceptors[zone * row_width + member]
+        assert acceptor.zone == zone
+        transport = self.sim.transport
+        address = acceptor.address
+
+        def bridge(stall_s, _a=address):
+            transport.stall_sender(_a, transport.now + stall_s)
+
+        wrapped = FsyncStallStorage(
+            acceptor.wal.storage, seed=self.seed, label=str(address),
+            stall_period_s=float(event.get("period_s", 0.0)),
+            stall_window_s=float(event.get("window_s", 0.0)),
+            clock=lambda: transport.now,
+            stall_every=int(event.get("every", 0)),
+            stall_s=float(event.get("stall_s", 0.05)),
+            on_stall=bridge)
+        acceptor.wal.storage = wrapped
+        self.sim.wal_storages[address] = wrapped
+        self.stall_storages[str(address)] = wrapped
+
+    # --- network faults ----------------------------------------------------
+    def do_partition(self, event: FaultEvent) -> None:
+        self.topology.partition_regions(event.get("region_a"),
+                                        event.get("region_b"))
+
+    def do_heal(self, event: FaultEvent) -> None:
+        self.topology.heal_regions(event.get("region_a"),
+                                   event.get("region_b"))
+
+    def do_brownout(self, event: FaultEvent) -> None:
+        """``extra_s`` of ADDED one-way latency (the cross-world
+        brownout unit -- the deployed backend injects the same
+        seconds flat at the TcpTransport send path), expressed here
+        as the multiplicative degrade factor that adds exactly that
+        much to the link's base delay. 0 restores."""
+        zone_a, zone_b = event.get("zone_a"), event.get("zone_b")
+        extra_s = float(event.get("extra_s", 0.0))
+        base_s = self.topology.link(zone_a, zone_b).base_s
+        self.topology.degrade_link(zone_a, zone_b,
+                                   1.0 + extra_s / base_s)
+
+    def do_heal_all(self, event: FaultEvent) -> None:
+        self.topology.heal_all()
+
+    def do_repair(self, event: FaultEvent) -> None:
+        raise NotImplementedError(
+            "repair is protocol machinery; scenario backends override")
+
+
+class SimCraqBackend:
+    """Compile the craq chain-kill plan onto an in-process chain over
+    GeoSimTransport. ``do_repair`` drives the chain re-link with the
+    dirty-version handoff (``protocols/craq.ChainReconfigure``)."""
+
+    def __init__(self, transport, nodes, clients):
+        self.transport = transport
+        self.nodes = list(nodes)
+        self.clients = list(clients)
+        self.killed: set[int] = set()
+        self.reconfigured_to: tuple = ()
+
+    def do_crash_role(self, event: FaultEvent) -> None:
+        index = int(event.target.rsplit("_", 1)[1])
+        self.transport.crash(self.nodes[index].address)
+        self.killed.add(index)
+
+    def do_repair(self, event: FaultEvent) -> None:
+        """Re-link the chain around every killed node: the surviving
+        nodes (and every client) adopt the new chain under a bumped
+        version; new-tail/dirty handoff happens inside the nodes'
+        ``ChainReconfigure`` handlers."""
+        from frankenpaxos_tpu.protocols.craq import ChainReconfigure
+
+        survivors = tuple(node.address
+                          for i, node in enumerate(self.nodes)
+                          if i not in self.killed)
+        version = max(node.chain_version
+                      for i, node in enumerate(self.nodes)
+                      if i not in self.killed) + 1
+        self.reconfigured_to = survivors
+        message = ChainReconfigure(version=version, chain=survivors)
+        for i, node in enumerate(self.nodes):
+            if i not in self.killed:
+                self.transport.send("chain-controller", node.address,
+                                    node.serializer.to_bytes(message))
+        for client in self.clients:
+            self.transport.send("chain-controller", client.address,
+                                client.serializer.to_bytes(message))
+
+    def __getattr__(self, name):
+        if name.startswith("do_"):
+            raise NotImplementedError(
+                f"{name[3:]} is not part of the craq chain plan")
+        raise AttributeError(name)

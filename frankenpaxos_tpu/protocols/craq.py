@@ -51,6 +51,13 @@ class WriteBatch:
     # the randomized sim reorders chain hops and caught value regression
     # without it.
     seq: int = 0
+    # paxchaos chain-configuration fence: the chain version this batch
+    # belongs to. A reconfigured chain bumps the version and re-stamps
+    # its dirty (pending) batches, so delayed frames from the old era
+    # -- including a dead head's in-flight sequence numbers that would
+    # otherwise COLLIDE with the new head's -- drop at receive instead
+    # of corrupting the order (docs/DURABILITY.md).
+    version: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +92,21 @@ class ReadReply:
     value: str
 
 
+@dataclasses.dataclass(frozen=True)
+class ChainReconfigure:
+    """Chain re-link (paxchaos): adopt ``chain`` (surviving nodes, in
+    order) as configuration ``version``. Controller-driven, sent to
+    every surviving node AND every client after a node kill; nodes
+    perform the dirty-version handoff on adoption (a node that becomes
+    tail applies + acks + replies its whole pending backlog -- those
+    writes include everything the dead tail acked, so no acked write
+    is lost; a node with a new successor re-propagates its pending
+    under the new version, deduped downstream by seq)."""
+
+    version: int
+    chain: tuple
+
+
 class ChainNode(Actor):
     """``admission`` (a serve.admission.AdmissionOptions, or None)
     arms paxload admission control on this node's CLIENT edge: bare
@@ -115,6 +137,14 @@ class ChainNode(Actor):
             transport.note_admission(address, self)
         self.is_head = self.index == 0
         self.is_tail = self.index == len(config.chain_node_addresses) - 1
+        #: paxchaos: the chain-configuration fence. Batches/acks from
+        #: another version drop at receive; ChainReconfigure bumps it.
+        self.chain_version = 0
+        #: Set when a reconfiguration removes THIS node: a fenced
+        #: node serves nothing (a partitioned-but-alive old tail
+        #: answering a delayed pinned read from its frozen state
+        #: would violate the read guarantee the re-link preserves).
+        self.fenced_out = False
         self.pending_writes: list[WriteBatch] = []
         self.state_machine: dict[str, str] = {}
         self.versions = 0
@@ -138,7 +168,11 @@ class ChainNode(Actor):
         self._resend_timer = None
         if not self.is_tail:
             def resend():
-                if self.pending_writes:
+                # Reads config/index/is_tail dynamically: a re-linked
+                # chain (ChainReconfigure) retargets the resend to the
+                # NEW successor; a node that became tail has nothing
+                # pending to push.
+                if self.pending_writes and not self.is_tail:
                     self.send(
                         self.config.chain_node_addresses[self.index + 1],
                         self.pending_writes[0])
@@ -171,7 +205,8 @@ class ChainNode(Actor):
                 fresh.append(write)
             if not fresh:
                 return
-            batch = WriteBatch(writes=tuple(fresh), seq=self._next_seq)
+            batch = WriteBatch(writes=tuple(fresh), seq=self._next_seq,
+                               version=self.chain_version)
             self._next_seq += 1
             self._accept_in_order(batch)
             return
@@ -190,6 +225,19 @@ class ChainNode(Actor):
 
     def _accept_in_order(self, batch: WriteBatch) -> None:
         self._next_in = batch.seq + 1
+        # Passive at-most-once maintenance on EVERY node (not just the
+        # head): each node sees every write flow past, so a node
+        # promoted to head by a chain re-link inherits a live
+        # duplicate-suppression map instead of an empty one -- a late
+        # client duplicate can never be re-sequenced over a newer
+        # committed value just because the original head died.
+        for write in batch.writes:
+            key = (write.command_id.client_address,
+                   write.command_id.client_pseudonym)
+            last_id, _ = self._sequenced.get(key, (-1, -1))
+            if write.command_id.client_id >= last_id:
+                self._sequenced[key] = (write.command_id.client_id,
+                                        batch.seq)
         if not self.is_tail:
             self.pending_writes.append(batch)
             self.send(self.config.chain_node_addresses[self.index + 1],
@@ -250,6 +298,83 @@ class ChainNode(Actor):
                       ReadReply(read.command_id, value))
             self.versions += 1
 
+    # --- chain reconfiguration (paxchaos) ---------------------------------
+    def _handle_reconfigure(self, m: ChainReconfigure) -> None:
+        """Adopt a re-linked chain with the dirty-version handoff.
+
+        The controller removed dead node(s) from the chain; survivors
+        keep their sequence state (``_next_in``/``_next_ack`` carry
+        over -- the surviving prefix saw a superset of what any
+        successor saw, so re-propagation + seq dedup heals every gap).
+        Three role transitions matter:
+
+        * became TAIL (old tail died): every pending batch is, by the
+          chain invariant, a superset of everything the dead tail
+          acked -- apply them all in order, reply, and ack upstream
+          (duplicate replies/applies are absorbed by client dedup and
+          last-write-wins per key). Zero acked writes lost.
+        * new SUCCESSOR (mid node died): re-propagate the whole
+          pending backlog under the new version; downstream dedupes by
+          seq and re-acks what it already acked.
+        * became HEAD (old head died): continue the sequence space at
+          ``max(_next_seq, _next_in)`` -- old-era in-flight seqs that
+          could collide are fenced off by the version bump -- with the
+          passively-maintained at-most-once map intact.
+        """
+        if m.version <= self.chain_version:
+            return
+        if self.address not in m.chain:
+            # Reconfigured OUT (we were presumed dead): stop serving
+            # the chain ENTIRELY -- a zombie tail answering stale
+            # reads is the failure mode the fence exists for, and the
+            # read path has no version field of its own, so the fence
+            # is a node-level flag checked at receive.
+            self.chain_version = m.version
+            self.fenced_out = True
+            self.pending_writes.clear()
+            self._in_buffer.clear()
+            self._ack_buffer.clear()
+            return
+        self.chain_version = m.version
+        self.fenced_out = False
+        self.config = CraqConfig(chain_node_addresses=tuple(m.chain))
+        was_tail = self.is_tail
+        self.index = list(m.chain).index(self.address)
+        self.is_head = self.index == 0
+        self.is_tail = self.index == len(m.chain) - 1
+        # Cross-era reorder buffers die with the old era: upstream
+        # re-propagation re-delivers anything that mattered.
+        self._in_buffer.clear()
+        self._ack_buffer.clear()
+        # Re-stamp the dirty backlog into the new era (the periodic
+        # resend timer then speaks the current version too).
+        self.pending_writes = [
+            dataclasses.replace(batch, version=m.version)
+            for batch in self.pending_writes]
+        if self.is_head:
+            self._next_seq = max(self._next_seq, self._next_in)
+        if self.is_tail and not was_tail:
+            # Dirty-version handoff: drain the pending backlog as the
+            # new tail -- apply, reply, ack upstream, in seq order.
+            backlog, self.pending_writes = self.pending_writes, []
+            for batch in backlog:
+                for write in batch.writes:
+                    self.state_machine[write.key] = write.value
+                    self.send(write.command_id.client_address,
+                              ClientReply(write.command_id))
+                    self.versions += 1
+                self._next_ack = max(self._next_ack, batch.seq + 1)
+                if not self.is_head:
+                    self.send(
+                        self.config.chain_node_addresses[self.index - 1],
+                        Ack(batch))
+        elif not self.is_tail:
+            # Possibly-new successor: push the whole backlog at it
+            # (dedup by seq downstream); its own acks flow back.
+            successor = self.config.chain_node_addresses[self.index + 1]
+            for batch in self.pending_writes:
+                self.send(successor, batch)
+
     # --- dispatch ---------------------------------------------------------
     def _admit_client(self, message) -> bool:
         """Admit one client-edge command, or answer ``Rejected`` (the
@@ -280,11 +405,27 @@ class ChainNode(Actor):
                     for batch in self.pending_writes))
 
     def receive(self, src: Address, message) -> None:
+        if self.fenced_out:
+            # Reconfigured out of the chain: drop EVERYTHING (reads
+            # included -- they carry no version to fence on). Clients
+            # conclude via their own resend-to-current-chain path.
+            if isinstance(message, ChainReconfigure):
+                self._handle_reconfigure(message)
+            return
         if isinstance(message, Write):
+            if not self.is_head:
+                # A client racing a chain re-link (its config updated
+                # before ours, or a stale frame to a demoted head):
+                # drop -- the client's resend lands once the
+                # configuration settles.
+                return
             if not self._admit_client(message):
                 return
-            self._process_write_batch(WriteBatch((message,)))
+            self._process_write_batch(
+                WriteBatch((message,), version=self.chain_version))
         elif isinstance(message, WriteBatch):
+            if message.version != self.chain_version:
+                return  # old-era frame fenced off (see WriteBatch)
             self._process_write_batch(message)
         elif isinstance(message, Read):
             if not self._admit_client(message):
@@ -293,9 +434,13 @@ class ChainNode(Actor):
         elif isinstance(message, ReadBatch):
             self._process_read_batch(message)
         elif isinstance(message, Ack):
+            if message.write_batch.version != self.chain_version:
+                return
             self._handle_ack(message)
         elif isinstance(message, TailRead):
             self._handle_tail_read(message)
+        elif isinstance(message, ChainReconfigure):
+            self._handle_reconfigure(message)
         else:
             self.logger.fatal(f"unexpected chain node message {message!r}")
 
@@ -336,6 +481,7 @@ class CraqClient(Actor):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        self.chain_version = 0
         self.rng = random.Random(seed)
         self.resend_period_s = resend_period_s
         self.retry_budget = retry_budget
@@ -362,6 +508,16 @@ class CraqClient(Actor):
             id, callback or (lambda *_: None), timer,
             request=request, dst=dst, is_read=is_read)
 
+    def _read_target(self) -> Address:
+        if self.read_node is not None:
+            # Clamp: a re-linked (shorter) chain keeps the pin valid;
+            # zone affinity is best-effort after a reconfiguration.
+            index = min(self.read_node,
+                        len(self.config.chain_node_addresses) - 1)
+            return self.config.chain_node_addresses[index]
+        return self.config.chain_node_addresses[self.rng.randrange(
+            len(self.config.chain_node_addresses))]
+
     def _resend(self, pseudonym: int) -> None:
         pending = self.pending.get(pseudonym)
         if pending is None:
@@ -371,6 +527,13 @@ class CraqClient(Actor):
             self._giveup(pseudonym)
             return
         pending.attempts += 1
+        # Re-derive the destination from the CURRENT chain (paxchaos:
+        # a ChainReconfigure may have removed the node this op was
+        # pinned to -- writes re-target the head, reads the clamped
+        # read pin), so in-flight ops survive a re-link on their own
+        # resend schedule.
+        pending.dst = (self._read_target() if pending.is_read
+                       else self.config.chain_node_addresses[0])
         self.send(pending.dst, pending.request)
         timer = pending.resend_timer
         timer.set_delay(self.resend_period_s)
@@ -423,13 +586,8 @@ class CraqClient(Actor):
 
     def read(self, pseudonym: int, key: str,
              callback: Optional[Callable[[str], None]] = None) -> None:
-        if self.read_node is not None:
-            node = self.config.chain_node_addresses[self.read_node]
-        else:
-            node = self.config.chain_node_addresses[self.rng.randrange(
-                len(self.config.chain_node_addresses))]
-        self._start(pseudonym, lambda cid: Read(cid, key), node,
-                    callback, is_read=True)
+        self._start(pseudonym, lambda cid: Read(cid, key),
+                    self._read_target(), callback, is_read=True)
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, ClientReply):
@@ -438,6 +596,12 @@ class CraqClient(Actor):
         elif isinstance(message, ReadReply):
             pseudonym = message.command_id.client_pseudonym
             result = message.value
+        elif isinstance(message, ChainReconfigure):
+            if message.version > self.chain_version:
+                self.chain_version = message.version
+                self.config = CraqConfig(
+                    chain_node_addresses=tuple(message.chain))
+            return
         elif type(message).__name__ == "Rejected":
             self._handle_rejected(src, message)
             return

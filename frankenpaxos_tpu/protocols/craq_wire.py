@@ -33,7 +33,7 @@ def _cq_take_cid(buf: bytes, at: int):
 
 
 def _cq_put_write_batch(out: bytearray, batch: cq.WriteBatch) -> None:
-    out += _I64.pack(batch.seq)
+    out += _I64I64.pack(batch.seq, batch.version)
     out += _I32.pack(len(batch.writes))
     for write in batch.writes:
         _cq_put_cid(out, write.command_id)
@@ -42,16 +42,16 @@ def _cq_put_write_batch(out: bytearray, batch: cq.WriteBatch) -> None:
 
 
 def _cq_take_write_batch(buf: bytes, at: int):
-    (seq,) = _I64.unpack_from(buf, at)
-    (n,) = _I32.unpack_from(buf, at + 8)
-    at += 12
+    seq, version = _I64I64.unpack_from(buf, at)
+    (n,) = _I32.unpack_from(buf, at + 16)
+    at += 20
     writes = []
     for _ in range(n):
         cid, at = _cq_take_cid(buf, at)
         key, at = _take_bytes(buf, at)
         value, at = _take_bytes(buf, at)
         writes.append(cq.Write(cid, key.decode(), value.decode()))
-    return cq.WriteBatch(tuple(writes), seq=seq), at
+    return cq.WriteBatch(tuple(writes), seq=seq, version=version), at
 
 
 def _cq_put_read_batch(out: bytearray, batch: cq.ReadBatch) -> None:
@@ -182,8 +182,37 @@ class CraqReadCodec(MessageCodec):
         return cq.Read(cid, key.decode()), at
 
 
+class CraqChainReconfigureCodec(MessageCodec):
+    """paxchaos chain re-link (control lane by construction: the tag
+    is outside the client-lane set, so a bounded inbox can never shed
+    the repair that unwedges the chain)."""
+
+    message_type = cq.ChainReconfigure
+    tag = 203
+
+    def encode(self, out, message):
+        out += _I64.pack(message.version)
+        out += _I32.pack(len(message.chain))
+        for address in message.chain:
+            _put_address(out, address)
+
+    def decode(self, buf, at):
+        (version,) = _I64.unpack_from(buf, at)
+        (n,) = _I32.unpack_from(buf, at + 8)
+        if not 0 <= n <= 1024:
+            raise ValueError(f"malformed chain length {n}")
+        at += 12
+        chain = []
+        for _ in range(n):
+            address, at = _take_address(buf, at)
+            chain.append(address)
+        return cq.ChainReconfigure(version=version,
+                                   chain=tuple(chain)), at
+
+
 for _codec in (CraqWriteBatchCodec(), CraqReadBatchCodec(),
                CraqTailReadCodec(), CraqAckCodec(),
                CraqClientReplyCodec(), CraqReadReplyCodec(),
-               CraqWriteCodec(), CraqReadCodec()):
+               CraqWriteCodec(), CraqReadCodec(),
+               CraqChainReconfigureCodec()):
     register_codec(_codec)

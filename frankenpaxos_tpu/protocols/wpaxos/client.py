@@ -51,6 +51,11 @@ class WPaxosClientOptions:
     #: serve.backoff.Backoff); None keeps the adaptive resend timer's
     #: own pacing (the pre-paxworld behavior).
     reject_backoff: object = None
+    #: This client's zone, stamped on every WRequest as
+    #: ``origin_zone`` -- the adaptive-placement EWMA's feed
+    #: (paxchaos). -1 (the default) stamps "unknown", which the
+    #: placement policy ignores.
+    zone: int = -1
 
 
 @dataclasses.dataclass
@@ -143,7 +148,8 @@ class WPaxosClient(Actor):
             WRequest(group=op.group,
                      command=Command(command_id=op.command_id,
                                      command=op.payload),
-                     steal=op.steal))
+                     steal=op.steal,
+                     origin_zone=self.options.zone))
 
     def _restart_timer(self, pseudonym: int, resends: int = 0) -> None:
         delay = self.options.resend_period_s

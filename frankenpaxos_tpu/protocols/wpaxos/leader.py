@@ -63,6 +63,24 @@ class WPaxosLeaderOptions:
     #: network speed -- the classic dueling-proposers livelock, seen
     #: as a stalled deployed smoke on a contended host.
     steal_backoff_s: float = 0.25
+    # paxchaos adaptive placement: per-group request-origin EWMA on
+    # the OWNING leader, evaluated on a timer. When a REMOTE zone's
+    # share of a group's traffic stays above ``placement_dominance``
+    # for ``placement_hysteresis_checks`` consecutive checks AND the
+    # group has been owned at least ``placement_min_dwell_s``, the
+    # owner hands the group off (sends the dominant zone's leader a
+    # Steal trigger). Hysteresis + min-dwell are what make the PR 13
+    # boomerang (instant re-steal wars) unconstructible: a freshly
+    # moved group cannot move again until it has both dwelled and
+    # re-proven a different dominant origin. 0 (the default) disables
+    # the whole policy -- no timer, no counters, no hot-path cost
+    # beyond one None test per owned-group request.
+    placement_check_period_s: float = 0.0
+    placement_ewma_alpha: float = 0.5
+    placement_dominance: float = 0.6
+    placement_min_dwell_s: float = 1.0
+    placement_hysteresis_checks: int = 2
+    placement_min_samples: int = 4
     quorum_backend: str = "dict"     # "dict" oracle | "tpu" fused
     tpu_window: int = 4096
     recover_reply_limit: int = 256
@@ -170,6 +188,23 @@ class WPaxosLeader(Actor):
         self._rng = random.Random(f"wpaxos-leader|{self.zone}")
         self._phase1_timers: dict[int, object] = {}
         self._steal_retry_timers: dict[int, object] = {}
+        # paxchaos adaptive placement (armed only by the knob -- the
+        # unarmed path carries one None test per owned-group request).
+        self._placement = None
+        if options.placement_check_period_s > 0:
+            self._placement = {
+                "counts": {},    # group -> {origin zone: ewma weight}
+                "streak": {},    # group -> [dominant zone, checks]
+                "acquired": {},  # group -> clock() at activation
+            }
+            #: Completed hand-offs, for the scenario telemetry:
+            #: dicts of group / to_zone / t_s / share.
+            self.placement_handoffs: list = []
+            timer = self.timer("placementCheck",
+                               options.placement_check_period_s,
+                               self._placement_check)
+            self._placement_timer = timer
+            timer.start()
         # group -> (timer, entry, set of acked acceptor ids)
         self._epoch_resends: dict[int, tuple] = {}
         # paxload admission (serve/): built only when a knob arms it.
@@ -213,6 +248,10 @@ class WPaxosLeader(Actor):
         if not 0 <= group < self.config.num_groups:
             return
         if group in self.active:
+            if self._placement is not None and m.origin_zone >= 0:
+                counts = self._placement["counts"].setdefault(group, {})
+                counts[m.origin_zone] = counts.get(m.origin_zone, 0.0) \
+                    + 1.0
             self._admit_and_propose(src, m)
             return
         steal = self.stealing.get(group)
@@ -523,6 +562,12 @@ class WPaxosLeader(Actor):
             self._epoch_timer(group), entry, set())
         self._broadcast_epoch_commit(group)
         self._epoch_resends[group][0].start()
+        if self._placement is not None:
+            # A freshly acquired group starts a clean dwell window
+            # with no inherited traffic history.
+            self._placement["acquired"][group] = self._clock()
+            self._placement["counts"].pop(group, None)
+            self._placement["streak"].pop(group, None)
         for src, request in st.buffered:
             self._admit_and_propose(src, request)
 
@@ -621,12 +666,65 @@ class WPaxosLeader(Actor):
         state = self.active.pop(group, None)
         if state is None:
             return
+        if self._placement is not None:
+            self._placement["counts"].pop(group, None)
+            self._placement["streak"].pop(group, None)
+            self._placement["acquired"].pop(group, None)
         entry = self.epochs.current(group)
         for slot, (value, client, cid) in state.proposals.items():
             if client is not None:
                 self.send(client, WNotOwner(
                     group=group, command_id=cid,
                     home_zone=entry.home_zone, ballot=entry.ballot))
+
+    # --- adaptive placement (paxchaos) --------------------------------------
+    def _placement_check(self) -> None:
+        """One placement-policy evaluation: for every owned group,
+        decide whether a remote zone's request-origin EWMA dominates
+        enough (for long enough) to hand the group off. The hand-off
+        is a Steal trigger to the dominant zone's leader -- the normal
+        fresh-ballot steal flow moves the group, this leader gets
+        preempted and redirects stragglers via the nack-floor hint
+        (the anti-boomerang path PR 13 fixed)."""
+        opts = self.options
+        state = self._placement
+        counts_by_group = state["counts"]
+        for group in list(self.active):
+            counts = counts_by_group.get(group)
+            if not counts:
+                continue
+            total = sum(counts.values())
+            zone = max(counts, key=counts.get)
+            share = counts[zone] / total
+            streak = state["streak"].setdefault(group, [zone, 0])
+            if zone != self.zone and total >= opts.placement_min_samples \
+                    and share >= opts.placement_dominance:
+                if streak[0] == zone:
+                    streak[1] += 1
+                else:
+                    streak[0], streak[1] = zone, 1
+            else:
+                streak[0], streak[1] = zone, 0
+            dwell = self._clock() - state["acquired"].get(group, 0.0)
+            if streak[1] >= opts.placement_hysteresis_checks \
+                    and dwell >= opts.placement_min_dwell_s:
+                self.send(self.config.leader_addresses[zone],
+                          Steal(group=group))
+                self.placement_handoffs.append({
+                    "group": group, "to_zone": zone,
+                    "t_s": round(self._clock(), 3),
+                    "share": round(share, 3)})
+                counts_by_group.pop(group, None)
+                state["streak"].pop(group, None)
+                continue
+            # EWMA decay: old traffic fades at alpha per check, so
+            # dominance tracks the CURRENT origin mix.
+            alpha = opts.placement_ewma_alpha
+            for origin in list(counts):
+                counts[origin] *= (1.0 - alpha)
+                if counts[origin] < 0.05:
+                    del counts[origin]
+        self._placement_timer.start()
 
     # --- replica hole recovery ----------------------------------------------
     def _handle_recover(self, src: Address, m: WRecover) -> None:

@@ -39,11 +39,15 @@ class WRequest:
     """Client write for one object group. ``steal`` marks a failover
     resend: the receiving leader should STEAL the group (cross-zone
     Phase1) instead of redirecting, because the client has given up on
-    the home zone answering."""
+    the home zone answering. ``origin_zone`` is the issuing client's
+    zone (-1 = unknown): the feed for the leader's adaptive-placement
+    EWMA (paxchaos) -- a routing HINT only, never consulted for
+    safety."""
 
     group: int
     command: Command
     steal: bool = False
+    origin_zone: int = -1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -96,13 +96,20 @@ class WRequestCodec(MessageCodec):
     def encode(self, out, message):
         out += _I64.pack(message.group)
         out.append(1 if message.steal else 0)
+        # origin_zone as one signed byte (-1 = unknown; no topology
+        # runs 127+ zones through a single client).
+        out.append(message.origin_zone & 0xFF)
         _put_command(out, message.command)
 
     def decode(self, buf, at):
         (group,) = _I64.unpack_from(buf, at)
         steal = buf[at + 8] != 0
-        command, at = _take_command(buf, at + 9)
-        return WRequest(group=group, command=command, steal=steal), at
+        origin = buf[at + 9]
+        if origin > 127:
+            origin -= 256
+        command, at = _take_command(buf, at + 10)
+        return WRequest(group=group, command=command, steal=steal,
+                        origin_zone=origin), at
 
 
 class WReplyCodec(MessageCodec):

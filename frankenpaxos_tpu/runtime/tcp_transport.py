@@ -207,6 +207,13 @@ class TcpTransport(Transport):
         self._flush_queue: list = []
         self._flush_dirty: set = set()
         self._flush_scheduled = False
+        #: paxchaos link-fault seam (faults/deployed_backend.LinkFaults
+        #: .check, or any ``(src, dst) -> extra_delay_s | None``):
+        #: consulted once per outbound message when armed -- None
+        #: drops the frame (partition), > 0 defers the write by that
+        #: many wall seconds (injected latency / brownout). Unarmed
+        #: (the default) costs one attribute test per send.
+        self.link_faults = None
         # Transport counters (the transport_lt A/B instruments these;
         # /metrics exports them when runtime_metrics is attached).
         # "syscalls" counts our sendmsg calls plus writer.write calls
@@ -608,8 +615,21 @@ class TcpTransport(Transport):
 
     def _write(self, src: Address, dst: Address, data: bytes,
                flush: bool,
-               ctx: "Optional[TraceContext]" = None) -> None:
+               ctx: "Optional[TraceContext]" = None,
+               faulted: bool = False) -> None:
         assert self.loop is not None, "transport not started"
+        if self.link_faults is not None and not faulted:
+            # paxchaos: one verdict per message, evaluated at the
+            # original send instant (a deferred write must not re-roll
+            # against a table that changed while it slept).
+            verdict = self.link_faults(src, dst)
+            if verdict is None:
+                return  # partitioned: dropped at the send path
+            if verdict > 0:
+                self.loop.call_later(
+                    verdict, self._write, src, dst, data, flush, ctx,
+                    True)
+                return
         conn = self._conn_for(src, dst)
         if conn.writer is not None and conn.writer.is_closing():
             # The peer died (process crash / kill -9) or reset the

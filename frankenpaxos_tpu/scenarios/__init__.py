@@ -2,17 +2,22 @@
 
 The paxgeo x paxload fusion (docs/GLOBAL.md): the SoA open-loop load
 tier (serve/loadgen.py) drives WPaxos/CRAQ deployments over
-GeoSimTransport WAN topologies through deterministic, seeded chaos
-schedules -- zone outages at the diurnal peak, cross-region
-partitions, follow-the-sun traffic migration, two-continent hot-object
-contention, and cloud storage pathologies (fsync stalls) -- and every
-scenario is GATED on explicit SLO clauses: a goodput floor, admitted
-p99/p999 ceilings, zero acked-write loss, a control plane that is
-never shed, and bounded recovery time.
+GeoSimTransport WAN topologies through deterministic, seeded
+paxchaos fault schedules (frankenpaxos_tpu/faults/) -- zone outages
+at the diurnal peak, cross-region partitions, follow-the-sun traffic
+migration under the adaptive placement policy, two-continent
+hot-object contention, cloud storage pathologies (periodic-window
+fsync stalls), and CRAQ chain reconfiguration under tail kill -- and
+every scenario is GATED on explicit SLO clauses: a goodput floor,
+admitted p99/p999 ceilings, zero acked-write loss, exactly-once
+execution, a control plane that is never shed, and bounded recovery
+time.
 
 ``bench/global_lt.py`` runs the matrix and commits
-``bench_results/global_lt.json``; the CI ``global-smoke`` job enforces
-the gates on a reduced scale every PR.
+``bench_results/global_lt.json``; the CI ``global-smoke`` job
+enforces the gates on a reduced scale every PR, and the
+``deployed-chaos`` job replays the zone-outage schedule against a
+REAL deployment (bench/deployed_twin.py).
 """
 
 from frankenpaxos_tpu.scenarios.matrix import (  # noqa: F401

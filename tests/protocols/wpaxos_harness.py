@@ -102,11 +102,19 @@ def make_wpaxos(
     clients = []
     for i in range(num_clients):
         address = f"client-{i}"
+        options = client_options or WPaxosClientOptions()
         if topology is not None:
-            topology.place(address, topology.zones[i % num_zones])
+            zone = i % num_zones
+            topology.place(address, topology.zones[zone])
+            if options.zone < 0:
+                # Stamp the placed zone on requests (origin_zone):
+                # the adaptive-placement EWMA's feed. Pure routing
+                # telemetry -- nothing consults it unless a leader
+                # arms the placement policy.
+                options = dataclasses.replace(options, zone=zone)
         clients.append(WPaxosClient(
-            address, transport, logger, config,
-            client_options or WPaxosClientOptions(), seed=seed + i))
+            address, transport, logger, config, options,
+            seed=seed + i))
 
     return WPaxosSim(transport, config, leaders, acceptors, replicas,
                      clients, topology=topology,

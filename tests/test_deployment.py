@@ -3,6 +3,7 @@ real TCP (the analog of scripts/benchmark_smoke.sh, which smoke-runs all
 18 reference protocols over SSH-to-localhost)."""
 
 import tempfile
+import time
 
 import pytest
 
@@ -420,6 +421,107 @@ def test_multipaxos_reconfigure_under_kill(tmp_path):
 
         assert FlightRecorder.read(
             str(tmp_path / "trace" / "acceptor_2.flight"))
+    finally:
+        if transport is not None:
+            transport.stop()
+        bench.cleanup()
+
+
+def test_craq_chain_reconfigure_under_tail_kill(tmp_path):
+    """paxchaos CRAQ chain reconfiguration on a REAL deployment: the
+    TAIL process is SIGKILLed mid-run (acked writes now live only in
+    predecessors' dirty versions), the chain re-links around it
+    (``ChainReconfigure`` with the dirty-version handoff), the
+    in-flight write concludes, and every acked write reads back from
+    the shortened chain -- the deployed smoke the acceptance
+    criterion names."""
+    import threading
+
+    from frankenpaxos_tpu.bench.chaos import sigkill_role
+    from frankenpaxos_tpu.bench.deploy_suite import launch_roles
+    from frankenpaxos_tpu.bench.harness import free_port
+    from frankenpaxos_tpu.deploy import DeployCtx, get_protocol
+    from frankenpaxos_tpu.protocols.craq import ChainReconfigure
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+    from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+    bench = BenchmarkDirectory(str(tmp_path / "craq_chaos"))
+    protocol = get_protocol("craq")
+    raw = protocol.cluster(1, lambda: ["127.0.0.1", free_port()])
+    config_path = bench.write_json("config.json", raw)
+    config = protocol.load_config(raw)
+    launch_roles(bench, "craq", config_path, config,
+                 state_machine="KeyValueStore",
+                 trace_dir=str(tmp_path / "trace"))
+    transport = None
+    try:
+        logger = FakeLogger(LogLevel.FATAL)
+        transport = TcpTransport(("127.0.0.1", free_port()), logger)
+        transport.start()
+        ctx = DeployCtx(config=config, transport=transport,
+                        logger=logger,
+                        overrides={"resend_period_s": "0.5"},
+                        seed=0xCAFE)
+        client = protocol.make_client(ctx, transport.listen_address)
+
+        def write(key: str, value: str, timeout=30) -> None:
+            done = threading.Event()
+            transport.loop.call_soon_threadsafe(
+                client.write, 0, key, value, lambda *_: done.set())
+            assert done.wait(timeout=timeout), f"write {key} wedged"
+
+        for k in range(6):
+            write(f"k{k}", f"v{k}")
+
+        # Kill the tail: everything it acked survives only as the
+        # predecessors' dirty versions (flight post-mortem included).
+        sigkill_role(bench, "chain_node_2")
+        # An in-flight write enters the headless-tail chain: it must
+        # ride the handoff, not wedge.
+        inflight_done = threading.Event()
+        transport.loop.call_soon_threadsafe(
+            client.write, 1, "k6", "v6",
+            lambda *_: inflight_done.set())
+        time.sleep(0.5)
+        assert not inflight_done.is_set()  # parked on the dead tail
+
+        survivors = tuple(
+            tuple(a) for a in raw["chain_nodes"][:2])
+        message = ChainReconfigure(version=1, chain=survivors)
+        data = DEFAULT_SERIALIZER.to_bytes(message)
+
+        def reconfigure() -> None:
+            for address in survivors:
+                transport.send(transport.listen_address, address,
+                               data)
+            client.receive("controller", message)
+
+        transport.loop.call_soon_threadsafe(reconfigure)
+        # The dirty handoff concludes the in-flight write (the new
+        # tail applies + replies), possibly via the client's resend.
+        assert inflight_done.wait(timeout=30), \
+            "write did not survive the chain re-link"
+        # New writes flow through the shortened chain.
+        write("k7", "v7")
+
+        # Zero acked-write loss: read every key back from the
+        # re-linked chain.
+        values: dict = {}
+        for k in range(8):
+            done = threading.Event()
+            transport.loop.call_soon_threadsafe(
+                client.read, 2, f"k{k}",
+                lambda value, k=k: (values.__setitem__(k, value),
+                                    done.set()))
+            assert done.wait(timeout=30), f"read k{k} wedged"
+        assert values == {k: f"v{k}" for k in range(8)}, values
+
+        # The killed tail left a readable flight post-mortem.
+        import os
+
+        assert os.path.exists(
+            bench.abspath("chain_node_2.flight.json"))
     finally:
         if transport is not None:
             transport.stop()

@@ -116,6 +116,35 @@ class TestFusedSafetyOracle:
         assert not refused  # control plane never shed
 
 
+class TestChaosScenarios:
+    def test_craq_chain_reconfig_loses_no_acked_write(self):
+        """The craq chaos exemption is over: tail kill + chain
+        re-link under load, gated on the matrix clauses (zero acked
+        loss via the dirty handoff, exactly-once via the monotone
+        audit, loud conclusions, bounded recovery)."""
+        row = run_scenario("craq_chain_reconfig", seed=0,
+                           scale=TEST_SCALE)
+        assert row["gate_passed"], row["slo"]
+        assert row["events"]["surviving_chain"] == ["chain-0",
+                                                    "chain-1"]
+        assert row["safety"]["violations"] == []
+        assert row["events"]["handoff_regressions"] == 0
+        assert row["stats"]["pending_after_settle"] == 0
+
+    def test_zone_outage_records_the_shared_schedule_digest(self):
+        """The row's digest equals a fresh build of the SAME schedule
+        the deployed twin compiles -- the one-fault-plane identity."""
+        from frankenpaxos_tpu.faults import zone_outage_schedule
+
+        row = run_scenario("zone_outage_peak", seed=2,
+                           scale=TEST_SCALE)
+        expected = zone_outage_schedule(
+            t_kill=1.0 + TEST_SCALE.duration_s / 4,
+            dwell_s=TEST_SCALE.outage_dwell_s, zone=0, seed=2)
+        assert row["events"]["fault_schedule_sha256"] \
+            == expected.digest()
+
+
 class TestCraqServing:
     def _chain(self, *, token_rate=0.0, inbox=0, budget=0,
                backoff=None, read_node=None, seed=0):

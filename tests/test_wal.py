@@ -215,3 +215,113 @@ def test_wants_compaction_threshold():
     assert wal.wants_compaction()
     wal.compact(WalSnapshot(payload=b""), [])
     assert not wal.wants_compaction()
+
+
+# --- paxchaos: FsyncStallStorage over REAL FileStorage on disk ---------------
+
+
+def test_fsync_stall_over_file_storage_blocking(tmp_path):
+    """The deployed fault arm (satellite of paxchaos): a BLOCKING
+    FsyncStallStorage over a real FileStorage actually sleeps through
+    its count-cadence stalls, and every synced record is durable on
+    disk afterwards."""
+    import time
+
+    from frankenpaxos_tpu.wal import FsyncStallStorage
+
+    root = str(tmp_path / "wal")
+    storage = FsyncStallStorage(
+        FileStorage(root), seed=7, label="a0", stall_every=2,
+        stall_s=0.02, jitter=0.0, blocking=True)
+    wal = Wal(storage)
+    t0 = time.perf_counter()
+    for i in range(4):
+        wal.append(WalVote(slot=i, round=1, value=b"v%d" % i))
+        wal.sync()
+    elapsed = time.perf_counter() - t0
+    assert len(storage.stalls) == 2
+    assert elapsed >= sum(storage.stalls)  # the sleeps were real
+    wal.close()
+    recovered = Wal(FileStorage(root)).recover()
+    assert recovered == [WalVote(slot=i, round=1, value=b"v%d" % i)
+                         for i in range(4)]
+
+
+def test_fsync_stall_periodic_windows_align_on_shared_clock(tmp_path):
+    """Periodic-window mode: two storages sharing one clock stall in
+    the SAME windows (the property that makes deployed overlap faults
+    reproducible), and outside a window no stall fires."""
+    from frankenpaxos_tpu.wal import FsyncStallStorage
+
+    now = {"t": 0.0}
+    clock = lambda: now["t"]  # noqa: E731
+    storages = [
+        FsyncStallStorage(FileStorage(str(tmp_path / f"w{i}")),
+                          label=f"a{i}", stall_period_s=1.0,
+                          stall_window_s=0.1, clock=clock)
+        for i in range(2)]
+    for t, expect_stall in ((0.05, True), (0.5, False),
+                            (1.02, True), (1.9, False)):
+        now["t"] = t
+        for storage in storages:
+            before = len(storage.stalls)
+            storage.append("seg-00000000.wal", b"x")
+            storage.sync("seg-00000000.wal")
+            assert (len(storage.stalls) > before) == expect_stall, t
+    # Both stalled at exactly the same instants, to the window end.
+    assert storages[0].stalls == storages[1].stalls
+    assert storages[0].stalls[0] == pytest.approx(0.05)
+
+
+def test_torn_tail_recovery_with_stall_in_flight(tmp_path):
+    """Crash DURING a stall (satellite 3's torn-tail case): the stall
+    fires after the real fsync, so records of the stalled group
+    commit are durable -- a crash mid-stall loses nothing synced, and
+    a torn tail appended by the dying process truncates away on
+    recovery over the SAME wrapped storage."""
+    from frankenpaxos_tpu.wal import FsyncStallStorage
+
+    root = str(tmp_path / "wal")
+    crashed = {}
+
+    def crash_mid_stall(stall_s):
+        # The "crash": capture the on-disk state AT the stall (fsync
+        # done, ack held, process about to die).
+        crashed["segments"] = FileStorage(root).segments()
+
+    storage = FsyncStallStorage(
+        FileStorage(root), seed=1, label="a0", stall_every=2,
+        stall_s=0.001, on_stall=crash_mid_stall)
+    wal = Wal(storage)
+    wal.append(WalPromise(round=1))
+    wal.sync()            # sync 1: no stall
+    wal.append(WalVote(slot=1, round=1, value=b"durable"))
+    wal.sync()            # sync 2: stall fires -- the "crash" point
+    assert crashed["segments"]  # the record was already on disk
+    # The dying process had staged (unsynced) records AND a torn
+    # half-frame reached the file (the kill landed mid-write).
+    wal.append(WalVote(slot=2, round=1, value=b"lost-with-buffer"))
+    name = storage.segments()[-1]
+    storage.append(name, b"\xff\xff\xff")  # torn garbage, no sync
+    storage.close()
+
+    # Recovery over a FRESH wrapped FileStorage (the relaunch keeps
+    # its fault arming, as the deployed launch spec does).
+    storage2 = FsyncStallStorage(
+        FileStorage(root), seed=1, label="a0", stall_every=2,
+        stall_s=0.001)
+    wal2 = Wal(storage2)
+    records = wal2.recover()
+    assert records == [WalPromise(round=1),
+                       WalVote(slot=1, round=1, value=b"durable")]
+    assert wal2.metrics.truncated_tail_bytes == 3
+    # Post-recovery appends survive another restart (idempotent), and
+    # the wrapper keeps injecting on the recovered log.
+    wal2.append(WalVote(slot=3, round=2, value=b"after"))
+    wal2.sync()
+    wal2.sync_count_before = storage2.syncs
+    wal2.close()
+    final = Wal(FileStorage(root)).recover()
+    assert final == [WalPromise(round=1),
+                     WalVote(slot=1, round=1, value=b"durable"),
+                     WalVote(slot=3, round=2, value=b"after")]

@@ -791,6 +791,8 @@ def all_codec_samples() -> dict:
         # the lane classifier sees CRAQ client traffic.
         cq.Write(ccid, "k", "v"),
         cq.Read(ccid, "k"),
+        # paxchaos (tag 203): the chain re-link (control lane).
+        cq.ChainReconfigure(version=2, chain=(("h", 1), ("h", 2))),
         # fastmultipaxos
         fmp.ProposeRequest(fcommand),
         fmp.ProposeReply(fmp.CommandId(("h", 5), 3), b"r", round=2),
