@@ -73,6 +73,40 @@ class _EchoServer(Actor):
             result=message.command.command))
 
 
+class _ColumnEchoServer(Actor):
+    """The paxingest arm's server: whole client batch frames land as
+    SoA columns through the wire sink (ingest/columns.py) and each
+    frame draws ONE ClientReplyArray -- no per-message decode, no
+    Command objects (docs/TRANSPORT.md wire-to-device section)."""
+
+    def __init__(self, address, transport, logger):
+        super().__init__(address, transport, logger)
+        from frankenpaxos_tpu.ingest.columns import (
+            parse_client_array,
+            parse_client_batch,
+        )
+
+        self.wire_sinks = {
+            151: (parse_client_batch, self._handle_columns),
+            115: (parse_client_array, self._handle_columns),
+            4: (parse_client_array, self._handle_columns),
+        }
+
+    def _handle_columns(self, src, colrun) -> None:
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            ClientReplyArray,
+        )
+
+        cols = colrun.cols
+        self.send(src, ClientReplyArray(entries=tuple(
+            (int(p), int(c), 0, b"")
+            for p, c in zip(cols[:, 1], cols[:, 2]))))
+
+    def receive(self, src, message):
+        # Fallback for shapes the sink declines.
+        _EchoServer.receive(self, src, message)
+
+
 class _LoadClient(Actor):
     """Closed loop: ``width`` pipelined commands; each reply issues the
     next request until ``total`` have been acknowledged."""
@@ -104,15 +138,20 @@ class _LoadClient(Actor):
             CommandId(self.address, 0, i), b"w%010d" % i)))
 
     def receive(self, src, message) -> None:
-        self.acked += 1
+        # The ingest arm acks whole frames with ClientReplyArray; the
+        # classic arms reply per command.
+        k = len(getattr(message, "entries", ())) or 1
+        self.acked += k
         if self.acked >= self.total:
             self.t1 = time.perf_counter()
             self.done.set()
-        elif self.sent < self.total:
-            self._send_next()
+        else:
+            for _ in range(min(k, self.total - self.sent)):
+                self._send_next()
 
 
-def run_arm(batching: bool, width: int, total: int) -> dict:
+def run_arm(arm: str, width: int, total: int) -> dict:
+    batching = arm != "per_frame"
     logger = FakeLogger(LogLevel.FATAL)
     server_addr = ("127.0.0.1", _free_port())
     client_addr = ("127.0.0.1", _free_port())
@@ -121,7 +160,9 @@ def run_arm(batching: bool, width: int, total: int) -> dict:
     server_t.start()
     client_t.start()
     try:
-        _EchoServer(server_addr, server_t, logger)
+        server_cls = (_ColumnEchoServer if arm == "ingest"
+                      else _EchoServer)
+        server_cls(server_addr, server_t, logger)
         client = _LoadClient(client_addr, client_t, logger,
                              server_addr, width, total)
         client.start()
@@ -135,6 +176,7 @@ def run_arm(batching: bool, width: int, total: int) -> dict:
         batch_bytes = (server_t.stat_batch_bytes
                        + client_t.stat_batch_bytes)
         return {
+            "arm": arm,
             "batching": batching,
             "in_flight": width,
             "num_commands": total,
@@ -157,19 +199,24 @@ def run_arm(batching: bool, width: int, total: int) -> dict:
 
 def run_pair(width: int, total: int, reps: int) -> dict:
     """Best-of-``reps`` for each arm on fresh transports, order
-    alternated so drift lands on both arms equally."""
+    alternated so drift lands on all arms equally. The ``ingest`` arm
+    (paxingest wire-sink columns, one reply array per frame) rides
+    along as the wire-to-device reference point; its own gate lives in
+    bench/ingest_lt.py."""
     best: dict = {}
+    order = ("per_frame", "batched", "ingest")
     for rep in range(reps):
-        arms = (False, True) if rep % 2 == 0 else (True, False)
-        for batching in arms:
-            stats = run_arm(batching, width, total)
-            key = "batched" if batching else "per_frame"
-            if key not in best or stats["cmds_per_s"] \
-                    > best[key]["cmds_per_s"]:
-                best[key] = stats
+        arms = order if rep % 2 == 0 else tuple(reversed(order))
+        for arm in arms:
+            stats = run_arm(arm, width, total)
+            if arm not in best or stats["cmds_per_s"] \
+                    > best[arm]["cmds_per_s"]:
+                best[arm] = stats
     pair = dict(best)
     pair["throughput_ratio"] = (best["batched"]["cmds_per_s"]
                                 / best["per_frame"]["cmds_per_s"])
+    pair["ingest_ratio"] = (best["ingest"]["cmds_per_s"]
+                            / best["per_frame"]["cmds_per_s"])
     pair["syscall_reduction"] = (
         best["per_frame"]["syscalls_per_cmd"]
         / max(best["batched"]["syscalls_per_cmd"], 1e-12))
@@ -221,6 +268,8 @@ def main(argv=None) -> dict:
               f"{p['per_frame']['cmds_per_s']:9.0f}/s "
               f"batched {p['batched']['cmds_per_s']:9.0f}/s "
               f"ratio {p['throughput_ratio']:.2f}x "
+              f"ingest {p['ingest']['cmds_per_s']:9.0f}/s "
+              f"({p['ingest_ratio']:.2f}x) "
               f"syscalls/cmd {p['per_frame']['syscalls_per_cmd']:.2f}"
               f"->{p['batched']['syscalls_per_cmd']:.4f} "
               f"({p['syscall_reduction']:.0f}x)")

@@ -89,6 +89,19 @@ def main(argv=None) -> None:
                              "every EVERY-th group commit. The "
                              "deployed twin of the scenario matrix's "
                              "fsync-stall schedule")
+    parser.add_argument("--fault_link", default=None,
+                        metavar="zone:H:P=Z;drop:ZA-ZB;lat:ZA-ZB=S",
+                        help="paxchaos link-fault arm (faults/): inject "
+                             "partitions/latency at THIS role's "
+                             "TcpTransport send path, mirroring "
+                             "--fault_fsync's launch-time arming -- the "
+                             "deployed twin of the scenario matrix's "
+                             "partition rows (before this flag only the "
+                             "in-process client transport armed "
+                             "LinkFaults; role->role links ran clean). "
+                             "Clauses: zone:HOST:PORT=NAME endpoint "
+                             "mapping, drop:ZA-ZB partition (both "
+                             "ways), lat:ZA-ZB=SECONDS extra latency")
     parser.add_argument("--ready_addr", default=None,
                         help="host:port the launcher listens on for the "
                              "wait-for-listen handshake: once this role "
@@ -165,6 +178,13 @@ def main(argv=None) -> None:
         listen_address = addresses[args.index]
 
     transport = TcpTransport(listen_address, logger)
+    if args.fault_link:
+        from frankenpaxos_tpu.faults.deployed_backend import (
+            parse_link_fault_spec,
+        )
+
+        transport.link_faults = parse_link_fault_spec(
+            args.fault_link).check
     label = f"{args.role}_{args.index}"
     if collectors is not None:
         from frankenpaxos_tpu.obs import RuntimeMetrics

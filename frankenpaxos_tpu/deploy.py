@@ -353,6 +353,20 @@ def _matchmakerpaxos() -> Protocol:
         lambda client, tag, cb: client.propose(f"v{tag}", cb))
 
 
+def _make_ingest_batcher(ctx: "DeployCtx", address, index: int,
+                         protocol: str):
+    """Construct a paxingest disseminator (ingest/) for either run-
+    pipeline protocol -- WAL-free by design, so no ctx.wal plumbing."""
+    from frankenpaxos_tpu import ingest
+
+    router = (ingest.MultiPaxosIngestRouter(ctx.config)
+              if protocol == "multipaxos"
+              else ingest.MenciusIngestRouter(ctx.config))
+    return ingest.IngestBatcher(
+        address, ctx.transport, ctx.logger, router, index=index,
+        options=ctx.opts(ingest.IngestBatcherOptions), seed=ctx.seed)
+
+
 def _multipaxos() -> Protocol:
     from frankenpaxos_tpu.protocols import multipaxos as mp
 
@@ -360,6 +374,8 @@ def _multipaxos() -> Protocol:
         config = mp.MultiPaxosConfig(
             f=raw["f"],
             batcher_addresses=_addrs(raw.get("batchers", [])),
+            ingest_batcher_addresses=_addrs(
+                raw.get("ingest_batchers", [])),
             read_batcher_addresses=_addrs(raw.get("read_batchers", [])),
             leader_addresses=_addrs(raw["leaders"]),
             leader_election_addresses=_addrs(raw["leader_elections"]),
@@ -392,6 +408,10 @@ def _multipaxos() -> Protocol:
                 lambda ctx, a, i: mp.ReadBatcher(
                     a, ctx.transport, ctx.logger, ctx.config,
                     ctx.opts(mp.ReadBatchingScheme), seed=ctx.seed)),
+            "ingest_batcher": Role(
+                lambda c: list(c.ingest_batcher_addresses),
+                lambda ctx, a, i: _make_ingest_batcher(
+                    ctx, a, i, "multipaxos")),
             "leader": Role(
                 lambda c: list(c.leader_addresses),
                 lambda ctx, a, i: mp.Leader(
@@ -432,6 +452,7 @@ def _multipaxos() -> Protocol:
         cluster=lambda f, port: {
             "f": f,
             "batchers": [],
+            "ingest_batchers": [],
             "read_batchers": [],
             "leaders": [port() for _ in range(f + 1)],
             "leader_elections": [port() for _ in range(f + 1)],
@@ -458,6 +479,8 @@ def _mencius() -> Protocol:
         config = m.MenciusConfig(
             f=raw["f"],
             batcher_addresses=_addrs(raw.get("batchers", [])),
+            ingest_batcher_addresses=_addrs(
+                raw.get("ingest_batchers", [])),
             leader_addresses=[_addrs(g) for g in raw["leaders"]],
             leader_election_addresses=[_addrs(g)
                                        for g in raw["leader_elections"]],
@@ -487,6 +510,10 @@ def _mencius() -> Protocol:
                 lambda ctx, a, i: m.MenciusBatcher(
                     a, ctx.transport, ctx.logger, ctx.config,
                     seed=ctx.seed, **ctx.kw(m.MenciusBatcher))),
+            "ingest_batcher": Role(
+                lambda c: list(c.ingest_batcher_addresses),
+                lambda ctx, a, i: _make_ingest_batcher(
+                    ctx, a, i, "mencius")),
             "leader": Role(
                 flat_leaders,
                 lambda ctx, a, i: m.MenciusLeader(
@@ -520,6 +547,7 @@ def _mencius() -> Protocol:
         cluster=lambda f, port: {
             "f": f,
             "batchers": [],
+            "ingest_batchers": [],
             "leaders": [[port() for _ in range(f + 1)]
                         for _ in range(2)],
             "leader_elections": [[port() for _ in range(f + 1)]

@@ -8,7 +8,9 @@ docs/GLOBAL.md for the twin methodology.
 from frankenpaxos_tpu.faults.deployed_backend import (  # noqa: F401
     DeployedBackend,
     fsync_fault_args,
+    link_fault_args,
     LinkFaults,
+    parse_link_fault_spec,
     run_wall,
 )
 from frankenpaxos_tpu.faults.schedule import (  # noqa: F401

@@ -85,6 +85,91 @@ class LinkFaults:
         return verdict
 
 
+def parse_link_fault_spec(spec: str) -> LinkFaults:
+    """Parse the ``--fault_link`` CLI spec into an armed
+    :class:`LinkFaults` table -- the role-process twin of the in-process
+    client transport's link arming (before this, only the twin driver's
+    own transport saw partitions; role->role links ran clean and the
+    deployed partition rows were impossible).
+
+    Grammar: semicolon-separated clauses --
+
+      * ``zone:HOST:PORT=NAME``  map an endpoint to a zone (repeat per
+        endpoint; unmapped endpoints ride untouched);
+      * ``lat:ZA-ZB=SECONDS``    extra latency, both directions;
+      * ``drop:ZA-ZB``           partition, both directions.
+
+    Example::
+
+        --fault_link "zone:127.0.0.1:5000=z0;zone:127.0.0.1:5001=z1;\\
+                      drop:z0-z1;lat:z0-z0=0.02"
+    """
+    zones: dict = {}
+    faults = LinkFaults(zone_of=lambda address: zones.get(address))
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, _, rest = clause.partition(":")
+        if kind == "zone":
+            endpoint, _, zone = rest.rpartition("=")
+            host, _, port = endpoint.rpartition(":")
+            if not host or not zone:
+                raise ValueError(
+                    f"--fault_link zone clause must be "
+                    f"zone:HOST:PORT=NAME; got {clause!r}")
+            zones[(host, int(port))] = zone
+        elif kind == "lat":
+            pair, _, seconds = rest.rpartition("=")
+            a, _, b = pair.partition("-")
+            if not a or not b or not seconds:
+                raise ValueError(
+                    f"--fault_link lat clause must be "
+                    f"lat:ZA-ZB=SECONDS; got {clause!r}")
+            faults.set_latency(a, b, float(seconds))
+        elif kind == "drop":
+            a, _, b = rest.partition("-")
+            if not a or not b:
+                raise ValueError(
+                    f"--fault_link drop clause must be drop:ZA-ZB; "
+                    f"got {clause!r}")
+            faults.partition(a, b)
+        else:
+            raise ValueError(
+                f"unknown --fault_link clause kind {kind!r} in "
+                f"{clause!r} (known: zone, lat, drop)")
+    return faults
+
+
+def link_fault_args(schedule: FaultSchedule, zone_map: dict,
+                    address_of: Callable) -> dict:
+    """Per-role extra CLI args arming a schedule's t=0 link faults as
+    ``--fault_link`` specs: ``{role label: ["--fault_link", spec]}``
+    (the link twin of :func:`fsync_fault_args`). ``zone_map`` maps a
+    deploy-registry role label to its zone name; ``address_of(label)``
+    returns the role's (host, port). Only t=0 partition/brownout
+    events compile into the launch arming -- mid-run link events stay
+    driver-side (``DeployedBackend.do_partition``), exactly like
+    mid-run storage faults."""
+    events = [e for e in schedule.events if e.t_s == 0
+              and e.kind in ("partition", "brownout")]
+    if not events:
+        return {}
+    clauses = [
+        f"zone:{address_of(label)[0]}:{address_of(label)[1]}={zone}"
+        for label, zone in sorted(zone_map.items())]
+    for event in events:
+        if event.kind == "partition":
+            clauses.append(f"drop:{event.get('region_a')}-"
+                           f"{event.get('region_b')}")
+        else:
+            clauses.append(f"lat:{event.get('zone_a')}-"
+                           f"{event.get('zone_b')}"
+                           f"={float(event.get('extra_s'))}")
+    spec = ";".join(clauses)
+    return {label: ["--fault_link", spec] for label in zone_map}
+
+
 def fsync_fault_args(schedule: FaultSchedule,
                      acceptor_label: Callable) -> dict:
     """Per-role extra CLI args arming the schedule's t=0 fsync-stall

@@ -1,0 +1,52 @@
+"""paxingest: the wire-to-device ingestion plane (docs/TRANSPORT.md).
+
+Deployed TCP throughput sits orders of magnitude under the on-device
+drain ceiling, and the gap is host-side Python between ``recv()`` and
+the vote board: one codec dispatch, one ``Command`` object, one handler
+call PER MESSAGE. This package removes that layer with two pieces:
+
+  * **Zero-object decode** (:mod:`ingest.columns` over
+    ``native.ingest_scan``): a paxwire ``ClientFrameBatch`` arriving on
+    the wire scans ONCE into SoA descriptor columns (addr_idx,
+    pseudonym, client_id, value offset/length) plus the run pipeline's
+    canonical value-array segment -- byte-identical to what
+    ``wire._put_value_array`` would produce, so the resulting
+    ``LazyValueArray`` re-encodes as a raw copy all the way to the
+    acceptors. No ``ClientRequest``/``Command`` ever materializes.
+
+  * **Disseminator/sequencer split** (:class:`ingest.IngestBatcher`,
+    the HT-Paxos shape): Batcher roles absorb client fan-in, run the
+    serve/ admission discipline at the edge, pre-encode drain-granular
+    runs, and hand MultiPaxos and Mencius leaders pre-batched
+    :class:`~ingest.messages.IngestRun` descriptors -- the ordering
+    leader's event loop touches only run metadata (start slot, count,
+    raw bytes). Batchers are WAL-free by design: their only state is
+    un-flushed staging, and clients keep their retry budgets, so a
+    batcher death costs retries, never acked-write loss (the replica
+    client table keeps resends exactly-once).
+
+Actors opt into the fast path by declaring ``wire_sinks`` (see
+:class:`frankenpaxos_tpu.runtime.actor.Actor`); the TCP transport hands
+matching undecoded frame payloads straight to the sink. Every native
+entry point has a bit-identical pure-Python fallback, fuzz-gated in
+tests/test_native_parity.py.
+"""
+
+# Importing registers the run-descriptor codecs (tags 204-205) with
+# the hybrid serializer -- without them IngestRun would silently
+# pickle (the COD301 class).
+from frankenpaxos_tpu.ingest import wire as _wire  # noqa: E402,F401
+from frankenpaxos_tpu.ingest.batcher import (  # noqa: F401
+    IngestBatcher,
+    IngestBatcherOptions,
+    MenciusIngestRouter,
+    MultiPaxosIngestRouter,
+)
+from frankenpaxos_tpu.ingest.columns import (  # noqa: F401
+    AckColumns,
+    ColumnRun,
+    parse_ack_batch,
+    parse_client_batch,
+    value_view,
+)
+from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest  # noqa: F401

@@ -1,0 +1,303 @@
+"""IngestBatcher: the HT-Paxos-style disseminator role.
+
+Client fan-in (thousands of connections) terminates HERE instead of at
+the ordering leader. The batcher absorbs ``ClientRequest`` /
+``ClientRequestArray`` traffic -- on the deployed transport, whole
+``ClientFrameBatch`` frames land through the wire-sink fast path as
+SoA columns, never as per-message objects -- runs the serve/ admission
+discipline at the edge, and once per drain ships the staged commands
+as pre-encoded :class:`~frankenpaxos_tpu.ingest.messages.IngestRun`
+descriptors to the current round's leader. The leader touches only run
+metadata; the value bytes it forwards are the bytes the clients sent.
+
+Batchers are WAL-free BY DESIGN: their only state is unflushed
+staging, and clients keep their retry budgets -- a batcher death costs
+client retries (resent commands stay exactly-once through the replica
+client table), never acked-write loss. The chaos sim twin
+(tests/protocols/test_ingest_chaos.py) kills and restarts batchers
+under partitions to hold exactly that line.
+
+Routing is protocol-pluggable: :class:`MultiPaxosIngestRouter` targets
+the round's single leader; :class:`MenciusIngestRouter` spreads runs
+over leader groups. Leader discovery reuses the protocols' existing
+``LeaderInfoRequestBatcher``/``LeaderInfoReplyBatcher`` flow; an
+inactive leader bounces the run back as ``NotLeaderIngest``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from frankenpaxos_tpu.ingest.columns import (
+    CLIENT_ARRAY_TAG,
+    ColumnRun,
+    parse_client_array,
+    parse_client_batch,
+)
+from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest
+from frankenpaxos_tpu.runtime import Actor, Logger
+from frankenpaxos_tpu.runtime.paxwire import CLIENT_BATCH_TAG
+from frankenpaxos_tpu.runtime.transport import Address, Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestBatcherOptions:
+    #: Commands per IngestRun descriptor built from LOOSE (decoded)
+    #: commands; column runs ship at their wire-batch granularity.
+    max_run: int = 4096
+    #: Safety-net flush for staging that outlives a drain (0 disables;
+    #: on both transports on_drain normally flushes every pass).
+    flush_period_s: float = 0.01
+    # paxload admission control at the ingest edge (serve/admission.py):
+    # all zeros admits everything and builds NO controller.
+    admission_token_rate: float = 0.0
+    admission_token_burst: float = 0.0
+    admission_inflight_limit: int = 0
+    admission_inbox_capacity: int = 0
+    admission_inbox_policy: str = "reject"
+    admission_codel_target_s: float = 0.0
+    admission_codel_interval_s: float = 0.1
+    admission_retry_after_ms: int = 0
+
+    def admission_options(self):
+        from frankenpaxos_tpu.serve.admission import options_from_flat
+
+        return options_from_flat(self)
+
+
+class MultiPaxosIngestRouter:
+    """Route runs to the MultiPaxos round's leader."""
+
+    num_groups = 1
+
+    def __init__(self, config):
+        from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+        self.config = config
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.round = 0
+
+    def leader(self, group: int) -> Address:
+        return self.config.leader_addresses[
+            self.round_system.leader(self.round)]
+
+    def choose_group(self, rng: random.Random) -> int:
+        return 0
+
+    def discovery_targets(self, group: int) -> list:
+        return list(self.config.leader_addresses)
+
+    def info_request(self):
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            LeaderInfoRequestBatcher,
+        )
+
+        return LeaderInfoRequestBatcher()
+
+    def is_info_reply(self, message) -> bool:
+        from frankenpaxos_tpu.protocols.multipaxos.messages import (
+            LeaderInfoReplyBatcher,
+        )
+
+        return isinstance(message, LeaderInfoReplyBatcher)
+
+    def note_info(self, message) -> None:
+        self.round = max(self.round, message.round)
+
+
+class MenciusIngestRouter:
+    """Route runs round-robin over Mencius leader groups (each group
+    owns a strided slot lane; any group can order any command)."""
+
+    def __init__(self, config):
+        from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+        self.config = config
+        self.num_groups = config.num_leader_groups
+        self._round_systems = [
+            ClassicRoundRobin(len(group))
+            for group in config.leader_addresses]
+        self.rounds = [0] * self.num_groups
+
+    def leader(self, group: int) -> Address:
+        return self.config.leader_addresses[group][
+            self._round_systems[group].leader(self.rounds[group])]
+
+    def choose_group(self, rng: random.Random) -> int:
+        return rng.randrange(self.num_groups)
+
+    def discovery_targets(self, group: int) -> list:
+        return list(self.config.leader_addresses[group])
+
+    def info_request(self):
+        from frankenpaxos_tpu.protocols.mencius.common import (
+            LeaderInfoRequestBatcher,
+        )
+
+        return LeaderInfoRequestBatcher()
+
+    def is_info_reply(self, message) -> bool:
+        from frankenpaxos_tpu.protocols.mencius.common import (
+            LeaderInfoReplyBatcher,
+        )
+
+        return isinstance(message, LeaderInfoReplyBatcher)
+
+    def note_info(self, message) -> None:
+        group = message.leader_group_index
+        self.rounds[group] = max(self.rounds[group], message.round)
+
+
+class IngestBatcher(Actor):
+    def __init__(self, address: Address, transport: Transport,
+                 logger: Logger, router, index: int = 0,
+                 options: IngestBatcherOptions = IngestBatcherOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        self.router = router
+        self.index = index
+        self.options = options
+        self.rng = random.Random(seed)
+        # Staged work, flushed once per drain: ColumnRun prefixes from
+        # the wire-sink fast path (raw bytes, no objects) and loose
+        # decoded Commands from the per-message path.
+        self._staged_columns: list = []   # (ColumnRun, admitted k)
+        self._staged_commands: list = []  # Command
+        # (group, IngestRun) bounced by inactive leaders, awaiting
+        # leader discovery.
+        self._pending_runs: list = []
+        admission_options = options.admission_options()
+        if admission_options is not None:
+            from frankenpaxos_tpu.serve.admission import (
+                AdmissionController,
+            )
+
+            self.admission = AdmissionController(
+                admission_options, role=f"ingest_batcher_{index}",
+                metrics=transport.runtime_metrics)
+            transport.note_admission(address, self)
+        # The zero-object fast path: client batch frames AND un-batched
+        # coalesced arrays land here as columns
+        # (runtime/tcp_transport.py dispatches by leading tag).
+        self.wire_sinks = {
+            CLIENT_BATCH_TAG: (parse_client_batch,
+                               self._handle_client_columns),
+            CLIENT_ARRAY_TAG: (parse_client_array,
+                               self._handle_client_columns),
+        }
+        self._flush_timer = None
+        if options.flush_period_s > 0:
+            self._flush_timer = self.timer(
+                "ingestFlush", options.flush_period_s, self._timer_flush)
+
+    # --- staging ----------------------------------------------------------
+    def _arm_flush(self) -> None:
+        if self._flush_timer is not None and not (
+                self._staged_columns or self._staged_commands):
+            # First stage of this drain: (re)arm the safety-net flush.
+            self._flush_timer.stop()
+            self._flush_timer.start()
+
+    def _timer_flush(self) -> None:
+        if self._staged_columns or self._staged_commands:
+            self.flush_ingest()
+
+    def _handle_client_columns(self, src: Address,
+                               colrun: ColumnRun) -> None:
+        """Wire-sink handler: a whole client frame batch as columns."""
+        n = len(colrun)
+        if n == 0:
+            return
+        k = n
+        admission = self.admission
+        if admission is not None:
+            k = admission.admit_up_to(n)
+            if k < n:
+                for address, reply in colrun.reject_entries(
+                        k, admission.retry_after_ms(),
+                        admission.last_reason):
+                    self.send(address, reply)
+            if k == 0:
+                return
+        self._arm_flush()
+        self._staged_columns.append((colrun, k))
+
+    def _admit(self, message, n: int) -> bool:
+        admission = self.admission
+        if admission is None or admission.admit(n):
+            return True
+        from frankenpaxos_tpu.serve.admission import reject_replies_for
+
+        for client, reply in reject_replies_for(
+                message, admission.retry_after_ms(),
+                admission.last_reason):
+            self.send(client, reply)
+        return False
+
+    # --- handlers ---------------------------------------------------------
+    def receive(self, src: Address, message) -> None:
+        name = type(message).__name__
+        if name == "ClientRequest":
+            if self._admit(message, 1):
+                self._arm_flush()
+                self._staged_commands.append(message.command)
+        elif name == "ClientRequestArray":
+            if self._admit(message, len(message.commands)):
+                self._arm_flush()
+                self._staged_commands.extend(message.commands)
+        elif isinstance(message, NotLeaderIngest):
+            self._handle_not_leader(src, message)
+        elif self.router.is_info_reply(message):
+            self.router.note_info(message)
+            self._resend_pending()
+        else:
+            self.logger.fatal(
+                f"unexpected ingest batcher message {message!r}")
+
+    def _handle_not_leader(self, src: Address,
+                           bounce: NotLeaderIngest) -> None:
+        self._pending_runs.append((bounce.group_index, bounce.run))
+        request = self.router.info_request()
+        for dst in self.router.discovery_targets(bounce.group_index):
+            self.send(dst, request)
+
+    def _resend_pending(self) -> None:
+        pending, self._pending_runs = self._pending_runs, []
+        for group, run in pending:
+            self.send(self.router.leader(group), run)
+
+    # --- flush ------------------------------------------------------------
+    def on_drain(self) -> None:
+        self.flush_ingest()
+
+    def flush_ingest(self) -> None:
+        """Ship everything staged this drain as pre-encoded runs."""
+        if self._staged_columns:
+            staged, self._staged_columns = self._staged_columns, []
+            for colrun, k in staged:
+                values = colrun.lazy_values(k)
+                self._ship(self.router.choose_group(self.rng),
+                           values, nbytes=len(values.raw))
+        if self._staged_commands:
+            from frankenpaxos_tpu.protocols.multipaxos.messages import (
+                CommandBatch,
+            )
+
+            staged_cmds, self._staged_commands = \
+                self._staged_commands, []
+            max_run = self.options.max_run
+            for at in range(0, len(staged_cmds), max_run):
+                chunk = staged_cmds[at:at + max_run]
+                self._ship(self.router.choose_group(self.rng),
+                           tuple(CommandBatch((c,)) for c in chunk))
+
+    def _ship(self, group: int, values, nbytes: int = 0) -> None:
+        run = IngestRun(batcher_index=self.index, values=values)
+        self.send(self.router.leader(group), run)
+        metrics = self.transport.runtime_metrics
+        if metrics is not None:
+            raw = getattr(values, "raw", None)
+            metrics.ingest_batch(
+                len(values),
+                nbytes or (len(raw) + 8 if raw is not None else 0))

@@ -1,0 +1,32 @@
+"""paxingest wire messages (codecs in ingest/wire.py, tags 204-205)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestRun:
+    """A disseminator's pre-batched, pre-encoded run descriptor: one
+    CommandBatch-of-one value per slot, in client arrival order.
+
+    ``values`` is a ``LazyValueArray`` on the deployed path (the
+    batcher's column scan built the segment; the leader forwards the
+    raw bytes into ``Phase2aRun`` without parsing them) or a plain
+    tuple on the sim/fallback path. The leader only ever touches run
+    METADATA: ``len(values)`` for slot assignment and admission, the
+    raw segment for the proposal."""
+
+    batcher_index: int
+    values: tuple  # tuple[CommandBatchOrNoop, ...] | LazyValueArray
+
+
+@dataclasses.dataclass(frozen=True)
+class NotLeaderIngest:
+    """An inactive leader bouncing a run back to its disseminator so it
+    can re-route after leader discovery (the ingest twin of
+    NotLeaderBatcher). ``group_index`` scopes discovery to one Mencius
+    leader group (always 0 for MultiPaxos)."""
+
+    group_index: int
+    run: IngestRun

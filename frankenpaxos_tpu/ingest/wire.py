@@ -1,0 +1,61 @@
+"""Fixed-layout codecs for the ingest plane (extended tags 204-205).
+
+``IngestRun`` is the disseminator/sequencer hot path: its payload is
+the run pipeline's canonical value-array segment, so a batcher that
+scanned client frames into columns encodes the run as a RAW COPY, and
+the leader's ``Phase2aRun`` re-encode is another raw copy -- the bytes
+a client put on the wire reach the acceptors untouched. Both codecs
+are fuzz-gated in the PR 3 corrupt-frame completeness gate
+(tests/test_wire_codecs.py).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest
+from frankenpaxos_tpu.protocols.multipaxos.wire import (
+    _put_value_array,
+    _take_value_array,
+)
+from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
+
+_I32 = struct.Struct("<i")
+_I32I32 = struct.Struct("<ii")
+
+
+class IngestRunCodec(MessageCodec):
+    message_type = IngestRun
+    tag = 204
+
+    def encode(self, out, message):
+        out += _I32.pack(message.batcher_index)
+        _put_value_array(out, message.values)
+
+    def decode(self, buf, at):
+        (batcher_index,) = _I32.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 4)
+        return IngestRun(batcher_index=batcher_index,
+                         values=values), at
+
+
+class NotLeaderIngestCodec(MessageCodec):
+    message_type = NotLeaderIngest
+    tag = 205
+
+    def encode(self, out, message):
+        out += _I32I32.pack(message.group_index,
+                            message.run.batcher_index)
+        _put_value_array(out, message.run.values)
+
+    def decode(self, buf, at):
+        group_index, batcher_index = _I32I32.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 8)
+        return NotLeaderIngest(
+            group_index=group_index,
+            run=IngestRun(batcher_index=batcher_index,
+                          values=values)), at
+
+
+register_codec(IngestRunCodec())
+register_codec(NotLeaderIngestCodec())

@@ -75,6 +75,14 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fpx_unpack_votes2.restype = ctypes.c_longlong
         lib.fpx_unpack_votes2.argtypes = [
             u8p, ctypes.c_uint64, i64p, i32p, ctypes.c_uint32]
+        lib.fpx_ingest_scan.restype = ctypes.c_longlong
+        lib.fpx_ingest_scan.argtypes = [
+            u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u64p, i64p,
+            ctypes.c_uint32]
+        lib.fpx_value_columns.restype = ctypes.c_longlong
+        lib.fpx_value_columns.argtypes = [
+            u8p, ctypes.c_uint64, i64p, ctypes.c_uint32,
+            ctypes.c_uint32]
         _lib = lib
     except (OSError, subprocess.CalledProcessError):
         _load_failed = True
@@ -257,6 +265,224 @@ def scan_batch(buf, at: int, max_segs: int = 1 << 20
         raise ValueError("malformed batch frame")
     return [(at + offsets[2 * i], at + offsets[2 * i + 1])
             for i in range(n)]
+
+
+# --- paxingest column scans (ingest/, docs/TRANSPORT.md) --------------------
+# The zero-object decode path: a ClientFrameBatch payload scans ONCE into
+# (a) the run pipeline's value-array segment (LazyValueArray.raw layout,
+# deduped first-seen address table) and (b) SoA descriptor columns
+# (addr_idx, pseudonym, client_id, value_off, value_len) -- no
+# per-message Python object between recv() and the leader's Phase2aRun.
+# Contract shared by native and fallback: ValueError = torn/corrupt
+# (the transport's corrupt-frame containment channel); None = well-formed
+# but unsupported shape (mixed tags, exotic address kinds, trailing
+# bytes) -- the caller falls back to ordinary per-message decode.
+
+_CLIENT_REQUEST_TAG = 4    # multipaxos ClientRequest
+_CLIENT_ARRAY_TAG = 115    # multipaxos ClientRequestArray (coalesced)
+_MAX_INGEST_ADDRS = 4096   # codec.cpp kMaxIngestAddrs (parity)
+_COLS = 5  # addr_idx, pseudonym, client_id, value_off, value_len
+_I64X2 = struct.Struct("<qq")
+
+
+def _py_ingest_scan(buf, at: int, max_cmds: int = 1 << 20):
+    n_left = len(buf) - at
+    if n_left < 4:
+        raise ValueError("malformed batch frame: short count header")
+    (n,) = _U32LE.unpack_from(buf, at)
+    if 4 + 4 * n > n_left:
+        raise ValueError(
+            f"malformed batch frame: count {n} exceeds payload")
+    # The same effective cap the native wrapper sizes its buffers by
+    # (bit-for-bit verdict parity; see ingest_scan).
+    max_cmds = min(max_cmds, n_left // 20 + 8)
+    if n > max_cmds:
+        return None
+    rows: list = []
+    addr_spans: list = []   # each unique address's raw bytes
+    addr_index: dict = {}   # raw bytes -> index
+    seg_at = at + 4 + 4 * n
+    for i in range(n):
+        (seg_len,) = _U32LE.unpack_from(buf, at + 4 + 4 * i)
+        if seg_at + seg_len > len(buf):
+            raise ValueError(
+                "malformed batch frame: segment overruns payload")
+        if seg_len < 2:
+            raise ValueError("malformed ingest segment: too short")
+        tag = buf[seg_at]
+        if tag not in (_CLIENT_REQUEST_TAG, _CLIENT_ARRAY_TAG):
+            return None
+        kind = buf[seg_at + 1]
+        if seg_len < 6:
+            raise ValueError("malformed ingest segment: short address")
+        (alen,) = _U32LE.unpack_from(buf, seg_at + 2)
+        a_end = 6 + alen
+        if kind == 1:
+            a_end += 4
+        elif kind not in (0, 2):
+            return None
+        if a_end > seg_len:
+            raise ValueError("malformed ingest segment: short address")
+        araw = bytes(buf[seg_at + 1:seg_at + a_end])
+        idx = addr_index.get(araw)
+        if idx is None:
+            if len(addr_spans) == _MAX_INGEST_ADDRS:
+                return None  # mirrors codec.cpp kMaxIngestAddrs
+            idx = len(addr_spans)
+            addr_index[araw] = idx
+            addr_spans.append(araw)
+        if tag == _CLIENT_REQUEST_TAG:
+            entry_at, n_entries = a_end, 1
+        else:
+            if a_end + 4 > seg_len:
+                raise ValueError(
+                    "malformed ingest segment: short array count")
+            (n_entries,) = _U32LE.unpack_from(buf, seg_at + a_end)
+            entry_at = a_end + 4
+        for _ in range(n_entries):
+            if entry_at + 20 > seg_len:
+                raise ValueError(
+                    "malformed ingest segment: short command")
+            (vlen,) = _U32LE.unpack_from(buf,
+                                         seg_at + entry_at + 16)
+            if entry_at + 20 + vlen > seg_len:
+                raise ValueError(
+                    "malformed ingest segment: value overruns segment")
+            if len(rows) == max_cmds:
+                return None
+            pseudonym, client_id = _I64X2.unpack_from(
+                buf, seg_at + entry_at)
+            rows.append((idx, pseudonym, client_id,
+                         seg_at + entry_at + 20, vlen))
+            entry_at += 20 + vlen
+        if entry_at != seg_len:
+            return None  # trailing bytes: let the codec decide
+        seg_at += seg_len
+    if seg_at != len(buf):
+        raise ValueError("malformed batch frame: trailing garbage")
+    cols = np.asarray(rows, dtype=np.int64).reshape(-1, _COLS)
+    out = bytearray()
+    out += _U32LE.pack(len(addr_spans))
+    for araw in addr_spans:
+        out += araw
+    for idx, pseudonym, client_id, voff, vlen in rows:
+        out.append(1)
+        out += _U32LE.pack(1)
+        out += _U32LE.pack(idx)
+        out += _I64X2.pack(pseudonym, client_id)
+        out += _U32LE.pack(vlen)
+        out += buf[voff:voff + vlen]
+    return bytes(out), cols
+
+
+def ingest_scan(buf, at: int = 2, max_cmds: int = 1 << 20):
+    """Scan a ClientFrameBatch payload (``buf[at:]`` starts at the u32
+    segment count) into ``(value_array_raw, columns)`` in one pass, or
+    None when the batch's shape is unsupported. Raises ValueError on a
+    torn/corrupt table -- the corrupt-frame containment channel."""
+    lib = load()
+    if lib is None:
+        return _py_ingest_scan(buf, at, max_cmds)
+    n_left = len(buf) - at
+    if n_left < 4:
+        raise ValueError("malformed batch frame: short count header")
+    (n_segs,) = _U32LE.unpack_from(buf, at)
+    if 4 + 4 * n_segs > n_left:
+        raise ValueError(
+            f"malformed batch frame: count {n_segs} exceeds payload")
+    # Capacity bound: every command consumes >= 20 payload bytes (its
+    # fixed entry header), so n_left // 20 can never under-size. The
+    # output segment adds <= 9 bytes of body header per command plus
+    # the (deduped) address table, covered by the same bound.
+    cap = min(max_cmds, n_left // 20 + 8)
+    cols = np.empty((cap, _COLS), dtype=np.int64)
+    out = (ctypes.c_uint8 * (n_left + 32 * cap + 64))()
+    out_len = ctypes.c_uint64()
+    ptr, keepalive = _as_u8p_view(buf, at)
+    try:
+        n = lib.fpx_ingest_scan(
+            ptr, n_left, out, len(out), ctypes.byref(out_len),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+    finally:
+        del ptr, keepalive
+    if n == -1:
+        raise ValueError("malformed ingest batch frame")
+    if n < 0:
+        return None  # -3 unsupported shape (-2 cannot happen: cap sized)
+    # Offsets were computed relative to buf[at:]; make them absolute.
+    cols = cols[:n]
+    cols[:, 3] += at
+    return bytes(out[:out_len.value]), cols
+
+
+def _py_value_columns(raw, n: int):
+    cols = np.empty((n, _COLS), dtype=np.int64)
+    if len(raw) < 4:
+        raise ValueError("malformed value array: short table header")
+    (t,) = _U32LE.unpack_from(raw, 0)
+    at = 4
+    for _ in range(t):
+        if at + 5 > len(raw):
+            raise ValueError("malformed value array: torn address table")
+        kind = raw[at]
+        (alen,) = _U32LE.unpack_from(raw, at + 1)
+        at += 5 + alen
+        if kind == 1:
+            at += 4
+        elif kind not in (0, 2):
+            return None
+        if at > len(raw):
+            raise ValueError("malformed value array: torn address table")
+    for i in range(n):
+        if at + 1 > len(raw):
+            raise ValueError("malformed value array: torn body")
+        if raw[at] != 1:
+            return None  # noop or exotic value
+        if at + 5 > len(raw):
+            raise ValueError("malformed value array: torn body")
+        (k,) = _U32LE.unpack_from(raw, at + 1)
+        if k != 1:
+            return None  # multi-command batch
+        if at + 29 > len(raw):
+            raise ValueError("malformed value array: torn entry")
+        (idx,) = _U32LE.unpack_from(raw, at + 5)
+        if idx >= t:
+            raise ValueError("malformed value array: address index")
+        pseudonym, client_id = _I64X2.unpack_from(raw, at + 9)
+        (vlen,) = _U32LE.unpack_from(raw, at + 25)
+        if at + 29 + vlen > len(raw):
+            raise ValueError("malformed value array: value overrun")
+        cols[i] = (idx, pseudonym, client_id, at + 29, vlen)
+        at += 29 + vlen
+    if at != len(raw):
+        raise ValueError("malformed value array: trailing garbage")
+    return cols
+
+
+def value_columns(raw, n: int, max_cmds: int = 1 << 20):
+    """SoA descriptor columns from a value-array raw segment
+    (LazyValueArray.raw): per entry (addr_idx, pseudonym, client_id,
+    value_off, value_len), offsets absolute into ``raw``. None when the
+    segment holds anything but one-command batches (noops, wide
+    batches); ValueError on corruption."""
+    lib = load()
+    if n > max_cmds:
+        return None
+    if lib is None:
+        return _py_value_columns(raw, n)
+    cols = np.empty((max(n, 1), _COLS), dtype=np.int64)
+    ptr, keepalive = _as_u8p_view(raw, 0)
+    try:
+        got = lib.fpx_value_columns(
+            ptr, len(raw),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, n)
+    finally:
+        del ptr, keepalive
+    if got == -1:
+        raise ValueError("malformed value array")
+    if got < 0:
+        return None
+    return cols[:n]
 
 
 def pack_votes(slots: np.ndarray, nodes: np.ndarray,

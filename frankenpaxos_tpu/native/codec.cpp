@@ -152,6 +152,213 @@ long long fpx_scan_batch(const uint8_t* buf, uint64_t len,
   return n;
 }
 
+// --- paxingest: wire-to-run-pipeline column scan ----------------------------
+// The zero-object decode path (frankenpaxos_tpu/ingest/, docs/TRANSPORT.md):
+// a ClientFrameBatch arriving on the wire scans ONCE into SoA columns and
+// the run pipeline's value-array segment, so no per-message Python object
+// (Command/ClientRequest/CommandId) ever materializes between recv() and
+// the leader's Phase2aRun.
+//
+// Input: the batch payload with the two leading tag bytes consumed (`buf`
+// points AT the u32 segment count, exactly like fpx_scan_batch). Every
+// segment must be a client-write payload, either shape:
+//   tag 4 (ClientRequest):
+//     [0x04][address][i64 pseudonym][i64 client_id][u32 len][cmd bytes]
+//   tag 115 (ClientRequestArray -- the coalescing client's shape; ONE
+//   address covers all its commands):
+//     [0x73][address][i32 n][n * (i64 pseudonym, i64 id, u32 len, bytes)]
+//   address = [u8 kind][u32 len][bytes]([i32 port] when kind == 1)
+//
+// Output:
+//   * `out` receives the RUN-PIPELINE VALUE ARRAY segment -- the exact
+//     byte layout multipaxos/wire.py's _put_value_array produces for a
+//     one-CommandBatch-per-command run (deduped address table in
+//     first-seen order, then per-command bodies). A LazyValueArray over
+//     these bytes re-encodes as a raw copy all the way to the acceptors.
+//   * `cols` receives n rows of 5 int64 columns: (addr_idx, pseudonym,
+//     client_id, value_off, value_len), value offsets ABSOLUTE into
+//     `buf` -- the descriptor the reply path consumes without decoding.
+//
+// Returns the command count; -1 = malformed (torn/corrupt -- the caller
+// surfaces ValueError through the transport's corrupt-frame guard);
+// -2 = out_cap too small; -3 = well-formed but unsupported shape (mixed
+// tags, exotic address kind, trailing bytes): the caller falls back to
+// the ordinary per-message decode, which defines the semantics.
+
+namespace {
+constexpr uint32_t kMaxIngestAddrs = 4096;
+}
+
+long long fpx_ingest_scan(const uint8_t* buf, uint64_t len, uint8_t* out,
+                          uint64_t out_cap, uint64_t* out_len,
+                          int64_t* cols, uint32_t max_cmds) {
+  if (len < 4) return -1;
+  uint32_t n;
+  std::memcpy(&n, buf, 4);
+  // Corruption checks strictly before shape checks (the Python
+  // fallback mirrors this order bit-for-bit).
+  if (4ull + 4ull * n > len) return -1;
+  if (n > max_cmds) return -3;
+  // Segment table (same validation contract as fpx_scan_batch).
+  uint64_t at = 4ull + 4ull * n;
+  // Pass A: validate every segment, dedup addresses by raw bytes.
+  uint64_t addr_off[kMaxIngestAddrs];
+  uint64_t addr_len[kMaxIngestAddrs];
+  uint32_t n_addrs = 0;
+  uint64_t table_bytes = 0;
+  uint64_t body_bytes = 0;
+  uint64_t seg_at = at;
+  uint64_t cmds = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t seg_len;
+    std::memcpy(&seg_len, buf + 4 + 4ull * i, 4);
+    if (seg_at + seg_len > len) return -1;
+    const uint8_t* seg = buf + seg_at;
+    if (seg_len < 2) return -1;
+    const uint8_t tag = seg[0];
+    if (tag != 4 && tag != 115) return -3;  // not a client write
+    const uint8_t kind = seg[1];
+    if (seg_len < 1 + 5) return -1;
+    uint32_t alen;
+    std::memcpy(&alen, seg + 2, 4);
+    uint64_t a_end = 1ull + 5ull + alen;  // past [kind][len][bytes]
+    if (kind == 1) {
+      a_end += 4;  // [i32 port]
+    } else if (kind != 0 && kind != 2) {
+      return -3;  // unknown address kind: let Python decode decide
+    }
+    if (a_end > seg_len) return -1;
+    // Dedup the address raw bytes [1, a_end).
+    const uint64_t araw_len = a_end - 1;
+    uint32_t idx = n_addrs;
+    for (uint32_t a = 0; a < n_addrs; ++a) {
+      if (addr_len[a] == araw_len
+          && std::memcmp(buf + addr_off[a], seg + 1, araw_len) == 0) {
+        idx = a;
+        break;
+      }
+    }
+    if (idx == n_addrs) {
+      if (n_addrs == kMaxIngestAddrs) return -3;
+      addr_off[n_addrs] = seg_at + 1;
+      addr_len[n_addrs] = araw_len;
+      table_bytes += araw_len;
+      ++n_addrs;
+    }
+    uint64_t entry_at;   // first (pseudonym, id, len, bytes) entry
+    uint64_t n_entries;
+    if (tag == 4) {
+      entry_at = a_end;
+      n_entries = 1;
+    } else {
+      if (a_end + 4 > seg_len) return -1;
+      uint32_t k;
+      std::memcpy(&k, seg + a_end, 4);
+      entry_at = a_end + 4;
+      n_entries = k;
+    }
+    for (uint64_t e = 0; e < n_entries; ++e) {
+      if (entry_at + 20 > seg_len) return -1;
+      uint32_t vlen;
+      std::memcpy(&vlen, seg + entry_at + 16, 4);
+      if (entry_at + 20ull + vlen > seg_len) return -1;
+      if (cmds == max_cmds) return -3;
+      // body entry: [u8 1][i32 1][i32 idx][i64 pseudonym][i64 id]
+      //             [u32 vlen][payload]
+      body_bytes += 1 + 4 + 20 + 4 + vlen;
+      cols[5ull * cmds + 0] = idx;
+      int64_t pseudonym, client_id;
+      std::memcpy(&pseudonym, seg + entry_at, 8);
+      std::memcpy(&client_id, seg + entry_at + 8, 8);
+      cols[5ull * cmds + 1] = pseudonym;
+      cols[5ull * cmds + 2] = client_id;
+      cols[5ull * cmds + 3] =
+          static_cast<int64_t>(seg_at + entry_at + 20);
+      cols[5ull * cmds + 4] = vlen;
+      ++cmds;
+      entry_at += 20ull + vlen;
+    }
+    if (entry_at != seg_len) return -3;  // trailing bytes
+    seg_at += seg_len;
+  }
+  if (seg_at != len) return -1;  // trailing garbage = torn/corrupt
+  const uint64_t total = 4 + table_bytes + body_bytes;
+  if (total > out_cap) return -2;
+  // Pass B: write [i32 t][addresses][bodies].
+  std::memcpy(out, &n_addrs, 4);
+  uint64_t w = 4;
+  for (uint32_t a = 0; a < n_addrs; ++a) {
+    std::memcpy(out + w, buf + addr_off[a], addr_len[a]);
+    w += addr_len[a];
+  }
+  const uint32_t one = 1;
+  for (uint64_t i = 0; i < cmds; ++i) {
+    out[w] = 1;
+    std::memcpy(out + w + 1, &one, 4);
+    const uint32_t idx = static_cast<uint32_t>(cols[5ull * i + 0]);
+    std::memcpy(out + w + 5, &idx, 4);
+    std::memcpy(out + w + 9, &cols[5ull * i + 1], 8);
+    std::memcpy(out + w + 17, &cols[5ull * i + 2], 8);
+    const uint32_t vlen = static_cast<uint32_t>(cols[5ull * i + 4]);
+    std::memcpy(out + w + 25, &vlen, 4);
+    std::memcpy(out + w + 29, buf + cols[5ull * i + 3], vlen);
+    w += 29ull + vlen;
+  }
+  *out_len = w;
+  return static_cast<long long>(cmds);
+}
+
+// Columns from a VALUE-ARRAY raw segment (LazyValueArray.raw: the layout
+// fpx_ingest_scan emits and _put_value_array writes). Supports exactly
+// the ingest-plane shape -- every entry a one-command CommandBatch --
+// and returns -3 for anything else (noops, multi-command batches) so
+// consumers fall back to the decoding path. Value offsets are ABSOLUTE
+// into `buf`. `n` is the declared entry count (LazyValueArray.n).
+long long fpx_value_columns(const uint8_t* buf, uint64_t len, int64_t* cols,
+                            uint32_t max_cmds, uint32_t n) {
+  if (len < 4 || n > max_cmds) return n > max_cmds ? -3 : -1;
+  uint32_t t;
+  std::memcpy(&t, buf, 4);
+  uint64_t at = 4;
+  // Walk the address table to find where bodies start.
+  for (uint32_t a = 0; a < t; ++a) {
+    if (at + 5 > len) return -1;
+    const uint8_t kind = buf[at];
+    uint32_t alen;
+    std::memcpy(&alen, buf + at + 1, 4);
+    at += 5ull + alen;
+    if (kind == 1) at += 4;
+    else if (kind != 0 && kind != 2) return -3;
+    if (at > len) return -1;
+  }
+  for (uint32_t i = 0; i < n; ++i) {
+    if (at + 1 > len) return -1;
+    if (buf[at] != 1) return -3;  // noop or exotic value
+    if (at + 5 > len) return -1;
+    uint32_t k;
+    std::memcpy(&k, buf + at + 1, 4);
+    if (k != 1) return -3;  // multi-command batch
+    if (at + 5 + 20 + 4 > len) return -1;
+    uint32_t idx;
+    std::memcpy(&idx, buf + at + 5, 4);
+    if (idx >= t) return -1;
+    int64_t pseudonym, client_id;
+    std::memcpy(&pseudonym, buf + at + 9, 8);
+    std::memcpy(&client_id, buf + at + 17, 8);
+    uint32_t vlen;
+    std::memcpy(&vlen, buf + at + 25, 4);
+    if (at + 29ull + vlen > len) return -1;
+    cols[5ull * i + 0] = idx;
+    cols[5ull * i + 1] = pseudonym;
+    cols[5ull * i + 2] = client_id;
+    cols[5ull * i + 3] = static_cast<int64_t>(at + 29);
+    cols[5ull * i + 4] = vlen;
+    at += 29ull + vlen;
+  }
+  if (at != len) return -1;
+  return n;
+}
+
 // --- Phase2b vote-batch codec ---------------------------------------------
 // Wire layout: [u32 count][count * (i32 slot, i32 node, i32 round)] with
 // little-endian fixed-width ints (the host side hands these straight to

@@ -199,6 +199,25 @@ class RuntimeMetrics:
             "fpx_runtime_transport_batch_bytes",
             help="Bytes sent through the batched (paxwire) flush path",
             labels=("role",)).labels(role)
+        # paxingest (ingest/, docs/TRANSPORT.md): the ingestion-plane
+        # health triple for batchers and leaders -- commands moved as
+        # pre-batched run descriptors, descriptor bytes (run metadata
+        # + raw value segments forwarded without decode), and the
+        # per-run fill (commands per descriptor).
+        self._ingest_cmds = collectors.counter(
+            "fpx_runtime_ingest_batched_cmds_total",
+            help="Client commands shipped/consumed as pre-batched "
+                 "ingest run descriptors",
+            labels=("role",)).labels(role)
+        self._ingest_bytes = collectors.counter(
+            "fpx_runtime_ingest_descriptor_bytes",
+            help="Run-descriptor bytes handled by the ingest plane "
+                 "(value segments forwarded as raw copies)",
+            labels=("role",)).labels(role)
+        self._ingest_fill = collectors.summary(
+            "fpx_runtime_ingest_batch_fill",
+            help="Commands per ingest run descriptor (batch fill)",
+            labels=("role",)).labels(role)
         # paxworld (scenarios/, docs/GLOBAL.md): per-region serving
         # health for the Grafana "Global serving" band -- commands
         # committed and client commands rejected/shed, labeled by the
@@ -260,6 +279,13 @@ class RuntimeMetrics:
             child = self._retry_counter.labels(self.role, kind)
             self._retry_children[kind] = child
         child.inc(n)
+
+    # --- paxingest ingestion plane (ingest/) ----------------------------
+    def ingest_batch(self, cmds: int, nbytes: int) -> None:
+        self._ingest_cmds.inc(cmds)
+        if nbytes:
+            self._ingest_bytes.inc(nbytes)
+        self._ingest_fill.observe(cmds)
 
     # --- paxworld global serving (scenarios/) ---------------------------
     def region_goodput(self, region: str, n: int = 1) -> None:

@@ -14,6 +14,9 @@ consecutive owned slots stay implicit and idle groups' slots keep
 coalescing into the noop-range skip machinery.
 """
 
+# The ingest plane's run-descriptor codecs (mencius leaders consume
+# IngestRun too; an unregistered descriptor would silently pickle).
+from frankenpaxos_tpu.ingest import wire as _ingest_wire  # noqa: F401
 # Importing registers the Mencius-specific binary codecs with the
 # hybrid serializer (the inner MultiPaxos machinery's types are
 # registered by protocols.multipaxos).

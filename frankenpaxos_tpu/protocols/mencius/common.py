@@ -186,6 +186,13 @@ class MenciusConfig:
     replica_addresses: tuple
     proxy_replica_addresses: tuple
     distribution_scheme: DistributionScheme = DistributionScheme.HASH
+    # paxingest disseminators (ingest/, docs/TRANSPORT.md): any count
+    # >= 1 is valid -- WAL-free, client retries cover failover.
+    ingest_batcher_addresses: tuple = ()
+
+    @property
+    def num_ingest_batchers(self) -> int:
+        return len(self.ingest_batcher_addresses)
 
     @property
     def quorum_size(self) -> int:

@@ -426,7 +426,12 @@ class MenciusClient(Actor):
         self._flush_scheduled = False
 
     def _send_request(self, request: ClientRequest) -> None:
-        if self.config.num_batchers > 0:
+        if self.config.num_ingest_batchers > 0:
+            # paxingest: disseminators absorb the fan-in (resends
+            # re-roll the pick, so a dead batcher costs a retry).
+            dst = self.config.ingest_batcher_addresses[
+                self.rng.randrange(self.config.num_ingest_batchers)]
+        elif self.config.num_batchers > 0:
             dst = self.config.batcher_addresses[
                 self.rng.randrange(self.config.num_batchers)]
         else:
@@ -447,9 +452,13 @@ class MenciusClient(Actor):
         if not self._staged_writes:
             return
         staged, self._staged_writes = self._staged_writes, []
-        group = self.rng.randrange(self.config.num_leader_groups)
-        self.send(self._leader_of_group(group),
-                  ClientRequestArray(commands=tuple(staged)))
+        if self.config.num_ingest_batchers > 0:
+            dst = self.config.ingest_batcher_addresses[
+                self.rng.randrange(self.config.num_ingest_batchers)]
+        else:
+            group = self.rng.randrange(self.config.num_leader_groups)
+            dst = self._leader_of_group(group)
+        self.send(dst, ClientRequestArray(commands=tuple(staged)))
 
     def _deferred_flush(self) -> None:
         self._flush_scheduled = False

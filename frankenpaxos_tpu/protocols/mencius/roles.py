@@ -394,6 +394,76 @@ class MenciusLeader(Actor):
                       HighWatermark(next_slot=self.next_slot))
             self._commands_since_watermark_send = 0
 
+    # --- paxingest (ingest/, docs/TRANSPORT.md) ---------------------------
+    def _note_ingest(self, cmds: int, nbytes: int) -> None:
+        metrics = self.transport.runtime_metrics
+        if metrics is not None:
+            metrics.ingest_batch(cmds, nbytes)
+
+    def _propose_value_run(self, values) -> None:
+        """Post-admission Phase2 proposal of one-value-per-OWNED-slot
+        ``values`` (tuple or LazyValueArray forwarded raw): the shared
+        tail of the array / wire-column / IngestRun paths."""
+        self.logger.check_eq(self.state, ("phase2",))
+        if len(self._my_acceptor_groups) > 1:
+            # Strided runs need a single acceptor audience; per-slot
+            # fallback (iterating decodes a lazy array -- this config
+            # is off the zero-object path).
+            for value in values:
+                self._process_batch(ClientRequestBatch(value))
+            return
+        change = self._epoch_change
+        if change is not None and not change.activated:
+            change.pending.extend(
+                ClientRequestBatch(value) for value in values)
+            return
+        stride = self.config.num_leader_groups
+        k = len(values)
+        self.send(self._proxy_leader(), Phase2aRun(
+            start_slot=self.next_slot, stride=stride, round=self.round,
+            values=values))
+        self._advance_proxy_leader()
+        self.next_slot += k * stride
+        self._gossip_watermark(k)
+
+    def _handle_ingest_run(self, src: Address, run) -> None:
+        """A disseminator's pre-batched run descriptor: one strided
+        Phase2aRun from pre-encoded values -- this leader touches only
+        run metadata (see the multipaxos twin)."""
+        from frankenpaxos_tpu.ingest.columns import (
+            reject_value_suffix,
+            value_view,
+        )
+        from frankenpaxos_tpu.ingest.messages import NotLeaderIngest
+
+        values = run.values
+        n = len(values)
+        if n == 0:
+            return
+        if self.state == ("inactive",):
+            self.send(src, NotLeaderIngest(group_index=self.group_index,
+                                           run=run))
+            return
+        k = n
+        admission = self.admission
+        if admission is not None:
+            k = admission.admit_up_to(n)
+            if k < n:
+                reject_value_suffix(self.send, values, k, admission)
+                if k == 0:
+                    return
+                view = value_view(values)
+                values = (view.lazy_values(k) if view is not None
+                          else tuple(values)[:k])
+        if isinstance(self.state, _Phase1):
+            self._admitted_backlog += k
+            for value in tuple(values)[:k]:  # cold: Phase1 only
+                self.state.pending_batches.append(
+                    ClientRequestBatch(value))
+            return
+        self._note_ingest(k, len(getattr(values, "raw", b"")))
+        self._propose_value_run(values)
+
     def _process_request_array(self, array: ClientRequestArray) -> None:
         """A drain's worth of independent requests: assign each its own
         OWNED slot (next_slot, next_slot + G, ...) and propose the whole
@@ -436,6 +506,8 @@ class MenciusLeader(Actor):
                 from_client=True)
         elif isinstance(message, ClientRequestArray):
             self._handle_client_request_array(src, message)
+        elif type(message).__name__ == "IngestRun":
+            self._handle_ingest_run(src, message)
         elif isinstance(message, ClientRequestBatch):
             self._handle_client_request_batch(src, message,
                                               from_client=False)

@@ -10,8 +10,12 @@ a pluggable quorum tracker; the "tpu" backend batches votes onto the
 TpuQuorumChecker vote board (ops/quorum.py) once per event-loop drain.
 """
 
+from frankenpaxos_tpu.ingest import wire as _ingest_wire  # noqa: F401
 # Importing registers the hot-path binary codecs with the hybrid
-# serializer (its module docstring explains the wire schema).
+# serializer (its module docstring explains the wire schema) -- the
+# protocol's own page plus the ingest plane's IngestRun/NotLeaderIngest
+# descriptors (ingest/wire.py; an unregistered IngestRun would silently
+# pickle, exactly the COD301 class).
 from frankenpaxos_tpu.protocols.multipaxos import wire  # noqa: F401
 from frankenpaxos_tpu.protocols.multipaxos.acceptor import (
     Acceptor,

@@ -331,7 +331,13 @@ class Client(Actor):
             self.rng.randrange(self.config.num_replicas)]
 
     def _send_client_request(self, request: ClientRequest) -> None:
-        if self.config.num_batchers > 0:
+        if self.config.num_ingest_batchers > 0:
+            # paxingest: disseminators absorb the fan-in; a resend
+            # (timeout failover) re-rolls the pick, so a dead batcher
+            # costs a retry, not a wedge.
+            dst = self.config.ingest_batcher_addresses[
+                self.rng.randrange(self.config.num_ingest_batchers)]
+        elif self.config.num_batchers > 0:
             dst = self.config.batcher_addresses[
                 self.rng.randrange(self.config.num_batchers)]
         else:
@@ -340,12 +346,18 @@ class Client(Actor):
         self.send(dst, request)
 
     def flush_writes(self) -> None:
-        """Ship writes staged by ``coalesce_writes`` as one array."""
+        """Ship writes staged by ``coalesce_writes`` as one array (to
+        an ingest disseminator when the config deploys them, else
+        straight to the round's leader)."""
         if not self._staged_writes:
             return
         staged, self._staged_writes = self._staged_writes, []
-        dst = self.config.leader_addresses[
-            self.round_system.leader(self.round)]
+        if self.config.num_ingest_batchers > 0:
+            dst = self.config.ingest_batcher_addresses[
+                self.rng.randrange(self.config.num_ingest_batchers)]
+        else:
+            dst = self.config.leader_addresses[
+                self.round_system.leader(self.round)]
         self.send(dst, ClientRequestArray(commands=tuple(staged)))
 
     def _deferred_flush(self) -> None:

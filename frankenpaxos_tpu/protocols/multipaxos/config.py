@@ -38,6 +38,18 @@ class MultiPaxosConfig:
     proxy_replica_addresses: Sequence[Address]
     flexible: bool = False
     distribution_scheme: DistributionScheme = DistributionScheme.HASH
+    # paxingest (ingest/, docs/TRANSPORT.md): disseminator roles that
+    # absorb client fan-in and hand leaders pre-batched IngestRun
+    # descriptors. When non-empty, clients route writes here instead of
+    # to batchers/leaders. WAL-free by design -- a dead batcher costs
+    # client retries (covered by retry budgets + the replica client
+    # table's exactly-once), never acked-write loss, so ANY count >= 1
+    # is valid (failover is the client's resend to another batcher).
+    ingest_batcher_addresses: Sequence[Address] = ()
+
+    @property
+    def num_ingest_batchers(self) -> int:
+        return len(self.ingest_batcher_addresses)
 
     @property
     def num_batchers(self) -> int:

@@ -28,6 +28,14 @@ from frankenpaxos_tpu.election.basic import (
     ElectionOptions,
     ElectionParticipant,
 )
+from frankenpaxos_tpu.ingest.columns import (
+    CLIENT_ARRAY_TAG,
+    parse_client_array,
+    parse_client_batch,
+    reject_value_suffix,
+    value_view,
+)
+from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest
 from frankenpaxos_tpu.protocols.multipaxos.config import (
     DistributionScheme,
     MultiPaxosConfig,
@@ -62,6 +70,7 @@ from frankenpaxos_tpu.reconfig import (
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
+from frankenpaxos_tpu.runtime.paxwire import CLIENT_BATCH_TAG
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 
 
@@ -218,6 +227,17 @@ class Leader(Actor):
         self._current_proxy_leader = 0
         self._unflushed_phase2as = 0
         self._chunk_sent = 0
+        # paxingest (ingest/, docs/TRANSPORT.md): client batch frames
+        # and un-batched coalesced arrays land as SoA columns and
+        # propose as ONE run -- the wire-to-device fast path for
+        # direct client->leader deployments (batcher'd deployments
+        # arrive as IngestRun).
+        self.wire_sinks = {
+            CLIENT_BATCH_TAG: (parse_client_batch,
+                               self._handle_client_columns),
+            CLIENT_ARRAY_TAG: (parse_client_array,
+                               self._handle_client_columns),
+        }
 
         # Embedded election participant (Leader.scala:192-203).
         self.election = ElectionParticipant(
@@ -565,6 +585,7 @@ class Leader(Actor):
              self._handle_client_request_array),
             (ClientRequestBatch, "ClientRequestBatch",
              self._handle_client_request_batch),
+            (IngestRun, "IngestRun", self._handle_ingest_run),
             (LeaderInfoRequestClient, "LeaderInfoRequestClient",
              self._handle_leader_info_request_client),
             (LeaderInfoRequestBatcher, "LeaderInfoRequestBatcher",
@@ -779,13 +800,24 @@ class Leader(Actor):
                 self.state.pending_batches.append(
                     ClientRequestBatch(CommandBatch((command,))))
             return
+        self._propose_value_run(
+            tuple(CommandBatch((c,)) for c in array.commands))
+
+    def _propose_value_run(self, values) -> None:
+        """Post-admission Phase2 proposal of one-value-per-slot
+        ``values`` -- a tuple, or a LazyValueArray whose raw segment is
+        forwarded without a parse (the ingest fast path). The shared
+        tail of the array / wire-column / IngestRun paths."""
         if self.config.num_acceptor_groups > 1 and not self.config.flexible:
             # Slots stripe over acceptor groups (slot % G) in this mode,
             # so a contiguous run has no single acceptor audience; fall
-            # back to per-slot proposals.
-            for command in array.commands:
-                self._process_client_request_batch(
-                    ClientRequestBatch(CommandBatch((command,))))
+            # back to per-slot proposals (iterating decodes a lazy
+            # array -- this config is off the zero-object path).
+            for value in values:
+                self._send_phase2a(Phase2a(slot=self.next_slot,
+                                           round=self.round,
+                                           value=value))
+                self.next_slot += 1
             return
         pending = self._epoch_buffering()
         if pending is not None:
@@ -793,22 +825,95 @@ class Leader(Actor):
             # activation quorum yet, and these commands' slots belong
             # to the NEW epoch -- hold them so in-flight runs drain in
             # the old epoch while the commit settles.
-            pending.extend(CommandBatch((c,)) for c in array.commands)
+            pending.extend(values)
             return
         if self._epoch_tagging:
-            self._send_epoch_runs(
-                tuple(CommandBatch((c,)) for c in array.commands))
+            self._send_epoch_runs(tuple(values))
             return
         run = Phase2aRun(
-            start_slot=self.next_slot, round=self.round,
-            values=tuple(CommandBatch((c,)) for c in array.commands))
-        k = len(array.commands)
+            start_slot=self.next_slot, round=self.round, values=values)
+        k = len(values)
         self.next_slot += k
         dst = self._proxy_leader_address()
         self.send(dst, run)
         # A run counts as k slots toward the proxy-leader chunk
         # rotation (runs never use the no-flush buffer).
         self._account_sent_slots(dst, k)
+
+    # --- paxingest (ingest/, docs/TRANSPORT.md) ---------------------------
+    def _note_ingest(self, cmds: int, nbytes: int) -> None:
+        metrics = self.transport.runtime_metrics
+        if metrics is not None:
+            metrics.ingest_batch(cmds, nbytes)
+
+    def _handle_client_columns(self, src: Address, colrun) -> None:
+        """Wire-sink handler: a whole ClientFrameBatch as SoA columns.
+        The hot branch proposes the frame as ONE Phase2aRun whose value
+        bytes are the clients' own wire bytes (LazyValueArray over the
+        scanned segment -- re-encoding is a raw copy); inactive /
+        Phase1 / refused-suffix conditions keep per-message
+        semantics on the cold path."""
+        n = len(colrun)
+        if n == 0:
+            return
+        if isinstance(self.state, _Inactive):
+            # One bounce per frame: every segment shares the sending
+            # connection, and redirect discovery is per-client anyway.
+            self.send(src, NotLeaderClient())
+            return
+        k = n
+        admission = self.admission
+        if admission is not None:
+            k = admission.admit_up_to(n)
+            if k < n:
+                for address, reply in colrun.reject_entries(
+                        k, admission.retry_after_ms(),
+                        admission.last_reason):
+                    self.send(address, reply)
+            if k == 0:
+                return
+        if isinstance(self.state, _Phase1):
+            self._admitted_backlog += k
+            for command in colrun.commands(k):  # cold: Phase1 only
+                self.state.pending_batches.append(
+                    ClientRequestBatch(CommandBatch((command,))))
+            return
+        values = colrun.lazy_values(k)
+        self._note_ingest(k, len(values.raw))
+        self._propose_value_run(values)
+
+    def _handle_ingest_run(self, src: Address, run: IngestRun) -> None:
+        """A disseminator's pre-batched run descriptor: assign a
+        contiguous slot block and forward the pre-encoded values as one
+        Phase2aRun -- the leader touches only run metadata (count, raw
+        bytes). ``src`` is the batcher, so the inactive bounce returns
+        the RUN for re-routing after leader discovery."""
+        values = run.values
+        n = len(values)
+        if n == 0:
+            return
+        if isinstance(self.state, _Inactive):
+            self.send(src, NotLeaderIngest(group_index=0, run=run))
+            return
+        k = n
+        admission = self.admission
+        if admission is not None:
+            k = admission.admit_up_to(n)
+            if k < n:
+                reject_value_suffix(self.send, values, k, admission)
+                if k == 0:
+                    return
+                view = value_view(values)
+                values = (view.lazy_values(k) if view is not None
+                          else tuple(values)[:k])
+        if isinstance(self.state, _Phase1):
+            self._admitted_backlog += k
+            for value in tuple(values)[:k]:  # cold: Phase1 only
+                self.state.pending_batches.append(
+                    ClientRequestBatch(value))
+            return
+        self._note_ingest(k, len(getattr(values, "raw", b"")))
+        self._propose_value_run(values)
 
     def _handle_client_request_batch(self, src: Address,
                                      batch: ClientRequestBatch) -> None:

@@ -99,6 +99,17 @@ class ProxyLeader(Actor):
             "multipaxos_proxy_leader_tpu_collect_seconds")
         self.grid = config.quorum_grid() if config.flexible else None
         self._row_size = len(config.acceptor_addresses[0])
+        # paxingest (ingest/): control batch frames of vote acks land
+        # as SoA range rows -- no Phase2b/Phase2bRange object per
+        # segment (non-ack control batches parse to None and fall back
+        # to per-message delivery).
+        from frankenpaxos_tpu.ingest.columns import parse_ack_batch
+        from frankenpaxos_tpu.runtime.paxwire import CONTROL_BATCH_TAG
+
+        self.wire_sinks = {
+            CONTROL_BATCH_TAG: (parse_ack_batch,
+                                self._handle_ack_columns),
+        }
         # (slot, round) -> pending value; moved to _done once chosen.
         self.pending: dict[tuple[int, int], object] = {}
         self._done: set[tuple[int, int]] = set()
@@ -455,6 +466,32 @@ class ProxyLeader(Actor):
         self.tracker.record_range(r.slot_start_inclusive,
                                   r.slot_end_exclusive, r.round,
                                   r.group_index, r.acceptor_index)
+
+    def _handle_ack_columns(self, src: Address, acks) -> None:
+        """Wire-sink handler (paxingest): a whole batch frame of vote
+        acks as (start, end, round, group, acceptor) rows, fed to the
+        quorum tracker range-at-a-time. Width-1 rows keep the
+        never-sent-a-Phase2a tripwire exactly like _handle_phase2b;
+        wider rows follow _handle_phase2b_range's
+        no-per-slot-pending-check rationale."""
+        self.metrics_requests.labels("AckColumns").inc()
+        epoch_tracker = self._epoch_tracker
+        for start, end, rnd, group, acceptor in acks.rows.tolist():
+            if end - start == 1:
+                key = (start, rnd)
+                if key not in self.pending \
+                        and self._run_for(start, rnd) is None:
+                    if key not in self._done \
+                            and not self._in_done_runs(start, rnd):
+                        self.logger.fatal(
+                            f"ProxyLeader got Phase2b for {key} but "
+                            f"never sent a Phase2a there")
+                    continue
+            if epoch_tracker is not None:
+                epoch_tracker.record_range(start, end, rnd, src)
+            else:
+                self.tracker.record_range(start, end, rnd, group,
+                                          acceptor)
 
     def _handle_phase2b_votes(self, src: Address, m) -> None:
         """A packed fragmented-drain ack (Phase2bVotes): unpack with

@@ -72,6 +72,22 @@ class Actor(abc.ABC):
     # disabled-path budget (bench_results/overload_lt.json).
     admission = None
 
+    # paxingest (ingest/): the zero-object wire-sink fast path. None
+    # (the default) keeps delivery untouched. An opted-in actor sets a
+    # ``{leading wire tag: (parser, handler)}`` mapping: when a frame's
+    # payload leads with a mapped tag, TcpTransport calls
+    # ``parser(payload)`` under its corrupt-frame guard (ValueError =
+    # torn/corrupt, log-and-drop; None = unsupported shape, fall back
+    # to ordinary per-message decode+deliver) and, on success, hands
+    # the parsed descriptor to ``handler(src, parsed)`` with normal
+    # handler semantics -- no per-message objects in between. The
+    # parsed object must expose ``count`` (messages represented) for
+    # drain bookkeeping. Sinks are bypassed whenever a tracer is
+    # attached (per-message span semantics win) -- and role-level
+    # admission is the SINK's job: the transport's client-lane inbox
+    # shed does not see sink frames.
+    wire_sinks = None
+
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger):
         self.address = address

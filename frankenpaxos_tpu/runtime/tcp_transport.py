@@ -384,18 +384,45 @@ class TcpTransport(Transport):
             ctx = (TraceContext.decode(trace_part)
                    if trace_part else None)
             data = bytes(buf[start + 4 + hlen:end])
-            if paxwire.is_batch_payload(data):
+            # The frame's actor, resolved ONCE (decode below reuses it,
+            # so the wire-sink check costs one attribute test net).
+            actor = self._actor_for(local)
+            # paxingest wire-sink fast path (Actor.wire_sinks): hand a
+            # whole undecoded batch payload to the actor's column
+            # parser -- no per-message decode, no expansion. Only the
+            # PARSE runs under this corrupt-frame guard; the handler
+            # runs below with ordinary handler semantics. Bypassed
+            # under a tracer (per-message span semantics win).
+            fast = None
+            sinks = getattr(actor, "wire_sinks", None)
+            if sinks is not None and self.tracer is None:
+                sink = sinks.get(paxwire.leading_tag(data))
+                if sink is not None:
+                    metrics = self.runtime_metrics
+                    if metrics is not None:
+                        p0 = time.perf_counter()
+                        parsed = sink[0](data)
+                        metrics.observe_stage(
+                            "decode", time.perf_counter() - p0)
+                    else:
+                        parsed = sink[0](data)
+                    if parsed is not None:
+                        fast = (actor, sink[1], parsed)
+            if fast is not None:
+                pass
+            elif paxwire.is_batch_payload(data):
                 segments = paxwire.split_batch(data)
             else:
                 segments = (data,)
             deliveries = []
             tracer = self.tracer
             metrics = self.runtime_metrics
-            for segment in segments:
+            for segment in segments if fast is None else ():
                 if tracer is not None and ctx is not None \
                         and ctx.sampled:
                     m0 = tracer.mono()
-                    delivery = self._decode(local, src, segment)
+                    delivery = self._decode(local, src, segment,
+                                            actor)
                     if delivery is not None:
                         tracer.record_stage("decode", m0, ctx)
                 elif metrics is not None:
@@ -403,37 +430,70 @@ class TcpTransport(Transport):
                     # on: the drain-stage histogram still sees EVERY
                     # decode -- sampling must not starve it.
                     p0 = time.perf_counter()
-                    delivery = self._decode(local, src, segment)
+                    delivery = self._decode(local, src, segment,
+                                            actor)
                     if delivery is not None:
                         metrics.observe_stage(
                             "decode", time.perf_counter() - p0)
                 else:
-                    delivery = self._decode(local, src, segment)
+                    delivery = self._decode(local, src, segment,
+                                            actor)
                 if delivery is not None:
                     deliveries.append(delivery)
         except Exception as e:
             self.logger.error(
                 f"dropping connection on corrupt frame: {e!r}")
             return False
+        if fast is not None:
+            actor, handler, parsed = fast
+            # Handler semantics match receive(): exceptions on a VALID
+            # frame propagate (a FatalError stays fatal).
+            handler(src, parsed)
+            self._note_delivered(actor, parsed.count)
+            return True
         for delivery in deliveries:
             self._deliver(*delivery, ctx)
         return True
 
-    def _decode(self, local: Address, src: Address, data: bytes):
-        """Frame payload -> (actor, src, message), or None if no actor
-        is registered. Decode errors propagate to the caller's
-        corrupt-frame guard."""
-        # Route by the address the frame arrived on: each registered
-        # actor (the role itself plus any embedded election/heartbeat
-        # participants) listens on its own port.
+    def _actor_for(self, local: Address):
+        """The registered actor for frames arriving on ``local``: each
+        registered actor (the role itself plus any embedded
+        election/heartbeat participants) listens on its own port."""
         actor = self.actors.get(local)
         if actor is None and self.listen_address is not None:
             actor = self.actors.get(self.listen_address)
+        return actor
+
+    def _decode(self, local: Address, src: Address, data: bytes,
+                actor: "Actor | None" = None):
+        """Frame payload -> (actor, src, message), or None if no actor
+        is registered. Decode errors propagate to the caller's
+        corrupt-frame guard. ``actor`` skips re-resolving when the
+        caller already did (_dispatch_frame resolves once per frame)."""
+        if actor is None:
+            actor = self._actor_for(local)
         if actor is None:
             self.logger.warn(f"dropping frame from {src} to {local}: "
                              f"no registered actor")
             return None
         return actor, src, actor.serializer.from_bytes(data)
+
+    def _note_delivered(self, actor: Actor, n: int) -> None:
+        """Drain bookkeeping for a wire-sink delivery of ``n``
+        messages' worth of work: batch-depth accounting plus the
+        deferred on_drain, exactly like per-message _deliver. The
+        client-lane bounded-inbox measure is intentionally NOT fed --
+        admission at sink granularity is the sink handler's job."""
+        admission = actor.admission
+        if self.runtime_metrics is not None or admission is not None:
+            self._batch_depth[actor] = \
+                self._batch_depth.get(actor, 0) + n
+        if actor not in self._drain_scheduled:
+            self._drain_scheduled.add(actor)
+            if admission is not None \
+                    and admission.options.codel_target_s:
+                self._batch_t0[actor] = time.perf_counter()
+            self.loop.call_soon(self._drain_actor, actor)
 
     def _deliver(self, actor: Actor, src: Address, message,
                  ctx: "Optional[TraceContext]" = None) -> None:

@@ -308,6 +308,25 @@ def reject_replies_for(message, retry_after_ms: int = 0,
         return [(message.commands[0].command_id.client_address,
                  Rejected(entries=entries,
                           retry_after_ms=retry_after_ms, reason=reason))]
+    if name == "IngestRun":
+        # paxingest: a disseminator's run descriptor -- entries are
+        # one-command batches spanning clients; prefer the zero-decode
+        # column route, fall back to decoding (refusal is cold).
+        from frankenpaxos_tpu.ingest.columns import value_view
+
+        view = value_view(message.values)
+        if view is not None:
+            return view.reject_entries(0, retry_after_ms, reason)
+        per_client: dict = {}
+        for value in message.values:
+            for command in getattr(value, "commands", ()):
+                cid = command.command_id
+                per_client.setdefault(cid.client_address, []).append(
+                    (cid.client_pseudonym, cid.client_id))
+        return [(address, Rejected(entries=tuple(entries),
+                                   retry_after_ms=retry_after_ms,
+                                   reason=reason))
+                for address, entries in per_client.items()]
     if name == "ClientRequestBatch":
         # A batcher's batch spans clients: group entries per client.
         per_client: dict = {}

@@ -60,6 +60,12 @@ CLIENT_LANE_TYPE_NAMES = frozenset({
     # of client-lane payloads in this envelope (runtime/paxwire.py),
     # and both the tag-level and type-level classifiers need to see it.
     "ClientFrameBatch",
+    # paxingest: a disseminator's pre-batched run descriptor is
+    # aggregated CLIENT load -- an overloaded leader must be able to
+    # shed it (one frame, whole run) exactly like the requests it
+    # carries; the batcher's own Rejected replies keep clients backing
+    # off. NotLeaderIngest (leader -> batcher bounce) stays control.
+    "IngestRun",
 })
 
 #: Client-lane membership by EXPLICIT wire tag, for client-edge

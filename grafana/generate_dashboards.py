@@ -58,9 +58,10 @@ RUNTIME_ROW_TITLE = ("Runtime (drain stages / queue depth / WAL fsync / "
 
 #: Total grid height of the runtime row: header (1) + the paxtrace
 #: band (8) + the paxload admission band (8) + the paxwire transport
-#: band (8) + the paxworld global-serving band (8). dashboard() and
-#: inject_runtime_row() both lay out protocol panels below this line.
-RUNTIME_ROW_H = 33
+#: band (8) + the paxworld global-serving band (8) + the paxingest
+#: ingestion band (8). dashboard() and inject_runtime_row() both lay
+#: out protocol panels below this line.
+RUNTIME_ROW_H = 41
 
 
 def runtime_row_panels(y: int = 0) -> list:
@@ -162,6 +163,27 @@ def runtime_row_panels(y: int = 0) -> list:
             "sum by (region) "
             "(rate(fpx_runtime_region_shed_total[5s]))",
             "{{region}}", "ops", x=12, y=y + 25, w=12),
+        # paxingest ingestion band (ingest/, docs/TRANSPORT.md):
+        # commands moving as pre-batched run descriptors, descriptor
+        # bytes, and the per-run batch fill -- batchers and leaders
+        # both export these.
+        _panel(
+            9013, "Ingest: batched cmds/s",
+            "sum by (role) "
+            "(rate(fpx_runtime_ingest_batched_cmds_total[5s]))",
+            "{{role}}", "ops", x=0, y=y + 33, w=8),
+        _panel(
+            9014, "Ingest: descriptor bytes/s",
+            "sum by (role) "
+            "(rate(fpx_runtime_ingest_descriptor_bytes[5s]))",
+            "{{role}}", "Bps", x=8, y=y + 33, w=8),
+        _panel(
+            9015, "Ingest: batch fill (cmds/run)",
+            "sum by (role) "
+            "(rate(fpx_runtime_ingest_batch_fill_sum[5s])) / "
+            "sum by (role) "
+            "(rate(fpx_runtime_ingest_batch_fill_count[5s]))",
+            "{{role}}", "short", x=16, y=y + 33, w=8),
     ]
 
 

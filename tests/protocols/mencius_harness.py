@@ -36,6 +36,8 @@ class MenciusSim:
     replicas: list
     proxy_replicas: list
     clients: list
+    # paxingest disseminators (ingest/): WAL-free.
+    ingest_batchers: list = dataclasses.field(default_factory=list)
     # wal=True extras (see multipaxos_harness).
     wal_storages: dict = dataclasses.field(default_factory=dict)
     state_machine_factory: object = None
@@ -75,7 +77,8 @@ def crash_restart_replica(sim: "MenciusSim", i: int) -> None:
 
 
 def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
-                 num_batchers=0, num_proxy_replicas=0, num_clients=1,
+                 num_batchers=0, num_ingest_batchers=0,
+                 num_proxy_replicas=0, num_clients=1,
                  batch_size=1, lag_threshold=100, coalesced=False,
                  state_machine_factory=AppendLog, seed=0,
                  wal=False, leader_admission: dict | None = None,
@@ -89,6 +92,8 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
     config = MenciusConfig(
         f=f,
         batcher_addresses=tuple(f"batcher-{i}" for i in range(num_batchers)),
+        ingest_batcher_addresses=tuple(
+            f"ingest-batcher-{i}" for i in range(num_ingest_batchers)),
         leader_addresses=tuple(
             tuple(f"leader-{g}-{i}" for i in range(f + 1))
             for g in range(num_leader_groups)),
@@ -109,6 +114,12 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
     batchers = [MenciusBatcher(a, transport, logger, config,
                                batch_size=batch_size, seed=seed + i)
                 for i, a in enumerate(config.batcher_addresses)]
+    from frankenpaxos_tpu.ingest import IngestBatcher, MenciusIngestRouter
+
+    ingest_batchers = [
+        IngestBatcher(a, transport, logger, MenciusIngestRouter(config),
+                      index=i, seed=seed + 40 + i)
+        for i, a in enumerate(config.ingest_batcher_addresses)]
     leaders = [MenciusLeader(a, transport, logger, config,
                              send_high_watermark_every_n=3,
                              send_noop_range_if_lagging_by=lag_threshold,
@@ -148,6 +159,7 @@ def make_mencius(f=1, num_leader_groups=2, num_acceptor_groups=1,
                for i in range(num_clients)]
     return MenciusSim(transport, config, batchers, leaders, proxy_leaders,
                       acceptors, replicas, proxy_replicas, clients,
+                      ingest_batchers=ingest_batchers,
                       wal_storages=wal_storages,
                       state_machine_factory=state_machine_factory,
                       seed=seed)

@@ -42,6 +42,9 @@ class MultiPaxosSim:
     replicas: list
     proxy_replicas: list
     clients: list
+    # paxingest disseminators (ingest/): WAL-free, rebuilt empty on
+    # crash_restart.
+    ingest_batchers: list = dataclasses.field(default_factory=list)
     # wal=True extras: address -> MemStorage (survives crash_restart),
     # plus what a restart needs to rebuild the actor.
     wal_storages: dict = dataclasses.field(default_factory=dict)
@@ -107,6 +110,25 @@ def add_replacement_acceptor(sim: "MultiPaxosSim", members: tuple,
         wal=_sim_wal(sim, new_address)))
 
 
+def crash_restart_ingest_batcher(sim: "MultiPaxosSim", i: int) -> None:
+    """kill -9 ingest batcher ``i`` and restart it EMPTY: batchers are
+    WAL-free by design -- staged-but-unshipped commands die with the
+    process and the owning clients' resend timers cover them (retries,
+    never acked-write loss; the replica client table keeps resends
+    exactly-once)."""
+    from frankenpaxos_tpu.ingest import (
+        IngestBatcher,
+        MultiPaxosIngestRouter,
+    )
+
+    old = sim.ingest_batchers[i]
+    sim.transport.crash(old.address)
+    sim.ingest_batchers[i] = IngestBatcher(
+        old.address, sim.transport, sim.transport.logger,
+        MultiPaxosIngestRouter(sim.config), index=i, options=old.options,
+        seed=sim.seed + 50 + i)
+
+
 def crash_restart_replica(sim: "MultiPaxosSim", i: int) -> None:
     """kill -9 replica ``i`` and restart it: the SM rebuilds from the
     WAL snapshot + chosen-record replay; unsynced executions (never
@@ -124,6 +146,7 @@ def make_multipaxos(
     num_clients: int = 1,
     num_acceptor_groups: int = 1,
     num_batchers: int = 0,
+    num_ingest_batchers: int = 0,
     num_read_batchers: int = 0,
     read_batching_scheme: ReadBatchingScheme = ReadBatchingScheme(
         kind="size", batch_size=1),
@@ -172,6 +195,8 @@ def make_multipaxos(
     config = MultiPaxosConfig(
         f=f,
         batcher_addresses=[f"batcher-{i}" for i in range(num_batchers)],
+        ingest_batcher_addresses=[f"ingest-batcher-{i}"
+                                  for i in range(num_ingest_batchers)],
         read_batcher_addresses=[f"read-batcher-{i}"
                                 for i in range(num_read_batchers)],
         leader_addresses=[f"leader-{i}" for i in range(f + 1)],
@@ -190,6 +215,16 @@ def make_multipaxos(
         Batcher(a, transport, logger, config,
                 BatcherOptions(batch_size=batch_size))
         for a in config.batcher_addresses]
+    from frankenpaxos_tpu.ingest import (
+        IngestBatcher,
+        MultiPaxosIngestRouter,
+    )
+
+    ingest_batchers = [
+        IngestBatcher(a, transport, logger,
+                      MultiPaxosIngestRouter(config), index=i,
+                      seed=seed + 50 + i)
+        for i, a in enumerate(config.ingest_batcher_addresses)]
     read_batchers = [
         ReadBatcher(a, transport, logger, config, read_batching_scheme,
                     seed=seed + 40 + i)
@@ -247,6 +282,7 @@ def make_multipaxos(
 
     return MultiPaxosSim(transport, config, batchers, leaders, proxy_leaders,
                          acceptors, replicas, proxy_replicas, clients,
+                         ingest_batchers=ingest_batchers,
                          wal_storages=wal_storages,
                          state_machine_factory=state_machine_factory,
                          seed=seed)

@@ -273,6 +273,23 @@ WAL_CHAOS_CONFIGS: list[tuple] = [
 ]
 CONFIGS.extend(WAL_CHAOS_CONFIGS)
 
+# paxingest chaos (ingest/, docs/TRANSPORT.md): WAL-free disseminator
+# kill/restart interleaved with the WAL chaos schedule -- a batcher
+# death must cost client retries, never acked-write loss or duplicate
+# execution (chosen-uniqueness/exactly-once oracle).
+from tests.protocols.test_ingest_chaos import MultiPaxosIngestSimulated  # noqa: E402
+
+CONFIGS.extend([
+    ("ingest-chaos/multipaxos-batchers2",
+     lambda: MultiPaxosIngestSimulated(f=1, num_ingest_batchers=2)),
+    ("ingest-chaos/multipaxos-batchers2-coalesced",
+     lambda: MultiPaxosIngestSimulated(f=1, num_ingest_batchers=2,
+                                       coalesced=True)),
+    ("ingest-chaos/multipaxos-f2-batchers3-mixed",
+     lambda: MultiPaxosIngestSimulated(f=2, num_ingest_batchers=3,
+                                       coalesced="mixed")),
+])
+
 # Live reconfiguration interleaved with the WAL chaos schedule
 # (reconfig/, docs/RECONFIG.md): member swaps to fresh replacement
 # acceptors mid-traffic under the same SM-prefix + chosen-uniqueness

@@ -968,6 +968,24 @@ def all_codec_samples() -> dict:
                                    new_matchmaker_indices=(6, 7, 8)),
         bp.Recover(vertex_id=bp.VertexId(1, 9)),
     ]
+    # paxingest run descriptors (ingest/wire.py, tags 204-205): the
+    # disseminator/sequencer hot path, including the lazy value-array
+    # boundary.
+    from frankenpaxos_tpu.ingest.messages import (
+        IngestRun,
+        NotLeaderIngest,
+    )
+
+    ingest_run = IngestRun(
+        batcher_index=1,
+        values=(mp.CommandBatch((command,)),
+                mp.CommandBatch((mp.Command(
+                    mp.CommandId(("10.0.0.2", 9001), 3, 8),
+                    b"second"),))))
+    samples += [
+        ingest_run,
+        NotLeaderIngest(group_index=1, run=ingest_run),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
