@@ -17,7 +17,12 @@ import json
 import os
 import sys
 
-from frankenpaxos_tpu.analysis import baseline as baseline_mod, flowgraph
+from frankenpaxos_tpu.analysis import (
+    baseline as baseline_mod,
+    diff as diff_mod,
+    flowgraph,
+    sarif as sarif_mod,
+)
 from frankenpaxos_tpu.analysis.core import (
     _ensure_loaded,
     Project,
@@ -48,17 +53,29 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print every rule ID with its description and exit")
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="finding output format: human text (default) or one JSON "
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="finding output format: human text (default), one JSON "
              "document with file/line/rule/scope/detail/message/"
-             "baselined records (the CI lint job uploads this as an "
-             "artifact)")
+             "baselined records, or a SARIF 2.1.0 document with the "
+             "identical finding set (the CI lint job uploads both as "
+             "artifacts)")
     parser.add_argument(
         "--output", default=None,
         help="write the JSON finding document to this file; works on "
              "its own (stdout keeps the human report -- how the CI "
              "lint job produces its artifact) or with --format=json "
              "(stdout carries the same JSON)")
+    parser.add_argument(
+        "--sarif-output", default=None,
+        help="write the SARIF document to this file (same finding set "
+             "as --output; the CI lint job uploads paxlint.sarif "
+             "alongside paxlint.json)")
+    parser.add_argument(
+        "--changed-since", default=None, metavar="REF",
+        help="diff-aware mode: only report findings in modules changed "
+             "since the git REF plus everything that (transitively) "
+             "imports them; the full project still parses, so the "
+             "result equals a full run restricted to that closure")
     parser.add_argument(
         "--write-flowgraphs", action="store_true",
         help="regenerate docs/flowgraphs/*.{json,dot} (paxflow "
@@ -106,6 +123,16 @@ def main(argv=None) -> int:
         return 0
 
     project = Project(root)
+    if args.changed_since:
+        changed = diff_mod.changed_paths(root, args.changed_since)
+        project.focus = diff_mod.affected_closure(project, changed)
+        scope = ("everything (out-of-package change)"
+                 if project.focus is None
+                 else f"{len(project.focus)} affected module(s)")
+        print(f"paxlint: diff-aware -- {len(changed)} changed path(s) "
+              f"since {args.changed_since}, checking {scope}",
+              # keep stdout machine-readable for the document formats
+              file=sys.stdout if args.format == "text" else sys.stderr)
     findings = run_rules(project)
 
     if args.write_baseline:
@@ -117,8 +144,18 @@ def main(argv=None) -> int:
     entries = [] if args.no_baseline else baseline_mod.load(baseline_path)
     new, old, stale = baseline_mod.split(findings, entries)
 
+    grandfathered = {f.key for f in old}
+    if args.format == "sarif" or args.sarif_output:
+        sarif_doc = sarif_mod.render(findings, grandfathered, RULES)
+        sarif_text = json.dumps(sarif_doc, indent=1, sort_keys=True)
+        if args.sarif_output:
+            with open(args.sarif_output, "w", encoding="utf-8") as out:
+                out.write(sarif_text + "\n")
+        if args.format == "sarif":
+            print(sarif_text)
+            return 1 if new else 0
+
     if args.format == "json" or args.output:
-        grandfathered = {f.key for f in old}
         document = {
             "files_checked": len(project.modules),
             "new": len(new),

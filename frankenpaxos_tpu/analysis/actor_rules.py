@@ -34,6 +34,7 @@ import ast
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     import_aliases,
     Module,
     Project,
@@ -188,6 +189,8 @@ def check(project: Project):
     actors = _actor_classes(project)
     per_module_actors: dict = {}
     for mod, cls in actors:
+        if not focused(project, mod.path):
+            continue
         per_module_actors.setdefault(mod.path, []).append(cls)
         refs = _module_refs(mod)
 

@@ -39,6 +39,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     Project,
     register_rules,
 )
@@ -401,6 +402,8 @@ def check(project: Project):
     findings: list = []
     for mod, cls in _actor_classes(project):
         if not _in_scope(mod.path):
+            continue
+        if not focused(project, mod.path):
             continue
         closure = _handler_closure(cls)
         if not closure:

@@ -19,6 +19,7 @@ import dataclasses
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     import_aliases,
+    is_sanitizer_call,
     Module,
     Project,
     qualname_index,
@@ -27,6 +28,14 @@ from frankenpaxos_tpu.analysis.core import (
 #: Method names never duck-resolved: builtin-collection noise that would
 #: wire the graph to unrelated classes. Package functions with these
 #: names are still reachable via self./module-qualified calls.
+#: Callees through which a param does NOT escape for the ownership
+#: fixpoint: deployed sends serialize their message argument at the
+#: send boundary (a copy), so buffer obligations end there. The
+#: queued-payload mutation window that remains is OWN1102's job.
+_ESCAPE_SKIP_CALLEES = frozenset({
+    "send", "send_no_flush", "_wal_send", "broadcast", "send_batch",
+})
+
 _DUCK_STOPLIST = frozenset({
     "append", "extend", "pop", "popleft", "add", "discard", "clear",
     "keys", "values", "items", "get", "set", "setdefault", "update",
@@ -179,6 +188,85 @@ class CallGraph:
             return []
         return list(self.by_method.get(method, ()))
 
+    # --- escape analysis (paxown) -----------------------------------------
+    def escaping_params(self) -> dict:
+        """``{func ref: set of param names that escape}`` -- a param
+        escapes when the function stores it (or a container holding
+        it) into ``self`` state, captures it in a nested def/lambda
+        closure, or passes it to a callee whose own param escapes
+        (computed to a fixpoint over the whole graph). A mention
+        wrapped in an ownership sanitizer (``bytes(p)``,
+        ``p.tobytes()``, ...) does not count, and neither does passing
+        to a send (``send``/``_wal_send``/...): the deployed transport
+        serializes at the send boundary, so ownership obligations end
+        there (OWN1102 guards the queued-payload window separately).
+        Memoized on the graph: the OWN11xx rules query it per call
+        site."""
+        cached = getattr(self, "_escaping_params", None)
+        if cached is not None:
+            return cached
+        out: dict = {ref: self._direct_escapes(info)
+                     for ref, info in self.funcs.items()}
+        # Resolve every plain param-passing call ONCE into an edge
+        # list, then fixpoint over the edges (resolution dominates the
+        # cost; the fixpoint itself is cheap).
+        edges: list = []  # (caller ref, caller param, callee ref, callee param)
+        for ref, info in self.funcs.items():
+            params = set(_param_names(info.node))
+            if not params:
+                continue
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                passed = _passed_params(call, params)
+                if not passed:
+                    continue
+                for callee in self.resolve_call(info, call):
+                    if self.funcs[callee].name in _ESCAPE_SKIP_CALLEES:
+                        continue
+                    callee_params = _param_names(self.funcs[callee].node)
+                    for pos, kw, name in passed:
+                        target = _bound_param(callee_params, pos, kw)
+                        if target is not None:
+                            edges.append((ref, name, callee, target))
+        changed = True
+        while changed:
+            changed = False
+            for ref, name, callee, target in edges:
+                if target in out[callee] and name not in out[ref]:
+                    out[ref].add(name)
+                    changed = True
+        self._escaping_params = out
+        return out
+
+    def _direct_escapes(self, info: FuncInfo) -> set:
+        params = set(_param_names(info.node))
+        if not params:
+            return set()
+        escaped: set = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign):
+                if any(_is_self_store(t) for t in node.targets):
+                    escaped |= _unsanitized_names(node.value, params)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("append", "extend", "add",
+                                       "appendleft", "setdefault",
+                                       "push", "insert") and \
+                    _is_self_store(node.func.value):
+                for arg in node.args:
+                    escaped |= _unsanitized_names(arg, params)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)) \
+                    and node is not info.node:
+                # Closure capture: a timer/resend callback holding the
+                # param alive past this dispatch.
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Name) and \
+                            inner.id in params:
+                        escaped.add(inner.id)
+        return escaped
+
     # --- reachability -----------------------------------------------------
     def reachable(self, roots: list) -> dict:
         """BFS from ``roots`` (function refs); returns
@@ -199,3 +287,86 @@ class CallGraph:
                                 nxt.append((callee, root))
             frontier = nxt
         return out
+
+
+def project_graph(project: Project) -> CallGraph:
+    """One CallGraph per Project, built lazily and shared by every
+    rule family that needs interprocedural resolution (the PR 7 cache
+    discipline: indexing the whole package once is what keeps the
+    full-run budget honest as families grow)."""
+    graph = getattr(project, "_callgraph", None)
+    if graph is None:
+        graph = project._callgraph = CallGraph(project)
+    return graph
+
+
+def _param_names(node: ast.AST) -> list:
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    names += [a.arg for a in args.kwonlyargs]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _is_self_store(node: ast.AST) -> bool:
+    """``self.X`` / ``self.X[k]`` / ``self.X.Y`` -- state that
+    outlives the call."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("self", "cls"):
+            return True
+        node = node.value
+    return False
+
+
+def _unsanitized_names(expr: ast.AST, names: set) -> set:
+    """Which of ``names`` does ``expr`` mention OUTSIDE an ownership
+    sanitizer call? ``(p, k)`` mentions p; ``bytes(p)`` does not."""
+    found: set = set()
+
+    def visit(node):
+        if is_sanitizer_call(node):
+            return
+        if isinstance(node, ast.Name) and node.id in names:
+            found.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return found
+
+
+def _passed_params(call: ast.Call, params: set) -> list:
+    """Params of the CALLER passed plainly to ``call``: a list of
+    ``(positional index or None, keyword or None, param name)``.
+    Sanitized mentions (``bytes(p)``) and derived expressions do not
+    count -- only a bare name or a container literal holding one."""
+    out: list = []
+    for i, arg in enumerate(call.args):
+        for name in _unsanitized_names(arg, params) \
+                if isinstance(arg, (ast.Tuple, ast.List, ast.Name)) \
+                else ():
+            out.append((i, None, name))
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        for name in _unsanitized_names(kw.value, params) \
+                if isinstance(kw.value,
+                              (ast.Tuple, ast.List, ast.Name)) \
+                else ():
+            out.append((None, kw.arg, name))
+    return out
+
+
+def _bound_param(callee_params: list, pos, kw):
+    """The callee param a call argument binds to. ``callee_params``
+    has self/cls stripped, which matches the common bound-call shape
+    (``self.helper(p)`` / ``obj.helper(p)``); an unbound
+    ``Class.helper(obj, p)`` call may misbind by one slot -- an
+    accepted over/under-approximation for a style this codebase does
+    not use."""
+    if kw is not None:
+        return kw if kw in callee_params else None
+    if pos is None or pos >= len(callee_params):
+        return None
+    return callee_params[pos]

@@ -27,6 +27,7 @@ import os
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focus_touches,
     Project,
     register_rules,
 )
@@ -41,6 +42,13 @@ RULES = {
 #: still cross the wire, so COD301 exhaustiveness must see them.
 _SEND_NAMES = frozenset({"send", "send_no_flush", "broadcast",
                          "_wal_send"})
+
+#: Where COD3xx findings anchor: codec modules and the message-class
+#: modules next to them. Diff-aware runs skip the registry scan when
+#: the focus closure cannot hold a finding (core.focus_touches).
+_FINDING_SURFACE = ("/election/", "/ingest/", "/protocols/",
+                    "/reconfig/", "/runtime/", "/serve/", "/wal/",
+                    "heartbeat.py")
 
 
 def _is_dataclass(cls: ast.ClassDef) -> bool:
@@ -286,6 +294,8 @@ def _sent_types(project: Project, pkg_dir: str, classes: dict) -> set:
 
 
 def check(project: Project):
+    if not focus_touches(project, _FINDING_SURFACE):
+        return []
     findings: list = []
     codecs = _codec_classes(project)
 

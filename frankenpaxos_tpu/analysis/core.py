@@ -64,6 +64,12 @@ class Project:
         self.package = package
         self.modules: dict[str, Module] = {}  # path -> Module
         self.by_name: dict[str, Module] = {}  # dotted name -> Module
+        #: Diff-aware mode (``--changed-since``): when not None, only
+        #: findings in these repo-relative paths are reported, and rule
+        #: families may skip per-module work outside the set (the
+        #: project itself still parses EVERY module, so cross-module
+        #: caches -- callgraph, class index -- stay warm and correct).
+        self.focus: set | None = None
         pkg_dir = os.path.join(self.root, package)
         for dirpath, dirnames, filenames in os.walk(pkg_dir):
             dirnames[:] = sorted(
@@ -123,8 +129,37 @@ def run_rules(project: Project) -> list:
                 seen.add(f.key)
                 findings.append(f)
     findings = [f for f in findings if not _suppressed(project, f)]
+    if project.focus is not None:
+        # Diff-aware mode: the per-family focus skips are a speedup;
+        # THIS filter is the semantics (cheap project-global families
+        # run in full and are trimmed here, so a focused run equals
+        # the full run restricted to the focus set).
+        findings = [f for f in findings if f.file in project.focus]
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
+
+
+def focused(project: Project, path: str) -> bool:
+    """Should a rule family spend per-module work on ``path``? True
+    always in a full run; in ``--changed-since`` mode only for files
+    in the transitively-affected closure."""
+    return project.focus is None or path in project.focus
+
+
+def focus_touches(project: Project, surface) -> bool:
+    """May any focused module ANCHOR one of this family's findings?
+    ``surface`` is the family's declared finding surface: path
+    substrings (directories, specific files) its findings' ``file``
+    fields always fall under. Cross-module families (paxflow, codec
+    exhaustiveness) pay expensive project-wide passes even in
+    diff-aware mode -- but when the focus closure cannot hold any of
+    their findings, the whole family is droppable: a send or handler
+    change in an out-of-surface module only affects findings anchored
+    ELSEWHERE, which run_rules' focus filter discards anyway."""
+    if project.focus is None:
+        return True
+    return any(any(seg in path for seg in surface)
+               for path in project.focus)
 
 
 def _ensure_loaded() -> None:
@@ -133,6 +168,7 @@ def _ensure_loaded() -> None:
         actor_rules,
         alias_rules,
         codec_rules,
+        device_rules,
         durability_rules,
         epoch_rules,
         flow_rules,
@@ -140,6 +176,7 @@ def _ensure_loaded() -> None:
         hotpath_rules,
         net_rules,
         overload_rules,
+        ownership_rules,
         safety_rules,
         shape_rules,
     )
@@ -230,6 +267,106 @@ def dotted(node: ast.AST) -> str:
 
 def call_name(node: ast.Call) -> str:
     return dotted(node.func)
+
+
+# --- buffer provenance (paxown: shared by ownership/device rules) -----------
+
+#: Calls whose result is a VIEW over (or an index table into) a
+#: caller-supplied buffer: mutating or compacting the backing buffer
+#: invalidates the result. The paxown rules (OWN11xx) track locals
+#: bound to these through aliases, helper params, and container
+#: stores. Matched on the LAST dotted component, so both
+#: ``native.scan_frames`` and a bare ``scan_frames`` import hit.
+BUFFER_VIEW_CALLS = frozenset({
+    "memoryview",
+    "scan_frames", "fpx_scan_frames",
+    "scan_batch", "fpx_scan_batch",
+    "ingest_scan", "fpx_ingest_scan",
+    "value_columns", "fpx_value_columns",
+    "parse_client_batch", "parse_client_array", "parse_ack_batch",
+    "value_view", "lazy_values", "frombuffer",
+})
+
+#: ctypes raw-pointer exports: a live export pins a bytearray against
+#: resize (BufferError) and dangles if the buffer is reallocated.
+#: ``from_buffer_copy`` is deliberately NOT here -- it is the
+#: sanitizer.
+BUFFER_EXPORT_CALLS = frozenset({"from_buffer", "cast"})
+
+#: Calls that take ownership: the result is an independent copy, so
+#: provenance (and every OWN11xx obligation) ends here.
+BUFFER_SANITIZERS = frozenset({
+    "bytes", "bytearray", "tobytes", "to_owned", "copy", "deepcopy",
+    "tolist", "list", "tuple", "value_bytes", "from_buffer_copy",
+})
+
+
+def is_sanitizer_call(node: ast.AST) -> bool:
+    """Is ``node`` a call that copies its buffer argument out
+    (``bytes(x)``, ``x.tobytes()``, ``x.to_owned()``, ...)?"""
+    return (isinstance(node, ast.Call)
+            and call_name(node).split(".")[-1] in BUFFER_SANITIZERS)
+
+
+def own_scope_walk(func: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``func`` excluding nested function/class bodies (each
+    nested def is analyzed as its own scope)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def buffer_locals(func: ast.AST, sources: frozenset = BUFFER_VIEW_CALLS,
+                  ) -> dict:
+    """Locals of ``func``'s own scope bound to a buffer-view source,
+    directly or through plain-name aliases and tuple unpacking: name
+    -> (source call name, line of the binding). A rebinding through a
+    sanitizer (``x = bytes(x)``) removes the name again."""
+    out: dict = {}
+    for node in own_scope_walk(func):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target, value = node.targets[0], node.value
+        names = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, ast.Tuple) and all(
+                isinstance(e, ast.Name) for e in target.elts):
+            names = [e.id for e in target.elts]
+        if not names:
+            continue
+        src = None
+        if isinstance(value, ast.Call):
+            last = call_name(value).split(".")[-1]
+            if last in sources:
+                # A call can be BOTH a sanitizer and a requested source
+                # (``bytearray(x)`` copies x out, but IS the mutable
+                # segment the OWN1102/OWN1103 source sets ask about):
+                # the caller's source set wins over the sanitizer pop.
+                src = last
+            elif is_sanitizer_call(value):
+                for n in names:
+                    out.pop(n, None)
+                continue
+        elif isinstance(value, ast.Name) and value.id in out:
+            src = out[value.id][0]
+        elif isinstance(value, ast.Subscript) and \
+                isinstance(value.value, ast.Name) and \
+                value.value.id in out:
+            # An element of a view table (a scan's offset tuple, a
+            # parsed column) keeps the backing buffer's provenance.
+            src = out[value.value.id][0]
+        if src is not None:
+            for n in names:
+                out[n] = (src, node.lineno)
+        else:
+            for n in names:
+                out.pop(n, None)  # rebound to something unrelated
+    return out
 
 
 #: Memo for :func:`import_aliases`, keyed by tree identity (trees are

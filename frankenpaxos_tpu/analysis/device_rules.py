@@ -1,0 +1,185 @@
+"""DEV12xx: host<->device transfer discipline for the multi-chip path.
+
+The TPU2xx family guards the *blocking* host syncs
+(``block_until_ready``, ``device_get``, ``np.asarray`` of an async
+dispatch). This family guards the TRANSFER DISCIPLINE the multi-chip
+flagship needs on the same hot-path reachable set (every ``on_drain``,
+``ops/`` kernels, run-pipeline handlers):
+
+  * DEV1201 -- a device->host scalar fetch in hot-path code outside
+    the sanctioned fetch points: ``.item()`` on an array, or
+    ``float()``/``int()``/``bool()`` coercion of a jax value. Each one
+    is a synchronous device round-trip per call -- per message, that
+    is the batching cliff.
+  * DEV1202 -- a host->device copy (``jnp.asarray``/``jnp.array``/
+    ``device_put``) inside a loop on the drain path: per-message H2D
+    transfers instead of building columns once and transferring the
+    column. The paxingest column planes exist so this never happens.
+  * DEV1203 -- ``jax.device_put`` without an explicit
+    device/``NamedSharding`` placement in mesh-aware code
+    (``ops/`` + ``bench/pipeline``): an unplaced put lands on the
+    default device and silently de-shards a mesh array on the next
+    collective.
+
+Sanctioned fetch points (drain-boundary collectors, flush timers)
+carry ``# paxlint: disable=DEV1201`` with the reason, exactly like the
+TPU20x pragma discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.callgraph import project_graph
+from frankenpaxos_tpu.analysis.core import (
+    dotted,
+    Finding,
+    focused,
+    import_aliases,
+    Project,
+    qualname_index,
+    register_rules,
+)
+from frankenpaxos_tpu.analysis.hotpath_rules import _roots
+
+RULES = {
+    "DEV1201": "device->host scalar fetch (.item()/float()/bool()) in "
+               "hot-path code outside a sanctioned fetch point",
+    "DEV1202": "per-message host->device copy inside a drain-path "
+               "loop (build columns, transfer once)",
+    "DEV1203": "jax.device_put without an explicit device/sharding in "
+               "mesh-aware code (ops/, bench/pipeline)",
+}
+
+#: Host->device transfer call leaves (DEV1202/1203).
+_H2D_LEAVES = frozenset({"device_put", "asarray", "array"})
+
+#: Files that are mesh-aware by contract: every array placement there
+#: must say WHERE (DEV1203).
+_MESH_SCOPES = ("/ops/", "bench/pipeline")
+
+
+def _is_jaxish(name: str, aliases: dict) -> bool:
+    """Does the dotted call/value name resolve into jax/jnp?"""
+    root = name.split(".")[0]
+    target = aliases.get(root, root)
+    return target in ("jax", "jnp") or target.startswith("jax.")
+
+
+def _jax_locals(func: ast.AST, aliases: dict) -> set:
+    """Locals assigned from a jax/jnp call (device values)."""
+    out: set = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            if _is_jaxish(dotted(node.value.func), aliases):
+                out.add(node.targets[0].id)
+    return out
+
+
+def _loop_spans(func: ast.AST) -> list:
+    """(start, end) line spans of for/while loop bodies in ``func``."""
+    return [(n.lineno, getattr(n, "end_lineno", n.lineno))
+            for n in ast.walk(func)
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While))]
+
+
+def check(project: Project):
+    findings: list = []
+    graph = project_graph(project)
+    roots = _roots(project, graph)
+    reachable = graph.reachable(list(roots))
+
+    def flag(rule, mod, node, scope, detail, message):
+        findings.append(Finding(
+            rule=rule, file=mod.path, line=node.lineno, scope=scope,
+            detail=detail, message=message))
+
+    for ref, root in reachable.items():
+        info = graph.funcs[ref]
+        mod = info.module
+        if not focused(project, mod.path):
+            continue
+        root_name = graph.funcs[root].qualname
+        via = roots.get(root)
+        how = (f"reachable from {root_name} ({via})"
+               if ref != root else f"a hot-path root ({via})")
+        aliases = import_aliases(mod.tree, mod.name)
+        jax_locals = _jax_locals(info.node, aliases)
+        loops = _loop_spans(info.node)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            # DEV1201: scalar fetches.
+            if leaf == "item" and not node.args and not node.keywords \
+                    and isinstance(node.func, ast.Attribute):
+                flag("DEV1201", mod, node, info.qualname, d,
+                     f".item() is a synchronous device->host scalar "
+                     f"fetch in code {how}; fetch once at the drain "
+                     f"boundary (or keep the value on device)")
+            elif leaf in ("float", "int", "bool") and d == leaf and \
+                    len(node.args) == 1:
+                arg = node.args[0]
+                src = None
+                if isinstance(arg, ast.Call) and \
+                        _is_jaxish(dotted(arg.func), aliases):
+                    src = dotted(arg.func)
+                elif isinstance(arg, ast.Name) and arg.id in jax_locals:
+                    src = arg.id
+                if src is not None:
+                    flag("DEV1201", mod, node, info.qualname,
+                         f"{leaf}({src})",
+                         f"{leaf}() of device value {src} is an "
+                         f"implicit device->host fetch in code {how}; "
+                         f"fetch once at the drain boundary")
+            # DEV1202: per-message H2D copies in a loop.
+            elif leaf in _H2D_LEAVES and _is_jaxish(d, aliases) and \
+                    any(s <= node.lineno <= e for s, e in loops):
+                flag("DEV1202", mod, node, info.qualname, d,
+                     f"{d} inside a loop in code {how} is a "
+                     f"per-message host->device copy; build the "
+                     f"column on host and transfer it once per drain")
+
+    # DEV1203: unplaced device_put in mesh-aware modules (file-scoped,
+    # not reachability-scoped: the contract is on the code's home).
+    for mod in project:
+        if not any(seg in mod.path for seg in _MESH_SCOPES):
+            continue
+        if not focused(project, mod.path):
+            continue
+        aliases = import_aliases(mod.tree, mod.name)
+        quals = None
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d.split(".")[-1] != "device_put" or \
+                    not _is_jaxish(d, aliases):
+                continue
+            placed = len(node.args) >= 2 or any(
+                kw.arg in ("device", "sharding", "dst_sharding")
+                for kw in node.keywords)
+            if placed:
+                continue
+            if quals is None:
+                quals = qualname_index(mod.tree)
+            scope = "<module>"
+            for d_node in ast.walk(mod.tree):
+                if isinstance(d_node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) and \
+                        d_node.lineno <= node.lineno <= \
+                        getattr(d_node, "end_lineno", d_node.lineno):
+                    scope = quals[id(d_node)]
+            flag("DEV1203", mod, node, scope, d,
+                 f"{d} without an explicit device/NamedSharding in "
+                 f"mesh-aware code; an unplaced put lands on the "
+                 f"default device and de-shards the array on the "
+                 f"next collective")
+    return findings
+
+
+register_rules(RULES, check)

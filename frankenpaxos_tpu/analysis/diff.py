@@ -1,0 +1,101 @@
+"""Diff-aware paxlint (``--changed-since REF``).
+
+Findings are module-local only in where they are REPORTED -- computing
+them still needs the whole project (the callgraph, the class index,
+the codec registry scan). So diff-aware mode parses everything exactly
+like a full run and narrows only the per-module rule work plus the
+final report, via :attr:`Project.focus`: the transitive closure of
+modules that import (directly or through any chain) a changed module.
+A focused run is therefore by construction the full run restricted to
+the closure -- tests/test_analysis_cli.py proves the equivalence on a
+synthetic diff.
+
+Changes outside the analyzed package (tests, docs, CI, and the
+analysis package itself -- rule changes can alter ANY module's
+findings) conservatively disable focusing: the run degrades to a full
+run rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+
+
+def changed_paths(root: str, ref: str) -> list:
+    """Repo-relative paths changed since ``ref`` (committed or not)."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=root, capture_output=True, text=True, check=True)
+    return sorted({line.strip() for line in out.stdout.splitlines()
+                   if line.strip()})
+
+
+def _imported_project_modules(project, mod) -> set:
+    """Dotted names of project modules ``mod`` imports. ``from pkg.a
+    import b`` counts both ``pkg.a`` and ``pkg.a.b`` (either may be
+    the module); relative imports resolve against ``mod.name``."""
+    names: set = set()
+
+    def note(dotted: str) -> None:
+        while dotted:
+            if dotted in project.by_name:
+                names.add(dotted)
+            dotted = dotted.rpartition(".")[0]
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                note(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                parts = mod.name.split(".")
+                # level=1 is the module's own package: drop the leaf
+                # module name -- except for __init__ modules, whose
+                # dotted name (sans __init__) already IS the package.
+                drop = node.level - (
+                    1 if mod.path.endswith("__init__.py") else 0)
+                if drop:
+                    parts = parts[:len(parts) - drop]
+                base = ".".join(parts + ([node.module]
+                                         if node.module else []))
+            else:
+                base = node.module or ""
+            note(base)
+            for alias in node.names:
+                if base:
+                    note(f"{base}.{alias.name}")
+    return names
+
+
+def affected_closure(project, changed: list):
+    """The repo-relative path set diff-aware mode should focus on, or
+    ``None`` for "run everything" (a change outside the package)."""
+    pkg_prefix = f"{project.package}/"
+    for path in changed:
+        if path.startswith(pkg_prefix) and path not in project.modules:
+            # Inside the package but not a parsed module: the analysis
+            # package itself, or a non-Python asset rules may read.
+            return None
+    seeds = {path for path in changed if path in project.modules}
+    if not seeds and any(not p.startswith(pkg_prefix) for p in changed):
+        # Only out-of-package changes (tests/docs/CI): nothing the
+        # rules look at changed, but equivalence with a full run is
+        # exactly "no findings can have changed", so report none.
+        return set()
+
+    # Reverse import edges: imported module name -> importer paths.
+    importers: dict = {}
+    for mod in project:
+        for name in _imported_project_modules(project, mod):
+            importers.setdefault(name, set()).add(mod.path)
+
+    closure = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        mod = project.modules[frontier.pop()]
+        for path in importers.get(mod.name, ()):
+            if path not in closure:
+                closure.add(path)
+                frontier.append(path)
+    return closure

@@ -32,6 +32,7 @@ from frankenpaxos_tpu.analysis import flowgraph
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     Project,
     qualname_index,
     register_rules,
@@ -78,6 +79,8 @@ def check(project: Project):
     classes = flowgraph._class_index(project)
 
     for mod in project:
+        if not focused(project, mod.path):
+            continue
         quals = qualname_index(mod.tree)
         ns = flowgraph._module_namespace(project, mod)
         for cls in mod.tree.body:

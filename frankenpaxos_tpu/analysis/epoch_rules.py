@@ -29,6 +29,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     Project,
     register_rules,
 )
@@ -66,6 +67,8 @@ def _assigns_epoch_store(cls: ast.ClassDef) -> bool:
 def check(project: Project):
     findings: list = []
     for mod, cls in _actor_classes(project):
+        if not focused(project, mod.path):
+            continue
         if not _assigns_epoch_store(cls):
             continue
         for name, func in _handler_closure(cls).items():

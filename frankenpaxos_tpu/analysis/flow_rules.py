@@ -37,7 +37,12 @@ from __future__ import annotations
 import ast
 
 from frankenpaxos_tpu.analysis import flowgraph
-from frankenpaxos_tpu.analysis.core import Finding, Project, register_rules
+from frankenpaxos_tpu.analysis.core import (
+    Finding,
+    focus_touches,
+    Project,
+    register_rules,
+)
 
 RULES = {
     "FLOW401": "message is sent but handled by no role anywhere",
@@ -81,6 +86,14 @@ def _transport_layer_codecs(project: Project) -> set:
 
 _REQUEST_SUFFIXES = ("Request", "RequestBatch")
 
+#: Where FLOW4xx findings anchor: message-class modules, codec
+#: modules, and serve/lanes.py. Diff-aware runs skip the family's
+#: project-wide graph passes when the focus closure cannot hold a
+#: finding (core.focus_touches).
+_FINDING_SURFACE = ("/election/", "/ingest/", "/protocols/",
+                    "/reconfig/", "/runtime/", "/serve/", "/wal/",
+                    "heartbeat.py")
+
 
 def _lane_type_names(project: Project) -> tuple:
     """(lanes module path, line, frozenset of names) parsed from the
@@ -109,6 +122,8 @@ def _client_edge_roles(senders) -> bool:
 
 
 def check(project: Project):
+    if not focus_touches(project, _FINDING_SURFACE):
+        return []
     findings: list = []
     graphs = flowgraph.build_all(project)
     sent_any = set(flowgraph.global_sent_types(project))

@@ -24,6 +24,7 @@ import ast
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     Project,
     register_rules,
 )
@@ -54,6 +55,8 @@ def check(project: Project):
     base = f"{project.package}/geo/"
     for mod in project:
         if not mod.path.startswith(base):
+            continue
+        if not focused(project, mod.path):
             continue
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):

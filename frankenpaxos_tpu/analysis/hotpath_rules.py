@@ -38,10 +38,11 @@ from __future__ import annotations
 
 import ast
 
-from frankenpaxos_tpu.analysis.callgraph import CallGraph
+from frankenpaxos_tpu.analysis.callgraph import CallGraph, project_graph
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     import_aliases,
     Project,
     qualname_index,
@@ -224,7 +225,7 @@ def _root_names(expr: ast.AST) -> set:
 
 def check(project: Project):
     findings: list = []
-    graph = CallGraph(project)
+    graph = project_graph(project)
     roots = _roots(project, graph)
     reachable = graph.reachable(list(roots))
 
@@ -237,6 +238,8 @@ def check(project: Project):
     for ref, root in reachable.items():
         info = graph.funcs[ref]
         mod = info.module
+        if not focused(project, mod.path):
+            continue
         via = roots.get(root)
         root_name = graph.funcs[root].qualname
         how = (f"reachable from {root_name} ({via})"
@@ -280,6 +283,8 @@ def check(project: Project):
     for ref, root in graph.reachable(ops_roots).items():
         info = graph.funcs[ref]
         mod = info.module
+        if not focused(project, mod.path):
+            continue
         root_name = graph.funcs[root].qualname
         how = (f"reachable from ops kernel {root_name}"
                if ref != root else "an ops kernel")
@@ -305,6 +310,8 @@ def check(project: Project):
     # Retrace / trace-coercion hazards in jitted functions, plus nested
     # jit in hot code (project-wide: kernels are hot by definition).
     for mod in project:
+        if not focused(project, mod.path):
+            continue
         aliases = import_aliases(mod.tree, mod.name)
         quals = qualname_index(mod.tree)
         for func in ast.walk(mod.tree):
@@ -378,6 +385,8 @@ def check(project: Project):
     # Non-hashable static args at jit call sites: jax.jit(f,
     # static_argnums=...) called with a list/dict/set literal there.
     for mod in project:
+        if not focused(project, mod.path):
+            continue
         aliases = import_aliases(mod.tree, mod.name)
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call) and \

@@ -25,6 +25,7 @@ from frankenpaxos_tpu.analysis.actor_rules import _actor_classes, _methods
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     Project,
     register_rules,
 )
@@ -83,6 +84,8 @@ def _expr_names(expr: ast.AST) -> set:
 def check(project: Project):
     findings: list = []
     for mod, cls in _actor_classes(project):
+        if not focused(project, mod.path):
+            continue
         for func in _drain_closure(cls):
             for loop in ast.walk(func):
                 if not isinstance(loop, ast.For):

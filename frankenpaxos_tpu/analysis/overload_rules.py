@@ -36,6 +36,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     Project,
     register_rules,
 )
@@ -122,6 +123,8 @@ def _walk_same_scope(root: ast.AST):
 def check(project: Project):
     findings: list = []
     for mod, cls in _actor_classes(project):
+        if not focused(project, mod.path):
+            continue
         buffers = _unbounded_buffer_attrs(cls)
         if not buffers:
             continue
@@ -153,6 +156,8 @@ def check(project: Project):
                             f"and shed explicitly"))
     for mod in project:
         if not any(seg in mod.path for seg in _SLEEP_SCOPES):
+            continue
+        if not focused(project, mod.path):
             continue
         # One finding per sleep CALL SITE: nested loops both walk over
         # the same call, and sleeps in functions merely DEFINED inside

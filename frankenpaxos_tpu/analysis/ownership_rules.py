@@ -1,0 +1,631 @@
+"""OWN11xx: buffer ownership & escape analysis for the zero-copy planes.
+
+The zero-copy machinery -- paxwire's deferred writev flush backlog,
+paxingest's column views over raw frame bytes, WAL raw-copy value
+segments, ``LazyValueArray`` throughout the run pipeline -- shares one
+invariant no other family checks: WHO owns a buffer, for HOW LONG, and
+WHEN it may be mutated. Every rule here tracks the provenance of
+buffer-typed values (``core.BUFFER_VIEW_CALLS``: ``scan_frames`` /
+``fpx_ingest_scan`` / ``fpx_value_columns`` / ``memoryview`` / wire-sink
+parser outputs / ``lazy_values`` segments, plus the ctypes export
+calls) through local aliases, helper params (the callgraph's
+``escaping_params`` fixpoint), and container stores.
+
+  * OWN1101 -- a view over a transport receive buffer escapes its
+    dispatch scope: stored on ``self``, closed over by a timer/resend
+    callback, appended to a container that outlives the drain, or
+    passed to a helper whose param escapes. The transport compacts and
+    reuses the backing bytearray between drains, so the view silently
+    goes stale (or pins the buffer).
+  * OWN1102 -- payload/message bytes mutated AFTER being queued for a
+    deferred send: paxwire flush-backlog entries and ``_wal_send``-held
+    replies are read at writev/fsync time, not enqueue time, so
+    in-place mutation after enqueue corrupts frames/records.
+  * OWN1103 -- a mutable raw segment (``bytearray`` carved from wire
+    ``_put_value_array`` output, an ingest canonical value segment, a
+    WAL record payload) aliased into a SECOND long-lived structure
+    without ``bytes()``/``copy()`` while some handler mutates one of
+    them -- the ALIAS10xx idea lifted from message objects to byte
+    planes.
+  * OWN1104 -- a ``ctypes.from_buffer``/``cast`` export whose lifetime
+    is not provably bounded: it escapes the function, or the backing
+    buffer is resized/compacted while the export is live (no ``del``
+    in between) -- the PR 8 BufferError/pinned-bytearray class.
+  * OWN1105 -- a wire-sink parser output escaping the sink handler
+    un-copied: the paxingest parsers document their column outputs as
+    views over the frame payload (docs/TRANSPORT.md "ownership
+    contract"), so staging one past the dispatch needs ``to_owned()``
+    / ``bytes()`` first.
+
+Scope: the zero-copy planes (``runtime/``, ``ingest/``, ``wal/``,
+``native/``, ``serve/``, ``ops/``) plus protocol roles
+(``protocols/``, ``reconfig/``, ``geo/``). Justified exceptions carry
+``# paxlint: disable=OWN110x`` with the invariant that bounds the
+lifetime (e.g. "callers del the export before any resize").
+"""
+
+from __future__ import annotations
+
+import ast
+
+from frankenpaxos_tpu.analysis.actor_rules import _methods
+from frankenpaxos_tpu.analysis.callgraph import (
+    _bound_param,
+    _param_names,
+    _passed_params,
+    project_graph,
+)
+from frankenpaxos_tpu.analysis.core import (
+    buffer_locals,
+    BUFFER_VIEW_CALLS,
+    call_name,
+    dotted,
+    Finding,
+    focused,
+    is_sanitizer_call,
+    own_scope_walk,
+    Project,
+    qualname_index,
+    register_rules,
+)
+
+RULES = {
+    "OWN1101": "a view over a transport receive buffer escapes its "
+               "dispatch scope (the backing bytearray is compacted "
+               "and reused)",
+    "OWN1102": "payload/message mutated after being queued for a "
+               "deferred send (flush backlog / _wal_send holds are "
+               "read at writev/fsync time)",
+    "OWN1103": "a mutable raw segment aliased into a second "
+               "long-lived structure without a copy while a handler "
+               "mutates it",
+    "OWN1104": "a ctypes buffer export whose lifetime is not bounded "
+               "before buffer resize/compaction",
+    "OWN1105": "a wire-sink parser output (documented as a view) "
+               "escapes the sink handler un-copied",
+}
+
+_SCOPES = ("/runtime/", "/ingest/", "/wal/", "/native/", "/serve/",
+           "/ops/", "/protocols/", "/reconfig/", "/geo/")
+
+_SEND_NAMES = frozenset({"send", "send_no_flush", "_wal_send",
+                         "broadcast", "send_batch"})
+
+#: In-place mutators that CORRUPT a queued payload (consumption-style
+#: mutators -- pop/clear/remove -- are how senders drain their own
+#: staging lists and are deliberately not flagged).
+_QUEUE_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "add", "insert",
+    "update", "setdefault", "sort", "reverse",
+})
+
+#: Sources whose result is a MUTABLE raw segment (OWN1103): a fresh
+#: bytearray, or an encoder that builds into one.
+_RAW_SEGMENT_SOURCES = frozenset({
+    "bytearray", "encode_value_array", "_put_value_array",
+})
+
+#: ctypes export constructors (OWN1104). ``from_buffer_copy`` copies
+#: and is exempt; a ``cast`` of a constant (the null-pointer idiom)
+#: is exempt at the call site.
+_EXPORT_LEAVES = frozenset({"from_buffer", "cast", "_as_u8p_view"})
+
+
+def _in_scope(path: str) -> bool:
+    return any(seg in path for seg in _SCOPES)
+
+
+def _functions(mod) -> list:
+    """Every (qualname, node) def in the module, outermost first."""
+    quals = qualname_index(mod.tree)
+    return [(quals[id(n)], n) for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    """``self.X`` / ``self.X[k]`` / deeper chains rooted at self."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("self", "cls"):
+            return True
+        node = node.value
+    return False
+
+
+def _mentions(expr: ast.AST, names: set) -> set:
+    """Which of ``names`` does ``expr`` mention OUTSIDE an ownership
+    sanitizer call (``bytes(v)``, ``v.tobytes()``, ``v.to_owned()``,
+    ``rows.tolist()``...)?"""
+    found: set = set()
+
+    def visit(node):
+        if is_sanitizer_call(node):
+            return
+        if isinstance(node, ast.Name) and node.id in names:
+            found.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return found
+
+
+def _container_store_args(node: ast.AST):
+    """If ``node`` is ``self.X.append(v)`` / extend / add /
+    setdefault-style store into self state, yield (field expr, args)."""
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("append", "appendleft", "extend", "add",
+                              "insert", "setdefault", "push") and \
+            _is_self_attr(node.func.value):
+        return node.args
+    return ()
+
+
+def _stmts_in_order(func: ast.AST) -> list:
+    """Every statement inside ``func`` (excluding nested defs'
+    bodies), in source order -- the straight-line approximation the
+    after-enqueue rules use."""
+    out: list = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.stmt):
+                out.append(child)
+            visit(child)
+
+    visit(func)
+    out.sort(key=lambda s: s.lineno)
+    return out
+
+
+def _mutation_target(stmt: ast.stmt, mutators: frozenset) -> str | None:
+    """The plain local name ``stmt`` mutates in place, if any:
+    ``v.append(..)``, ``v[k] = ..``, ``v += ..``, ``del v[..]``."""
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in mutators and \
+                isinstance(call.func.value, ast.Name):
+            return call.func.value.id
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name):
+                return t.value.id
+    if isinstance(stmt, ast.AugAssign) and \
+            isinstance(stmt.target, ast.Name):
+        return stmt.target.id
+    if isinstance(stmt, ast.AugAssign) and \
+            isinstance(stmt.target, ast.Subscript) and \
+            isinstance(stmt.target.value, ast.Name):
+        return stmt.target.value.id
+    if isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name):
+                return t.value.id
+    return None
+
+
+# --- OWN1101: receive-buffer views escaping the dispatch scope --------------
+
+
+def _check_view_escapes(project, graph, escaping, mod, qual, func,
+                        findings) -> None:
+    views = buffer_locals(func, BUFFER_VIEW_CALLS)
+    if not views:
+        return
+    names = set(views)
+
+    def flag(node, name, why):
+        src = views[name][0]
+        findings.append(Finding(
+            rule="OWN1101", file=mod.path, line=node.lineno,
+            scope=qual, detail=f"{name}<-{src}",
+            message=f"view '{name}' (from {src}) over a receive "
+                    f"buffer {why}; the transport compacts/reuses "
+                    f"the backing bytearray after the dispatch -- "
+                    f"copy with bytes() before it outlives the "
+                    f"drain"))
+
+    info_ref = f"{mod.path}::{qual}"
+    info = graph.funcs.get(info_ref)
+    for node in own_scope_walk(func):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if _is_self_attr(target):
+                    for name in _mentions(node.value, names):
+                        flag(node, name, "is stored on self")
+        elif isinstance(node, ast.Call):
+            for arg in _container_store_args(node):
+                for name in _mentions(arg, names):
+                    flag(node, name,
+                         "is appended to a container on self")
+            leaf = call_name(node).split(".")[-1]
+            if info is not None and leaf not in _SEND_NAMES and \
+                    not is_sanitizer_call(node):
+                passed = _passed_params(node, names)
+                if passed:
+                    for callee in graph.resolve_call(info, node):
+                        if graph.funcs[callee].name in _SEND_NAMES:
+                            continue
+                        cp = _param_names(graph.funcs[callee].node)
+                        for pos, kw, name in passed:
+                            t = _bound_param(cp, pos, kw)
+                            if t and t in escaping.get(callee, ()):
+                                flag(node, name,
+                                     f"escapes through helper "
+                                     f"{graph.funcs[callee].name}() "
+                                     f"(its '{t}' param is stored)")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)) and node is not func:
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Name) and inner.id in names:
+                    flag(inner, inner.id,
+                         "is captured by a nested callback closure")
+                    break
+
+
+# --- OWN1102: mutation after deferred-send enqueue --------------------------
+
+
+def _all_unsanitized_names(expr: ast.AST) -> set:
+    """Every plain name ``expr`` mentions outside a sanitizer call --
+    the message itself, or any value embedded in its construction."""
+    found: set = set()
+
+    def visit(node):
+        if is_sanitizer_call(node):
+            return
+        if isinstance(node, ast.Name):
+            found.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return found
+
+
+def _check_queued_mutation(mod, qual, func, findings) -> None:
+    stmts = _stmts_in_order(func)
+    mutable = set(buffer_locals(func, BUFFER_VIEW_CALLS)) | \
+        set(buffer_locals(func, _RAW_SEGMENT_SOURCES))
+    queued: dict = {}  # name -> (send leaf, line)
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                leaf = call_name(node).split(".")[-1]
+                if leaf in _SEND_NAMES:
+                    # Skip the destination arg of send(dst, msg)-shaped
+                    # calls; everything reachable from the message arg
+                    # is held by reference until the flush/fsync.
+                    args = node.args[1:] if len(node.args) > 1 \
+                        else node.args
+                    for arg in args:
+                        for name in _all_unsanitized_names(arg):
+                            queued.setdefault(
+                                name, (leaf, node.lineno))
+        target = _mutation_target(stmt, _QUEUE_MUTATORS)
+        if target is not None and target in queued:
+            leaf, line = queued[target]
+            if isinstance(stmt, ast.AugAssign) and \
+                    target not in mutable:
+                # ``buf += ...`` on immutable bytes REBINDS -- only a
+                # provenly-mutable buffer mutates in place.
+                continue
+            findings.append(Finding(
+                rule="OWN1102", file=mod.path, line=stmt.lineno,
+                scope=qual, detail=f"{target}@{leaf}",
+                message=f"'{target}' is mutated after being queued "
+                        f"for deferred send via {leaf}() at line "
+                        f"{line}; backlog entries are read at "
+                        f"writev/fsync time, not enqueue time -- "
+                        f"queue a copy or build a fresh buffer"))
+
+
+# --- OWN1103: raw segments double-aliased into mutated state ----------------
+
+
+def _check_segment_aliasing(mod, cls, findings) -> None:
+    methods = _methods(cls)
+    mutated_fields: set = set()
+    for func in methods.values():
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _QUEUE_MUTATORS and \
+                    _is_self_attr(node.func.value):
+                field = _self_root_field(node.func.value)
+                if field:
+                    mutated_fields.add(field)
+            elif isinstance(node, (ast.AugAssign, ast.Assign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            _is_self_attr(t.value):
+                        field = _self_root_field(t.value)
+                        if field:
+                            mutated_fields.add(field)
+    for name, func in methods.items():
+        segments = buffer_locals(func, _RAW_SEGMENT_SOURCES)
+        if not segments:
+            continue
+        stores: dict = {}  # local -> [(field, node)]
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if _is_self_attr(target):
+                        field = _self_root_field(target)
+                        for local in _mentions(node.value,
+                                               set(segments)):
+                            stores.setdefault(local, []).append(
+                                (field, node))
+            elif isinstance(node, ast.Call):
+                for arg in _container_store_args(node):
+                    field = _self_root_field(node.func.value)
+                    for local in _mentions(arg, set(segments)):
+                        stores.setdefault(local, []).append(
+                            (field, node))
+        for local, sites in stores.items():
+            if len(sites) < 2:
+                continue
+            fields = {f for f, _ in sites if f}
+            if not (fields & mutated_fields):
+                continue
+            src = segments[local][0]
+            node = sites[1][1]
+            findings.append(Finding(
+                rule="OWN1103", file=mod.path, line=node.lineno,
+                scope=f"{cls.name}.{name}",
+                detail=f"{local}<-{src}",
+                message=f"mutable raw segment '{local}' (from {src}) "
+                        f"is aliased into {len(sites)} long-lived "
+                        f"structures ({', '.join(sorted(fields))}) "
+                        f"and a handler mutates "
+                        f"{', '.join(sorted(fields & mutated_fields))}"
+                        f" -- store a bytes() copy so the aliases "
+                        f"cannot diverge"))
+
+
+def _self_root_field(node: ast.AST) -> str | None:
+    """The field name X of a ``self.X...`` chain."""
+    field = None
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in ("self", "cls"):
+                return node.attr
+            field = node.attr
+        node = node.value
+    return field
+
+
+# --- OWN1104: unbounded ctypes exports --------------------------------------
+
+
+def _export_bindings(func: ast.AST) -> dict:
+    """name -> (backing buffer name or None, line) for locals bound to
+    a ctypes export call (incl. tuple-unpacked keepalive pairs)."""
+    out: dict = {}
+    for node in own_scope_walk(func):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        leaf = call_name(call).split(".")[-1]
+        if leaf not in _EXPORT_LEAVES:
+            continue
+        if leaf == "cast" and call.args and \
+                isinstance(call.args[0], ast.Constant):
+            continue  # the null-pointer idiom: cast(0, ...)
+        backing = None
+        if call.args and isinstance(call.args[0], ast.Name):
+            backing = call.args[0].id
+        names = []
+        target = node.targets[0] if len(node.targets) == 1 else None
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, ast.Tuple):
+            names = [e.id for e in target.elts
+                     if isinstance(e, ast.Name)]
+        for n in names:
+            out[n] = (backing, node.lineno)
+    return out
+
+
+def _check_ctypes_exports(mod, qual, func, findings) -> None:
+    exports = _export_bindings(func)
+    direct_return = None
+    for node in own_scope_walk(func):
+        if isinstance(node, ast.Return) and \
+                isinstance(node.value, (ast.Call, ast.Tuple)):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    leaf = call_name(sub).split(".")[-1]
+                    if leaf in _EXPORT_LEAVES and not (
+                            leaf == "cast" and sub.args and
+                            isinstance(sub.args[0], ast.Constant)):
+                        direct_return = node
+                        break
+    names = set(exports)
+
+    def flag(node, name, why):
+        findings.append(Finding(
+            rule="OWN1104", file=mod.path, line=node.lineno,
+            scope=qual, detail=name,
+            message=f"ctypes buffer export {name} {why}; a live "
+                    f"export pins the bytearray (resize raises "
+                    f"BufferError) or dangles after reallocation -- "
+                    f"del it before the buffer can resize, or "
+                    f"from_buffer_copy()"))
+
+    if direct_return is not None:
+        flag(direct_return, "<return value>",
+             "is returned without a lifetime bound")
+    if not names:
+        return
+    # (a) escapes: returned / stored on self / appended.
+    for node in own_scope_walk(func):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for name in _mentions(node.value, names):
+                flag(node, f"'{name}'", "is returned without a "
+                     "lifetime bound")
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if _is_self_attr(target):
+                    for name in _mentions(node.value, names):
+                        flag(node, f"'{name}'", "is stored on self")
+        elif isinstance(node, ast.Call):
+            for arg in _container_store_args(node):
+                for name in _mentions(arg, names):
+                    flag(node, f"'{name}'",
+                         "is appended to a container on self")
+    # (b) the backing buffer is resized while the export is live.
+    live: dict = dict(exports)  # name -> (backing, line)
+    for stmt in _stmts_in_order(func):
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    live.pop(t.id, None)
+            continue
+        target = _mutation_target(stmt, frozenset(
+            {"extend", "append", "clear", "pop", "resize"}))
+        if target is None:
+            continue
+        for name, (backing, line) in list(live.items()):
+            if backing == target and stmt.lineno > line:
+                flag(stmt, f"'{name}'",
+                     f"is still live (bound at line {line}) when its "
+                     f"backing buffer '{backing}' is resized")
+                live.pop(name)
+
+
+# --- OWN1105: sink parser outputs escaping the sink handler -----------------
+
+
+def _wire_sink_handlers(cls: ast.ClassDef) -> set:
+    """Method names registered as wire-sink handlers:
+    ``wire_sinks = {TAG: (parser, self._handle_x)}`` (or the handler
+    directly as the value)."""
+    out: set = set()
+    for node in ast.walk(cls):
+        target_ok = False
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute) and
+                        t.attr == "wire_sinks") or \
+                        (isinstance(t, ast.Name) and
+                         t.id == "wire_sinks"):
+                    target_ok = True
+        if not target_ok or not isinstance(node.value, ast.Dict):
+            continue
+        for value in node.value.values:
+            exprs = value.elts if isinstance(value, ast.Tuple) \
+                else [value]
+            for e in exprs:
+                if isinstance(e, ast.Attribute) and \
+                        isinstance(e.value, ast.Name) and \
+                        e.value.id == "self":
+                    out.add(e.attr)
+    return out
+
+
+def _check_sink_escapes(project, graph, escaping, mod, cls,
+                        findings) -> None:
+    handlers = _wire_sink_handlers(cls)
+    if not handlers:
+        return
+    methods = _methods(cls)
+    for hname in sorted(handlers):
+        func = methods.get(hname)
+        if func is None:
+            continue
+        # The transport calls a sink handler as ``handler(src,
+        # parsed)``: only the LAST param is the parser output (src is
+        # an address, not a buffer).
+        all_params = _param_names(func)
+        params = set(all_params[-1:])
+        if not params:
+            continue
+        qual = f"{cls.name}.{hname}"
+        info = graph.funcs.get(f"{mod.path}::{qual}")
+
+        def flag(node, name, why):
+            findings.append(Finding(
+                rule="OWN1105", file=mod.path, line=node.lineno,
+                scope=qual, detail=name,
+                message=f"wire-sink parser output '{name}' {why}; "
+                        f"sink parser outputs are views over the "
+                        f"frame payload (docs/TRANSPORT.md ownership "
+                        f"contract) -- copy (to_owned()/bytes()) "
+                        f"before it outlives the dispatch"))
+
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if _is_self_attr(target):
+                        for name in _mentions(node.value, params):
+                            flag(node, name, "is stored on self")
+            elif isinstance(node, ast.Call):
+                for arg in _container_store_args(node):
+                    for name in _mentions(arg, params):
+                        flag(node, name,
+                             "is staged in a container that outlives "
+                             "the dispatch")
+                leaf = call_name(node).split(".")[-1]
+                if info is not None and leaf not in _SEND_NAMES and \
+                        not is_sanitizer_call(node):
+                    passed = _passed_params(node, params)
+                    for callee in (graph.resolve_call(info, node)
+                                   if passed else ()):
+                        if graph.funcs[callee].name in _SEND_NAMES:
+                            continue
+                        cp = _param_names(graph.funcs[callee].node)
+                        for pos, kw, name in passed:
+                            t = _bound_param(cp, pos, kw)
+                            if t and t in escaping.get(callee, ()):
+                                flag(node, name,
+                                     f"escapes through helper "
+                                     f"{graph.funcs[callee].name}()")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)) \
+                    and node is not func:
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Name) and \
+                            inner.id in params:
+                        flag(inner, inner.id,
+                             "is captured by a nested callback "
+                             "closure")
+                        break
+
+
+# --- the checker ------------------------------------------------------------
+
+
+def check(project: Project):
+    findings: list = []
+    graph = project_graph(project)
+    escaping = graph.escaping_params()
+    for mod in project:
+        if not _in_scope(mod.path) or not focused(project, mod.path):
+            continue
+        for qual, func in _functions(mod):
+            _check_view_escapes(project, graph, escaping, mod, qual,
+                                func, findings)
+            _check_queued_mutation(mod, qual, func, findings)
+            _check_ctypes_exports(mod, qual, func, findings)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_segment_aliasing(mod, node, findings)
+                _check_sink_escapes(project, graph, escaping, mod,
+                                    node, findings)
+    return findings
+
+
+register_rules(RULES, check)

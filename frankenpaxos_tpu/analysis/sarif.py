@@ -1,0 +1,66 @@
+"""SARIF 2.1.0 rendering of paxlint findings.
+
+One ``run`` with one result per finding -- the SAME finding set as the
+JSON document (tests/test_analysis_cli.py proves the round trip), so
+code-scanning UIs that ingest SARIF and tooling that reads
+paxlint.json can never disagree. Grandfathered findings map to
+``"note"`` severity (visible but non-blocking, like the baseline);
+new findings map to ``"error"``.
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render(findings, grandfathered: set, rules: dict) -> dict:
+    """The SARIF document (a JSON-ready dict) for ``findings``.
+    ``grandfathered`` holds the baselined finding keys; ``rules`` maps
+    every registered rule id to its one-line description."""
+    used = sorted({f.rule for f in findings})
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "note" if f.key in grandfathered else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": f.line},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.scope}],
+            }],
+            "partialFingerprints": {
+                # The baseline's stable key: line-independent, so a
+                # SARIF consumer dedupes across unrelated edits
+                # exactly like the baseline does.
+                "paxlintKey/v1": "|".join(f.key),
+            },
+            "properties": {
+                "detail": f.detail,
+                "baselined": f.key in grandfathered,
+            },
+        }
+        for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule))
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paxlint",
+                "informationUri":
+                    "docs/ANALYSIS.md",
+                "rules": [
+                    {
+                        "id": rule,
+                        "shortDescription": {"text": rules[rule]},
+                    }
+                    for rule in used
+                ],
+            }},
+            "results": results,
+        }],
+    }

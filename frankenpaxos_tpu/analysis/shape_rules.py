@@ -35,6 +35,7 @@ import ast
 from frankenpaxos_tpu.analysis.core import (
     dotted,
     Finding,
+    focused,
     import_aliases,
     Project,
     qualname_index,
@@ -184,6 +185,8 @@ def check(project: Project):
 
     # SHAPE601/602 inside every jitted function.
     for mod in project:
+        if not focused(project, mod.path):
+            continue
         aliases = import_aliases(mod.tree, mod.name)
         for qual, fn in _jitted_functions(mod, aliases):
             for node in _own_nodes(fn):
@@ -245,6 +248,8 @@ def check(project: Project):
         declared |= per_mod[mod.path]
     if declared:
         for mod in project:
+            if not focused(project, mod.path):
+                continue
             for axis, lineno, ctx in _used_axes(mod):
                 if axis not in declared:
                     findings.append(Finding(

@@ -221,7 +221,10 @@ class IngestBatcher(Actor):
             if k == 0:
                 return
         self._arm_flush()
-        self._staged_columns.append((colrun, k))
+        # Ownership contract: the parser output may view the
+        # transport's receive buffer, which is compacted after this
+        # dispatch returns. Staging past the dispatch takes ownership.
+        self._staged_columns.append((colrun.to_owned(), k))
 
     def _admit(self, message, n: int) -> bool:
         admission = self.admission

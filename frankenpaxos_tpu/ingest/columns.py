@@ -73,6 +73,22 @@ class ColumnRun:
         off = int(self.cols[i, COL_OFF])
         return bytes(self.buf[off:off + int(self.cols[i, COL_LEN])])
 
+    def to_owned(self) -> "ColumnRun":
+        """An ownership-safe twin whose ``buf`` no longer borrows the
+        transport's receive buffer. ``cols`` offsets index into
+        ``buf``, so the copy preserves them verbatim; when ``buf`` is
+        already immutable ``bytes`` the run owns its storage and is
+        returned as-is. Wire-sink handlers MUST call this before
+        staging a run past the dispatch (docs/TRANSPORT.md; paxlint
+        OWN1105)."""
+        if type(self.buf) is bytes:
+            return self
+        owned = ColumnRun(raw=self.raw, cols=self.cols,
+                          buf=bytes(self.buf))
+        owned._addresses = self._addresses
+        owned._body_start = self._body_start
+        return owned
+
     def values(self, k: "Optional[int]" = None):
         """Cold path: decode the first ``k`` entries into the ordinary
         CommandBatch tuple (Phase1 stash, unsupported-shape

@@ -97,7 +97,10 @@ def _as_u8p(buf) -> ctypes.POINTER(ctypes.c_uint8):  # type: ignore[misc]
 _U8P = ctypes.POINTER(ctypes.c_uint8)
 
 
-def _as_u8p_view(buf, offset: int = 0):
+# Deliberately exports a (pointer, keepalive) pair: every call site
+# dels BOTH immediately after the native call, before any buffer
+# resize/compaction can run (the BufferError class PR 8 closed).
+def _as_u8p_view(buf, offset: int = 0):  # paxlint: disable=OWN1104
     """READ-ONLY pointer to ``buf[offset:]`` WITHOUT copying the buffer
     (the `_as_u8p` copy was the receive path's quadratic cost: every
     4096-frame scan pass re-copied the whole inbound buffer). Returns

@@ -2386,7 +2386,10 @@ def test_paxlint_runtime_budget():
     run from 124s to 15s with project-level caches; the paxsafe
     interprocedural passes (SAFE9xx guard closures, ALIAS10xx taint)
     must stay inside that cached-namespace/callgraph infrastructure
-    rather than re-walking the tree per rule."""
+    rather than re-walking the tree per rule. The paxown families
+    (OWN11xx escape fixpoint, DEV12xx transfer discipline) ride the
+    same memoized callgraph and are included in this budget; the
+    diff-aware (<10s) twin lives in tests/test_analysis_cli.py."""
     import os as _os
     import time as _time
 

@@ -313,3 +313,48 @@ def test_build_from_source_succeeds_clean(tmp_path):
     lib = ctypes.CDLL(str(out))
     assert hasattr(lib, "fpx_scan_batch")
     assert hasattr(lib, "fpx_batch_header")
+
+
+def test_ingest_ownership_contract_parity_fuzz():
+    """docs/TRANSPORT.md ownership contract, as a native-vs-fallback
+    property: both implementations must agree on which scan outputs
+    are VIEWS over the caller's receive buffer (``ColumnRun.buf`` --
+    stable only until the dispatch returns) and which are OWNED copies
+    (the ``raw`` value-array segment, every ``value_bytes()`` result,
+    the ``to_owned()`` twin) -- asserted by compacting the backing
+    bytearray after the scan and checking what survives. paxlint
+    OWN1105 enforces the handler-side half of this contract."""
+    import numpy as np
+
+    from frankenpaxos_tpu.ingest import parse_client_batch
+
+    rng = random.Random(23)
+
+    def scan_and_own(payload: bytes):
+        data = bytearray(payload)
+        colrun = parse_client_batch(data)
+        if colrun is None:
+            return None
+        # The view half: buf IS the receive buffer, not a copy.
+        assert colrun.buf is data
+        values = [colrun.value_bytes(i) for i in range(len(colrun))]
+        owned = colrun.to_owned()
+        assert type(owned.buf) is bytes and owned.raw == colrun.raw
+        data[:] = b"\x00" * len(data)  # the transport reuses the buffer
+        # The owned half: everything copied out survives compaction.
+        assert [owned.value_bytes(i)
+                for i in range(len(owned))] == values
+        assert owned.to_owned() is owned  # already-owned identity
+        return colrun.raw, np.asarray(colrun.cols), values
+
+    for trial in range(60):
+        payload = _client_batch_payload(rng, rng.randrange(0, 10),
+                                        exotic=trial % 5 == 4)
+        nat = scan_and_own(payload)
+        with _fallback():
+            py = scan_and_own(payload)
+        assert (nat is None) == (py is None), trial
+        if nat is not None:
+            assert nat[0] == py[0], trial
+            assert np.array_equal(nat[1], py[1]), trial
+            assert nat[2] == py[2], trial

@@ -6,6 +6,7 @@ fixed-layout binary codecs behind the Serializer seam, with pickle for
 the long tail and first-byte discrimination between the two.
 """
 
+import dataclasses
 import pickle
 
 import pytest
@@ -91,14 +92,20 @@ def test_binary_round_trip(message):
     assert DEFAULT_SERIALIZER.from_bytes(data) == message
 
 
-def test_unregistered_types_fall_back_to_pickle():
-    # Recover graduated to a fixed layout (tag 200, paxsim COD301
-    # burn-down); simplegcbpaxos's SnapshotRequest is still a pickled
-    # cold-path admin message (grandfathered in
-    # .paxlint-baseline.json).
-    from frankenpaxos_tpu.protocols.simplegcbpaxos import SnapshotRequest
+@dataclasses.dataclass(frozen=True)
+class _NotOnAnyWire:
+    """A type no protocol sends -- the pickle fallback's remaining
+    clientele now that the COD301 baseline is empty."""
 
-    message = SnapshotRequest()
+    x: int
+
+
+def test_unregistered_types_fall_back_to_pickle():
+    # Every protocol-sent message now has a fixed layout (the COD301
+    # baseline burned to zero with SnapshotRequest/CommitSnapshot,
+    # tags 206-207); the pickle fallback survives only for types that
+    # never cross a protocol wire.
+    message = _NotOnAnyWire(7)
     data = DEFAULT_SERIALIZER.to_bytes(message)
     assert data[0] >= 128  # pickle PROTO opcode
     assert DEFAULT_SERIALIZER.from_bytes(data) == message
@@ -985,6 +992,30 @@ def all_codec_samples() -> dict:
     samples += [
         ingest_run,
         NotLeaderIngest(group_index=1, run=ingest_run),
+    ]
+    # COD301 burn-down, final tranche (tags 206-207, paxown): the
+    # simplegcbpaxos snapshot cold path -- the baseline is now empty.
+    from frankenpaxos_tpu.protocols import simplegcbpaxos as gcbp
+    from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+        VertexIdPrefixSet,
+    )
+
+    gc_watermark = VertexIdPrefixSet(2)
+    gc_watermark.add(bp.VertexId(0, 0))
+    gc_watermark.add(bp.VertexId(1, 0))
+    gc_watermark.add(bp.VertexId(1, 3))
+    samples += [
+        gcbp.SnapshotRequest(),
+        gcbp.CommitSnapshot(
+            id=4,
+            watermark=gc_watermark.to_dict(),
+            state_machine=b"\x00register state",
+            client_table={"kv": [{
+                "client": (("10.0.0.1", 5000), 2),
+                "largest_id": 7,
+                "largest_output": b"ok",
+                "executed_ids": {"watermark": 6, "values": [7]},
+            }]}),
     ]
     by_tag: dict = {}
     for message in samples:
