@@ -16,6 +16,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import NamedTuple
 
 #: Platforms that count as the real accelerator (a silent CPU fallback
 #: with rc=0 must NOT count as device-available).
@@ -93,3 +94,103 @@ def _probe_once(timeout_s: float) -> tuple[bool, str]:
                        f"(silent CPU fallback, not the accelerator)")
     return False, (f"probe exited {probe.returncode}: "
                    f"{(err or '').strip()[-120:]}")
+
+
+class MeshProbe(NamedTuple):
+    """What :func:`mesh_probe` learned about the device mesh."""
+
+    platform: str        # "" when the probe itself failed
+    device_count: int    # 0 when the probe itself failed
+    collective_ok: bool  # the all-device psum returned the right value
+    note: str            # true diagnosis for the artifact
+
+
+#: The collective micro-probe run inside the throwaway subprocess: a
+#: tiny psum of per-device ones across EVERY device. A healthy mesh
+#: prints ``<platform> <n> ok``; a wedged inter-chip link hangs (the
+#: parent's deadline contains it, same as the wedged-attach class) or
+#: errors; a mesh returning the WRONG sum prints ``bad-sum`` -- all
+#: three land as ``collective_ok=False`` with the note saying which.
+_MESH_PROBE_SRC = """
+import jax, jax.numpy as jnp, numpy as np
+ds = jax.devices()
+n = len(ds)
+status = "ok"
+if n > 1:
+    try:
+        from jax.sharding import Mesh, PartitionSpec as P
+        fn = getattr(jax, "shard_map", None)
+        if fn is None:
+            from jax.experimental.shard_map import shard_map as fn
+        mesh = Mesh(np.array(ds), ("d",))
+        f = jax.jit(fn(lambda x: jax.lax.psum(x, "d"), mesh=mesh,
+                       in_specs=P("d"), out_specs=P()))
+        out = np.asarray(f(jnp.ones(n, jnp.int32)))
+        if int(out[()] if out.ndim == 0 else out[0]) != n:
+            status = "bad-sum"
+    except Exception as e:
+        status = "error:" + type(e).__name__
+print(ds[0].platform, n, status)
+"""
+
+#: Process-lifetime cache for the mesh verdict (same rationale as
+#: ``_VERDICT``: a wedged link must cost ONE deadline per process).
+_MESH_VERDICT: "MeshProbe | None" = None
+_MESH_VERDICT_TIMEOUT_S: float = 0.0
+
+
+def mesh_probe(timeout_s: "float | None" = None,
+               refresh: bool = False) -> MeshProbe:
+    """Probe the mesh: platform, device count, and a per-device
+    collective micro-probe (a tiny psum every device participates in,
+    under the same wedged-link deadline as :func:`device_probe`).
+
+    ``collective_ok=False`` with ``device_count >= 2`` is the PARTIAL
+    MESH verdict -- some inter-chip link is wedged or lying even though
+    attach succeeded -- and headline benches must refuse to stamp a
+    device result from it (the r05 regression class, extended from
+    "CPU fallback" to "mesh that cannot psum"). A single-device result
+    with ``collective_ok=True`` is a legitimate 1-chip run, not
+    degradation."""
+    global _MESH_VERDICT, _MESH_VERDICT_TIMEOUT_S
+    budget = DEFAULT_TIMEOUT_S if timeout_s is None else timeout_s
+    if _MESH_VERDICT is not None and not refresh:
+        if _MESH_VERDICT.collective_ok \
+                or budget <= _MESH_VERDICT_TIMEOUT_S:
+            return _MESH_VERDICT
+    _MESH_VERDICT = _mesh_probe_once(budget)
+    _MESH_VERDICT_TIMEOUT_S = budget
+    return _MESH_VERDICT
+
+
+def _mesh_probe_once(timeout_s: float) -> MeshProbe:
+    probe = subprocess.Popen(
+        [sys.executable, "-c", _MESH_PROBE_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + timeout_s
+    while probe.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if probe.poll() is None:
+        probe.kill()
+        try:
+            probe.wait(timeout=1)  # bounded reap; see _probe_once
+        except subprocess.TimeoutExpired:
+            pass
+        return MeshProbe("", 0, False,
+                         f"mesh probe timed out after {timeout_s:.0f}s "
+                         f"(wedged link or hung collective)")
+    out, err = probe.communicate()
+    parts = (out or "").strip().split()
+    if probe.returncode != 0 or len(parts) != 3:
+        return MeshProbe("", 0, False,
+                         f"mesh probe exited {probe.returncode}: "
+                         f"{(err or '').strip()[-120:]}")
+    platform, count, status = parts[0].lower(), int(parts[1]), parts[2]
+    if status != "ok":
+        return MeshProbe(
+            platform, count, False,
+            f"collective psum failed on the {count}-device {platform} "
+            f"mesh: {status} (partial mesh -- refusing is on the "
+            f"caller)")
+    return MeshProbe(platform, count, True,
+                     f"{platform} x{count}, collective psum healthy")

@@ -85,6 +85,46 @@ def _axis_index(axis: Optional[str]) -> jax.Array:
     return jnp.int32(0) if axis is None else jax.lax.axis_index(axis)
 
 
+def local_block(block_size: int, slot_shards: int) -> tuple:
+    """``(b_local, pad)``: the per-shard lane count (the global block
+    rounded UP over the slot shards) and the number of pad lanes the
+    rounding adds to the padded global block. A non-divisible split
+    (e.g. a 1M-slot block over 3 slot shards) pads the last lanes of
+    every block; the padded lanes are masked out of proposals, votes,
+    commits, and execution inside :func:`steady_state_step`, so the
+    committed results stay bit-identical to the unpadded host oracle."""
+    b_local = -(-block_size // slot_shards)
+    return b_local, b_local * slot_shards - block_size
+
+
+def padded_window(window: int, block_size: int, slot_shards: int) -> int:
+    """The padded GLOBAL window for a sharded run: every shard holds
+    whole rounded-up ``b_local`` blocks, so the global window grows by
+    ``pad`` lanes per block when the block does not divide over the
+    slot shards (and is unchanged when it does)."""
+    if window % block_size:
+        raise ValueError(
+            f"window {window} must hold whole {block_size}-slot blocks")
+    b_local, _ = local_block(block_size, slot_shards)
+    return (window // block_size) * b_local * slot_shards
+
+
+def gathered_layout(slot_shards: int, w_local: int, b_local: int,
+                    block_size: int) -> tuple:
+    """``(logical, valid)`` for each physical column of the gathered
+    sharded window (shard windows concatenated): ``logical[c]`` is the
+    unsharded slot id the column holds and ``valid[c]`` is False for
+    pad columns (lane >= block_size under a rounded-up split), whose
+    logical id is meaningless. Within shard ``s``, local column ``j``
+    holds block ``j // b_local`` at block-lane
+    ``s * b_local + (j % b_local)``; the unsharded layout is
+    block-major."""
+    cols = np.arange(slot_shards * w_local)
+    s, j = cols // w_local, cols % w_local
+    bi, lane = j // b_local, s * b_local + (j % b_local)
+    return bi * block_size + lane, lane < block_size
+
+
 def steady_state_step(state: PipelineState, i: jax.Array, *,
                       block_size: int, masks: np.ndarray,
                       thresholds, combine_any: bool,
@@ -113,10 +153,12 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     static sizes in ``group_shards``/``slot_shards``).
     """
     n_local, w_local = state.votes.shape
-    assert block_size % slot_shards == 0, (
-        f"block_size {block_size} must divide over {slot_shards} slot "
-        f"shards")
-    b_local = block_size // slot_shards
+    # A block that does not divide over the slot shards rounds the
+    # local block UP; the pad lanes (global lane >= block_size) are
+    # masked out of every effect below, so the committed semantics are
+    # those of the unpadded global block (make_sharded_state sizes the
+    # padded window to match).
+    b_local, block_pad = local_block(block_size, slot_shards)
     assert w_local % b_local == 0, (
         f"local window {w_local} must hold whole {b_local}-slot blocks")
     masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [G, n_global]
@@ -162,8 +204,21 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
             masks_d, (0, group_idx * n_local),
             (masks_d.shape[0], n_local))
 
+    # Pad-lane mask for non-divisible splits; the divisible (and the
+    # unsharded) case stays mask-free so the hot path traces the exact
+    # same ops as before. Lane coordinates are block-relative, so ONE
+    # mask covers the new block, the straggler block, and execution.
+    lane_valid = lanes_new < block_size if block_pad else None
+
+    def _mask_arrivals(arr):
+        if lane_valid is None:
+            return arr
+        return arr & lane_valid[None, :].astype(jnp.uint8)
+
     # --- Leader: assign slots, propose command ids --------------------------
     proposed = lanes_new * 7 + i * 13 + 1
+    if lane_valid is not None:
+        proposed = jnp.where(lane_valid, proposed, 0)
     commands = jax.lax.dynamic_update_slice(state.commands, proposed,
                                             (start_new,))
 
@@ -200,6 +255,10 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
                            group_axis)                   # [G, b_local]
             satisfied = counts >= thresholds_d[:, None]
             hit = satisfied.any(0) if combine_any else satisfied.all(0)
+        if lane_valid is not None:
+            # Pad lanes never accrue votes, but a degenerate spec could
+            # still "hit" them; keep them permanently unchosen.
+            hit = hit & lane_valid
         old = jax.lax.dynamic_slice(chosen, (start,), (b_local,))
         newly = hit & ~old
         chosen = jax.lax.dynamic_update_slice(chosen, hit | old, (start,))
@@ -209,17 +268,19 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
         return votes, chosen, committed
 
     # --- Acceptors + ProxyLeader: pass 1 on the new block -------------------
-    arr1 = _arrivals(i, lanes_new, accs, salt=0)
+    arr1 = _mask_arrivals(_arrivals(i, lanes_new, accs, salt=0))
     votes, chosen, committed = quorum_pass(
         state.votes, state.chosen, state.committed, start_new, arr1)
     # --- pass 2: stragglers complete the previous block ---------------------
-    arr2 = 1 - _arrivals(i - 1, lanes_new, accs, salt=0)
+    arr2 = _mask_arrivals(1 - _arrivals(i - 1, lanes_new, accs, salt=0))
     votes, chosen, committed = quorum_pass(
         votes, chosen, committed, start_old, arr2)
 
     # --- Replica: execute the now fully-chosen previous block ---------------
     cmds_old = jax.lax.dynamic_slice(commands, (start_old,), (b_local,))
     block_results = cmds_old * 3 + 7
+    if lane_valid is not None:
+        block_results = jnp.where(lane_valid, block_results, 0)
     results = jax.lax.dynamic_update_slice(state.results, block_results,
                                            (start_old,))
     sm_state = state.sm_state + _psum(cmds_old.sum(dtype=jnp.int32),
@@ -253,6 +314,26 @@ def run_steps(state: PipelineState, iters: int, block_size: int,
                                  combine_any=combine_any)
 
     return jax.lax.fori_loop(0, iters, body, state)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6),
+                   donate_argnums=(0,))
+def run_steps_from(state: PipelineState, start: jax.Array, iters: int,
+                   block_size: int, masks_t: tuple, thresholds_t: tuple,
+                   combine_any: bool) -> PipelineState:
+    """:func:`run_steps` with a TRACED start iteration: chunked A/B
+    arms resume the drain counter where the previous chunk left off
+    (ring positions and arrival hashes continue instead of replaying
+    drain 0), and every chunk reuses one compiled executable."""
+    masks = np.asarray(masks_t, dtype=np.int32)
+    thresholds = np.asarray(thresholds_t, dtype=np.int32)
+
+    def body(i, s):
+        return steady_state_step(s, i, block_size=block_size, masks=masks,
+                                 thresholds=thresholds,
+                                 combine_any=combine_any)
+
+    return jax.lax.fori_loop(start, start + iters, body, state)
 
 
 def drain_latency_distribution(spec_arrays, num_acceptors: int,
@@ -390,3 +471,68 @@ def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
         **kwargs), donate_argnums=(0,))
     sharding = jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree)
     return sharded, sharding
+
+
+def state_sharding(mesh):
+    """The ``NamedSharding`` tree matching ``PIPELINE_PARTITION`` over
+    ``mesh`` (what :func:`make_sharded_step` returns as its second
+    element), for callers that place state without building a step."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec_tree = PipelineState(*(P(*axes) for axes in PIPELINE_PARTITION))
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree)
+
+
+def make_sharded_state(mesh, window: int, block_size: int,
+                       num_acceptors: int) -> tuple:
+    """``(state, sharding, w_padded)``: a fresh ``PipelineState`` laid
+    out over ``mesh`` for a GLOBAL ``window`` of whole ``block_size``
+    blocks. When the block does not divide over the slot shards the
+    window is PADDED (see :func:`padded_window`); the pad lanes are
+    masked inside :func:`steady_state_step`, so committed counts and
+    per-slot results match the unpadded host oracle bit-for-bit
+    (compare through :func:`gathered_layout`)."""
+    slot_shards = mesh.shape["slot"]
+    w_padded = padded_window(window, block_size, slot_shards)
+    sharding = state_sharding(mesh)
+    state = jax.device_put(make_state(w_padded, num_acceptors), sharding)
+    return state, sharding, w_padded
+
+
+def make_sharded_runner(mesh, *, block_size: int, masks: np.ndarray,
+                        thresholds, combine_any: bool, iters: int):
+    """The mesh twin of :func:`run_steps_from`: jit one shard_map'd
+    ``fori_loop`` of ``iters`` drains (ONE dispatch per call, the bench
+    hot loop -- per-drain dispatch through :func:`make_sharded_step`
+    costs a host round-trip per drain and measures the link, not the
+    mesh). Returns ``(runner, sharding)`` with
+    ``runner(state, start) -> state``."""
+    import inspect
+
+    from jax.sharding import PartitionSpec as P
+
+    group_shards = mesh.shape["group"]
+    slot_shards = mesh.shape["slot"]
+
+    def run(state, start):
+        def body(i, s):
+            return steady_state_step(
+                s, i, block_size=block_size, masks=masks,
+                thresholds=thresholds, combine_any=combine_any,
+                group_axis="group", slot_axis="slot",
+                group_shards=group_shards, slot_shards=slot_shards)
+
+        return jax.lax.fori_loop(start, start + iters, body, state)
+
+    spec_tree = PipelineState(*(P(*axes) for axes in PIPELINE_PARTITION))
+    shard_map = _shard_map_fn()
+    kwargs = {}
+    params = inspect.signature(shard_map).parameters
+    if "check_vma" in params:
+        kwargs["check_vma"] = False
+    elif "check_rep" in params:
+        kwargs["check_rep"] = False
+    runner = jax.jit(shard_map(
+        run, mesh=mesh, in_specs=(spec_tree, P()), out_specs=spec_tree,
+        **kwargs), donate_argnums=(0,))
+    return runner, state_sharding(mesh)

@@ -28,7 +28,12 @@ from frankenpaxos_tpu.quorums import ZoneGrid
 class GeoQuorumTracker:
     def __init__(self, store: ObjectEpochStore, group: int,
                  grid: ZoneGrid, backend: str = "dict",
-                 window: int = 4096):
+                 window: int = 4096, mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` for the tpu
+        backend -- the checker's board shards its slot axis over the
+        mesh with the epoch planes replicated (the ZoneGrid steal
+        planes ride the same rule as every epoch plane; see
+        EpochSegmentedChecker). Ignored by the dict oracle."""
         if backend not in ("dict", "tpu"):
             raise ValueError(f"unknown geo tracker backend {backend!r}")
         self.store = store
@@ -36,6 +41,7 @@ class GeoQuorumTracker:
         self.grid = grid
         self.backend = backend
         self.window = window
+        self.mesh = mesh
         self._known = store.known(group)
         # dict backend: (slot, ballot) -> set of acceptor ids; None
         # once reported (Done).
@@ -60,7 +66,8 @@ class GeoQuorumTracker:
 
         specs, starts = self._specs_and_starts()
         self._checker = EpochSegmentedChecker(specs, starts,
-                                              window=self.window)
+                                              window=self.window,
+                                              mesh=self.mesh)
         # Prewarm the scatter buckets before client traffic.
         self._checker.record_and_check([0], [0], [-1])
         self._checker.release([0])

@@ -50,3 +50,4 @@ from frankenpaxos_tpu.ingest.columns import (  # noqa: F401
     value_view,
 )
 from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest  # noqa: F401
+from frankenpaxos_tpu.ingest.shard import command_ids, place_block, route_block  # noqa: F401

@@ -1,0 +1,90 @@
+"""Per-shard ingest routing: wire columns -> mesh slices, one copy each.
+
+The multi-chip twin of the paxingest landing path. On one chip, a
+drain's ``fpx_ingest_scan`` columns land as ONE host->device copy of
+the block's command ids. On a ``(group, slot)`` mesh the block's lanes
+are OWNED by slot shards (``bench/pipeline.local_block``: lane ``l``
+of a ``block_size`` block lives on shard ``l // b_local``), so a
+single global ``device_put`` would make XLA re-lay the block out
+across the mesh AFTER an all-to-one landing -- a cross-device shuffle
+per drain. Instead the host routes the columns per slot shard
+(:func:`route_block` -- a reshape, no per-command work) and lands each
+shard's segment with one EXPLICITLY PLACED ``device_put`` per mesh
+slice (:func:`place_block`): the copy fans out once, every byte lands
+on the device that owns it, and the drain kernels see an already-sharded
+operand. DEV1202 (per-message H2D in a drain loop) and DEV1203
+(unplaced ``device_put`` in mesh code) stay clean by construction:
+one placed put per slice per drain.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from frankenpaxos_tpu.ingest.columns import COL_ID, COL_PSEUDONYM, ColumnRun
+
+
+def command_ids(colrun: ColumnRun) -> np.ndarray:
+    """``[k]`` int32 pipeline command ids straight off a ColumnRun's
+    descriptor columns (no value decode): the same
+    (pseudonym, client-id) identity ``CommandId`` carries, folded to
+    the int32 id the drain pipeline's command window holds."""
+    cols = colrun.cols
+    return (cols[:, COL_PSEUDONYM].astype(np.int64) * 1_000_003
+            + cols[:, COL_ID].astype(np.int64)).astype(np.int32)
+
+
+def route_block(ids: np.ndarray, block_size: int,
+                slot_shards: int) -> np.ndarray:
+    """Route a drain block's command ids to their owning slot shards.
+
+    ``ids`` covers global lanes ``[0, len(ids))`` of a ``block_size``
+    block (a partial drain routes a short prefix; the tail pads with
+    zero, the pipeline's "no proposal" id). Returns
+    ``[slot_shards, b_local]`` int32 where row ``s`` is shard ``s``'s
+    local block segment -- lane ``l`` lands at
+    ``[l // b_local, l % b_local]``, matching
+    ``bench/pipeline.gathered_layout``. Pure reshape on the host: no
+    per-command Python, no device work.
+    """
+    if len(ids) > block_size:
+        raise ValueError(f"{len(ids)} ids exceed the {block_size}-slot "
+                         f"block")
+    # The round-up split rule, NOT imported from bench.pipeline: ingest
+    # is on every protocol's import path and pipeline's reverse-import
+    # closure must stay a handful of bench modules (the diff-aware
+    # paxlint <10s budget). tests/test_multichip_ingest.py pins this
+    # equal to pipeline.local_block lane for lane.
+    b_local = -(-block_size // slot_shards)
+    routed = np.zeros(slot_shards * b_local, dtype=np.int32)
+    routed[:len(ids)] = np.asarray(ids, dtype=np.int32)
+    return routed.reshape(slot_shards, b_local)
+
+
+def place_block(mesh, ids: np.ndarray, block_size: int):
+    """Land a routed block on the mesh: ONE explicitly placed
+    ``device_put`` per mesh slice (the tentpole's per-slice copy rule).
+
+    Returns a global jax.Array of shape ``[slot_shards * b_local]``
+    sharded over the mesh's ``slot`` axis (replicated over ``group`` --
+    every acceptor shard sees the whole command segment for its slot
+    range, as the pipeline's ``commands`` window is laid out). The
+    device order comes from the sharding's own
+    ``addressable_devices_indices_map``, so the placement is correct
+    for any mesh topology without assuming device id order.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    slot_shards = mesh.shape["slot"]
+    routed = route_block(ids, block_size, slot_shards)
+    flat = routed.reshape(-1)
+    sharding = NamedSharding(mesh, P("slot"))
+    shape = flat.shape
+    arrays = [
+        jax.device_put(flat[index], device)
+        for device, index in
+        sharding.addressable_devices_indices_map(shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(shape, sharding,
+                                                    arrays)

@@ -377,6 +377,40 @@ def _check_batch_multi(
                      satisfied.any(-1), satisfied.all(-1))
 
 
+def _shard_board(board: VoteBoard, mesh, window: int) -> VoteBoard:
+    """Lay a :class:`VoteBoard` out over ``mesh``: the SLOT axis shards
+    over every mesh axis (the slot-partitioning scaling axis, SURVEY.md
+    section 2.3 / multipaxos/DistributionScheme) while the acceptor
+    axis stays whole per device. Each device holds
+    ``window / mesh.size`` columns; XLA's partitioner inserts the
+    collectives for cross-shard scatters and block updates, and results
+    stay bit-identical to the unsharded board
+    (tests/test_multichip_checker.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if window % mesh.size != 0:
+        raise ValueError(f"window {window} must be a multiple of "
+                         f"the mesh size {mesh.size}")
+    axes = tuple(mesh.axis_names)
+    slot_sharded = NamedSharding(mesh, PartitionSpec(axes))
+    return VoteBoard(
+        votes=jax.device_put(
+            board.votes, NamedSharding(mesh, PartitionSpec(None, axes))),
+        rounds=jax.device_put(board.rounds, slot_sharded),
+        chosen=jax.device_put(board.chosen, slot_sharded),
+        owner=jax.device_put(board.owner, slot_sharded),
+    )
+
+
+def _replicate(x: jax.Array, mesh) -> jax.Array:
+    """Place ``x`` fully REPLICATED over ``mesh`` (the epoch-plane
+    rule: predicate planes are tiny and every shard checks its own
+    slots against all of them, so replication beats any split)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+
+
 def _spec_statics(spec: QuorumSpec) -> tuple[tuple, tuple]:
     """Hashable statics for the jitted kernels: ``(masks_t, meta)``
     where ``meta = (thresholds_t, combine_any, grid_or_None)``. Grid
@@ -463,21 +497,7 @@ class TpuQuorumChecker:
         self._masks_t, self._meta = _spec_statics(spec)
         self.board = make_vote_board(window, spec.num_nodes)
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            if window % mesh.size != 0:
-                raise ValueError(f"window {window} must be a multiple of "
-                                 f"the mesh size {mesh.size}")
-            axes = tuple(mesh.axis_names)
-            slot_sharded = NamedSharding(mesh, PartitionSpec(axes))
-            self.board = VoteBoard(
-                votes=jax.device_put(
-                    self.board.votes,
-                    NamedSharding(mesh, PartitionSpec(None, axes))),
-                rounds=jax.device_put(self.board.rounds, slot_sharded),
-                chosen=jax.device_put(self.board.chosen, slot_sharded),
-                owner=jax.device_put(self.board.owner, slot_sharded),
-            )
+            self.board = _shard_board(self.board, mesh, window)
 
     def record_block_async(self, start_slot: int, block: np.ndarray,
                            vote_round: int = 0) -> jax.Array:
@@ -704,10 +724,20 @@ class EpochSegmentedChecker:
     same on-device gather as :meth:`TpuQuorumChecker.reshape` --
     mid-flight votes for surviving acceptors keep counting across the
     handover.
+
+    ``mesh``: an optional ``jax.sharding.Mesh``. The board's SLOT axis
+    shards over every mesh axis (:func:`_shard_board`, the same layout
+    as the sharded TpuQuorumChecker) while the epoch planes
+    (``masks``/``thresholds``/``combine_any``/``boundaries``) are
+    REPLICATED: every shard's slots select their own plane by
+    searchsorted, so the plane stack must be whole on every device.
+    Results stay bit-identical to the unsharded checker
+    (tests/test_multichip_epoch.py, vs the two-config systems oracle).
     """
 
     def __init__(self, specs: Sequence[QuorumSpec],
-                 boundaries: Sequence[int], window: int = 4096):
+                 boundaries: Sequence[int], window: int = 4096,
+                 mesh=None):
         if len(specs) != len(boundaries):
             raise ValueError(
                 f"{len(specs)} specs vs {len(boundaries)} boundaries")
@@ -715,6 +745,7 @@ class EpochSegmentedChecker:
             raise ValueError(
                 f"epoch boundaries must be nondecreasing: {boundaries}")
         self.window = window
+        self.mesh = mesh
         # Per-epoch specs in their OWN universes; the union universe is
         # first-seen order so adding an epoch only APPENDS columns
         # (existing columns keep their indices -- the board gather for
@@ -724,6 +755,8 @@ class EpochSegmentedChecker:
         self.universe: tuple = ()
         self._rebuild_universe()
         self.board = make_vote_board(window, len(self.universe))
+        if mesh is not None:
+            self.board = _shard_board(self.board, mesh, window)
 
     def _rebuild_universe(self) -> None:
         seen: dict = {}
@@ -746,6 +779,13 @@ class EpochSegmentedChecker:
             np.asarray(self._starts[1:], dtype=np.int32))
         self._boundaries_np = np.asarray(self._starts[1:],
                                          dtype=np.int64)
+        if getattr(self, "mesh", None) is not None:
+            # Replicated epoch planes: explicit placement so the drain
+            # kernels never re-lay them out (and DEV1203 stays clean).
+            self._masks = _replicate(self._masks, self.mesh)
+            self._thresholds = _replicate(self._thresholds, self.mesh)
+            self._combine_any = _replicate(self._combine_any, self.mesh)
+            self._boundaries = _replicate(self._boundaries, self.mesh)
 
     def column_of(self, node_id: int) -> int:
         return self.universe.index(node_id)

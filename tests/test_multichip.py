@@ -18,8 +18,14 @@ import numpy as np
 import pytest
 
 from frankenpaxos_tpu.bench.pipeline import (
+    gathered_layout,
+    local_block,
+    make_sharded_runner,
+    make_sharded_state,
     make_sharded_step,
     make_state,
+    padded_window,
+    run_steps,
     steady_state_step,
 )
 from frankenpaxos_tpu.quorums import Grid, SimpleMajority
@@ -155,6 +161,92 @@ def test_grid_spec_sharded_equivalence():
     # proves the spec is actually exercised, not collapsed to majority.
     maj = _run_unsharded(n_acc, window, block, iters)
     assert int(un.committed) != int(maj.committed)
+
+
+def _assert_gathered_equivalent(sharded, host, slot_dim, window, block,
+                                w_padded):
+    """Pad-aware twin of :func:`_assert_equivalent`: gather the sharded
+    (possibly PADDED) window back to logical slot order through
+    ``gathered_layout`` and demand bit-identity with the unpadded host
+    oracle, pad columns all-zero."""
+    b_local, pad = local_block(block, slot_dim)
+    w_local = w_padded // slot_dim
+    logical, valid = gathered_layout(slot_dim, w_local, b_local, block)
+
+    def gathered(x):
+        x = np.asarray(x)
+        if x.ndim == 1:
+            out = np.zeros(window, x.dtype)
+            out[logical[valid]] = x[valid]
+            return out
+        out = np.zeros((x.shape[0], window), x.dtype)
+        out[:, logical[valid]] = x[:, valid]
+        return out
+
+    assert int(sharded.committed) == int(host.committed)
+    assert int(sharded.sm_state) == int(host.sm_state)
+    assert int(sharded.exec_wm) == int(host.exec_wm)
+    for field in ("chosen", "commands", "results", "votes"):
+        np.testing.assert_array_equal(
+            gathered(getattr(sharded, field)),
+            np.asarray(getattr(host, field)), err_msg=field)
+    if pad:
+        assert not np.asarray(sharded.votes)[:, ~valid].any()
+        assert not np.asarray(sharded.commands)[~valid].any()
+
+
+@pytest.mark.parametrize("group_dim,slot_dim", [(1, 3), (2, 3)])
+def test_non_divisible_slot_split(group_dim, slot_dim):
+    """A block that does NOT divide over the slot shards (100 % 3): the
+    local block rounds up, the pad tail is masked, and every state leaf
+    still matches the unpadded host oracle bit-for-bit -- the regression
+    for the old silent ``block_size % slot_shards`` assert."""
+    n_acc, window, block, iters = 2 * group_dim, 400, 100, 9
+    assert block % slot_dim != 0
+    w_padded = padded_window(window, block, slot_dim)
+    assert w_padded > window  # the split actually pads
+
+    host = _run_unsharded(n_acc, window, block, iters)
+    assert int(host.committed) > 0
+
+    devices = np.asarray(jax.devices()[:group_dim * slot_dim])
+    mesh = Mesh(devices.reshape(group_dim, slot_dim), ("group", "slot"))
+    masks, thresholds, combine_any = _spec(n_acc)
+    state, _, wp = make_sharded_state(mesh, window, block, n_acc)
+    assert wp == w_padded
+    step, _ = make_sharded_step(mesh, block_size=block, masks=masks,
+                                thresholds=thresholds,
+                                combine_any=combine_any)
+    for t in range(iters):
+        state = step(state, jnp.int32(t))
+    _assert_gathered_equivalent(jax.device_get(state), host, slot_dim,
+                                window, block, w_padded)
+
+
+def test_sharded_runner_matches_run_steps():
+    """``make_sharded_runner`` (the bench hot loop: one shard_map'd
+    fori_loop dispatch with a traced start) agrees with the unsharded
+    ``run_steps`` across chunk boundaries -- including a non-divisible
+    slot split."""
+    n_acc, window, block = 3, 400, 100
+    mesh = Mesh(np.asarray(jax.devices()[:3]).reshape(1, 3),
+                ("group", "slot"))
+    masks, thresholds, combine_any = _spec(n_acc)
+    masks_t = tuple(tuple(int(x) for x in row) for row in masks)
+    thresholds_t = tuple(int(t) for t in thresholds)
+
+    host = make_state(window, n_acc)
+    host = run_steps(host, 8, block, masks_t, thresholds_t, combine_any)
+
+    state, _, wp = make_sharded_state(mesh, window, block, n_acc)
+    runner, _ = make_sharded_runner(
+        mesh, block_size=block, masks=masks, thresholds=thresholds,
+        combine_any=combine_any, iters=4)
+    state = runner(state, jnp.int32(0))   # chunk 1: drains 0..3
+    state = runner(state, jnp.int32(4))   # chunk 2 resumes at drain 4
+    _assert_gathered_equivalent(jax.device_get(state),
+                                jax.device_get(host), 3, window, block,
+                                wp)
 
 
 def test_dryrun_multichip_entry():

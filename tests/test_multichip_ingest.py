@@ -1,0 +1,125 @@
+"""Per-shard ingest routing on the forced 8-device mesh.
+
+``ingest.shard`` is the wire-to-device leg of the tentpole: a drain
+block's command ids, scanned off a REAL paxwire client batch
+(``parse_client_batch``), route to the slot shards that own their
+lanes (``route_block``) and land with one explicitly placed
+``device_put`` per mesh slice (``place_block``). These tests pin the
+routing to ``bench/pipeline``'s gathered layout on divisible AND
+non-divisible splits, round-trip the placed global array, and verify
+the one-copy-per-slice placement itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu import native
+from frankenpaxos_tpu.bench.pipeline import local_block
+from frankenpaxos_tpu.ingest import (
+    command_ids,
+    parse_client_batch,
+    place_block,
+    route_block,
+)
+import frankenpaxos_tpu.protocols.multipaxos  # noqa: F401 (codecs)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ClientRequest,
+    Command,
+    CommandId,
+)
+from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+
+@pytest.fixture(autouse=True)
+def _devices(need_8_devices):
+    """All tests here need the shared 8-device mesh (conftest.py)."""
+
+
+def _client_batch(n: int, pseudonym: int = 3) -> bytes:
+    segs = [DEFAULT_SERIALIZER.to_bytes(ClientRequest(Command(
+        CommandId(("10.0.0.1", 9000), pseudonym, i), b"w%04d" % i)))
+        for i in range(n)]
+    return bytes(native.batch_header(151, [len(s) for s in segs])
+                 + b"".join(segs))
+
+
+def test_command_ids_off_real_wire_batch():
+    """ids come straight off the descriptor columns of a parsed
+    paxwire batch -- deterministic in (pseudonym, client-id), no value
+    decode."""
+    colrun = parse_client_batch(_client_batch(6, pseudonym=3))
+    assert colrun is not None
+    ids = command_ids(colrun)
+    assert ids.dtype == np.int32 and ids.shape == (6,)
+    want = np.int32(np.int64(3) * 1_000_003 + np.arange(6))
+    np.testing.assert_array_equal(ids, want)
+    # Distinct pseudonyms produce distinct id streams.
+    other = command_ids(parse_client_batch(_client_batch(6, pseudonym=4)))
+    assert not np.intersect1d(ids, other).size
+
+
+@pytest.mark.parametrize("block,slot_shards", [(128, 4), (100, 3)])
+def test_route_block_matches_lane_ownership(block, slot_shards):
+    """Lane ``l`` of the block lands at ``[l // b_local, l % b_local]``
+    -- the exact ownership rule ``bench/pipeline.gathered_layout``
+    derives, on divisible and non-divisible splits, with the pad tail
+    zeroed (the pipeline's "no proposal" id)."""
+    b_local, pad = local_block(block, slot_shards)
+    assert (pad > 0) == (block % slot_shards != 0)
+    k = block - 7  # a partial drain: short prefix of the block
+    ids = np.arange(1, k + 1, dtype=np.int32)
+    routed = route_block(ids, block, slot_shards)
+    assert routed.shape == (slot_shards, b_local)
+    for lane in range(k):
+        assert routed[lane // b_local, lane % b_local] == ids[lane]
+    # Unrouted lanes and the pad tail are zero.
+    flat = routed.reshape(-1)
+    owned = np.zeros(slot_shards * b_local, dtype=bool)
+    owned[:k] = True
+    assert not flat[~owned].any()
+
+
+def test_route_block_rejects_oversized_drain():
+    with pytest.raises(ValueError, match="exceed"):
+        route_block(np.arange(101, dtype=np.int32), 100, 3)
+
+
+@pytest.mark.parametrize("group_dim,slot_dim,block",
+                         [(1, 8, 64), (2, 4, 64), (2, 3, 100)])
+def test_place_block_round_trip(group_dim, slot_dim, block,
+                                mesh_factory):
+    """The placed global array round-trips to the routed layout on
+    several mesh shapes, including the non-divisible slot split."""
+    mesh = mesh_factory(group_dim, slot_dim)
+    colrun = parse_client_batch(_client_batch(block - 5))
+    ids = command_ids(colrun)
+    placed = place_block(mesh, ids, block)
+    routed = route_block(ids, block, slot_dim)
+    np.testing.assert_array_equal(np.asarray(placed),
+                                  routed.reshape(-1))
+    assert placed.sharding.mesh.shape["slot"] == slot_dim
+
+
+def test_place_block_one_copy_per_slice(mesh_factory):
+    """Every addressable shard of the placed array already holds
+    exactly its own routed segment -- the copy fanned out once, no
+    post-landing cross-device shuffle is pending."""
+    mesh = mesh_factory(2, 4)
+    block = 64
+    ids = np.arange(1, block + 1, dtype=np.int32)
+    placed = place_block(mesh, ids, block)
+    routed = route_block(ids, block, 4)
+    seg = routed.shape[1]
+    devices_seen = set()
+    for shard in placed.addressable_shards:
+        (sl,) = shard.index
+        start = 0 if sl.start is None else sl.start
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), routed.reshape(-1)[start:start + seg])
+        devices_seen.add(shard.device)
+    # group=2 replicates each slot segment on two devices.
+    assert len(devices_seen) == 8
+    assert jax.device_get(placed).shape == (4 * seg,)
