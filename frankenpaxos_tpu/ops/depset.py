@@ -15,10 +15,28 @@ epaxos/Replica.scala:1159-1420 become one fused step per drain.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (bucket size for cached planes)."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@functools.lru_cache(maxsize=None)
+def _index_plane(cap: int) -> jax.Array:
+    """Cached ``[cap] int32`` row-index plane at pow2 capacity.
+
+    Built lazily (an import-time device array would initialize the
+    backend in every process that merely imports a protocol module, see
+    ops/quorum.py) and pinned to int32 regardless of the x64 flag so
+    jitted consumers never retrace on index dtype (SHAPE602).
+    """
+    return jnp.arange(cap, dtype=jnp.int32)
 
 
 class DepSetBatch(NamedTuple):
@@ -116,10 +134,9 @@ def equal(a: DepSetBatch, b: DepSetBatch) -> jax.Array:
 
 
 @jax.jit
-def contains(d: DepSetBatch, leader: jax.Array, vid: jax.Array) -> jax.Array:
-    """[B] bool: does each row contain vertex (leader[b], vid[b])?"""
-    b = d.watermarks.shape[0]
-    rows = jnp.arange(b, dtype=jnp.int32)
+def _contains_kernel(d: DepSetBatch, leader: jax.Array, vid: jax.Array,
+                     plane: jax.Array) -> jax.Array:
+    rows = plane[:d.watermarks.shape[0]]
     in_prefix = vid < d.watermarks[rows, leader]
     off = vid - d.tail_base
     off_c = jnp.clip(off, 0, d.tails.shape[-1] - 1)
@@ -128,8 +145,80 @@ def contains(d: DepSetBatch, leader: jax.Array, vid: jax.Array) -> jax.Array:
     return in_prefix | in_tail
 
 
+def contains(d: DepSetBatch, leader: jax.Array, vid: jax.Array) -> jax.Array:
+    """[B] bool: does each row contain vertex (leader[b], vid[b])?
+
+    The row-index plane is the cached pow2-padded :func:`_index_plane`
+    (sliced inside the kernel), not a per-call ``jnp.arange``: batches
+    sharing a pow2 bucket share one device constant, and the plane's
+    int32 dtype is pinned against x64 drift (SHAPE602).
+    """
+    cap = _pow2(int(d.watermarks.shape[0]))
+    return _contains_kernel(d, leader, vid, _index_plane(cap))
+
+
 @jax.jit
 def size(d: DepSetBatch) -> jax.Array:
     """[B] int32 cardinality (assumes normalized rows)."""
     return (d.watermarks.sum(-1)
             + d.tails.astype(jnp.int32).sum(axis=(-1, -2)))
+
+
+@jax.jit
+def conflict_max(seqs: jax.Array, d: DepSetBatch
+                 ) -> tuple[jax.Array, DepSetBatch]:
+    """The EPaxos seq/deps conflict aggregation over a quorum of replies.
+
+    The slow path picks ``seq = max(reply seqs)`` and
+    ``deps = union(reply deps)`` (epaxos/Replica.scala:795-813); here the
+    whole reply set reduces in one fused step: ``seqs [B]`` -> ``[]``
+    max, plus the normalized one-row union of all B dependency rows.
+    """
+    return jnp.max(seqs), union_reduce(d)
+
+
+@jax.jit
+def intersect(a: DepSetBatch, b: DepSetBatch) -> DepSetBatch:
+    """Rowwise set intersection -- the interference-closure step
+    (restrict a dependency set to the instances that actually interfere
+    with the command under consideration).
+
+    PRECONDITION: shared ``tail_base`` (as for :func:`union`; use
+    :func:`intersect_checked` from host code). An id is in the result
+    iff it is in both sets: ids below both watermarks stay prefix
+    (``min`` of watermarks), everything else lands as tail bits and
+    renormalizes. Ids at or past the tail window can only be present
+    via both watermarks, which the ``min`` already covers.
+    """
+    w = a.tails.shape[-1]
+    ids = a.tail_base + jnp.arange(w, dtype=jnp.int32)          # [W]
+    in_a = (ids[None, None, :] < a.watermarks[:, :, None]) | (a.tails > 0)
+    in_b = (ids[None, None, :] < b.watermarks[:, :, None]) | (b.tails > 0)
+    new_wm = jnp.minimum(a.watermarks, b.watermarks)
+    tails = ((in_a & in_b)
+             & (ids[None, None, :] >= new_wm[:, :, None])).astype(jnp.uint8)
+    return normalized(DepSetBatch(new_wm, tails, a.tail_base))
+
+
+def intersect_checked(a: DepSetBatch, b: DepSetBatch) -> DepSetBatch:
+    """Host-side intersection enforcing the shared-tail-base precondition."""
+    if int(a.tail_base) != int(b.tail_base):
+        raise ValueError(
+            f"dep-set intersections need a shared tail base: "
+            f"{int(a.tail_base)} != {int(b.tail_base)}")
+    return intersect(a, b)
+
+
+@jax.jit
+def compact(d: DepSetBatch, executed: jax.Array) -> DepSetBatch:
+    """Prefix-compaction against the executed watermark.
+
+    ``executed`` is ``[L]`` or ``[B, L]`` int32 per-column executed
+    watermarks: every instance below it has executed, so a dependency on
+    it is vacuously satisfied -- absorb those ids into the prefix (raise
+    each column's watermark to at least ``executed``, the device twin of
+    ``add_all(InstancePrefixSet.from_watermarks(executed))``) and
+    renormalize so newly-covered tail bits fold into the run.
+    """
+    wm = jnp.maximum(d.watermarks, jnp.asarray(executed, dtype=jnp.int32))
+    return normalized(DepSetBatch(wm, d.tails, d.tail_base))

@@ -84,6 +84,25 @@ def union_many(sets: list[InstancePrefixSet],
                     int(reduced.tail_base))
 
 
+def conflict_max_many(seq_deps: list[tuple[int, InstancePrefixSet]],
+                      num_replicas: int) -> tuple[int, InstancePrefixSet]:
+    """Quorum (max sequence number, union deps) as ONE fused device
+    reduction (ops/depset.conflict_max); host fallback on overflow."""
+    batch = to_batch([deps for _, deps in seq_deps], num_replicas)
+    if batch is None:
+        union = InstancePrefixSet(num_replicas)
+        for _, deps in seq_deps:
+            union.add_all(deps)
+        return max(seq for seq, _ in seq_deps), union
+    import jax.numpy as jnp
+
+    seq, reduced = depset.conflict_max(
+        jnp.asarray([seq for seq, _ in seq_deps], dtype=jnp.int32), batch)
+    return int(seq), from_row(np.asarray(reduced.watermarks)[0],
+                              np.asarray(reduced.tails)[0],
+                              int(reduced.tail_base))
+
+
 def all_identical(seq_deps: list[tuple[int, InstancePrefixSet]],
                   num_replicas: int) -> bool:
     """Do all (sequence number, deps) pairs denote the same set?"""

@@ -322,14 +322,15 @@ class EPaxosReplica(Actor):
         """Union deps across a classic quorum (Replica.scala:795-813)."""
         self.logger.check_ge(len(state.responses),
                              self.config.slow_quorum_size)
-        sequence_number = max(r.sequence_number
-                              for r in state.responses.values())
         if self.options.dep_backend == "tpu":
             from frankenpaxos_tpu.protocols.epaxos import device_deps
-            dependencies = device_deps.union_many(
-                [r.dependencies for r in state.responses.values()],
+            sequence_number, dependencies = device_deps.conflict_max_many(
+                [(r.sequence_number, r.dependencies)
+                 for r in state.responses.values()],
                 self.config.n)
         else:
+            sequence_number = max(r.sequence_number
+                                  for r in state.responses.values())
             dependencies = InstancePrefixSet(self.config.n)
             for response in state.responses.values():
                 dependencies.add_all(response.dependencies)

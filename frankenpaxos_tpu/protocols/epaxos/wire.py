@@ -309,3 +309,7 @@ for _codec in (PreAcceptCodec(), PreAcceptOkCodec(), AcceptCodec(),
                EPaxosClientRequestCodec(), EPaxosClientReplyCodec(),
                PrepareCodec(), EPaxosNackCodec(), PrepareOkCodec()):
     register_codec(_codec)
+
+# Importing for side effect: registers the drain-coalesced
+# PreAcceptOkRun codec and its paxwire coalescer for tag 15.
+from frankenpaxos_tpu.runs import wire as _run_wire  # noqa: E402,F401

@@ -31,7 +31,6 @@ Liveness/performance knobs:
 
 from __future__ import annotations
 
-from collections import Counter
 import dataclasses
 import random
 import time
@@ -43,6 +42,7 @@ from frankenpaxos_tpu.election.raft import (
 )
 from frankenpaxos_tpu.heartbeat import HeartbeatOptions, HeartbeatParticipant
 from frankenpaxos_tpu.roundsystem import RoundSystem, RoundType
+from frankenpaxos_tpu.runs.quorums import fast_flexible_specs, SpecChecker
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
@@ -387,6 +387,9 @@ class FastMultiPaxosLeaderOptions:
     # Also the fast-stuck detection period: a fast round that makes no
     # progress for a full period falls back to a classic round.
     resend_phase2as_period_s: float = 5.0
+    # "host": NumPy quorum-spec evaluation; "tpu": the fused ops/quorum
+    # checker (runs/quorums.SpecChecker) -- bit-identical predicates.
+    quorum_backend: str = "host"
 
 
 class FastMultiPaxosLeader(Actor):
@@ -405,6 +408,15 @@ class FastMultiPaxosLeader(Actor):
         self.options = options
         self.state_machine = state_machine
         self.rng = random.Random(seed)
+        # Classic/fast/recovery predicates in matrix form, sized from
+        # the LIVE config (runs/quorums.py).
+        specs = fast_flexible_specs(config.n, config.classic_quorum_size,
+                                    config.fast_quorum_size)
+        self.classic_quorum = SpecChecker(specs.classic,
+                                          options.quorum_backend)
+        self.fast_quorum = SpecChecker(specs.fast, options.quorum_backend)
+        self.recovery_quorum = SpecChecker(specs.recovery,
+                                           options.quorum_backend)
         self.leader_id = list(config.leader_addresses).index(address)
         self.round = 0 if config.round_system.leader(0) == self.leader_id \
             else -1
@@ -545,24 +557,35 @@ class FastMultiPaxosLeader(Actor):
 
     def _choose_proposal(self, phase1bs: dict[int, Phase1b],
                          slot: int) -> Value:
-        """Fast Paxos phase-1 value selection (Leader.scala:482-530)."""
+        """Fast Paxos phase-1 value selection (Leader.scala:482-530).
+
+        At max vote round k, a unique value wins; else a value whose
+        round-k voters satisfy the recovery spec (>= q1 + qf - n of
+        them, i.e. fast-quorum intersection demands adoption) wins;
+        else any round-k vote. An ambiguity between popular values is
+        only possible when the configuration violates the fast
+        intersection condition; adoption is then not forced."""
         votes = []
-        for phase1b in phase1bs.values():
+        for acceptor_id, phase1b in phase1bs.items():
             vote = next((v for v in phase1b.votes if v.slot == slot), None)
-            votes.append((-1, None) if vote is None
-                         else (vote.vote_round, vote.value))
-        k = max(vote_round for vote_round, _ in votes)
+            votes.append((acceptor_id, -1, None) if vote is None
+                         else (acceptor_id, vote.vote_round, vote.value))
+        k = max(vote_round for _, vote_round, _ in votes)
         if k == -1:
             return NOOP
-        at_k = [value for vote_round, value in votes if vote_round == k]
-        if len(set(at_k)) == 1:
-            return at_k[0]
-        counts = Counter(at_k)
-        popular = [v for v, c in counts.items()
-                   if c >= self.config.quorum_majority_size]
-        if popular:
+        at_k = [(acceptor_id, value)
+                for acceptor_id, vote_round, value in votes
+                if vote_round == k]
+        if len({value for _, value in at_k}) == 1:
+            return at_k[0][1]
+        voters: dict[Value, list[int]] = {}
+        for acceptor_id, value in at_k:
+            voters.setdefault(value, []).append(acceptor_id)
+        popular = [value for value, ids in voters.items()
+                   if self.recovery_quorum.check(ids)]
+        if len(popular) == 1:
             return popular[0]
-        return at_k[0]
+        return at_k[0][1]
 
     def _choose(self, slot: int, value: Value) -> None:
         if slot in self.log:
@@ -655,7 +678,7 @@ class FastMultiPaxosLeader(Actor):
             return
         state = self.state
         state.phase1bs[phase1b.acceptor_id] = phase1b
-        if len(state.phase1bs) < self.config.classic_quorum_size:
+        if not self.classic_quorum.check(state.phase1bs):
             return
         # Fill every unchosen slot up to the max voted slot.
         max_slot = max(
@@ -713,22 +736,24 @@ class FastMultiPaxosLeader(Actor):
         in_slot[phase2b.acceptor_id] = phase2b
         round_type = self.config.round_system.round_type(self.round)
         if round_type == RoundType.CLASSIC:
-            if len(in_slot) >= self.config.classic_quorum_size:
+            if self.classic_quorum.check(in_slot):
                 self._choose(phase2b.slot,
                              state.pending_entries[phase2b.slot])
             return
         # Fast round.
-        if len(in_slot) < self.config.classic_quorum_size:
+        if not self.classic_quorum.check(in_slot):
             return
-        counts = Counter(p.vote for p in in_slot.values())
+        voters: dict[Value, list[int]] = {}
+        for acceptor_id, p in in_slot.items():
+            voters.setdefault(p.vote, []).append(acceptor_id)
         votes_left = self.config.n - len(in_slot)
-        if not any(c + votes_left >= self.config.fast_quorum_size
-                   for c in counts.values()):
+        if not any(len(ids) + votes_left >= self.config.fast_quorum_size
+                   for ids in voters.values()):
             # Fast stuck: coordinated recovery in the next round.
             self._bump_round_and_restart(self.round)
             return
-        for value, count in counts.items():
-            if count >= self.config.fast_quorum_size:
+        for value, ids in voters.items():
+            if self.fast_quorum.check(ids):
                 self._choose(phase2b.slot, value)
                 return
 

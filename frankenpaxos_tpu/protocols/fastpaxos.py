@@ -12,10 +12,10 @@ popular-items/majority-of-quorum rule, Leader.scala:150-190).
 
 from __future__ import annotations
 
-from collections import Counter
 import dataclasses
 from typing import Callable, Optional
 
+from frankenpaxos_tpu.runs.quorums import fast_flexible_specs, SpecChecker
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 
@@ -87,10 +87,19 @@ class Phase2b:
 
 class FastPaxosLeader(Actor):
     def __init__(self, address: Address, transport: Transport,
-                 logger: Logger, config: FastPaxosConfig):
+                 logger: Logger, config: FastPaxosConfig,
+                 quorum_backend: str = "host"):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        # Quorum predicates in matrix form, sized from the LIVE config
+        # (runs/quorums.py): recovery adopts a fast-round value exactly
+        # when fast-quorum intersection demands it (>= q1 + qf - n
+        # votes among the phase-1 replies).
+        specs = fast_flexible_specs(config.n, config.classic_quorum_size,
+                                    config.fast_quorum_size)
+        self.classic_quorum = SpecChecker(specs.classic, quorum_backend)
+        self.recovery_quorum = SpecChecker(specs.recovery, quorum_backend)
         self.index = list(config.leader_addresses).index(address)
         self.round = self.index
         self.status = "idle"
@@ -136,7 +145,7 @@ class FastPaxosLeader(Actor):
         if self.status != "phase1" or response.round != self.round:
             return
         self.phase1b_responses[response.acceptor_id] = response
-        if len(self.phase1b_responses) < self.config.classic_quorum_size:
+        if not self.classic_quorum.check(self.phase1b_responses):
             return
         k = max(r.vote_round for r in self.phase1b_responses.values())
         if k == -1:
@@ -149,15 +158,21 @@ class FastPaxosLeader(Actor):
             value = next(iter(values))
             self.proposed_value = value
         else:
-            # Fast round: a value with a majority of the quorum may have
-            # been chosen (Leader.scala:168-185).
-            votes = [r.vote_value for r in self.phase1b_responses.values()
-                     if r.vote_round == 0]
-            counts = Counter(votes)
-            popular = [v for v, c in counts.items()
-                       if c >= self.config.quorum_majority_size]
-            if popular:
-                self.logger.check_eq(len(popular), 1)
+            # Fast round: a value the fast quorum may have chosen is one
+            # whose voters intersect every fast quorum -- the recovery
+            # spec (Leader.scala:168-185; runs/quorums.py). Under a
+            # valid configuration at most one value can be popular; an
+            # ambiguity means the config violates the fast intersection
+            # condition, and adoption is not forced, so the leader keeps
+            # its own value (the divergence stays observable to sims).
+            voters: dict[Optional[str], list[int]] = {}
+            for r in self.phase1b_responses.values():
+                if r.vote_round == 0:
+                    voters.setdefault(r.vote_value, []).append(
+                        r.acceptor_id)
+            popular = [v for v, ids in voters.items()
+                       if self.recovery_quorum.check(ids)]
+            if len(popular) == 1:
                 value = popular[0]
                 self.proposed_value = value
             else:
@@ -171,7 +186,7 @@ class FastPaxosLeader(Actor):
         if self.status != "phase2" or response.round != self.round:
             return
         self.phase2b_responses[response.acceptor_id] = response
-        if len(self.phase2b_responses) < self.config.classic_quorum_size:
+        if not self.classic_quorum.check(self.phase2b_responses):
             return
         self.logger.check(self.proposed_value is not None)
         chosen = self.proposed_value
@@ -254,10 +269,15 @@ class FastPaxosClient(Actor):
 
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: FastPaxosConfig,
-                 repropose_period_s: float = 10.0):
+                 repropose_period_s: float = 10.0,
+                 quorum_backend: str = "host"):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
+        self.fast_quorum = SpecChecker(
+            fast_flexible_specs(config.n, config.classic_quorum_size,
+                                config.fast_quorum_size).fast,
+            quorum_backend)
         self.proposed_value: Optional[str] = None
         self.chosen_value: Optional[str] = None
         self.phase2b_responses: dict[int, Phase2b] = {}
@@ -306,7 +326,7 @@ class FastPaxosClient(Actor):
         elif isinstance(message, Phase2b):
             self.logger.check_eq(message.round, 0)
             self.phase2b_responses[message.acceptor_id] = message
-            if len(self.phase2b_responses) < self.config.fast_quorum_size:
+            if not self.fast_quorum.check(self.phase2b_responses):
                 return
             self.logger.check(self.proposed_value is not None)
             self._choose(self.proposed_value)

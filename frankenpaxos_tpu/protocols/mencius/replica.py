@@ -43,6 +43,14 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
     encode_value_array,
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runs import (
+    log_chosen_values,
+    pick_array_destination,
+    pick_request_destination,
+    RetryAdmissionMixin,
+    StagedWriteMixin,
+    wal_log_chosen_run,
+)
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.serve.messages import Rejected
@@ -150,19 +158,13 @@ class MenciusReplica(Actor, DurableRole):
         self._execute_log()  # replies discarded; clients resend
 
     def _log_chosen(self, start_slot: int, stride: int, values) -> int:
-        """Put a strided run of chosen values into the log (slots below
-        the executed watermark are duplicates by definition); returns
-        how many were new. Shared by the live handlers and WAL
-        replay."""
-        new = 0
-        slot = start_slot
-        for value in values:
-            if slot >= self.executed_watermark \
-                    and self.log.get(slot) is None:
-                self.log.put(slot, value)
-                new += 1
-                self.high_watermark = max(self.high_watermark, slot)
-            slot += stride
+        """Put a strided run of chosen values into the log
+        (runs/records.py); returns how many were new. Shared by the
+        live handlers and WAL replay."""
+        new, high = log_chosen_values(self.log, self.executed_watermark,
+                                      start_slot, stride, values)
+        if high >= 0:
+            self.high_watermark = max(self.high_watermark, high)
         self.num_chosen += new
         return new
 
@@ -339,19 +341,13 @@ class MenciusReplica(Actor, DurableRole):
         if new == 0:
             return
         if self.wal is not None:
-            if new == len(run.values):
-                # The common case logs the inbound lazy value segment
-                # as a raw copy.
-                self.wal.append(WalChosenRun(
-                    start_slot=run.start_slot, stride=run.stride,
-                    values=encode_value_array(run.values)))
-            else:
-                for i, value in enumerate(run.values):
-                    slot = run.start_slot + i * run.stride
-                    if self.log.get(slot) is value:
-                        self.wal.append(WalChosenRun(
-                            start_slot=slot, stride=1,
-                            values=encode_value_array((value,))))
+            # Common case: every slot new -> one raw-copy segment
+            # record; partial overlap falls back to per-new-slot
+            # records (runs/records.py).
+            wal_log_chosen_run(self.wal, self.log.get, run.start_slot,
+                               run.stride, run.values,
+                               all_new=(new == len(run.values)),
+                               encode=encode_value_array)
         self._after_choose(coalesce_replies=True)
 
 
@@ -391,7 +387,7 @@ class _PendingWrite:
     backoff_pending: bool = False
 
 
-class MenciusClient(Actor):
+class MenciusClient(RetryAdmissionMixin, StagedWriteMixin, Actor):
     """(mencius/Client.scala): like the MultiPaxos client, but tracks a
     round per leader group and targets a random group per request."""
 
@@ -405,40 +401,32 @@ class MenciusClient(Actor):
         self.config = config
         self.rng = random.Random(seed)
         self.resend_period_s = resend_period_s
-        # paxload retry discipline (serve/backoff.py): 0 = unlimited
+        # runs/ retry discipline (serve/backoff.py): 0 = unlimited
         # resends, the pre-paxload behavior; see multipaxos
         # ClientOptions.retry_budget for the contract.
-        self.retry_budget = retry_budget
         from frankenpaxos_tpu.serve.backoff import Backoff
 
-        self.backoff = backoff or Backoff()
+        self._retry_budget = retry_budget
+        self._retry_backoff = backoff or Backoff()
         # Coalesce this event-loop pass's writes into ONE
         # ClientRequestArray to a random group's leader (each command
         # still gets its own owned slot there). Flushed by on_drain /
-        # flush_writes; resends still go per-request. Bypasses
-        # batchers: the array is transport-level coalescing, not slot
-        # sharing.
+        # flush_writes (runs/client.py); resends still go per-request.
         self.coalesce_writes = coalesce_writes
         self.rounds = [0] * config.num_leader_groups
         self.ids: dict[int, int] = {}
         self.states: dict[int, _PendingWrite] = {}
-        self._staged_writes: list[Command] = []
-        self._flush_scheduled = False
+        self._init_staging()
+
+    def _random_group_leader(self) -> Address:
+        group = self.rng.randrange(self.config.num_leader_groups)
+        return self._leader_of_group(group)
 
     def _send_request(self, request: ClientRequest) -> None:
-        if self.config.num_ingest_batchers > 0:
-            # paxingest: disseminators absorb the fan-in (resends
-            # re-roll the pick, so a dead batcher costs a retry).
-            dst = self.config.ingest_batcher_addresses[
-                self.rng.randrange(self.config.num_ingest_batchers)]
-        elif self.config.num_batchers > 0:
-            dst = self.config.batcher_addresses[
-                self.rng.randrange(self.config.num_batchers)]
-        else:
-            group = self.rng.randrange(self.config.num_leader_groups)
-            rs = ClassicRoundRobin(len(self.config.leader_addresses[group]))
-            dst = self.config.leader_addresses[group][
-                rs.leader(self.rounds[group])]
+        # runs/routing ladder (ingest batchers > batchers > a random
+        # group's leader: any group can sequence any command).
+        dst = pick_request_destination(self.config, self.rng,
+                                       self._random_group_leader)
         self.send(dst, request)
 
     def _leader_of_group(self, group: int) -> Address:
@@ -446,26 +434,12 @@ class MenciusClient(Actor):
         return self.config.leader_addresses[group][
             rs.leader(self.rounds[group])]
 
-    def flush_writes(self) -> None:
+    def _flush_staged(self, staged: list) -> None:
         """Ship writes staged by ``coalesce_writes`` as one array to a
         random leader group (any group can sequence any command)."""
-        if not self._staged_writes:
-            return
-        staged, self._staged_writes = self._staged_writes, []
-        if self.config.num_ingest_batchers > 0:
-            dst = self.config.ingest_batcher_addresses[
-                self.rng.randrange(self.config.num_ingest_batchers)]
-        else:
-            group = self.rng.randrange(self.config.num_leader_groups)
-            dst = self._leader_of_group(group)
+        dst = pick_array_destination(self.config, self.rng,
+                                     self._random_group_leader)
         self.send(dst, ClientRequestArray(commands=tuple(staged)))
-
-    def _deferred_flush(self) -> None:
-        self._flush_scheduled = False
-        self.flush_writes()
-
-    def on_drain(self) -> None:
-        self.flush_writes()
 
     def write(self, pseudonym: int, command: bytes,
               callback: Optional[Callable[[bytes], None]] = None) -> None:
@@ -476,15 +450,7 @@ class MenciusClient(Actor):
         request = ClientRequest(Command(
             CommandId(self.address, pseudonym, id), command))
         if self.coalesce_writes:
-            self._staged_writes.append(request.command)
-            # On a real event-loop transport, flush at the END of this
-            # loop pass so a burst of writes crosses the wire as one
-            # array; SimTransport has no loop -- there on_drain / an
-            # explicit flush_writes() ships them.
-            loop = getattr(self.transport, "loop", None)
-            if loop is not None and not self._flush_scheduled:
-                self._flush_scheduled = True
-                loop.call_soon_threadsafe(self._deferred_flush)
+            self._stage_write(request.command)
         else:
             self._send_request(request)
 
@@ -504,71 +470,17 @@ class MenciusClient(Actor):
             id, command, callback or (lambda _: None), timer)
         self.ids[pseudonym] = id + 1
 
-    def _consume_retry(self, pseudonym: int, state, kind: str) -> bool:
-        """Retry-budget bookkeeping (see multipaxos Client)."""
-        if self.retry_budget <= 0:
-            return True
-        from frankenpaxos_tpu.serve.backoff import RETRY_EXHAUSTED
-
-        metrics = self.transport.runtime_metrics
-        if state.attempts >= self.retry_budget:
-            state.resend.stop()
-            del self.states[pseudonym]
-            if metrics is not None:
-                metrics.client_retry("giveup")
-            state.callback(RETRY_EXHAUSTED)
-            return False
-        state.attempts += 1
-        if metrics is not None:
-            metrics.client_retry(kind)
-        return True
-
-    def _handle_rejected(self, rejected) -> None:
-        """Admission refused: jittered exponential backoff, then
-        re-issue to the SAME leader class (no failover -- the leader
-        is alive, just saturated)."""
-        for pseudonym, client_id in rejected.entries:
-            state = self.states.get(pseudonym)
-            if state is None or client_id != state.id:
-                continue
-            if state.backoff_pending:
-                # One backoff per operation (see the multipaxos
-                # client): the resend's duplicate Rejected must not
-                # double-consume the budget or double-reissue.
-                continue
-            state.resend.stop()
-            if not self._consume_retry(pseudonym, state, "backoff"):
-                continue
-            if self.retry_budget <= 0:
-                state.attempts += 1
-            delay_s = self.backoff.delay_s(
-                state.attempts - 1, self.rng,
-                floor_s=rejected.retry_after_ms / 1000.0)
-            expected = state
-            state.backoff_pending = True
-
-            def reissue(pseudonym=pseudonym, expected=expected):
-                current = self.states.get(pseudonym)
-                if current is not expected:
-                    return
-                current.backoff_pending = False
-                request = ClientRequest(Command(
-                    CommandId(self.address, pseudonym, current.id),
-                    current.command))
-                if self.coalesce_writes:
-                    # Coalesce backoff expiries back into one array
-                    # (see the multipaxos client's reissue path).
-                    self._staged_writes.append(request.command)
-                    loop = getattr(self.transport, "loop", None)
-                    if loop is not None and not self._flush_scheduled:
-                        self._flush_scheduled = True
-                        loop.call_soon_threadsafe(self._deferred_flush)
-                else:
-                    self._send_request(request)
-                current.resend.start()
-
-            timer = self.timer(f"backoff{pseudonym}", delay_s, reissue)
-            timer.start()
+    # Rejected handling + backoff/reissue scheduling live in
+    # RetryAdmissionMixin (runs/client.py); only the re-send is ours.
+    def _reissue(self, pseudonym: int, state) -> None:
+        request = ClientRequest(Command(
+            CommandId(self.address, pseudonym, state.id), state.command))
+        if self.coalesce_writes:
+            # Coalesce backoff expiries back into one array instead of
+            # a retry storm of singles.
+            self._stage_write(request.command)
+        else:
+            self._send_request(request)
 
     def receive(self, src: Address, message) -> None:
         if isinstance(message, ClientReply):
@@ -594,7 +506,7 @@ class MenciusClient(Actor):
                     message.leader_group_index]:
                 self.send(leader, LeaderInfoRequestClient())
         elif isinstance(message, Rejected):
-            self._handle_rejected(message)
+            self._handle_rejected(src, message)
         elif isinstance(message, LeaderInfoReplyClient):
             if message.round > self.rounds[message.leader_group_index]:
                 self.rounds[message.leader_group_index] = message.round

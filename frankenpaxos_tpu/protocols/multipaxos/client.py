@@ -41,9 +41,15 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     SequentialReadRequest,
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.runs import (
+    pick_array_destination,
+    pick_request_destination,
+    RetryAdmissionMixin,
+    StagedWriteMixin,
+)
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
-from frankenpaxos_tpu.serve.backoff import Backoff, RETRY_EXHAUSTED
+from frankenpaxos_tpu.serve.backoff import Backoff
 from frankenpaxos_tpu.serve.messages import Rejected
 
 Callback = Callable[[bytes], None]
@@ -115,7 +121,7 @@ class _PendingRead:
     replica: object = None
 
 
-class Client(Actor):
+class Client(RetryAdmissionMixin, StagedWriteMixin, Actor):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: MultiPaxosConfig,
                  options: ClientOptions = ClientOptions(), seed: int = 0,
@@ -135,9 +141,10 @@ class Client(Actor):
         self.ids: dict[int, int] = {}               # pseudonym -> next id
         self.states: dict[int, object] = {}         # pseudonym -> pending op
         self.largest_seen_slots: dict[int, int] = {}  # pseudonym -> slot
-        # Writes staged by coalesce_writes, shipped on flush_writes().
-        self._staged_writes: list[Command] = []
-        self._flush_scheduled = False
+        # runs/ retry discipline + coalesce_writes staging.
+        self._retry_budget = options.retry_budget
+        self._retry_backoff = options.backoff
+        self._init_staging()
         # One reusable resend timer per pseudonym (vs a fresh Timer per
         # write): timer construction was a measurable per-command cost
         # at drain widths in the thousands.
@@ -152,18 +159,10 @@ class Client(Actor):
         request = ClientRequest(Command(
             CommandId(self.address, pseudonym, id), command))
         if self.options.coalesce_writes:
-            self._staged_writes.append(request.command)
-            # On a real event-loop transport, flush at the END of this
-            # loop pass: writes issued in one pass (a burst of
-            # call_soon'd closed loops, or reissues inside a delivery
-            # drain) coalesce into one array. SimTransport has no loop;
-            # there on_drain / an explicit flush_writes() ships them.
-            loop = getattr(self.transport, "loop", None)
-            if loop is not None and not self._flush_scheduled:
-                self._flush_scheduled = True
-                # threadsafe: write() may be driven from off-loop
-                # threads (the in-process bench driver does).
-                loop.call_soon_threadsafe(self._deferred_flush)
+            # Stage for the end-of-pass array flush (runs/client.py:
+            # a burst of call_soon'd closed loops, or reissues inside
+            # a delivery drain, coalesce into one array).
+            self._stage_write(request.command)
         else:
             self._send_client_request(request)
         timer = self._write_resend_timer(pseudonym)
@@ -195,26 +194,6 @@ class Client(Actor):
                 self.options.resend_client_request_period_s, resend)
             self._write_timers[pseudonym] = timer
         return timer
-
-    def _consume_retry(self, pseudonym: int, state, kind: str) -> bool:
-        """Retry-budget bookkeeping (serve/backoff.py contract): True =
-        proceed with the retry; False = the budget is exhausted and the
-        operation just completed with RETRY_EXHAUSTED."""
-        budget = self.options.retry_budget
-        if budget <= 0:
-            return True
-        metrics = self.transport.runtime_metrics
-        if state.attempts >= budget:
-            state.resend.stop()
-            del self.states[pseudonym]
-            if metrics is not None:
-                metrics.client_retry("giveup")
-            state.callback(RETRY_EXHAUSTED)
-            return False
-        state.attempts += 1
-        if metrics is not None:
-            metrics.client_retry(kind)
-        return True
 
     def read(self, pseudonym: int, command: bytes,
              callback: Optional[Callback] = None) -> None:
@@ -330,42 +309,25 @@ class Client(Actor):
         return self.config.replica_addresses[
             self.rng.randrange(self.config.num_replicas)]
 
+    def _round_leader(self) -> Address:
+        return self.config.leader_addresses[
+            self.round_system.leader(self.round)]
+
     def _send_client_request(self, request: ClientRequest) -> None:
-        if self.config.num_ingest_batchers > 0:
-            # paxingest: disseminators absorb the fan-in; a resend
-            # (timeout failover) re-rolls the pick, so a dead batcher
-            # costs a retry, not a wedge.
-            dst = self.config.ingest_batcher_addresses[
-                self.rng.randrange(self.config.num_ingest_batchers)]
-        elif self.config.num_batchers > 0:
-            dst = self.config.batcher_addresses[
-                self.rng.randrange(self.config.num_batchers)]
-        else:
-            dst = self.config.leader_addresses[
-                self.round_system.leader(self.round)]
+        # runs/routing ladder: ingest disseminators absorb the fan-in
+        # (a resend re-rolls the pick: a dead batcher costs a retry,
+        # not a wedge) > batchers > the round's leader.
+        dst = pick_request_destination(self.config, self.rng,
+                                       self._round_leader)
         self.send(dst, request)
 
-    def flush_writes(self) -> None:
+    def _flush_staged(self, staged: list) -> None:
         """Ship writes staged by ``coalesce_writes`` as one array (to
         an ingest disseminator when the config deploys them, else
         straight to the round's leader)."""
-        if not self._staged_writes:
-            return
-        staged, self._staged_writes = self._staged_writes, []
-        if self.config.num_ingest_batchers > 0:
-            dst = self.config.ingest_batcher_addresses[
-                self.rng.randrange(self.config.num_ingest_batchers)]
-        else:
-            dst = self.config.leader_addresses[
-                self.round_system.leader(self.round)]
+        dst = pick_array_destination(self.config, self.rng,
+                                     self._round_leader)
         self.send(dst, ClientRequestArray(commands=tuple(staged)))
-
-    def _deferred_flush(self) -> None:
-        self._flush_scheduled = False
-        self.flush_writes()
-
-    def on_drain(self) -> None:
-        self.flush_writes()
 
     def _make_read_resend_timer(self, pseudonym: int, replica: Address,
                                 request) -> object:
@@ -402,78 +364,24 @@ class Client(Actor):
         else:
             self.logger.fatal(f"unexpected client message {message!r}")
 
-    # --- paxload retry discipline (serve/, docs/SERVING.md) ---------------
-    def _handle_rejected(self, src: Address, rejected: Rejected) -> None:
-        """Admission refused these commands: the server is ALIVE but
-        saturated. Back off (jittered exponential, the server's
-        retry_after_ms as a floor) and re-issue to the SAME
-        destination class -- unlike a timeout, no failover. Each
-        backoff consumes the retry budget when one is set."""
-        for pseudonym, client_id in rejected.entries:
-            state = self.states.get(pseudonym)
-            if state is None or client_id != getattr(state, "id", None):
-                self.logger.debug(
-                    f"stale Rejected entry for pseudonym {pseudonym}")
-                continue
-            if getattr(state, "backoff_pending", True):
-                # Under overload the resend and the original both reach
-                # the leader and each draws a Rejected; one backoff per
-                # operation, or the budget is double-consumed and the
-                # shedding leader gets duplicate reissues. The True
-                # default drops states that cannot be rejected at all
-                # (_MaxSlot: acceptors carry no admission).
-                continue
-            state.resend.stop()
-            if not self._consume_retry(pseudonym, state, "backoff"):
-                continue
-            delay_s = self.options.backoff.delay_s(
-                state.attempts - 1 if self.options.retry_budget > 0
-                else state.attempts, self.rng,
-                floor_s=rejected.retry_after_ms / 1000.0)
-            if self.options.retry_budget <= 0:
-                # No budget: attempts still drive the backoff curve.
-                state.attempts += 1
-            self._schedule_reissue(pseudonym, state, delay_s)
-
-    def _schedule_reissue(self, pseudonym: int, state,
-                          delay_s: float) -> None:
-        """One-shot jittered-backoff timer re-issuing ``state``'s
-        operation. The closure re-validates the pending state at fire
-        time: a completion (or a newer operation) in the backoff
-        window makes it a no-op."""
-        expected_id = state.id
-        state.backoff_pending = True
-
-        def reissue():
-            current = self.states.get(pseudonym)
-            if current is not state \
-                    or getattr(current, "id", None) != expected_id:
-                return
-            current.backoff_pending = False
-            if isinstance(current, _PendingWrite):
-                request = ClientRequest(Command(
-                    CommandId(self.address, pseudonym, current.id),
-                    current.command))
-                if self.options.coalesce_writes:
-                    # Re-enter through the STAGED path: a burst of
-                    # backoff expiries coalesces back into one
-                    # ClientRequestArray instead of a retry storm of
-                    # singles (the storm would re-congest the very
-                    # leader that just shed us).
-                    self._staged_writes.append(request.command)
-                    loop = getattr(self.transport, "loop", None)
-                    if loop is not None and not self._flush_scheduled:
-                        self._flush_scheduled = True
-                        loop.call_soon_threadsafe(self._deferred_flush)
-                else:
-                    self._send_client_request(request)
-            elif isinstance(current, _PendingRead) \
-                    and current.request is not None:
-                self.send(current.replica, current.request)
-            current.resend.start()
-
-        timer = self.timer(f"backoff{pseudonym}", delay_s, reissue)
-        timer.start()
+    # --- paxload retry discipline (runs/client.py, docs/SERVING.md) -------
+    # Rejected handling + backoff/reissue scheduling live in
+    # RetryAdmissionMixin; only the operation re-send is ours.
+    def _reissue(self, pseudonym: int, state) -> None:
+        if isinstance(state, _PendingWrite):
+            request = ClientRequest(Command(
+                CommandId(self.address, pseudonym, state.id),
+                state.command))
+            if self.options.coalesce_writes:
+                # Re-enter through the STAGED path: a burst of backoff
+                # expiries coalesces back into one ClientRequestArray
+                # instead of a retry storm of singles (the storm would
+                # re-congest the very leader that just shed us).
+                self._stage_write(request.command)
+            else:
+                self._send_client_request(request)
+        elif isinstance(state, _PendingRead) and state.request is not None:
+            self.send(state.replica, state.request)
 
     def _handle_client_reply(self, src: Address, reply: ClientReply) -> None:
         pseudonym = reply.command_id.client_pseudonym

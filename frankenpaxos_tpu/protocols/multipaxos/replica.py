@@ -48,6 +48,7 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
     decode_value_array,
     encode_value_array,
 )
+from frankenpaxos_tpu.runs import log_chosen_values, wal_log_chosen_run
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine
@@ -207,18 +208,11 @@ class Replica(Actor, DurableRole):
         self._execute_log()
 
     def _log_chosen(self, start_slot: int, values) -> int:
-        """Put a contiguous run of chosen values into the log (slots
-        below the executed watermark are duplicates by definition);
-        returns how many were new. Shared by the live handlers and WAL
-        replay."""
-        new = 0
-        slot = start_slot
-        for value in values:
-            if slot >= self.executed_watermark \
-                    and self.log.get(slot) is None:
-                self.log.put(slot, value)
-                new += 1
-            slot += 1
+        """Put a contiguous run of chosen values into the log
+        (runs/records.py); returns how many were new. Shared by the
+        live handlers and WAL replay."""
+        new, _ = log_chosen_values(self.log, self.executed_watermark,
+                                   start_slot, 1, values)
         self.num_chosen += new
         return new
 
@@ -468,21 +462,12 @@ class Replica(Actor, DurableRole):
 
     def _wal_log_chosen_run(self, start_slot: int, values,
                             all_new: bool) -> None:
-        """Append the run's NEW entries to the WAL. The common case --
-        every slot new -- logs the inbound lazy value array as a raw
-        copy; a partially-duplicate run falls back to per-new-slot
-        records (rare: a resend or post-failover overlap)."""
-        if all_new:
-            self.wal.append(WalChosenRun(
-                start_slot=start_slot, stride=1,
-                values=encode_value_array(values)))
-            return
-        for i, value in enumerate(values):
-            slot = start_slot + i
-            if self.log.get(slot) is value:  # the entry this run put
-                self.wal.append(WalChosenRun(
-                    start_slot=slot, stride=1,
-                    values=encode_value_array((value,))))
+        """Append the run's NEW entries to the WAL (runs/records.py):
+        all-new runs log the inbound lazy value array as ONE raw copy;
+        a partially-duplicate run falls back to per-new-slot records
+        (rare: a resend or post-failover overlap)."""
+        wal_log_chosen_run(self.wal, self.log.get, start_slot, 1, values,
+                           all_new=all_new, encode=encode_value_array)
 
     def _handle_chosen(self, src: Address, chosen: Chosen) -> None:
         """(Replica.scala:572-628)."""

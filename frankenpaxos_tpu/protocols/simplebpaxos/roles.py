@@ -45,7 +45,8 @@ VERTEX_LIKE = TUPLE_VERTEX_LIKE
 class BPaxosLeader(Actor):
     def __init__(self, address: Address, transport: Transport,
                  logger: Logger, config: SimpleBPaxosConfig,
-                 resend_deps_period_s: float = 10.0, seed: int = 0):
+                 resend_deps_period_s: float = 10.0, seed: int = 0,
+                 dep_backend: str = "host"):
         super().__init__(address, transport, logger)
         config.check_valid()
         self.config = config
@@ -53,6 +54,11 @@ class BPaxosLeader(Actor):
         self.resend_deps_period_s = resend_deps_period_s
         self.index = list(config.leader_addresses).index(address)
         self.next_vertex_id = 0
+        # "host": per-reply VertexIdPrefixSet add_all loops. "tpu": the
+        # dep-service quorum union as one batched ops/depset reduction
+        # (VertexIdPrefixSet IS InstancePrefixSet, so the EPaxos
+        # device_deps bridge applies unchanged).
+        self.dep_backend = dep_backend
         # vertex -> ("waiting", command, {node_index: reply}, timer)
         #         | ("proposed",)
         self.states: dict[VertexId, object] = {}
@@ -102,9 +108,16 @@ class BPaxosLeader(Actor):
         state[2][reply.dep_service_node_index] = reply
         if len(state[2]) < self.config.quorum_size:
             return
-        dependencies = VertexIdPrefixSet(len(self.config.leader_addresses))
-        for r in state[2].values():
-            dependencies.add_all(r.dependencies)
+        if self.dep_backend == "tpu":
+            from frankenpaxos_tpu.protocols.epaxos import device_deps
+            dependencies = device_deps.union_many(
+                [r.dependencies for r in state[2].values()],
+                len(self.config.leader_addresses))
+        else:
+            dependencies = VertexIdPrefixSet(
+                len(self.config.leader_addresses))
+            for r in state[2].values():
+                dependencies.add_all(r.dependencies)
         state[3].stop()
         self.send(self.config.proposer_addresses[self.index],
                   Propose(vertex_id=reply.vertex_id, command=state[1],

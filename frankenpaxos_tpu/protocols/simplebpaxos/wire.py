@@ -309,3 +309,7 @@ for _codec in (BPaxosClientRequestCodec(), DependencyRequestCodec(),
                BPaxosPhase1aCodec(), BPaxosPhase1bCodec(),
                BPaxosNackCodec(), BPaxosRecoverCodec()):
     register_codec(_codec)
+
+# Importing for side effect: registers the drain-coalesced DepReplyRun
+# codec and its paxwire coalescer for tag 23.
+from frankenpaxos_tpu.runs import wire as _run_wire  # noqa: E402,F401

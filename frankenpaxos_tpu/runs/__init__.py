@@ -1,0 +1,31 @@
+"""The protocol-neutral run layer.
+
+What MultiPaxos and Mencius grew separately -- run records + lazy value
+arrays, watermark GC, WAL run records, serve/admission + retry
+discipline, IngestBatcher routing -- extracted so any protocol can join
+the drain-granular run pipeline without re-duplicating it. See
+docs/RUN_PIPELINE.md ("The protocol-neutral layer") for the contract a
+protocol implements to join.
+
+Modules:
+
+  * :mod:`.client` -- the client-side retry/admission discipline
+    (retry budgets, Rejected backoff, staged-write coalescing);
+  * :mod:`.routing` -- ClientRequest/array destination selection
+    (ingest batchers > batchers > protocol leader fallback);
+  * :mod:`.records` -- chosen-run log/WAL record helpers shared by
+    replica roles;
+  * :mod:`.depruns` -- drain-coalesced dependency columns for the
+    EPaxos/BPaxos family (batched ops/depset reductions);
+  * :mod:`.quorums` -- Fast Flexible Paxos quorum-spec construction
+    for the fast-path protocols;
+  * :mod:`.wire` -- fixed-layout codecs + paxwire coalescers for the
+    run messages.
+"""
+
+from frankenpaxos_tpu.runs.client import RetryAdmissionMixin, StagedWriteMixin  # noqa: F401
+from frankenpaxos_tpu.runs.records import log_chosen_values, wal_log_chosen_run  # noqa: F401
+from frankenpaxos_tpu.runs.routing import (  # noqa: F401
+    pick_array_destination,
+    pick_request_destination,
+)

@@ -1,0 +1,40 @@
+"""ClientRequest routing for the run pipeline.
+
+The paxingest destination ladder the multipaxos and mencius clients
+each hard-coded: ingest disseminators absorb client fan-in when the
+config deploys them (a resend re-rolls the pick, so a dead batcher
+costs a retry, not a wedge), plain batchers come next, and the
+protocol's own leader-selection rule is the fallback. Protocols that
+route differently (per-group leaders, rounds) pass that rule in as
+``leader_fallback`` -- the ladder itself is protocol-neutral.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+
+def pick_request_destination(config, rng: random.Random,
+                             leader_fallback: Callable):
+    """Destination for a single ClientRequest:
+    ingest batchers > batchers > ``leader_fallback()``."""
+    if getattr(config, "num_ingest_batchers", 0) > 0:
+        return config.ingest_batcher_addresses[
+            rng.randrange(config.num_ingest_batchers)]
+    if getattr(config, "num_batchers", 0) > 0:
+        return config.batcher_addresses[
+            rng.randrange(config.num_batchers)]
+    return leader_fallback()
+
+
+def pick_array_destination(config, rng: random.Random,
+                           leader_fallback: Callable):
+    """Destination for a staged ClientRequestArray: ingest batchers >
+    ``leader_fallback()``. Arrays bypass plain batchers -- they are
+    already transport-level coalesced, and the batcher tier only
+    re-buckets singles."""
+    if getattr(config, "num_ingest_batchers", 0) > 0:
+        return config.ingest_batcher_addresses[
+            rng.randrange(config.num_ingest_batchers)]
+    return leader_fallback()

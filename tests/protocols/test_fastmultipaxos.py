@@ -11,7 +11,11 @@ from frankenpaxos_tpu.protocols.fastmultipaxos import (
 from frankenpaxos_tpu.roundsystem import RoundZeroFast
 from frankenpaxos_tpu.runtime import FakeLogger, LogLevel, SimTransport
 from frankenpaxos_tpu.statemachine import AppendLog
-def make_fmp(f=1, num_clients=2, seed=0):
+def make_fmp(f=1, num_clients=2, seed=0, quorum_backend="host"):
+    from frankenpaxos_tpu.protocols.fastmultipaxos import (
+        FastMultiPaxosLeaderOptions,
+    )
+
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     n = 2 * f + 1
@@ -25,8 +29,11 @@ def make_fmp(f=1, num_clients=2, seed=0):
         acceptor_heartbeat_addresses=tuple(
             f"ahb-{i}" for i in range(n)),
         round_system=RoundZeroFast(f + 1))
-    leaders = [FastMultiPaxosLeader(a, transport, logger, config,
-                                    AppendLog(), seed=seed + i)
+    leaders = [FastMultiPaxosLeader(
+                   a, transport, logger, config, AppendLog(),
+                   seed=seed + i,
+                   options=FastMultiPaxosLeaderOptions(
+                       quorum_backend=quorum_backend))
                for i, a in enumerate(config.leader_addresses)]
     acceptors = [FastMultiPaxosAcceptor(a, transport, logger, config)
                  for a in config.acceptor_addresses]

@@ -24,7 +24,7 @@ from frankenpaxos_tpu.statemachine import GetRequest, KeyValueStore, SetRequest
 SER = PickleSerializer()
 
 
-def make_bpaxos(f=1, num_clients=1, seed=0):
+def make_bpaxos(f=1, num_clients=1, seed=0, dep_backend="host"):
     logger = FakeLogger(LogLevel.FATAL)
     transport = SimTransport(logger)
     n = 2 * f + 1
@@ -35,7 +35,8 @@ def make_bpaxos(f=1, num_clients=1, seed=0):
         dep_service_node_addresses=tuple(f"dep-{i}" for i in range(n)),
         acceptor_addresses=tuple(f"acceptor-{i}" for i in range(n)),
         replica_addresses=tuple(f"replica-{i}" for i in range(f + 1)))
-    leaders = [BPaxosLeader(a, transport, logger, config, seed=seed + i)
+    leaders = [BPaxosLeader(a, transport, logger, config, seed=seed + i,
+                            dep_backend=dep_backend)
                for i, a in enumerate(config.leader_addresses)]
     proposers = [BPaxosProposer(a, transport, logger, config,
                                 seed=seed + 10 + i)
@@ -128,9 +129,12 @@ class BPaxosSimulated(SimulatedSystem):
 
     KEYS = ["a", "b"]
 
+    def __init__(self, dep_backend="host"):
+        self.dep_backend = dep_backend
+
     def new_system(self, seed):
         transport, config, replicas, clients = make_bpaxos(
-            num_clients=2, seed=seed)
+            num_clients=2, seed=seed, dep_backend=self.dep_backend)
         return dict(transport=transport, replicas=replicas,
                     clients=clients, counter=0)
 

@@ -170,6 +170,9 @@ def test_paxos_sim_catches_skipped_vote_adoption(monkeypatch):
 
 
 class FastPaxosSimulated(SingleDecreeSim):
+    def __init__(self, quorum_backend: str = "host"):
+        self.quorum_backend = quorum_backend
+
     def make_system(self, seed: int) -> dict:
         from frankenpaxos_tpu.protocols.fastpaxos import (
             FastPaxosAcceptor,
@@ -186,12 +189,14 @@ class FastPaxosSimulated(SingleDecreeSim):
             leader_addresses=tuple(f"leader-{i}" for i in range(f + 1)),
             acceptor_addresses=tuple(
                 f"acceptor-{i}" for i in range(2 * f + 1)))
-        leaders = [FastPaxosLeader(a, transport, logger, config)
+        leaders = [FastPaxosLeader(a, transport, logger, config,
+                                   quorum_backend=self.quorum_backend)
                    for a in config.leader_addresses]
         acceptors = [FastPaxosAcceptor(a, transport, logger, config)
                      for a in config.acceptor_addresses]
         clients = [FastPaxosClient(f"client-{i}", transport, logger,
-                                   config)
+                                   config,
+                                   quorum_backend=self.quorum_backend)
                    for i in range(self.num_clients)]
         return dict(transport=transport, leaders=leaders,
                     acceptors=acceptors, clients=clients)

@@ -85,7 +85,7 @@ from tests.protocols.test_vanillamencius import (
 class EPaxosF2Simulated(EPaxosSimulated):
     def new_system(self, seed):
         transport, config, replicas, clients = make_epaxos(
-            f=2, num_clients=2, seed=seed)
+            f=2, num_clients=2, seed=seed, dep_backend=self.dep_backend)
         return dict(transport=transport, replicas=replicas,
                     clients=clients, counter=0)
 
@@ -150,6 +150,13 @@ class FasterPaxosF2Simulated(FasterPaxosSimulated):
 class FastMultiPaxosF2Simulated(FastMultiPaxosSimulated):
     def make_system(self, seed):
         sim = make_fmp(f=2, seed=seed)
+        return dict(transport=sim[0], leaders=sim[2],
+                    acceptors=sim[3], clients=sim[4])
+
+
+class FMPTpuQuorumsSimulated(FastMultiPaxosSimulated):
+    def make_system(self, seed):
+        sim = make_fmp(f=1, seed=seed, quorum_backend="tpu")
         return dict(transport=sim[0], leaders=sim[2],
                     acceptors=sim[3], clients=sim[4])
 
@@ -245,6 +252,27 @@ CONFIGS: list[tuple] = [
     ("multipaxos/f1-coalesced-mixed",
      lambda: MultiPaxosSimulated(f=1, coalesced="mixed")),
 ]
+
+# paxruns chaos (runs/, docs/RUN_PIPELINE.md): the dependency-set and
+# quorum-spec device backends under randomized interleaving --
+# EPaxos/BPaxos unions through ops/depset kernels, Fast (Multi)Paxos
+# fast/classic/recovery quorums through runs/quorums.SpecChecker --
+# all under the same chosen-uniqueness / exactly-once oracles as the
+# host rows above.
+from tests.protocols.test_single_decree_sims import FastPaxosSimulated  # noqa: E402
+
+CONFIGS.extend([
+    ("depset-chaos/epaxos-f2-tpu-deps",
+     lambda: EPaxosF2Simulated(dep_backend="tpu")),
+    ("depset-chaos/simplebpaxos-f1-tpu-deps",
+     lambda: BPaxosSimulated(dep_backend="tpu")),
+    ("fastquorum-chaos/fastpaxos-f1",
+     lambda: FastPaxosSimulated()),
+    ("fastquorum-chaos/fastpaxos-f1-tpu-quorums",
+     lambda: FastPaxosSimulated(quorum_backend="tpu")),
+    ("fastquorum-chaos/fastmultipaxos-f1-tpu-quorums",
+     lambda: FMPTpuQuorumsSimulated()),
+])
 
 # The paxlog crash-restart chaos arms (wal/): randomized kill -9 +
 # restart-from-WAL of acceptors/replicas interleaved with drops,

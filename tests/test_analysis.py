@@ -1508,6 +1508,39 @@ def test_flowgraph_golden_multipaxos_mencius():
                     f"--write-flowgraphs")
 
 
+def test_flowgraph_topology_golden_epaxos_simplebpaxos():
+    """The paxruns port contract, mechanically checked: coalescing
+    PreAcceptOk/DependencyReply into DepRun frames must leave the
+    epaxos and simplebpaxos role x message topology EXACTLY as it was
+    (runs/wire.py codecs are transport_layer; receivers re-expand to
+    the original messages). A topology diff here means a run message
+    leaked into a protocol's role graph -- update tests/golden/ only
+    with a deliberate protocol change, never for a transport one."""
+    import json
+
+    from frankenpaxos_tpu.analysis import flowgraph
+
+    graphs = flowgraph.build_all(Project("."))
+    for unit in ("epaxos", "simplebpaxos"):
+        d = flowgraph.to_json(graphs[unit])
+        live = {
+            "protocol": unit,
+            "edges": sorted(
+                d["edges"],
+                key=lambda e: (e["message"], e["from"], e["to"],
+                               e["kind"])),
+            "roles": {role: {"handles": sorted(v["handles"]),
+                             "sends": sorted(v["sends"])}
+                      for role, v in d["roles"].items()},
+        }
+        with open(f"tests/golden/flow_topology_{unit}.json",
+                  encoding="utf-8") as f:
+            golden = json.load(f)
+        assert live == golden, (
+            f"{unit} role x message topology changed -- the run-layer "
+            f"port must be topology-neutral")
+
+
 # --- import_sort: the tooled import-order pass ------------------------------
 
 

@@ -1,0 +1,295 @@
+"""The run layer's dependency-column plane (runs/depruns.py +
+runs/wire.py).
+
+Property gates: every column transform is checked against the host
+``InstancePrefixSet`` dict oracle; the DepRun codecs (tags 208/209)
+keep corrupt frames on the ValueError containment channel; and the
+paxwire coalescers expand back to the exact original messages --
+coalescing may change frames and decode cost, never delivered
+semantics.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.compact import IntPrefixSet
+import frankenpaxos_tpu.protocols.epaxos  # noqa: F401 (codecs + runs/wire)
+from frankenpaxos_tpu.protocols.epaxos.instance_prefix_set import (
+    Instance,
+    InstancePrefixSet,
+)
+from frankenpaxos_tpu.protocols.epaxos.messages import PreAcceptOk
+import frankenpaxos_tpu.protocols.simplebpaxos  # noqa: F401
+from frankenpaxos_tpu.protocols.simplebpaxos.messages import (
+    DependencyReply,
+    VertexId,
+    VertexIdPrefixSet,
+)
+from frankenpaxos_tpu.runs import depruns
+from frankenpaxos_tpu.runs.wire import (
+    _coalesce_dependency_reply,
+    _coalesce_pre_accept_ok,
+    DepReplyRun,
+    DepReplyRunCodec,
+    PreAcceptOkRun,
+    PreAcceptOkRunCodec,
+)
+from frankenpaxos_tpu.runtime import paxwire
+from frankenpaxos_tpu.runtime.serializer import DEFAULT_SERIALIZER
+
+NUM_LEADERS = 3
+
+
+def random_set(rng: random.Random,
+               num_leaders: int = NUM_LEADERS) -> InstancePrefixSet:
+    columns = []
+    for _ in range(num_leaders):
+        watermark = rng.randrange(0, 50)
+        tail = {watermark + rng.randrange(0, 30)
+                for _ in range(rng.randrange(0, 5))}
+        columns.append(IntPrefixSet(watermark, tail))
+    return InstancePrefixSet(num_leaders, columns)
+
+
+def materialize(s: InstancePrefixSet) -> set:
+    """The dict-oracle view: the full set of (leader, id) members."""
+    out = set()
+    for leader, column in enumerate(s.columns):
+        for i in range(column.watermark):
+            out.add((leader, i))
+        for v in column.values:
+            out.add((leader, v))
+    return out
+
+
+class TestColumns:
+    def test_roundtrip_vs_oracle(self):
+        rng = random.Random(3)
+        for _ in range(20):
+            sets = [random_set(rng) for _ in range(rng.randrange(1, 9))]
+            columns = depruns.sets_to_columns(sets)
+            assert columns is not None
+            num_leaders, watermarks, counts, values = columns
+            assert num_leaders == NUM_LEADERS
+            rebuilt = []
+            for wm, ct, vals in depruns.split_columns(*columns):
+                cols = []
+                offset = 0
+                for watermark, count in zip(wm, ct):
+                    cols.append(IntPrefixSet(
+                        watermark, set(vals[offset:offset + count])))
+                    offset += count
+                rebuilt.append(InstancePrefixSet(num_leaders, cols))
+            assert [materialize(s) for s in rebuilt] == \
+                [materialize(s) for s in sets]
+
+    def test_ragged_columns_decline(self):
+        a = InstancePrefixSet(2)
+        b = InstancePrefixSet(3)
+        assert depruns.sets_to_columns([a, b]) is None
+        assert depruns.sets_to_columns([]) is None
+
+    def test_split_columns_rejects_ragged_input(self):
+        with pytest.raises(ValueError):
+            list(depruns.split_columns(2, (1, 2, 3), (0, 0, 0), ()))
+        with pytest.raises(ValueError):
+            list(depruns.split_columns(2, (1, 2), (1, 2), (5,)))
+
+    def test_columns_to_batch_matches_oracle(self):
+        rng = random.Random(11)
+        sets = [random_set(rng) for _ in range(6)]
+        columns = depruns.sets_to_columns(sets)
+        batch = depruns.columns_to_batch(*columns)
+        assert batch is not None
+        from frankenpaxos_tpu.protocols.epaxos import device_deps
+
+        for b, original in enumerate(sets):
+            row = device_deps.from_row(
+                np.asarray(batch.watermarks)[b],
+                np.asarray(batch.tails)[b], int(batch.tail_base))
+            assert materialize(row) == materialize(original)
+
+    def test_columns_to_batch_window_overflow_declines(self):
+        # The window bounds the sparse-id SPAN, not absolute ids: two
+        # tail values further apart than MAX_TAIL_WINDOW overflow.
+        wide = InstancePrefixSet(1, [IntPrefixSet(
+            0, {5, depruns.MAX_TAIL_WINDOW + 700})])
+        columns = depruns.sets_to_columns([wide])
+        assert depruns.columns_to_batch(*columns) is None
+        narrow = InstancePrefixSet(1, [IntPrefixSet(
+            0, {depruns.MAX_TAIL_WINDOW + 700})])
+        assert depruns.columns_to_batch(
+            *depruns.sets_to_columns([narrow])) is not None
+
+    def test_drain_union_matches_host_union(self):
+        rng = random.Random(29)
+        for _ in range(10):
+            sets = [random_set(rng) for _ in range(rng.randrange(1, 7))]
+            batch = depruns.columns_to_batch(
+                *depruns.sets_to_columns(sets))
+            watermarks, tails, tail_base = depruns.drain_union(batch)
+            from frankenpaxos_tpu.protocols.epaxos import device_deps
+
+            device = device_deps.from_row(
+                np.asarray(watermarks), np.asarray(tails),
+                int(tail_base))
+            host = InstancePrefixSet(NUM_LEADERS)
+            for s in sets:
+                host.add_all(s)
+            assert materialize(device) == materialize(host)
+
+
+def make_pre_accept_oks(rng: random.Random, count: int) -> list:
+    return [PreAcceptOk(instance=Instance(i % NUM_LEADERS, 100 + i),
+                        ballot=(1, i % NUM_LEADERS),
+                        replica_index=i % NUM_LEADERS,
+                        sequence_number=rng.randrange(0, 1 << 30),
+                        dependencies=random_set(rng))
+            for i in range(count)]
+
+
+def make_dependency_replies(rng: random.Random, count: int) -> list:
+    replies = []
+    for i in range(count):
+        deps = VertexIdPrefixSet(NUM_LEADERS)
+        deps.add_all(random_set(rng))
+        replies.append(DependencyReply(
+            vertex_id=VertexId(i % NUM_LEADERS, 50 + i),
+            dep_service_node_index=i % (2 * NUM_LEADERS),
+            dependencies=deps))
+    return replies
+
+
+class TestCoalescers:
+    def test_pre_accept_ok_roundtrip(self):
+        rng = random.Random(5)
+        messages = make_pre_accept_oks(rng, 7)
+        payloads = [DEFAULT_SERIALIZER.to_bytes(m) for m in messages]
+        merged = _coalesce_pre_accept_ok(payloads)
+        assert merged is not None
+        assert len(merged) < sum(len(p) for p in payloads)
+        run = DEFAULT_SERIALIZER.from_bytes(merged)
+        assert isinstance(run, PreAcceptOkRun)
+        expanded = list(run.__wire_expand__(DEFAULT_SERIALIZER))
+        assert expanded == messages  # send order preserved, bit-equal
+
+    def test_dependency_reply_roundtrip(self):
+        rng = random.Random(17)
+        messages = make_dependency_replies(rng, 5)
+        payloads = [DEFAULT_SERIALIZER.to_bytes(m) for m in messages]
+        merged = _coalesce_dependency_reply(payloads)
+        assert merged is not None
+        run = DEFAULT_SERIALIZER.from_bytes(merged)
+        assert isinstance(run, DepReplyRun)
+        assert list(run.__wire_expand__(DEFAULT_SERIALIZER)) == messages
+
+    def test_decline_on_foreign_tag_and_trailing_bytes(self):
+        rng = random.Random(23)
+        payloads = [DEFAULT_SERIALIZER.to_bytes(m)
+                    for m in make_pre_accept_oks(rng, 3)]
+        assert _coalesce_pre_accept_ok(payloads[:2]
+                                       + [b"\x07junk"]) is None
+        assert _coalesce_pre_accept_ok(payloads[:2]
+                                       + [payloads[2] + b"x"]) is None
+        assert _coalesce_pre_accept_ok([b""] + payloads[:2]) is None
+
+    def test_wide_span_coalesces_but_falls_back_to_host_sets(self):
+        """The window is a RECEIVER batch concern, not a wire one: a
+        drain whose sparse ids span past MAX_TAIL_WINDOW still
+        coalesces and expands exactly; only the device-batch
+        conversion declines (the receiver unions via host sets)."""
+        rng = random.Random(31)
+        messages = make_pre_accept_oks(rng, 2)
+        wide = InstancePrefixSet(NUM_LEADERS)
+        wide.add(Instance(0, depruns.MAX_TAIL_WINDOW * 3))
+        import dataclasses
+
+        messages[1] = dataclasses.replace(messages[1],
+                                          dependencies=wide)
+        payloads = [DEFAULT_SERIALIZER.to_bytes(m) for m in messages]
+        merged = _coalesce_pre_accept_ok(payloads)
+        assert merged is not None
+        run = DEFAULT_SERIALIZER.from_bytes(merged)
+        assert list(run.__wire_expand__(DEFAULT_SERIALIZER)) == messages
+        assert depruns.columns_to_batch(run.num_leaders, run.watermarks,
+                                        run.counts, run.values) is None
+
+    def test_plan_flush_coalesces_adjacent_ack_runs(self):
+        """End to end through paxwire: an adjacent run of tag-15
+        payloads on one connection flushes as ONE tag-208 frame, and
+        the decline path falls back to the generic batch frame."""
+        rng = random.Random(41)
+        payloads = [DEFAULT_SERIALIZER.to_bytes(m)
+                    for m in make_pre_accept_oks(rng, 4)]
+        header = b"h"
+        plan = paxwire.plan_flush([(header, p) for p in payloads])
+        assert plan.coalesced_acks == 4
+        assert plan.frames == 1
+        # segments = [frame prefix, merged payload]
+        run = DEFAULT_SERIALIZER.from_bytes(bytes(plan.segments[1]))
+        assert isinstance(run, PreAcceptOkRun)
+        assert len(run.headers) == 4
+
+
+class TestDepRunCodecHostileDecode:
+    def encode(self, codec, message) -> bytes:
+        out = bytearray((0, codec.tag - 128))
+        codec.encode(out, message)
+        return bytes(out)
+
+    def sample_run(self) -> PreAcceptOkRun:
+        return PreAcceptOkRun(
+            num_leaders=2, headers=((0, 4, 1, 0, 2, 7),),
+            watermarks=(1, 2), counts=(1, 0), values=(5,))
+
+    def test_negative_entry_count(self):
+        data = bytearray(self.encode(PreAcceptOkRunCodec(),
+                                     self.sample_run()))
+        data[2:6] = (-1).to_bytes(4, "little", signed=True)
+        with pytest.raises(ValueError):
+            DEFAULT_SERIALIZER.from_bytes(bytes(data))
+
+    def test_zero_leaders(self):
+        data = bytearray(self.encode(PreAcceptOkRunCodec(),
+                                     self.sample_run()))
+        data[6:10] = (0).to_bytes(4, "little")
+        with pytest.raises(ValueError):
+            DEFAULT_SERIALIZER.from_bytes(bytes(data))
+
+    def test_entry_count_exceeding_payload(self):
+        data = bytearray(self.encode(PreAcceptOkRunCodec(),
+                                     self.sample_run()))
+        data[2:6] = (1 << 20).to_bytes(4, "little")
+        with pytest.raises(ValueError):
+            DEFAULT_SERIALIZER.from_bytes(bytes(data))
+
+    def test_negative_tail_count(self):
+        run = self.sample_run()
+        bad = PreAcceptOkRun(num_leaders=run.num_leaders,
+                             headers=run.headers,
+                             watermarks=run.watermarks,
+                             counts=(-1, 2), values=(5,))
+        data = self.encode(PreAcceptOkRunCodec(), bad)
+        with pytest.raises(ValueError):
+            DEFAULT_SERIALIZER.from_bytes(data)
+
+    def test_values_exceeding_payload(self):
+        run = self.sample_run()
+        bad = PreAcceptOkRun(num_leaders=run.num_leaders,
+                             headers=run.headers,
+                             watermarks=run.watermarks,
+                             counts=(1 << 20, 0), values=(5,))
+        data = self.encode(PreAcceptOkRunCodec(), bad)
+        with pytest.raises(ValueError):
+            DEFAULT_SERIALIZER.from_bytes(data)
+
+    def test_truncated_bpaxos_run(self):
+        messages = make_dependency_replies(random.Random(3), 3)
+        payloads = [DEFAULT_SERIALIZER.to_bytes(m) for m in messages]
+        merged = _coalesce_dependency_reply(payloads)
+        with pytest.raises(ValueError):
+            DEFAULT_SERIALIZER.from_bytes(merged[:len(merged) - 6])
+        codec = DepReplyRunCodec()
+        assert codec.tag == 209

@@ -97,6 +97,129 @@ def test_all_identical_respects_sequence_numbers():
     assert device_deps.all_identical([], 3)
 
 
+def test_union_reduce_invariant_under_permuted_deps():
+    """Union is order-invariant: any permutation of the reply rows
+    reduces to the identical normalized batch."""
+    rng = random.Random(11)
+    for _ in range(10):
+        sets = [random_instance_set(rng, 3) for _ in range(5)]
+        base = device_deps.union_many(sets, 3)
+        for _ in range(4):
+            rng.shuffle(sets)
+            assert device_deps.union_many(sets, 3) == base
+
+
+def test_conflict_max_matches_host():
+    rng = random.Random(12)
+    for _ in range(15):
+        num = rng.randrange(2, 6)
+        sets = [random_instance_set(rng, 3) for _ in range(num)]
+        seqs = [rng.randrange(100) for _ in range(num)]
+        batch = device_deps.to_batch(sets, 3)
+        seq, reduced = depset.conflict_max(
+            np.asarray(seqs, dtype=np.int32), batch)
+        host = InstancePrefixSet(3)
+        for s in sets:
+            host.add_all(s)
+        got = device_deps.from_row(np.asarray(reduced.watermarks)[0],
+                                   np.asarray(reduced.tails)[0],
+                                   int(reduced.tail_base))
+        assert int(seq) == max(seqs)
+        assert got == host
+
+
+def test_intersect_matches_host_sparse_and_dense():
+    """Interference-closure intersection vs materialized-set oracle,
+    across sparse (few interferers) and dense (most ids interfere)
+    regimes."""
+    rng = random.Random(13)
+    for trial in range(30):
+        dense = trial % 2 == 1
+        max_id = 20 if dense else 60
+        a_sets = [random_instance_set(rng, 3, max_id) for _ in range(4)]
+        b_sets = [random_instance_set(rng, 3, max_id) for _ in range(4)]
+        # A shared tail base: pack both sides in ONE batch, then split.
+        both = device_deps.to_batch(a_sets + b_sets, 3)
+        a = depset.DepSetBatch(both.watermarks[:4], both.tails[:4],
+                               both.tail_base)
+        b = depset.DepSetBatch(both.watermarks[4:], both.tails[4:],
+                               both.tail_base)
+        out = depset.intersect_checked(a, b)
+        for row in range(4):
+            got = device_deps.from_row(np.asarray(out.watermarks)[row],
+                                       np.asarray(out.tails)[row],
+                                       int(out.tail_base))
+            expect = (a_sets[row].materialize()
+                      & b_sets[row].materialize())
+            assert got.materialize() == expect, (trial, row)
+
+
+def test_intersect_checked_rejects_mismatched_bases():
+    import pytest
+
+    a = device_deps.to_batch([random_instance_set(random.Random(0), 3)], 3)
+    b = depset.DepSetBatch(a.watermarks, a.tails, a.tail_base + 1)
+    with pytest.raises(ValueError):
+        depset.intersect_checked(a, b)
+
+
+def test_compact_matches_host_at_boundaries():
+    """Prefix-compaction against the executed watermark == oracle
+    add_all(from_watermarks(executed)), probed AT the representation
+    boundaries: below the tail base, exactly at a column watermark,
+    inside the tail window, and past the window end."""
+    rng = random.Random(14)
+    for trial in range(25):
+        sets = [random_instance_set(rng, 3) for _ in range(3)]
+        batch = device_deps.to_batch(sets, 3)
+        base = int(batch.tail_base)
+        width = batch.tails.shape[-1]
+        boundary_choices = [0, max(base - 1, 0), base, base + width // 2,
+                            base + width, base + width + 7]
+        executed = [rng.choice(boundary_choices
+                               + [int(np.asarray(batch.watermarks)[0, c])])
+                    for c in range(3)]
+        out = depset.compact(batch, np.asarray(executed, dtype=np.int32))
+        for row, instance_set in enumerate(sets):
+            host = instance_set.copy()
+            host.add_all(InstancePrefixSet.from_watermarks(executed))
+            got = device_deps.from_row(np.asarray(out.watermarks)[row],
+                                       np.asarray(out.tails)[row],
+                                       int(out.tail_base))
+            assert got == host, (trial, row, executed)
+
+
+def test_contains_index_plane_is_cached_and_int32():
+    """SHAPE602 fixture: the contains() row-index plane is hoisted to a
+    cached pow2 bucket (one device constant per bucket, not one arange
+    per call) with its dtype pinned to int32."""
+    depset._index_plane.cache_clear()
+    plane = depset._index_plane(8)
+    assert plane.dtype == np.int32
+    assert depset._index_plane(8) is plane
+    assert depset._pow2(1) == 1
+    assert depset._pow2(8) == 8
+    assert depset._pow2(9) == 16
+
+    rng = random.Random(15)
+    # Grow the batch past the pow2 pad: 8 rows shares the bucket-8
+    # plane, 9 rows jumps to the 16 bucket -- results stay oracle-exact
+    # across the boundary.
+    for num_rows in (7, 8, 9):
+        sets = [random_instance_set(rng, 3) for _ in range(num_rows)]
+        batch = depset.normalized(device_deps.to_batch(sets, 3))
+        leaders = np.asarray([rng.randrange(3) for _ in range(num_rows)],
+                             dtype=np.int32)
+        vids = np.asarray([rng.randrange(45) for _ in range(num_rows)],
+                          dtype=np.int32)
+        got = np.asarray(depset.contains(batch, leaders, vids))
+        for row, instance_set in enumerate(sets):
+            assert got[row] == instance_set.contains(
+                Instance(int(leaders[row]), int(vids[row])))
+    # 7 and 8 rows share the bucket-8 plane; 9 rows adds bucket 16.
+    assert depset._index_plane.cache_info().currsize == 2
+
+
 def test_contains_and_size_match_host():
     rng = random.Random(5)
     sets = [random_instance_set(rng, 3) for _ in range(8)]

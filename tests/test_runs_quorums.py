@@ -1,0 +1,144 @@
+"""Fast Flexible Paxos quorum specs (runs/quorums.py).
+
+The run layer expresses Fast Paxos' three per-configuration predicates
+(classic, fast, recovery) as plain ``QuorumSpec``s, so the unchanged
+fused checker evaluates them -- no new kernel family. These tests pin
+the spec math (the relaxed Fast Flexible intersection condition and
+the live-size recovery threshold) and gate the tpu backend
+bit-identical to the host oracle.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.runs.quorums import (
+    check_fast_flexible,
+    fast_flexible_specs,
+    SpecChecker,
+)
+
+
+def brute_threshold_oracle(present_row, threshold: int) -> bool:
+    return int(np.sum(present_row)) >= threshold
+
+
+class TestFastFlexibleSpecs:
+    @pytest.mark.parametrize("f", [1, 2, 3])
+    def test_classic_and_fast_sizes(self, f):
+        n = 2 * f + 1
+        q1 = f + 1
+        qf = f + ((f + 1) // 2 + 1)  # f + majority-of-quorum
+        specs = fast_flexible_specs(n, q1, qf)
+        assert specs.classic.universe == tuple(range(n))
+        assert int(specs.classic.thresholds[0]) == q1
+        assert int(specs.fast.thresholds[0]) == qf
+
+    @pytest.mark.parametrize("f", [1, 2, 3])
+    def test_recovery_threshold_is_fast_intersection(self, f):
+        """recovery = q1 + qf - n: how much of any fast quorum the
+        leader's classic quorum is guaranteed to see. For the
+        symmetric sizes this equals the reference's
+        majority-of-quorum rule (Leader.scala:168-185)."""
+        n = 2 * f + 1
+        q1 = f + 1
+        majority_of_quorum = (f + 1) // 2 + 1
+        qf = f + majority_of_quorum
+        specs = fast_flexible_specs(n, q1, qf)
+        assert int(specs.recovery.thresholds[0]) == q1 + qf - n
+        assert int(specs.recovery.thresholds[0]) == majority_of_quorum
+
+    def test_recovery_weakens_with_the_live_config(self):
+        """The mutation-sensitivity contract: a config whose fast
+        quorum is (unsafely) weakened to a classic majority must yield
+        a correspondingly weakened recovery rule -- NOT one silently
+        re-derived from f -- so safety sims can catch the violation
+        (tests/protocols/test_single_decree_sims.py)."""
+        n, q1 = 3, 2
+        weak = fast_flexible_specs(n, q1, q1)  # qf = q1: invalid
+        assert int(weak.recovery.thresholds[0]) == max(1, 2 * q1 - n)
+        # Two disjoint-enough vote sets can BOTH be popular now.
+        assert weak.recovery.check([0])
+        assert weak.recovery.check([1])
+
+    def test_universe_override_and_mismatch(self):
+        specs = fast_flexible_specs(3, 2, 3, universe=(7, 8, 9))
+        assert specs.classic.universe == (7, 8, 9)
+        assert specs.classic.check([7, 9])
+        assert not specs.classic.check([7])
+        with pytest.raises(ValueError):
+            fast_flexible_specs(3, 2, 3, universe=(7, 8))
+
+
+class TestCheckFastFlexible:
+    @pytest.mark.parametrize("f", [1, 2, 3, 5])
+    def test_reference_sizes_are_valid(self, f):
+        n = 2 * f + 1
+        q1 = f + 1
+        qf = f + ((f + 1) // 2 + 1)
+        assert check_fast_flexible(n, q1, qf) == []
+
+    def test_weak_fast_quorum_flagged(self):
+        violations = check_fast_flexible(3, 2, 2)
+        assert len(violations) == 1
+        assert "fast intersection" in violations[0]
+
+    def test_weak_classic_quorum_flagged(self):
+        violations = check_fast_flexible(5, 2, 5, classic_quorum_size2=2)
+        assert any("classic intersection" in v for v in violations)
+
+    def test_relaxed_flexible_sizes(self):
+        """Fast FLEXIBLE Paxos: a bigger phase-1 quorum buys a SMALLER
+        fast quorum (n = 5, q1 = 5 admits qf = 3 where the majority
+        read quorum q1 = 3 requires qf = 4) -- the relaxed condition
+        q1 + 2*qf > 2n at work, with the phase-2 classic quorum shrunk
+        independently via q1 + q2 > n."""
+        assert check_fast_flexible(5, 5, 3, classic_quorum_size2=1) == []
+        assert check_fast_flexible(5, 3, 3) != []
+
+
+class TestSpecChecker:
+    def test_backend_validation(self):
+        spec = fast_flexible_specs(3, 2, 3).classic
+        with pytest.raises(ValueError):
+            SpecChecker(spec, "gpu")
+
+    @pytest.mark.parametrize("backend", ["host", "tpu"])
+    def test_check_matches_threshold_oracle(self, backend):
+        specs = fast_flexible_specs(5, 3, 4)
+        for spec, threshold in ((specs.classic, 3), (specs.fast, 4),
+                                (specs.recovery, 2)):
+            checker = SpecChecker(spec, backend)
+            rng = random.Random(7)
+            for _ in range(40):
+                nodes = [i for i in range(5) if rng.random() < 0.5]
+                expected = len(nodes) >= threshold
+                assert checker.check(nodes) == expected, (
+                    backend, threshold, nodes)
+
+    def test_tpu_batch_bit_identical_to_host(self):
+        """Property gate: [B, N] random presence matrices evaluate
+        identically through the host oracle and the fused device
+        checker for every spec of every config size."""
+        rng = np.random.default_rng(13)
+        for f in (1, 2, 3):
+            n = 2 * f + 1
+            q1 = f + 1
+            qf = f + ((f + 1) // 2 + 1)
+            specs = fast_flexible_specs(n, q1, qf)
+            for spec in (specs.classic, specs.fast, specs.recovery):
+                host = SpecChecker(spec, "host")
+                tpu = SpecChecker(spec, "tpu")
+                present = (rng.random((64, n)) < 0.5).astype(np.uint8)
+                host_out = np.asarray(host.check_batch(present), bool)
+                tpu_out = np.asarray(tpu.check_batch(present), bool)
+                assert np.array_equal(host_out, tpu_out), (f, spec)
+
+    def test_check_accepts_dict_keys(self):
+        """Protocol call sites pass response dicts keyed by acceptor
+        id; iteration order must not matter."""
+        spec = fast_flexible_specs(3, 2, 3).classic
+        checker = SpecChecker(spec)
+        assert checker.check({2: "x", 0: "y"})
+        assert not checker.check({1: "x"})

@@ -1017,6 +1017,24 @@ def all_codec_samples() -> dict:
                 "executed_ids": {"watermark": 6, "values": [7]},
             }]}),
     ]
+    # paxruns dep-reply runs (runs/wire.py, tags 208-209): the
+    # drain-coalesced dependency columns for epaxos/simplebpaxos --
+    # transport-layer frames like Phase2bAckBatch, fuzzed like every
+    # role-sent message. Column layout: B=2 entries x L=2 leaders.
+    from frankenpaxos_tpu.runs.wire import DepReplyRun, PreAcceptOkRun
+
+    samples += [
+        PreAcceptOkRun(
+            num_leaders=2,
+            headers=((0, 4, 1, 0, 2, 7), (1, 9, 1, 0, 2, 3)),
+            watermarks=(1, 0, 2, 1), counts=(1, 0, 2, 0),
+            values=(3, 5, 6)),
+        DepReplyRun(
+            num_leaders=2,
+            headers=((0, 3, 1), (1, 5, 2)),
+            watermarks=(2, 1, 0, 0), counts=(0, 1, 1, 0),
+            values=(4, 2)),
+    ]
     by_tag: dict = {}
     for message in samples:
         data = DEFAULT_SERIALIZER.to_bytes(message)
