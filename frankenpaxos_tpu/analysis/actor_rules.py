@@ -32,6 +32,7 @@ from __future__ import annotations
 import ast
 
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -62,7 +63,7 @@ def _class_index(project: Project) -> dict:
     for the Actor hierarchy)."""
     out: dict = {}
     for mod in project:
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if isinstance(node, ast.ClassDef) and node.name not in out:
                 out[node.name] = (
                     mod, node, [dotted(b).split(".")[-1]
@@ -95,7 +96,7 @@ def _timer_callbacks(func: ast.AST) -> list:
     """Names of methods/functions passed as the callback to
     ``self.timer(name, delay, f)``."""
     out = []
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Call) and dotted(node.func) in (
                 "self.timer",):
             for arg in list(node.args) + [kw.value for kw in
@@ -121,7 +122,7 @@ def _handler_closure(cls: ast.ClassDef) -> dict:
         if name in closure or name not in methods:
             continue
         closure[name] = methods[name]
-        for node in ast.walk(methods[name]):
+        for node in cached_walk(methods[name]):
             if isinstance(node, ast.Call):
                 called = dotted(node.func)
                 if called.startswith("self.") and called.count(".") == 1:
@@ -141,10 +142,10 @@ def _thread_targets(cls: ast.ClassDef, methods: dict) -> list:
     self-call closure. Returns [(name, node)]."""
     roots: list = []
     nested: dict = {}
-    for node in ast.walk(cls):
+    for node in cached_walk(cls):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             nested[node.name] = node
-    for node in ast.walk(cls):
+    for node in cached_walk(cls):
         if not isinstance(node, ast.Call):
             continue
         name = dotted(node.func)
@@ -166,7 +167,7 @@ def _thread_targets(cls: ast.ClassDef, methods: dict) -> list:
             continue
         seen.add(name)
         out.append((name, nested[name]))
-        for node in ast.walk(nested[name]):
+        for node in cached_walk(nested[name]):
             if isinstance(node, ast.Call):
                 called = dotted(node.func)
                 if called.startswith("self.") and called.count(".") == 1:
@@ -202,7 +203,7 @@ def check(project: Project):
         handlers = _handler_closure(cls)
         for name, func in handlers.items():
             scope = f"{cls.name}.{name}"
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if not isinstance(node, (ast.Call, ast.Attribute,
                                          ast.Name)):
                     continue
@@ -237,7 +238,7 @@ def check(project: Project):
                              f"symbol {node.id}")
 
         # PAX104: class-wide (timers wired at construction count too).
-        for node in ast.walk(cls):
+        for node in cached_walk(cls):
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node)
@@ -259,7 +260,7 @@ def check(project: Project):
 
         # PAX106: sends from thread targets.
         for name, func in _thread_targets(cls, _methods(cls)):
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if isinstance(node, ast.Call):
                     d = dotted(node.func)
                     if (d.startswith("self.")
@@ -294,7 +295,7 @@ def check(project: Project):
             continue
         users: dict = {}
         for cls in classes:
-            for node in ast.walk(cls):
+            for node in cached_walk(cls):
                 if isinstance(node, ast.Name) and isinstance(
                         node.ctx, ast.Load) and node.id in mutables:
                     users.setdefault(node.id, set()).add(cls.name)

@@ -37,6 +37,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
     _handler_closure,
 )
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -82,7 +83,7 @@ def _mutable_fields(cls: ast.ClassDef) -> set:
     """Fields initialized to a mutable container anywhere in the class
     (``__init__``, recovery helpers, handlers)."""
     out: set = set()
-    for node in ast.walk(cls):
+    for node in cached_walk(cls):
         targets = []
         if isinstance(node, ast.Assign):
             targets = node.targets
@@ -110,7 +111,7 @@ def _mutated_fields(closure: dict) -> set:
     """Fields some handler-closure method mutates in place."""
     out: set = set()
     for func in closure.values():
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Attribute) \
                     and node.func.attr in _MUTATORS:
@@ -191,12 +192,12 @@ def _message_exprs(func: ast.AST):
     send-like call in ``func``: every arg past the destination, with
     local names resolved to the construction they alias."""
     local_ctors: dict = {}
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
                 and isinstance(node.value, ast.Call):
             local_ctors[node.targets[0].id] = node.value
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if not (isinstance(node, ast.Call)
                 and dotted(node.func).split(".")[-1] in _SEND_NAMES):
             continue
@@ -251,7 +252,7 @@ def _check_alias1001(mod, cls, closure, findings: list) -> None:
                             f"snapshot -- freeze it (tuple()/copy()) "
                             f"at the send"))
         # Sender helpers: the alias leaks at the CALL SITE.
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if not isinstance(node, ast.Call):
                 continue
             called = dotted(node.func)
@@ -301,7 +302,7 @@ def _tainted_params(cls: ast.ClassDef, closure: dict) -> dict:
         for name, func in closure.items():
             if not taint[name]:
                 continue
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if not isinstance(node, ast.Call):
                     continue
                 called = dotted(node.func)
@@ -356,7 +357,7 @@ def _check_alias1002(mod, cls, closure, findings: list) -> None:
                         f"recipient) observes it in sim but not over "
                         f"TCP -- copy before mutating"))
 
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             # Track locals aliasing message internals
             # (``deps = msg.deps`` then ``deps.add(...)``).
             if isinstance(node, ast.Assign) \
@@ -367,7 +368,7 @@ def _check_alias1002(mod, cls, closure, findings: list) -> None:
                 root = _root_name(node.value)
                 if root in tainted:
                     tainted.add(node.targets[0].id)
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, (ast.Assign, ast.AugAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
                     else [node.target]

@@ -17,6 +17,7 @@ import ast
 import dataclasses
 
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     import_aliases,
     is_sanitizer_call,
@@ -86,7 +87,7 @@ class CallGraph:
     def _index_module(self, mod: Module) -> None:
         self._aliases[mod.path] = import_aliases(mod.tree, mod.name)
         quals = qualname_index(mod.tree)
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
@@ -101,7 +102,7 @@ class CallGraph:
             if cls is not None and len(parts) == 2:
                 self.class_methods.setdefault(cls, {})[node.name] = \
                     info.ref
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if isinstance(node, ast.ClassDef):
                 self.bases[node.name] = [
                     dotted(b).split(".")[-1] for b in node.bases]
@@ -215,7 +216,7 @@ class CallGraph:
             params = set(_param_names(info.node))
             if not params:
                 continue
-            for call in ast.walk(info.node):
+            for call in cached_walk(info.node):
                 if not isinstance(call, ast.Call):
                     continue
                 passed = _passed_params(call, params)
@@ -244,7 +245,7 @@ class CallGraph:
         if not params:
             return set()
         escaped: set = set()
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if isinstance(node, ast.Assign):
                 if any(_is_self_store(t) for t in node.targets):
                     escaped |= _unsanitized_names(node.value, params)
@@ -261,7 +262,7 @@ class CallGraph:
                     and node is not info.node:
                 # Closure capture: a timer/resend callback holding the
                 # param alive past this dispatch.
-                for inner in ast.walk(node):
+                for inner in cached_walk(node):
                     if isinstance(inner, ast.Name) and \
                             inner.id in params:
                         escaped.add(inner.id)
@@ -280,7 +281,7 @@ class CallGraph:
                     continue
                 out[ref] = root
                 info = self.funcs[ref]
-                for node in ast.walk(info.node):
+                for node in cached_walk(info.node):
                     if isinstance(node, ast.Call):
                         for callee in self.resolve_call(info, node):
                             if callee not in out:

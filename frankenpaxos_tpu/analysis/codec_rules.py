@@ -25,6 +25,7 @@ import ast
 import os
 
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focus_touches,
@@ -80,9 +81,12 @@ def _codec_classes(project: Project) -> list:
     through the codec module's imports -- several protocols define
     same-named message classes (Phase2a, ClientReply), so a global
     name index would check codecs against the wrong dataclass."""
+    cached = getattr(project, "_codec_classes_cache", None)
+    if cached is not None:
+        return cached
     out = []
     for mod in project:
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
             assigns = {stmt.targets[0].id: stmt.value
@@ -98,14 +102,24 @@ def _codec_classes(project: Project) -> list:
             msg = dotted(assigns["message_type"])
             if msg:
                 out.append((mod, node, msg))
+    project._codec_classes_cache = out
     return out
+
+
+#: Per-module {class name: ClassDef} maps for :func:`_find_method`,
+#: keyed by tree identity (the core._ALIAS_CACHE pinning contract) --
+#: it runs per (codec class, method) and must not re-walk the module.
+_MODULE_CLASSES_CACHE: dict = {}
 
 
 def _find_method(mod, cls: ast.ClassDef, name: str):
     """``name`` method on ``cls`` or a same-module base (one level of
     the shared-layout pattern)."""
-    classes = {n.name: n for n in ast.walk(mod.tree)
-               if isinstance(n, ast.ClassDef)}
+    classes = _MODULE_CLASSES_CACHE.get(id(mod.tree))
+    if classes is None:
+        classes = _MODULE_CLASSES_CACHE[id(mod.tree)] = {
+            n.name: n for n in cached_walk(mod.tree)
+            if isinstance(n, ast.ClassDef)}
     seen: set = set()
     stack = [cls.name]
     while stack:
@@ -124,8 +138,23 @@ def _find_method(mod, cls: ast.ClassDef, name: str):
 def _class_in_module(project: Project, mod, name: str,
                      follow: int = 2) -> tuple | None:
     """A dataclass ``name`` defined in ``mod``, following re-exports
-    (``from x import name``) up to ``follow`` hops."""
-    for node in ast.walk(mod.tree):
+    (``from x import name``) up to ``follow`` hops. Memoized on the
+    project: the flow/codec global passes resolve the same
+    (module, name) pairs repeatedly and trees never change."""
+    cache = getattr(project, "_class_in_module_cache", None)
+    if cache is None:
+        cache = project._class_in_module_cache = {}
+    key = (mod.path, name, follow)
+    if key in cache:
+        return cache[key]
+    cache[key] = found = _class_in_module_uncached(project, mod, name,
+                                                   follow)
+    return found
+
+
+def _class_in_module_uncached(project: Project, mod, name: str,
+                              follow: int) -> tuple | None:
+    for node in cached_walk(mod.tree):
         if isinstance(node, ast.ClassDef) and node.name == name \
                 and _is_dataclass(node):
             return (mod, node)
@@ -178,7 +207,7 @@ def _module_funcs(mod) -> dict:
 
 
 def _attr_reads(func: ast.AST, param: str) -> set:
-    return {node.attr for node in ast.walk(func)
+    return {node.attr for node in cached_walk(func)
             if isinstance(node, ast.Attribute)
             and isinstance(node.value, ast.Name)
             and node.value.id == param}
@@ -197,7 +226,7 @@ def _encode_reads(mod, cls: ast.ClassDef) -> set | None:
     msg = args[1]  # encode(self, out, message)
     reads = _attr_reads(encode, msg)
     helpers = _module_funcs(mod)
-    for node in ast.walk(encode):
+    for node in cached_walk(encode):
         if not isinstance(node, ast.Call):
             continue
         helper = helpers.get(dotted(node.func))
@@ -224,12 +253,12 @@ def _decode_fields(mod, cls: ast.ClassDef, message: str,
         return None
     helpers = _module_funcs(mod)
     scopes = [decode] + [helpers[dotted(n.func)]
-                         for n in ast.walk(decode)
+                         for n in cached_walk(decode)
                          if isinstance(n, ast.Call)
                          and dotted(n.func) in helpers]
     for scope in scopes:
         sets = []
-        for node in ast.walk(scope):
+        for node in cached_walk(scope):
             if not isinstance(node, ast.Call):
                 continue
             name = dotted(node.func)
@@ -250,7 +279,7 @@ def _package_dataclasses(project: Project, pkg_dir: str) -> dict:
     out: dict = {}
     for mod in project:
         if os.path.dirname(mod.path) == pkg_dir:
-            for node in ast.walk(mod.tree):
+            for node in cached_walk(mod.tree):
                 if isinstance(node, ast.ClassDef) \
                         and _is_dataclass(node):
                     out.setdefault(node.name, (mod, node))
@@ -259,17 +288,31 @@ def _package_dataclasses(project: Project, pkg_dir: str) -> dict:
 
 def _sent_types(project: Project, pkg_dir: str, classes: dict) -> set:
     """Message class names that appear in send/broadcast calls within
-    the package (directly constructed, or via a one-hop local alias)."""
+    the package (directly constructed, or via a one-hop local alias).
+    Memoized per package dir on the project (one scan per protocol,
+    not one per rule that asks)."""
+    cache = getattr(project, "_codec_sent_types_cache", None)
+    if cache is None:
+        cache = project._codec_sent_types_cache = {}
+    if pkg_dir in cache:
+        return cache[pkg_dir]
+    cache[pkg_dir] = sent = _sent_types_uncached(project, pkg_dir,
+                                                 classes)
+    return sent
+
+
+def _sent_types_uncached(project: Project, pkg_dir: str,
+                         classes: dict) -> set:
     sent: set = set()
     for mod in project:
         if os.path.dirname(mod.path) != pkg_dir:
             continue
-        for func in ast.walk(mod.tree):
+        for func in cached_walk(mod.tree):
             if not isinstance(func, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
             local_types: dict = {}
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if isinstance(node, ast.Assign) \
                         and isinstance(node.value, ast.Call):
                     name = dotted(node.value.func).split(".")[-1]
@@ -277,7 +320,7 @@ def _sent_types(project: Project, pkg_dir: str, classes: dict) -> set:
                         for t in node.targets:
                             if isinstance(t, ast.Name):
                                 local_types[t.id] = name
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if not isinstance(node, ast.Call):
                     continue
                 if dotted(node.func).split(".")[-1] not in _SEND_NAMES:

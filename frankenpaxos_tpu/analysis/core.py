@@ -222,7 +222,7 @@ def _suppressed(project: Project, finding: Finding) -> bool:
 def _enclosing_defs(tree: ast.Module, lineno: int) -> list:
     """Every def/class whose span contains ``lineno``."""
     out = []
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             end = getattr(node, "end_lineno", node.lineno)
@@ -234,8 +234,19 @@ def _enclosing_defs(tree: ast.Module, lineno: int) -> list:
 # --- shared AST helpers (used by every rule family) -------------------------
 
 
+#: Memo for :func:`qualname_index`, keyed by tree identity (the same
+#: pinning contract and size bound as ``_ALIAS_CACHE``): several
+#: families index the same module trees and the visit must not repeat.
+_QUALNAME_CACHE: dict = {}
+
+
 def qualname_index(tree: ast.Module) -> dict:
     """id(def-node) -> dotted qualname ("Class.method", "func.inner")."""
+    hit = _QUALNAME_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    if len(_QUALNAME_CACHE) > 4096:
+        _QUALNAME_CACHE.clear()
     out: dict = {}
 
     def visit(node, prefix):
@@ -249,7 +260,31 @@ def qualname_index(tree: ast.Module) -> dict:
                 visit(child, prefix)
 
     visit(tree, "")
+    _QUALNAME_CACHE[id(tree)] = (tree, out)
     return out
+
+
+#: Memo for :func:`cached_walk` (same identity check and size bound as
+#: ``_ALIAS_CACHE``). Every rule family traverses the same module trees
+#: and function bodies, several of them more than once per run; the
+#: materialized walk order turns those repeat traversals into list
+#: iteration, which is where most of the diff-aware <10s budget comes
+#: from (docs/ANALYSIS.md).
+_WALK_CACHE: dict = {}
+
+
+def cached_walk(node: ast.AST) -> list:
+    """``list(ast.walk(node))``, memoized on node identity."""
+    hit = _WALK_CACHE.get(id(node))
+    if hit is not None and hit[0] is node:
+        return hit[1]
+    if len(_WALK_CACHE) > 16384:
+        # Bound the pinned-node set (throwaway Projects in long test
+        # runs), same rationale as _ALIAS_CACHE.
+        _WALK_CACHE.clear()
+    nodes = list(ast.walk(node))
+    _WALK_CACHE[id(node)] = (node, nodes)
+    return nodes
 
 
 def dotted(node: ast.AST) -> str:

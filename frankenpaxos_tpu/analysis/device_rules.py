@@ -32,6 +32,7 @@ import ast
 
 from frankenpaxos_tpu.analysis.callgraph import project_graph
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -69,7 +70,7 @@ def _is_jaxish(name: str, aliases: dict) -> bool:
 def _jax_locals(func: ast.AST, aliases: dict) -> set:
     """Locals assigned from a jax/jnp call (device values)."""
     out: set = set()
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Assign) and \
                 isinstance(node.value, ast.Call) and \
                 len(node.targets) == 1 and \
@@ -82,7 +83,7 @@ def _jax_locals(func: ast.AST, aliases: dict) -> set:
 def _loop_spans(func: ast.AST) -> list:
     """(start, end) line spans of for/while loop bodies in ``func``."""
     return [(n.lineno, getattr(n, "end_lineno", n.lineno))
-            for n in ast.walk(func)
+            for n in cached_walk(func)
             if isinstance(n, (ast.For, ast.AsyncFor, ast.While))]
 
 
@@ -109,7 +110,7 @@ def check(project: Project):
         aliases = import_aliases(mod.tree, mod.name)
         jax_locals = _jax_locals(info.node, aliases)
         loops = _loop_spans(info.node)
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node.func)
@@ -153,7 +154,7 @@ def check(project: Project):
             continue
         aliases = import_aliases(mod.tree, mod.name)
         quals = None
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node.func)
@@ -168,7 +169,7 @@ def check(project: Project):
             if quals is None:
                 quals = qualname_index(mod.tree)
             scope = "<module>"
-            for d_node in ast.walk(mod.tree):
+            for d_node in cached_walk(mod.tree):
                 if isinstance(d_node, (ast.FunctionDef,
                                        ast.AsyncFunctionDef)) and \
                         d_node.lineno <= node.lineno <= \

@@ -21,6 +21,8 @@ from __future__ import annotations
 import ast
 import subprocess
 
+from frankenpaxos_tpu.analysis.core import cached_walk
+
 
 def changed_paths(root: str, ref: str) -> list:
     """Repo-relative paths changed since ``ref`` (committed or not)."""
@@ -43,7 +45,7 @@ def _imported_project_modules(project, mod) -> set:
                 names.add(dotted)
             dotted = dotted.rpartition(".")[0]
 
-    for node in ast.walk(mod.tree):
+    for node in cached_walk(mod.tree):
         if isinstance(node, ast.Import):
             for alias in node.names:
                 note(alias.name)

@@ -30,6 +30,7 @@ import ast
 
 from frankenpaxos_tpu.analysis import flowgraph
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -69,7 +70,7 @@ def _is_durable(name: str, classes: dict, seen: set | None = None) -> bool:
 
 def _wal_appends(fn) -> list:
     """``self.wal.append(...)`` call nodes inside ``fn``."""
-    return [node for node in ast.walk(fn)
+    return [node for node in cached_walk(fn)
             if isinstance(node, ast.Call)
             and dotted(node.func).endswith("wal.append")]
 
@@ -97,7 +98,7 @@ def check(project: Project):
                  and (dotted(node.func).endswith("wal.append")
                       or dotted(node.func).split(".")[-1]
                       in _WAL_SURFACE))
-                for fn in methods.values() for node in ast.walk(fn))
+                for fn in methods.values() for node in cached_walk(fn))
 
             if uses_wal and not durable and cls.name != "DurableRole":
                 findings.append(Finding(
@@ -120,7 +121,7 @@ def check(project: Project):
                     isinstance(node, ast.Call)
                     and dotted(node.func).split(".")[-1] == "_wal_drain"
                     for m in closure
-                    for node in ast.walk(methods[m]))
+                    for node in cached_walk(methods[m]))
                 if not reaches:
                     findings.append(Finding(
                         rule="DUR503", file=mod.path,
@@ -137,7 +138,7 @@ def check(project: Project):
                 appends = _wal_appends(fn)
                 if not appends:
                     continue
-                for node in ast.walk(fn):
+                for node in cached_walk(fn):
                     if not isinstance(node, ast.Call):
                         continue
                     leaf = dotted(node.func).split(".")[-1]

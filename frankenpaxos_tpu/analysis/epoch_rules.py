@@ -27,6 +27,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
     _handler_closure,
 )
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -49,7 +50,7 @@ def _assigns_epoch_store(cls: ast.ClassDef) -> bool:
     for node in cls.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and node.name == "__init__":
-            for sub in ast.walk(node):
+            for sub in cached_walk(node):
                 targets = []
                 if isinstance(sub, ast.Assign):
                     targets = sub.targets
@@ -73,7 +74,7 @@ def check(project: Project):
             continue
         for name, func in _handler_closure(cls).items():
             scope = f"{cls.name}.{name}"
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if isinstance(node, ast.Attribute) \
                         and node.attr in _BYPASS_ATTRS:
                     d = dotted(node)

@@ -38,6 +38,7 @@ import ast
 
 from frankenpaxos_tpu.analysis import flowgraph
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     Finding,
     focus_touches,
     Project,
@@ -107,7 +108,7 @@ def _lane_type_names(project: Project) -> tuple:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
                 and node.targets[0].id == "CLIENT_LANE_TYPE_NAMES":
-            names = {c.value for c in ast.walk(node.value)
+            names = {c.value for c in cached_walk(node.value)
                      if isinstance(c, ast.Constant)
                      and isinstance(c.value, str)}
             return path, node.lineno, frozenset(names)
@@ -253,7 +254,7 @@ def check(project: Project):
         mod = project.modules.get(mod_path)
         line = 1
         if mod is not None:
-            for node in ast.walk(mod.tree):
+            for node in cached_walk(mod.tree):
                 if isinstance(node, ast.ClassDef) \
                         and node.name == mname:
                     line = node.lineno

@@ -50,6 +50,7 @@ import os
 
 from frankenpaxos_tpu.analysis import codec_rules
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     import_aliases,
     Module,
@@ -71,6 +72,10 @@ _NON_UNIT_STEMS = frozenset({"__init__", "driver_util", "baseline_wire"})
 
 #: Dataclass-name suffixes that are configuration, not wire messages.
 _NON_MESSAGE_SUFFIXES = ("Config", "Options")
+
+#: The role scans walk the same function bodies once per extraction
+#: pass; the shared memo turns repeat traversals into list iteration.
+_walk = cached_walk
 
 
 def _unwrap_replace(arg: ast.AST) -> ast.AST:
@@ -170,7 +175,7 @@ def _class_index(project: Project) -> dict:
         return cached
     out: dict = {}
     for mod in project:
-        for node in ast.walk(mod.tree):
+        for node in _walk(mod.tree):
             if isinstance(node, ast.ClassDef):
                 out.setdefault(node.name, []).append((mod, node))
     project._flow_class_index = out
@@ -228,7 +233,7 @@ class _Namespace:
         # name -> (Module, ClassDef) for unit-defined messages.
         self.local: dict = {}
         for mod in mods:
-            for node in ast.walk(mod.tree):
+            for node in _walk(mod.tree):
                 if isinstance(node, ast.ClassDef) \
                         and _is_message_class(node):
                     self.local.setdefault(node.name, (mod, node))
@@ -337,7 +342,7 @@ class _RoleScan:
     # -- plumbing --
     def _called_methods(self, fn) -> set:
         out = set()
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if isinstance(node, ast.Call):
                 d = dotted(node.func)
                 parts = d.split(".")
@@ -370,7 +375,7 @@ class _RoleScan:
             changed = False
             for name, fn in self.methods.items():
                 params = set(self._params(fn))
-                for node in ast.walk(fn):
+                for node in _walk(fn):
                     if not isinstance(node, ast.Call):
                         continue
                     d = dotted(node.func).split(".")
@@ -405,7 +410,7 @@ class _RoleScan:
         for name, fn in self.methods.items():
             params = set(self._params(fn))
             sent_locals: set = set()
-            for node in ast.walk(fn):
+            for node in _walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 if dotted(node.func).split(".")[-1] not in SEND_KINDS:
@@ -418,7 +423,7 @@ class _RoleScan:
                             and isinstance(arg.func, ast.Name) \
                             and arg.func.id in params:
                         out[name].add(arg.func.id)
-            for node in ast.walk(fn):
+            for node in _walk(fn):
                 if isinstance(node, ast.Assign) \
                         and isinstance(node.value, ast.Call) \
                         and isinstance(node.value.func, ast.Name) \
@@ -451,7 +456,7 @@ class _RoleScan:
             cur = stack.pop()
             fn = self.methods[cur]
             msg = out[cur]
-            for node in ast.walk(fn):
+            for node in _walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 d = dotted(node.func).split(".")
@@ -477,7 +482,7 @@ class _RoleScan:
         list/tuple literals ``(Klass, ..., self._f)``. A lambda value
         (``Phase2aAnyAck: lambda s, m: None`` -- an explicit ack sink)
         yields None: the message is handled, by the enclosing method."""
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if isinstance(node, ast.Dict):
                 for key, value in zip(node.keys, node.values):
                     k = dotted(key) if key is not None else ""
@@ -504,7 +509,7 @@ class _RoleScan:
     def _timer_callbacks(self) -> set:
         out: set = set()
         for fn in self.methods.values():
-            for node in ast.walk(fn):
+            for node in _walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 if dotted(node.func).split(".")[-1] != "timer":
@@ -563,7 +568,7 @@ class _RoleScan:
                     ann = dotted(a.annotation)
                     if ann:
                         note(ann, fn_name)
-            for node in ast.walk(fn):
+            for node in _walk(fn):
                 if isinstance(node, ast.Call) \
                         and dotted(node.func) == "isinstance" \
                         and len(node.args) == 2 \
@@ -618,7 +623,7 @@ class _RoleScan:
                                 site_origins(node), self.mod.path,
                                 node.lineno))
 
-            for node in ast.walk(fn):
+            for node in _walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 d = dotted(node.func).split(".")
@@ -646,10 +651,10 @@ class _RoleScan:
         transport timer callbacks inside ``fn`` -- the ubiquitous
         client idiom ``def resend(): self.send(...)`` +
         ``self.timer(..., resend)``."""
-        nested = {n.name: n for n in ast.walk(fn)
+        nested = {n.name: n for n in _walk(fn)
                   if isinstance(n, ast.FunctionDef) and n is not fn}
         spans: list = []
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             if dotted(node.func).split(".")[-1] != "timer":
@@ -688,7 +693,7 @@ class _RoleScan:
         """{local var: message name} for vars assigned a constructed
         message in this function."""
         out: dict = {}
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if isinstance(node, ast.Assign) \
                     and isinstance(node.value, ast.Call):
                 found = self.ns.resolve(self.mod,
@@ -710,7 +715,7 @@ class _RoleScan:
             found = self.ns.resolve(self.mod, dotted(a.annotation))
             if found is not None:
                 out.setdefault(a.arg, set()).add(found[1].name)
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if isinstance(node, ast.Call) \
                     and dotted(node.func) == "isinstance" \
                     and len(node.args) == 2 \
@@ -734,7 +739,7 @@ class _RoleScan:
         (``replies: list[ClientReply] = []``) or by what gets
         ``.append``-ed to it."""
         local_elems: dict = {}
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if isinstance(node, ast.AnnAssign) \
                     and isinstance(node.target, ast.Name):
                 for sub in ast.walk(node.annotation):
@@ -757,7 +762,7 @@ class _RoleScan:
                         local_elems.setdefault(
                             node.func.value.id, set()).add(
                             found[1].name)
-        for node in ast.walk(fn):
+        for node in _walk(fn):
             if not isinstance(node, ast.For) \
                     or not isinstance(node.target, ast.Name):
                 continue
@@ -789,7 +794,12 @@ class _RoleScan:
 
 
 def _codec_tags(project: Project) -> dict:
-    """{(defining module path, message name): tag} for every codec."""
+    """{(defining module path, message name): tag} for every codec.
+    Memoized on the project -- build_all and the FLOW4xx passes both
+    need it and the resolution walks every codec module."""
+    cached = getattr(project, "_flow_codec_tags", None)
+    if cached is not None:
+        return cached
     out: dict = {}
     for mod, cls, msg_dotted in codec_rules._codec_classes(project):
         entry = codec_rules._resolve_message_class(project, mod,
@@ -806,6 +816,7 @@ def _codec_tags(project: Project) -> dict:
                     and isinstance(stmt.value, ast.Constant):
                 tag = stmt.value.value
         out[(msg_mod.path, msg_cls.name)] = tag
+    project._flow_codec_tags = out
     return out
 
 
@@ -951,12 +962,12 @@ def global_sent_types(project: Project) -> dict:
     out: dict = {}
     for mod in project:
         ns = _module_namespace(project, mod)
-        for func in ast.walk(mod.tree):
+        for func in _walk(mod.tree):
             if not isinstance(func, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
             local_types: dict = {}
-            for node in ast.walk(func):
+            for node in _walk(func):
                 if isinstance(node, ast.Assign) \
                         and isinstance(node.value, ast.Call):
                     found = ns.resolve(mod, dotted(node.value.func))
@@ -964,7 +975,7 @@ def global_sent_types(project: Project) -> dict:
                         for t in node.targets:
                             if isinstance(t, ast.Name):
                                 local_types[t.id] = found
-            for node in ast.walk(func):
+            for node in _walk(func):
                 if not isinstance(node, ast.Call):
                     continue
                 if dotted(node.func).split(".")[-1] not in leaves:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import ast
 
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -58,7 +59,7 @@ def check(project: Project):
             continue
         if not focused(project, mod.path):
             continue
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
             callee = dotted(node.func)

@@ -40,6 +40,7 @@ import ast
 
 from frankenpaxos_tpu.analysis.callgraph import CallGraph, project_graph
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -106,14 +107,14 @@ def _roots(project: Project, graph: CallGraph) -> dict:
     # Run-pipeline handlers: calls guarded by isinstance checks against
     # the run-pipeline message types.
     for ref, info in list(graph.funcs.items()):
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if not isinstance(node, ast.If):
                 continue
             matched = _isinstance_messages(node.test)
             if not matched:
                 continue
             for sub in node.body:
-                for call in ast.walk(sub):
+                for call in cached_walk(sub):
                     if isinstance(call, ast.Call):
                         for callee in graph.resolve_call(info, call):
                             roots.setdefault(
@@ -125,7 +126,7 @@ def _roots(project: Project, graph: CallGraph) -> dict:
 def _isinstance_messages(test: ast.AST) -> set:
     """Run-pipeline message names matched by an isinstance() test."""
     out: set = set()
-    for node in ast.walk(test):
+    for node in cached_walk(test):
         if isinstance(node, ast.Call) and dotted(node.func) \
                 == "isinstance" and len(node.args) == 2:
             target = node.args[1]
@@ -217,7 +218,7 @@ def _traced_params(func: ast.AST, statics: tuple) -> set:
 
 
 def _root_names(expr: ast.AST) -> set:
-    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    return {n.id for n in cached_walk(expr) if isinstance(n, ast.Name)}
 
 
 # --- the checker ------------------------------------------------------------
@@ -246,7 +247,7 @@ def check(project: Project):
                if ref != root else f"a hot-path root ({via})")
         aliases = import_aliases(mod.tree, mod.name)
         async_locals = _async_locals(info.node)
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node.func)
@@ -288,7 +289,7 @@ def check(project: Project):
         root_name = graph.funcs[root].qualname
         how = (f"reachable from ops kernel {root_name}"
                if ref != root else "an ops kernel")
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node.func)
@@ -314,7 +315,7 @@ def check(project: Project):
             continue
         aliases = import_aliases(mod.tree, mod.name)
         quals = qualname_index(mod.tree)
-        for func in ast.walk(mod.tree):
+        for func in cached_walk(mod.tree):
             if not isinstance(func, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
@@ -372,7 +373,7 @@ def check(project: Project):
                         isinstance(sub, ast.Attribute)
                         and sub.attr == "shape"
                         and _root_names(sub) & traced
-                        for sub in ast.walk(it))
+                        for sub in cached_walk(it))
                     if shape_dep or (_root_names(it) & traced
                                      and isinstance(node, ast.For)):
                         flag("TPU207", mod, node, qual,
@@ -388,7 +389,7 @@ def check(project: Project):
         if not focused(project, mod.path):
             continue
         aliases = import_aliases(mod.tree, mod.name)
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if isinstance(node, ast.Call) and \
                     _is_jit_name(node.func, aliases):
                 for kw in node.keywords:
@@ -419,7 +420,7 @@ def _is_numpy(name: str, aliases: dict) -> bool:
 def _isinstance_test(test: ast.AST) -> bool:
     return any(isinstance(n, ast.Call)
                and dotted(n.func) == "isinstance"
-               for n in ast.walk(test))
+               for n in cached_walk(test))
 
 
 def _own_nodes(func: ast.AST):
@@ -438,7 +439,7 @@ def _async_locals(func: ast.AST) -> dict:
     """Local names bound from a ``*_async(...)`` call result:
     {name: dispatch call name}."""
     out: dict = {}
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         value = None
         targets = []
         if isinstance(node, ast.Assign):

@@ -23,6 +23,7 @@ import ast
 
 from frankenpaxos_tpu.analysis.actor_rules import _actor_classes, _methods
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -54,7 +55,7 @@ def _drain_closure(cls: ast.ClassDef) -> list:
         seen.add(name)
         func = methods[name]
         out.append(func)
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, ast.Call):
                 callee = dotted(node.func)
                 if callee.startswith("self."):
@@ -74,11 +75,11 @@ def _walk_same_scope(node: ast.AST):
 
 
 def _target_names(target: ast.AST) -> set:
-    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+    return {n.id for n in cached_walk(target) if isinstance(n, ast.Name)}
 
 
 def _expr_names(expr: ast.AST) -> set:
-    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    return {n.id for n in cached_walk(expr) if isinstance(n, ast.Name)}
 
 
 def check(project: Project):
@@ -87,7 +88,7 @@ def check(project: Project):
         if not focused(project, mod.path):
             continue
         for func in _drain_closure(cls):
-            for loop in ast.walk(func):
+            for loop in cached_walk(func):
                 if not isinstance(loop, ast.For):
                     continue
                 loop_names = _target_names(loop.target)

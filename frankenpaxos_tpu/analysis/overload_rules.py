@@ -34,6 +34,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
     _handler_closure,
 )
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -69,7 +70,7 @@ def _unbounded_buffer_attrs(cls: ast.ClassDef) -> dict:
         if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                 and node.name == "__init__"):
             continue
-        for sub in ast.walk(node):
+        for sub in cached_walk(node):
             if not isinstance(sub, ast.Assign):
                 continue
             for target in sub.targets:
@@ -94,7 +95,7 @@ def _unbounded_buffer_attrs(cls: ast.ClassDef) -> dict:
 def _class_has_bound_guard(cls: ast.ClassDef, attr: str) -> bool:
     """Any ``len(self.<attr>)`` read or ``inbox_full`` call in the
     class counts as a capacity guard."""
-    for node in ast.walk(cls):
+    for node in cached_walk(cls):
         if isinstance(node, ast.Call):
             callee = dotted(node.func)
             if callee.split(".")[-1] == "inbox_full":
@@ -130,7 +131,7 @@ def check(project: Project):
             continue
         flagged: set = set()
         for name, func in _handler_closure(cls).items():
-            for node in ast.walk(func):
+            for node in cached_walk(func):
                 if not (isinstance(node, ast.Call)
                         and isinstance(node.func, ast.Attribute)
                         and node.func.attr in _APPENDS):
@@ -163,7 +164,7 @@ def check(project: Project):
         # the same call, and sleeps in functions merely DEFINED inside
         # a loop run in another scope (_walk_same_scope stops there).
         seen_lines: set = set()
-        for loop in ast.walk(mod.tree):
+        for loop in cached_walk(mod.tree):
             if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
                 continue
             for node in _walk_same_scope(loop):

@@ -58,6 +58,7 @@ from frankenpaxos_tpu.analysis.callgraph import (
 from frankenpaxos_tpu.analysis.core import (
     buffer_locals,
     BUFFER_VIEW_CALLS,
+    cached_walk,
     call_name,
     dotted,
     Finding,
@@ -118,7 +119,7 @@ def _in_scope(path: str) -> bool:
 def _functions(mod) -> list:
     """Every (qualname, node) def in the module, outermost first."""
     quals = qualname_index(mod.tree)
-    return [(quals[id(n)], n) for n in ast.walk(mod.tree)
+    return [(quals[id(n)], n) for n in cached_walk(mod.tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
 
 
@@ -264,7 +265,7 @@ def _check_view_escapes(project, graph, escaping, mod, qual, func,
                                      f"(its '{t}' param is stored)")
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.Lambda)) and node is not func:
-            for inner in ast.walk(node):
+            for inner in cached_walk(node):
                 if isinstance(inner, ast.Name) and inner.id in names:
                     flag(inner, inner.id,
                          "is captured by a nested callback closure")
@@ -297,7 +298,7 @@ def _check_queued_mutation(mod, qual, func, findings) -> None:
         set(buffer_locals(func, _RAW_SEGMENT_SOURCES))
     queued: dict = {}  # name -> (send leaf, line)
     for stmt in stmts:
-        for node in ast.walk(stmt):
+        for node in cached_walk(stmt):
             if isinstance(node, ast.Call):
                 leaf = call_name(node).split(".")[-1]
                 if leaf in _SEND_NAMES:
@@ -335,7 +336,7 @@ def _check_segment_aliasing(mod, cls, findings) -> None:
     methods = _methods(cls)
     mutated_fields: set = set()
     for func in methods.values():
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, ast.Call) and \
                     isinstance(node.func, ast.Attribute) and \
                     node.func.attr in _QUEUE_MUTATORS and \
@@ -357,7 +358,7 @@ def _check_segment_aliasing(mod, cls, findings) -> None:
         if not segments:
             continue
         stores: dict = {}  # local -> [(field, node)]
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, ast.Assign):
                 for target in node.targets:
                     if _is_self_attr(target):
@@ -445,7 +446,7 @@ def _check_ctypes_exports(mod, qual, func, findings) -> None:
     for node in own_scope_walk(func):
         if isinstance(node, ast.Return) and \
                 isinstance(node.value, (ast.Call, ast.Tuple)):
-            for sub in ast.walk(node.value):
+            for sub in cached_walk(node.value):
                 if isinstance(sub, ast.Call):
                     leaf = call_name(sub).split(".")[-1]
                     if leaf in _EXPORT_LEAVES and not (
@@ -514,7 +515,7 @@ def _wire_sink_handlers(cls: ast.ClassDef) -> set:
     ``wire_sinks = {TAG: (parser, self._handle_x)}`` (or the handler
     directly as the value)."""
     out: set = set()
-    for node in ast.walk(cls):
+    for node in cached_walk(cls):
         target_ok = False
         if isinstance(node, ast.Assign):
             for t in node.targets:
@@ -566,7 +567,7 @@ def _check_sink_escapes(project, graph, escaping, mod, cls,
                         f"contract) -- copy (to_owned()/bytes()) "
                         f"before it outlives the dispatch"))
 
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, ast.Assign):
                 for target in node.targets:
                     if _is_self_attr(target):
@@ -596,7 +597,7 @@ def _check_sink_escapes(project, graph, escaping, mod, cls,
             elif isinstance(node, (ast.FunctionDef,
                                    ast.AsyncFunctionDef, ast.Lambda)) \
                     and node is not func:
-                for inner in ast.walk(node):
+                for inner in cached_walk(node):
                     if isinstance(inner, ast.Name) and \
                             inner.id in params:
                         flag(inner, inner.id,
@@ -620,7 +621,7 @@ def check(project: Project):
                                 func, findings)
             _check_queued_mutation(mod, qual, func, findings)
             _check_ctypes_exports(mod, qual, func, findings)
-        for node in ast.walk(mod.tree):
+        for node in cached_walk(mod.tree):
             if isinstance(node, ast.ClassDef):
                 _check_segment_aliasing(mod, node, findings)
                 _check_sink_escapes(project, graph, escaping, mod,

@@ -52,6 +52,7 @@ from frankenpaxos_tpu.analysis.actor_rules import (
     _handler_closure,
 )
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -178,7 +179,7 @@ def _unpacked(targets: list, value):
 def _mentions(tree: ast.AST, pred) -> bool:
     """Any Name/Attribute leaf in ``tree`` whose name satisfies
     ``pred``."""
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, ast.Attribute) and pred(node.attr):
             return True
         if isinstance(node, ast.Name) and pred(node.id):
@@ -190,7 +191,7 @@ def _has_guard_compare(func: ast.AST, pred) -> bool:
     """A Compare whose leaves mention a name satisfying ``pred`` --
     the shape of every monotonicity/write-once guard
     (``if msg.round < self.round``, ``while w in self.log``...)."""
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Compare):
             if _mentions(node, pred):
                 return True
@@ -198,7 +199,7 @@ def _has_guard_compare(func: ast.AST, pred) -> bool:
 
 
 def _reads_self_field(tree: ast.AST, field: str) -> bool:
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, ast.Attribute) and node.attr == field \
                 and isinstance(node.value, ast.Name) \
                 and node.value.id == "self" \
@@ -210,7 +211,7 @@ def _reads_self_field(tree: ast.AST, field: str) -> bool:
 def _calls_get_on(func: ast.AST, field: str) -> bool:
     """``self.<field>.get(...)`` / ``self.<field>[...].get(...)`` --
     the read-before-write shape of a write-once check."""
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \
                 and node.func.attr in ("get", "setdefault") \
@@ -233,7 +234,7 @@ def _closure_callers(closure: dict) -> dict:
     handler closure."""
     callers: dict = {name: set() for name in closure}
     for name, func in closure.items():
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if isinstance(node, ast.Call):
                 called = dotted(node.func)
                 if called.startswith("self.") and called.count(".") == 1:
@@ -268,14 +269,14 @@ def _phase1b_sends(func: ast.AST) -> list:
     assigned from) a ``*Phase1b*`` construction."""
     locals_p1b: set = set()
     out = []
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
                 and isinstance(node.value, ast.Call) \
                 and _is_phase1b_ctor(dotted(node.value.func)
                                      .split(".")[-1]):
             locals_p1b.add(node.targets[0].id)
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if not (isinstance(node, ast.Call)
                 and dotted(node.func).split(".")[-1] in _SEND_NAMES):
             continue
@@ -285,7 +286,7 @@ def _phase1b_sends(func: ast.AST) -> list:
                 break
             if any(isinstance(sub, ast.Call)
                    and _is_phase1b_ctor(dotted(sub.func).split(".")[-1])
-                   for sub in ast.walk(arg)):
+                   for sub in cached_walk(arg)):
                 out.append(node)
                 break
     return out
@@ -299,7 +300,7 @@ def _post_send_statements(func: ast.AST, send_call: ast.Call) -> list:
     sibling branch of the same ``if`` (line numbers alone would)."""
     # Parent map over the statement tree (cheap: one walk per call).
     parents: dict = {}
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         for child in ast.iter_child_nodes(node):
             parents[id(child)] = node
     # The statement containing the send.
@@ -350,7 +351,7 @@ def _local_env(func: ast.AST) -> dict:
     (all of them: provenance is merged conservatively toward
     cleanliness)."""
     env: dict = {}
-    for node in ast.walk(func):
+    for node in cached_walk(func):
         if isinstance(node, ast.Assign):
             for target in node.targets:
                 if isinstance(target, ast.Name):
@@ -388,7 +389,7 @@ def _slot_leaves(expr: ast.AST, func: ast.AST,
 
     def scan(node: ast.AST, expand: bool) -> None:
         nonlocal watermark, voted_max
-        for sub in ast.walk(node):
+        for sub in cached_walk(node):
             if isinstance(sub, ast.Attribute):
                 if _watermark_leaf(sub.attr):
                     watermark = True
@@ -461,7 +462,7 @@ def _check_next_slot(mod, cls, closure: dict) -> list:
     for name, func in closure.items():
         scope = f"{cls.name}.{name}"
         env = _local_env(func)
-        for node in ast.walk(func):
+        for node in cached_walk(func):
             if not isinstance(node, ast.Call):
                 continue
             called = dotted(node.func)
@@ -489,7 +490,7 @@ def _check_next_slot(mod, cls, closure: dict) -> list:
                         isinstance(cmp, ast.Compare)
                         and _mentions(cmp, _watermark_leaf)
                         and _mentions(cmp, lambda n, a=arg.id: n == a)
-                        for cmp in ast.walk(func)):
+                        for cmp in cached_walk(func)):
                     continue
                 fields = sorted(set(deferred[callee][pname]))
                 findings.append(Finding(

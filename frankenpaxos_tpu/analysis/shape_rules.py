@@ -33,6 +33,7 @@ from __future__ import annotations
 import ast
 
 from frankenpaxos_tpu.analysis.core import (
+    cached_walk,
     dotted,
     Finding,
     focused,
@@ -88,7 +89,7 @@ def _jitted_functions(mod, aliases: dict):
     module-level ``wrapped = jax.jit(local_fn, ...)`` targets."""
     quals = qualname_index(mod.tree)
     by_name: dict = {}
-    for node in ast.walk(mod.tree):
+    for node in cached_walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             by_name.setdefault(node.name, node)
             if _jit_info(node, aliases) is not None:
@@ -110,19 +111,19 @@ def _declared_axes(mod, aliases: dict) -> set:
     ``*_axis`` parameter defaults and keyword bindings, and strings in
     module-level ``*PARTITION*``/``*AXES*`` constants."""
     out: set = set()
-    for node in ast.walk(mod.tree):
+    for node in cached_walk(mod.tree):
         if isinstance(node, ast.Call):
             leaf = dotted(node.func).split(".")[-1]
             if leaf in ("Mesh", "make_mesh"):
                 for arg in list(node.args) + [
                         kw.value for kw in node.keywords]:
-                    out.update(c.value for c in ast.walk(arg)
+                    out.update(c.value for c in cached_walk(arg)
                                if isinstance(c, ast.Constant)
                                and isinstance(c.value, str))
             for kw in node.keywords:
                 if kw.arg and (kw.arg == "axis_names"
                                or kw.arg.endswith("_axis")):
-                    out.update(c.value for c in ast.walk(kw.value)
+                    out.update(c.value for c in cached_walk(kw.value)
                                if isinstance(c, ast.Constant)
                                and isinstance(c.value, str))
         elif isinstance(node, ast.Subscript) \
@@ -150,7 +151,7 @@ def _declared_axes(mod, aliases: dict) -> set:
                 and isinstance(node.targets[0], ast.Name) \
                 and any(k in node.targets[0].id.upper()
                         for k in ("PARTITION", "AXES", "AXIS")):
-            out.update(c.value for c in ast.walk(node.value)
+            out.update(c.value for c in cached_walk(node.value)
                        if isinstance(c, ast.Constant)
                        and isinstance(c.value, str))
     return out
@@ -160,20 +161,20 @@ def _used_axes(mod) -> list:
     """(axis name, lineno, context) literals this module consumes:
     collectives' ``axis_name=`` and PartitionSpec positional args."""
     out: list = []
-    for node in ast.walk(mod.tree):
+    for node in cached_walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
         leaf = dotted(node.func).split(".")[-1]
         if leaf in _COLLECTIVES:
             for kw in node.keywords:
                 if kw.arg == "axis_name":
-                    for c in ast.walk(kw.value):
+                    for c in cached_walk(kw.value):
                         if isinstance(c, ast.Constant) \
                                 and isinstance(c.value, str):
                             out.append((c.value, node.lineno, leaf))
         elif leaf in _PSPEC_NAMES:
             for arg in node.args:
-                for c in ast.walk(arg):
+                for c in cached_walk(arg):
                     if isinstance(c, ast.Constant) \
                             and isinstance(c.value, str):
                         out.append((c.value, node.lineno, leaf))

@@ -43,13 +43,11 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
     encode_value_array,
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runs import (
-    log_chosen_values,
+from frankenpaxos_tpu.runs.client import RetryAdmissionMixin, StagedWriteMixin
+from frankenpaxos_tpu.runs.records import log_chosen_values, wal_log_chosen_run
+from frankenpaxos_tpu.runs.routing import (
     pick_array_destination,
     pick_request_destination,
-    RetryAdmissionMixin,
-    StagedWriteMixin,
-    wal_log_chosen_run,
 )
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport

@@ -41,11 +41,10 @@ from frankenpaxos_tpu.protocols.multipaxos.messages import (
     SequentialReadRequest,
 )
 from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
-from frankenpaxos_tpu.runs import (
+from frankenpaxos_tpu.runs.client import RetryAdmissionMixin, StagedWriteMixin
+from frankenpaxos_tpu.runs.routing import (
     pick_array_destination,
     pick_request_destination,
-    RetryAdmissionMixin,
-    StagedWriteMixin,
 )
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport

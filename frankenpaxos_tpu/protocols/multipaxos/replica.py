@@ -48,7 +48,7 @@ from frankenpaxos_tpu.protocols.multipaxos.wire import (
     decode_value_array,
     encode_value_array,
 )
-from frankenpaxos_tpu.runs import log_chosen_values, wal_log_chosen_run
+from frankenpaxos_tpu.runs.records import log_chosen_values, wal_log_chosen_run
 from frankenpaxos_tpu.runtime import Actor, Collectors, FakeCollectors, Logger
 from frankenpaxos_tpu.runtime.transport import Address, Transport
 from frankenpaxos_tpu.statemachine import StateMachine

@@ -21,11 +21,9 @@ Modules:
     for the fast-path protocols;
   * :mod:`.wire` -- fixed-layout codecs + paxwire coalescers for the
     run messages.
-"""
 
-from frankenpaxos_tpu.runs.client import RetryAdmissionMixin, StagedWriteMixin  # noqa: F401
-from frankenpaxos_tpu.runs.records import log_chosen_values, wal_log_chosen_run  # noqa: F401
-from frankenpaxos_tpu.runs.routing import (  # noqa: F401
-    pick_array_destination,
-    pick_request_destination,
-)
+Import from the submodules directly -- this ``__init__`` deliberately
+re-exports nothing, so a change to one runs/ module keeps a narrow
+reverse-import closure (the diff-aware paxlint <10s budget,
+docs/ANALYSIS.md).
+"""
