@@ -175,6 +175,7 @@ def _ensure_loaded() -> None:
         geo_rules,
         hotpath_rules,
         net_rules,
+        obs_rules,
         overload_rules,
         ownership_rules,
         safety_rules,

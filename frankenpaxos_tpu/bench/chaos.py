@@ -24,6 +24,7 @@ kill-then-repair events (bench/sweeps.py).
 from __future__ import annotations
 
 import copy
+import json
 import os
 import signal
 import sys
@@ -36,11 +37,15 @@ def sigkill_role(bench: BenchmarkDirectory, label: str) -> None:
     """``kill -9`` the role process for ``label`` and reap it. When the
     deployment ran with ``trace_dir`` (paxtrace), the killed role's
     flight-recorder ring is snapshotted to the post-mortem JSON
-    immediately -- BEFORE any relaunch can reuse the ring file."""
+    immediately -- BEFORE any relaunch can reuse the ring file. When a
+    paxpulse :class:`~frankenpaxos_tpu.obs.telemetry.TelemetryReporter`
+    is registered for the label (``bench.telemetry_reporters``), its
+    last device-counter summary is snapshotted beside the ring."""
     proc = bench.labeled_procs[label]
     os.kill(proc.pid(), signal.SIGKILL)
     proc.wait(timeout=10)
     collect_flight_record(bench, label)
+    collect_telemetry_snapshot(bench, label)
 
 
 def collect_flight_record(bench: BenchmarkDirectory,
@@ -63,6 +68,33 @@ def collect_flight_record(bench: BenchmarkDirectory,
         out = bench.abspath(f"{label}.flight.json.killed{n}")
         n += 1
     FlightRecorder.dump_file(ring, out)
+    return out
+
+
+def collect_telemetry_snapshot(bench: BenchmarkDirectory,
+                               label: str) -> "str | None":
+    """Dump the last paxpulse device-counter summary for ``label`` to
+    ``<bench>/<label>.telemetry.json`` -- the device-plane half of the
+    SIGKILL post-mortem (the flight ring is the host half).
+
+    Harnesses that drive a device pipeline beside the deployed roles
+    register the reporter in ``bench.telemetry_reporters[label]``; the
+    host-side reporter holds the last ``collect()`` snapshot, so the
+    post-mortem shows the pipeline's committed/occupancy/lag counters
+    as of the last reporting interval before the kill. Numbered like
+    the flight dumps so repeated kills keep every post-mortem.
+    Returns the dump path, or None when no reporter is registered."""
+    reporter = getattr(bench, "telemetry_reporters", {}).get(label)
+    if reporter is None:
+        return None
+    out = bench.abspath(f"{label}.telemetry.json")
+    n = 1
+    while os.path.exists(out):
+        out = bench.abspath(f"{label}.telemetry.json.killed{n}")
+        n += 1
+    with open(out, "w") as f:
+        json.dump(reporter.summary(), f, indent=2, sort_keys=True)
+        f.write("\n")
     return out
 
 

@@ -104,6 +104,11 @@ class BenchmarkDirectory:
         # role can be relaunched verbatim (readiness retry, chaos
         # driver).
         self.role_commands: dict[str, tuple] = {}
+        # label -> obs.telemetry.TelemetryReporter, registered by
+        # harnesses that drive a device pipeline beside the roles;
+        # chaos SIGKILL post-mortems snapshot each reporter's last
+        # device-counter summary next to the flight ring.
+        self.telemetry_reporters: dict = {}
 
     def abspath(self, name: str) -> str:
         return os.path.join(self.path, name)

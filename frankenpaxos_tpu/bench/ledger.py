@@ -1,0 +1,512 @@
+"""Committed perf-trajectory ledger over the ``bench_results/`` artifacts.
+
+Every latency/throughput bench in this repo (``*_lt.py``) writes a JSON
+artifact whose *gate medians* -- the paired-A/B ratios and bounded
+overheads the bench's own pass/fail logic keys on -- are the numbers we
+actually defend PR over PR. This module lifts those headlines into one
+append-only ledger, ``bench_results/LEDGER.json``, so the performance
+trajectory is a committed, reviewable object rather than something
+reconstructed from git archaeology:
+
+  * ``--update`` extracts every known artifact's headline rows (value +
+    kind + direction + explicit tolerance band + methodology tag) and
+    appends a history entry per row when the artifact changed. Rows are
+    keyed (bench, metric); history is never rewritten.
+  * ``--check`` re-extracts the same headlines from FRESH artifacts (a
+    reduced/smoke re-run, typically in CI or pre-commit) and compares
+    them against the last committed trajectory point within the row's
+    tolerance band. Exit 1 on any out-of-band regression.
+
+Comparison discipline -- the part that keeps the check honest:
+
+  * Tolerances are explicit per row and wide enough for shared-host
+    noise (the ``*_lt`` methodology notes record 15-30% variance for
+    absolute numbers; ratio headlines are steadier, which is why they
+    are the headlines). A smoke-vs-full mismatch WIDENS the band by
+    ``SMOKE_EXTRA_REL`` instead of silently comparing unlike runs.
+  * Environment labels (``host_mesh``, ``degraded``, ``mode``,
+    ``mesh_shape``) gate comparability: a row recorded on a forced host
+    mesh or a degraded run is never compared against a hardware row --
+    the check reports a labeled SKIP, not a pass.
+  * A methodology drift (the bench changed how it measures) is a
+    labeled SKIP too: the committed point is stale by construction and
+    the fix is ``--update``, not a tolerance fudge.
+  * ``info`` rows (host-variance-dominated absolutes like protocol_lt
+    throughputs, crossover widths) ride the trajectory for plotting but
+    are never gated.
+
+CLI::
+
+  python -m frankenpaxos_tpu.bench.ledger --update [--tag pr19]
+  python -m frankenpaxos_tpu.bench.ledger --check --fresh /tmp/fresh
+
+CI wiring: the ``perf-ledger`` job re-runs the smoke-capable benches
+into a scratch dir and runs ``--check`` against the committed ledger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from typing import Any, Optional
+
+LEDGER_VERSION = 1
+DEFAULT_RESULTS_DIR = "bench_results"
+DEFAULT_LEDGER = os.path.join(DEFAULT_RESULTS_DIR, "LEDGER.json")
+
+# Labels that must match exactly for two rows to be comparable. A row
+# measured on a forced host mesh (multichip_lt without real devices) or
+# in degraded mode is a different experiment from its hardware twin.
+COMPARABILITY_LABELS = ("host_mesh", "degraded", "mode", "mesh_shape")
+
+# Extra relative slack added when one side of a comparison is a smoke
+# run and the other is not (reduced reps => noisier medians).
+SMOKE_EXTRA_REL = 0.25
+
+
+# --------------------------------------------------------------------------
+# Headline declarations
+# --------------------------------------------------------------------------
+#
+# Each entry: (dotted path, kind, direction, tolerance).
+#   * path       -- dotted into the artifact; one ``*`` segment expands
+#                   to every key at that level (sorted), yielding one
+#                   row per key (e.g. per in-flight width).
+#   * kind       -- ratio | throughput | latency | pct | bool | count
+#   * direction  -- "higher" (regression = fresh below band),
+#                   "lower" (regression = fresh above band),
+#                   "bool" (regression = committed True, fresh False),
+#                   "info" (recorded, never gated).
+#   * tolerance  -- {"rel": r} relative band, {"abs": a} absolute band
+#                   (same unit as the value; used for pct/latency rows
+#                   where relative bands misbehave near zero), or None
+#                   for bool/info rows.
+
+HEADLINES: dict[str, list[tuple[str, str, str, Optional[dict]]]] = {
+    "depset_lt": [
+        ("gates.throughput_ratio_at_ge_1024.*", "ratio", "higher", {"rel": 0.35}),
+        ("gates.oracle_bit_identical", "bool", "bool", None),
+        ("gates.gate_passed", "bool", "bool", None),
+    ],
+    "transport_lt": [
+        ("gates.throughput_ratio_at_ge_256.*", "ratio", "higher", {"rel": 0.35}),
+        ("gates.syscall_reduction_at_1024", "ratio", "higher", {"rel": 0.25}),
+        ("gates.gate_passed", "bool", "bool", None),
+    ],
+    "ingest_lt": [
+        ("gates.throughput_ratio_at_ge_1024.*", "ratio", "higher", {"rel": 0.35}),
+        ("gates.overhead_pct", "pct", "lower", {"abs": 2.0}),
+        ("gates.gate_passed", "bool", "bool", None),
+    ],
+    "multipaxos_lt": [
+        ("sim_ab_pipeline.*.tpu_over_dict_ratio", "ratio", "higher", {"rel": 0.35}),
+        ("sim_ab_pipeline.*.run_over_dict_ratio", "ratio", "higher", {"rel": 0.35}),
+        ("crossover_inflight", "count", "info", None),
+        ("tracker_crossover_width", "count", "info", None),
+    ],
+    "mencius_lt": [
+        ("sim_ab_pipeline.*.coalesced_over_per_message_ratio", "ratio",
+         "higher", {"rel": 0.35}),
+        ("crossover_inflight", "count", "info", None),
+    ],
+    "wal_lt": [
+        ("sim_ab_pipeline.*.wal_on_over_off_ratio", "ratio", "higher",
+         {"rel": 0.35}),
+    ],
+    "reconfig_lt": [
+        ("sim_ab_pipeline.*.tagged_over_plain_ratio", "ratio", "higher",
+         {"rel": 0.35}),
+        ("sim_handover.handover_wall_s_median", "latency", "lower",
+         {"rel": 0.5}),
+        ("deployed_handover.steady_latency_median_s", "latency", "info", None),
+        ("deployed_handover.handover_spike_latency_s", "latency", "info", None),
+    ],
+    "overload_lt": [
+        ("gate.peak_1x_goodput", "throughput", "higher", {"rel": 0.4}),
+        ("gate.p99_1x_s", "latency", "lower", {"rel": 0.5}),
+        ("admission_overhead.off_overhead_pct_worst_width", "pct", "lower",
+         {"abs": 2.0}),
+        ("gate.gate_passed", "bool", "bool", None),
+        ("admission_overhead.gate_passed", "bool", "bool", None),
+    ],
+    "geo_lt": [
+        ("gates.home_p50_below_quarter_wan_rtt.value", "latency", "lower",
+         {"rel": 0.5}),
+        ("gates.steal_latency_within_3_wan_rtt.value", "latency", "lower",
+         {"rel": 0.5}),
+        ("gates.flat_vs_multipaxos_at_noise_floor.value", "ratio", "higher",
+         {"rel": 0.25}),
+        ("gates.flat_geo_layer_overhead_bounded.value", "ratio", "higher",
+         {"rel": 0.25}),
+        ("hot_objects.speedup_p50", "ratio", "info", None),
+        ("gates.all_passed", "bool", "bool", None),
+    ],
+    "global_lt": [
+        ("scenario_overhead.ratio_wave_over_legacy_median", "ratio", "lower",
+         {"rel": 0.1}),
+        ("scenario_overhead.overhead_pct", "pct", "lower", {"abs": 3.0}),
+        ("matrix.gate_passed", "bool", "bool", None),
+        ("gate_passed", "bool", "bool", None),
+    ],
+    "multichip_lt": [
+        ("arms.window_1m.speedup", "ratio", "higher", {"rel": 0.35}),
+        ("arms.window_8m.speedup", "ratio", "higher", {"rel": 0.35}),
+        ("per_shard_latency.worst_shard_p50_us", "latency", "lower",
+         {"rel": 0.5}),
+        ("gates_pass", "bool", "bool", None),
+    ],
+    "protocol_lt": [
+        # Host-variance-dominated absolutes (see the artifact's note):
+        # trajectory only, never gated.
+        ("protocols.*.throughput_p90_1s", "throughput", "info", None),
+        ("protocols.*.latency_median_ms", "latency", "info", None),
+    ],
+    "trace_overhead": [
+        ("off_overhead_pct_worst_width", "pct", "lower", {"abs": 2.0}),
+        ("gate_passed", "bool", "bool", None),
+    ],
+    "telemetry_overhead": [
+        ("off_overhead_pct_worst_width", "pct", "lower", {"abs": 2.0}),
+        ("on_overhead_pct_worst_width", "pct", "info", None),
+        ("gate_passed", "bool", "bool", None),
+    ],
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Row:
+    """One extracted headline (pre-history)."""
+
+    bench: str
+    metric: str
+    kind: str
+    direction: str
+    tolerance: Optional[dict]
+    labels: dict
+    methodology_sha: str
+    value: Any
+
+
+def _resolve(artifact: dict, path: str) -> list[tuple[str, Any]]:
+    """Dotted path -> [(concrete_path, value)]; ``*`` expands dict keys."""
+    parts = path.split(".")
+    results: list[tuple[list[str], Any]] = [([], artifact)]
+    for part in parts:
+        nxt: list[tuple[list[str], Any]] = []
+        for prefix, node in results:
+            if not isinstance(node, dict):
+                continue
+            if part == "*":
+                for key in sorted(node, key=str):
+                    nxt.append((prefix + [key], node[key]))
+            elif part in node:
+                nxt.append((prefix + [part], node[part]))
+        results = nxt
+    out = []
+    for prefix, value in results:
+        if isinstance(value, (int, float, bool)) and not isinstance(
+                value, complex):
+            out.append((".".join(prefix), value))
+    return out
+
+
+def _methodology_sha(artifact: dict) -> str:
+    text = artifact.get("methodology") or artifact.get("sim_ab_methodology")
+    if not text:
+        return "none"
+    return hashlib.sha256(str(text).encode()).hexdigest()[:10]
+
+
+def _labels(artifact: dict) -> dict:
+    labels = {}
+    for key in ("host_mesh", "degraded", "mode", "smoke"):
+        if key in artifact:
+            labels[key] = artifact[key]
+    shape = artifact.get("mesh_shape")
+    if isinstance(shape, dict):
+        labels["mesh_shape"] = "x".join(
+            str(shape[k]) for k in sorted(shape))
+    return labels
+
+
+def extract_rows(bench: str, artifact: dict) -> list[Row]:
+    """All declared headline rows present in ``artifact``."""
+    rows = []
+    sha = _methodology_sha(artifact)
+    labels = _labels(artifact)
+    for path, kind, direction, tolerance in HEADLINES.get(bench, []):
+        for concrete, value in _resolve(artifact, path):
+            rows.append(Row(bench=bench, metric=concrete, kind=kind,
+                            direction=direction, tolerance=tolerance,
+                            labels=labels, methodology_sha=sha, value=value))
+    return rows
+
+
+# --------------------------------------------------------------------------
+# Ledger file
+# --------------------------------------------------------------------------
+
+def load_ledger(path: str) -> dict:
+    if os.path.exists(path):
+        with open(path) as f:
+            ledger = json.load(f)
+        if ledger.get("version") != LEDGER_VERSION:
+            raise ValueError(
+                f"ledger {path} has version {ledger.get('version')!r}, "
+                f"this tool writes version {LEDGER_VERSION}")
+        return ledger
+    return {
+        "version": LEDGER_VERSION,
+        "note": ("append-only perf trajectory; rows keyed (bench, metric); "
+                 "maintained by frankenpaxos_tpu.bench.ledger"),
+        "rows": [],
+    }
+
+
+def _artifact_sha(raw: bytes) -> str:
+    return hashlib.sha256(raw).hexdigest()[:10]
+
+
+def _find_row(ledger: dict, bench: str, metric: str) -> Optional[dict]:
+    for row in ledger["rows"]:
+        if row["bench"] == bench and row["metric"] == metric:
+            return row
+    return None
+
+
+def update_ledger(ledger: dict, results_dir: str, tag: str) -> dict:
+    """Extract headlines from every known artifact under ``results_dir``
+    and append a history point per row when the artifact changed.
+    Returns ``{"appended": n, "unchanged": n, "benches": [...]}``.
+    """
+    appended = unchanged = 0
+    benches = []
+    for bench in sorted(HEADLINES):
+        path = os.path.join(results_dir, f"{bench}.json")
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            raw = f.read()
+        sha = _artifact_sha(raw)
+        artifact = json.loads(raw)
+        benches.append(bench)
+        for row in extract_rows(bench, artifact):
+            entry = _find_row(ledger, bench, row.metric)
+            if entry is None:
+                entry = {"bench": bench, "metric": row.metric,
+                         "kind": row.kind, "direction": row.direction,
+                         "tolerance": row.tolerance, "labels": row.labels,
+                         "methodology_sha": row.methodology_sha,
+                         "history": []}
+                ledger["rows"].append(entry)
+            # Declared policy (kind/direction/tolerance) follows the
+            # tool, not the file: update in place so edits here take
+            # effect on the next --update without hand-editing JSON.
+            entry["kind"] = row.kind
+            entry["direction"] = row.direction
+            entry["tolerance"] = row.tolerance
+            entry["labels"] = row.labels
+            entry["methodology_sha"] = row.methodology_sha
+            history = entry["history"]
+            if history and history[-1].get("artifact_sha") == sha:
+                unchanged += 1
+                continue
+            history.append({"value": row.value, "tag": tag,
+                            "artifact_sha": sha,
+                            "source": f"{bench}.json"})
+            appended += 1
+    ledger["rows"].sort(key=lambda r: (r["bench"], r["metric"]))
+    return {"appended": appended, "unchanged": unchanged, "benches": benches}
+
+
+def save_ledger(ledger: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(ledger, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------------
+# Check
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CheckResult:
+    bench: str
+    metric: str
+    status: str            # pass | fail | skip | new | info
+    reason: str
+    committed: Any = None
+    fresh: Any = None
+
+
+def _band(committed: float, tolerance: dict, direction: str,
+          smoke_mismatch: bool) -> tuple[float, str]:
+    """(threshold, description) for the failing side of the band."""
+    if "rel" in tolerance:
+        rel = tolerance["rel"] + (SMOKE_EXTRA_REL if smoke_mismatch else 0.0)
+        if direction == "higher":
+            return committed * (1.0 - rel), f"-{rel:.0%} rel"
+        return committed * (1.0 + rel), f"+{rel:.0%} rel"
+    abs_tol = tolerance["abs"]
+    if direction == "higher":
+        return committed - abs_tol, f"-{abs_tol} abs"
+    return committed + abs_tol, f"+{abs_tol} abs"
+
+
+def check_row(entry: dict, fresh: Row) -> CheckResult:
+    """Compare one fresh headline against its committed trajectory."""
+    bench, metric = entry["bench"], entry["metric"]
+    committed = entry["history"][-1]["value"] if entry["history"] else None
+    if committed is None:
+        return CheckResult(bench, metric, "new", "no committed history",
+                           fresh=fresh.value)
+    if fresh.direction == "info":
+        return CheckResult(bench, metric, "info", "trajectory-only row",
+                           committed, fresh.value)
+    for key in COMPARABILITY_LABELS:
+        have, want = fresh.labels.get(key), entry["labels"].get(key)
+        if have != want:
+            return CheckResult(
+                bench, metric, "skip",
+                f"label {key!r} mismatch (committed={want!r}, "
+                f"fresh={have!r}): not comparable", committed, fresh.value)
+    if fresh.methodology_sha != entry.get("methodology_sha"):
+        return CheckResult(
+            bench, metric, "skip",
+            "methodology drift (bench measurement changed; re-run --update)",
+            committed, fresh.value)
+    smoke_mismatch = (fresh.labels.get("smoke", False)
+                      != entry["labels"].get("smoke", False))
+    if fresh.direction == "bool":
+        if smoke_mismatch:
+            # A reduced run's gate verdict is NOT the committed gate
+            # (different widths/blocks); the numeric rows -- with their
+            # smoke-widened bands -- carry the regression coverage.
+            return CheckResult(
+                bench, metric, "skip",
+                "smoke/full mismatch: reduced-run gate is not the "
+                "committed gate", committed, fresh.value)
+        if bool(committed) and not bool(fresh.value):
+            return CheckResult(bench, metric, "fail",
+                               "committed True, fresh False",
+                               committed, fresh.value)
+        return CheckResult(bench, metric, "pass", "bool holds",
+                           committed, fresh.value)
+    threshold, band = _band(float(committed), entry["tolerance"],
+                            fresh.direction, smoke_mismatch)
+    value = float(fresh.value)
+    if fresh.direction == "higher" and value < threshold:
+        return CheckResult(bench, metric, "fail",
+                           f"{value:.4g} < band floor {threshold:.4g} "
+                           f"({band} of {float(committed):.4g})",
+                           committed, fresh.value)
+    if fresh.direction == "lower" and value > threshold:
+        return CheckResult(bench, metric, "fail",
+                           f"{value:.4g} > band ceiling {threshold:.4g} "
+                           f"({band} of {float(committed):.4g})",
+                           committed, fresh.value)
+    return CheckResult(bench, metric, "pass", f"within {band}",
+                       committed, fresh.value)
+
+
+def check_against_ledger(ledger: dict, fresh_dir: str,
+                         benches: Optional[list[str]] = None
+                         ) -> list[CheckResult]:
+    """Compare every fresh artifact in ``fresh_dir`` against the ledger.
+
+    Only benches with a fresh artifact are checked -- the point is that
+    a reduced CI re-run covers what it can re-run, explicitly.
+    """
+    results = []
+    for bench in sorted(benches or HEADLINES):
+        path = os.path.join(fresh_dir, f"{bench}.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            artifact = json.load(f)
+        fresh_rows = extract_rows(bench, artifact)
+        if not fresh_rows:
+            results.append(CheckResult(bench, "(none)", "skip",
+                                       "no headline rows in fresh artifact"))
+            continue
+        for row in fresh_rows:
+            entry = _find_row(ledger, bench, row.metric)
+            if entry is None:
+                results.append(CheckResult(bench, row.metric, "new",
+                                           "not in committed ledger",
+                                           fresh=row.value))
+                continue
+            results.append(check_row(entry, row))
+    return results
+
+
+def _print_report(results: list[CheckResult], out=sys.stdout) -> dict:
+    counts: dict[str, int] = {}
+    for r in results:
+        counts[r.status] = counts.get(r.status, 0) + 1
+        marker = {"pass": "ok  ", "fail": "FAIL", "skip": "skip",
+                  "new": "new ", "info": "info"}[r.status]
+        line = f"  [{marker}] {r.bench}:{r.metric}"
+        if r.status in ("fail", "skip"):
+            line += f" -- {r.reason}"
+        elif r.status == "pass":
+            line += f" ({r.fresh!r} vs {r.committed!r}, {r.reason})"
+        print(line, file=out)
+    print(f"ledger check: {counts}", file=out)
+    return counts
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m frankenpaxos_tpu.bench.ledger",
+        description=__doc__.split("\n\n")[0])
+    parser.add_argument("--ledger", default=DEFAULT_LEDGER)
+    parser.add_argument("--results", default=DEFAULT_RESULTS_DIR,
+                        help="artifact dir for --update")
+    parser.add_argument("--update", action="store_true",
+                        help="append current artifact headlines to the ledger")
+    parser.add_argument("--tag", default="untagged",
+                        help="trajectory tag for --update (e.g. a PR name)")
+    parser.add_argument("--check", action="store_true",
+                        help="compare fresh artifacts against the ledger")
+    parser.add_argument("--fresh", default=None,
+                        help="dir of fresh artifacts for --check "
+                             "(default: --results)")
+    parser.add_argument("--report", default=None,
+                        help="also write the check report as JSON here")
+    args = parser.parse_args(argv)
+
+    if args.update == args.check:
+        parser.error("exactly one of --update / --check required")
+
+    if args.update:
+        ledger = load_ledger(args.ledger)
+        stats = update_ledger(ledger, args.results, args.tag)
+        save_ledger(ledger, args.ledger)
+        print(f"ledger update: {stats['appended']} point(s) appended, "
+              f"{stats['unchanged']} unchanged, benches: "
+              f"{', '.join(stats['benches'])}")
+        return 0
+
+    if not os.path.exists(args.ledger):
+        print(f"no ledger at {args.ledger}; run --update first",
+              file=sys.stderr)
+        return 2
+    ledger = load_ledger(args.ledger)
+    results = check_against_ledger(ledger, args.fresh or args.results)
+    counts = _print_report(results)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump({"counts": counts,
+                       "results": [dataclasses.asdict(r) for r in results]},
+                      f, indent=2)
+            f.write("\n")
+    return 1 if counts.get("fail") else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from frankenpaxos_tpu.ops.telemetry import (
+    drain_update,
+    make_telemetry,
+    quorum_pass_update,
+    TELEMETRY_PARTITION,
+    TelemetryState,
+)
+
 
 class PipelineState(NamedTuple):
     votes: jax.Array      # [n, window] uint8
@@ -53,9 +61,16 @@ class PipelineState(NamedTuple):
     sm_state: jax.Array   # [] int32: the replica's running register
     committed: jax.Array  # [] int32 committed commands
     exec_wm: jax.Array    # [] int32 executed watermark (global slots)
+    # paxpulse device counters (ops/telemetry.py) -- None means the
+    # telemetry plane is OFF and every accumulation site compiles out
+    # (the pytree simply has no leaves there), keeping the traced ops
+    # byte-identical to the pre-paxpulse pipeline.
+    telemetry: Optional[TelemetryState] = None
 
 
-def make_state(window: int, num_acceptors: int) -> PipelineState:
+def make_state(window: int, num_acceptors: int, *,
+               telemetry: bool = False,
+               slot_shards: int = 1) -> PipelineState:
     return PipelineState(
         votes=jnp.zeros((num_acceptors, window), jnp.uint8),
         chosen=jnp.zeros((window,), jnp.bool_),
@@ -64,6 +79,8 @@ def make_state(window: int, num_acceptors: int) -> PipelineState:
         sm_state=jnp.int32(0),
         committed=jnp.int32(0),
         exec_wm=jnp.int32(0),
+        telemetry=(make_telemetry(num_acceptors, slot_shards)
+                   if telemetry else None),
     )
 
 
@@ -222,7 +239,7 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     commands = jax.lax.dynamic_update_slice(state.commands, proposed,
                                             (start_new,))
 
-    def quorum_pass(votes, chosen, committed, start, arrivals):
+    def quorum_pass(votes, chosen, committed, tel, start, arrivals):
         block = jax.lax.dynamic_slice(votes, (0, start),
                                       (n_local, b_local)) | arrivals
         votes = jax.lax.dynamic_update_slice(votes, block, (0, start))
@@ -265,16 +282,24 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
         # Post-group-psum ``newly`` is replicated over group; summing the
         # slot shards yields the global count, replicated everywhere.
         committed = committed + _psum(newly.sum(dtype=jnp.int32), slot_axis)
-        return votes, chosen, committed
+        if tel is not None:
+            # paxpulse: at choose time, how many GLOBAL votes had landed
+            # on each lane? (Only traced on the telemetry-on arm.)
+            votes_count = _psum(block.astype(jnp.int32).sum(0),
+                                group_axis)
+            tel = quorum_pass_update(tel, votes_count=votes_count,
+                                     newly=newly, slot_axis=slot_axis)
+        return votes, chosen, committed, tel
 
     # --- Acceptors + ProxyLeader: pass 1 on the new block -------------------
     arr1 = _mask_arrivals(_arrivals(i, lanes_new, accs, salt=0))
-    votes, chosen, committed = quorum_pass(
-        state.votes, state.chosen, state.committed, start_new, arr1)
+    votes, chosen, committed, tel = quorum_pass(
+        state.votes, state.chosen, state.committed, state.telemetry,
+        start_new, arr1)
     # --- pass 2: stragglers complete the previous block ---------------------
     arr2 = _mask_arrivals(1 - _arrivals(i - 1, lanes_new, accs, salt=0))
-    votes, chosen, committed = quorum_pass(
-        votes, chosen, committed, start_old, arr2)
+    votes, chosen, committed, tel = quorum_pass(
+        votes, chosen, committed, tel, start_old, arr2)
 
     # --- Replica: execute the now fully-chosen previous block ---------------
     cmds_old = jax.lax.dynamic_slice(commands, (start_old,), (b_local,))
@@ -295,8 +320,20 @@ def steady_state_step(state: PipelineState, i: jax.Array, *,
     chosen = jax.lax.dynamic_update_slice(
         chosen, jnp.zeros((b_local,), jnp.bool_), (start_gc,))
 
+    # paxpulse once-per-drain counters: proposal fill, pad-lane waste,
+    # and the end-of-drain watermark lag (slots proposed but unchosen --
+    # with ring reuse, cumulative proposals are (i+1) * block_size).
+    # The lag expression stays under the guard so the telemetry-off
+    # trace is the pre-paxpulse program to the op.
+    if tel is not None:
+        tel = drain_update(tel, proposed_block=proposed,
+                           lane_valid=lane_valid,
+                           lag=(i.astype(jnp.int32) + 1) * block_size
+                           - committed,
+                           slot_axis=slot_axis)
+
     return PipelineState(votes, chosen, commands, results, sm_state,
-                         committed, exec_wm)
+                         committed, exec_wm, tel)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5),
@@ -425,7 +462,26 @@ PIPELINE_PARTITION = PipelineState(
     sm_state=(),
     committed=(),
     exec_wm=(),
+    # The telemetry leaf defaults to None (plane off). When the plane is
+    # on, its per-leaf axes come from ops/telemetry.TELEMETRY_PARTITION
+    # via :func:`partition_specs`.
 )
+
+
+def partition_specs(telemetry: bool = False):
+    """The ``PartitionSpec`` tree for a ``PipelineState`` over the
+    ``(group, slot)`` mesh: ``PIPELINE_PARTITION`` leaf-for-leaf, with
+    the paxpulse subtree (per ``TELEMETRY_PARTITION``) attached when the
+    telemetry plane is on and an empty (``None``) node when off."""
+    from jax.sharding import PartitionSpec as P
+
+    tel = (TelemetryState(*(P(*axes) for axes in TELEMETRY_PARTITION))
+           if telemetry else None)
+    base = {field: P(*axes)
+            for field, axes in zip(PipelineState._fields,
+                                   PIPELINE_PARTITION)
+            if isinstance(axes, tuple)}
+    return PipelineState(telemetry=tel, **base)
 
 
 def _shard_map_fn():
@@ -436,7 +492,8 @@ def _shard_map_fn():
 
 
 def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
-                      thresholds, combine_any: bool):
+                      thresholds, combine_any: bool,
+                      telemetry: bool = False):
     """Jit ``steady_state_step`` under shard_map over ``mesh``.
 
     ``mesh`` must have axes ``("group", "slot")``. Returns
@@ -457,8 +514,7 @@ def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
         group_axis="group", slot_axis="slot",
         group_shards=group_shards, slot_shards=slot_shards)
 
-    spec_tree = PipelineState(
-        *(P(*axes) for axes in PIPELINE_PARTITION))
+    spec_tree = partition_specs(telemetry)
     shard_map = _shard_map_fn()
     kwargs = {}
     params = inspect.signature(shard_map).parameters
@@ -473,18 +529,19 @@ def make_sharded_step(mesh, *, block_size: int, masks: np.ndarray,
     return sharded, sharding
 
 
-def state_sharding(mesh):
+def state_sharding(mesh, telemetry: bool = False):
     """The ``NamedSharding`` tree matching ``PIPELINE_PARTITION`` over
     ``mesh`` (what :func:`make_sharded_step` returns as its second
     element), for callers that place state without building a step."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
-    spec_tree = PipelineState(*(P(*axes) for axes in PIPELINE_PARTITION))
+    spec_tree = partition_specs(telemetry)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree)
 
 
 def make_sharded_state(mesh, window: int, block_size: int,
-                       num_acceptors: int) -> tuple:
+                       num_acceptors: int, *,
+                       telemetry: bool = False) -> tuple:
     """``(state, sharding, w_padded)``: a fresh ``PipelineState`` laid
     out over ``mesh`` for a GLOBAL ``window`` of whole ``block_size``
     blocks. When the block does not divide over the slot shards the
@@ -494,13 +551,16 @@ def make_sharded_state(mesh, window: int, block_size: int,
     (compare through :func:`gathered_layout`)."""
     slot_shards = mesh.shape["slot"]
     w_padded = padded_window(window, block_size, slot_shards)
-    sharding = state_sharding(mesh)
-    state = jax.device_put(make_state(w_padded, num_acceptors), sharding)
+    sharding = state_sharding(mesh, telemetry)
+    state = jax.device_put(
+        make_state(w_padded, num_acceptors, telemetry=telemetry,
+                   slot_shards=slot_shards), sharding)
     return state, sharding, w_padded
 
 
 def make_sharded_runner(mesh, *, block_size: int, masks: np.ndarray,
-                        thresholds, combine_any: bool, iters: int):
+                        thresholds, combine_any: bool, iters: int,
+                        telemetry: bool = False):
     """The mesh twin of :func:`run_steps_from`: jit one shard_map'd
     ``fori_loop`` of ``iters`` drains (ONE dispatch per call, the bench
     hot loop -- per-drain dispatch through :func:`make_sharded_step`
@@ -524,7 +584,7 @@ def make_sharded_runner(mesh, *, block_size: int, masks: np.ndarray,
 
         return jax.lax.fori_loop(start, start + iters, body, state)
 
-    spec_tree = PipelineState(*(P(*axes) for axes in PIPELINE_PARTITION))
+    spec_tree = partition_specs(telemetry)
     shard_map = _shard_map_fn()
     kwargs = {}
     params = inspect.signature(shard_map).parameters
@@ -535,4 +595,4 @@ def make_sharded_runner(mesh, *, block_size: int, masks: np.ndarray,
     runner = jax.jit(shard_map(
         run, mesh=mesh, in_specs=(spec_tree, P()), out_specs=spec_tree,
         **kwargs), donate_argnums=(0,))
-    return runner, state_sharding(mesh)
+    return runner, state_sharding(mesh, telemetry)

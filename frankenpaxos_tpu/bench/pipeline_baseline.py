@@ -1,0 +1,213 @@
+"""VERBATIM pre-paxpulse pipeline core, pinned as the overhead baseline.
+
+This module is a frozen copy of the ``bench/pipeline.py`` hot path as it
+stood the commit BEFORE the paxpulse telemetry plane landed (PR 19). It
+exists for exactly one purpose: the paired overhead A/B in
+``bench/telemetry_overhead.py`` gates the telemetry-OFF arm of the live
+pipeline against this copy at the <3% noise floor, proving that carrying
+an optional (``None``-when-disabled) ``telemetry`` leaf in
+``PipelineState`` compiles out completely. The same pinning idiom as
+``runtime/sim_legacy.py``: the baseline arm must be immune to later
+edits of the live module, or the gate silently measures nothing.
+
+Do NOT edit the function bodies here; they are the measurement. If the
+live pipeline's semantics intentionally change, re-pin a fresh copy and
+say so in the bench artifact's methodology string.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PipelineState(NamedTuple):
+    votes: jax.Array      # [n, window] uint8
+    chosen: jax.Array     # [window] bool
+    commands: jax.Array   # [window] int32 proposed command ids
+    results: jax.Array    # [window] int32 state-machine outputs
+    sm_state: jax.Array   # [] int32: the replica's running register
+    committed: jax.Array  # [] int32 committed commands
+    exec_wm: jax.Array    # [] int32 executed watermark (global slots)
+
+
+def make_state(window: int, num_acceptors: int) -> PipelineState:
+    return PipelineState(
+        votes=jnp.zeros((num_acceptors, window), jnp.uint8),
+        chosen=jnp.zeros((window,), jnp.bool_),
+        commands=jnp.zeros((window,), jnp.int32),
+        results=jnp.zeros((window,), jnp.int32),
+        sm_state=jnp.int32(0),
+        committed=jnp.int32(0),
+        exec_wm=jnp.int32(0),
+    )
+
+
+def _arrivals(i: jax.Array, lanes: jax.Array, accs: jax.Array,
+              salt: int) -> jax.Array:
+    """Deterministic pseudo-random [len(accs), len(lanes)] uint8 arrival
+    mask, keyed by logical (block-lane, global-acceptor) coordinates so
+    every mesh sharding generates the same votes for the same slot."""
+    h = (lanes[None, :] * 1103515245 + accs[:, None] * 12820163
+         + (i + salt) * 22695477) >> 7
+    return ((h & 7) < 7).astype(jnp.uint8)  # ~87.5% arrive this drain
+
+
+def _psum(x, axis: Optional[str]):
+    return x if axis is None else jax.lax.psum(x, axis)
+
+
+def _axis_index(axis: Optional[str]) -> jax.Array:
+    return jnp.int32(0) if axis is None else jax.lax.axis_index(axis)
+
+
+def local_block(block_size: int, slot_shards: int) -> tuple:
+    """``(b_local, pad)``: the per-shard lane count (the global block
+    rounded UP over the slot shards) and the number of pad lanes the
+    rounding adds to the padded global block."""
+    b_local = -(-block_size // slot_shards)
+    return b_local, b_local * slot_shards - block_size
+
+
+def steady_state_step(state: PipelineState, i: jax.Array, *,
+                      block_size: int, masks: np.ndarray,
+                      thresholds, combine_any: bool,
+                      group_axis: Optional[str] = None,
+                      slot_axis: Optional[str] = None,
+                      group_shards: int = 1,
+                      slot_shards: int = 1) -> PipelineState:
+    """One event-loop drain: new proposals + straggler completion
+    (the pinned pre-paxpulse body; see the live module for docs)."""
+    n_local, w_local = state.votes.shape
+    b_local, block_pad = local_block(block_size, slot_shards)
+    assert w_local % b_local == 0, (
+        f"local window {w_local} must hold whole {b_local}-slot blocks")
+    masks_d = jnp.asarray(masks, dtype=jnp.int32)          # [G, n_global]
+    thresholds_d = jnp.asarray(np.asarray(thresholds, dtype=np.int32))
+    assert thresholds_d.shape == (masks_d.shape[0],), (
+        f"{thresholds_d.shape} thresholds for {masks_d.shape[0]} mask "
+        f"groups")
+    assert masks_d.shape[1] == group_shards * n_local, (
+        f"masks cover {masks_d.shape[1]} acceptors but the mesh holds "
+        f"{group_shards} x {n_local}")
+    num_blocks = w_local // b_local
+    start_new = (i % num_blocks) * b_local
+    start_old = ((i - 1) % num_blocks) * b_local
+
+    from frankenpaxos_tpu.ops.quorum import _fused_grid_hit, grid_layout
+
+    grid = grid_layout(masks, thresholds, combine_any)
+    if grid is not None and group_axis is not None \
+            and (grid[3] is not None or n_local % grid[2] != 0):
+        grid = None
+
+    if slot_axis is None:
+        lanes_new = jnp.arange(b_local, dtype=jnp.int32)
+    else:
+        lanes_new = (_axis_index(slot_axis) * b_local
+                     + jnp.arange(b_local, dtype=jnp.int32))
+    if group_axis is None:
+        accs = jnp.arange(n_local, dtype=jnp.int32)
+        masks_local = masks_d
+    else:
+        group_idx = _axis_index(group_axis)
+        accs = group_idx * n_local + jnp.arange(n_local, dtype=jnp.int32)
+        masks_local = jax.lax.dynamic_slice(
+            masks_d, (0, group_idx * n_local),
+            (masks_d.shape[0], n_local))
+
+    lane_valid = lanes_new < block_size if block_pad else None
+
+    def _mask_arrivals(arr):
+        if lane_valid is None:
+            return arr
+        return arr & lane_valid[None, :].astype(jnp.uint8)
+
+    proposed = lanes_new * 7 + i * 13 + 1
+    if lane_valid is not None:
+        proposed = jnp.where(lane_valid, proposed, 0)
+    commands = jax.lax.dynamic_update_slice(state.commands, proposed,
+                                            (start_new,))
+
+    def quorum_pass(votes, chosen, committed, start, arrivals):
+        block = jax.lax.dynamic_slice(votes, (0, start),
+                                      (n_local, b_local)) | arrivals
+        votes = jax.lax.dynamic_update_slice(votes, block, (0, start))
+        if grid is not None and group_axis is None:
+            hit = _fused_grid_hit(block, grid)
+        elif grid is not None:
+            kind, _, g_cols, _ = grid
+            local_rows = []
+            for r in range(block.shape[0] // g_cols):
+                row = block[r * g_cols]
+                for c in range(1, g_cols):
+                    cell = block[r * g_cols + c]
+                    row = (row | cell) if kind == "write" else (row & cell)
+                local_rows.append(row)
+            if kind == "write":
+                missing = sum((jnp.uint8(1) - row for row in local_rows),
+                              jnp.zeros((b_local,), jnp.uint8))
+                hit = _psum(missing.astype(jnp.int32), group_axis) == 0
+            else:
+                full = sum(local_rows,
+                           jnp.zeros((b_local,), jnp.uint8))
+                hit = _psum(full.astype(jnp.int32), group_axis) > 0
+        else:
+            counts = _psum(masks_local @ block.astype(jnp.int32),
+                           group_axis)                   # [G, b_local]
+            satisfied = counts >= thresholds_d[:, None]
+            hit = satisfied.any(0) if combine_any else satisfied.all(0)
+        if lane_valid is not None:
+            hit = hit & lane_valid
+        old = jax.lax.dynamic_slice(chosen, (start,), (b_local,))
+        newly = hit & ~old
+        chosen = jax.lax.dynamic_update_slice(chosen, hit | old, (start,))
+        committed = committed + _psum(newly.sum(dtype=jnp.int32), slot_axis)
+        return votes, chosen, committed
+
+    arr1 = _mask_arrivals(_arrivals(i, lanes_new, accs, salt=0))
+    votes, chosen, committed = quorum_pass(
+        state.votes, state.chosen, state.committed, start_new, arr1)
+    arr2 = _mask_arrivals(1 - _arrivals(i - 1, lanes_new, accs, salt=0))
+    votes, chosen, committed = quorum_pass(
+        votes, chosen, committed, start_old, arr2)
+
+    cmds_old = jax.lax.dynamic_slice(commands, (start_old,), (b_local,))
+    block_results = cmds_old * 3 + 7
+    if lane_valid is not None:
+        block_results = jnp.where(lane_valid, block_results, 0)
+    results = jax.lax.dynamic_update_slice(state.results, block_results,
+                                           (start_old,))
+    sm_state = state.sm_state + _psum(cmds_old.sum(dtype=jnp.int32),
+                                      slot_axis)
+    exec_wm = jnp.where(i >= 1, i.astype(jnp.int32) * block_size, 0)
+
+    start_gc = ((i - 2) % num_blocks) * b_local
+    votes = jax.lax.dynamic_update_slice(
+        votes, jnp.zeros((n_local, b_local), jnp.uint8), (0, start_gc))
+    chosen = jax.lax.dynamic_update_slice(
+        chosen, jnp.zeros((b_local,), jnp.bool_), (start_gc,))
+
+    return PipelineState(votes, chosen, commands, results, sm_state,
+                         committed, exec_wm)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6),
+                   donate_argnums=(0,))
+def run_steps_from(state: PipelineState, start: jax.Array, iters: int,
+                   block_size: int, masks_t: tuple, thresholds_t: tuple,
+                   combine_any: bool) -> PipelineState:
+    """The pinned chunked runner (traced start, one executable)."""
+    masks = np.asarray(masks_t, dtype=np.int32)
+    thresholds = np.asarray(thresholds_t, dtype=np.int32)
+
+    def body(i, s):
+        return steady_state_step(s, i, block_size=block_size, masks=masks,
+                                 thresholds=thresholds,
+                                 combine_any=combine_any)
+
+    return jax.lax.fori_loop(start, start + iters, body, state)

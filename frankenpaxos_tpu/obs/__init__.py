@@ -16,6 +16,11 @@ TPU209 enforces that):
   * ``perfetto`` -- span records -> Chrome-trace-event JSON (loads in
     Perfetto / chrome://tracing), per-command critical paths, and the
     drain-stage latency-breakdown table.
+  * ``telemetry`` -- the paxpulse HOST side: one batched D2H collect
+    per reporting interval of the device counters that ride inside the
+    jitted pipeline as arrays (ops/telemetry.py -- counters are data,
+    not hooks, so TPU209 stays satisfied), publishing
+    ``fpx_pipeline_*`` RuntimeMetrics and Perfetto counter tracks.
 
 Docs: docs/OBSERVABILITY.md.
 """
@@ -26,6 +31,11 @@ from frankenpaxos_tpu.obs.perfetto import (
     load_jsonl,
     to_chrome_trace,
     trace_tree,
+)
+from frankenpaxos_tpu.obs.telemetry import (
+    collect,
+    TelemetryReporter,
+    TelemetrySnapshot,
 )
 from frankenpaxos_tpu.obs.trace import (
     RuntimeMetrics,
@@ -39,9 +49,12 @@ __all__ = [
     "FlightRecorder",
     "RuntimeMetrics",
     "SpanRecord",
+    "TelemetryReporter",
+    "TelemetrySnapshot",
     "TraceContext",
     "Tracer",
     "VirtualClock",
+    "collect",
     "latency_breakdown",
     "load_jsonl",
     "to_chrome_trace",

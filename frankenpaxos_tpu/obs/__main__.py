@@ -5,10 +5,12 @@ Usage::
     python -m frankenpaxos_tpu.obs <dir-or-trace.jsonl>... \
         --out trace.json [--breakdown] [--flight <ring.flight>]
 
-Globs ``*.trace.jsonl`` under directories, merges every role's spans
-into one Chrome-trace-event JSON (load it at ui.perfetto.dev or
-chrome://tracing), prints the drain-stage latency-breakdown table,
-and renders flight-recorder rings to their post-mortem JSON.
+Globs ``*.trace.jsonl`` (spans) and ``*.counters.jsonl`` (paxpulse
+device-counter samples) under directories, merges every role's spans
+and counter tracks into one Chrome-trace-event JSON (load it at
+ui.perfetto.dev or chrome://tracing), prints the drain-stage
+latency-breakdown table, and renders flight-recorder rings to their
+post-mortem JSON.
 """
 
 from __future__ import annotations
@@ -26,6 +28,7 @@ from frankenpaxos_tpu.obs.perfetto import (
     load_jsonl,
     to_chrome_trace,
 )
+from frankenpaxos_tpu.obs.telemetry import counter_events, load_counters
 
 
 def main(argv=None) -> int:
@@ -42,22 +45,35 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     paths = []
+    counter_paths = []
     for item in args.inputs:
         if os.path.isdir(item):
             paths.extend(sorted(glob.glob(
                 os.path.join(item, "*.trace.jsonl"))))
+            counter_paths.extend(sorted(glob.glob(
+                os.path.join(item, "*.counters.jsonl"))))
+        elif item.endswith(".counters.jsonl"):
+            counter_paths.append(item)
         else:
             paths.append(item)
     records = []
     for path in paths:
         records.extend(load_jsonl(path))
     records.sort(key=lambda r: (r.t0, r.role, r.span_id))
+    counters = []
+    for path in counter_paths:
+        by_role: dict = {}
+        for t, role, snap in load_counters(path):
+            by_role.setdefault(role, []).append((t, snap))
+        for role, samples in sorted(by_role.items()):
+            counters.extend(counter_events(samples, role))
 
     if args.out:
         with open(args.out, "w") as f:
-            json.dump(to_chrome_trace(records), f)
+            json.dump(to_chrome_trace(records, counters), f)
         print(f"wrote {args.out} ({len(records)} spans from "
-              f"{len(paths)} role dumps)")
+              f"{len(paths)} role dumps, {len(counters)} counter "
+              f"events from {len(counter_paths)} paxpulse dumps)")
     if args.breakdown:
         print(format_breakdown(latency_breakdown(records)))
     for ring in args.flight:

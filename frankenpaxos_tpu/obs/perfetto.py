@@ -37,9 +37,15 @@ def load_jsonl(path: str) -> list:
     return records
 
 
-def to_chrome_trace(records: Iterable[SpanRecord]) -> dict:
+def to_chrome_trace(records: Iterable[SpanRecord],
+                    counters: Iterable[dict] = ()) -> dict:
     """The Trace Event Format dict (``json.dump`` it; Perfetto and
-    chrome://tracing both load it)."""
+    chrome://tracing both load it).
+
+    ``counters`` are ready-made COUNTER events (``ph: "C"``, e.g. from
+    ``obs.telemetry.counter_events``): Perfetto renders them as value
+    tracks alongside the span tracks, so the paxpulse device counters
+    line up under the host spans on one timeline."""
     events = []
     roles = {}
     for record in records:
@@ -63,6 +69,7 @@ def to_chrome_trace(records: Iterable[SpanRecord]) -> dict:
     for role, tid in roles.items():
         events.append({"name": "thread_name", "ph": "M", "pid": 1,
                        "tid": tid, "args": {"name": role}})
+    events.extend(counters)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -232,10 +232,80 @@ class RuntimeMetrics:
             help="Client commands rejected or shed by this role, by "
                  "region/zone",
             labels=("role", "region"))
+        # paxpulse (ops/telemetry.py + obs/telemetry.py): the device
+        # pipeline counters that ride INSIDE the jitted drain loop as
+        # arrays and reach here through one batched collect() per
+        # reporting interval. fpx_pipeline_* (not fpx_runtime_*)
+        # because the exporter is the pipeline harness, not a role's
+        # event loop.
+        self._pipe_drains = collectors.counter(
+            "fpx_pipeline_drains_total",
+            help="Device pipeline drains accumulated (fori_loop "
+                 "iterations collected)",
+            labels=("role",)).labels(role)
+        self._pipe_committed = collectors.counter(
+            "fpx_pipeline_committed_total",
+            help="Commands newly chosen by the device pipeline "
+                 "(mesh-global)",
+            labels=("role",)).labels(role)
+        self._pipe_proposed = collectors.counter(
+            "fpx_pipeline_proposed_total",
+            help="Valid (non-pad) commands proposed by the device "
+                 "pipeline",
+            labels=("role",)).labels(role)
+        self._pipe_pads = collectors.counter(
+            "fpx_pipeline_pad_lanes_total",
+            help="Pad-lane slots masked per drain under a "
+                 "non-divisible paxmesh slot split (padding waste)",
+            labels=("role",)).labels(role)
+        self._pipe_shard = collectors.gauge(
+            "fpx_pipeline_shard_committed",
+            help="Cumulative committed commands per slot shard (the "
+                 "skew source)",
+            labels=("role", "shard"))
+        self._pipe_skew = collectors.gauge(
+            "fpx_pipeline_shard_skew_ratio",
+            help="max/mean of per-shard committed (1.0 = perfectly "
+                 "even mesh)",
+            labels=("role",)).labels(role)
+        self._pipe_fill = collectors.gauge(
+            "fpx_pipeline_batch_fill",
+            help="Valid proposals per drain over the global block "
+                 "(1.0 = every lane carried a command)",
+            labels=("role",)).labels(role)
+        self._pipe_occ = collectors.counter(
+            "fpx_pipeline_quorum_occupancy_total",
+            help="Slots first chosen with exactly `votes` acceptor "
+                 "votes landed (quorum-progress occupancy)",
+            labels=("role", "votes"))
+        self._pipe_lag = collectors.counter(
+            "fpx_pipeline_watermark_lag_total",
+            help="End-of-drain watermark lag (proposed-but-unchosen "
+                 "slots), log2-bucketed by lower bound",
+            labels=("role", "bucket"))
+        # paxruns (runs/ + protocols/{epaxos,simplebpaxos,fastpaxos}):
+        # the batched dependency-set engine and fast-quorum layer
+        # shipped in PR 18 without metrics; these close that gap.
+        self._depset_deps = collectors.counter(
+            "fpx_runtime_depset_batched_deps_total",
+            help="Dependency columns computed through the batched "
+                 "depset engine (runs/depruns.py)",
+            labels=("role",)).labels(role)
+        self._depset_fallbacks = collectors.counter(
+            "fpx_runtime_depset_span_fallbacks_total",
+            help="Depset unions that fell back to the sparse-span "
+                 "path (tail window exceeded / host backend)",
+            labels=("role",)).labels(role)
+        self._fastquorum_checks = collectors.counter(
+            "fpx_runtime_fastquorum_checks_total",
+            help="Fast-quorum / spec-checker evaluations (fastpaxos, "
+                 "fastmultipaxos, runs/quorums.py)",
+            labels=("role",)).labels(role)
         self._adm_rejected_children: dict = {}
         self._adm_shed_children: dict = {}
         self._retry_children: dict = {}
         self._region_children: dict = {}
+        self._pipe_children: dict = {}
 
     def observe_stage(self, stage: str, dur_s: float) -> None:
         child = self._stage_children.get(stage)
@@ -308,6 +378,56 @@ class RuntimeMetrics:
 
     def outbound_stall(self, n: int = 1) -> None:
         self._outbuf_stalls.inc(n)
+
+    # --- paxpulse device pipeline (obs/telemetry.py publishes) ----------
+    def pipeline_interval(self, *, drains: int, committed: int,
+                          proposed: int, pad_lanes: int,
+                          occupancy, lag_hist, shard_committed,
+                          skew: float, fill=None) -> None:
+        """One reporting interval: deltas for the counters, the
+        cumulative per-shard/skew/fill state for the gauges."""
+        self._pipe_drains.inc(drains)
+        self._pipe_committed.inc(committed)
+        self._pipe_proposed.inc(proposed)
+        self._pipe_pads.inc(pad_lanes)
+        for votes, n in enumerate(occupancy):
+            if not n:
+                continue
+            key = ("occ", votes)
+            child = self._pipe_children.get(key)
+            if child is None:
+                child = self._pipe_occ.labels(self.role, str(votes))
+                self._pipe_children[key] = child
+            child.inc(n)
+        for bucket, n in enumerate(lag_hist):
+            if not n:
+                continue
+            key = ("lag", bucket)
+            child = self._pipe_children.get(key)
+            if child is None:
+                child = self._pipe_lag.labels(self.role, str(bucket))
+                self._pipe_children[key] = child
+            child.inc(n)
+        for shard, total in enumerate(shard_committed):
+            key = ("shard", shard)
+            child = self._pipe_children.get(key)
+            if child is None:
+                child = self._pipe_shard.labels(self.role, str(shard))
+                self._pipe_children[key] = child
+            child.set(total)
+        self._pipe_skew.set(skew)
+        if fill is not None:
+            self._pipe_fill.set(fill)
+
+    # --- paxruns depset / fast-quorum layer (runs/, protocols/) ---------
+    def depset_batch(self, ndeps: int) -> None:
+        self._depset_deps.inc(ndeps)
+
+    def depset_span_fallback(self, n: int = 1) -> None:
+        self._depset_fallbacks.inc(n)
+
+    def fastquorum_check(self, n: int = 1) -> None:
+        self._fastquorum_checks.inc(n)
 
     # --- paxwire batched transport (runtime/paxwire.py) -----------------
     def transport_flush(self, frames: int, nbytes: int) -> None:

@@ -1,0 +1,160 @@
+"""paxpulse device-plane telemetry: counters as DATA, not hooks.
+
+paxlint TPU209 (correctly) bans span hooks and clock reads inside
+``ops/`` kernels and jit-reachable bodies, so the fused drain loop is a
+black box to paxtrace: per-shard skew, quorum-progress occupancy,
+watermark lag, and pad-lane waste are invisible exactly where the
+north-star budget lives. paxpulse restores visibility WITHOUT hooks: a
+small SoA array tree (:class:`TelemetryState`) rides inside the
+pipeline's donated carry and is accumulated by pure jit-safe reductions
+woven into the same fused step -- no callbacks, no clocks, no D2H until
+an explicit :func:`frankenpaxos_tpu.obs.telemetry.collect` at the
+reporting interval.
+
+Disabled means GONE: the pipeline carries ``telemetry=None`` by default,
+and every accumulation site is guarded by a *Python* ``is not None``
+check, so the telemetry-off trace contains byte-identical ops to the
+pre-paxpulse pipeline (gated by the bit-identity tests and the paired
+overhead A/B in ``bench/telemetry_overhead.py``).
+
+Counter semantics (all cumulative since ``make_telemetry``; the host
+computes interval deltas between collects):
+
+  * ``shard_committed`` -- ``[slot_shards]`` newly-chosen commands per
+    slot shard (replicated over ``group``; each slot shard holds its own
+    element). The source of the per-shard gauges and the skew ratio.
+  * ``proposed`` -- valid (non-pad) proposed commands, mesh-global.
+  * ``occupancy`` -- ``[n_acceptors + 1]`` histogram: at the moment a
+    slot is first chosen, how many acceptor votes had landed on it?
+    Bucket k counts slots chosen with exactly k votes (clipped at n).
+    Saturation shows up here before it shows up in wall-clock.
+  * ``lag_hist`` -- ``[LAG_BUCKETS]`` histogram of the end-of-drain
+    watermark lag (slots proposed but not yet chosen), bucketed by
+    :func:`lag_bucket_bounds` (0, then powers of two).
+  * ``pad_lanes`` -- pad-lane slots masked per drain under a
+    non-divisible paxmesh split (the waste the padding costs).
+  * ``drains`` -- drains accumulated (the denominator for fill rates:
+    ingest batch fill = proposed / (drains * block_size)).
+
+All dtypes are int32 and all updates are adds/scatter-adds, so the tree
+is safe to donate, psum, and carry through ``fori_loop`` unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Watermark-lag histogram buckets: 0, 1, 2, [3,4], [5,8], ... (log2).
+LAG_BUCKETS = 16
+
+
+class TelemetryState(NamedTuple):
+    shard_committed: jax.Array  # [slot_shards] int32
+    proposed: jax.Array         # [] int32
+    occupancy: jax.Array        # [n_acceptors + 1] int32
+    lag_hist: jax.Array         # [LAG_BUCKETS] int32
+    pad_lanes: jax.Array        # [] int32
+    drains: jax.Array           # [] int32
+
+
+#: Mesh partition per leaf, in the PIPELINE_PARTITION axis-tuple idiom:
+#: ``shard_committed`` lives with its slot shard; everything else is a
+#: mesh-global (replicated) reduction.
+TELEMETRY_PARTITION = TelemetryState(
+    shard_committed=("slot",),
+    proposed=(),
+    occupancy=(),
+    lag_hist=(),
+    pad_lanes=(),
+    drains=(),
+)
+
+
+def make_telemetry(num_acceptors: int,
+                   slot_shards: int = 1) -> TelemetryState:
+    """A zeroed telemetry tree for ``num_acceptors`` GLOBAL acceptors
+    over ``slot_shards`` slot shards."""
+    return TelemetryState(
+        shard_committed=jnp.zeros((slot_shards,), jnp.int32),
+        proposed=jnp.int32(0),
+        occupancy=jnp.zeros((num_acceptors + 1,), jnp.int32),
+        lag_hist=jnp.zeros((LAG_BUCKETS,), jnp.int32),
+        pad_lanes=jnp.int32(0),
+        drains=jnp.int32(0),
+    )
+
+
+def lag_bucket_bounds() -> np.ndarray:
+    """Lower bounds of the lag buckets: bucket b counts lags in
+    ``[bounds[b], bounds[b+1])`` with bucket 0 = exactly 0 and the last
+    bucket open-ended. Host-side, for reporting."""
+    return np.concatenate(
+        ([0], 2 ** np.arange(LAG_BUCKETS - 1, dtype=np.int64)))
+
+
+def lag_bucket(lag: jax.Array) -> jax.Array:
+    """The jit-safe bucket index for a scalar int32 lag: counts how many
+    power-of-two lower bounds the lag reaches (integer compares only --
+    no float log, so the bucketing is bit-stable across backends)."""
+    bounds = jnp.asarray(2 ** np.arange(LAG_BUCKETS - 1, dtype=np.int64),
+                         jnp.int32)
+    return jnp.sum((lag >= bounds).astype(jnp.int32))
+
+
+def quorum_pass_update(tel: Optional[TelemetryState], *,
+                       votes_count: jax.Array, newly: jax.Array,
+                       slot_axis: Optional[str]) -> \
+        Optional[TelemetryState]:
+    """Accumulate one quorum pass: ``votes_count`` is the [B] per-lane
+    GLOBAL vote count (already psum'd over ``group``), ``newly`` the [B]
+    newly-chosen mask (group-replicated). Pure adds; ``None`` in,
+    ``None`` out (the disabled arm traces nothing).
+
+    The histogram is a one-hot compare-and-reduce, NOT a scatter:
+    XLA expands a vector ``.at[idx].add`` into a SERIAL per-lane while
+    loop (on CPU that made telemetry-on ~5x slower than off), while
+    the [bins, B] one-hot reduction stays a fused vector op. Integer
+    adds either way, so the counts are bit-identical."""
+    if tel is None:
+        return None
+    n_bins = tel.occupancy.shape[0]
+    one_hot = (jnp.clip(votes_count, 0, n_bins - 1)[None, :]
+               == jnp.arange(n_bins, dtype=jnp.int32)[:, None])
+    local = jnp.sum(one_hot * newly.astype(jnp.int32)[None, :],
+                    axis=1, dtype=jnp.int32)
+    occ = local if slot_axis is None else jax.lax.psum(local, slot_axis)
+    return tel._replace(
+        shard_committed=tel.shard_committed
+        + newly.sum(dtype=jnp.int32),
+        occupancy=tel.occupancy + occ)
+
+
+def drain_update(tel: Optional[TelemetryState], *,
+                 proposed_block: jax.Array,
+                 lane_valid: Optional[jax.Array],
+                 lag: jax.Array,
+                 slot_axis: Optional[str]) -> Optional[TelemetryState]:
+    """Accumulate the once-per-drain counters: valid proposals, pad-lane
+    waste, the end-of-drain watermark-lag bucket, and the drain count.
+    ``lag`` must be mesh-replicated (it derives from ``committed``)."""
+    if tel is None:
+        return None
+
+    def _global(x):
+        return x if slot_axis is None else jax.lax.psum(x, slot_axis)
+
+    valid = _global((proposed_block != 0).sum(dtype=jnp.int32))
+    if lane_valid is None:
+        pads = tel.pad_lanes
+    else:
+        pads = tel.pad_lanes + _global(
+            (~lane_valid).sum(dtype=jnp.int32))
+    return tel._replace(
+        proposed=tel.proposed + valid,
+        pad_lanes=pads,
+        lag_hist=tel.lag_hist.at[lag_bucket(lag)].add(1),
+        drains=tel.drains + 1)

@@ -69,10 +69,21 @@ def from_row(watermarks: np.ndarray, tails: np.ndarray,
     return InstancePrefixSet(len(columns), columns)
 
 
+def _count(metrics, nsets: int, fell_back: bool) -> None:
+    """paxruns runtime metrics (obs/trace.py): dep columns routed
+    through the batched engine, and sparse-span host fallbacks."""
+    if metrics is None:
+        return
+    metrics.depset_batch(nsets)
+    if fell_back:
+        metrics.depset_span_fallback()
+
+
 def union_many(sets: list[InstancePrefixSet],
-               num_replicas: int) -> InstancePrefixSet:
+               num_replicas: int, metrics=None) -> InstancePrefixSet:
     """Union of all sets, reduced on device (host fallback on overflow)."""
     batch = to_batch(sets, num_replicas)
+    _count(metrics, len(sets), batch is None)
     if batch is None:
         union = InstancePrefixSet(num_replicas)
         for instance_set in sets:
@@ -85,10 +96,12 @@ def union_many(sets: list[InstancePrefixSet],
 
 
 def conflict_max_many(seq_deps: list[tuple[int, InstancePrefixSet]],
-                      num_replicas: int) -> tuple[int, InstancePrefixSet]:
+                      num_replicas: int,
+                      metrics=None) -> tuple[int, InstancePrefixSet]:
     """Quorum (max sequence number, union deps) as ONE fused device
     reduction (ops/depset.conflict_max); host fallback on overflow."""
     batch = to_batch([deps for _, deps in seq_deps], num_replicas)
+    _count(metrics, len(seq_deps), batch is None)
     if batch is None:
         union = InstancePrefixSet(num_replicas)
         for _, deps in seq_deps:
@@ -104,13 +117,14 @@ def conflict_max_many(seq_deps: list[tuple[int, InstancePrefixSet]],
 
 
 def all_identical(seq_deps: list[tuple[int, InstancePrefixSet]],
-                  num_replicas: int) -> bool:
+                  num_replicas: int, metrics=None) -> bool:
     """Do all (sequence number, deps) pairs denote the same set?"""
     if len(seq_deps) <= 1:
         return True
     if len({seq for seq, _ in seq_deps}) > 1:
         return False
     batch = to_batch([deps for _, deps in seq_deps], num_replicas)
+    _count(metrics, len(seq_deps), batch is None)
     if batch is None:
         first = seq_deps[0][1]
         return all(deps == first for _, deps in seq_deps[1:])

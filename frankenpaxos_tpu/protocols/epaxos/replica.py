@@ -327,7 +327,8 @@ class EPaxosReplica(Actor):
             sequence_number, dependencies = device_deps.conflict_max_many(
                 [(r.sequence_number, r.dependencies)
                  for r in state.responses.values()],
-                self.config.n)
+                self.config.n,
+                metrics=self.transport.runtime_metrics)
         else:
             sequence_number = max(r.sequence_number
                                   for r in state.responses.values())
@@ -567,8 +568,9 @@ class EPaxosReplica(Actor):
                 # device equality over the normalized dep sets.
                 from frankenpaxos_tpu.protocols.epaxos import device_deps
                 winner = (seq_deps[0]
-                          if device_deps.all_identical(seq_deps,
-                                                       self.config.n)
+                          if device_deps.all_identical(
+                              seq_deps, self.config.n,
+                              metrics=self.transport.runtime_metrics)
                           else None)
             else:
                 counts = _Counter(seq_deps)

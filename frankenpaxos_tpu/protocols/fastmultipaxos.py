@@ -412,11 +412,15 @@ class FastMultiPaxosLeader(Actor):
         # the LIVE config (runs/quorums.py).
         specs = fast_flexible_specs(config.n, config.classic_quorum_size,
                                     config.fast_quorum_size)
-        self.classic_quorum = SpecChecker(specs.classic,
-                                          options.quorum_backend)
-        self.fast_quorum = SpecChecker(specs.fast, options.quorum_backend)
-        self.recovery_quorum = SpecChecker(specs.recovery,
-                                           options.quorum_backend)
+        self.classic_quorum = SpecChecker(
+            specs.classic, options.quorum_backend,
+            metrics=lambda: transport.runtime_metrics)
+        self.fast_quorum = SpecChecker(
+            specs.fast, options.quorum_backend,
+            metrics=lambda: transport.runtime_metrics)
+        self.recovery_quorum = SpecChecker(
+            specs.recovery, options.quorum_backend,
+            metrics=lambda: transport.runtime_metrics)
         self.leader_id = list(config.leader_addresses).index(address)
         self.round = 0 if config.round_system.leader(0) == self.leader_id \
             else -1

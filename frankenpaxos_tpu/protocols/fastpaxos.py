@@ -98,8 +98,12 @@ class FastPaxosLeader(Actor):
         # votes among the phase-1 replies).
         specs = fast_flexible_specs(config.n, config.classic_quorum_size,
                                     config.fast_quorum_size)
-        self.classic_quorum = SpecChecker(specs.classic, quorum_backend)
-        self.recovery_quorum = SpecChecker(specs.recovery, quorum_backend)
+        self.classic_quorum = SpecChecker(
+            specs.classic, quorum_backend,
+            metrics=lambda: transport.runtime_metrics)
+        self.recovery_quorum = SpecChecker(
+            specs.recovery, quorum_backend,
+            metrics=lambda: transport.runtime_metrics)
         self.index = list(config.leader_addresses).index(address)
         self.round = self.index
         self.status = "idle"
@@ -277,7 +281,8 @@ class FastPaxosClient(Actor):
         self.fast_quorum = SpecChecker(
             fast_flexible_specs(config.n, config.classic_quorum_size,
                                 config.fast_quorum_size).fast,
-            quorum_backend)
+            quorum_backend,
+            metrics=lambda: transport.runtime_metrics)
         self.proposed_value: Optional[str] = None
         self.chosen_value: Optional[str] = None
         self.phase2b_responses: dict[int, Phase2b] = {}

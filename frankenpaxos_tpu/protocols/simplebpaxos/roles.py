@@ -112,7 +112,8 @@ class BPaxosLeader(Actor):
             from frankenpaxos_tpu.protocols.epaxos import device_deps
             dependencies = device_deps.union_many(
                 [r.dependencies for r in state[2].values()],
-                len(self.config.leader_addresses))
+                len(self.config.leader_addresses),
+                metrics=self.transport.runtime_metrics)
         else:
             dependencies = VertexIdPrefixSet(
                 len(self.config.leader_addresses))

@@ -111,16 +111,26 @@ class SpecChecker:
     bit-identical; the sims default to host.
     """
 
-    def __init__(self, spec: QuorumSpec, backend: str = "host"):
+    def __init__(self, spec: QuorumSpec, backend: str = "host",
+                 metrics=None):
         if backend not in ("host", "tpu"):
             raise ValueError(f"unknown quorum backend {backend!r}")
         self.spec = spec
         self.backend = backend
         self._device = None
+        # Zero-arg callable -> the owning role's RuntimeMetrics (or
+        # None): resolved per check because the CLI attaches
+        # transport.runtime_metrics after some roles construct their
+        # checkers.
+        self.metrics = metrics
 
     def check_batch(self, present: np.ndarray) -> np.ndarray:
         """``[B, N]`` responder rows -> ``[B]`` bool."""
         present = np.asarray(present, dtype=np.uint8)
+        if self.metrics is not None:
+            metrics = self.metrics()
+            if metrics is not None:
+                metrics.fastquorum_check(present.shape[0])
         if self.backend == "tpu":
             if self._device is None:
                 from frankenpaxos_tpu.ops.quorum import (

@@ -32,7 +32,11 @@ _DATASOURCE = {"type": "prometheus", "uid": "${DS_PROMETHEUS}"}
 
 
 def _panel(panel_id: int, title: str, expr: str, legend: str, unit: str,
-           x: int, y: int, w: int = 12) -> dict:
+           x: int, y: int, w: int = 12, extra: list = ()) -> dict:
+    targets = [{"expr": expr, "legendFormat": legend, "refId": "A"}]
+    for n, (more_expr, more_legend) in enumerate(extra):
+        targets.append({"expr": more_expr, "legendFormat": more_legend,
+                        "refId": chr(ord("B") + n)})
     return {
         "id": panel_id,
         "type": "timeseries",
@@ -40,7 +44,7 @@ def _panel(panel_id: int, title: str, expr: str, legend: str, unit: str,
         "gridPos": {"h": 8, "w": w, "x": x, "y": y},
         "datasource": _DATASOURCE,
         "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
-        "targets": [{"expr": expr, "legendFormat": legend, "refId": "A"}],
+        "targets": targets,
     }
 
 
@@ -59,9 +63,10 @@ RUNTIME_ROW_TITLE = ("Runtime (drain stages / queue depth / WAL fsync / "
 #: Total grid height of the runtime row: header (1) + the paxtrace
 #: band (8) + the paxload admission band (8) + the paxwire transport
 #: band (8) + the paxworld global-serving band (8) + the paxingest
-#: ingestion band (8). dashboard() and inject_runtime_row() both lay
-#: out protocol panels below this line.
-RUNTIME_ROW_H = 41
+#: ingestion band (8) + the paxpulse device-pipeline band (8).
+#: dashboard() and inject_runtime_row() both lay out protocol panels
+#: below this line.
+RUNTIME_ROW_H = 49
 
 
 def runtime_row_panels(y: int = 0) -> list:
@@ -107,6 +112,34 @@ def runtime_row_panels(y: int = 0) -> list:
         "legendFormat": "inflight {{role}}",
         "refId": "B",
     })
+    commit_rate = _panel(
+        9016, "Device pipeline: committed / proposed rate",
+        "sum by (role) (rate(fpx_pipeline_committed_total[5s]))",
+        "committed {{role}}", "ops", x=0, y=y + 41, w=4,
+        extra=[
+            ("sum by (role) (rate(fpx_pipeline_proposed_total[5s]))",
+             "proposed {{role}}"),
+            ("sum by (role) (rate(fpx_pipeline_drains_total[5s]))",
+             "drains {{role}}"),
+        ])
+    shard_band = _panel(
+        9017, "Device pipeline: per-shard committed + skew",
+        "fpx_pipeline_shard_committed",
+        "shard {{shard}}", "short", x=4, y=y + 41, w=4,
+        extra=[("fpx_pipeline_shard_skew_ratio",
+                "skew {{role}}")])
+    lag_band = _panel(
+        9019, "Device pipeline: watermark lag + pad waste",
+        "sum by (bucket) "
+        "(rate(fpx_pipeline_watermark_lag_total[5s]))",
+        "lag bucket {{bucket}}", "ops", x=12, y=y + 41, w=4,
+        extra=[("sum by (role) "
+                "(rate(fpx_pipeline_pad_lanes_total[5s]))",
+                "pad lanes {{role}}")])
+    fill_band = _panel(
+        9020, "Device pipeline: proposal batch fill",
+        "fpx_pipeline_batch_fill",
+        "fill {{role}}", "percentunit", x=16, y=y + 41, w=4)
     return [
         {
             "id": 9000,
@@ -141,15 +174,20 @@ def runtime_row_panels(y: int = 0) -> list:
             "fpx_runtime_transport_frames_per_writev",
             "{{role}}", "short", x=0, y=y + 17, w=8),
         _panel(
-            9009, "Transport: coalesced acks/s",
+            9009, "Transport: coalesced acks/s + outbound stalls",
             "sum by (role) "
             "(rate(fpx_runtime_transport_coalesced_acks_total[5s]))",
-            "{{role}}", "ops", x=8, y=y + 17, w=8),
+            "{{role}}", "ops", x=8, y=y + 17, w=8,
+            extra=[("sum by (role) "
+                    "(rate(fpx_runtime_outbound_stalls_total[5s]))",
+                    "{{role}} stalls")]),
         _panel(
-            9010, "Transport: batched bytes/s",
+            9010, "Transport: batched bytes/s + outbound buffer",
             "sum by (role) "
             "(rate(fpx_runtime_transport_batch_bytes[5s]))",
-            "{{role}}", "Bps", x=16, y=y + 17, w=8),
+            "{{role}}", "Bps", x=16, y=y + 17, w=8,
+            extra=[("fpx_runtime_outbound_buffer_bytes",
+                    "{{role}} outbound hwm")]),
         # paxworld global-serving band (scenarios/, docs/GLOBAL.md):
         # per-region committed goodput vs rejected/shed load -- the
         # fleet view the SLO matrix gates in CI.
@@ -184,6 +222,36 @@ def runtime_row_panels(y: int = 0) -> list:
             "sum by (role) "
             "(rate(fpx_runtime_ingest_batch_fill_count[5s]))",
             "{{role}}", "short", x=16, y=y + 33, w=8),
+        # paxpulse device-pipeline band (ops/telemetry.py +
+        # obs/telemetry.py, docs/OBSERVABILITY.md): the counters that
+        # ride INSIDE the jitted drain loop as arrays and reach the
+        # host through one batched collect() per reporting interval --
+        # commit/propose rates, per-shard skew, quorum-progress
+        # occupancy, watermark lag, pad-lane waste, proposal fill, and
+        # the paxruns depset/fast-quorum counters the runs/ layer
+        # exports.
+        commit_rate,
+        shard_band,
+        _panel(
+            9018, "Device pipeline: quorum occupancy (votes at choose)",
+            "sum by (votes) "
+            "(rate(fpx_pipeline_quorum_occupancy_total[5s]))",
+            "{{votes}} votes", "ops", x=8, y=y + 41, w=4),
+        lag_band,
+        fill_band,
+        _panel(
+            9021, "Depset / fast-quorum engine",
+            "sum by (role) "
+            "(rate(fpx_runtime_depset_batched_deps_total[5s]))",
+            "deps {{role}}", "ops", x=20, y=y + 41, w=4,
+            extra=[
+                ("sum by (role) (rate("
+                 "fpx_runtime_depset_span_fallbacks_total[5s]))",
+                 "span fallback {{role}}"),
+                ("sum by (role) (rate("
+                 "fpx_runtime_fastquorum_checks_total[5s]))",
+                 "fastquorum checks {{role}}"),
+            ]),
     ]
 
 

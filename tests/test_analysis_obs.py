@@ -1,0 +1,111 @@
+"""OBS13xx metric-name drift rules (analysis/obs_rules.py).
+
+Fixture projects pair a fake package (metric registrations) with a
+fake ``grafana/`` tree (generator + dashboard JSON) under the same
+root, mirroring the real repo layout.
+"""
+
+import textwrap
+
+from frankenpaxos_tpu.analysis.core import Project, run_rules
+
+
+def project(tmp_path, files: dict, grafana: dict = ()) -> Project:
+    """{relative path under pkg/: source} + {path under grafana/: text}."""
+    for rel, source in files.items():
+        path = tmp_path / "pkg" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    for rel, text in dict(grafana or {}).items():
+        path = tmp_path / "grafana" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    return Project(str(tmp_path), package="pkg")
+
+
+def obs(findings) -> list:
+    return [f for f in findings if f.rule.startswith("OBS13")]
+
+
+REGISTER = """
+    def wire(collectors):
+        return collectors.counter(
+            "fpx_demo_commits_total", help="commits", labels=("role",))
+"""
+
+CHART = """
+    {"panels": [{"targets": [
+        {"expr": "sum by (role) (rate(fpx_demo_commits_total[5s]))"}]}]}
+"""
+
+
+def test_obs1301_charted_but_never_exported(tmp_path):
+    findings = obs(run_rules(project(
+        tmp_path, {"m.py": "x = 1\n"},
+        grafana={"dashboards/demo.json": CHART})))
+    assert [f.rule for f in findings] == ["OBS1301"]
+    assert findings[0].detail == "fpx_demo_commits_total"
+    assert findings[0].file == "grafana/dashboards/demo.json"
+
+
+def test_obs1302_exported_but_never_charted(tmp_path):
+    findings = obs(run_rules(project(tmp_path, {"m.py": REGISTER})))
+    assert [f.rule for f in findings] == ["OBS1302"]
+    assert findings[0].detail == "fpx_demo_commits_total"
+    assert findings[0].file == "pkg/m.py"
+
+
+def test_matched_pair_is_clean(tmp_path):
+    findings = obs(run_rules(project(
+        tmp_path, {"m.py": REGISTER},
+        grafana={"dashboards/demo.json": CHART,
+                 "generate_dashboards.py": "EXPR = 'fpx_demo_commits_total'\n"})))
+    assert findings == []
+
+
+def test_histogram_children_resolve_to_base(tmp_path):
+    findings = obs(run_rules(project(
+        tmp_path,
+        {"m.py": """
+            def wire(collectors):
+                return collectors.histogram(
+                    "fpx_demo_latency_seconds", help="lat")
+         """},
+        grafana={"dashboards/demo.json": """
+            {"panels": [{"targets": [{"expr":
+              "histogram_quantile(0.99, rate(fpx_demo_latency_seconds_bucket[5s]))"},
+              {"expr": "rate(fpx_demo_latency_seconds_sum[5s]) / rate(fpx_demo_latency_seconds_count[5s])"}
+            ]}]}
+         """})))
+    assert findings == []
+
+
+def test_counter_child_suffix_does_not_resolve(tmp_path):
+    # Only histograms/summaries export suffixed children: charting a
+    # _bucket form of a plain counter is drift, not a child series.
+    findings = obs(run_rules(project(
+        tmp_path, {"m.py": REGISTER},
+        grafana={"dashboards/demo.json": CHART + """
+            {"expr": "rate(fpx_demo_commits_total_bucket[5s])"}
+         """})))
+    assert [(f.rule, f.detail) for f in findings] == [
+        ("OBS1301", "fpx_demo_commits_total_bucket")]
+
+
+def test_obs1302_pragma_suppresses(tmp_path):
+    findings = obs(run_rules(project(tmp_path, {"m.py": """
+        def wire(collectors):
+            # paxlint: disable=OBS1302
+            return collectors.gauge("fpx_demo_scrape_only", help="dbg")
+    """})))
+    assert findings == []
+
+
+def test_prose_prefix_token_is_not_a_series(tmp_path):
+    # A trailing-underscore fragment like "fpx_runtime_" in generator
+    # prose must not register as a charted series.
+    findings = obs(run_rules(project(
+        tmp_path, {"m.py": "x = 1\n"},
+        grafana={"generate_dashboards.py":
+                 "# every fpx_runtime_ series gets a panel\n"})))
+    assert findings == []

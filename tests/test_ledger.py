@@ -1,0 +1,228 @@
+"""Perf-trajectory ledger (bench/ledger.py): extraction, append-only
+history, and the --check tolerance/label/methodology discipline."""
+
+import json
+
+import pytest
+
+from frankenpaxos_tpu.bench import ledger as ledger_mod
+from frankenpaxos_tpu.bench.ledger import (
+    check_against_ledger,
+    extract_rows,
+    load_ledger,
+    main,
+    save_ledger,
+    update_ledger,
+)
+
+
+def _depset_artifact(ratio_1024=5.0, ratio_4096=6.5, passed=True,
+                     methodology="paired alternating-chunk A/B",
+                     smoke=False):
+    return {
+        "benchmark": "depset_lt",
+        "smoke": smoke,
+        "methodology": methodology,
+        "gates": {
+            "gate_passed": passed,
+            "oracle_bit_identical": True,
+            "throughput_2x_passed": passed,
+            "throughput_ratio_at_ge_1024": {"1024": ratio_1024,
+                                            "4096": ratio_4096},
+        },
+    }
+
+
+def _multichip_artifact(speedup=1.1, host_mesh=True):
+    return {
+        "kind": "multichip_lt",
+        "mode": "full",
+        "degraded": False,
+        "host_mesh": host_mesh,
+        "mesh_shape": {"group": 1, "slot": 8},
+        "methodology": "alternating-chunk paired A/B",
+        "arms": {"window_1m": {"speedup": speedup},
+                 "window_8m": {"speedup": speedup}},
+        "per_shard_latency": {"worst_shard_p50_us": 2000.0},
+        "gates_pass": True,
+    }
+
+
+def _write(tmp_path, name, artifact):
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps(artifact))
+    return path
+
+
+def _fresh_ledger(tmp_path, artifacts: dict):
+    results = tmp_path / "committed"
+    results.mkdir()
+    for name, art in artifacts.items():
+        _write(results, name, art)
+    ledger = load_ledger(str(tmp_path / "LEDGER.json"))
+    update_ledger(ledger, str(results), tag="seed")
+    return ledger, results
+
+
+def _statuses(results):
+    return {(r.bench, r.metric): r.status for r in results}
+
+
+def test_extract_wildcard_rows():
+    rows = extract_rows("depset_lt", _depset_artifact())
+    metrics = {r.metric: r.value for r in rows}
+    assert metrics["gates.throughput_ratio_at_ge_1024.1024"] == 5.0
+    assert metrics["gates.throughput_ratio_at_ge_1024.4096"] == 6.5
+    assert metrics["gates.gate_passed"] is True
+
+
+def test_update_is_append_only_and_idempotent(tmp_path):
+    ledger, results = _fresh_ledger(tmp_path,
+                                    {"depset_lt": _depset_artifact()})
+    row = next(r for r in ledger["rows"]
+               if r["metric"] == "gates.throughput_ratio_at_ge_1024.1024")
+    assert [h["value"] for h in row["history"]] == [5.0]
+    # Same artifact -> no new point.
+    stats = update_ledger(ledger, str(results), tag="again")
+    assert stats["appended"] == 0
+    # Changed artifact -> one appended point, old one untouched.
+    _write(results, "depset_lt", _depset_artifact(ratio_1024=5.5))
+    update_ledger(ledger, str(results), tag="pr2")
+    assert [h["value"] for h in row["history"]] == [5.0, 5.5]
+    assert [h["tag"] for h in row["history"]] == ["seed", "pr2"]
+
+
+def test_check_passes_within_band(tmp_path):
+    ledger, _ = _fresh_ledger(tmp_path, {"depset_lt": _depset_artifact()})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    # 20% below committed: inside the 35% band.
+    _write(fresh, "depset_lt", _depset_artifact(ratio_1024=4.0))
+    results = check_against_ledger(ledger, str(fresh))
+    assert all(r.status == "pass" for r in results), _statuses(results)
+
+
+def test_check_fails_on_regression_negative(tmp_path):
+    """THE negative test: a synthetic >tolerance regression must fail,
+    both via the API and via the CLI exit code CI keys on."""
+    ledger, _ = _fresh_ledger(tmp_path, {"depset_lt": _depset_artifact()})
+    ledger_path = tmp_path / "LEDGER.json"
+    save_ledger(ledger, str(ledger_path))
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    # 5.0 -> 1.0 is far past the 35% band.
+    _write(fresh, "depset_lt", _depset_artifact(ratio_1024=1.0))
+    results = check_against_ledger(ledger, str(fresh))
+    statuses = _statuses(results)
+    assert statuses[("depset_lt",
+                     "gates.throughput_ratio_at_ge_1024.1024")] == "fail"
+    assert statuses[("depset_lt",
+                     "gates.throughput_ratio_at_ge_1024.4096")] == "pass"
+    assert main(["--check", "--ledger", str(ledger_path),
+                 "--fresh", str(fresh)]) == 1
+
+
+def test_check_fails_on_bool_regression(tmp_path):
+    ledger, _ = _fresh_ledger(tmp_path, {"depset_lt": _depset_artifact()})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    _write(fresh, "depset_lt", _depset_artifact(passed=False))
+    statuses = _statuses(check_against_ledger(ledger, str(fresh)))
+    assert statuses[("depset_lt", "gates.gate_passed")] == "fail"
+
+
+def test_host_mesh_rows_never_compare_against_hardware(tmp_path):
+    """A committed host-mesh row is a different experiment from a
+    hardware run: labeled SKIP, not a comparison either way."""
+    ledger, _ = _fresh_ledger(
+        tmp_path, {"multichip_lt": _multichip_artifact(host_mesh=True)})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    # Hardware run, wildly "regressed" vs the host-mesh number.
+    _write(fresh, "multichip_lt",
+           _multichip_artifact(speedup=0.1, host_mesh=False))
+    results = check_against_ledger(ledger, str(fresh))
+    gated = [r for r in results if r.status in ("pass", "fail")]
+    assert gated == []
+    skip = next(r for r in results
+                if r.metric == "arms.window_1m.speedup")
+    assert skip.status == "skip" and "host_mesh" in skip.reason
+
+
+def test_methodology_drift_is_labeled_skip(tmp_path):
+    ledger, _ = _fresh_ledger(tmp_path, {"depset_lt": _depset_artifact()})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    _write(fresh, "depset_lt",
+           _depset_artifact(ratio_1024=1.0, methodology="NEW estimator"))
+    results = check_against_ledger(ledger, str(fresh))
+    assert {r.status for r in results
+            if r.metric.startswith("gates.throughput")} == {"skip"}
+
+
+def test_smoke_mismatch_widens_band(tmp_path):
+    ledger, _ = _fresh_ledger(tmp_path, {"depset_lt": _depset_artifact()})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    # 45% below: outside the 35% band but inside 35%+25% smoke slack.
+    _write(fresh, "depset_lt",
+           _depset_artifact(ratio_1024=5.0 * 0.55, smoke=True))
+    statuses = _statuses(check_against_ledger(ledger, str(fresh)))
+    assert statuses[("depset_lt",
+                     "gates.throughput_ratio_at_ge_1024.1024")] == "pass"
+
+
+def test_smoke_mismatch_makes_bool_rows_labeled_skip(tmp_path):
+    """A reduced run's gate verdict is not the committed gate: under a
+    smoke/full mismatch bool rows skip (the widened numeric rows carry
+    the regression coverage), and a smoke gate 'failure' cannot fail
+    the check."""
+    ledger, _ = _fresh_ledger(tmp_path, {"depset_lt": _depset_artifact()})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    _write(fresh, "depset_lt",
+           _depset_artifact(passed=False, smoke=True))
+    results = check_against_ledger(ledger, str(fresh))
+    statuses = _statuses(results)
+    assert statuses[("depset_lt", "gates.gate_passed")] == "skip"
+    skip = next(r for r in results if r.metric == "gates.gate_passed")
+    assert "smoke" in skip.reason
+    assert not any(r.status == "fail" for r in results), _statuses(results)
+
+
+def test_info_rows_are_never_gated(tmp_path):
+    art = {"benchmark": "protocol_lt", "methodology": "m",
+           "protocols": {"echo": {"throughput_p90_1s": 3000.0,
+                                  "latency_median_ms": 3.0}}}
+    ledger, _ = _fresh_ledger(tmp_path, {"protocol_lt": art})
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    art2 = json.loads(json.dumps(art))
+    art2["protocols"]["echo"]["throughput_p90_1s"] = 1.0  # 3000x "worse"
+    _write(fresh, "protocol_lt", art2)
+    results = check_against_ledger(ledger, str(fresh))
+    assert {r.status for r in results} == {"info"}
+
+
+def test_every_committed_artifact_has_ledger_rows():
+    """Acceptance: the committed LEDGER.json carries rows for every
+    existing bench_results/*_lt.json headline (plus trace_overhead)."""
+    import glob
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ledger = load_ledger(os.path.join(repo, "bench_results", "LEDGER.json"))
+    covered = {r["bench"] for r in ledger["rows"]}
+    for path in glob.glob(os.path.join(repo, "bench_results", "*_lt.json")):
+        bench = os.path.basename(path)[:-len(".json")]
+        assert bench in ledger_mod.HEADLINES, bench
+        assert bench in covered, bench
+    assert "trace_overhead" in covered
+    for row in ledger["rows"]:
+        assert row["history"], (row["bench"], row["metric"])
+
+
+def test_cli_requires_exactly_one_mode(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["--update", "--check"])
+    with pytest.raises(SystemExit):
+        main([])

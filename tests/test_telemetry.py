@@ -1,0 +1,286 @@
+"""paxpulse device-telemetry plane (ops/telemetry.py + the pipeline
+weave + obs/telemetry.py's one-batched-fetch host side).
+
+Three contracts:
+
+  * **Host-oracle recount** -- the on-device counters are exact, not
+    sampled: occupancy and shard_committed both re-add to the committed
+    watermark, proposed/drains/lag tallies are exact, and pad lanes
+    count ZERO on divisible splits and exactly (padded - block) per
+    drain on non-divisible ones.
+  * **Off == absent** -- telemetry off is a ``None`` leaf: the traced
+    program is the pre-paxpulse one and every non-telemetry output is
+    bit-identical, on 1x1 and across mesh shapes including the
+    non-divisible 2x3.
+  * **One batched D2H per interval** -- stepping never fetches;
+    ``obs.collect`` fetches exactly once (guarded with
+    ``jax.transfer_guard_device_to_host`` for real accelerators, and by
+    counting ``jax.device_get`` calls, which is what the CPU backend
+    can enforce).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.bench.pipeline import (
+    make_sharded_state,
+    make_sharded_step,
+    make_state,
+    steady_state_step,
+)
+from frankenpaxos_tpu.obs.telemetry import collect, TelemetrySnapshot
+from frankenpaxos_tpu.ops.telemetry import (
+    lag_bucket_bounds,
+    LAG_BUCKETS,
+    make_telemetry,
+    TelemetryState,
+)
+from frankenpaxos_tpu.quorums import SimpleMajority
+
+
+def _spec(n_acc):
+    return SimpleMajority(range(n_acc)).write_spec().as_arrays()
+
+
+def _run_1x1(window, block, iters, n_acc=3, telemetry=True):
+    masks, thresholds, combine_any = _spec(n_acc)
+    step = jax.jit(lambda s, i: steady_state_step(
+        s, i, block_size=block, masks=masks, thresholds=thresholds,
+        combine_any=combine_any))
+    state = make_state(window, n_acc, telemetry=telemetry)
+    for t in range(iters):
+        state = step(state, jnp.int32(t))
+    return jax.device_get(state)
+
+
+def _run_mesh(group_dim, slot_dim, window, block, iters, n_acc=6,
+              telemetry=True):
+    devices = np.asarray(jax.devices()[:group_dim * slot_dim])
+    mesh = Mesh(devices.reshape(group_dim, slot_dim), ("group", "slot"))
+    masks, thresholds, combine_any = _spec(n_acc)
+    step, _ = make_sharded_step(
+        mesh, block_size=block, masks=masks, thresholds=thresholds,
+        combine_any=combine_any, telemetry=telemetry)
+    state, _, _ = make_sharded_state(mesh, window, block, n_acc,
+                                     telemetry=telemetry)
+    for t in range(iters):
+        state = step(state, jnp.int32(t))
+    return jax.device_get(state)
+
+
+def _assert_recount(state, *, block, iters, pad_per_drain, shards):
+    """The host oracle: every counter re-derives from committed/drains."""
+    tel = state.telemetry
+    committed = int(state.committed)
+    assert committed == iters * block
+    assert int(tel.drains) == iters
+    # Every committed slot was counted once, at choose time, in exactly
+    # one occupancy bin.
+    assert int(tel.occupancy.sum()) == committed
+    # Per-shard committed counters re-add to the global watermark.
+    shard = np.asarray(tel.shard_committed)
+    assert shard.shape == (shards,)
+    assert int(shard.sum()) == committed
+    # The workload proposes a full block of nonzero commands per drain.
+    assert int(tel.proposed) == iters * block
+    # Pad lanes are a PHYSICAL artifact: zero on divisible splits,
+    # exactly (padded_block - block) per drain otherwise.
+    assert int(tel.pad_lanes) == pad_per_drain * iters
+    # One lag sample per drain, each in exactly one bucket.
+    assert tel.lag_hist.shape == (LAG_BUCKETS,)
+    assert int(tel.lag_hist.sum()) == iters
+
+
+def test_recount_1x1():
+    state = _run_1x1(window=1 << 10, block=1 << 7, iters=6)
+    _assert_recount(state, block=1 << 7, iters=6, pad_per_drain=0,
+                    shards=1)
+
+
+def test_recount_2x4_divisible(need_8_devices):
+    state = _run_mesh(2, 4, window=1 << 10, block=1 << 7, iters=6)
+    _assert_recount(state, block=1 << 7, iters=6, pad_per_drain=0,
+                    shards=4)
+
+
+def test_recount_2x3_pad_lanes(need_8_devices):
+    # block=100 over 3 slot shards -> b_local=34, padded block 102:
+    # exactly 2 pad lanes per drain, never counted as commits.
+    state = _run_mesh(2, 3, window=1000, block=100, iters=10)
+    _assert_recount(state, block=100, iters=10, pad_per_drain=2,
+                    shards=3)
+
+
+@pytest.mark.parametrize("pad_per_drain,block,slots",
+                         [(0, 128, 4), (2, 100, 3), (0, 96, 3)])
+def test_pad_lane_arithmetic_property(pad_per_drain, block, slots):
+    # The padding rule itself: pad lanes per drain = ceil-split excess.
+    b_local = -(-block // slots)
+    assert b_local * slots - block == pad_per_drain
+
+
+def _strip_tel(state):
+    return state._replace(telemetry=None)
+
+
+def _assert_bit_identical(a, b):
+    for name, av, bv in zip(a._fields, a, b):
+        if name == "telemetry":
+            continue
+        np.testing.assert_array_equal(np.asarray(av), np.asarray(bv),
+                                      err_msg=name)
+
+
+def test_on_off_bit_identity_1x1():
+    off = _run_1x1(window=1 << 9, block=1 << 6, iters=5, telemetry=False)
+    on = _run_1x1(window=1 << 9, block=1 << 6, iters=5, telemetry=True)
+    assert off.telemetry is None
+    assert on.telemetry is not None
+    assert int(off.committed) > 0
+    _assert_bit_identical(off, on)
+
+
+@pytest.mark.parametrize("shape,window,block",
+                         [((2, 4), 1 << 10, 1 << 7),
+                          ((2, 3), 1000, 100)])
+def test_on_off_bit_identity_mesh(need_8_devices, shape, window, block):
+    g, s = shape
+    off = _run_mesh(g, s, window, block, iters=6, telemetry=False)
+    on = _run_mesh(g, s, window, block, iters=6, telemetry=True)
+    assert off.telemetry is None
+    assert int(off.committed) > 0
+    _assert_bit_identical(off, on)
+
+
+def test_collect_is_one_batched_fetch(monkeypatch):
+    """Stepping performs zero D2H fetches; one collect() = exactly one
+    ``jax.device_get`` of the whole telemetry tree."""
+    masks, thresholds, combine_any = _spec(3)
+    block = 1 << 6
+    step = jax.jit(lambda s, i: steady_state_step(
+        s, i, block_size=block, masks=masks, thresholds=thresholds,
+        combine_any=combine_any))
+    state = make_state(1 << 9, 3, telemetry=True)
+    state = step(state, jnp.int32(0))  # compile outside the guard
+
+    calls = []
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        calls.append(x)
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    # On accelerator backends the transfer guard would fault any hidden
+    # per-drain fetch; on CPU the call count below is the enforcement.
+    with jax.transfer_guard_device_to_host("disallow"):
+        for t in range(1, 5):
+            state = step(state, jnp.int32(t))
+    assert calls == []
+
+    snap = collect(state)
+    assert len(calls) == 1
+    assert isinstance(calls[0], TelemetryState)
+    assert isinstance(snap, TelemetrySnapshot)
+    assert snap.drains == 5
+    assert snap.committed == 5 * block
+    assert sum(snap.occupancy) == snap.committed
+
+
+def test_off_traces_to_pinned_baseline_program():
+    """Telemetry off is not just bit-identical -- it traces to the
+    EXACT pre-paxpulse program (pinned in bench/pipeline_baseline.py),
+    op for op. This is the 'compiled out when disabled' contract."""
+    from frankenpaxos_tpu.bench import pipeline as live
+    from frankenpaxos_tpu.bench import pipeline_baseline as pinned
+
+    masks, thresholds, combine_any = _spec(3)
+    mt = tuple(tuple(int(x) for x in row) for row in masks)
+    tt = tuple(int(t) for t in thresholds)
+    jaxpr_live = jax.make_jaxpr(
+        lambda s, t: live.run_steps_from(s, t, 8, 128, mt, tt,
+                                         combine_any))(
+        live.make_state(1 << 10, 3), jnp.int32(0))
+    jaxpr_pinned = jax.make_jaxpr(
+        lambda s, t: pinned.run_steps_from(s, t, 8, 128, mt, tt,
+                                           combine_any))(
+        pinned.make_state(1 << 10, 3), jnp.int32(0))
+    assert str(jaxpr_live) == str(jaxpr_pinned)
+
+
+def test_collect_off_state_returns_none():
+    state = make_state(1 << 8, 3, telemetry=False)
+    assert collect(state) is None
+
+
+def test_snapshot_delta_and_skew():
+    a = TelemetrySnapshot(drains=4, proposed=400, shard_committed=(100, 98),
+                          occupancy=(0, 198), lag_hist=(4,) + (0,) * 15,
+                          pad_lanes=8)
+    b = TelemetrySnapshot(drains=6, proposed=600, shard_committed=(151, 147),
+                          occupancy=(0, 298), lag_hist=(6,) + (0,) * 15,
+                          pad_lanes=12)
+    d = b.delta(a)
+    assert d.drains == 2 and d.proposed == 200
+    assert d.shard_committed == (51, 49)
+    assert b.committed == 298
+    assert b.shard_skew() == pytest.approx(151 / 149)
+    assert b.batch_fill(100) == pytest.approx(1.0)
+    assert TelemetrySnapshot.from_json(b.to_json()) == b
+
+
+def test_lag_bucket_bounds_shape():
+    bounds = lag_bucket_bounds()
+    assert bounds.shape == (LAG_BUCKETS,)
+    assert bounds[0] == 0 and bounds[1] == 1
+    assert all(int(b) == 2 ** (i - 1) for i, b in enumerate(bounds[1:], 1))
+
+
+def test_make_telemetry_zeroed():
+    tel = make_telemetry(num_acceptors=5, slot_shards=3)
+    assert tel.shard_committed.shape == (3,)
+    assert int(sum(np.asarray(leaf).sum() for leaf in tel)) == 0
+
+
+def test_sigkill_postmortem_snapshots_telemetry(tmp_path):
+    """A SIGKILL'd label with a registered TelemetryReporter leaves a
+    ``<label>.telemetry.json`` post-mortem of the last device-counter
+    interval beside the flight ring -- and repeated kills number the
+    dumps instead of overwriting the first."""
+    import json
+    import subprocess
+    import sys
+
+    from frankenpaxos_tpu.bench.chaos import sigkill_role
+    from frankenpaxos_tpu.bench.harness import (BenchmarkDirectory,
+                                                LocalHost)
+    from frankenpaxos_tpu.obs.telemetry import TelemetryReporter
+
+    block = 1 << 6
+    state = _run_1x1(window=1 << 9, block=block, iters=4)
+    reporter = TelemetryReporter("pipeline_0", block_size=block)
+    reporter.collect(state, t=1.0)
+
+    bench = BenchmarkDirectory(str(tmp_path / "bench"))
+    bench.telemetry_reporters["pipeline_0"] = reporter
+    for _ in range(2):
+        bench.popen(LocalHost(), "pipeline_0",
+                    [sys.executable, "-c", "import time; time.sleep(60)"])
+        sigkill_role(bench, "pipeline_0")
+
+    with open(bench.abspath("pipeline_0.telemetry.json")) as f:
+        summary = json.load(f)
+    assert summary["collected"] is True
+    assert summary["committed"] == 4 * block
+    # Second kill numbered its dump, first post-mortem intact.
+    import os
+    assert os.path.exists(
+        bench.abspath("pipeline_0.telemetry.json.killed1"))
+    # No reporter registered -> no dump, kill still clean.
+    bench.popen(LocalHost(), "other",
+                [sys.executable, "-c", "import time; time.sleep(60)"])
+    sigkill_role(bench, "other")
+    assert not os.path.exists(bench.abspath("other.telemetry.json"))
