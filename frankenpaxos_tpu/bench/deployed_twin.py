@@ -63,6 +63,7 @@ from frankenpaxos_tpu.faults import (
     DeployedBackend,
     fsync_fault_args,
     fsync_stall_schedule,
+    ingest_handoff_schedule,
     run_wall,
     ScheduleRunner,
     zone_outage_schedule,
@@ -644,9 +645,172 @@ def run_fsync_stall_twin(out_dir: str, scale: TwinScale = SMOKE,
     return row
 
 
+class _MultiPaxosLaneClient:
+    """DeployedLaneDriver adapter over a multipaxos ``Client``: the
+    driver speaks ``write(pseudonym, payload, cb, key=...)``,
+    ``pending``, and patches ``_handle_rejected``; multipaxos routes
+    by (client, pseudonym) through the ingest ring, so the wpaxos
+    locality ``key`` is dropped and the pending map is ``states``.
+    The ``_handle_rejected`` property proxies to the INNER actor so
+    the driver's rejection hook patches the real dispatch path
+    (Client.receive looks the handler up on self)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def write(self, pseudonym: int, payload: bytes, callback,
+              key=None) -> None:
+        self._inner.write(pseudonym, payload, callback)
+
+    @property
+    def pending(self) -> dict:
+        return self._inner.states
+
+    @property
+    def _handle_rejected(self):
+        return self._inner._handle_rejected
+
+    @_handle_rejected.setter
+    def _handle_rejected(self, fn) -> None:
+        self._inner._handle_rejected = fn
+
+    @property
+    def fan(self):
+        return self._inner._fan
+
+
+def _handoff_clients(transport, config, seed: int, lanes: int = 3):
+    """One multipaxos client per lane on the shared transport, armed
+    with the twin retry discipline (budgeted retries, Rejected
+    backoff) and a 1s resend period -- the ring-failover detection
+    clock the clause budget is sized against."""
+    from frankenpaxos_tpu.protocols.multipaxos.client import (
+        Client,
+        ClientOptions,
+    )
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.serve.backoff import Backoff
+
+    logger = FakeLogger(LogLevel.FATAL)
+    options = ClientOptions(
+        resend_client_request_period_s=1.0, retry_budget=6,
+        backoff=Backoff(initial_s=0.1, max_s=1.0, multiplier=2.0,
+                        jitter=0.5))
+    clients = []
+    for z in range(lanes):
+        address = (transport.listen_address if z == 0
+                   else ("127.0.0.1", free_port()))
+        clients.append(_MultiPaxosLaneClient(Client(
+            address, transport, logger, config, options,
+            seed=seed + z)))
+    return clients
+
+
+def run_ingest_handoff_twin(out_dir: str, scale: TwinScale = SMOKE,
+                            seed: int = 0) -> dict:
+    """paxfan failover twin: SIGKILL ingest-batcher shard 1 of the
+    15-role multipaxos serving cluster MID-DESCRIPTOR-HANDOFF (staged
+    columns and un-credited IngestRuns die with the process), relaunch
+    after the dwell, wall-clock. The dead shard's pinned sessions must
+    fail over to the clockwise ring survivors on their resend timeout
+    (``failover_exercised`` asserts the ring actually moved) and the
+    WAL post-mortem must show the outage cost RETRIES, never acked
+    loss. Deployed-only: the sim chaos soak covers this plan's virtual
+    twin (tests/protocols/test_ingest_chaos.py), so the row records
+    its schedule digest with no sim cross-check."""
+    from frankenpaxos_tpu.bench.deployed_serving_lt import (
+        launch_multipaxos_serving,
+        wal_chosen_payloads_multipaxos,
+    )
+    from frankenpaxos_tpu.runtime import FakeLogger, LogLevel
+    from frankenpaxos_tpu.runtime.tcp_transport import TcpTransport
+
+    t_wall = time.time()
+    bench = BenchmarkDirectory(os.path.join(out_dir, "ingest_handoff"))
+    wal_dir = bench.abspath("wal")
+    raw, config, _labels = launch_multipaxos_serving(
+        bench, wal_dir=wal_dir,
+        admission_token_rate=40.0 * scale.per_zone_rate)
+    # The kill lands a quarter into the measured window: batcher 1 is
+    # mid-stream (staged commands + in-flight descriptor windows).
+    schedule = ingest_handoff_schedule(
+        t_kill=scale.warm_s + scale.duration_s / 4,
+        dwell_s=scale.outage_dwell_s, shard=1, seed=seed)
+    backend = DeployedBackend(bench,
+                              zone_roles={1: ["ingest_batcher_1"]})
+    runner = ScheduleRunner(schedule, backend)
+
+    transport = None
+    try:
+        transport = TcpTransport(("127.0.0.1", free_port()),
+                                 FakeLogger(LogLevel.FATAL))
+        transport.start()
+        clients = _handoff_clients(transport, config, seed)
+        lanes = []
+        for z, client in enumerate(clients):
+            workload = OpenLoopWorkload(
+                rate=scale.per_zone_rate, zipf_s=1.1, num_keys=8,
+                diurnal_amplitude=0.0,
+                diurnal_period_s=scale.duration_s,
+                diurnal_phase_s=-scale.warm_s)
+            lanes.append(TwinLane(f"lane-{z}", client, [b"x"],
+                                  workload))
+        driver = DeployedLaneDriver(transport, lanes, seed=seed)
+        chaos = run_wall(runner)
+        driver.run(scale.duration_s, scale.warm_s,
+                   scale.sessions_per_lane)
+        chaos.join(timeout=60)
+        pending = driver.settle(scale.settle_s)
+        stats = driver.lane_stats(scale.warm_s, scale.duration_s)
+        failovers = sum(c.fan.failovers for c in clients
+                        if c.fan is not None)
+        t_restart = next(
+            t for t, e in runner.fired if e.kind == "restart_zone")
+        recovery = driver.recovery_after(0, t_restart)
+    finally:
+        if transport is not None:
+            transport.stop()
+        bench.cleanup()
+
+    chosen = wal_chosen_payloads_multipaxos(wal_dir, raw)
+    lost = [p for p in driver.acked if p not in chosen]
+
+    offered = len(lanes) * scale.per_zone_rate
+    clauses = {
+        "goodput_floor": clause(stats["goodput_cmds_per_s"],
+                                0.5 * offered, "min"),
+        "zero_acked_write_loss": clause(len(lost), 0, "zero"),
+        "no_silent_wedge": clause(pending, 0, "zero"),
+        # The ring MOVED: at least one client suspected the dead shard
+        # and failed its keys over to a clockwise survivor.
+        "failover_exercised": clause(failovers, 1, "min"),
+    }
+    row = {
+        "scenario": "ingest_handoff/deployed",
+        "seed": seed,
+        "scale": scale.name,
+        "fault_schedule_sha256": schedule.digest(),
+        "wall_seconds": round(time.time() - t_wall, 1),
+        "stats": stats,
+        "events": {
+            "applied": backend.applied,
+            "ring_failovers": failovers,
+            "recovery_after_relaunch_s": recovery,
+            "acked_writes": len(driver.acked),
+            "wal_chosen_payloads": len(chosen),
+            "control_plane_never_shed": "structural (client-lane-only "
+                                        "shedding; tests/test_serve.py)",
+        },
+        "slo": clauses,
+    }
+    row["gate_passed"] = all(c["passed"] for c in clauses.values())
+    return row
+
+
 TWINS = {
     "zone_outage": run_zone_outage_twin,
     "fsync_stalls": run_fsync_stall_twin,
+    "ingest_handoff": run_ingest_handoff_twin,
 }
 
 

@@ -18,6 +18,7 @@ from frankenpaxos_tpu.faults.schedule import (  # noqa: F401
     FaultEvent,
     FaultSchedule,
     fsync_stall_schedule,
+    ingest_handoff_schedule,
     KINDS,
     ScheduleRunner,
     zone_outage_schedule,

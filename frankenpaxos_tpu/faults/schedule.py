@@ -215,6 +215,21 @@ def zone_outage_schedule(*, t_kill: float, dwell_s: float,
             .add(t_kill + dwell_s, "restart_zone", str(zone)))
 
 
+def ingest_handoff_schedule(*, t_kill: float, dwell_s: float,
+                            shard: int = 1,
+                            seed: int = 0) -> FaultSchedule:
+    """SIGKILL one ingest-batcher shard mid-descriptor-handoff (the
+    batcher holds staged commands and un-credited IngestRuns when the
+    signal lands), relaunch it ``dwell_s`` later -- the paxfan
+    failover plan: the dead shard's ring keys fail over to its
+    clockwise survivors on the clients' resend timeout, every other
+    key stays pinned, and the cost must be RETRIES, never acked
+    loss."""
+    return (FaultSchedule("ingest_handoff", seed=seed)
+            .add(t_kill, "crash_zone", str(shard))
+            .add(t_kill + dwell_s, "restart_zone", str(shard)))
+
+
 def fsync_stall_schedule(*, window_s: float = 0.15,
                          zone: int = 0,
                          periods: tuple = ((0, 0.8), (1, 2.4)),

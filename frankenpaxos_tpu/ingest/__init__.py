@@ -49,5 +49,15 @@ from frankenpaxos_tpu.ingest.columns import (  # noqa: F401
     parse_client_batch,
     value_view,
 )
-from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest  # noqa: F401
+from frankenpaxos_tpu.ingest.fan import (  # noqa: F401
+    BatcherRing,
+    shard_of_address,
+    ShardRouter,
+    stable_key,
+)
+from frankenpaxos_tpu.ingest.messages import (  # noqa: F401
+    IngestCredit,
+    IngestRun,
+    NotLeaderIngest,
+)
 from frankenpaxos_tpu.ingest.shard import command_ids, place_block, route_block  # noqa: F401

@@ -26,8 +26,11 @@ inactive leader bounces the run back as ``NotLeaderIngest``.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import random
+
+import numpy as np
 
 from frankenpaxos_tpu.ingest.columns import (
     CLIENT_ARRAY_TAG,
@@ -35,10 +38,19 @@ from frankenpaxos_tpu.ingest.columns import (
     parse_client_array,
     parse_client_batch,
 )
-from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest
+from frankenpaxos_tpu.ingest.messages import (
+    IngestCredit,
+    IngestRun,
+    NotLeaderIngest,
+)
 from frankenpaxos_tpu.runtime import Actor, Logger
 from frankenpaxos_tpu.runtime.paxwire import CLIENT_BATCH_TAG
 from frankenpaxos_tpu.runtime.transport import Address, Transport
+
+#: Cap on the distinct-session tracking set behind the
+#: fpx_runtime_ingest_shard_owned_keys gauge: past this the gauge
+#: saturates rather than the set growing with a million-session tier.
+_MAX_TRACKED_KEYS = 1 << 17
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +61,19 @@ class IngestBatcherOptions:
     #: Safety-net flush for staging that outlives a drain (0 disables;
     #: on both transports on_drain normally flushes every pass).
     flush_period_s: float = 0.01
+    #: paxfan descriptor pipelining: max un-credited IngestRuns in
+    #: flight per leader group. The batcher ships AHEAD of leader
+    #: acks up to this window (the leader drains several runs per
+    #: event-loop pass and replies with one watermark-granular
+    #: IngestCredit per drain); 0 disables the window (ship
+    #: immediately, unbounded -- the pre-paxfan behavior).
+    pipeline_window: int = 16
+    #: Consecutive blocked safety-net ticks before a wedged window
+    #: resets. Credits ride the control lane and survive client-lane
+    #: shedding, but a leader crash can still swallow them -- the
+    #: reset re-opens the window (duplicate deliveries stay
+    #: exactly-once through the replica client table).
+    pipeline_stall_ticks: int = 50
     # paxload admission control at the ingest edge (serve/admission.py):
     # all zeros admits everything and builds NO controller.
     admission_token_rate: float = 0.0
@@ -167,6 +192,30 @@ class IngestBatcher(Actor):
         # (group, IngestRun) bounced by inactive leaders, awaiting
         # leader discovery.
         self._pending_runs: list = []
+        # paxfan descriptor pipelining: per-group run sequencing, the
+        # in-flight (un-credited) seq sets bounding the window, the
+        # overflow queue of runs waiting for credit, and the stall
+        # escape. _last_leader detects failovers: a leader change
+        # voids that group's outstanding credits.
+        num_groups = router.num_groups
+        self._next_seq = [0] * num_groups
+        self._inflight: list = [set() for _ in range(num_groups)]
+        self._window_queue: list = [collections.deque()
+                                    for _ in range(num_groups)]
+        self._stall_ticks = [0] * num_groups
+        self._last_leader: list = [None] * num_groups
+        self.failovers = 0
+        # Shard telemetry: distinct sessions seen (capped) and this
+        # shard's structural ring share (skew = share * N; 1.0 = even).
+        self._seen_keys: set = set()
+        num_batchers = getattr(router.config, "num_ingest_batchers", 0)
+        if num_batchers > 1:
+            from frankenpaxos_tpu.ingest.fan import BatcherRing
+
+            share = BatcherRing(num_batchers).arc_share()
+            self.ring_skew = share[index % num_batchers] * num_batchers
+        else:
+            self.ring_skew = 1.0
         admission_options = options.admission_options()
         if admission_options is not None:
             from frankenpaxos_tpu.serve.admission import (
@@ -202,6 +251,35 @@ class IngestBatcher(Actor):
     def _timer_flush(self) -> None:
         if self._staged_columns or self._staged_commands:
             self.flush_ingest()
+        for group in range(self.router.num_groups):
+            if not self._window_queue[group]:
+                continue
+            if not self._inflight[group]:
+                self._pump(group)
+            elif self._bump_stall(group):
+                self._pump(group)
+            # Queued runs outlive this tick: keep the safety net armed.
+            self._flush_timer.stop()
+            self._flush_timer.start()
+
+    def _bump_stall(self, group: int) -> bool:
+        """Stall escape: runs queued, window full, no credit arriving.
+        Credits ride the control lane, but a crashed leader can still
+        swallow them -- after pipeline_stall_ticks consecutive blocked
+        ticks, void the window and ship (duplicate deliveries stay
+        exactly-once through the replica client table)."""
+        self._stall_ticks[group] += 1
+        if self._stall_ticks[group] < self.options.pipeline_stall_ticks:
+            return False
+        self.logger.warn(
+            f"ingest batcher {self.index}: pipeline window for group "
+            f"{group} wedged ({len(self._inflight[group])} un-credited "
+            "runs); resetting window")
+        self._inflight[group].clear()
+        self._stall_ticks[group] = 0
+        self.failovers += 1
+        self._note_failover()
+        return True
 
     def _handle_client_columns(self, src: Address,
                                colrun: ColumnRun) -> None:
@@ -221,6 +299,12 @@ class IngestBatcher(Actor):
             if k == 0:
                 return
         self._arm_flush()
+        if len(self._seen_keys) < _MAX_TRACKED_KEYS:
+            # Distinct sessions behind the owned_keys gauge: one
+            # vectorized unique over the admitted pseudonym column --
+            # no per-command Python.
+            self._seen_keys.update(
+                np.unique(colrun.cols[:k, 1]).tolist())
         # Ownership contract: the parser output may view the
         # transport's receive buffer, which is compacted after this
         # dispatch returns. Staging past the dispatch takes ownership.
@@ -245,14 +329,21 @@ class IngestBatcher(Actor):
             if self._admit(message, 1):
                 self._arm_flush()
                 self._staged_commands.append(message.command)
+                self._track_key(
+                    message.command.command_id.client_pseudonym)
         elif name == "ClientRequestArray":
             if self._admit(message, len(message.commands)):
                 self._arm_flush()
                 self._staged_commands.extend(message.commands)
+                for command in message.commands:
+                    self._track_key(command.command_id.client_pseudonym)
+        elif isinstance(message, IngestCredit):
+            self._handle_credit(message)
         elif isinstance(message, NotLeaderIngest):
             self._handle_not_leader(src, message)
         elif self.router.is_info_reply(message):
             self.router.note_info(message)
+            self._note_leader_changes()
             self._resend_pending()
         else:
             self.logger.fatal(
@@ -260,14 +351,42 @@ class IngestBatcher(Actor):
 
     def _handle_not_leader(self, src: Address,
                            bounce: NotLeaderIngest) -> None:
+        # A bounced run is out of the window -- it re-enters on resend.
+        self._inflight[bounce.group_index].discard(bounce.run.seq)
         self._pending_runs.append((bounce.group_index, bounce.run))
         request = self.router.info_request()
         for dst in self.router.discovery_targets(bounce.group_index):
             self.send(dst, request)
 
+    def _handle_credit(self, credit: IngestCredit) -> None:
+        """Leader ack: every seq <= watermark drained; reopen window."""
+        group = credit.group_index
+        inflight = self._inflight[group]
+        for seq in [s for s in inflight if s <= credit.watermark_seq]:
+            inflight.discard(seq)
+        self._stall_ticks[group] = 0
+        self._pump(group)
+
+    def _note_leader_changes(self) -> None:
+        """A leader change voids that group's outstanding credits: the
+        new leader never saw the old in-flight runs (resends go through
+        _pending_runs), so holding the window shut against it would
+        wedge the pipeline."""
+        for group in range(self.router.num_groups):
+            leader = self.router.leader(group)
+            if leader != self._last_leader[group]:
+                if self._last_leader[group] is not None:
+                    self.failovers += 1
+                    self._note_failover()
+                    self._inflight[group].clear()
+                    self._stall_ticks[group] = 0
+                self._last_leader[group] = leader
+                self._pump(group)
+
     def _resend_pending(self) -> None:
         pending, self._pending_runs = self._pending_runs, []
         for group, run in pending:
+            self._inflight[group].add(run.seq)
             self.send(self.router.leader(group), run)
 
     # --- flush ------------------------------------------------------------
@@ -280,6 +399,11 @@ class IngestBatcher(Actor):
             staged, self._staged_columns = self._staged_columns, []
             for colrun, k in staged:
                 values = colrun.lazy_values(k)
+                # paxlint: disable=OWN1101 -- lazy_values wraps
+                # colrun.raw, which ingest_scan returns as an OWNED
+                # bytes copy (never the receive buffer; buf is the
+                # borrowed side and to_owned() already copied it at
+                # staging), so queuing past the drain is safe.
                 self._ship(self.router.choose_group(self.rng),
                            values, nbytes=len(values.raw))
         if self._staged_commands:
@@ -296,11 +420,53 @@ class IngestBatcher(Actor):
                            tuple(CommandBatch((c,)) for c in chunk))
 
     def _ship(self, group: int, values, nbytes: int = 0) -> None:
-        run = IngestRun(batcher_index=self.index, values=values)
-        self.send(self.router.leader(group), run)
+        self._window_queue[group].append((values, nbytes))
+        self._pump(group)
+
+    def _pump(self, group: int) -> None:
+        """Ship queued runs up to the pipeline window. seq is assigned
+        at ACTUAL ship time (not staging time) so the per-(batcher,
+        group) stream stays gap-free and monotone even when runs sit
+        queued behind a closed window."""
+        window = self.options.pipeline_window
+        queue = self._window_queue[group]
+        inflight = self._inflight[group]
+        metrics = self.transport.runtime_metrics
+        shipped = 0
+        while queue and (window <= 0 or len(inflight) < window):
+            values, nbytes = queue.popleft()
+            seq = self._next_seq[group]
+            self._next_seq[group] += 1
+            run = IngestRun(batcher_index=self.index, values=values,
+                            seq=seq)
+            if window > 0:
+                inflight.add(seq)
+            self.send(self.router.leader(group), run)
+            shipped += len(values)
+            if metrics is not None:
+                raw = getattr(values, "raw", None)
+                metrics.ingest_batch(
+                    len(values),
+                    nbytes or (len(raw) + 8 if raw is not None else 0))
+        if metrics is not None:
+            if shipped:
+                metrics.ingest_shard_routed(self.index, shipped)
+            metrics.ingest_shard_state(
+                self.index, owned_keys=len(self._seen_keys),
+                pipeline_depth=sum(len(s) for s in self._inflight),
+                skew=self.ring_skew)
+        if queue and self._flush_timer is not None:
+            # Window closed with work still queued: the safety-net
+            # tick is the credit-loss backstop, keep it armed.
+            self._flush_timer.stop()
+            self._flush_timer.start()
+
+    # --- shard telemetry --------------------------------------------------
+    def _track_key(self, pseudonym: int) -> None:
+        if len(self._seen_keys) < _MAX_TRACKED_KEYS:
+            self._seen_keys.add(pseudonym)
+
+    def _note_failover(self) -> None:
         metrics = self.transport.runtime_metrics
         if metrics is not None:
-            raw = getattr(values, "raw", None)
-            metrics.ingest_batch(
-                len(values),
-                nbytes or (len(raw) + 8 if raw is not None else 0))
+            metrics.ingest_shard_failover(self.index)

@@ -251,6 +251,60 @@ class AckColumns:
         return len(self.rows)
 
 
+# --- client reply columns ----------------------------------------------------
+# The RETURN-path twin (paxfan): a ClientReplyArray frame (tag 118) --
+# a replica's per-client fan-out for one ChosenRun drain, or several of
+# them merged by the flush-time coalescer -- lands as ONE (n, 5) int64
+# array of (pseudonym, client_id, slot, result_off, result_len) rows.
+# An open-loop SoA client acks a whole drain of replies with numpy
+# column ops, never one ClientReply tuple per command.
+
+#: multipaxos wire.ClientReplyArrayCodec.tag -- the reply-array frame a
+#: reply sink registers for.
+REPLY_ARRAY_TAG = 118
+
+#: Column indices in ``ReplyColumns.cols``.
+RCOL_PSEUDONYM, RCOL_ID, RCOL_SLOT, RCOL_OFF, RCOL_LEN = range(5)
+
+
+class ReplyColumns:
+    """One reply-array frame's entries as SoA columns over undecoded
+    bytes (the return-path :class:`ColumnRun`)."""
+
+    __slots__ = ("cols", "buf")
+
+    def __init__(self, cols: np.ndarray, buf):
+        self.cols = cols
+        self.buf = buf
+
+    def __len__(self) -> int:
+        return len(self.cols)
+
+    def result_bytes(self, i: int) -> bytes:
+        off = int(self.cols[i, RCOL_OFF])
+        return bytes(self.buf[off:off + int(self.cols[i, RCOL_LEN])])
+
+    def to_owned(self) -> "ReplyColumns":
+        """Ownership-safe twin (see :meth:`ColumnRun.to_owned`): sinks
+        MUST call this before staging past the dispatch (OWN1105)."""
+        if type(self.buf) is bytes:
+            return self
+        return ReplyColumns(cols=self.cols, buf=bytes(self.buf))
+
+
+def parse_reply_array(data) -> "Optional[ReplyColumns]":
+    """One-pass scan of a ClientReplyArray frame payload (leading tag
+    118 included) into ReplyColumns. None = unsupported shape (the
+    caller falls back to per-message decode); ValueError = torn/corrupt
+    (the transport's corrupt-frame containment channel)."""
+    if not len(data) or data[0] != REPLY_ARRAY_TAG:
+        return None
+    cols = native.reply_columns(data, 1)
+    if cols is None:
+        return None
+    return ReplyColumns(cols=cols, buf=data)
+
+
 def parse_ack_batch(data) -> "Optional[AckColumns]":
     """Scan a control batch frame of vote acks into range rows. None =
     some segment is not an ack shape (fall back to per-message decode);

@@ -1,4 +1,4 @@
-"""paxingest wire messages (codecs in ingest/wire.py, tags 204-205)."""
+"""paxingest wire messages (codecs in ingest/wire.py, tags 204-205 + 210)."""
 
 from __future__ import annotations
 
@@ -15,10 +15,17 @@ class IngestRun:
     raw bytes into ``Phase2aRun`` without parsing them) or a plain
     tuple on the sim/fallback path. The leader only ever touches run
     METADATA: ``len(values)`` for slot assignment and admission, the
-    raw segment for the proposal."""
+    raw segment for the proposal.
+
+    ``seq`` (paxfan) numbers this batcher's runs per destination
+    group, monotonically from 0: batchers PIPELINE descriptors ahead
+    of leader acks up to a bounded per-(batcher, group) window, and
+    the leader's :class:`IngestCredit` replies carry the drained
+    watermark that reopens it."""
 
     batcher_index: int
     values: tuple  # tuple[CommandBatchOrNoop, ...] | LazyValueArray
+    seq: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,3 +37,17 @@ class NotLeaderIngest:
 
     group_index: int
     run: IngestRun
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestCredit:
+    """The leader's watermark-granular credit reply: every run with
+    ``seq <= watermark_seq`` from this batcher for ``group_index`` has
+    been drained into proposals (or bounced). ONE credit per batcher
+    per leader drain (accumulated in the handler, flushed on_drain),
+    not one per run -- the return path stays O(batchers) per pass.
+    Control-lane: credits must survive client-lane shedding or the
+    window wedges shut."""
+
+    group_index: int
+    watermark_seq: int

@@ -1,11 +1,13 @@
-"""Fixed-layout codecs for the ingest plane (extended tags 204-205).
+"""Fixed-layout codecs for the ingest plane (extended tags 204-205, 210).
 
 ``IngestRun`` is the disseminator/sequencer hot path: its payload is
 the run pipeline's canonical value-array segment, so a batcher that
 scanned client frames into columns encodes the run as a RAW COPY, and
 the leader's ``Phase2aRun`` re-encode is another raw copy -- the bytes
-a client put on the wire reach the acceptors untouched. Both codecs
-are fuzz-gated in the PR 3 corrupt-frame completeness gate
+a client put on the wire reach the acceptors untouched. ``seq``
+(paxfan descriptor pipelining) rides as a fixed i64 ahead of the
+segment; ``IngestCredit`` is the leader's 12-byte watermark reply.
+All codecs are fuzz-gated in the PR 3 corrupt-frame completeness gate
 (tests/test_wire_codecs.py).
 """
 
@@ -13,7 +15,11 @@ from __future__ import annotations
 
 import struct
 
-from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest
+from frankenpaxos_tpu.ingest.messages import (
+    IngestCredit,
+    IngestRun,
+    NotLeaderIngest,
+)
 from frankenpaxos_tpu.protocols.multipaxos.wire import (
     _put_value_array,
     _take_value_array,
@@ -22,6 +28,8 @@ from frankenpaxos_tpu.runtime.serializer import MessageCodec, register_codec
 
 _I32 = struct.Struct("<i")
 _I32I32 = struct.Struct("<ii")
+_I32Q = struct.Struct("<iq")
+_I32I32Q = struct.Struct("<iiq")
 
 
 class IngestRunCodec(MessageCodec):
@@ -29,14 +37,14 @@ class IngestRunCodec(MessageCodec):
     tag = 204
 
     def encode(self, out, message):
-        out += _I32.pack(message.batcher_index)
+        out += _I32Q.pack(message.batcher_index, message.seq)
         _put_value_array(out, message.values)
 
     def decode(self, buf, at):
-        (batcher_index,) = _I32.unpack_from(buf, at)
-        values, at = _take_value_array(buf, at + 4)
+        batcher_index, seq = _I32Q.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 12)
         return IngestRun(batcher_index=batcher_index,
-                         values=values), at
+                         values=values, seq=seq), at
 
 
 class NotLeaderIngestCodec(MessageCodec):
@@ -44,18 +52,33 @@ class NotLeaderIngestCodec(MessageCodec):
     tag = 205
 
     def encode(self, out, message):
-        out += _I32I32.pack(message.group_index,
-                            message.run.batcher_index)
+        out += _I32I32Q.pack(message.group_index,
+                             message.run.batcher_index,
+                             message.run.seq)
         _put_value_array(out, message.run.values)
 
     def decode(self, buf, at):
-        group_index, batcher_index = _I32I32.unpack_from(buf, at)
-        values, at = _take_value_array(buf, at + 8)
+        group_index, batcher_index, seq = _I32I32Q.unpack_from(buf, at)
+        values, at = _take_value_array(buf, at + 16)
         return NotLeaderIngest(
             group_index=group_index,
             run=IngestRun(batcher_index=batcher_index,
-                          values=values)), at
+                          values=values, seq=seq)), at
+
+
+class IngestCreditCodec(MessageCodec):
+    message_type = IngestCredit
+    tag = 210
+
+    def encode(self, out, message):
+        out += _I32Q.pack(message.group_index, message.watermark_seq)
+
+    def decode(self, buf, at):
+        group_index, watermark_seq = _I32Q.unpack_from(buf, at)
+        return IngestCredit(group_index=group_index,
+                            watermark_seq=watermark_seq), at + 12
 
 
 register_codec(IngestRunCodec())
 register_codec(NotLeaderIngestCodec())
+register_codec(IngestCreditCodec())

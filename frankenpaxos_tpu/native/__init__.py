@@ -83,6 +83,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fpx_value_columns.argtypes = [
             u8p, ctypes.c_uint64, i64p, ctypes.c_uint32,
             ctypes.c_uint32]
+        lib.fpx_reply_columns.restype = ctypes.c_longlong
+        lib.fpx_reply_columns.argtypes = [
+            u8p, ctypes.c_uint64, i64p, ctypes.c_uint32]
         _lib = lib
     except (OSError, subprocess.CalledProcessError):
         _load_failed = True
@@ -486,6 +489,69 @@ def value_columns(raw, n: int, max_cmds: int = 1 << 20):
     if got < 0:
         return None
     return cols[:n]
+
+
+_REPLY_ENTRY_HDR = struct.Struct("<qqq")  # pseudonym, client_id, slot
+
+
+def _py_reply_columns(buf, at: int, max_replies: int):
+    n_left = len(buf) - at
+    if n_left < 4:
+        raise ValueError("malformed reply array: short count header")
+    (n,) = struct.unpack_from("<i", buf, at)
+    if n < 0 or 4 + 28 * n > n_left:
+        raise ValueError(
+            f"malformed reply array: count {n} exceeds payload")
+    if n > max_replies:
+        return None
+    cols = np.empty((n, _COLS), dtype=np.int64)
+    pos = at + 4
+    for i in range(n):
+        if pos + 28 > len(buf):
+            raise ValueError("malformed reply array: torn entry")
+        pseudonym, client_id, slot = _REPLY_ENTRY_HDR.unpack_from(
+            buf, pos)
+        (rlen,) = _U32LE.unpack_from(buf, pos + 24)
+        if pos + 28 + rlen > len(buf):
+            raise ValueError(
+                "malformed reply array: result overruns payload")
+        cols[i] = (pseudonym, client_id, slot, pos + 28, rlen)
+        pos += 28 + rlen
+    if pos != len(buf):
+        raise ValueError("malformed reply array: trailing garbage")
+    return cols
+
+
+def reply_columns(buf, at: int = 1, max_replies: int = 1 << 20):
+    """A ClientReplyArray payload's entries as (n, 5) int64 SoA columns
+    of (pseudonym, client_id, slot, result_off, result_len) -- the
+    RETURN-path twin of :func:`ingest_scan`. ``buf[at:]`` starts at the
+    i32 entry count (the leading tag byte consumed by the caller);
+    offsets are absolute into ``buf``. None when the count exceeds
+    ``max_replies``; ValueError on a torn/corrupt payload (the
+    corrupt-frame containment channel)."""
+    lib = load()
+    if lib is None:
+        return _py_reply_columns(buf, at, max_replies)
+    n_left = len(buf) - at
+    # Capacity bound mirrors the native pre-cap check: every entry
+    # consumes >= 28 payload bytes.
+    cap = min(max_replies, max(n_left, 0) // 28 + 1)
+    cols = np.empty((cap, _COLS), dtype=np.int64)
+    ptr, keepalive = _as_u8p_view(buf, at)
+    try:
+        n = lib.fpx_reply_columns(
+            ptr, n_left,
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+    finally:
+        del ptr, keepalive
+    if n == -1:
+        raise ValueError("malformed reply array")
+    if n < 0:
+        return None  # -2: count past max_replies
+    cols = cols[:n]
+    cols[:, 3] += at
+    return cols
 
 
 def pack_votes(slots: np.ndarray, nodes: np.ndarray,

@@ -359,6 +359,48 @@ long long fpx_value_columns(const uint8_t* buf, uint64_t len, int64_t* cols,
   return n;
 }
 
+// --- paxfan reply columns (ingest/columns.py, docs/TRANSPORT.md) -----------
+// A ClientReplyArray payload's entries as SoA columns -- the RETURN-path
+// twin of fpx_ingest_scan. ``buf`` starts at the i32 entry count (the
+// leading tag byte already consumed by the caller). Entry layout
+// (protocols/multipaxos/wire.py ClientReplyArrayCodec, tag 118):
+//   [i64 pseudonym][i64 client_id][i64 slot][u32 result_len][result]
+// cols rows are (pseudonym, client_id, slot, result_off, result_len),
+// offsets relative to ``buf``. Returns n >= 0 on success, -1 on a
+// torn/corrupt payload, -2 when the count exceeds the caller's cap.
+long long fpx_reply_columns(const uint8_t* buf, uint64_t len, int64_t* cols,
+                            uint32_t cap) {
+  if (len < 4) return -1;
+  int32_t n_signed;
+  std::memcpy(&n_signed, buf, 4);
+  if (n_signed < 0) return -1;
+  const uint32_t n = static_cast<uint32_t>(n_signed);
+  // Every entry consumes >= 28 bytes, so a count past len / 28 is torn
+  // regardless of cap -- checked BEFORE the cap so hostile counts are
+  // corruption, not a silent fallback.
+  if (4ull + 28ull * n > len) return -1;
+  if (n > cap) return -2;
+  uint64_t at = 4;
+  for (uint32_t i = 0; i < n; ++i) {
+    if (at + 28 > len) return -1;
+    int64_t pseudonym, client_id, slot;
+    std::memcpy(&pseudonym, buf + at, 8);
+    std::memcpy(&client_id, buf + at + 8, 8);
+    std::memcpy(&slot, buf + at + 16, 8);
+    uint32_t rlen;
+    std::memcpy(&rlen, buf + at + 24, 4);
+    if (at + 28ull + rlen > len) return -1;
+    cols[5ull * i + 0] = pseudonym;
+    cols[5ull * i + 1] = client_id;
+    cols[5ull * i + 2] = slot;
+    cols[5ull * i + 3] = static_cast<int64_t>(at + 28);
+    cols[5ull * i + 4] = rlen;
+    at += 28ull + rlen;
+  }
+  if (at != len) return -1;
+  return n;
+}
+
 // --- Phase2b vote-batch codec ---------------------------------------------
 // Wire layout: [u32 count][count * (i32 slot, i32 node, i32 round)] with
 // little-endian fixed-width ints (the host side hands these straight to

@@ -218,6 +218,36 @@ class RuntimeMetrics:
             "fpx_runtime_ingest_batch_fill",
             help="Commands per ingest run descriptor (batch fill)",
             labels=("role",)).labels(role)
+        # paxfan (ingest/fan.py): per-shard fan-in health for the
+        # N-batcher ring -- distinct sessions pinned to this shard
+        # (capped gauge), commands routed through it, the descriptor-
+        # pipelining window depth, failovers absorbed, and the shard's
+        # structural ring skew (arc share x N; 1.0 = perfectly even).
+        self._shard_owned = collectors.gauge(
+            "fpx_runtime_ingest_shard_owned_keys",
+            help="Distinct client sessions (pseudonyms) observed by "
+                 "this ingest shard (capped tracking set)",
+            labels=("role", "shard"))
+        self._shard_routed = collectors.counter(
+            "fpx_runtime_ingest_shard_routed_cmds_total",
+            help="Client commands shipped onward by this ingest shard",
+            labels=("role", "shard"))
+        self._shard_depth = collectors.gauge(
+            "fpx_runtime_ingest_shard_pipeline_depth",
+            help="Un-credited IngestRuns in flight from this shard "
+                 "(descriptor-pipelining window occupancy)",
+            labels=("role", "shard"))
+        self._shard_failovers = collectors.counter(
+            "fpx_runtime_ingest_shard_failovers_total",
+            help="Leader changes and wedged-window resets absorbed by "
+                 "this ingest shard",
+            labels=("role", "shard"))
+        self._shard_skew = collectors.gauge(
+            "fpx_runtime_ingest_shard_ring_skew",
+            help="Structural routing skew of this shard's ring arcs "
+                 "(hash-space share x num_batchers; 1.0 = even)",
+            labels=("role", "shard"))
+        self._shard_children: dict = {}
         # paxworld (scenarios/, docs/GLOBAL.md): per-region serving
         # health for the Grafana "Global serving" band -- commands
         # committed and client commands rejected/shed, labeled by the
@@ -356,6 +386,34 @@ class RuntimeMetrics:
         if nbytes:
             self._ingest_bytes.inc(nbytes)
         self._ingest_fill.observe(cmds)
+
+    # --- paxfan sharded fan-in (ingest/fan.py) --------------------------
+    def _shard_family(self, shard: int):
+        children = self._shard_children.get(shard)
+        if children is None:
+            label = str(shard)
+            children = (
+                self._shard_owned.labels(self.role, label),
+                self._shard_routed.labels(self.role, label),
+                self._shard_depth.labels(self.role, label),
+                self._shard_failovers.labels(self.role, label),
+                self._shard_skew.labels(self.role, label),
+            )
+            self._shard_children[shard] = children
+        return children
+
+    def ingest_shard_routed(self, shard: int, cmds: int) -> None:
+        self._shard_family(shard)[1].inc(cmds)
+
+    def ingest_shard_state(self, shard: int, *, owned_keys: int,
+                           pipeline_depth: int, skew: float) -> None:
+        owned, _, depth, _, skew_g = self._shard_family(shard)
+        owned.set(owned_keys)
+        depth.set(pipeline_depth)
+        skew_g.set(skew)
+
+    def ingest_shard_failover(self, shard: int) -> None:
+        self._shard_family(shard)[3].inc()
 
     # --- paxworld global serving (scenarios/) ---------------------------
     def region_goodput(self, region: str, n: int = 1) -> None:

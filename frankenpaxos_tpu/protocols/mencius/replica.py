@@ -415,17 +415,38 @@ class MenciusClient(RetryAdmissionMixin, StagedWriteMixin, Actor):
         self.ids: dict[int, int] = {}
         self.states: dict[int, _PendingWrite] = {}
         self._init_staging()
+        # paxfan: consistent ring over the ingest-batcher tier (see
+        # the multipaxos client) -- sessions pin to shards; timeouts
+        # suspect one shard; Rejected floors backoff per shard.
+        from frankenpaxos_tpu.runs.routing import make_fan_router
+
+        self._fan = make_fan_router(config,
+                                    revive_after_s=resend_period_s)
 
     def _random_group_leader(self) -> Address:
         group = self.rng.randrange(self.config.num_leader_groups)
         return self._leader_of_group(group)
 
     def _send_request(self, request: ClientRequest) -> None:
-        # runs/routing ladder (ingest batchers > batchers > a random
-        # group's leader: any group can sequence any command).
-        dst = pick_request_destination(self.config, self.rng,
-                                       self._random_group_leader)
+        # runs/routing ladder (ingest batchers, ring-pinned per
+        # session > batchers > a random group's leader: any group can
+        # sequence any command).
+        dst = pick_request_destination(
+            self.config, self.rng, self._random_group_leader,
+            fan=self._fan,
+            key=(self.address, request.command.command_id.client_pseudonym))
         self.send(dst, request)
+
+    def _note_shed_source(self, src: Address, rejected) -> float:
+        if self._fan is None:
+            return 0.0
+        from frankenpaxos_tpu.ingest.fan import shard_of_address
+
+        shard = shard_of_address(self.config, src)
+        if shard < 0:
+            return 0.0
+        self._fan.note_shed(shard, rejected.retry_after_ms)
+        return self._fan.floor_delay_s(shard)
 
     def _leader_of_group(self, group: int) -> Address:
         rs = ClassicRoundRobin(len(self.config.leader_addresses[group]))
@@ -434,9 +455,12 @@ class MenciusClient(RetryAdmissionMixin, StagedWriteMixin, Actor):
 
     def _flush_staged(self, staged: list) -> None:
         """Ship writes staged by ``coalesce_writes`` as one array to a
-        random leader group (any group can sequence any command)."""
+        random leader group (any group can sequence any command); the
+        array rides the client-scoped ring key (pseudonym -1)."""
         dst = pick_array_destination(self.config, self.rng,
-                                     self._random_group_leader)
+                                     self._random_group_leader,
+                                     fan=self._fan,
+                                     key=(self.address, -1))
         self.send(dst, ClientRequestArray(commands=tuple(staged)))
 
     def write(self, pseudonym: int, command: bytes,
@@ -458,6 +482,10 @@ class MenciusClient(RetryAdmissionMixin, StagedWriteMixin, Actor):
                     or not self._consume_retry(pseudonym, state,
                                                "failover"):
                 return
+            if self._fan is not None:
+                # paxfan: suspect this key's shard so the resend
+                # routes past it; other keys stay pinned.
+                self._fan.suspect_key(self.address, pseudonym)
             self._send_request(request)
             timer.start()
 

@@ -246,6 +246,10 @@ class MenciusLeader(Actor):
         self._commands_since_watermark_send = 0
         self._current_proxy_leader = self.rng.randrange(
             config.num_proxy_leaders)
+        # paxfan descriptor pipelining: per-batcher drained-seq
+        # high-water, flushed as ONE IngestCredit per batcher per
+        # drain (the multipaxos leader's twin).
+        self._ingest_credit_hw: dict = {}
 
         self.election = ElectionParticipant(
             config.leader_election_addresses[self.group_index][self.index],
@@ -444,6 +448,11 @@ class MenciusLeader(Actor):
             self.send(src, NotLeaderIngest(group_index=self.group_index,
                                            run=run))
             return
+        # Credit the batcher's pipelining window (see the multipaxos
+        # twin): consumed on every non-bounce path below.
+        hw = self._ingest_credit_hw.get(src)
+        if hw is None or run.seq > hw:
+            self._ingest_credit_hw[src] = run.seq
         k = n
         admission = self.admission
         if admission is not None:
@@ -463,6 +472,18 @@ class MenciusLeader(Actor):
             return
         self._note_ingest(k, len(getattr(values, "raw", b"")))
         self._propose_value_run(values)
+
+    def on_drain(self) -> None:
+        """Flush accumulated pipelining credits: ONE watermark-granular
+        IngestCredit per batcher per drain. Control-lane, so shedding
+        never wedges the batchers' windows."""
+        if self._ingest_credit_hw:
+            from frankenpaxos_tpu.ingest.messages import IngestCredit
+
+            credits, self._ingest_credit_hw = self._ingest_credit_hw, {}
+            for src, hw in credits.items():
+                self.send(src, IngestCredit(
+                    group_index=self.group_index, watermark_seq=hw))
 
     def _process_request_array(self, array: ClientRequestArray) -> None:
         """A drain's worth of independent requests: assign each its own

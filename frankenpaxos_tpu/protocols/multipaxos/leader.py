@@ -35,7 +35,11 @@ from frankenpaxos_tpu.ingest.columns import (
     reject_value_suffix,
     value_view,
 )
-from frankenpaxos_tpu.ingest.messages import IngestRun, NotLeaderIngest
+from frankenpaxos_tpu.ingest.messages import (
+    IngestCredit,
+    IngestRun,
+    NotLeaderIngest,
+)
 from frankenpaxos_tpu.protocols.multipaxos.config import (
     DistributionScheme,
     MultiPaxosConfig,
@@ -238,6 +242,11 @@ class Leader(Actor):
             CLIENT_ARRAY_TAG: (parse_client_array,
                                self._handle_client_columns),
         }
+        # paxfan descriptor pipelining: per-batcher drained-seq
+        # high-water accumulated across one event-loop pass (the leader
+        # drains SEVERAL pipelined runs per pass) and flushed as ONE
+        # IngestCredit per batcher in on_drain.
+        self._ingest_credit_hw: dict = {}
 
         # Embedded election participant (Leader.scala:192-203).
         self.election = ElectionParticipant(
@@ -846,6 +855,17 @@ class Leader(Actor):
         if metrics is not None:
             metrics.ingest_batch(cmds, nbytes)
 
+    def on_drain(self) -> None:
+        """Flush accumulated pipelining credits: ONE watermark-granular
+        IngestCredit per batcher per drain, regardless of how many runs
+        this pass consumed. Control-lane (serve/lanes.py), so shedding
+        never wedges the batchers' windows."""
+        if self._ingest_credit_hw:
+            credits, self._ingest_credit_hw = self._ingest_credit_hw, {}
+            for src, hw in credits.items():
+                self.send(src, IngestCredit(group_index=0,
+                                            watermark_seq=hw))
+
     def _handle_client_columns(self, src: Address, colrun) -> None:
         """Wire-sink handler: a whole ClientFrameBatch as SoA columns.
         The hot branch proposes the frame as ONE Phase2aRun whose value
@@ -895,6 +915,13 @@ class Leader(Actor):
         if isinstance(self.state, _Inactive):
             self.send(src, NotLeaderIngest(group_index=0, run=run))
             return
+        # Credit the batcher's pipelining window: this run is consumed
+        # on every non-bounce path below (proposed, Phase1-buffered, or
+        # fully rejected back to clients). Accumulated per batcher,
+        # flushed once in on_drain.
+        hw = self._ingest_credit_hw.get(src)
+        if hw is None or run.seq > hw:
+            self._ingest_credit_hw[src] = run.seq
         k = n
         admission = self.admission
         if admission is not None:

@@ -931,10 +931,36 @@ def _coalesce_phase2b(payloads: list):
     return bytes(out)
 
 
+def _coalesce_client_replies(payloads: list):
+    """paxwire coalescer for runs of tag-118 (ClientReplyArray)
+    payloads: one drain can queue several reply arrays to one client
+    (one per ChosenRun executed that pass); merge them so the drain's
+    replies to that client flush as ONE frame -- and the client's
+    reply sink scans ONE column batch (ingest/columns.py
+    ReplyColumns). Entries are independent acks, so concatenation in
+    send order preserves semantics. Returns None (decline) on any
+    unexpected layout."""
+    total = 0
+    for payload in payloads:
+        if len(payload) < 5 or payload[0] != ClientReplyArrayCodec.tag:
+            return None
+        (n,) = _I32.unpack_from(payload, 1)
+        if n < 0:
+            return None
+        total += n
+    out = bytearray((ClientReplyArrayCodec.tag,))
+    out += _I32.pack(total)
+    for payload in payloads:
+        out += payload[5:]
+    return bytes(out)
+
+
 def _register_coalescers() -> None:
     from frankenpaxos_tpu.runtime import paxwire
 
     paxwire.register_coalescer(Phase2bCodec.tag, _coalesce_phase2b)
+    paxwire.register_coalescer(ClientReplyArrayCodec.tag,
+                               _coalesce_client_replies)
 
 
 # --- cold-path codecs (COD301 burn-down, extended tags 153-156) -------------

@@ -63,10 +63,10 @@ RUNTIME_ROW_TITLE = ("Runtime (drain stages / queue depth / WAL fsync / "
 #: Total grid height of the runtime row: header (1) + the paxtrace
 #: band (8) + the paxload admission band (8) + the paxwire transport
 #: band (8) + the paxworld global-serving band (8) + the paxingest
-#: ingestion band (8) + the paxpulse device-pipeline band (8).
-#: dashboard() and inject_runtime_row() both lay out protocol panels
-#: below this line.
-RUNTIME_ROW_H = 49
+#: ingestion band (8) + the paxfan shard band (8) + the paxpulse
+#: device-pipeline band (8). dashboard() and inject_runtime_row()
+#: both lay out protocol panels below this line.
+RUNTIME_ROW_H = 57
 
 
 def runtime_row_panels(y: int = 0) -> list:
@@ -115,7 +115,7 @@ def runtime_row_panels(y: int = 0) -> list:
     commit_rate = _panel(
         9016, "Device pipeline: committed / proposed rate",
         "sum by (role) (rate(fpx_pipeline_committed_total[5s]))",
-        "committed {{role}}", "ops", x=0, y=y + 41, w=4,
+        "committed {{role}}", "ops", x=0, y=y + 49, w=4,
         extra=[
             ("sum by (role) (rate(fpx_pipeline_proposed_total[5s]))",
              "proposed {{role}}"),
@@ -125,21 +125,21 @@ def runtime_row_panels(y: int = 0) -> list:
     shard_band = _panel(
         9017, "Device pipeline: per-shard committed + skew",
         "fpx_pipeline_shard_committed",
-        "shard {{shard}}", "short", x=4, y=y + 41, w=4,
+        "shard {{shard}}", "short", x=4, y=y + 49, w=4,
         extra=[("fpx_pipeline_shard_skew_ratio",
                 "skew {{role}}")])
     lag_band = _panel(
         9019, "Device pipeline: watermark lag + pad waste",
         "sum by (bucket) "
         "(rate(fpx_pipeline_watermark_lag_total[5s]))",
-        "lag bucket {{bucket}}", "ops", x=12, y=y + 41, w=4,
+        "lag bucket {{bucket}}", "ops", x=12, y=y + 49, w=4,
         extra=[("sum by (role) "
                 "(rate(fpx_pipeline_pad_lanes_total[5s]))",
                 "pad lanes {{role}}")])
     fill_band = _panel(
         9020, "Device pipeline: proposal batch fill",
         "fpx_pipeline_batch_fill",
-        "fill {{role}}", "percentunit", x=16, y=y + 41, w=4)
+        "fill {{role}}", "percentunit", x=16, y=y + 49, w=4)
     return [
         {
             "id": 9000,
@@ -222,6 +222,32 @@ def runtime_row_panels(y: int = 0) -> list:
             "sum by (role) "
             "(rate(fpx_runtime_ingest_batch_fill_count[5s]))",
             "{{role}}", "short", x=16, y=y + 33, w=8),
+        # paxfan shard band (ingest/fan.py, docs/TRANSPORT.md
+        # "Scale-out fan-in"): per-shard fan-in health for the
+        # N-batcher ring -- sessions pinned per shard plus the
+        # structural ring-skew gauge, commands routed per shard, the
+        # descriptor-pipelining window occupancy, and failovers
+        # absorbed (leader changes + wedged-window voids).
+        _panel(
+            9022, "Ingest shards: owned sessions + ring skew",
+            "fpx_runtime_ingest_shard_owned_keys",
+            "shard {{shard}}", "short", x=0, y=y + 41, w=6,
+            extra=[("fpx_runtime_ingest_shard_ring_skew",
+                    "skew shard {{shard}}")]),
+        _panel(
+            9023, "Ingest shards: routed cmds/s",
+            "sum by (shard) "
+            "(rate(fpx_runtime_ingest_shard_routed_cmds_total[5s]))",
+            "shard {{shard}}", "ops", x=6, y=y + 41, w=6),
+        _panel(
+            9024, "Ingest shards: pipeline window depth",
+            "fpx_runtime_ingest_shard_pipeline_depth",
+            "shard {{shard}}", "short", x=12, y=y + 41, w=6),
+        _panel(
+            9025, "Ingest shards: failovers absorbed",
+            "sum by (shard) "
+            "(rate(fpx_runtime_ingest_shard_failovers_total[5s]))",
+            "shard {{shard}}", "ops", x=18, y=y + 41, w=6),
         # paxpulse device-pipeline band (ops/telemetry.py +
         # obs/telemetry.py, docs/OBSERVABILITY.md): the counters that
         # ride INSIDE the jitted drain loop as arrays and reach the

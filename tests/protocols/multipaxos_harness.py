@@ -168,6 +168,7 @@ def make_multipaxos(
     leader_admission: dict | None = None,
     client_retry_budget: int = 0,
     client_backoff=None,
+    ingest_pipeline_window: int | None = None,
 ) -> MultiPaxosSim:
     """``wal``: False (reference in-memory behavior), True (MemStorage
     WALs, the crash-restart sims), or a directory path (FileStorage
@@ -217,13 +218,20 @@ def make_multipaxos(
         for a in config.batcher_addresses]
     from frankenpaxos_tpu.ingest import (
         IngestBatcher,
+        IngestBatcherOptions,
         MultiPaxosIngestRouter,
     )
 
+    ingest_options = IngestBatcherOptions()
+    if ingest_pipeline_window is not None:
+        # Chaos rows pin tight descriptor windows so IngestCredit
+        # watermarks are load-bearing under kill/partition, not slack.
+        ingest_options = IngestBatcherOptions(
+            pipeline_window=ingest_pipeline_window)
     ingest_batchers = [
         IngestBatcher(a, transport, logger,
                       MultiPaxosIngestRouter(config), index=i,
-                      seed=seed + 50 + i)
+                      options=ingest_options, seed=seed + 50 + i)
         for i, a in enumerate(config.ingest_batcher_addresses)]
     read_batchers = [
         ReadBatcher(a, transport, logger, config, read_batching_scheme,

@@ -74,7 +74,12 @@ class MultiPaxosIngestSimulated(MultiPaxosWalSimulated):
     dict(f=1, num_ingest_batchers=2),
     dict(f=1, num_ingest_batchers=2, coalesced=True),
     dict(f=2, num_ingest_batchers=3, coalesced="mixed"),
-], ids=["f1", "f1-coalesced", "f2-mixed"])
+    # paxfan scale-out: a 4-shard ring with a 1-run descriptor window
+    # -- every ship blocks on an IngestCredit watermark, so batcher
+    # kills interleaved with partitions and leader changes exercise
+    # the credit/void/resend machinery, not just staging loss.
+    dict(f=1, num_ingest_batchers=4, ingest_pipeline_window=1),
+], ids=["f1", "f1-coalesced", "f2-mixed", "f1-ring4-window1"])
 def test_ingest_chaos_no_divergence(kwargs):
     """Regression-smoke scale; tests/soak.py runs 500x250."""
     simulated = MultiPaxosIngestSimulated(**kwargs)

@@ -316,6 +316,12 @@ CONFIGS.extend([
     ("ingest-chaos/multipaxos-f2-batchers3-mixed",
      lambda: MultiPaxosIngestSimulated(f=2, num_ingest_batchers=3,
                                        coalesced="mixed")),
+    # paxfan: the 4-shard ring with a 1-run descriptor window (every
+    # ship waits on an IngestCredit watermark) under the full kill x
+    # partition x leader-change schedule.
+    ("ingest-chaos/multipaxos-ring4-window1",
+     lambda: MultiPaxosIngestSimulated(f=1, num_ingest_batchers=4,
+                                       ingest_pipeline_window=1)),
 ])
 
 # Live reconfiguration interleaved with the WAL chaos schedule

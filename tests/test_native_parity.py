@@ -358,3 +358,65 @@ def test_ingest_ownership_contract_parity_fuzz():
             assert nat[0] == py[0], trial
             assert np.array_equal(nat[1], py[1]), trial
             assert nat[2] == py[2], trial
+
+
+def _reply_array_payload(rng: random.Random, n: int) -> bytes:
+    """A tag-118 ClientReplyArray payload: [118][i32 n] then per entry
+    <qqq>(pseudonym, client_id, slot) + [u32 len][result]."""
+    import struct
+
+    out = bytearray([118])
+    out += struct.pack("<i", n)
+    for _ in range(n):
+        result = _rand_bytes(rng, 0, 24)
+        out += struct.pack("<qqq", rng.randrange(1 << 40),
+                           rng.randrange(1 << 30),
+                           rng.randrange(1 << 30))
+        out += struct.pack("<I", len(result)) + result
+    return bytes(out)
+
+
+def test_reply_columns_parity_fuzz_with_torn_and_corrupt_tails():
+    """The paxfan RETURN-path scan (``fpx_reply_columns`` vs
+    ``_py_reply_columns``): both implementations must agree on the
+    five SoA columns AND the verdict class (columns / None=cap /
+    ValueError=torn-or-corrupt) over random reply arrays, torn tails,
+    bit flips, and hostile counts -- the reply twin of the ingest-scan
+    parity gate."""
+    import struct
+
+    import numpy as np
+
+    rng = random.Random(31)
+    for trial in range(300):
+        payload = _reply_array_payload(rng, rng.randrange(0, 12))
+        mode = trial % 4
+        if mode == 1 and len(payload) > 6:  # torn tail
+            payload = payload[:rng.randrange(2, len(payload))]
+        elif mode == 2 and len(payload) > 6:  # random bit flip
+            corrupt = bytearray(payload)
+            corrupt[rng.randrange(1, len(corrupt))] ^= \
+                1 << rng.randrange(8)
+            payload = bytes(corrupt)
+        elif mode == 3:  # hostile count word
+            corrupt = bytearray(payload)
+            struct.pack_into(
+                "<i", corrupt, 1,
+                rng.choice([-1, -(1 << 30), 1 << 28,
+                            len(payload) // 28 + 2]))
+            payload = bytes(corrupt)
+        max_replies = 1 << 20 if trial % 5 else 4
+        try:
+            nat = native.reply_columns(payload, 1, max_replies)
+            nat_kind = "cap" if nat is None else "ok"
+        except ValueError:
+            nat, nat_kind = None, "corrupt"
+        with _fallback():
+            try:
+                py = native.reply_columns(payload, 1, max_replies)
+                py_kind = "cap" if py is None else "ok"
+            except ValueError:
+                py, py_kind = None, "corrupt"
+        assert nat_kind == py_kind, (trial, nat_kind, py_kind)
+        if nat_kind == "ok":
+            assert np.array_equal(nat, py), trial

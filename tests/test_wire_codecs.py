@@ -975,10 +975,11 @@ def all_codec_samples() -> dict:
                                    new_matchmaker_indices=(6, 7, 8)),
         bp.Recover(vertex_id=bp.VertexId(1, 9)),
     ]
-    # paxingest run descriptors (ingest/wire.py, tags 204-205): the
-    # disseminator/sequencer hot path, including the lazy value-array
-    # boundary.
+    # paxingest run descriptors (ingest/wire.py, tags 204-205 + 210):
+    # the disseminator/sequencer hot path, including the lazy
+    # value-array boundary and the paxfan pipelining seq/credit pair.
     from frankenpaxos_tpu.ingest.messages import (
+        IngestCredit,
         IngestRun,
         NotLeaderIngest,
     )
@@ -988,10 +989,12 @@ def all_codec_samples() -> dict:
         values=(mp.CommandBatch((command,)),
                 mp.CommandBatch((mp.Command(
                     mp.CommandId(("10.0.0.2", 9001), 3, 8),
-                    b"second"),))))
+                    b"second"),))),
+        seq=7)
     samples += [
         ingest_run,
         NotLeaderIngest(group_index=1, run=ingest_run),
+        IngestCredit(group_index=1, watermark_seq=7),
     ]
     # COD301 burn-down, final tranche (tags 206-207, paxown): the
     # simplegcbpaxos snapshot cold path -- the baseline is now empty.
